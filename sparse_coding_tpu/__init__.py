@@ -1,0 +1,21 @@
+"""sparse_coding_tpu — a TPU-native (JAX/XLA/pjit) sparse-coding framework.
+
+A ground-up re-design of the capabilities of HoagyC/sparse_coding (see
+/root/reference) for TPU hardware:
+
+- ensembles of sparse autoencoders trained with a single vmapped+jitted step
+  (reference: autoencoders/ensemble.py uses torch.vmap imitating JAX),
+- data/model sharding over a `jax.sharding.Mesh` replacing the reference's
+  process-per-GPU scheduler (cluster_runs.py) and gloo DDP
+  (experiments/huge_batch_size.py),
+- a pure-JAX LM forward with activation taps replacing transformer_lens
+  `run_with_cache` (activation_dataset.py),
+- metrics, interpretation, and plotting layers mirroring standard_metrics.py,
+  interpret.py and plotting/.
+"""
+
+__version__ = "0.1.0"
+
+from sparse_coding_tpu import config as config
+from sparse_coding_tpu import ensemble as ensemble
+from sparse_coding_tpu import models as models

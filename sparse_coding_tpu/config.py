@@ -1,0 +1,227 @@
+"""Typed config/flag system.
+
+Re-designs the reference's dataclass-as-CLI pattern (reference: config.py:7-27,
+where `BaseArgs.__post_init__` builds an argparse parser from dataclass fields)
+with the same field vocabulary but *no implicit fields*: everything the
+reference attaches ad hoc (`cfg.n_repetitions`, `cfg.center_activations`,
+read at big_sweep.py:351,359) is declared here explicitly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from dataclasses import dataclass, field, fields
+from pathlib import Path
+from typing import Any, Optional, Sequence, Type, TypeVar
+
+T = TypeVar("T", bound="BaseArgs")
+
+_PRIMITIVES = (int, float, str, bool)
+
+
+def _parse_value(raw: str, ftype: Any) -> Any:
+    if ftype is bool:
+        return raw.lower() in ("1", "true", "t", "yes", "y")
+    if ftype in (int, float, str):
+        return ftype(raw)
+    # lists / optionals / anything else: accept JSON
+    return json.loads(raw)
+
+
+@dataclass
+class BaseArgs:
+    """Base config: every subclass gets `from_cli()` and `to_dict()` for free."""
+
+    @classmethod
+    def from_cli(cls: Type[T], argv: Optional[Sequence[str]] = None) -> T:
+        parser = argparse.ArgumentParser(description=cls.__name__)
+        for f in fields(cls):
+            parser.add_argument(f"--{f.name}", type=str, default=None)
+        ns, _ = parser.parse_known_args(argv)
+        overrides = {}
+        for f in fields(cls):
+            raw = getattr(ns, f.name)
+            if raw is not None:
+                overrides[f.name] = _parse_value(raw, f.type if isinstance(f.type, type) else _field_runtime_type(cls, f.name))
+        return cls(**overrides)
+
+    def to_dict(self) -> dict[str, Any]:
+        out = {}
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, Path):
+                v = str(v)
+            out[f.name] = v
+        return out
+
+    def save(self, path: str | Path) -> None:
+        Path(path).parent.mkdir(parents=True, exist_ok=True)
+        Path(path).write_text(json.dumps(self.to_dict(), indent=2, default=str))
+
+    @classmethod
+    def load(cls: Type[T], path: str | Path) -> T:
+        data = json.loads(Path(path).read_text())
+        known = {f.name for f in fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in known})
+
+    def replace(self: T, **kwargs: Any) -> T:
+        return dataclasses.replace(self, **kwargs)
+
+
+def _field_runtime_type(cls: type, name: str) -> Any:
+    """Resolve a dataclass field's runtime type from string annotations."""
+    import typing
+
+    hints = typing.get_type_hints(cls)
+    t = hints.get(name, str)
+    origin = typing.get_origin(t)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        t = args[0] if args else str
+    return t if t in _PRIMITIVES else list
+
+
+# ---------------------------------------------------------------------------
+# Workload configs (field vocabulary mirrors reference config.py:29-143)
+# ---------------------------------------------------------------------------
+
+LAYER_LOCS = ("residual", "mlp", "attn", "attn_concat", "mlpout")
+
+
+@dataclass
+class DataArgs(BaseArgs):
+    """Activation-harvesting / dataset config (reference: config.py TrainArgs
+    fields + generate_test_data.py GenTestArgs)."""
+
+    model_name: str = "EleutherAI/pythia-70m-deduped"
+    dataset_name: str = "NeelNanda/pile-10k"
+    dataset_folder: str = "activation_data"
+    layers: list[int] = field(default_factory=lambda: [2])
+    layer_loc: str = "residual"
+    context_len: int = 256
+    model_batch_size: int = 4
+    chunk_size_gb: float = 2.0
+    n_chunks: int = 1
+    skip_chunks: int = 0
+    center_dataset: bool = False
+    activation_dtype: str = "bfloat16"
+    max_docs: Optional[int] = None
+    seed: int = 0
+
+
+@dataclass
+class EnsembleArgs(BaseArgs):
+    """Ensemble sweep config (reference: config.py EnsembleArgs:54-79 plus
+    implicit fields declared explicitly)."""
+
+    output_folder: str = "output"
+    dataset_folder: str = "activation_data"
+    batch_size: int = 1024
+    lr: float = 1e-3
+    adam_epsilon: float = 1e-8
+    use_wandb: bool = False
+    wandb_images: bool = False
+    dtype: str = "float32"
+    layer: int = 2
+    layer_loc: str = "residual"
+    tied_ae: bool = False
+    seed: int = 0
+    learned_dict_ratio: float = 4.0
+    n_chunks: int = 10
+    # implicit in the reference (big_sweep.py:351,359) — explicit here:
+    n_repetitions: int = 1
+    center_activations: bool = False
+    # TPU additions
+    mesh_data: int = 1  # data-parallel axis size (1 = single chip)
+    mesh_model: int = 1  # ensemble-parallel axis size
+    save_every_chunks: Optional[int] = None  # default: powers of two, like ref
+
+
+@dataclass
+class SyntheticEnsembleArgs(EnsembleArgs):
+    """Synthetic-data sweep (reference: config.py SyntheticEnsembleArgs:60-69)."""
+
+    n_ground_truth_features: int = 512
+    activation_dim: int = 256
+    feature_prob_decay: float = 0.99
+    feature_num_nonzero: int = 5
+    correlated_components: bool = False
+    noise_magnitude_scale: float = 0.0
+    dataset_size: int = 200_000
+
+
+@dataclass
+class ToyArgs(BaseArgs):
+    """Toy-model replication (reference: config.py ToyArgs:81-110)."""
+
+    n_ground_truth_features: int = 256
+    activation_dim: int = 128
+    feature_prob_decay: float = 0.99
+    feature_num_nonzero: int = 5
+    correlated_components: bool = False
+    learned_dict_ratio: float = 1.0
+    l1_alpha: float = 1e-3
+    lr: float = 1e-3
+    batch_size: int = 256
+    epochs: int = 1
+    dataset_size: int = 100_000
+    seed: int = 0
+
+
+@dataclass
+class InterpArgs(BaseArgs):
+    """Auto-interpretation config (reference: config.py InterpArgs:112-127,
+    interpret.py:50-57 constants)."""
+
+    model_name: str = "EleutherAI/pythia-70m-deduped"
+    layer: int = 2
+    layer_loc: str = "residual"
+    learned_dict_path: str = ""
+    output_folder: str = "interp_output"
+    n_feats_to_explain: int = 10
+    fragment_len: int = 64
+    n_fragments: int = 5000
+    top_k_fragments: int = 10
+    n_random_fragments: int = 10
+    batch_size: int = 20
+    provider: str = "offline"  # offline | openai — no import-time secrets (unlike interpret.py:30-32)
+    explainer_model: str = "gpt-4"
+    simulator_model: str = "text-davinci-003"
+    seed: int = 0
+
+
+@dataclass
+class ErasureArgs(BaseArgs):
+    """Concept-erasure eval (reference: config.py ErasureArgs:71-79; the
+    reference's compute script is missing — see SURVEY.md §2.6 — so this
+    framework reconstructs the capability)."""
+
+    model_name: str = "EleutherAI/pythia-410m-deduped"
+    layers: list[int] = field(default_factory=lambda: [4])
+    layer_loc: str = "residual"
+    dict_path: str = ""
+    output_folder: str = "erasure_output"
+    max_edit_feats: int = 64
+    seed: int = 0
+
+
+@dataclass
+class BigSAEArgs(BaseArgs):
+    """Large single-SAE trainer (reference: experiments/huge_batch_size.py
+    config at :163-175,259-274): big batch, dead-feature resurrection."""
+
+    activation_dim: int = 1024
+    n_feats: int = 16384
+    l1_alpha: float = 1e-3
+    lr: float = 1e-3
+    batch_size: int = 65536
+    dataset_folder: str = "activation_data"
+    output_folder: str = "big_sae_output"
+    n_chunks: int = 10
+    n_epochs: int = 1
+    dead_feature_window: int = 100  # steps with no activation => dead
+    resurrect_every: int = 500
+    mesh_data: int = 1
+    seed: int = 0

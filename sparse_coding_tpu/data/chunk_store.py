@@ -1,0 +1,160 @@
+"""Chunked on-disk activation store with device prefetch.
+
+Replaces the reference's `torch.save(i.pt)` chunk files
+(reference: activation_dataset.py:499-503 `save_activation_chunk`, 2 GB fp16
+chunks per :25-27) and its shared-memory DataLoader trick
+(cluster_runs.py:26-32) with:
+
+- `.npy` chunk files named `0.npy, 1.npy, …` (same cursor-style contract as
+  the reference's `0.pt …`), float16 or bfloat16 on disk;
+- a `ChunkStore` reader that mmaps chunks and yields shuffled fixed-size
+  batches;
+- `device_prefetch`, a double-buffering iterator that keeps the TPU fed by
+  overlapping host→device transfer of batch i+1 with compute on batch i —
+  the TPU-native replacement for pinned shared memory.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+_DTYPES = {"float16": np.float16, "float32": np.float32,
+           "bfloat16": jnp.bfloat16}  # ml_dtypes-backed numpy dtype
+
+
+class ChunkWriter:
+    """Accumulates [n, d] activation slabs and flushes ~chunk_size_gb files
+    (reference: make_activation_dataset_tl's accumulate-and-save loop,
+    activation_dataset.py:371-389)."""
+
+    def __init__(self, folder: str | Path, activation_dim: int,
+                 chunk_size_gb: float = 2.0, dtype: str = "bfloat16",
+                 start_index: int = 0, round_rows_to: int = 1):
+        self.folder = Path(folder)
+        self.folder.mkdir(parents=True, exist_ok=True)
+        self.activation_dim = activation_dim
+        self.dtype = np.dtype(_DTYPES[dtype])
+        bytes_per_row = activation_dim * self.dtype.itemsize
+        self.rows_per_chunk = int(chunk_size_gb * 2**30 / bytes_per_row)
+        if round_rows_to > 1:
+            # align chunk boundaries to producer batch boundaries so
+            # skip_chunks-style resume maps exactly onto input offsets
+            self.rows_per_chunk = max(round_rows_to,
+                                      self.rows_per_chunk // round_rows_to * round_rows_to)
+        self._buffer: list[np.ndarray] = []
+        self._buffered_rows = 0
+        self.chunk_index = start_index
+
+    def add(self, acts) -> None:
+        arr = np.asarray(acts).reshape(-1, self.activation_dim).astype(self.dtype)
+        self._buffer.append(arr)
+        self._buffered_rows += arr.shape[0]
+        while self._buffered_rows >= self.rows_per_chunk:
+            self._flush_chunk()
+
+    def _flush_chunk(self) -> None:
+        flat = np.concatenate(self._buffer, axis=0)
+        chunk, rest = flat[:self.rows_per_chunk], flat[self.rows_per_chunk:]
+        np.save(self.folder / f"{self.chunk_index}.npy", chunk)
+        self.chunk_index += 1
+        self._buffer = [rest] if rest.size else []
+        self._buffered_rows = rest.shape[0] if rest.size else 0
+
+    def finalize(self, metadata: Optional[dict] = None) -> int:
+        """Flush the tail (the reference's HF path loses it to a precedence
+        bug, activation_dataset.py:474 — we keep it) and write metadata.
+        Returns the number of chunks written."""
+        if self._buffered_rows:
+            flat = np.concatenate(self._buffer, axis=0)
+            np.save(self.folder / f"{self.chunk_index}.npy", flat)
+            self.chunk_index += 1
+            self._buffer, self._buffered_rows = [], 0
+        meta = {"activation_dim": self.activation_dim,
+                "dtype": str(np.dtype(self.dtype)),
+                "n_chunks": self.chunk_index}
+        meta.update(metadata or {})
+        (self.folder / "meta.json").write_text(json.dumps(meta, indent=2))
+        return self.chunk_index
+
+
+class ChunkStore:
+    """Reader over a chunk folder (reference counterpart: the torch.load
+    loops at big_sweep.py:357-364 and basic_l1_sweep.py:86-105)."""
+
+    def __init__(self, folder: str | Path):
+        self.folder = Path(folder)
+        self.chunk_paths = sorted(self.folder.glob("*.npy"),
+                                  key=lambda p: int(p.stem))
+        if not self.chunk_paths:
+            raise FileNotFoundError(f"no .npy chunks in {self.folder}")
+        meta_path = self.folder / "meta.json"
+        self.meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
+        first = np.load(self.chunk_paths[0], mmap_mode="r")
+        self.activation_dim = int(first.shape[-1])
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.chunk_paths)
+
+    def load_chunk(self, i: int, dtype=np.float32) -> np.ndarray:
+        return np.load(self.chunk_paths[i]).astype(dtype)
+
+    def chunk_mean(self, i: int = 0) -> np.ndarray:
+        """Mean of one chunk — the reference's first-chunk centering
+        (activation_dataset.py:379-381, big_sweep.py:359-364)."""
+        return self.load_chunk(i).mean(axis=0)
+
+    def batches(self, chunk: np.ndarray, batch_size: int,
+                rng: np.random.Generator, drop_last: bool = True) -> Iterator[np.ndarray]:
+        """Shuffled fixed-size batches from an in-RAM chunk (reference:
+        BatchSampler(RandomSampler), cluster_runs.py:26-32)."""
+        n = chunk.shape[0]
+        perm = rng.permutation(n)
+        end = n - (n % batch_size) if drop_last else n
+        for lo in range(0, end, batch_size):
+            yield chunk[perm[lo:lo + batch_size]]
+
+    def epoch(self, batch_size: int, rng: np.random.Generator,
+              n_repetitions: int = 1, dtype=np.float32) -> Iterator[np.ndarray]:
+        """Stream batches over all chunks, chunk order shuffled per repetition
+        (reference: big_sweep.py:349-357)."""
+        order = np.concatenate([rng.permutation(self.n_chunks)
+                                for _ in range(n_repetitions)])
+        for ci in order:
+            chunk = self.load_chunk(int(ci), dtype)
+            yield from self.batches(chunk, batch_size, rng)
+
+
+def device_prefetch(batches: Iterable[np.ndarray], sharding=None,
+                    buffer_size: int = 2) -> Iterator[Array]:
+    """Double-buffered host→device pipeline: batch i+1 transfers while batch i
+    computes. jax.device_put is async, so a small lookahead queue suffices."""
+    from collections import deque
+
+    queue: deque[Array] = deque()
+    it = iter(batches)
+
+    def put(x):
+        x = jnp.asarray(x) if sharding is None else jax.device_put(x, sharding)
+        return x
+
+    try:
+        for _ in range(buffer_size):
+            queue.append(put(next(it)))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield out

@@ -1,0 +1,125 @@
+"""Activation harvesting: LM forward → on-disk chunk store.
+
+TPU-native replacement for the reference's three harvesting paths
+(`make_activation_dataset_tl` activation_dataset.py:323-391,
+`make_activation_dataset_hf` :393-496, baukit `make_activation_dataset`
+:263-320): one jitted multi-tap forward per token batch, with
+`stop_at_layer` pruning and all requested layers captured in a single pass.
+Batches are data-sharded over the mesh for multi-chip harvesting.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.config import DataArgs
+from sparse_coding_tpu.data.chunk_store import ChunkStore, ChunkWriter
+from sparse_coding_tpu.lm import hooks
+from sparse_coding_tpu.lm.model_config import LMConfig
+
+
+def make_harvest_fn(params, cfg: LMConfig, taps: Sequence[str], forward=None):
+    """Jitted tokens[b,s] -> {tap: [b*s, width]} harvesting step
+    (the reference's run_with_cache + rearrange "b s n -> (b s) n",
+    activation_dataset.py:361-368)."""
+    if forward is None:
+        from sparse_coding_tpu.lm.convert import forward_fn
+        forward = forward_fn(cfg)
+    stop = hooks.max_tap_layer(taps) + 1
+
+    def harvest(tokens):
+        _, tapped = forward(params, tokens, cfg, taps=taps, stop_at_layer=stop)
+        return {name: acts.reshape(-1, acts.shape[-1])
+                for name, acts in tapped.items()}
+
+    return jax.jit(harvest)
+
+
+def harvest_activations(
+    params,
+    cfg: LMConfig,
+    token_rows: np.ndarray,
+    layers: Sequence[int],
+    layer_loc: str,
+    output_folder: str | Path,
+    model_batch_size: int = 4,
+    chunk_size_gb: float = 2.0,
+    n_chunks: Optional[int] = None,
+    skip_chunks: int = 0,
+    center: bool = False,
+    dtype: str = "bfloat16",
+    forward=None,
+) -> dict[str, int]:
+    """Run the LM over packed token rows, streaming each tap's activations to
+    its own chunk folder `{output_folder}/{tap}/`. Multi-layer in one pass
+    (as the reference does, activation_dataset.py:323-391).
+
+    Returns {tap_name: n_chunks_written}. `skip_chunks` resumes mid-dataset
+    by skipping already-harvested leading chunks (reference:
+    activation_dataset.py:348,433)."""
+    taps = hooks.taps_for(layers, layer_loc)
+    harvest = make_harvest_fn(params, cfg, taps, forward=forward)
+    width = hooks.get_activation_size(layer_loc, cfg)
+
+    seq_len = token_rows.shape[1]
+    # chunk boundaries aligned to whole model batches so skip_chunks resume
+    # maps exactly onto token-row offsets (no duplicated/shifted data)
+    writers = {
+        t: ChunkWriter(Path(output_folder) / t, width,
+                       chunk_size_gb=chunk_size_gb, dtype=dtype,
+                       start_index=skip_chunks,
+                       round_rows_to=model_batch_size * seq_len)
+        for t in taps
+    }
+
+    n_rows = token_rows.shape[0]
+    rows_done = 0
+    target_rows_per_chunk = next(iter(writers.values())).rows_per_chunk
+    skip_rows = skip_chunks * (target_rows_per_chunk // seq_len)
+
+    for lo in range(skip_rows, n_rows, model_batch_size):
+        batch = jnp.asarray(token_rows[lo:lo + model_batch_size])
+        if batch.shape[0] < model_batch_size:
+            break  # keep shapes static for jit
+        tapped = harvest(batch)
+        for name, acts in tapped.items():
+            writers[name].add(jax.device_get(acts))
+        rows_done += batch.shape[0]
+        if n_chunks is not None and all(
+                w.chunk_index - skip_chunks >= n_chunks for w in writers.values()):
+            break
+
+    out = {}
+    for name, w in writers.items():
+        n_written = w.finalize({"model": cfg.arch, "layer_loc": layer_loc,
+                                "centered": center})
+        out[name] = n_written
+    if center:
+        # first-chunk-mean centering metadata (reference:
+        # activation_dataset.py:379-381 subtracts the first chunk's mean)
+        for name in out:
+            store = ChunkStore(Path(output_folder) / name)
+            mean = store.chunk_mean(0)
+            np.save(Path(output_folder) / name / "center.npy", mean)
+    return out
+
+
+def setup_data(cfg: DataArgs, params, lm_cfg: LMConfig, texts, tokenizer,
+               forward=None) -> dict[str, int]:
+    """End-to-end orchestrator: tokenize/pack then harvest
+    (reference: setup_data, activation_dataset.py:544-604)."""
+    from sparse_coding_tpu.data.tokenize import chunk_and_tokenize
+
+    rows, _ = chunk_and_tokenize(texts, tokenizer, max_length=cfg.context_len,
+                                 eos_token_id=lm_cfg.eos_token_id,
+                                 max_docs=cfg.max_docs)
+    return harvest_activations(
+        params, lm_cfg, rows, cfg.layers, cfg.layer_loc, cfg.dataset_folder,
+        model_batch_size=cfg.model_batch_size, chunk_size_gb=cfg.chunk_size_gb,
+        n_chunks=cfg.n_chunks, skip_chunks=cfg.skip_chunks,
+        center=cfg.center_dataset, dtype=cfg.activation_dtype, forward=forward)

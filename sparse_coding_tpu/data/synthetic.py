@@ -1,0 +1,167 @@
+"""Synthetic sparse-dictionary datasets.
+
+Pure-JAX re-design of the reference's generators
+(reference: sc_datasets/random_dataset.py): ground-truth unit-norm feature
+dictionaries, sparse codes with geometric-decay inclusion probabilities,
+optionally correlated via a Gaussian copula, plus covariance noise. Everything
+is a jitted pure function of a PRNG key — batches are generated *on device*
+(no host→device copies in the training loop, unlike the torch version which
+samples on device but drives from Python).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+from jax.scipy.stats import norm as jnorm
+
+Array = jax.Array
+
+
+def generate_rand_feats(key: Array, feat_dim: int, num_feats: int,
+                        dtype=jnp.float32) -> Array:
+    """Unit-norm ground-truth feature dictionary [num_feats, feat_dim]
+    (reference: random_dataset.py:248-261)."""
+    feats = jax.random.normal(key, (num_feats, feat_dim), dtype)
+    return feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+
+
+def generate_corr_matrix(key: Array, num_feats: int, dtype=jnp.float32) -> Array:
+    """Random symmetric PSD-projected correlation matrix
+    (reference: random_dataset.py:264-279)."""
+    m = jax.random.uniform(key, (num_feats, num_feats), dtype)
+    m = (m + m.T) / 2.0
+    min_eig = jnp.min(jnp.linalg.eigvalsh(m))
+    return jnp.where(min_eig < 0,
+                     m - 1.001 * min_eig * jnp.eye(num_feats, dtype=dtype), m)
+
+
+@partial(jax.jit, static_argnames=("batch_size",))
+def _rand_batch(key: Array, feats: Array, component_probs: Array,
+                batch_size: int) -> tuple[Array, Array]:
+    """Uncorrelated sparse batch (reference: random_dataset.py:160-188).
+    Returns (codes, data)."""
+    n = feats.shape[0]
+    k_thresh, k_vals, k_strength = jax.random.split(key, 3)
+    thresh = jax.random.uniform(k_thresh, (batch_size, n))
+    values = jax.random.uniform(k_vals, (batch_size, n))
+    codes = jnp.where(thresh <= component_probs, values, 0.0)
+    strengths = jax.random.uniform(k_strength, (batch_size, n))
+    data = (codes * strengths) @ feats
+    return codes, data
+
+
+@partial(jax.jit, static_argnames=("batch_size",))
+def _correlated_batch(key: Array, feats: Array, corr_chol: Array, decay: Array,
+                      frac_nonzero: float, batch_size: int) -> tuple[Array, Array]:
+    """Correlated sparse batch via Gaussian copula
+    (reference: random_dataset.py:191-245). Returns (codes, data)."""
+    n = feats.shape[0]
+    k_mvn, k_thresh, k_vals, k_fix, k_strength = jax.random.split(key, 5)
+    corr_sample = corr_chol @ jax.random.normal(k_mvn, (n,))
+    cdf = jnorm.cdf(corr_sample)
+    component_probs = cdf * decay
+    component_probs = component_probs * (frac_nonzero / jnp.mean(component_probs))
+
+    thresh = jax.random.uniform(k_thresh, (batch_size, n))
+    values = jax.random.uniform(k_vals, (batch_size, n))
+    codes = jnp.where(thresh <= component_probs, values, 0.0)
+
+    # ensure no all-zero rows: flip one random coefficient on for empty samples
+    empty = jnp.sum(codes > 0, axis=-1) == 0
+    rand_idx = jax.random.randint(k_fix, (batch_size,), 0, n)
+    fix = jax.nn.one_hot(rand_idx, n) * empty[:, None]
+    codes = jnp.where(fix > 0, 1.0, codes)
+
+    strengths = jax.random.uniform(k_strength, (batch_size, n))
+    data = (codes * strengths) @ feats
+    return codes, data
+
+
+@partial(jax.jit, static_argnames=("batch_size",))
+def _noise_batch(key: Array, noise_chol: Array, scale: float,
+                 batch_size: int) -> Array:
+    """Multivariate-normal noise (reference: random_dataset.py:145-157)."""
+    d = noise_chol.shape[0]
+    return scale * (jax.random.normal(key, (batch_size, d)) @ noise_chol.T)
+
+
+class RandomDatasetGenerator(struct.PyTreeNode):
+    """Sparse-code dataset with geometric-decay feature probabilities
+    (reference: random_dataset.py:17-73). Usage:
+
+        gen = RandomDatasetGenerator.create(key, d, n, num_nonzero, decay, corr)
+        key, sub = jax.random.split(key)
+        batch = gen.batch(sub, batch_size)
+    """
+
+    feats: Array  # [n, d] ground-truth dictionary
+    decay: Array  # [n]
+    corr_chol: Optional[Array]  # Cholesky of the copula correlation (if correlated)
+    frac_nonzero: float = struct.field(pytree_node=False, default=0.0)
+    correlated: bool = struct.field(pytree_node=False, default=False)
+
+    @classmethod
+    def create(cls, key: Array, activation_dim: int, n_ground_truth_components: int,
+               feature_num_nonzero: int, feature_prob_decay: float,
+               correlated: bool = False) -> "RandomDatasetGenerator":
+        k_feats, k_corr = jax.random.split(key)
+        n = n_ground_truth_components
+        feats = generate_rand_feats(k_feats, activation_dim, n)
+        decay = feature_prob_decay ** jnp.arange(n, dtype=jnp.float32)
+        corr_chol = None
+        if correlated:
+            corr = generate_corr_matrix(k_corr, n)
+            corr_chol = jnp.linalg.cholesky(corr)
+        return cls(feats=feats, decay=decay, corr_chol=corr_chol,
+                   frac_nonzero=feature_num_nonzero / n, correlated=correlated)
+
+    def batch_with_codes(self, key: Array, batch_size: int) -> tuple[Array, Array]:
+        if self.correlated:
+            return _correlated_batch(key, self.feats, self.corr_chol, self.decay,
+                                     self.frac_nonzero, batch_size)
+        component_probs = self.decay * self.frac_nonzero
+        return _rand_batch(key, self.feats, component_probs, batch_size)
+
+    def batch(self, key: Array, batch_size: int) -> Array:
+        return self.batch_with_codes(key, batch_size)[1]
+
+
+class SparseMixDataset(struct.PyTreeNode):
+    """Correlated sparse codes + covariance noise
+    (reference: random_dataset.py:77-142)."""
+
+    base: RandomDatasetGenerator
+    noise_chol: Array  # [d, d]
+    noise_magnitude_scale: float = struct.field(pytree_node=False, default=0.0)
+
+    @classmethod
+    def create(cls, key: Array, activation_dim: int, n_sparse_components: int,
+               feature_num_nonzero: int, feature_prob_decay: float,
+               noise_magnitude_scale: float,
+               noise_covariance: Optional[Array] = None) -> "SparseMixDataset":
+        k_base, _ = jax.random.split(key)
+        base = RandomDatasetGenerator.create(
+            k_base, activation_dim, n_sparse_components, feature_num_nonzero,
+            feature_prob_decay, correlated=True)
+        if noise_covariance is None:
+            noise_chol = jnp.eye(activation_dim)
+        else:
+            noise_chol = jnp.linalg.cholesky(noise_covariance)
+        return cls(base=base, noise_chol=noise_chol,
+                   noise_magnitude_scale=noise_magnitude_scale)
+
+    @property
+    def feats(self) -> Array:
+        return self.base.feats
+
+    def batch(self, key: Array, batch_size: int) -> Array:
+        k_sparse, k_noise = jax.random.split(key)
+        sparse = self.base.batch(k_sparse, batch_size)
+        noise = _noise_batch(k_noise, self.noise_chol,
+                             self.noise_magnitude_scale, batch_size)
+        return sparse + noise

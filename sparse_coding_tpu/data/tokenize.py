@@ -1,0 +1,70 @@
+"""Text → packed token rows.
+
+Re-implements the reference's `chunk_and_tokenize` semantics
+(reference: activation_dataset.py:136-235, itself adapted from tuned-lens):
+documents are tokenized, joined with EOS separators, and packed into
+fixed-length rows with no padding; returns the packed [n_rows, max_length]
+array plus the nats/byte ratio used for bits-per-byte perplexity conversion.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+
+def pack_tokens(token_lists: Iterable[list[int]], max_length: int,
+                eos_token_id: int) -> np.ndarray:
+    """EOS-joined GPT-style packing into [n_rows, max_length] int32 rows.
+    Trailing tokens that don't fill a row are dropped (matching the
+    reference's drop-last behavior)."""
+    stream: list[int] = []
+    rows: list[list[int]] = []
+    for toks in token_lists:
+        stream.extend(toks)
+        stream.append(eos_token_id)
+        while len(stream) >= max_length:
+            rows.append(stream[:max_length])
+            stream = stream[max_length:]
+    if not rows:
+        return np.zeros((0, max_length), np.int32)
+    return np.asarray(rows, np.int32)
+
+
+def chunk_and_tokenize(texts: Iterable[str], tokenizer, max_length: int = 256,
+                       eos_token_id: Optional[int] = None,
+                       max_docs: Optional[int] = None) -> tuple[np.ndarray, float]:
+    """Tokenize + pack a text iterable. Returns (rows, bits_per_byte_ratio)
+    where ratio = (total_tokens/total_bytes)/ln(2): multiply a nats-per-token
+    loss by it to get bits per byte (reference: activation_dataset.py:223-233)."""
+    token_lists = []
+    total_tokens = 0
+    total_bytes = 0
+    for i, text in enumerate(texts):
+        if max_docs is not None and i >= max_docs:
+            break
+        toks = tokenizer.encode(text)
+        token_lists.append(toks)
+        total_tokens += len(toks)
+        total_bytes += len(text.encode("utf-8"))
+    import math
+
+    eos = eos_token_id if eos_token_id is not None else tokenizer.eos_token_id
+    rows = pack_tokens(token_lists, max_length, eos)
+    ratio = total_tokens / max(total_bytes, 1) / math.log(2)
+    return rows, ratio
+
+
+def load_text_dataset(dataset_name: str, split: str = "train",
+                      text_key: str = "text",
+                      max_docs: Optional[int] = None) -> list[str]:
+    """HF-datasets loader (reference: make_sentence_dataset,
+    activation_dataset.py:121-134). Requires a populated local HF cache in
+    this zero-egress image."""
+    from datasets import load_dataset
+
+    ds = load_dataset(dataset_name, split=split)
+    if max_docs is not None:
+        ds = ds.select(range(min(max_docs, len(ds))))
+    return ds[text_key]

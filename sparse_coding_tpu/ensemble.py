@@ -1,0 +1,277 @@
+"""The ensemble training engine.
+
+TPU-native replacement for the reference's `FunctionalEnsemble`
+(reference: autoencoders/ensemble.py:68-193), which imitates JAX in PyTorch by
+stacking param pytrees and running `torch.vmap(torch.func.grad(loss))` +
+`torch.vmap(optimizer.update)` with in-place state copies. Here the whole
+step — per-member grads, Adam update, parameter application — is one pure
+function, vmapped over the ensemble axis and jitted once; XLA fuses the
+elementwise optimizer math into the matmul epilogues.
+
+Sharding model (replaces cluster_runs.py's process-per-GPU scheduler and
+huge_batch_size.py's gloo DDP):
+- mesh axes ("model", "data");
+- stacked params/opt-state sharded over "model" along the leading ensemble
+  axis (each shard owns N/mesh_model members — the moral equivalent of one
+  reference worker process, with zero host code);
+- the activation batch sharded over "data"; per-member grads/losses are
+  reduced over "data" by XLA-inserted collectives riding ICI.
+
+Members whose loss has *static* hyperparameters that change compiled shapes
+(e.g. TopK's k) are bucketed into sub-ensembles — the analogue of the
+reference's `no_stacking` Python loop (ensemble.py:100-116), but each bucket
+is still vmapped internally.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparse_coding_tpu.models.signatures import AuxData
+from sparse_coding_tpu.utils.trees import stack_trees, tree_index
+
+Array = jax.Array
+Pytree = Any
+
+_STATIC_TYPES = (int, float, bool, str, type(None))
+
+StaticBuffers = tuple[tuple[str, Any], ...]  # hashable, jit-static
+
+
+def split_buffers(buffers: Pytree) -> tuple[Pytree, StaticBuffers]:
+    """Partition a member's buffers into (array leaves, static leaves).
+
+    Static leaves (plain Python scalars, e.g. TopK's k) become compile-time
+    constants shared by every member of a bucket; array leaves are stacked and
+    vmapped over.
+    """
+    arrays = {}
+    statics = {}
+    for name, leaf in buffers.items():
+        if isinstance(leaf, _STATIC_TYPES):
+            statics[name] = leaf
+        else:
+            arrays[name] = leaf
+    return arrays, tuple(sorted(statics.items()))
+
+
+def merge_buffers(arrays: Pytree, statics: StaticBuffers) -> dict:
+    merged = dict(arrays)
+    merged.update(dict(statics))
+    return merged
+
+
+class EnsembleState(struct.PyTreeNode):
+    """Device state for one vmapped bucket: everything stacked on axis 0."""
+
+    params: Pytree
+    buffers: Pytree
+    opt_state: Pytree
+    lrs: Array  # [N] per-member learning rate
+    step: Array  # scalar step counter
+    static_buffers: StaticBuffers = struct.field(pytree_node=False, default=())
+    sig_name: str = struct.field(pytree_node=False, default="")
+
+    @property
+    def n_members(self) -> int:
+        return int(self.lrs.shape[0])
+
+
+def adam_optimizer(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> optax.GradientTransformation:
+    """Bare Adam transform; the per-member lr is applied by the step function
+    (matching torchopt.adam semantics used at reference ensemble.py:85,
+    update = lr·m̂/(√v̂ + eps))."""
+    return optax.scale_by_adam(b1=b1, b2=b2, eps=eps, eps_root=0.0)
+
+
+def make_train_step(
+    sig: Any,
+    optimizer: optax.GradientTransformation,
+    statics: StaticBuffers = (),
+    donate: bool = True,
+) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
+    """Build the jitted (state, batch) -> (state, aux) step for a signature.
+
+    One minibatch is shared by every member (the reference expands it across
+    the ensemble axis, ensemble.py:175-181 — under vmap with in_axes=None the
+    broadcast is free)."""
+
+    def member_step(params, buffers, opt_state, lr, batch):
+        def loss_fn(p):
+            return sig.loss(p, merge_buffers(buffers, statics), batch)
+
+        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        updates = jax.tree.map(lambda u: -lr * u, updates)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, aux
+
+    def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
+        vstep = jax.vmap(member_step, in_axes=(0, 0, 0, 0, None))
+        params, opt_state, aux = vstep(
+            state.params, state.buffers, state.opt_state, state.lrs, batch)
+        new_state = state.replace(params=params, opt_state=opt_state,
+                                  step=state.step + 1)
+        return new_state, aux
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+class Ensemble:
+    """One vmapped bucket of N same-shape members.
+
+    Construction mirrors `FunctionalEnsemble(models, sig, optimizer)`
+    (reference: ensemble.py:68-99): takes a list of (params, buffers) pairs
+    from `sig.init`, stacks them, and builds the jitted vmapped step.
+    """
+
+    def __init__(
+        self,
+        members: Sequence[tuple[Pytree, Pytree]],
+        sig: Any,
+        lr: float | Sequence[float] = 1e-3,
+        adam_b1: float = 0.9,
+        adam_b2: float = 0.999,
+        adam_eps: float = 1e-8,
+        mesh: Optional[Mesh] = None,
+        donate: bool = True,
+    ):
+        if not members:
+            raise ValueError("ensemble needs at least one member")
+        self.sig = sig
+        self.sig_name = getattr(sig, "signature_name", sig.__name__)
+        self.optimizer = adam_optimizer(adam_b1, adam_b2, adam_eps)
+        self.mesh = mesh
+
+        split = [split_buffers(b) for _, b in members]
+        statics0 = split[0][1]
+        for _, statics in split[1:]:
+            if statics != statics0:
+                raise ValueError(
+                    "members with differing static buffers cannot share a vmapped "
+                    f"bucket (got {dict(statics)} vs {dict(statics0)}); use "
+                    "EnsembleGroup.build to bucket them")
+
+        params = stack_trees([p for p, _ in members])
+        buffers = stack_trees([arrays for arrays, _ in split]) if split[0][0] else {}
+        n = len(members)
+        lrs = jnp.full((n,), lr, jnp.float32) if isinstance(lr, (int, float)) else jnp.asarray(lr, jnp.float32)
+        if lrs.shape != (n,):
+            raise ValueError(f"lr must be scalar or length-{n}, got shape {lrs.shape}")
+        opt_state = jax.vmap(self.optimizer.init)(params)
+
+        self.state = EnsembleState(
+            params=params, buffers=buffers, opt_state=opt_state, lrs=lrs,
+            step=jnp.zeros((), jnp.int32), static_buffers=statics0,
+            sig_name=self.sig_name,
+        )
+        if mesh is not None:
+            self.state = shard_ensemble_state(self.state, mesh)
+        self._step_fn = make_train_step(sig, self.optimizer, statics=statics0,
+                                        donate=donate)
+
+    @property
+    def n_members(self) -> int:
+        return self.state.n_members
+
+    def step_batch(self, batch: Array) -> AuxData:
+        """One training step on a [batch, d] activation slab shared by every
+        member (reference: ensemble.py:175-193). Returns stacked per-member aux."""
+        if self.mesh is not None:
+            n_data = self.mesh.shape["data"]
+            if batch.shape[0] % n_data != 0:
+                raise ValueError(
+                    f"batch size {batch.shape[0]} not divisible by mesh data "
+                    f"axis {n_data}; drop the remainder or pad the batch")
+            batch = jax.device_put(batch, NamedSharding(self.mesh, P("data")))
+        self.state, aux = self._step_fn(self.state, batch)
+        return aux
+
+    def unstack(self) -> list[tuple[Pytree, dict]]:
+        """Per-member (params, buffers incl. statics), host-side
+        (reference: ensemble.py:59-66 unstack_dict)."""
+        params = jax.device_get(self.state.params)
+        buffers = jax.device_get(self.state.buffers)
+        out = []
+        for i in range(self.n_members):
+            member_buffers = merge_buffers(
+                tree_index(buffers, i) if buffers else {}, self.state.static_buffers)
+            out.append((tree_index(params, i), member_buffers))
+        return out
+
+    def to_learned_dicts(self) -> list:
+        """Export every member as an inference LearnedDict
+        (reference: big_sweep.py:202-225 `unstacked_to_learned_dicts`)."""
+        return [self.sig.to_learned_dict(p, b) for p, b in self.unstack()]
+
+
+def shard_ensemble_state(state: EnsembleState, mesh: Mesh) -> EnsembleState:
+    """Place a stacked state on a mesh: ensemble axis over "model"
+    (each model-shard owns N/mesh_model members, the analogue of one
+    reference worker process, cluster_runs.py:110-127)."""
+    n_model = mesh.shape["model"]
+    if state.n_members % n_model != 0:
+        raise ValueError(
+            f"ensemble size {state.n_members} not divisible by mesh model axis "
+            f"{n_model}; pad the sweep grid or choose a dividing mesh_model")
+
+    def place(leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
+            return jax.device_put(leaf, NamedSharding(mesh, P()))
+        return jax.device_put(leaf, NamedSharding(mesh, P("model")))
+
+    return EnsembleState(
+        params=jax.tree.map(place, state.params),
+        buffers=jax.tree.map(place, state.buffers),
+        opt_state=jax.tree.map(place, state.opt_state),
+        lrs=place(state.lrs),
+        step=jax.device_put(state.step, NamedSharding(mesh, P())),
+        static_buffers=state.static_buffers,
+        sig_name=state.sig_name,
+    )
+
+
+class EnsembleGroup:
+    """A set of buckets trained together on the same data stream — the
+    analogue of the reference's `no_stacking` mode (ensemble.py:100-116) and
+    of running several `FunctionalEnsemble`s per sweep (big_sweep.py:331-336).
+
+    Buckets are keyed by static buffers; each bucket is its own jitted vmapped
+    step, so e.g. TopK members with k=4,8,16 form three buckets that still
+    pipeline on device (dispatch is async)."""
+
+    def __init__(self, ensembles: dict[str, Ensemble]):
+        self.ensembles = dict(ensembles)
+
+    @classmethod
+    def build(
+        cls,
+        sig: Any,
+        member_inits: Sequence[tuple[Pytree, Pytree]],
+        lr: float = 1e-3,
+        mesh: Optional[Mesh] = None,
+        **adam_kwargs,
+    ) -> "EnsembleGroup":
+        """Bucket members by static buffers and build one Ensemble per bucket."""
+        buckets: dict[StaticBuffers, list[tuple[Pytree, Pytree]]] = {}
+        for member in member_inits:
+            _, statics = split_buffers(member[1])
+            buckets.setdefault(statics, []).append(member)
+        ensembles = {}
+        for statics, members in buckets.items():
+            name = getattr(sig, "signature_name", sig.__name__) + (
+                "_" + "_".join(f"{k}{v}" for k, v in statics) if statics else "")
+            ensembles[name] = Ensemble(members, sig, lr=lr, mesh=mesh, **adam_kwargs)
+        return cls(ensembles)
+
+    def step_batch(self, batch: Array) -> dict[str, AuxData]:
+        return {name: ens.step_batch(batch) for name, ens in self.ensembles.items()}
+
+    def to_learned_dicts(self) -> dict[str, list]:
+        return {name: ens.to_learned_dicts() for name, ens in self.ensembles.items()}

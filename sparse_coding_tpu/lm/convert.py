@@ -1,0 +1,110 @@
+"""HF-checkpoint → JAX param-tree conversion.
+
+Replaces the reference's dependency on transformer_lens's checkpoint loading
+(reference: big_sweep.py:28-40 `get_model`): torch state dicts (from local HF
+caches or freshly-initialized `transformers` models in tests) are mapped to
+the param trees consumed by lm/gptneox.py and lm/gpt2.py. Torch stays on the
+host CPU; arrays stream to device lazily.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.lm.model_config import LMConfig, get_config
+
+
+def _np(t: Any) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+def convert_gptneox_state_dict(sd: dict, cfg: LMConfig, dtype=jnp.float32) -> dict:
+    """Map a HF GPTNeoXForCausalLM state dict to our param tree."""
+    def g(name):
+        return jnp.asarray(_np(sd[name]), dtype)
+
+    prefix = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
+    layers = []
+    for i in range(cfg.n_layers):
+        p = f"{prefix}layers.{i}."
+        layers.append({
+            "ln1_w": g(p + "input_layernorm.weight"),
+            "ln1_b": g(p + "input_layernorm.bias"),
+            "ln2_w": g(p + "post_attention_layernorm.weight"),
+            "ln2_b": g(p + "post_attention_layernorm.bias"),
+            "qkv_w": g(p + "attention.query_key_value.weight"),
+            "qkv_b": g(p + "attention.query_key_value.bias"),
+            "dense_w": g(p + "attention.dense.weight"),
+            "dense_b": g(p + "attention.dense.bias"),
+            "h_to_4h_w": g(p + "mlp.dense_h_to_4h.weight"),
+            "h_to_4h_b": g(p + "mlp.dense_h_to_4h.bias"),
+            "fourh_to_h_w": g(p + "mlp.dense_4h_to_h.weight"),
+            "fourh_to_h_b": g(p + "mlp.dense_4h_to_h.bias"),
+        })
+    return {
+        "embed_in": g(prefix + "embed_in.weight"),
+        "layers": layers,
+        "final_ln_w": g(prefix + "final_layer_norm.weight"),
+        "final_ln_b": g(prefix + "final_layer_norm.bias"),
+        "embed_out": g("embed_out.weight"),
+    }
+
+
+def convert_gpt2_state_dict(sd: dict, cfg: LMConfig, dtype=jnp.float32) -> dict:
+    """Map a HF GPT2LMHeadModel state dict to our param tree (HF Conv1D
+    weights are already [in, out] — no transpose needed for our x @ W)."""
+    def g(name):
+        return jnp.asarray(_np(sd[name]), dtype)
+
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    layers = []
+    for i in range(cfg.n_layers):
+        p = f"{prefix}h.{i}."
+        layers.append({
+            "ln1_w": g(p + "ln_1.weight"), "ln1_b": g(p + "ln_1.bias"),
+            "ln2_w": g(p + "ln_2.weight"), "ln2_b": g(p + "ln_2.bias"),
+            "c_attn_w": g(p + "attn.c_attn.weight"),
+            "c_attn_b": g(p + "attn.c_attn.bias"),
+            "c_proj_w": g(p + "attn.c_proj.weight"),
+            "c_proj_b": g(p + "attn.c_proj.bias"),
+            "c_fc_w": g(p + "mlp.c_fc.weight"), "c_fc_b": g(p + "mlp.c_fc.bias"),
+            "mlp_c_proj_w": g(p + "mlp.c_proj.weight"),
+            "mlp_c_proj_b": g(p + "mlp.c_proj.bias"),
+        })
+    return {
+        "wte": g(prefix + "wte.weight"),
+        "wpe": g(prefix + "wpe.weight"),
+        "layers": layers,
+        "final_ln_w": g(prefix + "ln_f.weight"),
+        "final_ln_b": g(prefix + "ln_f.bias"),
+    }
+
+
+def load_model(model_name: str, dtype=jnp.float32) -> tuple[dict, LMConfig]:
+    """Load a pretrained checkpoint via transformers (local cache; the image
+    has no network egress, so this requires a pre-populated HF cache) and
+    convert. Returns (params, cfg)."""
+    cfg = get_config(model_name)
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(model_name)
+    sd = model.state_dict()
+    if cfg.arch == "gptneox":
+        return convert_gptneox_state_dict(sd, cfg, dtype), cfg
+    if cfg.arch == "gpt2":
+        return convert_gpt2_state_dict(sd, cfg, dtype), cfg
+    raise ValueError(f"unknown arch {cfg.arch}")
+
+
+def forward_fn(cfg: LMConfig):
+    """Dispatch to the right architecture's forward."""
+    if cfg.arch == "gptneox":
+        from sparse_coding_tpu.lm import gptneox
+        return gptneox.forward
+    if cfg.arch == "gpt2":
+        from sparse_coding_tpu.lm import gpt2
+        return gpt2.forward
+    raise ValueError(f"unknown arch {cfg.arch}")

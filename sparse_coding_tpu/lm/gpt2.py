@@ -1,0 +1,120 @@
+"""Pure-JAX GPT-2 forward pass with activation taps.
+
+Same tap/edit interface as lm/gptneox.py; covers the reference's GPT-2-small
+sweeps (BASELINE.md; reference big_sweep_experiments.py:1239-1269). Serial
+residual, learned positional embeddings, tanh-approx GeLU, tied unembedding —
+parity-tested against HF's torch GPT2LMHeadModel on random weights.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu.lm.model_config import LMConfig
+
+Array = jax.Array
+EditFn = tuple[str, Callable[[Array], Array]]
+
+
+def _layernorm(x: Array, w: Array, b: Array, eps: float) -> Array:
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (out * w + b).astype(x.dtype)
+
+
+def _attention(x_ln: Array, layer: dict, cfg: LMConfig) -> tuple[Array, Array]:
+    b, s, d = x_ln.shape
+    h, dh = cfg.n_heads, cfg.d_head
+    # HF GPT-2 Conv1D: y = x @ W + b with W [d, 3d]; heads blocked q|k|v
+    qkv = x_ln @ layer["c_attn_w"] + layer["c_attn_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, dh)
+    k = k.reshape(b, s, h, dh)
+    v = v.reshape(b, s, h, dh)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / dh ** 0.5
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(causal, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    z = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    z_flat = z.reshape(b, s, h * dh)
+    attn_out = z_flat @ layer["c_proj_w"] + layer["c_proj_b"]
+    return attn_out, z_flat
+
+
+def forward(
+    params: dict,
+    tokens: Array,
+    cfg: LMConfig,
+    taps: Sequence[str] = (),
+    stop_at_layer: Optional[int] = None,
+    edit: Optional[EditFn] = None,
+) -> tuple[Optional[Array], dict[str, Array]]:
+    taps = tuple(taps)
+    collected: dict[str, Array] = {}
+    edit_name = edit[0] if edit is not None else None
+
+    def maybe_edit(name: str, value: Array) -> Array:
+        if edit_name == name:
+            value = edit[1](value)
+        if name in taps:
+            collected[name] = value
+        return value
+
+    b, s = tokens.shape
+    x = params["wte"][tokens] + params["wpe"][:s]
+
+    n_layers = cfg.n_layers if stop_at_layer is None else min(stop_at_layer, cfg.n_layers)
+    for i in range(n_layers):
+        layer = params["layers"][i]
+        x_ln1 = _layernorm(x, layer["ln1_w"], layer["ln1_b"], cfg.layernorm_eps)
+        attn_out, z_flat = _attention(x_ln1, layer, cfg)
+        z_flat = maybe_edit(f"attn_concat.{i}", z_flat)
+        x = x + attn_out
+
+        x_ln2 = _layernorm(x, layer["ln2_w"], layer["ln2_b"], cfg.layernorm_eps)
+        h = x_ln2 @ layer["c_fc_w"] + layer["c_fc_b"]
+        post_act = jax.nn.gelu(h, approximate=True)  # gelu_new
+        post_act = maybe_edit(f"mlp.{i}", post_act)
+        mlp_out = post_act @ layer["mlp_c_proj_w"] + layer["mlp_c_proj_b"]
+        mlp_out = maybe_edit(f"mlpout.{i}", mlp_out)
+        x = x + mlp_out
+
+        x = maybe_edit(f"residual.{i}", x)
+        x = maybe_edit(f"attn.{i}", x)
+
+    if stop_at_layer is not None and stop_at_layer < cfg.n_layers:
+        return None, collected
+
+    x = _layernorm(x, params["final_ln_w"], params["final_ln_b"], cfg.layernorm_eps)
+    logits = x @ params["wte"].T  # tied unembedding
+    return logits, collected
+
+
+def init_params(key: Array, cfg: LMConfig, dtype=jnp.float32) -> dict:
+    d, v, dm = cfg.d_model, cfg.vocab_size, cfg.d_mlp
+    keys = iter(jax.random.split(key, 3 + 4 * cfg.n_layers))
+
+    def norm(k, *shape):
+        return 0.02 * jax.random.normal(k, shape, dtype)
+
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append({
+            "ln1_w": jnp.ones(d, dtype), "ln1_b": jnp.zeros(d, dtype),
+            "ln2_w": jnp.ones(d, dtype), "ln2_b": jnp.zeros(d, dtype),
+            "c_attn_w": norm(next(keys), d, 3 * d), "c_attn_b": jnp.zeros(3 * d, dtype),
+            "c_proj_w": norm(next(keys), d, d), "c_proj_b": jnp.zeros(d, dtype),
+            "c_fc_w": norm(next(keys), d, dm), "c_fc_b": jnp.zeros(dm, dtype),
+            "mlp_c_proj_w": norm(next(keys), dm, d), "mlp_c_proj_b": jnp.zeros(d, dtype),
+        })
+    return {
+        "wte": norm(next(keys), v, d),
+        "wpe": norm(next(keys), cfg.max_seq_len, d),
+        "layers": layers,
+        "final_ln_w": jnp.ones(d, dtype), "final_ln_b": jnp.zeros(d, dtype),
+    }

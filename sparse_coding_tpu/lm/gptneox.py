@@ -1,0 +1,187 @@
+"""Pure-JAX GPT-NeoX (Pythia) forward pass with activation taps.
+
+Replaces the reference's transformer_lens `run_with_cache` harvesting path
+(reference: activation_dataset.py:323-391) and `run_with_hooks` intervention
+path (standard_metrics.py:36-53,693-699) with a single jittable function:
+
+    logits, taps = forward(params, tokens, cfg, taps=("residual.2",),
+                           stop_at_layer=3, edit=None)
+
+- `taps` collects activations named by lm/hooks.py's vocabulary.
+- `stop_at_layer` mirrors `run_with_cache(stop_at_layer=...)`
+  (activation_dataset.py:361): later layers are simply not traced.
+- `edit=(tap, fn)` applies `fn` to the named activation in-flight — the
+  pure-functional form of the reference's hook interventions, used for
+  perplexity-under-reconstruction and ablation graphs.
+
+Numerics match HF's GPTNeoXForCausalLM (float32 softmax/LN, exact GeLU,
+NeoX-style rotate-half rotary on the leading rotary_pct dims); parity is
+tested against transformers' torch implementation on random weights in
+tests/test_lm_parity.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu.lm.model_config import LMConfig
+
+Array = jax.Array
+EditFn = tuple[str, Callable[[Array], Array]]
+
+
+def _layernorm(x: Array, w: Array, b: Array, eps: float) -> Array:
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (out * w + b).astype(x.dtype)
+
+
+def _rotary_cos_sin(seq_len: int, rotary_ndims: int, dtype=jnp.float32,
+                    base: float = 10000.0) -> tuple[Array, Array]:
+    inv_freq = 1.0 / (base ** (jnp.arange(0, rotary_ndims, 2, dtype=jnp.float32) / rotary_ndims))
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(pos, inv_freq)  # [s, rd/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [s, rd]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x: Array) -> Array:
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def _apply_rotary(q: Array, k: Array, cos: Array, sin: Array,
+                  rotary_ndims: int) -> tuple[Array, Array]:
+    # q, k: [b, s, h, dh]; cos/sin: [s, rd] — NeoX rotates the first rd dims
+    q_rot, q_pass = q[..., :rotary_ndims], q[..., rotary_ndims:]
+    k_rot, k_pass = k[..., :rotary_ndims], k[..., rotary_ndims:]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    q_rot = q_rot * cos + _rotate_half(q_rot) * sin
+    k_rot = k_rot * cos + _rotate_half(k_rot) * sin
+    return (jnp.concatenate([q_rot, q_pass], axis=-1),
+            jnp.concatenate([k_rot, k_pass], axis=-1))
+
+
+def _attention(x_ln: Array, layer: dict, cfg: LMConfig,
+               cos: Array, sin: Array) -> tuple[Array, Array]:
+    """Returns (attn branch output [b,s,d], z pre-W_O [b,s,h*dh])."""
+    b, s, _ = x_ln.shape
+    h, dh = cfg.n_heads, cfg.d_head
+    qkv = x_ln @ layer["qkv_w"].T + layer["qkv_b"]  # [b, s, 3d] in HF head-blocked layout
+    qkv = qkv.reshape(b, s, h, 3 * dh)
+    q, k, v = jnp.split(qkv, 3, axis=-1)  # each [b, s, h, dh]
+
+    rotary_ndims = int(dh * cfg.rotary_pct)
+    q, k = _apply_rotary(q, k, cos, sin, rotary_ndims)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / dh ** 0.5
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(causal, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    z = jnp.einsum("bhqk,bkhd->bqhd", probs, v)  # [b, s, h, dh]
+    z_flat = z.reshape(b, s, h * dh)
+    attn_out = z_flat @ layer["dense_w"].T + layer["dense_b"]
+    return attn_out, z_flat
+
+
+def _mlp(x_ln: Array, layer: dict) -> tuple[Array, Array]:
+    """Returns (mlp branch output [b,s,d], post-activation [b,s,d_mlp])."""
+    h = x_ln @ layer["h_to_4h_w"].T + layer["h_to_4h_b"]
+    post_act = jax.nn.gelu(h, approximate=False)  # HF pythia uses exact gelu
+    out = post_act @ layer["fourh_to_h_w"].T + layer["fourh_to_h_b"]
+    return out, post_act
+
+
+def forward(
+    params: dict,
+    tokens: Array,
+    cfg: LMConfig,
+    taps: Sequence[str] = (),
+    stop_at_layer: Optional[int] = None,
+    edit: Optional[EditFn] = None,
+) -> tuple[Optional[Array], dict[str, Array]]:
+    """Run GPT-NeoX; collect `taps`; optionally apply an in-flight edit.
+
+    Returns (logits or None if stopped early, {tap_name: [b, s, width]}).
+    """
+    taps = tuple(taps)
+    collected: dict[str, Array] = {}
+    edit_name = edit[0] if edit is not None else None
+
+    def maybe_edit(name: str, value: Array) -> Array:
+        if edit_name == name:
+            value = edit[1](value)
+        if name in taps:
+            collected[name] = value
+        return value
+
+    x = params["embed_in"][tokens]
+    s = tokens.shape[1]
+    rotary_ndims = int(cfg.d_head * cfg.rotary_pct)
+    cos, sin = _rotary_cos_sin(s, rotary_ndims, dtype=x.dtype)
+
+    n_layers = cfg.n_layers if stop_at_layer is None else min(stop_at_layer, cfg.n_layers)
+    for i in range(n_layers):
+        layer = params["layers"][i]
+        x_ln1 = _layernorm(x, layer["ln1_w"], layer["ln1_b"], cfg.layernorm_eps)
+        attn_out, z_flat = _attention(x_ln1, layer, cfg, cos, sin)
+        z_flat = maybe_edit(f"attn_concat.{i}", z_flat)
+
+        if cfg.parallel_residual:
+            x_ln2 = _layernorm(x, layer["ln2_w"], layer["ln2_b"], cfg.layernorm_eps)
+            mlp_out, post_act = _mlp(x_ln2, layer)
+            post_act = maybe_edit(f"mlp.{i}", post_act)
+            mlp_out = maybe_edit(f"mlpout.{i}", mlp_out)
+            x = x + attn_out + mlp_out
+        else:
+            x = x + attn_out
+            x_ln2 = _layernorm(x, layer["ln2_w"], layer["ln2_b"], cfg.layernorm_eps)
+            mlp_out, post_act = _mlp(x_ln2, layer)
+            post_act = maybe_edit(f"mlp.{i}", post_act)
+            mlp_out = maybe_edit(f"mlpout.{i}", mlp_out)
+            x = x + mlp_out
+
+        x = maybe_edit(f"residual.{i}", x)
+        # "attn" aliases the post-block residual, as in the reference
+        # (activation_dataset.py:96-100)
+        x = maybe_edit(f"attn.{i}", x)
+
+    if stop_at_layer is not None and stop_at_layer < cfg.n_layers:
+        return None, collected
+
+    x = _layernorm(x, params["final_ln_w"], params["final_ln_b"], cfg.layernorm_eps)
+    logits = x @ params["embed_out"].T
+    return logits, collected
+
+
+def init_params(key: Array, cfg: LMConfig, dtype=jnp.float32) -> dict:
+    """Random-weight init (for tests and parity checks; real checkpoints come
+    from lm/convert.py)."""
+    d, v, dm = cfg.d_model, cfg.vocab_size, cfg.d_mlp
+    keys = iter(jax.random.split(key, 4 + 8 * cfg.n_layers))
+
+    def norm(k, *shape):
+        return 0.02 * jax.random.normal(k, shape, dtype)
+
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append({
+            "ln1_w": jnp.ones(d, dtype), "ln1_b": jnp.zeros(d, dtype),
+            "ln2_w": jnp.ones(d, dtype), "ln2_b": jnp.zeros(d, dtype),
+            "qkv_w": norm(next(keys), 3 * d, d), "qkv_b": jnp.zeros(3 * d, dtype),
+            "dense_w": norm(next(keys), d, d), "dense_b": jnp.zeros(d, dtype),
+            "h_to_4h_w": norm(next(keys), dm, d), "h_to_4h_b": jnp.zeros(dm, dtype),
+            "fourh_to_h_w": norm(next(keys), d, dm), "fourh_to_h_b": jnp.zeros(d, dtype),
+        })
+    return {
+        "embed_in": norm(next(keys), v, d),
+        "layers": layers,
+        "final_ln_w": jnp.ones(d, dtype), "final_ln_b": jnp.zeros(d, dtype),
+        "embed_out": norm(next(keys), v, d),
+    }

@@ -1,0 +1,245 @@
+"""Core dictionary metrics.
+
+TPU-native re-implementation of the pure-math half of the reference's
+`standard_metrics.py` (model-intervention metrics live in
+`metrics/intervention.py`). Every metric is a jit-friendly pure function of a
+`LearnedDict` pytree + data, so they can be vmapped across a whole sweep's
+dicts at once — the reference evaluates dicts one by one in Python loops
+(e.g. standard_metrics.py:711-756 spins up an mp.Pool over GPUs for what is a
+single vmap here).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.models.learned_dict import LearnedDict, normalize_rows
+
+Array = jax.Array
+
+
+# -- reconstruction quality --------------------------------------------------
+
+def fraction_variance_unexplained(model: LearnedDict, batch: Array) -> Array:
+    """FVU = E‖x − x̂‖² / E‖x − x̄‖² (reference: standard_metrics.py:310-314)."""
+    x_hat = model.predict(batch)
+    residuals = jnp.mean(jnp.square(batch - x_hat))
+    total = jnp.mean(jnp.square(batch - jnp.mean(batch, axis=0)))
+    return residuals / total
+
+
+def fvu_top_activating(model: LearnedDict, batch: Array, n_top: int = 2) -> tuple[Array, Array]:
+    """FVU split into top-n-mean-activation features vs the rest
+    (reference: standard_metrics.py:316-342)."""
+    c = model.encode(model.center(batch))
+    order = jnp.argsort(-jnp.mean(c, axis=0))
+    ranks = jnp.argsort(order)
+    is_top = ranks < n_top
+    c_top = jnp.where(is_top, c, 0.0)
+    c_rest = jnp.where(is_top, 0.0, c)
+    # NOTE: the reference compares in center-transformed space (":333-334"
+    # applies center to the decode output); we mirror that.
+    x_hat_top = model.center(model.decode(c_top))
+    x_hat_rest = model.center(model.decode(c_rest))
+    variance = jnp.mean(jnp.square(batch - jnp.mean(batch, axis=0)))
+    return (jnp.mean(jnp.square(batch - x_hat_top)) / variance,
+            jnp.mean(jnp.square(batch - x_hat_rest)) / variance)
+
+
+def r_squared(model: LearnedDict, batch: Array) -> Array:
+    """(reference: standard_metrics.py:344)."""
+    return 1.0 - fraction_variance_unexplained(model, batch)
+
+
+# -- sparsity / activity -----------------------------------------------------
+
+def mean_nonzero_activations(model: LearnedDict, batch: Array) -> Array:
+    """Per-feature firing frequency (reference: standard_metrics.py:305-308)."""
+    c = model.encode(model.center(batch))
+    return jnp.mean((c != 0).astype(jnp.float32), axis=0)
+
+
+def mean_l0(model: LearnedDict, batch: Array) -> Array:
+    """Mean active features per sample."""
+    c = model.encode(model.center(batch))
+    return jnp.mean(jnp.sum((c != 0).astype(jnp.float32), axis=-1))
+
+
+def calc_feature_n_active(codes: Array) -> Array:
+    """(reference: standard_metrics.py:441-444)."""
+    return jnp.sum(codes != 0, axis=0)
+
+
+def n_ever_active(model: LearnedDict, activations: Array, batch_size: int = 1000,
+                  threshold: int = 10) -> int:
+    """Number of features active more than `threshold` times across a dataset
+    (reference: standard_metrics.py:446-454), scanned in fixed-size batches."""
+    n = (activations.shape[0] // batch_size) * batch_size
+    batches = activations[:n].reshape(-1, batch_size, activations.shape[-1])
+
+    def body(count, batch):
+        return count + calc_feature_n_active(model.encode(batch)), None
+
+    counts, _ = jax.lax.scan(body, jnp.zeros(model.n_feats, jnp.int32), batches)
+    return int(jnp.sum(counts > threshold))
+
+
+# -- dictionary similarity ---------------------------------------------------
+
+def mcs_duplicates(ground: LearnedDict, model: LearnedDict) -> Array:
+    """Max cosine similarity of each model atom to any ground atom
+    (reference: standard_metrics.py:270-274)."""
+    sims = model.get_learned_dict() @ ground.get_learned_dict().T
+    return jnp.max(sims, axis=-1)
+
+
+def mmcs(model: LearnedDict, model2: LearnedDict) -> Array:
+    """(reference: standard_metrics.py:276-277)."""
+    return jnp.mean(mcs_duplicates(model2, model))
+
+
+def mcs_to_fixed(model: LearnedDict, truth: Array) -> Array:
+    """Max cos-sim of each model atom to a fixed (already normalized)
+    ground-truth dictionary (reference: standard_metrics.py:279-282)."""
+    sims = model.get_learned_dict() @ truth.T
+    return jnp.max(sims, axis=-1)
+
+
+def mmcs_to_fixed(model: LearnedDict, truth: Array) -> Array:
+    return jnp.mean(mcs_to_fixed(model, truth))
+
+
+def mmcs_from_list(dicts: Sequence[LearnedDict]) -> Array:
+    """Symmetric pairwise MMCS matrix (reference: standard_metrics.py:287-297)."""
+    n = len(dicts)
+    out = np.eye(n, dtype=np.float32)
+    for i in range(n):
+        for j in range(i):
+            out[i, j] = out[j, i] = float(mmcs(dicts[i], dicts[j]))
+    return jnp.asarray(out)
+
+
+def representedness(features: Array, model: LearnedDict) -> Array:
+    """How well each ground-truth feature is represented in the dict
+    (reference: standard_metrics.py:299-303)."""
+    sims = features @ model.get_learned_dict().T
+    return jnp.max(sims, axis=-1)
+
+
+def hungarian_mcs(smaller: Array, larger: Array) -> Array:
+    """One-to-one matched cosine similarities between a smaller and a larger
+    dictionary via the Hungarian algorithm
+    (reference: standard_metrics.py:811-842 `run_mmcs_with_larger` core)."""
+    from scipy.optimize import linear_sum_assignment
+
+    sims = np.asarray(normalize_rows(smaller) @ normalize_rows(larger).T)
+    row, col = linear_sum_assignment(1.0 - sims)
+    return jnp.asarray(sims[row, col])
+
+
+def mmcs_with_larger_grid(learned_dict_grid: Sequence[Sequence[Array]],
+                          threshold: float = 0.9):
+    """For a [n_l1, n_sizes] grid of dictionaries, Hungarian-match each dict to
+    the next-larger dict (reference: standard_metrics.py:811-842). Returns
+    (mean mcs grid, % feats above threshold, per-cell similarity arrays)."""
+    n_l1 = len(learned_dict_grid)
+    n_sizes = len(learned_dict_grid[0])
+    av = np.zeros((n_l1, n_sizes))
+    above = np.zeros((n_l1, n_sizes))
+    hists: list[list[Optional[np.ndarray]]] = [[None] * (n_sizes - 1) for _ in range(n_l1)]
+    for i in range(n_l1):
+        for j in range(n_sizes - 1):
+            sims = np.asarray(hungarian_mcs(learned_dict_grid[i][j],
+                                            learned_dict_grid[i][j + 1]))
+            av[i, j] = sims.mean()
+            above[i, j] = (sims > threshold).sum() / len(sims) * 100.0
+            hists[i][j] = sims
+    return av, above, hists
+
+
+# -- feature statistics ------------------------------------------------------
+
+def feature_moments(codes: Array) -> dict[str, Array]:
+    """Per-feature mean/var and the reference's asymmetric (uncentered,
+    variance-normalized) skew/kurtosis (standard_metrics.py:456-479)."""
+    mean = jnp.mean(codes, axis=0)
+    var = jnp.var(codes, axis=0, ddof=1)
+    skew = jnp.mean(codes**3, axis=0) / jnp.clip(var**1.5, 1e-8)
+    kurtosis = jnp.mean(codes**4, axis=0) / jnp.clip(var**2, 1e-8)
+    return {"mean": mean, "var": var, "skew": skew, "kurtosis": kurtosis}
+
+
+def calc_moments_streaming(model: LearnedDict, activations: Array,
+                           batch_size: int = 1000):
+    """Streaming raw-moment accumulation over a dataset, one jitted scan
+    (reference: standard_metrics.py:482-511). Returns
+    (times_active, mean, var, skew, kurtosis, m4) with the reference's
+    population-variance (m2 − mean²) semantics."""
+    n = (activations.shape[0] // batch_size) * batch_size
+    batches = activations[:n].reshape(-1, batch_size, activations.shape[-1])
+    zeros = jnp.zeros(model.n_feats, jnp.float32)
+
+    def body(carry, batch):
+        times_active, m1, m2, m3, m4 = carry
+        c = model.encode(batch)
+        times_active = times_active + (jnp.mean(c, axis=0) != 0).astype(jnp.float32)
+        return (times_active,
+                m1 + jnp.mean(c, axis=0), m2 + jnp.mean(c**2, axis=0),
+                m3 + jnp.mean(c**3, axis=0), m4 + jnp.mean(c**4, axis=0)), None
+
+    (times_active, m1, m2, m3, m4), _ = jax.lax.scan(
+        body, (zeros, zeros, zeros, zeros, zeros), batches)
+    k = batches.shape[0]
+    mean, m2, m3, m4 = m1 / k, m2 / k, m3 / k, m4 / k
+    var = m2 - mean**2
+    skew = m3 / jnp.clip(var**1.5, 1e-8)
+    kurtosis = m4 / jnp.clip(var**2, 1e-8)
+    return times_active, mean, var, skew, kurtosis, m4
+
+
+# -- geometry ----------------------------------------------------------------
+
+def neurons_per_feature(model: LearnedDict) -> Array:
+    """Mean inverse Simpson index of |dict| rows
+    (reference: standard_metrics.py:347-352)."""
+    d = model.get_learned_dict()
+    d = d / jnp.sum(jnp.abs(d), axis=-1, keepdims=True)
+    simpson = jnp.sum(jnp.square(d), axis=-1)
+    return jnp.mean(1.0 / simpson)
+
+
+def capacity_per_feature(model: LearnedDict) -> Array:
+    """Scherlis et al. 2022 capacity: ‖dᵢ‖⁴ / Σⱼ⟨dᵢ,dⱼ⟩²
+    (reference: standard_metrics.py:356-362)."""
+    d = model.get_learned_dict()
+    sq_dots = jnp.square(d @ d.T)
+    return jnp.diag(sq_dots) / jnp.sum(sq_dots, axis=-1)
+
+
+# -- supervised probes -------------------------------------------------------
+
+def logistic_regression_auroc(activations: Array, labels: Array, **kwargs) -> float:
+    """(reference: standard_metrics.py:254-260; sklearn on host, as the
+    reference does)."""
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.metrics import roc_auc_score
+
+    x = np.asarray(activations)
+    y = np.asarray(labels)
+    clf = LogisticRegression(**kwargs).fit(x, y)
+    return float(roc_auc_score(y, clf.decision_function(x)))
+
+
+def ridge_regression_auroc(activations: Array, labels: Array, **kwargs) -> float:
+    """(reference: standard_metrics.py:262-268)."""
+    from sklearn.linear_model import RidgeClassifier
+    from sklearn.metrics import roc_auc_score
+
+    x = np.asarray(activations)
+    y = np.asarray(labels)
+    clf = RidgeClassifier(**kwargs).fit(x, y)
+    return float(roc_auc_score(y, clf.decision_function(x)))

@@ -1,0 +1,39 @@
+from sparse_coding_tpu.models import learned_dict as learned_dict
+from sparse_coding_tpu.models import signatures as signatures
+from sparse_coding_tpu.models import sae as sae
+from sparse_coding_tpu.models import topk as topk
+# imported for their @register side effects so the string signature registry
+# covers the full model zoo
+from sparse_coding_tpu.models import direct_coef as direct_coef
+from sparse_coding_tpu.models import ica as ica
+from sparse_coding_tpu.models import lista as lista
+from sparse_coding_tpu.models import nmf as nmf
+from sparse_coding_tpu.models import pca as pca
+from sparse_coding_tpu.models import positive as positive
+from sparse_coding_tpu.models import rica as rica
+from sparse_coding_tpu.models import semilinear as semilinear
+from sparse_coding_tpu.models.learned_dict import (
+    AddedNoise,
+    Identity,
+    IdentityPositive,
+    IdentityReLU,
+    LearnedDict,
+    RandomDict,
+    ReverseSAE,
+    Rotation,
+    TiedCenteredSAE,
+    TiedSAE,
+    TopKLearnedDict,
+    UntiedSAE,
+)
+from sparse_coding_tpu.models.sae import (
+    FunctionalMaskedSAE,
+    FunctionalMaskedTiedSAE,
+    FunctionalReverseSAE,
+    FunctionalSAE,
+    FunctionalThresholdingSAE,
+    FunctionalTiedCenteredSAE,
+    FunctionalTiedSAE,
+    ThresholdingSAE,
+)
+from sparse_coding_tpu.models.topk import TopKEncoder

@@ -1,0 +1,80 @@
+"""Direct coefficient optimization (ISTA/FISTA) over a fixed dictionary.
+
+The reference *imports* `autoencoders.direct_coef_search.DirectCoefOptimizer`
+(big_sweep_experiments.py:13) but the module does not exist in the repo —
+SURVEY.md §2.1 flags it as a missing capability. This implements the implied
+baseline: sparse codes obtained by directly minimizing
+½‖x − cD‖² + α‖c‖₁ with FISTA, entirely on device via lax.scan (no learned
+encoder). Useful as an upper bound on what any amortized encoder can achieve
+with the same dictionary.
+"""
+
+from __future__ import annotations
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu.models.learned_dict import LearnedDict, normalize_rows
+
+Array = jax.Array
+
+
+def _soft_threshold(x: Array, t: Array) -> Array:
+    return jnp.sign(x) * jax.nn.relu(jnp.abs(x) - t)
+
+
+def fista_codes(dictionary: Array, x: Array, l1_alpha: float,
+                n_iters: int = 50, nonneg: bool = False) -> Array:
+    """FISTA for c* = argmin ½‖x − cD‖² + α‖c‖₁, D row-normalized [n, d].
+
+    Step size 1/L with L = ‖DDᵀ‖₂ estimated by power iteration (cheap, done
+    in-trace)."""
+    d = normalize_rows(dictionary)
+    gram = d @ d.T  # [n, n]
+
+    # power iteration for the Lipschitz constant
+    def power_body(v, _):
+        v = gram @ v
+        return v / (jnp.linalg.norm(v) + 1e-8), None
+
+    v0 = jnp.ones((gram.shape[0],)) / jnp.sqrt(gram.shape[0])
+    v, _ = jax.lax.scan(power_body, v0, None, length=16)
+    lipschitz = jnp.maximum(v @ gram @ v, 1e-6)
+    step = 1.0 / lipschitz
+    thresh = l1_alpha * step
+
+    xd = x @ d.T  # [b, n]
+
+    def prox(z):
+        out = _soft_threshold(z, thresh)
+        return jax.nn.relu(out) if nonneg else out
+
+    def body(carry, _):
+        c, y, t = carry
+        grad = y @ gram - xd
+        c_new = prox(y - step * grad)
+        t_new = (1.0 + jnp.sqrt(1.0 + 4.0 * t * t)) / 2.0
+        y_new = c_new + ((t - 1.0) / t_new) * (c_new - c)
+        return (c_new, y_new, t_new), None
+
+    c0 = jnp.zeros_like(xd)
+    (c, _, _), _ = jax.lax.scan(body, (c0, c0, jnp.asarray(1.0)), None,
+                                length=n_iters)
+    return c
+
+
+class DirectCoefOptimizer(LearnedDict):
+    """Inference dict whose encode runs FISTA to convergence."""
+
+    dictionary: Array
+    l1_alpha: float = struct.field(pytree_node=False, default=1e-3)
+    n_iters: int = struct.field(pytree_node=False, default=50)
+    nonneg: bool = struct.field(pytree_node=False, default=True)
+
+    def get_learned_dict(self) -> Array:
+        return normalize_rows(self.dictionary)
+
+    def encode(self, x: Array) -> Array:
+        return fista_codes(self.dictionary, x, self.l1_alpha,
+                           n_iters=self.n_iters, nonneg=self.nonneg)

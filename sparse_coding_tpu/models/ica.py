@@ -1,0 +1,83 @@
+"""FastICA dictionary (reference: autoencoders/ica.py).
+
+Host-side sklearn fit (the reference does the same and notes ~15 min/GB,
+ica.py:43); encode/decode are device-side JAX using the fitted whitening +
+unmixing matrices, so evals run on TPU. The reference's NNegICAEncoder is
+broken (`np.clamp` doesn't exist, `self.scaler` unset — ica.py:71-75); this
+version works.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.models.learned_dict import (
+    LearnedDict,
+    TopKLearnedDict,
+    normalize_rows,
+)
+
+Array = jax.Array
+
+
+class ICAEncoder(LearnedDict):
+    """Linear ICA codes: c = (x − mean)/scale → ica_transform
+    (reference: ica.py:18-58). Fitted parameters baked into arrays."""
+
+    components: Array  # [n, d] unmixing rows (in standardized space)
+    scaler_mean: Array  # [d]
+    scaler_scale: Array  # [d]
+    ica_mean: Array  # [d] FastICA's internal mean
+
+    @classmethod
+    def train(cls, dataset: Array, n_components: Optional[int] = None,
+              max_iter: int = 500) -> "ICAEncoder":
+        from sklearn.decomposition import FastICA
+        from sklearn.preprocessing import StandardScaler
+
+        x = np.asarray(jax.device_get(dataset), np.float64)
+        scaler = StandardScaler()
+        x_std = scaler.fit_transform(x)
+        ica = FastICA(n_components=n_components, max_iter=max_iter)
+        ica.fit(x_std)
+        return cls(
+            components=jnp.asarray(ica.components_, jnp.float32),
+            scaler_mean=jnp.asarray(scaler.mean_, jnp.float32),
+            scaler_scale=jnp.asarray(scaler.scale_, jnp.float32),
+            ica_mean=jnp.asarray(ica.mean_, jnp.float32),
+        )
+
+    def encode(self, x: Array) -> Array:
+        x_std = (x - self.scaler_mean) / self.scaler_scale
+        return (x_std - self.ica_mean) @ self.components.T
+
+    def get_learned_dict(self) -> Array:
+        return normalize_rows(self.components)
+
+    def to_topk_dict(self, sparsity: int) -> TopKLearnedDict:
+        """± components TopK export (reference: ica.py:53-58)."""
+        comps = jnp.concatenate([self.components, -self.components], axis=0)
+        return TopKLearnedDict(dictionary=comps, k=sparsity)
+
+    def to_nneg_dict(self) -> "NNegICAEncoder":
+        return NNegICAEncoder(components=self.components,
+                              scaler_mean=self.scaler_mean,
+                              scaler_scale=self.scaler_scale,
+                              ica_mean=self.ica_mean)
+
+
+class NNegICAEncoder(ICAEncoder):
+    """Rectified ± ICA codes (reference: ica.py:61-81, fixed)."""
+
+    def encode(self, x: Array) -> Array:
+        c = super().encode(x)
+        return jnp.concatenate([jax.nn.relu(c), jax.nn.relu(-c)], axis=-1)
+
+    def get_learned_dict(self) -> Array:
+        comps = jnp.concatenate([self.components, -self.components], axis=0)
+        return normalize_rows(comps)

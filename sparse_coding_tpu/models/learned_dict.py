@@ -1,0 +1,268 @@
+"""Inference-side dictionary interface.
+
+TPU-native re-design of the reference's `LearnedDict` ABC
+(reference: autoencoders/learned_dict.py:16-53): every dictionary is an
+immutable flax-struct pytree with pure `encode`/`decode`/`predict` methods, so
+any dict can be passed straight into jitted eval/intervention functions (and
+vmapped over for batched-dict evals — something the torch ABC cannot do).
+
+Conventions (matching the reference):
+- activations x: [batch, d_activation]
+- codes c: [batch, n_feats]
+- dictionary D: [n_feats, d_activation]; `decode(c) = c @ normalize(D)`
+  (the reference's einsum "nd,bn->bd", learned_dict.py:32)
+- `predict = uncenter ∘ decode ∘ encode ∘ center` (learned_dict.py:45)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_NORM_EPS = 1e-8
+
+
+def normalize_rows(d: Array, eps: float = _NORM_EPS) -> Array:
+    """Row-normalize a dictionary to unit L2 norm."""
+    return d / (jnp.linalg.norm(d, axis=-1, keepdims=True) + eps)
+
+
+class LearnedDict(struct.PyTreeNode):
+    """Base class: subclasses provide `encode` and `get_learned_dict`."""
+
+    @property
+    def n_feats(self) -> int:
+        return self.get_learned_dict().shape[0]
+
+    @property
+    def activation_size(self) -> int:
+        return self.get_learned_dict().shape[-1]
+
+    def n_dict_components(self) -> int:
+        return self.n_feats
+
+    def get_learned_dict(self) -> Array:
+        raise NotImplementedError
+
+    def encode(self, x: Array) -> Array:
+        raise NotImplementedError
+
+    def decode(self, c: Array) -> Array:
+        return c @ self.get_learned_dict()
+
+    def center(self, x: Array) -> Array:
+        return x
+
+    def uncenter(self, x: Array) -> Array:
+        return x
+
+    def predict(self, x: Array) -> Array:
+        return self.uncenter(self.decode(self.encode(self.center(x))))
+
+
+class Identity(LearnedDict):
+    """Identity dictionary: features are the neuron basis
+    (reference: learned_dict.py:56-69)."""
+
+    eye: Array
+
+    @classmethod
+    def create(cls, activation_size: int, dtype=jnp.float32) -> "Identity":
+        return cls(eye=jnp.eye(activation_size, dtype=dtype))
+
+    def get_learned_dict(self) -> Array:
+        return self.eye
+
+    def encode(self, x: Array) -> Array:
+        return x
+
+
+class IdentityReLU(Identity):
+    """Identity with ReLU codes (reference: learned_dict.py:86-103)."""
+
+    def encode(self, x: Array) -> Array:
+        return jax.nn.relu(x)
+
+
+class IdentityPositive(LearnedDict):
+    """±identity: stacks +I and −I so both signs get nonnegative codes
+    (reference: learned_dict.py:71-84)."""
+
+    pm_eye: Array
+
+    @classmethod
+    def create(cls, activation_size: int, dtype=jnp.float32) -> "IdentityPositive":
+        eye = jnp.eye(activation_size, dtype=dtype)
+        return cls(pm_eye=jnp.concatenate([eye, -eye], axis=0))
+
+    def get_learned_dict(self) -> Array:
+        return self.pm_eye
+
+    def encode(self, x: Array) -> Array:
+        return jax.nn.relu(x @ self.pm_eye.T)
+
+
+class RandomDict(LearnedDict):
+    """Random unit-norm dictionary with ReLU projection codes
+    (reference: learned_dict.py:106-126)."""
+
+    dictionary: Array
+
+    @classmethod
+    def create(cls, key: Array, activation_size: int, n_feats: Optional[int] = None,
+               dtype=jnp.float32) -> "RandomDict":
+        n = n_feats or activation_size
+        d = jax.random.normal(key, (n, activation_size), dtype=dtype)
+        return cls(dictionary=normalize_rows(d))
+
+    def get_learned_dict(self) -> Array:
+        return normalize_rows(self.dictionary)
+
+    def encode(self, x: Array) -> Array:
+        return jax.nn.relu(x @ self.get_learned_dict().T)
+
+
+class Rotation(LearnedDict):
+    """Orthonormal rotation dictionary (reference: learned_dict.py:277-293)."""
+
+    rotation: Array  # [n, d], orthonormal rows
+
+    @classmethod
+    def create(cls, key: Array, activation_size: int, dtype=jnp.float32) -> "Rotation":
+        g = jax.random.normal(key, (activation_size, activation_size), dtype=dtype)
+        q, _ = jnp.linalg.qr(g)
+        return cls(rotation=q.T)
+
+    def get_learned_dict(self) -> Array:
+        return self.rotation
+
+    def encode(self, x: Array) -> Array:
+        return x @ self.rotation.T
+
+
+class AddedNoise(LearnedDict):
+    """Identity encode with additive-noise predict, a null-model baseline
+    (reference: learned_dict.py:260-275)."""
+
+    noise_mag: Array
+    eye: Array
+    key: Array
+
+    @classmethod
+    def create(cls, key: Array, activation_size: int, noise_mag: float,
+               dtype=jnp.float32) -> "AddedNoise":
+        return cls(noise_mag=jnp.asarray(noise_mag, dtype),
+                   eye=jnp.eye(activation_size, dtype=dtype), key=key)
+
+    def get_learned_dict(self) -> Array:
+        return self.eye
+
+    def encode(self, x: Array) -> Array:
+        return x
+
+    def predict(self, x: Array) -> Array:
+        noise = jax.random.normal(self.key, x.shape, dtype=x.dtype)
+        return x + self.noise_mag * noise
+
+
+class UntiedSAE(LearnedDict):
+    """Separately-learned encoder and decoder
+    (reference: learned_dict.py:129-150)."""
+
+    encoder: Array  # [n, d]
+    encoder_bias: Array  # [n]
+    dictionary: Array  # [n, d]
+
+    def get_learned_dict(self) -> Array:
+        return normalize_rows(self.dictionary)
+
+    def encode(self, x: Array) -> Array:
+        return jax.nn.relu(x @ self.encoder.T + self.encoder_bias)
+
+
+class TiedSAE(LearnedDict):
+    """Tied encoder = normalized dictionary, with an optional affine centering
+    transform (rotation R, translation t, per-dim scale s), matching the
+    reference's TiedSAE (learned_dict.py:152-215): center(x) = ((x − t) @ Rᵀ)/s.
+    """
+
+    dictionary: Array  # [n, d]
+    encoder_bias: Array  # [n]
+    centering_rot: Optional[Array] = None  # [d, d]
+    centering_trans: Optional[Array] = None  # [d]
+    centering_scale: Optional[Array] = None  # [d]
+
+    def get_learned_dict(self) -> Array:
+        return normalize_rows(self.dictionary)
+
+    def encode(self, x: Array) -> Array:
+        return jax.nn.relu(x @ self.get_learned_dict().T + self.encoder_bias)
+
+    def center(self, x: Array) -> Array:
+        """center(x) = (R·(x − t))·s — matches the reference's whitening
+        transform orientation (sae_ensemble.py:127-128)."""
+        if self.centering_trans is not None:
+            x = x - self.centering_trans
+        if self.centering_rot is not None:
+            x = x @ self.centering_rot.T
+        if self.centering_scale is not None:
+            x = x * self.centering_scale
+        return x
+
+    def uncenter(self, x: Array) -> Array:
+        if self.centering_scale is not None:
+            x = x / self.centering_scale
+        if self.centering_rot is not None:
+            x = x @ self.centering_rot
+        if self.centering_trans is not None:
+            x = x + self.centering_trans
+        return x
+
+
+class TiedCenteredSAE(TiedSAE):
+    """Tied SAE with a learnable center translation
+    (reference: sae_ensemble.py:164-230 inference side)."""
+
+
+class ReverseSAE(LearnedDict):
+    """Tied SAE whose decode subtracts the bias from *active* coefficients
+    before projecting (reference: learned_dict.py:218-257 — whose torch decode
+    mutates its input in place, learned_dict.py:253-255; this version is pure).
+    """
+
+    dictionary: Array
+    encoder_bias: Array
+
+    def get_learned_dict(self) -> Array:
+        return normalize_rows(self.dictionary)
+
+    def encode(self, x: Array) -> Array:
+        return jax.nn.relu(x @ self.get_learned_dict().T + self.encoder_bias)
+
+    def decode(self, c: Array) -> Array:
+        active = c > 0
+        adjusted = jnp.where(active, c - self.encoder_bias, c)
+        return adjusted @ self.get_learned_dict()
+
+
+class TopKLearnedDict(LearnedDict):
+    """k-sparse inference dict: keep the top-k scores, ReLU the rest away
+    (reference: topk_encoder.py:43-63)."""
+
+    dictionary: Array
+    k: int = struct.field(pytree_node=False, default=8)
+
+    def get_learned_dict(self) -> Array:
+        return normalize_rows(self.dictionary)
+
+    def encode(self, x: Array) -> Array:
+        scores = x @ self.get_learned_dict().T
+        topk_vals, topk_idx = jax.lax.top_k(scores, self.k)
+        batch_idx = jnp.arange(scores.shape[0])[:, None]
+        out = jnp.zeros_like(scores)
+        return out.at[batch_idx, topk_idx].set(jax.nn.relu(topk_vals))

@@ -1,0 +1,186 @@
+"""Unrolled iterative-shrinkage (LISTA) and residual-denoising encoders.
+
+Re-implements the reference's residual_denoising_autoencoder.py in pure JAX:
+- `FunctionalLISTADenoisingSAE`: unrolled LISTA (arXiv:2008.02683 per the
+  reference's citation) with soft-threshold shrinkage and momentum mixing;
+- `FunctionalResidualDenoisingSAE`: residual stack of
+  relu-shift → orthogonal mix layers.
+
+The unrolled encoder layers are stacked [L, ...] pytrees scanned with
+lax.scan (the reference holds a Python list of per-layer dicts,
+residual_denoising_autoencoder.py:53). The reference's inference wrapper also
+reads `params["dict"]` that init never creates
+(residual_denoising_autoencoder.py:188 vs :142) — fixed here by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu.models import learned_dict as ld
+from sparse_coding_tpu.models.signatures import make_aux, register
+
+Array = jax.Array
+
+
+def _orthogonal(key: Array, shape, dtype=jnp.float32) -> Array:
+    return jax.nn.initializers.orthogonal()(key, shape, dtype)
+
+
+def shrinkage(r: Array, theta: Array) -> Array:
+    """Soft threshold: sign(r)·relu(|r| − θ)
+    (reference: residual_denoising_autoencoder.py:9-11)."""
+    return jnp.sign(r) * jax.nn.relu(jnp.abs(r) - theta)
+
+
+def _lista_layer_init(key: Array, d_activation: int, n_features: int, dtype):
+    k_w, k_theta = jax.random.split(key)
+    return {
+        "W": _orthogonal(k_w, (n_features, d_activation), dtype),
+        "theta": 0.02 * jax.random.normal(k_theta, (n_features,), dtype),
+        "rho": jnp.asarray(0.1, dtype),
+    }
+
+
+def _lista_step(layer: dict, y: Array, b: Array, x: Array, A: Array):
+    """One LISTA iteration solving Ay=b
+    (reference: residual_denoising_autoencoder.py:24-36)."""
+    m = jnp.clip(layer["rho"], 0.0, 1.0)
+    Ay = y @ A  # [batch, d]
+    r = y + (b - Ay) @ layer["W"].T
+    x_new = shrinkage(r, layer["theta"])
+    y_new = x_new + m * (x_new - x)
+    return y_new, x_new
+
+
+@register("lista_denoising_sae")
+class FunctionalLISTADenoisingSAE:
+    """(reference: residual_denoising_autoencoder.py:39-103)."""
+
+    @staticmethod
+    def init(key: Array, activation_size: int, n_dict_components: int,
+             l1_alpha: float, n_hidden_layers: int = 2, dtype=jnp.float32):
+        k_dec, *k_layers = jax.random.split(key, n_hidden_layers + 1)
+        layers = [_lista_layer_init(k, activation_size, n_dict_components, dtype)
+                  for k in k_layers]
+        params = {
+            "decoder": _orthogonal(k_dec, (n_dict_components, activation_size), dtype),
+            # stacked [L, ...] for lax.scan
+            "encoder_layers": jax.tree.map(lambda *xs: jnp.stack(xs), *layers),
+        }
+        buffers = {"l1_alpha": jnp.asarray(l1_alpha, dtype),
+                   "n_hidden_layers": n_hidden_layers}
+        return params, buffers
+
+    @staticmethod
+    def encode(params, batch: Array, dictionary: Array) -> Array:
+        y0 = batch @ dictionary.T
+        def body(carry, layer):
+            y, x = carry
+            y_new, x_new = _lista_step(layer, y, batch, x, dictionary)
+            return (y_new, x_new), None
+        (y, _), _ = jax.lax.scan(body, (y0, y0), params["encoder_layers"])
+        return y
+
+    @staticmethod
+    def loss(params, buffers, batch: Array):
+        dictionary = ld.normalize_rows(params["decoder"])
+        c = FunctionalLISTADenoisingSAE.encode(params, batch, dictionary)
+        x_hat = c @ dictionary
+        l_reconstruction = jnp.mean(jnp.square(x_hat - batch))
+        l_sparsity = buffers["l1_alpha"] * jnp.mean(jnp.sum(jnp.abs(c), axis=-1))
+        total = l_reconstruction + l_sparsity
+        return total, make_aux(
+            {"loss": total, "l_reconstruction": l_reconstruction,
+             "l_l1": l_sparsity}, c)
+
+    @staticmethod
+    def to_learned_dict(params, buffers) -> "LISTADenoisingSAE":
+        return LISTADenoisingSAE(decoder=params["decoder"],
+                                 encoder_layers=params["encoder_layers"])
+
+
+class LISTADenoisingSAE(ld.LearnedDict):
+    """(reference: residual_denoising_autoencoder.py:106-131)."""
+
+    decoder: Array
+    encoder_layers: dict  # stacked [L, ...]
+
+    def get_learned_dict(self) -> Array:
+        return ld.normalize_rows(self.decoder)
+
+    def encode(self, x: Array) -> Array:
+        return FunctionalLISTADenoisingSAE.encode(
+            {"encoder_layers": self.encoder_layers}, x, self.get_learned_dict())
+
+
+def _resid_layer_init(key: Array, n_features: int, dtype):
+    k_w, k_theta = jax.random.split(key)
+    return {
+        "W": _orthogonal(k_w, (n_features, n_features), dtype),
+        "theta": 0.02 * jax.random.normal(k_theta, (n_features,), dtype),
+    }
+
+
+@register("residual_denoising_sae")
+class FunctionalResidualDenoisingSAE:
+    """(reference: residual_denoising_autoencoder.py:134-182)."""
+
+    @staticmethod
+    def init(key: Array, activation_size: int, n_dict_components: int,
+             l1_alpha: float, n_hidden_layers: int = 2, dtype=jnp.float32):
+        k_dec, k_bias, *k_layers = jax.random.split(key, n_hidden_layers + 2)
+        layers = [_resid_layer_init(k, n_dict_components, dtype) for k in k_layers]
+        params = {
+            "decoder": _orthogonal(k_dec, (n_dict_components, activation_size), dtype),
+            "encoder_layers": jax.tree.map(lambda *xs: jnp.stack(xs), *layers),
+            "encoder_bias": 0.02 * jax.random.normal(k_bias, (n_dict_components,), dtype),
+        }
+        buffers = {"l1_alpha": jnp.asarray(l1_alpha, dtype),
+                   "n_hidden_layers": n_hidden_layers}
+        return params, buffers
+
+    @staticmethod
+    def encode(params, batch: Array, dictionary: Array) -> Array:
+        x = batch @ dictionary.T
+        def body(x, layer):
+            x_ = jax.nn.relu(x + layer["theta"])
+            return x_ @ layer["W"].T + x, None
+        x, _ = jax.lax.scan(body, x, params["encoder_layers"])
+        return jax.nn.relu(x + params["encoder_bias"])
+
+    @staticmethod
+    def loss(params, buffers, batch: Array):
+        dictionary = ld.normalize_rows(params["decoder"])
+        c = FunctionalResidualDenoisingSAE.encode(params, batch, dictionary)
+        x_hat = c @ dictionary
+        l_reconstruction = jnp.mean(jnp.square(x_hat - batch))
+        l_sparsity = buffers["l1_alpha"] * jnp.mean(jnp.sum(jnp.abs(c), axis=-1))
+        total = l_reconstruction + l_sparsity
+        return total, make_aux(
+            {"loss": total, "l_reconstruction": l_reconstruction,
+             "l_l1": l_sparsity}, c)
+
+    @staticmethod
+    def to_learned_dict(params, buffers) -> "ResidualDenoisingSAE":
+        return ResidualDenoisingSAE(decoder=params["decoder"],
+                                    encoder_layers=params["encoder_layers"],
+                                    encoder_bias=params["encoder_bias"])
+
+
+class ResidualDenoisingSAE(ld.LearnedDict):
+    """(reference: residual_denoising_autoencoder.py:185-201, minus its
+    params["dict"] init bug)."""
+
+    decoder: Array
+    encoder_layers: dict
+    encoder_bias: Array
+
+    def get_learned_dict(self) -> Array:
+        return ld.normalize_rows(self.decoder)
+
+    def encode(self, x: Array) -> Array:
+        return FunctionalResidualDenoisingSAE.encode(
+            {"encoder_layers": self.encoder_layers,
+             "encoder_bias": self.encoder_bias}, x, self.get_learned_dict())

@@ -1,0 +1,54 @@
+"""NMF dictionary (reference: autoencoders/nmf.py).
+
+Host-side sklearn fit with the reference's shift-to-nonnegative handling
+(nmf.py:44-54); encode solves the NMF transform on host (sklearn), while the
+fitted components live in a JAX pytree for device-side decode/eval.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.models.learned_dict import LearnedDict, TopKLearnedDict
+
+Array = jax.Array
+
+
+class NMFEncoder(LearnedDict):
+    components: Array  # [n, d]
+    shift: Array  # scalar
+    _nmf: Any = struct.field(pytree_node=False, default=None)  # fitted sklearn model
+
+    @classmethod
+    def train(cls, dataset: Array, n_components: Optional[int] = None,
+              max_iter: int = 400) -> "NMFEncoder":
+        from sklearn.decomposition import NMF
+
+        x = np.asarray(jax.device_get(dataset), np.float64)
+        shift = min(float(x.min()), 0.0)  # shift data to nonneg (nmf.py:44-47)
+        x = x - shift
+        nmf = NMF(n_components=n_components, max_iter=max_iter, init="nndsvda")
+        nmf.fit(x)
+        return cls(components=jnp.asarray(nmf.components_, jnp.float32),
+                   shift=jnp.asarray(shift, jnp.float32), _nmf=nmf)
+
+    def encode(self, x: Array) -> Array:
+        if self._nmf is None:
+            raise RuntimeError("NMFEncoder needs its fitted sklearn model to encode")
+        x_np = np.asarray(jax.device_get(x), np.float64)
+        x_np = np.clip(x_np - float(self.shift), 0.0, None)
+        c = self._nmf.transform(x_np)
+        return jnp.asarray(c, jnp.float32)
+
+    def get_learned_dict(self) -> Array:
+        # NOTE (as the reference warns, nmf.py:60-62): H isn't recoverable by
+        # multiplying with the dictionary; this is for geometry metrics only.
+        return self.components
+
+    def to_topk_dict(self, sparsity: int) -> TopKLearnedDict:
+        return TopKLearnedDict(dictionary=self.components, k=sparsity)

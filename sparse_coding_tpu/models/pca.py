@@ -1,0 +1,151 @@
+"""Streaming PCA + PCA-based dictionaries.
+
+TPU-native re-design of the reference's `BatchedPCA`/`BatchedMean`/`PCAEncoder`
+(reference: autoencoders/pca.py): the streaming covariance/mean accumulation
+is a single jitted `lax.scan` over fixed-size batches (the reference drives a
+Python loop per batch, pca.py:10-17), eigh runs on device, and the exported
+dictionaries are the same family: top-k PCA codes, rotation, ±rotation tied
+SAE, and the whitening centering transform used for centered SAE training.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu.models.learned_dict import (
+    LearnedDict,
+    Rotation,
+    TiedSAE,
+    TopKLearnedDict,
+    normalize_rows,
+)
+
+Array = jax.Array
+
+
+class PCAState(struct.PyTreeNode):
+    """Streaming moment state (reference: BatchedPCA, pca.py:41-64)."""
+
+    cov: Array  # [d, d]
+    mean: Array  # [d]
+    n_samples: Array  # scalar
+
+    @classmethod
+    def create(cls, n_dims: int, dtype=jnp.float32) -> "PCAState":
+        return cls(cov=jnp.zeros((n_dims, n_dims), dtype),
+                   mean=jnp.zeros((n_dims,), dtype),
+                   n_samples=jnp.zeros((), dtype))
+
+
+@jax.jit
+def pca_update(state: PCAState, batch: Array) -> PCAState:
+    """Numerically-stable streaming covariance update (same recurrence as
+    reference pca.py:54-64)."""
+    b = batch.shape[0]
+    corrected = batch - state.mean
+    new_mean = state.mean + jnp.mean(corrected, axis=0) * b / (state.n_samples + b)
+    cov_update = (corrected.T @ (batch - new_mean)) / b
+    w_old = state.n_samples / (state.n_samples + b)
+    w_new = b / (state.n_samples + b)
+    return PCAState(cov=state.cov * w_old + cov_update * w_new,
+                    mean=new_mean, n_samples=state.n_samples + b)
+
+
+def fit_pca(activations: Array, batch_size: int = 512) -> PCAState:
+    """Fit over a dataset in one jitted scan (reference: calc_pca,
+    pca.py:6-13)."""
+    d = activations.shape[-1]
+    n = (activations.shape[0] // batch_size) * batch_size
+    batches = activations[:n].reshape(-1, batch_size, d)
+
+    def body(state, batch):
+        return pca_update(state, batch), None
+
+    state, _ = jax.lax.scan(body, PCAState.create(d), batches)
+    tail = activations[n:]
+    if tail.shape[0]:
+        state = pca_update(state, tail)
+    return state
+
+
+def fit_mean(activations: Array, batch_size: int = 512) -> Array:
+    """(reference: BatchedMean/calc_mean, pca.py:15-38)."""
+    return fit_pca(activations, batch_size).mean
+
+
+class BatchedPCA:
+    """Stateful convenience wrapper matching the reference's interface
+    (train_batch / get_pca / exports, pca.py:41-110)."""
+
+    def __init__(self, n_dims: int):
+        self.state = PCAState.create(n_dims)
+        self.n_dims = n_dims
+
+    def train_batch(self, activations: Array) -> None:
+        self.state = pca_update(self.state, jnp.asarray(activations))
+
+    def get_mean(self) -> Array:
+        return self.state.mean
+
+    def get_pca(self) -> tuple[Array, Array]:
+        cov_symm = (self.state.cov + self.state.cov.T) / 2
+        return jnp.linalg.eigh(cov_symm)
+
+    def get_centering_transform(self) -> tuple[Array, Array, Array]:
+        """(mean, eigvecs, 1/√eigvals) whitening transform for centered SAE
+        training (reference: pca.py:71-82)."""
+        eigvals, eigvecs = self.get_pca()
+        eigvals = jnp.clip(eigvals, 1e-6)
+        return self.get_mean(), eigvecs, 1.0 / jnp.sqrt(eigvals)
+
+    def get_dict(self) -> Array:
+        """Eigenvectors as rows, descending eigenvalue order
+        (reference: pca.py:90-93)."""
+        eigvals, eigvecs = self.get_pca()
+        order = jnp.argsort(-eigvals)
+        return eigvecs[:, order].T
+
+    def to_learned_dict(self, sparsity: int) -> "PCAEncoder":
+        return PCAEncoder(pca_dict=normalize_rows(self.get_dict()), k=sparsity)
+
+    def to_topk_dict(self, sparsity: int) -> TopKLearnedDict:
+        """± eigenvector TopK dict (reference: pca.py:96-100)."""
+        d = self.get_dict()
+        return TopKLearnedDict(dictionary=jnp.concatenate([d, -d], axis=0),
+                               k=sparsity)
+
+    def to_rotation_dict(self, n_components: Optional[int] = None) -> Rotation:
+        n = n_components or self.n_dims
+        return Rotation(rotation=self.get_dict()[:n])
+
+    def to_pve_rotation_dict(self, n_components: Optional[int] = None) -> TiedSAE:
+        """±rotation tied SAE with mean-centering (reference: pca.py:102-107)."""
+        n = n_components or self.n_dims
+        dirs = self.get_dict()[:n]
+        return TiedSAE(dictionary=jnp.concatenate([dirs, -dirs], axis=0),
+                       encoder_bias=jnp.zeros(2 * n),
+                       centering_trans=self.get_mean())
+
+
+class PCAEncoder(LearnedDict):
+    """Top-k-|score| sparse PCA codes (reference: pca.py:113-135). Keeps the
+    top-k components by |score| with their *signed* values."""
+
+    pca_dict: Array  # [n, d] already normalized
+    k: int = struct.field(pytree_node=False, default=8)
+
+    def get_learned_dict(self) -> Array:
+        return self.pca_dict
+
+    def encode(self, x: Array) -> Array:
+        scores = x @ self.pca_dict.T
+        _, idx = jax.lax.top_k(jnp.abs(scores), self.k)
+        batch_idx = jnp.arange(scores.shape[0])[:, None]
+        vals = jnp.take_along_axis(scores, idx, axis=-1)
+        out = jnp.zeros_like(scores)
+        return out.at[batch_idx, idx].set(vals)

@@ -1,0 +1,61 @@
+"""Nonnegativity-constrained SAE variants
+(reference: autoencoders/mlp_tests.py).
+
+The reference's FunctionalPositiveTiedSAE clamps the encoder to ≥0 inside the
+loss by *mutating params* (mlp_tests.py:100 `params["encoder"] =
+torch.clamp(...)`) and applies a fixed +0.18 input shift (:104,110). Here the
+clamp is a projection inside the pure loss (gradients flow through the clamp,
+matching the torch autograd behavior) and the shift is an explicit buffer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu.models import learned_dict as ld
+from sparse_coding_tpu.models.sae import _glorot, _l1, _mse, _safe_norm
+from sparse_coding_tpu.models.signatures import make_aux, register
+
+Array = jax.Array
+
+INPUT_SHIFT = 0.18  # reference: mlp_tests.py:104,110
+
+
+@register("positive_tied_sae")
+class FunctionalPositiveTiedSAE:
+    @staticmethod
+    def init(key: Array, activation_size: int, n_dict_components: int,
+             l1_alpha: float, bias_decay: float = 0.0, dtype=jnp.float32):
+        params = {
+            "encoder": jnp.abs(_glorot(key, (n_dict_components, activation_size), dtype)),
+            # bias init at -1 (reference: mlp_tests.py:89)
+            "encoder_bias": -jnp.ones((n_dict_components,), dtype),
+        }
+        buffers = {
+            "l1_alpha": jnp.asarray(l1_alpha, dtype),
+            "bias_decay": jnp.asarray(bias_decay, dtype),
+            "input_shift": jnp.asarray(INPUT_SHIFT, dtype),
+        }
+        return params, buffers
+
+    @staticmethod
+    def loss(params, buffers, batch: Array):
+        encoder = jax.nn.relu(params["encoder"])  # nonneg projection
+        norms = jnp.clip(jnp.linalg.norm(encoder, axis=-1, keepdims=True), 1e-8)
+        dictionary = encoder / norms
+        shifted = batch + buffers["input_shift"]
+        c = jax.nn.relu(shifted @ dictionary.T + params["encoder_bias"])
+        x_hat = c @ dictionary
+        l_reconstruction = _mse(x_hat - buffers["input_shift"], batch)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        l_bias_decay = buffers["bias_decay"] * _safe_norm(params["encoder_bias"])
+        total = l_reconstruction + l_l1 + l_bias_decay
+        return total, make_aux(
+            {"loss": total, "l_reconstruction": l_reconstruction,
+             "l_l1": l_l1, "l_bias_decay": l_bias_decay}, c)
+
+    @staticmethod
+    def to_learned_dict(params, buffers) -> ld.TiedSAE:
+        return ld.TiedSAE(dictionary=jax.nn.relu(params["encoder"]),
+                          encoder_bias=params["encoder_bias"])

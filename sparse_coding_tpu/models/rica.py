@@ -1,0 +1,64 @@
+"""Reconstruction ICA (reference: autoencoders/rica.py, after Le et al.,
+http://ai.stanford.edu/~quocle/LeKarpenkoNgiamNg.pdf): tied linear code with
+smooth-L1 (or L1) sparsity — expressed as a DictSignature so it trains in the
+same vmapped ensembles as the SAEs (the reference leaves it a torch nn.Module
+with a separate train_batch loop)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu.models import learned_dict as ld
+from sparse_coding_tpu.models.sae import _glorot, _mse
+from sparse_coding_tpu.models.signatures import make_aux, register
+
+Array = jax.Array
+
+
+def _smooth_l1(c: Array, beta: float = 1.0) -> Array:
+    """Huber/smooth-L1 against zero (reference: rica.py:36 uses
+    F.smooth_l1_loss(c, 0), elementwise mean)."""
+    absc = jnp.abs(c)
+    return jnp.mean(jnp.where(absc < beta, 0.5 * c * c / beta, absc - 0.5 * beta))
+
+
+@register("rica")
+class RICA:
+    @staticmethod
+    def init(key: Array, activation_size: int, n_dict_components: int,
+             sparsity_coef: float = 0.0, sparsity_loss: str = "smooth_l1",
+             dtype=jnp.float32):
+        params = {"weights": _glorot(key, (n_dict_components, activation_size), dtype)}
+        buffers = {"sparsity_coef": jnp.asarray(sparsity_coef, dtype),
+                   "sparsity_loss": sparsity_loss}
+        return params, buffers
+
+    @staticmethod
+    def loss(params, buffers, batch: Array):
+        w = params["weights"]
+        c = batch @ w.T
+        x_hat = c @ w
+        l_reconstruction = _mse(x_hat, batch)
+        if buffers["sparsity_loss"] == "l1":
+            l_sparsity = jnp.mean(jnp.abs(c))
+        else:
+            l_sparsity = _smooth_l1(c)
+        total = l_reconstruction + buffers["sparsity_coef"] * l_sparsity
+        return total, make_aux(
+            {"loss": total, "l_reconstruction": l_reconstruction,
+             "l_sparsity": l_sparsity}, c)
+
+    @staticmethod
+    def to_learned_dict(params, buffers) -> "RICADict":
+        return RICADict(weights=params["weights"])
+
+
+class RICADict(ld.LearnedDict):
+    weights: Array
+
+    def get_learned_dict(self) -> Array:
+        return ld.normalize_rows(self.weights)
+
+    def encode(self, x: Array) -> Array:
+        return x @ self.weights.T

@@ -1,0 +1,379 @@
+"""Trainable SAE families.
+
+TPU-native re-implementations of the reference's functional SAE zoo
+(reference: autoencoders/sae_ensemble.py): pure init/loss/export functions over
+explicit pytrees. Loss semantics match the reference exactly —
+MSE(x̂, x) + l1_alpha·mean‖c‖₁ (+ bias_decay·‖b‖₂), decoder row-normalized
+inside the loss — so training curves are comparable; the mechanics (jax.grad
+through vmap, no in-place ops) are idiomatic JAX.
+
+All matmuls are written on [batch, d] × [n, d] operands so XLA tiles them onto
+the MXU; params default to float32 with bfloat16 activations handled upstream.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu.models import learned_dict as ld
+from sparse_coding_tpu.models.signatures import AuxData, make_aux, register
+
+Array = jax.Array
+
+_EPS = 1e-8
+
+
+def _glorot(key: Array, shape, dtype) -> Array:
+    """Xavier-uniform init matching torch.nn.init.xavier_uniform_ on [n, d]
+    (reference: sae_ensemble.py:27)."""
+    fan_out, fan_in = shape
+    limit = jnp.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, minval=-limit, maxval=limit)
+
+
+def _normalize(d: Array) -> Array:
+    return d / jnp.clip(jnp.linalg.norm(d, axis=-1, keepdims=True), _EPS)
+
+
+def _mse(x_hat: Array, x: Array) -> Array:
+    return jnp.mean(jnp.square(x_hat - x))
+
+
+def _l1(c: Array) -> Array:
+    return jnp.mean(jnp.sum(jnp.abs(c), axis=-1))
+
+
+def _safe_norm(v: Array) -> Array:
+    """L2 norm with a finite gradient at 0 (jnp.linalg.norm's grad at the
+    zero vector is NaN, which would poison grads even when bias_decay=0)."""
+    return jnp.sqrt(jnp.sum(jnp.square(v)) + _EPS * _EPS)
+
+
+@register("sae")
+class FunctionalSAE:
+    """Untied ReLU SAE (reference: sae_ensemble.py:13-78)."""
+
+    @staticmethod
+    def init(key: Array, activation_size: int, n_dict_components: int,
+             l1_alpha: float, bias_decay: float = 0.0, dtype=jnp.float32):
+        k_enc, k_dec = jax.random.split(key)
+        params = {
+            "encoder": _glorot(k_enc, (n_dict_components, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_dict_components,), dtype),
+            "decoder": _glorot(k_dec, (n_dict_components, activation_size), dtype),
+        }
+        buffers = {
+            "l1_alpha": jnp.asarray(l1_alpha, dtype),
+            "bias_decay": jnp.asarray(bias_decay, dtype),
+        }
+        return params, buffers
+
+    @staticmethod
+    def encode(params, buffers, batch: Array) -> Array:
+        return jax.nn.relu(batch @ params["encoder"].T + params["encoder_bias"])
+
+    @staticmethod
+    def loss(params, buffers, batch: Array):
+        c = FunctionalSAE.encode(params, buffers, batch)
+        dictionary = _normalize(params["decoder"])
+        x_hat = c @ dictionary
+        l_reconstruction = _mse(x_hat, batch)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        l_bias_decay = buffers["bias_decay"] * _safe_norm(params["encoder_bias"])
+        total = l_reconstruction + l_l1 + l_bias_decay
+        return total, make_aux(
+            {"loss": total, "l_reconstruction": l_reconstruction,
+             "l_l1": l_l1, "l_bias_decay": l_bias_decay}, c)
+
+    @staticmethod
+    def to_learned_dict(params, buffers) -> ld.UntiedSAE:
+        return ld.UntiedSAE(encoder=params["encoder"],
+                            encoder_bias=params["encoder_bias"],
+                            dictionary=params["decoder"])
+
+
+@register("tied_sae")
+class FunctionalTiedSAE:
+    """Tied SAE: encoder is the row-normalized dictionary; optional fixed
+    whitening-centering transform (reference: sae_ensemble.py:81-162)."""
+
+    @staticmethod
+    def init(key: Array, activation_size: int, n_dict_components: int,
+             l1_alpha: float, bias_decay: float = 0.0,
+             rotation: Optional[Array] = None, translation: Optional[Array] = None,
+             scaling: Optional[Array] = None, dtype=jnp.float32):
+        params = {
+            "encoder": _glorot(key, (n_dict_components, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_dict_components,), dtype),
+        }
+        buffers = {
+            "l1_alpha": jnp.asarray(l1_alpha, dtype),
+            "bias_decay": jnp.asarray(bias_decay, dtype),
+            "center_rot": rotation if rotation is not None else jnp.eye(activation_size, dtype=dtype),
+            "center_trans": translation if translation is not None else jnp.zeros((activation_size,), dtype),
+            "center_scale": scaling if scaling is not None else jnp.ones((activation_size,), dtype),
+        }
+        return params, buffers
+
+    @staticmethod
+    def center(buffers, batch: Array) -> Array:
+        return ((batch - buffers["center_trans"]) @ buffers["center_rot"].T) * buffers["center_scale"]
+
+    @staticmethod
+    def uncenter(buffers, batch: Array) -> Array:
+        return (batch / buffers["center_scale"]) @ buffers["center_rot"] + buffers["center_trans"]
+
+    @staticmethod
+    def encode(params, buffers, batch: Array) -> Array:
+        dictionary = _normalize(params["encoder"])
+        return jax.nn.relu(batch @ dictionary.T + params["encoder_bias"])
+
+    @staticmethod
+    def loss(params, buffers, batch: Array):
+        dictionary = _normalize(params["encoder"])
+        batch_centered = FunctionalTiedSAE.center(buffers, batch)
+        c = jax.nn.relu(batch_centered @ dictionary.T + params["encoder_bias"])
+        x_hat_centered = c @ dictionary
+        # reconstruction measured in centered space (reference: sae_ensemble.py:148)
+        l_reconstruction = _mse(x_hat_centered, batch_centered)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        l_bias_decay = buffers["bias_decay"] * _safe_norm(params["encoder_bias"])
+        total = l_reconstruction + l_l1 + l_bias_decay
+        return total, make_aux(
+            {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}, c)
+
+    @staticmethod
+    def to_learned_dict(params, buffers) -> ld.TiedSAE:
+        return ld.TiedSAE(dictionary=params["encoder"],
+                          encoder_bias=params["encoder_bias"],
+                          centering_rot=buffers["center_rot"],
+                          centering_trans=buffers["center_trans"],
+                          centering_scale=buffers["center_scale"])
+
+
+@register("tied_centered_sae")
+class FunctionalTiedCenteredSAE:
+    """Tied SAE with a *learnable* center translation
+    (reference: sae_ensemble.py:164-230)."""
+
+    @staticmethod
+    def init(key: Array, activation_size: int, n_dict_components: int,
+             l1_alpha: float, center: Optional[Array] = None, dtype=jnp.float32):
+        params = {
+            "encoder": _glorot(key, (n_dict_components, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_dict_components,), dtype),
+            "center": center if center is not None else jnp.zeros((activation_size,), dtype),
+        }
+        buffers = {"l1_alpha": jnp.asarray(l1_alpha, dtype)}
+        return params, buffers
+
+    @staticmethod
+    def loss(params, buffers, batch: Array):
+        dictionary = _normalize(params["encoder"])
+        batch_centered = batch - params["center"]
+        c = jax.nn.relu(batch_centered @ dictionary.T + params["encoder_bias"])
+        x_hat_centered = c @ dictionary
+        l_reconstruction = _mse(x_hat_centered, batch_centered)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        total = l_reconstruction + l_l1
+        return total, make_aux(
+            {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}, c)
+
+    @staticmethod
+    def to_learned_dict(params, buffers) -> ld.TiedCenteredSAE:
+        return ld.TiedCenteredSAE(dictionary=params["encoder"],
+                                  encoder_bias=params["encoder_bias"],
+                                  centering_trans=params["center"])
+
+
+def _threshold_gate(c: Array, scale: Array, gain: Array) -> Array:
+    """Soft-threshold surrogate gate (reference: sae_ensemble.py:256-259):
+    relu6(60·(u−0.9))/6 + relu(u−1) on the gain-shifted, scale²-normalized
+    pre-activation u, rescaled back by scale²."""
+    a_sq = jnp.clip(jnp.square(scale), _EPS)
+    u = (c + gain) / a_sq
+    gated = jnp.clip(60.0 * (u - 0.9), 0.0, 6.0) / 6.0 + jax.nn.relu(u - 1.0)
+    return gated * a_sq
+
+
+@register("thresholding_sae")
+class FunctionalThresholdingSAE:
+    """Soft-threshold gated tied SAE with learnable per-feature scale/gain
+    (reference: sae_ensemble.py:232-289; its encode reads an uninitialized
+    ``params["centering"]`` — a latent bug we do not replicate)."""
+
+    @staticmethod
+    def init(key: Array, activation_size: int, n_dict_components: int,
+             l1_alpha: float, dtype=jnp.float32):
+        params = {
+            "encoder": _glorot(key, (n_dict_components, activation_size), dtype),
+            "activation_scale": jnp.ones((n_dict_components,), dtype),
+            "activation_gain": jnp.zeros((n_dict_components,), dtype),
+        }
+        buffers = {"l1_alpha": jnp.asarray(l1_alpha, dtype)}
+        return params, buffers
+
+    @staticmethod
+    def encode(params, buffers, batch: Array) -> Array:
+        dictionary = _normalize(params["encoder"])
+        scores = batch @ dictionary.T
+        return _threshold_gate(scores, params["activation_scale"], params["activation_gain"])
+
+    @staticmethod
+    def loss(params, buffers, batch: Array):
+        c = FunctionalThresholdingSAE.encode(params, buffers, batch)
+        dictionary = _normalize(params["encoder"])
+        x_hat = c @ dictionary
+        l_reconstruction = _mse(x_hat, batch)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        total = l_reconstruction + l_l1
+        return total, make_aux(
+            {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}, c)
+
+    @staticmethod
+    def to_learned_dict(params, buffers) -> "ThresholdingSAE":
+        return ThresholdingSAE(dictionary=params["encoder"],
+                               activation_scale=params["activation_scale"],
+                               activation_gain=params["activation_gain"])
+
+
+class ThresholdingSAE(ld.LearnedDict):
+    """Inference wrapper for the thresholding SAE
+    (reference: sae_ensemble.py:292-305)."""
+
+    dictionary: Array
+    activation_scale: Array
+    activation_gain: Array
+
+    def get_learned_dict(self) -> Array:
+        return ld.normalize_rows(self.dictionary)
+
+    def encode(self, x: Array) -> Array:
+        scores = x @ self.get_learned_dict().T
+        return _threshold_gate(scores, self.activation_scale, self.activation_gain)
+
+
+@register("masked_tied_sae")
+class FunctionalMaskedTiedSAE:
+    """Tied SAE padded to `n_components_stack` with a coefficient mask, so
+    members with *different dictionary sizes* share one vmapped ensemble
+    (reference: sae_ensemble.py:309-373). `coef_mask` is True for ACTIVE
+    coefficients (the reference uses the inverted convention, :332-333)."""
+
+    @staticmethod
+    def init(key: Array, activation_size: int, n_dict_components: int,
+             n_components_stack: int, l1_alpha: float, bias_decay: float = 0.0,
+             dtype=jnp.float32):
+        params = {
+            "encoder": _glorot(key, (n_components_stack, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_components_stack,), dtype),
+        }
+        buffers = {
+            "l1_alpha": jnp.asarray(l1_alpha, dtype),
+            "bias_decay": jnp.asarray(bias_decay, dtype),
+            "dict_size": jnp.asarray(n_dict_components, jnp.int32),
+            "coef_mask": jnp.arange(n_components_stack) < n_dict_components,
+        }
+        return params, buffers
+
+    @staticmethod
+    def loss(params, buffers, batch: Array):
+        dictionary = _normalize(params["encoder"])
+        c = jax.nn.relu(batch @ dictionary.T + params["encoder_bias"])
+        c = jnp.where(buffers["coef_mask"], c, 0.0)
+        x_hat = c @ dictionary
+        l_reconstruction = _mse(x_hat, batch)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        total = l_reconstruction + l_l1
+        return total, make_aux(
+            {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}, c)
+
+    @staticmethod
+    def to_learned_dict(params, buffers) -> ld.TiedSAE:
+        n = int(buffers["dict_size"])
+        return ld.TiedSAE(dictionary=params["encoder"][:n],
+                          encoder_bias=params["encoder_bias"][:n])
+
+
+@register("masked_sae")
+class FunctionalMaskedSAE:
+    """Untied masked variant (reference: sae_ensemble.py:377-444)."""
+
+    @staticmethod
+    def init(key: Array, activation_size: int, n_dict_components: int,
+             n_components_stack: int, l1_alpha: float, bias_decay: float = 0.0,
+             dtype=jnp.float32):
+        k_enc, k_dec = jax.random.split(key)
+        params = {
+            "encoder": _glorot(k_enc, (n_components_stack, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_components_stack,), dtype),
+            "decoder": _glorot(k_dec, (n_components_stack, activation_size), dtype),
+        }
+        buffers = {
+            "l1_alpha": jnp.asarray(l1_alpha, dtype),
+            "bias_decay": jnp.asarray(bias_decay, dtype),
+            "dict_size": jnp.asarray(n_dict_components, jnp.int32),
+            "coef_mask": jnp.arange(n_components_stack) < n_dict_components,
+        }
+        return params, buffers
+
+    @staticmethod
+    def loss(params, buffers, batch: Array):
+        dictionary = _normalize(params["decoder"])
+        c = jax.nn.relu(batch @ params["encoder"].T + params["encoder_bias"])
+        c = jnp.where(buffers["coef_mask"], c, 0.0)
+        x_hat = c @ dictionary
+        l_reconstruction = _mse(x_hat, batch)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        total = l_reconstruction + l_l1
+        return total, make_aux(
+            {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}, c)
+
+    @staticmethod
+    def to_learned_dict(params, buffers) -> ld.UntiedSAE:
+        n = int(buffers["dict_size"])
+        return ld.UntiedSAE(encoder=params["encoder"][:n],
+                            encoder_bias=params["encoder_bias"][:n],
+                            dictionary=params["decoder"][:n])
+
+
+@register("reverse_sae")
+class FunctionalReverseSAE:
+    """Tied SAE subtracting the bias from active coefficients before decode
+    (reference: sae_ensemble.py:447-503; implemented without the in-place
+    masked writes)."""
+
+    @staticmethod
+    def init(key: Array, activation_size: int, n_dict_components: int,
+             l1_alpha: float, bias_decay: float = 0.0, dtype=jnp.float32):
+        params = {
+            "encoder": _glorot(key, (n_dict_components, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_dict_components,), dtype),
+        }
+        buffers = {
+            "l1_alpha": jnp.asarray(l1_alpha, dtype),
+            "bias_decay": jnp.asarray(bias_decay, dtype),
+        }
+        return params, buffers
+
+    @staticmethod
+    def loss(params, buffers, batch: Array):
+        dictionary = _normalize(params["encoder"])
+        c = jax.nn.relu(batch @ dictionary.T + params["encoder_bias"])
+        c = jnp.where(c > 0.0, c - params["encoder_bias"], c)
+        x_hat = c @ dictionary
+        l_reconstruction = _mse(x_hat, batch)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        l_bias_decay = buffers["bias_decay"] * _safe_norm(params["encoder_bias"])
+        total = l_reconstruction + l_l1 + l_bias_decay
+        return total, make_aux(
+            {"loss": total, "l_reconstruction": l_reconstruction,
+             "l_l1": l_l1, "l_bias_decay": l_bias_decay}, c)
+
+    @staticmethod
+    def to_learned_dict(params, buffers) -> ld.ReverseSAE:
+        return ld.ReverseSAE(dictionary=params["encoder"],
+                             encoder_bias=params["encoder_bias"])

@@ -1,0 +1,68 @@
+"""Semi-linear SAE: 2-layer ReLU MLP encoder, normalized linear decoder
+(reference: autoencoders/semilinear_autoencoder.py:31-83)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu.models import learned_dict as ld
+from sparse_coding_tpu.models.sae import _glorot, _l1, _mse, _normalize
+from sparse_coding_tpu.models.signatures import make_aux, register
+
+Array = jax.Array
+
+
+@register("semilinear_sae")
+class SemiLinearSAE:
+    @staticmethod
+    def init(key: Array, activation_size: int, n_dict_components: int,
+             l1_alpha: float, hidden_size: int | None = None, dtype=jnp.float32):
+        hidden = hidden_size or n_dict_components
+        k1, k2, k_dec = jax.random.split(key, 3)
+        params = {
+            "enc0_w": _glorot(k1, (hidden, activation_size), dtype),
+            "enc0_b": jnp.zeros((hidden,), dtype),
+            "enc1_w": _glorot(k2, (n_dict_components, hidden), dtype),
+            "enc1_b": jnp.zeros((n_dict_components,), dtype),
+            "decoder": _glorot(k_dec, (n_dict_components, activation_size), dtype),
+        }
+        buffers = {"l1_alpha": jnp.asarray(l1_alpha, dtype)}
+        return params, buffers
+
+    @staticmethod
+    def encode(params, batch: Array) -> Array:
+        h = jax.nn.relu(batch @ params["enc0_w"].T + params["enc0_b"])
+        return jax.nn.relu(h @ params["enc1_w"].T + params["enc1_b"])
+
+    @staticmethod
+    def loss(params, buffers, batch: Array):
+        c = SemiLinearSAE.encode(params, batch)
+        dictionary = _normalize(params["decoder"])
+        x_hat = c @ dictionary
+        l_reconstruction = _mse(x_hat, batch)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        total = l_reconstruction + l_l1
+        return total, make_aux(
+            {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}, c)
+
+    @staticmethod
+    def to_learned_dict(params, buffers) -> "SemiLinearDict":
+        return SemiLinearDict(enc0_w=params["enc0_w"], enc0_b=params["enc0_b"],
+                              enc1_w=params["enc1_w"], enc1_b=params["enc1_b"],
+                              dictionary=params["decoder"])
+
+
+class SemiLinearDict(ld.LearnedDict):
+    enc0_w: Array
+    enc0_b: Array
+    enc1_w: Array
+    enc1_b: Array
+    dictionary: Array
+
+    def get_learned_dict(self) -> Array:
+        return ld.normalize_rows(self.dictionary)
+
+    def encode(self, x: Array) -> Array:
+        h = jax.nn.relu(x @ self.enc0_w.T + self.enc0_b)
+        return jax.nn.relu(h @ self.enc1_w.T + self.enc1_b)

@@ -1,0 +1,73 @@
+"""The trainable-dictionary protocol.
+
+TPU-native analogue of the reference's `DictSignature`
+(reference: autoencoders/ensemble.py:15-22): a signature is a namespace of
+*pure functions* over explicit params/buffers pytrees, so the ensemble engine
+can `jax.vmap(jax.grad(sig.loss))` over a stacked ensemble axis.
+
+Contract:
+- ``init(key, ...) -> (params, buffers)``: params are trained, buffers are
+  per-member constants (hyperparameters like l1_alpha live here as 0-d arrays
+  so they can vary across vmapped ensemble members).
+- ``loss(params, buffers, batch) -> (loss, aux)`` where ``aux`` is an
+  `AuxData` of scalar loss components and activity statistics (the reference
+  returns the full code tensor as aux, sae_ensemble.py:74-76 — we return
+  reduced statistics instead to keep the jitted step memory-light, plus a
+  per-feature activity count used for dead-feature tracking).
+- ``to_learned_dict(params, buffers) -> LearnedDict``: inference export.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Protocol, Tuple
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+Params = Any
+Buffers = Any
+
+
+class AuxData(struct.PyTreeNode):
+    """Reduced per-step statistics returned by every signature's loss."""
+
+    losses: dict[str, Array]  # scalar loss components, incl. "loss"
+    l0: Array  # mean number of nonzero coefficients per sample
+    feat_activity: Array  # [n_feats] count of samples activating each feature
+
+
+def make_aux(losses: dict[str, Array], c: Array) -> AuxData:
+    active = c > 0.0
+    return AuxData(
+        losses=losses,
+        l0=jnp.mean(jnp.sum(active, axis=-1).astype(jnp.float32)),
+        feat_activity=jnp.sum(active, axis=0).astype(jnp.int32),
+    )
+
+
+class DictSignature(Protocol):
+    init: Callable[..., Tuple[Params, Buffers]]
+    loss: Callable[[Params, Buffers, Array], Tuple[Array, AuxData]]
+    to_learned_dict: Callable[[Params, Buffers], Any]
+
+
+# Registry so sweep configs can name signatures by string.
+_REGISTRY: dict[str, type] = {}
+
+
+def register(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        cls.signature_name = name
+        return cls
+    return deco
+
+
+def get_signature(name: str) -> type:
+    return _REGISTRY[name]
+
+
+def signature_names() -> list[str]:
+    return sorted(_REGISTRY)

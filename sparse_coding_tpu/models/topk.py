@@ -1,0 +1,61 @@
+"""k-sparse (TopK) encoder.
+
+Reference: autoencoders/topk_encoder.py — tied dictionary, codes are the
+ReLU'd top-k projection scores, trained with MSE only (no L1 term). Because
+`k` is a static shape parameter, members with different k cannot share one
+vmapped ensemble; the engine buckets them per-k instead (the reference uses a
+`no_stacking` Python loop, ensemble.py:100-116).
+
+On TPU, `jax.lax.top_k` lowers to an efficient sort on the VPU and the scatter
+is a one-hot matmul-free `.at[].set` — still dominated by the two MXU matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu.models import learned_dict as ld
+from sparse_coding_tpu.models.sae import _glorot, _normalize
+from sparse_coding_tpu.models.signatures import make_aux, register
+
+Array = jax.Array
+
+
+def topk_sparsify(scores: Array, k: int) -> Array:
+    """Keep the top-k entries of each row (ReLU'd), zero the rest
+    (reference: topk_encoder.py:20-27)."""
+    topk_vals, topk_idx = jax.lax.top_k(scores, k)
+    batch_idx = jnp.arange(scores.shape[0])[:, None]
+    out = jnp.zeros_like(scores)
+    return out.at[batch_idx, topk_idx].set(jax.nn.relu(topk_vals))
+
+
+@register("topk")
+class TopKEncoder:
+    """Trainable top-k tied SAE (reference: topk_encoder.py:10-40)."""
+
+    @staticmethod
+    def init(key: Array, activation_size: int, n_dict_components: int,
+             k: int, dtype=jnp.float32):
+        params = {
+            "encoder": _glorot(key, (n_dict_components, activation_size), dtype),
+        }
+        # k is static (shapes depend on it): kept in buffers as a plain int so
+        # it partitions ensembles into same-k buckets rather than being traced.
+        buffers = {"k": k}
+        return params, buffers
+
+    @staticmethod
+    def loss(params, buffers, batch: Array):
+        dictionary = _normalize(params["encoder"])
+        scores = batch @ dictionary.T
+        c = topk_sparsify(scores, buffers["k"])
+        x_hat = c @ dictionary
+        l_reconstruction = jnp.mean(jnp.square(x_hat - batch))
+        return l_reconstruction, make_aux(
+            {"loss": l_reconstruction, "l_reconstruction": l_reconstruction}, c)
+
+    @staticmethod
+    def to_learned_dict(params, buffers) -> ld.TopKLearnedDict:
+        return ld.TopKLearnedDict(dictionary=params["encoder"], k=int(buffers["k"]))

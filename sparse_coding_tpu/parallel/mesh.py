@@ -1,0 +1,92 @@
+"""Device-mesh construction and sharding rules.
+
+Single place defining the framework's parallelism model, replacing all three
+of the reference's distribution mechanisms (SURVEY.md §2.7):
+- process-per-GPU ensemble scheduling (reference: cluster_runs.py:100-157),
+- gloo DDP all-reduce (reference: experiments/huge_batch_size.py:337-342),
+- manual device lists in experiment fns (big_sweep_experiments.py:51,68).
+
+Axes:
+- "model": the ensemble axis — members sharded across chips (the analogue of
+  one reference worker process per GPU);
+- "data": batch axis — activation slabs sharded across chips, grads reduced
+  by XLA psum over ICI.
+
+A very large single SAE (the huge_batch_size.py regime) additionally shards
+the feature dimension over "model" — see train/big_sae.py.
+
+Multi-host: `initialize_distributed()` wires `jax.distributed` so the same
+mesh spans hosts (ICI within a slice, DCN across; XLA routes collectives).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MODEL_AXIS = "model"
+DATA_AXIS = "data"
+
+
+def make_mesh(mesh_model: int = 1, mesh_data: Optional[int] = None,
+              devices: Optional[list] = None) -> Mesh:
+    """Build a ("model", "data") mesh.
+
+    mesh_data=None uses all remaining devices on the data axis. The model
+    axis is placed first so ensemble members land on contiguous devices
+    (minimizing ICI hops for the per-member all-reduces, which only span the
+    data axis)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if mesh_data is None:
+        if n % mesh_model != 0:
+            raise ValueError(f"{n} devices not divisible by mesh_model={mesh_model}")
+        mesh_data = n // mesh_model
+    use = mesh_model * mesh_data
+    if use > n:
+        raise ValueError(f"mesh {mesh_model}x{mesh_data} needs {use} devices, have {n}")
+    grid = np.asarray(devices[:use]).reshape(mesh_model, mesh_data)
+    return Mesh(grid, (MODEL_AXIS, DATA_AXIS))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(1, 1)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Activations [batch, d] sharded over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def ensemble_sharding(mesh: Mesh) -> NamedSharding:
+    """Stacked ensemble leaves [N, ...] sharded over the model axis."""
+    return NamedSharding(mesh, P(MODEL_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def feature_sharding(mesh: Mesh) -> NamedSharding:
+    """A single giant SAE's [n_feats, d] params sharded over "model" on the
+    feature axis — tensor parallelism for the huge_batch_size.py regime."""
+    return NamedSharding(mesh, P(MODEL_AXIS, None))
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host entry (SURVEY.md §5 'distributed communication backend'):
+    call once per host before device queries. No-op when single-process env
+    vars are absent and no explicit coordinator is given."""
+    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,112 @@
+"""Minimal single-host L1 sweep over a directory of activation chunks.
+
+Re-design of the reference's `basic_l1_sweep` (reference:
+basic_l1_sweep.py:46-115): one vmapped tied-SAE ensemble over an l1 grid,
+fed from a ChunkStore with device prefetch, saving learned dicts + FVU/L0
+per epoch. This is the framework's "minimum end-to-end slice"
+(SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.config import EnsembleArgs
+from sparse_coding_tpu.data.chunk_store import ChunkStore, device_prefetch
+from sparse_coding_tpu.ensemble import Ensemble
+from sparse_coding_tpu.metrics.core import fraction_variance_unexplained, mean_l0
+from sparse_coding_tpu.models.sae import FunctionalSAE, FunctionalTiedSAE
+from sparse_coding_tpu.parallel.mesh import batch_sharding, make_mesh
+from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+from sparse_coding_tpu.utils.logging import MetricsLogger
+
+
+def basic_l1_sweep(
+    dataset_dir: str | Path,
+    output_dir: str | Path,
+    l1_values: Sequence[float],
+    dict_ratio: float = 4.0,
+    batch_size: int = 1024,
+    lr: float = 1e-3,
+    n_epochs: int = 1,
+    tied: bool = True,
+    adam_epsilon: float = 1e-8,
+    seed: int = 0,
+    mesh=None,
+    use_wandb: bool = False,
+) -> list:
+    """Train one ensemble member per l1 value; save per-epoch artifacts.
+    Returns the final list of (LearnedDict, hyperparams)."""
+    store = ChunkStore(dataset_dir)
+    d = store.activation_dim  # inferred from chunk 0, as basic_l1_sweep.py:59-62
+    n_dict = int(d * dict_ratio)
+    sig = FunctionalTiedSAE if tied else FunctionalSAE
+
+    keys = jax.random.split(jax.random.PRNGKey(seed), len(l1_values))
+    members = [sig.init(k, d, n_dict, l1_alpha=float(l1))
+               for k, l1 in zip(keys, l1_values)]
+    ens = Ensemble(members, sig, lr=lr, adam_eps=adam_epsilon, mesh=mesh)
+
+    logger = MetricsLogger(output_dir, use_wandb=use_wandb, run_name="basic_l1_sweep")
+    rng = np.random.default_rng(seed)
+    sharding = batch_sharding(mesh) if mesh is not None else None
+
+    step = 0
+    for epoch in range(n_epochs):
+        batches = store.epoch(batch_size, rng)
+        for batch in device_prefetch(batches, sharding):
+            aux = ens.step_batch(batch)
+            step += 1
+            if step % 100 == 0:
+                losses = jax.device_get(aux.losses)
+                l0 = jax.device_get(aux.l0)
+                for i, l1 in enumerate(l1_values):
+                    logger.log({f"l1={l1:.2e}/loss": float(losses["loss"][i]),
+                                f"l1={l1:.2e}/l0": float(l0[i])}, step=step)
+        _save_epoch(ens, l1_values, dict_ratio, store, output_dir, epoch, rng)
+    logger.close()
+
+    dicts = ens.to_learned_dicts()
+    return [(ld, {"l1_alpha": float(l1), "dict_size": n_dict})
+            for ld, l1 in zip(dicts, l1_values)]
+
+
+def _save_epoch(ens: Ensemble, l1_values, dict_ratio, store: ChunkStore,
+                output_dir, epoch: int, rng) -> None:
+    out = Path(output_dir) / f"epoch_{epoch}"
+    dicts = ens.to_learned_dicts()
+    tagged = [(ld, {"l1_alpha": float(l1), "dict_ratio": dict_ratio})
+              for ld, l1 in zip(dicts, l1_values)]
+    save_learned_dicts(tagged, out / "learned_dicts.pkl")
+    # quick eval on a fresh slab (reference logs fvu/sparsity per save)
+    chunk = store.load_chunk(int(rng.integers(store.n_chunks)))
+    eval_batch = jnp.asarray(chunk[rng.permutation(chunk.shape[0])[:4096]])
+    stats = []
+    for ld, hyper in tagged:
+        stats.append({"l1_alpha": hyper["l1_alpha"],
+                      "fvu": float(fraction_variance_unexplained(ld, eval_batch)),
+                      "l0": float(mean_l0(ld, eval_batch))})
+    import json
+
+    (out / "eval.json").write_text(json.dumps(stats, indent=2))
+
+
+def main(argv=None) -> None:
+    cfg = EnsembleArgs.from_cli(argv)
+    l1_values = list(np.logspace(-4, -2, 16))
+    mesh = None
+    if cfg.mesh_data > 1 or cfg.mesh_model > 1:
+        mesh = make_mesh(cfg.mesh_model, cfg.mesh_data)
+    basic_l1_sweep(cfg.dataset_folder, cfg.output_folder, l1_values,
+                   dict_ratio=cfg.learned_dict_ratio, batch_size=cfg.batch_size,
+                   lr=cfg.lr, tied=cfg.tied_ae, adam_epsilon=cfg.adam_epsilon,
+                   seed=cfg.seed, mesh=mesh, use_wandb=cfg.use_wandb)
+
+
+if __name__ == "__main__":
+    main()

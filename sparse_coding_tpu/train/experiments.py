@@ -1,0 +1,147 @@
+"""Experiment registry: functions building ensembles for `sweep()`.
+
+Replaces the reference's 1.3k-line registry (reference:
+big_sweep_experiments.py) with parameterized builders. The reference
+hand-assigns GPUs per ensemble (e.g. :51,68 `devices.pop()`); here device
+placement is the mesh's job, so an "experiment" is just the grid definition.
+
+Each builder returns `[(Ensemble|EnsembleGroup, member_hyperparams, name)]` —
+the 4-tuple contract of the reference (big_sweep_experiments.py:208-228)
+minus the device bookkeeping.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from sparse_coding_tpu.config import EnsembleArgs
+from sparse_coding_tpu.ensemble import Ensemble, EnsembleGroup
+from sparse_coding_tpu.models.sae import (
+    FunctionalMaskedTiedSAE,
+    FunctionalSAE,
+    FunctionalTiedSAE,
+)
+from sparse_coding_tpu.models.topk import TopKEncoder
+
+DEFAULT_L1_RANGE = list(np.logspace(-4, -2, 16))  # big_sweep_experiments.py:295
+
+
+def _activation_dim(cfg: EnsembleArgs) -> int:
+    from sparse_coding_tpu.data.chunk_store import ChunkStore
+
+    return ChunkStore(cfg.dataset_folder).activation_dim
+
+
+def dense_l1_range_experiment(cfg: EnsembleArgs, mesh=None,
+                              l1_range: Optional[Sequence[float]] = None,
+                              activation_dim: Optional[int] = None):
+    """16-point l1 sweep at one dict ratio, tied or untied
+    (reference: big_sweep_experiments.py:294-340)."""
+    l1s = list(l1_range if l1_range is not None else DEFAULT_L1_RANGE)
+    d = activation_dim or _activation_dim(cfg)
+    n_dict = int(d * cfg.learned_dict_ratio)
+    sig = FunctionalTiedSAE if cfg.tied_ae else FunctionalSAE
+    keys = jax.random.split(jax.random.PRNGKey(cfg.seed), len(l1s))
+    members = [sig.init(k, d, n_dict, l1_alpha=float(l1))
+               for k, l1 in zip(keys, l1s)]
+    ens = Ensemble(members, sig, lr=cfg.lr, adam_eps=cfg.adam_epsilon, mesh=mesh)
+    hypers = [{"l1_alpha": float(l1), "dict_size": n_dict, "tied": cfg.tied_ae}
+              for l1 in l1s]
+    return [(ens, hypers, "dense_l1_range")]
+
+
+def tied_vs_not_experiment(cfg: EnsembleArgs, mesh=None,
+                           l1_range: Optional[Sequence[float]] = None,
+                           activation_dim: Optional[int] = None):
+    """Tied and untied ensembles over the same l1 grid
+    (reference: big_sweep_experiments.py:42-229)."""
+    l1s = list(l1_range if l1_range is not None else DEFAULT_L1_RANGE)
+    d = activation_dim or _activation_dim(cfg)
+    n_dict = int(d * cfg.learned_dict_ratio)
+    out = []
+    for tied, sig, name in [(True, FunctionalTiedSAE, "tied"),
+                            (False, FunctionalSAE, "untied")]:
+        keys = jax.random.split(jax.random.PRNGKey(cfg.seed + tied), len(l1s))
+        members = [sig.init(k, d, n_dict, l1_alpha=float(l1))
+                   for k, l1 in zip(keys, l1s)]
+        ens = Ensemble(members, sig, lr=cfg.lr, adam_eps=cfg.adam_epsilon, mesh=mesh)
+        hypers = [{"l1_alpha": float(l1), "dict_size": n_dict, "tied": tied}
+                  for l1 in l1s]
+        out.append((ens, hypers, name))
+    return out
+
+
+def topk_experiment(cfg: EnsembleArgs, mesh=None,
+                    ks: Sequence[int] = (4, 8, 16, 32, 64, 128),
+                    activation_dim: Optional[int] = None):
+    """TopK sweep across k — ragged shapes bucketed per k
+    (reference: big_sweep_experiments.py:232-292, which falls back to
+    no_stacking)."""
+    d = activation_dim or _activation_dim(cfg)
+    n_dict = int(d * cfg.learned_dict_ratio)
+    keys = jax.random.split(jax.random.PRNGKey(cfg.seed), len(ks))
+    members = [TopKEncoder.init(k_rng, d, n_dict, k=int(k))
+               for k_rng, k in zip(keys, ks)]
+    group = EnsembleGroup.build(TopKEncoder, members, lr=cfg.lr, mesh=mesh)
+    # hypers must follow bucket-flattening order (group.to_learned_dicts
+    # iterates buckets in insertion order), not sorted(ks)
+    hypers = [{"k": dict(ens.state.static_buffers)["k"], "dict_size": n_dict}
+              for ens in group.ensembles.values()
+              for _ in range(ens.n_members)]
+    return [(group, hypers, "topk")]
+
+
+def dict_ratio_experiment(cfg: EnsembleArgs, mesh=None,
+                          ratios: Sequence[float] = (0.5, 1, 2, 4, 8, 16, 32),
+                          l1_alpha: float = 8.577e-4,
+                          activation_dim: Optional[int] = None):
+    """Mixed dict sizes in ONE vmapped ensemble via masking
+    (reference: big_sweep_experiments.py:543-618 with FunctionalMaskedTiedSAE;
+    l1 default is the reference's canonical operating point,
+    interpret.py:791)."""
+    d = activation_dim or _activation_dim(cfg)
+    sizes = [int(d * r) for r in ratios]
+    n_stack = max(sizes)
+    keys = jax.random.split(jax.random.PRNGKey(cfg.seed), len(sizes))
+    members = [FunctionalMaskedTiedSAE.init(k, d, n, n_stack, l1_alpha=l1_alpha)
+               for k, n in zip(keys, sizes)]
+    ens = Ensemble(members, FunctionalMaskedTiedSAE, lr=cfg.lr,
+                   adam_eps=cfg.adam_epsilon, mesh=mesh)
+    hypers = [{"l1_alpha": l1_alpha, "dict_size": n, "dict_ratio": r}
+              for n, r in zip(sizes, ratios)]
+    return [(ens, hypers, "dict_ratio")]
+
+
+def zero_l1_baseline_experiment(cfg: EnsembleArgs, mesh=None,
+                                activation_dim: Optional[int] = None):
+    """l1=0 pure-reconstruction baseline member next to a small l1 grid
+    (reference: big_sweep_experiments.py:497-541)."""
+    l1s = [0.0, 1e-4, 1e-3]
+    return dense_l1_range_experiment(cfg, mesh, l1_range=l1s,
+                                     activation_dim=activation_dim)
+
+
+def long_l1_range_experiment(cfg: EnsembleArgs, mesh=None,
+                             activation_dim: Optional[int] = None):
+    """32-point l1 grid (reference: big_sweep_experiments.py:341-433
+    residual_denoising/long variants use wider grids)."""
+    l1s = list(np.logspace(-5, -2, 32))
+    return dense_l1_range_experiment(cfg, mesh, l1_range=l1s,
+                                     activation_dim=activation_dim)
+
+
+EXPERIMENTS = {
+    "dense_l1_range": dense_l1_range_experiment,
+    "tied_vs_not": tied_vs_not_experiment,
+    "topk": topk_experiment,
+    "dict_ratio": dict_ratio_experiment,
+    "zero_l1_baseline": zero_l1_baseline_experiment,
+    "long_l1_range": long_l1_range_experiment,
+}
+
+
+def get_experiment(name: str):
+    return EXPERIMENTS[name]

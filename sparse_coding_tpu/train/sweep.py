@@ -1,0 +1,229 @@
+"""The full sweep driver — the heart of the framework.
+
+TPU-native re-design of the reference's `sweep()` pipeline
+(reference: big_sweep.py:298-386) and its process-per-GPU chunk scheduler
+(cluster_runs.py:100-157). The reference pins a 2 GB chunk into POSIX shared
+memory and forks one OS process per ensemble; here every ensemble's step is
+an async-dispatched jitted computation on a shared device mesh, so "dispatch
+a chunk to all ensembles" is just interleaved step calls — XLA pipelines
+them, and the host stays a thin orchestrator.
+
+Flow (mirroring big_sweep.py:298-386):
+  1. seed + logger init
+  2. dataset: existing ChunkStore, or synthetic generator materialized to disk
+  3. ensemble_init_fn(cfg, mesh) -> [(Ensemble|EnsembleGroup, member_hyperparams, name)]
+  4. chunk order shuffled ×n_repetitions; optional first-chunk-mean centering
+  5. per chunk: stream shuffled batches through every ensemble
+  6. save learned_dicts + config at power-of-two chunk counts and at the end
+  7. full-state checkpoint each chunk for exact resume (beyond the reference)
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.config import EnsembleArgs, SyntheticEnsembleArgs
+from sparse_coding_tpu.data.chunk_store import ChunkStore, ChunkWriter, device_prefetch
+from sparse_coding_tpu.ensemble import Ensemble, EnsembleGroup
+from sparse_coding_tpu.metrics.core import (
+    fraction_variance_unexplained,
+    mean_l0,
+    mmcs_from_list,
+)
+from sparse_coding_tpu.parallel.mesh import batch_sharding, make_mesh
+from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+from sparse_coding_tpu.utils.checkpoint import restore_ensemble, save_ensemble
+from sparse_coding_tpu.utils.logging import MetricsLogger
+
+EnsembleLike = Union[Ensemble, EnsembleGroup]
+# ensemble_init_fn(cfg, mesh) -> list of (ensemble, per-member hyperparams, name)
+EnsembleInitFn = Callable[..., list[tuple[EnsembleLike, list[dict], str]]]
+
+
+def init_synthetic_dataset(cfg: SyntheticEnsembleArgs) -> ChunkStore:
+    """Materialize a synthetic dataset to chunk files
+    (reference: big_sweep.py:269-295 init_synthetic_dataset)."""
+    from sparse_coding_tpu.data.synthetic import RandomDatasetGenerator
+
+    folder = Path(cfg.dataset_folder)
+    if (folder / "meta.json").exists():
+        return ChunkStore(folder)
+    gen = RandomDatasetGenerator.create(
+        jax.random.PRNGKey(cfg.seed), cfg.activation_dim,
+        cfg.n_ground_truth_features, cfg.feature_num_nonzero,
+        cfg.feature_prob_decay, correlated=cfg.correlated_components)
+    writer = ChunkWriter(folder, cfg.activation_dim,
+                         chunk_size_gb=max(cfg.dataset_size * cfg.activation_dim
+                                           * 2 / cfg.n_chunks / 2**30, 1e-6),
+                         dtype="float16")
+    key = jax.random.PRNGKey(cfg.seed + 1)
+    remaining = cfg.dataset_size
+    while remaining > 0:
+        key, sub = jax.random.split(key)
+        n = min(remaining, 65536)
+        writer.add(jax.device_get(gen.batch(sub, n)))
+        remaining -= n
+    writer.finalize({"synthetic": True})
+    np.save(folder / "ground_truth_feats.npy", jax.device_get(gen.feats))
+    return ChunkStore(folder)
+
+
+def _ensembles_of(e: EnsembleLike) -> list[Ensemble]:
+    return list(e.ensembles.values()) if isinstance(e, EnsembleGroup) else [e]
+
+
+def _flat_dicts(e: EnsembleLike) -> list:
+    if isinstance(e, EnsembleGroup):
+        return [d for ds in e.to_learned_dicts().values() for d in ds]
+    return e.to_learned_dicts()
+
+
+def sweep(
+    ensemble_init_fn: EnsembleInitFn,
+    cfg: EnsembleArgs,
+    store: Optional[ChunkStore] = None,
+    mesh=None,
+    log_every: int = 100,
+    image_metrics_every: Optional[int] = 10,
+    resume: bool = False,
+) -> dict[str, list]:
+    """Run the sweep; returns {name: [(LearnedDict, hyperparams), ...]}.
+
+    `cfg.n_chunks` limits chunks per repetition; saves happen at chunk counts
+    {7, 15, 31, ...} and at the end (reference: big_sweep.py:378-384 saves at
+    i ∈ {7,15,…,2^9−1} and the final chunk), or every
+    `cfg.save_every_chunks` when set. `resume=True` restores ensemble state +
+    the batch RNG from the newest checkpoints and skips completed chunks."""
+    out_dir = Path(cfg.output_folder)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    cfg.save(out_dir / "config.json")  # YAML-dump analogue (big_sweep.py:382-384)
+
+    if store is None:
+        if isinstance(cfg, SyntheticEnsembleArgs):
+            store = init_synthetic_dataset(cfg)
+        else:
+            store = ChunkStore(cfg.dataset_folder)
+
+    if mesh is None and (cfg.mesh_data > 1 or cfg.mesh_model > 1):
+        mesh = make_mesh(cfg.mesh_model, cfg.mesh_data)
+
+    ensembles = ensemble_init_fn(cfg, mesh)
+    logger = MetricsLogger(out_dir, use_wandb=cfg.use_wandb,
+                           run_name=out_dir.name, config=cfg.to_dict())
+
+    rng = np.random.default_rng(cfg.seed)
+    n_chunks = min(cfg.n_chunks, store.n_chunks)
+    chunk_order = np.concatenate([rng.permutation(n_chunks)
+                                  for _ in range(cfg.n_repetitions)])
+
+    chunks_done = 0
+    if resume:
+        chunks_done, rng_state = resume_sweep_state(ensembles, out_dir)
+        if rng_state is not None:
+            rng.bit_generator.state = rng_state
+
+    center = None
+    if cfg.center_activations:
+        center = store.chunk_mean(0)  # (reference: big_sweep.py:359-364)
+
+    sharding = batch_sharding(mesh) if mesh is not None else None
+    if cfg.save_every_chunks:
+        save_points = set(range(cfg.save_every_chunks - 1, len(chunk_order),
+                                cfg.save_every_chunks))
+    else:
+        save_points = {2**k - 1 for k in range(3, 10)}
+    step = 0
+
+    for ci, chunk_idx in enumerate(chunk_order):
+        if ci < chunks_done:
+            continue
+        chunk = store.load_chunk(int(chunk_idx))
+        if center is not None:
+            chunk = chunk - center
+        batches = store.batches(chunk, cfg.batch_size, rng)
+        for batch in device_prefetch(batches, sharding):
+            step += 1
+            for ensemble, hypers, name in ensembles:
+                if isinstance(ensemble, EnsembleGroup):
+                    auxes = ensemble.step_batch(batch)
+                    aux_items = list(auxes.items())
+                else:
+                    aux_items = [(name, ensemble.step_batch(batch))]
+                if step % log_every == 0:
+                    for sub_name, aux in aux_items:
+                        losses = jax.device_get(aux.losses["loss"])
+                        l0 = jax.device_get(aux.l0)
+                        logger.log({f"{sub_name}/loss_mean": float(np.mean(losses)),
+                                    f"{sub_name}/loss_max": float(np.max(losses)),
+                                    f"{sub_name}/l0_mean": float(np.mean(l0))},
+                                   step=step)
+        # checkpoint + periodic artifact saves; the RNG state makes the data
+        # stream resume exactly where it stopped
+        rng_state = rng.bit_generator.state
+        for ensemble, hypers, name in ensembles:
+            for j, sub in enumerate(_ensembles_of(ensemble)):
+                save_ensemble(sub, out_dir / "ckpt" / f"{name}_{j}.msgpack",
+                              extra={"chunks_done": ci + 1,
+                                     "rng_state": rng_state})
+        if ci in save_points or ci == len(chunk_order) - 1:
+            _save_artifacts(ensembles, out_dir / f"_{ci}", chunk, cfg, logger,
+                            image_metrics=image_metrics_every is not None
+                            and (ci + 1) % image_metrics_every == 0)
+
+    logger.close()
+    result = {}
+    for ensemble, hypers, name in ensembles:
+        dicts = _flat_dicts(ensemble)
+        result[name] = list(zip(dicts, hypers))
+    return result
+
+
+def _save_artifacts(ensembles, folder: Path, chunk: np.ndarray,
+                    cfg: EnsembleArgs, logger: MetricsLogger,
+                    image_metrics: bool = False) -> None:
+    """Save learned dicts + quick evals (reference: big_sweep.py:368-384 +
+    log_standard_metrics :86-156)."""
+    folder.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(0)
+    eval_batch = jnp.asarray(chunk[rng.permutation(chunk.shape[0])[:4096]])
+    for ensemble, hypers, name in ensembles:
+        dicts = _flat_dicts(ensemble)
+        tagged = list(zip(dicts, hypers))
+        save_learned_dicts(tagged, folder / f"{name}_learned_dicts.pkl")
+        evals = []
+        for ld, hyper in tagged:
+            evals.append({**{k: v for k, v in hyper.items()
+                             if isinstance(v, (int, float, str))},
+                          "fvu": float(fraction_variance_unexplained(ld, eval_batch)),
+                          "l0": float(mean_l0(ld, eval_batch))})
+        (folder / f"{name}_eval.json").write_text(json.dumps(evals, indent=2))
+        if image_metrics and len(dicts) > 1:
+            # MMCS grid vs the other members (reference's image panel,
+            # big_sweep.py:96-133, as data rather than a wandb image)
+            grid = np.asarray(mmcs_from_list(dicts[: min(len(dicts), 8)]))
+            np.save(folder / f"{name}_mmcs_grid.npy", grid)
+
+
+def resume_sweep_state(ensembles: Sequence[tuple[EnsembleLike, list, str]],
+                       out_dir: str | Path) -> tuple[int, Optional[dict]]:
+    """Restore all ensembles from the newest checkpoints; returns
+    (chunks_done, batch-rng bit-generator state) — (0, None) without
+    checkpoints."""
+    out_dir = Path(out_dir)
+    chunks_done = 0
+    rng_state = None
+    for ensemble, hypers, name in ensembles:
+        for j, sub in enumerate(_ensembles_of(ensemble)):
+            path = out_dir / "ckpt" / f"{name}_{j}.msgpack"
+            if path.exists():
+                meta = restore_ensemble(sub, path)
+                if int(meta.get("chunks_done", 0)) >= chunks_done:
+                    chunks_done = int(meta.get("chunks_done", 0))
+                    rng_state = meta.get("rng_state", rng_state)
+    return chunks_done, rng_state

@@ -1,0 +1,83 @@
+"""Learned-dictionary artifact files.
+
+The reference persists sweeps as `learned_dicts.pt`: a torch-pickled list of
+(LearnedDict, hyperparams) tuples (reference: big_sweep.py:378-384,
+basic_l1_sweep.py:108-115). Here the same contract is a
+`learned_dicts.pkl`: a pickled list of records {cls, fields(numpy), static,
+hyperparams}, reconstructed into flax-struct pytrees on load — torch-free and
+readable from any host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+from pathlib import Path
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+ARTIFACT_NAME = "learned_dicts.pkl"
+
+
+def _dict_registry() -> dict[str, type]:
+    """Every LearnedDict class in the package, across all model modules."""
+    import sparse_coding_tpu.models as m
+    from sparse_coding_tpu.models import direct_coef, ica, lista, nmf, pca, rica, semilinear
+    from sparse_coding_tpu.models.learned_dict import LearnedDict
+    from sparse_coding_tpu.models.sae import ThresholdingSAE
+
+    reg = {name: getattr(m, name) for name in dir(m)
+           if isinstance(getattr(m, name), type)}
+    for mod in (direct_coef, ica, lista, nmf, pca, rica, semilinear):
+        for name in dir(mod):
+            obj = getattr(mod, name)
+            if isinstance(obj, type) and issubclass(obj, LearnedDict):
+                reg[name] = obj
+    reg["ThresholdingSAE"] = ThresholdingSAE
+    return reg
+
+
+def _to_numpy_tree(v):
+    return jax.tree.map(lambda leaf: np.asarray(jax.device_get(leaf)), v)
+
+
+def _to_jax_tree(v):
+    return jax.tree.map(jax.numpy.asarray, v)
+
+
+def save_learned_dicts(dicts: Sequence[tuple[Any, dict]], path: str | Path) -> None:
+    """dicts: [(LearnedDict, hyperparams), ...] — the reference's tuple
+    contract."""
+    records = []
+    for d, hyper in dicts:
+        fields = {}
+        static = {}
+        for f in dataclasses.fields(d):
+            v = getattr(d, f.name)
+            if f.metadata.get("pytree_node", True) and v is not None:
+                # pytree-valued fields (e.g. LISTA's stacked encoder_layers
+                # dict) are converted leaf-wise, not with a bare np.asarray
+                fields[f.name] = _to_numpy_tree(v)
+            else:
+                static[f.name] = v
+        records.append({"cls": type(d).__name__, "fields": fields,
+                        "static": static, "hyperparams": dict(hyper)})
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("wb") as fh:
+        pickle.dump(records, fh)
+
+
+def load_learned_dicts(path: str | Path) -> list[tuple[Any, dict]]:
+    with Path(path).open("rb") as fh:
+        records = pickle.load(fh)
+    reg = _dict_registry()
+    out = []
+    for rec in records:
+        cls = reg[rec["cls"]]
+        kwargs = {k: _to_jax_tree(v) for k, v in rec["fields"].items()}
+        kwargs.update(rec["static"])
+        out.append((cls(**kwargs), rec["hyperparams"]))
+    return out

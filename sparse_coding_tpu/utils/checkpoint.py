@@ -1,0 +1,70 @@
+"""Checkpoint / resume.
+
+The reference only saves converted `learned_dicts.pt` artifacts at
+power-of-two chunk counts (reference: big_sweep.py:378-384) — training state
+is serializable (ensemble.py:125-161) but never persisted. Here we checkpoint
+the FULL training state (params, buffers, optimizer state, lrs, step, data
+cursor, RNG) so sweeps resume exactly (SURVEY.md §5 'Checkpoint / resume').
+
+Format: flax msgpack for the pytree + a JSON sidecar for static metadata.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+from sparse_coding_tpu.ensemble import Ensemble, EnsembleState
+
+
+def save_ensemble(ens: Ensemble, path: str | Path,
+                  extra: Optional[dict] = None) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    state = jax.device_get(ens.state)
+    tree = {"params": state.params, "buffers": state.buffers,
+            "opt_state": state.opt_state, "lrs": state.lrs, "step": state.step}
+    path.write_bytes(serialization.to_bytes(tree))
+    meta = {"sig_name": state.sig_name,
+            "static_buffers": list(state.static_buffers),
+            **(extra or {})}
+    path.with_suffix(path.suffix + ".meta.json").write_text(
+        json.dumps(meta, indent=2, default=str))
+
+
+def restore_ensemble(ens: Ensemble, path: str | Path) -> dict:
+    """Restore state in-place into a freshly-constructed, same-shape Ensemble.
+    Returns the metadata sidecar (incl. any data-cursor extras)."""
+    path = Path(path)
+    state = jax.device_get(ens.state)
+    template = {"params": state.params, "buffers": state.buffers,
+                "opt_state": state.opt_state, "lrs": state.lrs,
+                "step": state.step}
+    tree = serialization.from_bytes(template, path.read_bytes())
+    new_state = EnsembleState(
+        params=tree["params"], buffers=tree["buffers"],
+        opt_state=tree["opt_state"], lrs=tree["lrs"], step=tree["step"],
+        static_buffers=state.static_buffers, sig_name=state.sig_name)
+    if ens.mesh is not None:
+        from sparse_coding_tpu.ensemble import shard_ensemble_state
+        new_state = shard_ensemble_state(new_state, ens.mesh)
+    else:
+        new_state = jax.tree.map(jax.numpy.asarray, new_state)
+    ens.state = new_state
+    meta_path = path.with_suffix(path.suffix + ".meta.json")
+    return json.loads(meta_path.read_text()) if meta_path.exists() else {}
+
+
+def save_pytree(tree: Any, path: str | Path) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_bytes(serialization.to_bytes(jax.device_get(tree)))
+
+
+def restore_pytree(template: Any, path: str | Path) -> Any:
+    return serialization.from_bytes(template, Path(path).read_bytes())

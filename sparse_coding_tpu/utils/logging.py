@@ -1,0 +1,60 @@
+"""Run metrics logging.
+
+The reference hard-wires wandb with secrets read from secrets.json
+(reference: big_sweep.py:310-319). Here the default sink is a local JSONL
+file (always works in a zero-egress container); wandb attaches on top when
+available and requested.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+
+class MetricsLogger:
+    def __init__(self, output_folder: str | Path, use_wandb: bool = False,
+                 run_name: str = "run", config: Optional[dict] = None):
+        self.folder = Path(output_folder)
+        self.folder.mkdir(parents=True, exist_ok=True)
+        self.path = self.folder / "metrics.jsonl"
+        self._fh = self.path.open("a")
+        self.wandb = None
+        if use_wandb:
+            try:
+                import wandb
+
+                self.wandb = wandb.init(project="sparse_coding_tpu",
+                                        name=run_name, config=config or {})
+            except Exception:
+                self.wandb = None  # offline image: silently fall back to JSONL
+
+    def log(self, metrics: dict[str, Any], step: Optional[int] = None) -> None:
+        rec = {"ts": time.time(), **({"step": step} if step is not None else {}),
+               **metrics}
+        self._fh.write(json.dumps(rec, default=float) + "\n")
+        if self.wandb is not None:
+            self.wandb.log(metrics, step=step)
+
+    def flush(self) -> None:
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.flush()
+        self._fh.close()
+        if self.wandb is not None:
+            self.wandb.finish()
+
+
+def make_hyperparam_name(hyperparams: dict[str, Any]) -> str:
+    """Stable run-name from hyperparams (reference: big_sweep.py:75-83)."""
+    parts = []
+    for k in sorted(hyperparams):
+        v = hyperparams[k]
+        if isinstance(v, float):
+            parts.append(f"{k}{v:.2e}")
+        else:
+            parts.append(f"{k}{v}")
+    return "_".join(parts)

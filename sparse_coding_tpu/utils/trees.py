@@ -1,0 +1,59 @@
+"""Pytree stack/unstack helpers for the ensemble axis.
+
+Replaces the reference's `stack_dict`/`unstack_dict`
+(reference: autoencoders/ensemble.py:50-66) with jax.tree operations. Stacked
+pytrees carry a leading ensemble axis of size N on every leaf; all training
+math is vmapped over that axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def stack_trees(trees: Sequence[Pytree]) -> Pytree:
+    """Stack a list of structurally-identical pytrees along a new leading axis."""
+    if not trees:
+        raise ValueError("cannot stack an empty list of pytrees")
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves, axis=0), *trees)
+
+
+def unstack_tree(tree: Pytree) -> list[Pytree]:
+    """Invert `stack_trees`: split the leading axis into a list of pytrees."""
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return []
+    n = leaves[0].shape[0]
+    return [jax.tree.unflatten(treedef, [leaf[i] for leaf in leaves]) for i in range(n)]
+
+
+def tree_index(tree: Pytree, i: int) -> Pytree:
+    """Select member `i` of a stacked pytree."""
+    return jax.tree.map(lambda leaf: leaf[i], tree)
+
+
+def tree_len(tree: Pytree) -> int:
+    """Ensemble size of a stacked pytree (leading-axis length of the first leaf)."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return 0
+    return int(leaves[0].shape[0])
+
+
+def tree_bytes(tree: Pytree) -> int:
+    """Total bytes across all leaves."""
+    return sum(leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(tree))
+
+
+def tree_cast(tree: Pytree, dtype) -> Pytree:
+    """Cast all floating-point leaves to `dtype`."""
+    def cast(leaf):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.astype(dtype)
+        return leaf
+    return jax.tree.map(cast, tree)

@@ -1,0 +1,123 @@
+"""Ensemble engine tests.
+
+Gates from SURVEY.md §7 stage 2: an N-member vmapped sweep must match N
+independent single runs, and training must actually recover structure.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.ensemble import Ensemble, EnsembleGroup
+from sparse_coding_tpu.models.sae import FunctionalSAE, FunctionalTiedSAE
+from sparse_coding_tpu.models.topk import TopKEncoder
+
+D, N_DICT, BATCH = 16, 32, 64
+
+
+def _members(key, sig, n, **kwargs):
+    keys = jax.random.split(key, n)
+    return [sig.init(k, D, N_DICT, **kwargs) for k in keys]
+
+
+def test_losses_decrease(rng):
+    k_init, k_data = jax.random.split(rng)
+    members = _members(k_init, FunctionalTiedSAE, 4, l1_alpha=1e-4)
+    ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3)
+    batch = jax.random.normal(k_data, (BATCH, D))
+    first = ens.step_batch(batch).losses["loss"]
+    for _ in range(50):
+        last = ens.step_batch(batch).losses["loss"]
+    assert last.shape == (4,)
+    assert jnp.all(last < first)
+
+
+def test_ensemble_matches_single_runs(rng):
+    """N-member vmapped training ≡ N independent 1-member runs."""
+    k_init, k_data = jax.random.split(rng)
+    members = _members(k_init, FunctionalSAE, 3, l1_alpha=1e-3)
+    batch = jax.random.normal(k_data, (BATCH, D))
+
+    ens = Ensemble(members, FunctionalSAE, lr=1e-3)
+    for _ in range(10):
+        ens.step_batch(batch)
+    stacked_params = [p for p, _ in ens.unstack()]
+
+    for i, member in enumerate(members):
+        solo = Ensemble([member], FunctionalSAE, lr=1e-3)
+        for _ in range(10):
+            solo.step_batch(batch)
+        solo_params = solo.unstack()[0][0]
+        for name in solo_params:
+            np.testing.assert_allclose(
+                np.asarray(solo_params[name]),
+                np.asarray(stacked_params[i][name]),
+                rtol=2e-4, atol=2e-5,
+                err_msg=f"member {i} param {name} diverged from solo run")
+
+
+def test_per_member_l1_affects_sparsity(rng):
+    """Members with higher l1_alpha end up sparser — the vmapped hyperparam
+    axis actually does something."""
+    k_init, k_data = jax.random.split(rng)
+    l1s = [1e-5, 3e-1]
+    keys = jax.random.split(k_init, 2)
+    members = [FunctionalTiedSAE.init(k, D, N_DICT, l1_alpha=l1)
+               for k, l1 in zip(keys, l1s)]
+    ens = Ensemble(members, FunctionalTiedSAE, lr=1e-2)
+    data_key = k_data
+    for _ in range(200):
+        data_key, sub = jax.random.split(data_key)
+        batch = jax.random.normal(sub, (BATCH, D))
+        aux = ens.step_batch(batch)
+    l0 = np.asarray(aux.l0)
+    assert l0[1] < l0[0]
+
+
+def test_feat_activity_shape(rng):
+    k_init, k_data = jax.random.split(rng)
+    members = _members(k_init, FunctionalTiedSAE, 2, l1_alpha=1e-4)
+    ens = Ensemble(members, FunctionalTiedSAE)
+    aux = ens.step_batch(jax.random.normal(k_data, (BATCH, D)))
+    assert aux.feat_activity.shape == (2, N_DICT)
+    assert aux.l0.shape == (2,)
+
+
+def test_static_buffer_mismatch_raises(rng):
+    keys = jax.random.split(rng, 2)
+    members = [TopKEncoder.init(keys[0], D, N_DICT, k=4),
+               TopKEncoder.init(keys[1], D, N_DICT, k=8)]
+    with pytest.raises(ValueError, match="static"):
+        Ensemble(members, TopKEncoder)
+
+
+def test_ensemble_group_buckets_topk(rng):
+    """Mixed-k TopK members bucket into per-k sub-ensembles
+    (the reference's no_stacking analogue)."""
+    keys = jax.random.split(rng, 4)
+    members = [TopKEncoder.init(keys[0], D, N_DICT, k=4),
+               TopKEncoder.init(keys[1], D, N_DICT, k=4),
+               TopKEncoder.init(keys[2], D, N_DICT, k=8),
+               TopKEncoder.init(keys[3], D, N_DICT, k=8)]
+    group = EnsembleGroup.build(TopKEncoder, members, lr=1e-3)
+    assert len(group.ensembles) == 2
+    batch = jax.random.normal(jax.random.PRNGKey(9), (BATCH, D))
+    aux = group.step_batch(batch)
+    for name, a in aux.items():
+        assert a.losses["loss"].shape == (2,)
+    dicts = group.to_learned_dicts()
+    ks = sorted(d.k for ds in dicts.values() for d in ds)
+    assert ks == [4, 4, 8, 8]
+
+
+def test_to_learned_dicts_roundtrip(rng):
+    k_init, k_data = jax.random.split(rng)
+    members = _members(k_init, FunctionalTiedSAE, 3, l1_alpha=1e-4)
+    ens = Ensemble(members, FunctionalTiedSAE)
+    batch = jax.random.normal(k_data, (BATCH, D))
+    ens.step_batch(batch)
+    dicts = ens.to_learned_dicts()
+    assert len(dicts) == 3
+    for d in dicts:
+        assert d.encode(batch).shape == (BATCH, N_DICT)

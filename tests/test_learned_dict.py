@@ -1,0 +1,125 @@
+"""Unit tests for the inference-side dictionary zoo."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.models import (
+    Identity,
+    IdentityPositive,
+    IdentityReLU,
+    RandomDict,
+    ReverseSAE,
+    Rotation,
+    TiedSAE,
+    TopKLearnedDict,
+    UntiedSAE,
+)
+from sparse_coding_tpu.models.learned_dict import normalize_rows
+
+
+def test_identity_roundtrip(rng):
+    d = Identity.create(16)
+    x = jax.random.normal(rng, (8, 16))
+    np.testing.assert_allclose(d.predict(x), x, atol=1e-6)
+    assert d.n_feats == 16
+
+
+def test_identity_relu_nonneg_codes(rng):
+    d = IdentityReLU.create(16)
+    x = jax.random.normal(rng, (8, 16))
+    assert jnp.all(d.encode(x) >= 0)
+
+
+def test_identity_positive_reconstructs(rng):
+    d = IdentityPositive.create(16)
+    x = jax.random.normal(rng, (8, 16))
+    assert d.n_feats == 32
+    assert jnp.all(d.encode(x) >= 0)
+    np.testing.assert_allclose(d.predict(x), x, atol=1e-5)
+
+
+def test_rotation_is_orthonormal(rng):
+    d = Rotation.create(rng, 16)
+    eye = d.rotation @ d.rotation.T
+    np.testing.assert_allclose(eye, jnp.eye(16), atol=1e-5)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+    np.testing.assert_allclose(d.predict(x), x, atol=1e-5)
+
+
+def test_random_dict_unit_rows(rng):
+    d = RandomDict.create(rng, 16, n_feats=64)
+    norms = jnp.linalg.norm(d.get_learned_dict(), axis=-1)
+    np.testing.assert_allclose(norms, jnp.ones(64), atol=1e-5)
+
+
+def test_untied_sae_shapes(rng):
+    k1, k2, kx = jax.random.split(rng, 3)
+    sae = UntiedSAE(
+        encoder=jax.random.normal(k1, (32, 16)),
+        encoder_bias=jnp.zeros(32),
+        dictionary=jax.random.normal(k2, (32, 16)),
+    )
+    x = jax.random.normal(kx, (8, 16))
+    c = sae.encode(x)
+    assert c.shape == (8, 32)
+    assert jnp.all(c >= 0)
+    assert sae.predict(x).shape == (8, 16)
+    norms = jnp.linalg.norm(sae.get_learned_dict(), axis=-1)
+    np.testing.assert_allclose(norms, jnp.ones(32), atol=1e-4)
+
+
+def test_tied_sae_centering_roundtrip(rng):
+    k1, kx, kr = jax.random.split(rng, 3)
+    rot = Rotation.create(kr, 16).rotation
+    sae = TiedSAE(
+        dictionary=jax.random.normal(k1, (32, 16)),
+        encoder_bias=jnp.zeros(32),
+        centering_rot=rot,
+        centering_trans=jnp.full((16,), 0.5),
+        centering_scale=jnp.full((16,), 2.0),
+    )
+    x = jax.random.normal(kx, (8, 16))
+    np.testing.assert_allclose(sae.uncenter(sae.center(x)), x, atol=1e-5)
+
+
+def test_reverse_sae_decode_is_pure(rng):
+    k1, kx = jax.random.split(rng)
+    sae = ReverseSAE(dictionary=jax.random.normal(k1, (32, 16)),
+                     encoder_bias=jnp.full((32,), 0.1))
+    x = jax.random.normal(kx, (8, 16))
+    c = sae.encode(x)
+    c_before = np.asarray(c).copy()
+    sae.decode(c)
+    # the reference's torch ReverseSAE.decode mutates its input
+    # (learned_dict.py:253-255) — ours must not
+    np.testing.assert_array_equal(np.asarray(c), c_before)
+
+
+def test_topk_dict_exact_sparsity(rng):
+    k1, kx = jax.random.split(rng)
+    d = TopKLearnedDict(dictionary=jax.random.normal(k1, (64, 16)), k=5)
+    x = jax.random.normal(kx, (8, 16))
+    c = d.encode(x)
+    assert c.shape == (8, 64)
+    assert jnp.all(jnp.sum(c != 0, axis=-1) <= 5)
+
+
+def test_dicts_are_jittable_pytrees(rng):
+    k1, kx = jax.random.split(rng)
+    sae = TiedSAE(dictionary=jax.random.normal(k1, (32, 16)),
+                  encoder_bias=jnp.zeros(32))
+    x = jax.random.normal(kx, (8, 16))
+
+    @jax.jit
+    def f(d, x):
+        return d.predict(x)
+
+    np.testing.assert_allclose(f(sae, x), sae.predict(x), atol=1e-6)
+
+
+def test_normalize_rows_handles_zero():
+    d = jnp.zeros((4, 8))
+    out = normalize_rows(d)
+    assert jnp.all(jnp.isfinite(out))
