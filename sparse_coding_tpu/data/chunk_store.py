@@ -60,11 +60,18 @@ class ChunkWriter:
         while self._buffered_rows >= self.rows_per_chunk:
             self._flush_chunk()
 
+    def _write(self, arr: np.ndarray) -> None:
+        # np.save can't round-trip ml_dtypes bfloat16 — store the raw bit
+        # pattern as uint16; ChunkStore views it back via meta["dtype"]
+        if self.dtype == jnp.bfloat16:
+            arr = arr.view(np.uint16)
+        np.save(self.folder / f"{self.chunk_index}.npy", arr)
+        self.chunk_index += 1
+
     def _flush_chunk(self) -> None:
         flat = np.concatenate(self._buffer, axis=0)
         chunk, rest = flat[:self.rows_per_chunk], flat[self.rows_per_chunk:]
-        np.save(self.folder / f"{self.chunk_index}.npy", chunk)
-        self.chunk_index += 1
+        self._write(chunk)
         self._buffer = [rest] if rest.size else []
         self._buffered_rows = rest.shape[0] if rest.size else 0
 
@@ -74,8 +81,7 @@ class ChunkWriter:
         Returns the number of chunks written."""
         if self._buffered_rows:
             flat = np.concatenate(self._buffer, axis=0)
-            np.save(self.folder / f"{self.chunk_index}.npy", flat)
-            self.chunk_index += 1
+            self._write(flat)
             self._buffer, self._buffered_rows = [], 0
         meta = {"activation_dim": self.activation_dim,
                 "dtype": str(np.dtype(self.dtype)),
@@ -91,8 +97,9 @@ class ChunkStore:
 
     def __init__(self, folder: str | Path):
         self.folder = Path(folder)
-        self.chunk_paths = sorted(self.folder.glob("*.npy"),
-                                  key=lambda p: int(p.stem))
+        self.chunk_paths = sorted(
+            (p for p in self.folder.glob("*.npy") if p.stem.isdigit()),
+            key=lambda p: int(p.stem))
         if not self.chunk_paths:
             raise FileNotFoundError(f"no .npy chunks in {self.folder}")
         meta_path = self.folder / "meta.json"
@@ -105,7 +112,19 @@ class ChunkStore:
         return len(self.chunk_paths)
 
     def load_chunk(self, i: int, dtype=np.float32) -> np.ndarray:
-        return np.load(self.chunk_paths[i]).astype(dtype)
+        raw = np.load(self.chunk_paths[i])
+        if raw.dtype == np.uint16:
+            # bfloat16 chunks are stored as uint16 bit patterns; without the
+            # meta.json dtype tag (e.g. a crash before finalize()) the values
+            # would be silently garbage — fail loudly instead
+            if self.meta.get("dtype") != "bfloat16":
+                raise ValueError(
+                    f"{self.chunk_paths[i]} holds uint16 (bfloat16 bit "
+                    "patterns) but meta.json is missing or lacks "
+                    "dtype=bfloat16 — likely an interrupted harvest; re-run "
+                    "it or write meta.json by hand")
+            raw = raw.view(jnp.bfloat16)
+        return raw.astype(dtype)
 
     def chunk_mean(self, i: int = 0) -> np.ndarray:
         """Mean of one chunk — the reference's first-chunk centering

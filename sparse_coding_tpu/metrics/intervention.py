@@ -1,0 +1,270 @@
+"""Model-intervention metrics: perplexity under reconstruction, feature
+ablation graphs, activation caching.
+
+TPU-native re-design of the reference's hook-based evals
+(reference: standard_metrics.py:36-53,69-222,224-252,621-709): instead of
+transformer_lens `run_with_hooks` mutating tensors in Python callbacks, every
+intervention is a pure `edit=(tap, fn)` passed to the jitted LM forward
+(lm/gptneox.py / lm/gpt2.py) — the whole intervened forward is one compiled
+program, and dictionaries vmap across eval batches.
+"""
+
+from __future__ import annotations
+
+from itertools import product
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.lm.hooks import tap_name
+from sparse_coding_tpu.lm.model_config import LMConfig
+from sparse_coding_tpu.models.learned_dict import LearnedDict
+
+Array = jax.Array
+Location = Tuple[int, str]  # (layer, layer_loc) — reference's Location type
+
+
+def _loc_tap(location: Location) -> str:
+    layer, loc = location
+    return tap_name(layer, loc)
+
+
+def lm_loss(logits: Array, tokens: Array) -> Array:
+    """Mean next-token cross-entropy in nats (transformer_lens
+    return_type="loss" semantics)."""
+    logprobs = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    targets = tokens[:, 1:]
+    ll = jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def reconstruction_edit(model: LearnedDict) -> Callable[[Array], Array]:
+    """Replace a tapped [b, s, d] activation with the dict's reconstruction
+    (reference: replace_with_reconstruction, standard_metrics.py:641-648)."""
+
+    def edit(tensor: Array) -> Array:
+        b, s, d = tensor.shape
+        flat = tensor.reshape(b * s, d)
+        return model.predict(flat).reshape(b, s, d)
+
+    return edit
+
+
+def ablate_feature_edit(model: LearnedDict, feature_idx: int,
+                        position: Optional[int] = None) -> Callable[[Array], Array]:
+    """Subtract one feature's contribution from the tapped activation, at one
+    position or everywhere (reference: ablate_feature_intervention,
+    standard_metrics.py:69-84 and :163-177)."""
+
+    def edit(tensor: Array) -> Array:
+        b, s, d = tensor.shape
+        flat = tensor.reshape(b * s, d)
+        codes = model.encode(flat)
+        # feature_idx/position may be traced (the jitted ablation-graph loops
+        # pass them as arguments to avoid per-feature recompiles)
+        code = jnp.take(codes, feature_idx, axis=1)[:, None]
+        atom = jnp.take(model.get_learned_dict(), feature_idx, axis=0)
+        contribution = (code * atom).reshape(b, s, d)
+        if position is None:
+            return tensor - contribution
+        mask = (jnp.arange(s) == position)[None, :, None]
+        return tensor - jnp.where(mask, contribution, 0.0)
+
+    return edit
+
+
+def run_with_model_intervention(params, lm_cfg: LMConfig, model: LearnedDict,
+                                location: Location, tokens: Array,
+                                forward=None) -> Array:
+    """Forward pass with the tapped activation replaced by its reconstruction;
+    returns logits (reference: run_with_model_intervention,
+    standard_metrics.py:36-53)."""
+    if forward is None:
+        from sparse_coding_tpu.lm.convert import forward_fn
+        forward = forward_fn(lm_cfg)
+    logits, _ = forward(params, tokens, lm_cfg,
+                        edit=(_loc_tap(location), reconstruction_edit(model)))
+    return logits
+
+
+def perplexity_under_reconstruction(params, lm_cfg: LMConfig,
+                                    model: LearnedDict, location: Location,
+                                    tokens: Array, forward=None) -> Array:
+    """Loss (nats) with the tap replaced by the dict's reconstruction
+    (reference: standard_metrics.py:224-252)."""
+    logits = run_with_model_intervention(params, lm_cfg, model, location,
+                                         tokens, forward=forward)
+    return lm_loss(logits, tokens)
+
+
+def calculate_perplexity(params, lm_cfg: LMConfig,
+                         autoencoders: Sequence[tuple[LearnedDict, dict]],
+                         layer: int, setting: str, token_rows: np.ndarray,
+                         model_batch_size: int = 32,
+                         forward=None) -> tuple[float, list[float]]:
+    """Original perplexity + per-dict perplexity under reconstruction
+    (reference: calculate_perplexity, standard_metrics.py:621-709). The
+    per-dict intervened forwards are jitted once and reused across batches."""
+    if forward is None:
+        from sparse_coding_tpu.lm.convert import forward_fn
+        forward = forward_fn(lm_cfg)
+    location = (layer, setting)
+    tap = _loc_tap(location)
+
+    base_fn = jax.jit(lambda toks: lm_loss(forward(params, toks, lm_cfg)[0], toks))
+
+    def intervened_fn(model: LearnedDict):
+        def fn(toks):
+            logits, _ = forward(params, toks, lm_cfg,
+                                edit=(tap, reconstruction_edit(model)))
+            return lm_loss(logits, toks)
+        return jax.jit(fn)
+
+    # include the partial final batch, as the reference's DataLoader does
+    # (drop_last=False); it costs one extra jit specialization
+    batches = [jnp.asarray(token_rows[i:i + model_batch_size])
+               for i in range(0, token_rows.shape[0], model_batch_size)]
+    if not batches:
+        raise ValueError("token_rows is empty")
+
+    base = float(np.mean([float(base_fn(b)) for b in batches]))
+    original_perplexity = float(np.exp(base))
+
+    per_dict = []
+    for model, _hyper in autoencoders:
+        fn = intervened_fn(model)
+        loss = float(np.mean([float(fn(b)) for b in batches]))
+        per_dict.append(float(np.exp(loss)))
+    return original_perplexity, per_dict
+
+
+def cache_all_activations(params, lm_cfg: LMConfig,
+                          models: Dict[Location, LearnedDict], tokens: Array,
+                          edit=None, forward=None) -> Dict[Location, Array]:
+    """Encode every location's tapped activations with its dictionary in one
+    forward (reference: cache_all_activations, standard_metrics.py:86-110)."""
+    if forward is None:
+        from sparse_coding_tpu.lm.convert import forward_fn
+        forward = forward_fn(lm_cfg)
+    taps = tuple(_loc_tap(loc) for loc in models)
+    _, tapped = forward(params, tokens, lm_cfg, taps=taps, edit=edit)
+    out = {}
+    for loc, model in models.items():
+        t = tapped[_loc_tap(loc)]
+        b, s, d = t.shape
+        out[loc] = model.encode(t.reshape(b * s, d)).reshape(b, s, -1)
+    return out
+
+
+def _make_ablated_cache_fn(params, lm_cfg: LMConfig,
+                           models: Dict[Location, LearnedDict],
+                           location: Location, forward,
+                           positional: bool):
+    """One jitted (tokens, feat_idx[, pos]) -> encoded-activations function per
+    ablated location. feat_idx/pos are traced arguments, so the O(features)
+    graph loops reuse a single compiled program instead of retracing the LM
+    per feature."""
+    model = models[location]
+    tap = _loc_tap(location)
+    taps = tuple(_loc_tap(loc) for loc in models)
+
+    def fn(tokens, feat_idx, pos=None):
+        edit = (tap, ablate_feature_edit(model, feat_idx,
+                                         position=pos if positional else None))
+        _, tapped = forward(params, tokens, lm_cfg, taps=taps, edit=edit)
+        out = {}
+        for loc, m in models.items():
+            t = tapped[_loc_tap(loc)]
+            b, s, d = t.shape
+            out[loc] = m.encode(t.reshape(b * s, d)).reshape(b, s, -1)
+        return out
+
+    return jax.jit(fn)
+
+
+def build_ablation_graph(params, lm_cfg: LMConfig,
+                         models: Dict[Location, LearnedDict], tokens: Array,
+                         features_to_ablate: Optional[Dict[Location, List[Tuple[int, int]]]] = None,
+                         target_features: Optional[Dict[Location, List[Tuple[int, int]]]] = None,
+                         forward=None) -> Dict[tuple, float]:
+    """Positional ablation-impact graph: for each (location, (pos, feat)),
+    ablate it and measure every other feature's activation shift — edge
+    weight ‖u − a‖₂ over the batch, matching the reference
+    (build_ablation_graph, standard_metrics.py:117-161). O(features ×
+    forwards), but each location's intervened forward is compiled once with
+    (pos, feat) as traced arguments."""
+    if forward is None:
+        from sparse_coding_tpu.lm.convert import forward_fn
+        forward = forward_fn(lm_cfg)
+    B, L = tokens.shape
+    if not features_to_ablate:  # None or {} → all, the reference's sentinel
+        features_to_ablate = {
+            loc: list(product(range(L), range(int(m.n_feats))))
+            for loc, m in models.items()}
+    target_features = target_features or {}
+    all_features = [(loc, f) for loc, feats in
+                    {**features_to_ablate, **target_features}.items()
+                    for f in feats]
+
+    base = cache_all_activations(params, lm_cfg, models, tokens, forward=forward)
+
+    graph: Dict[tuple, float] = {}
+    for location in models:
+        feats = features_to_ablate.get(location, ())
+        if not feats:
+            continue
+        ablate_fn = _make_ablated_cache_fn(params, lm_cfg, models, location,
+                                           forward, positional=True)
+        for feature in feats:
+            pos, feat_idx = feature
+            ablated = ablate_fn(tokens, feat_idx, pos)
+            for loc_, feature_ in all_features:
+                if loc_ == location and feature_ == feature:
+                    continue
+                u = base[loc_][:, feature_[0], feature_[1]]
+                a = ablated[loc_][:, feature_[0], feature_[1]]
+                graph[((location, feature), (loc_, feature_))] = float(
+                    jnp.linalg.norm(u - a))
+    return graph
+
+
+def build_ablation_graph_non_positional(
+        params, lm_cfg: LMConfig, models: Dict[Location, LearnedDict],
+        tokens: Array,
+        features_to_ablate: Optional[Dict[Location, List[int]]] = None,
+        target_features: Optional[Dict[Location, List[int]]] = None,
+        forward=None) -> Dict[tuple, float]:
+    """Ablate a feature at every position (reference:
+    build_ablation_graph_non_positional, standard_metrics.py:179-222)."""
+    if forward is None:
+        from sparse_coding_tpu.lm.convert import forward_fn
+        forward = forward_fn(lm_cfg)
+    if not features_to_ablate:  # None or {} → all, the reference's sentinel
+        features_to_ablate = {loc: list(range(int(m.n_feats)))
+                              for loc, m in models.items()}
+    target_features = target_features or {}
+    all_features = [(loc, f) for loc, feats in
+                    {**features_to_ablate, **target_features}.items()
+                    for f in feats]
+
+    base = cache_all_activations(params, lm_cfg, models, tokens, forward=forward)
+
+    graph: Dict[tuple, float] = {}
+    for location in models:
+        feats = features_to_ablate.get(location, ())
+        if not feats:
+            continue
+        ablate_fn = _make_ablated_cache_fn(params, lm_cfg, models, location,
+                                           forward, positional=False)
+        for feat_idx in feats:
+            ablated = ablate_fn(tokens, feat_idx)
+            for loc_, feature_ in all_features:
+                if loc_ == location and feature_ == feat_idx:
+                    continue
+                u = base[loc_][:, :, feature_]
+                a = ablated[loc_][:, :, feature_]
+                graph[((location, feat_idx), (loc_, feature_))] = float(
+                    jnp.mean(jnp.linalg.norm(u - a, axis=-1)))
+    return graph

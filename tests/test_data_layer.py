@@ -1,0 +1,141 @@
+"""Chunk store, tokenizer, and harvesting tests (SURVEY.md §4: tiny
+random-weight model replaces the reference's network-bound harvesting test)."""
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.data.chunk_store import ChunkStore, ChunkWriter, device_prefetch
+from sparse_coding_tpu.data.tokenize import chunk_and_tokenize, pack_tokens
+from sparse_coding_tpu.data.harvest import harvest_activations
+from sparse_coding_tpu.lm import gptneox
+from sparse_coding_tpu.lm.model_config import tiny_test_config
+
+
+def test_chunk_writer_roundtrip(tmp_path):
+    w = ChunkWriter(tmp_path, 16, chunk_size_gb=16 * 100 * 2 / 2**30,
+                    dtype="float16")
+    data = np.random.default_rng(0).normal(size=(250, 16)).astype(np.float32)
+    w.add(data)
+    n = w.finalize({"tag": "test"})
+    assert n == 3  # 100 + 100 + 50 tail (the reference's HF path drops tails)
+    store = ChunkStore(tmp_path)
+    assert store.n_chunks == 3
+    assert store.activation_dim == 16
+    assert store.meta["tag"] == "test"
+    got = np.concatenate([store.load_chunk(i) for i in range(3)])
+    np.testing.assert_allclose(got, data, atol=2e-3)  # fp16 roundtrip
+
+
+def test_chunk_writer_bfloat16(tmp_path):
+    w = ChunkWriter(tmp_path, 8, chunk_size_gb=1.0, dtype="bfloat16")
+    w.add(np.ones((10, 8), np.float32) * 1.5)
+    w.finalize()
+    store = ChunkStore(tmp_path)
+    chunk = store.load_chunk(0)
+    assert chunk.dtype == np.float32
+    np.testing.assert_array_equal(chunk, 1.5)
+
+
+def test_chunk_writer_row_alignment(tmp_path):
+    w = ChunkWriter(tmp_path, 8, chunk_size_gb=8 * 100 * 2 / 2**30,
+                    dtype="float16", round_rows_to=64)
+    assert w.rows_per_chunk == 64  # 100 rounded down to batch multiple
+
+
+def test_store_epoch_batches(tmp_path):
+    w = ChunkWriter(tmp_path, 8, chunk_size_gb=8 * 128 * 2 / 2**30, dtype="float16")
+    w.add(np.arange(256 * 8, dtype=np.float32).reshape(256, 8))
+    w.finalize()
+    store = ChunkStore(tmp_path)
+    rng = np.random.default_rng(0)
+    batches = list(store.epoch(32, rng, n_repetitions=2))
+    assert len(batches) == 16  # 256 rows x2 reps / 32
+    assert all(b.shape == (32, 8) for b in batches)
+
+
+def test_device_prefetch_order(tmp_path):
+    batches = [np.full((4, 2), i, np.float32) for i in range(5)]
+    out = list(device_prefetch(batches))
+    assert len(out) == 5
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(b), batches[i])
+
+
+def test_pack_tokens_eos_joined():
+    rows = pack_tokens([[1, 2, 3], [4, 5], [6, 7, 8, 9]], max_length=4,
+                       eos_token_id=0)
+    # stream: 1 2 3 0 4 5 0 6 7 8 9 0 → rows [1230][4506][7890]
+    np.testing.assert_array_equal(
+        rows, [[1, 2, 3, 0], [4, 5, 0, 6], [7, 8, 9, 0]])
+
+
+class _FakeTokenizer:
+    eos_token_id = 0
+
+    def encode(self, text):
+        return [ord(c) % 100 + 1 for c in text]
+
+
+def test_chunk_and_tokenize_ratio():
+    texts = ["hello world", "foo bar baz"]
+    rows, ratio = chunk_and_tokenize(texts, _FakeTokenizer(), max_length=8)
+    total_tokens = sum(len(t) for t in texts)
+    total_bytes = sum(len(t.encode()) for t in texts)
+    assert math.isclose(ratio, total_tokens / total_bytes / math.log(2))
+    assert rows.shape[1] == 8
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = tiny_test_config("gptneox")
+    params = gptneox.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def test_harvest_activations(tmp_path, tiny_lm):
+    params, cfg = tiny_lm
+    rng = np.random.default_rng(0)
+    token_rows = rng.integers(0, cfg.vocab_size, size=(24, 16))
+    out = harvest_activations(
+        params, cfg, token_rows, layers=[0, 1], layer_loc="residual",
+        output_folder=tmp_path, model_batch_size=4,
+        chunk_size_gb=32 * 128 * 2 / 2**30, dtype="float16",
+        forward=gptneox.forward)
+    assert set(out) == {"residual.0", "residual.1"}
+    store = ChunkStore(tmp_path / "residual.1")
+    total = sum(store.load_chunk(i).shape[0] for i in range(store.n_chunks))
+    assert total == 24 * 16  # every (row, pos) activation saved
+    assert store.activation_dim == cfg.d_model
+
+    # chunk contents equal a direct forward's tap (fp16 tolerance)
+    _, tapped = gptneox.forward(params, jnp.asarray(token_rows[:4]), cfg,
+                                taps=("residual.1",))
+    direct = np.asarray(tapped["residual.1"]).reshape(-1, cfg.d_model)
+    stored = store.load_chunk(0)[:direct.shape[0]]
+    np.testing.assert_allclose(stored, direct, atol=2e-2, rtol=2e-2)
+
+
+def test_harvest_mlp_width(tmp_path, tiny_lm):
+    params, cfg = tiny_lm
+    token_rows = np.random.default_rng(1).integers(0, cfg.vocab_size, size=(8, 16))
+    harvest_activations(params, cfg, token_rows, layers=[1], layer_loc="mlp",
+                        output_folder=tmp_path, model_batch_size=4,
+                        dtype="float16", forward=gptneox.forward)
+    store = ChunkStore(tmp_path / "mlp.1")
+    assert store.activation_dim == cfg.d_mlp
+
+
+def test_harvest_centering_metadata(tmp_path, tiny_lm):
+    params, cfg = tiny_lm
+    token_rows = np.random.default_rng(2).integers(0, cfg.vocab_size, size=(8, 16))
+    harvest_activations(params, cfg, token_rows, layers=[0], layer_loc="residual",
+                        output_folder=tmp_path, model_batch_size=4, center=True,
+                        dtype="float16", forward=gptneox.forward)
+    center = np.load(tmp_path / "residual.0" / "center.npy")
+    store = ChunkStore(tmp_path / "residual.0")
+    np.testing.assert_allclose(center, store.chunk_mean(0), rtol=1e-5)

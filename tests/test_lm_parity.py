@@ -1,0 +1,153 @@
+"""LM forward parity vs HuggingFace torch implementations on random weights
+(SURVEY.md §4/§7: verify activation equivalence against reference hooks
+without network access — transformers builds models from config offline)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.lm import gpt2 as jgpt2
+from sparse_coding_tpu.lm import gptneox as jneox
+from sparse_coding_tpu.lm.convert import convert_gpt2_state_dict, convert_gptneox_state_dict
+from sparse_coding_tpu.lm.model_config import tiny_test_config
+
+TOL = dict(rtol=2e-4, atol=2e-4)
+
+
+@pytest.fixture(scope="module")
+def neox_pair():
+    torch = pytest.importorskip("torch")
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    cfg = tiny_test_config("gptneox")
+    hf_cfg = GPTNeoXConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        num_hidden_layers=cfg.n_layers, num_attention_heads=cfg.n_heads,
+        intermediate_size=cfg.d_mlp, max_position_embeddings=cfg.max_seq_len,
+        rotary_pct=cfg.rotary_pct, use_parallel_residual=True,
+        hidden_act="gelu", layer_norm_eps=cfg.layernorm_eps,
+        attention_dropout=0.0, hidden_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    hf_model = GPTNeoXForCausalLM(hf_cfg).eval()
+    params = convert_gptneox_state_dict(hf_model.state_dict(), cfg)
+    return hf_model, params, cfg
+
+
+@pytest.fixture(scope="module")
+def gpt2_pair():
+    torch = pytest.importorskip("torch")
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    cfg = tiny_test_config("gpt2")
+    hf_cfg = GPT2Config(
+        vocab_size=cfg.vocab_size, n_embd=cfg.d_model, n_layer=cfg.n_layers,
+        n_head=cfg.n_heads, n_inner=cfg.d_mlp, n_positions=cfg.max_seq_len,
+        layer_norm_epsilon=cfg.layernorm_eps,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    hf_model = GPT2LMHeadModel(hf_cfg).eval()
+    params = convert_gpt2_state_dict(hf_model.state_dict(), cfg)
+    return hf_model, params, cfg
+
+
+def _tokens(cfg, batch=2, seq=16):
+    rng = np.random.default_rng(0)
+    return rng.integers(0, cfg.vocab_size, size=(batch, seq))
+
+
+def test_gptneox_logits_match(neox_pair):
+    import torch
+
+    hf_model, params, cfg = neox_pair
+    toks = _tokens(cfg)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(toks)).logits.numpy()
+    logits, _ = jneox.forward(params, jnp.asarray(toks), cfg)
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, **TOL)
+
+
+def test_gptneox_hidden_states_match(neox_pair):
+    """Our residual.{i} taps equal HF's per-layer hidden states."""
+    import torch
+
+    hf_model, params, cfg = neox_pair
+    toks = _tokens(cfg)
+    with torch.no_grad():
+        out = hf_model(torch.tensor(toks), output_hidden_states=True)
+    taps = tuple(f"residual.{i}" for i in range(cfg.n_layers))
+    _, tapped = jneox.forward(params, jnp.asarray(toks), cfg, taps=taps)
+    # HF hidden_states[i+1] is the post-block residual of layer i, EXCEPT the
+    # last entry which HF returns post-final-LN; logits cover the last layer
+    for i in range(cfg.n_layers - 1):
+        np.testing.assert_allclose(
+            np.asarray(tapped[f"residual.{i}"]),
+            out.hidden_states[i + 1].numpy(), **TOL,
+            err_msg=f"residual mismatch at layer {i}")
+
+
+def test_gptneox_tap_widths(neox_pair):
+    from sparse_coding_tpu.lm import hooks
+
+    _, params, cfg = neox_pair
+    toks = _tokens(cfg)
+    taps = ("residual.1", "mlp.1", "attn_concat.1", "mlpout.1", "attn.1")
+    _, tapped = jneox.forward(params, jnp.asarray(toks), cfg, taps=taps)
+    for t in taps:
+        loc, _ = hooks.parse_tap_name(t)
+        assert tapped[t].shape[-1] == hooks.get_activation_size(loc, cfg), t
+
+
+def test_gptneox_stop_at_layer(neox_pair):
+    _, params, cfg = neox_pair
+    toks = _tokens(cfg)
+    full_logits, full_taps = jneox.forward(params, jnp.asarray(toks), cfg,
+                                           taps=("residual.1",))
+    logits, tapped = jneox.forward(params, jnp.asarray(toks), cfg,
+                                   taps=("residual.1",), stop_at_layer=2)
+    assert logits is None
+    np.testing.assert_allclose(np.asarray(tapped["residual.1"]),
+                               np.asarray(full_taps["residual.1"]), rtol=1e-6, atol=1e-6)
+
+
+def test_gptneox_edit_applies(neox_pair):
+    """The edit hook replaces the tapped tensor in-flight — downstream logits
+    change (the run_with_hooks analogue for intervention evals)."""
+    _, params, cfg = neox_pair
+    toks = _tokens(cfg)
+    base_logits, _ = jneox.forward(params, jnp.asarray(toks), cfg)
+    edited_logits, tapped = jneox.forward(
+        params, jnp.asarray(toks), cfg, taps=("residual.1",),
+        edit=("residual.1", lambda x: jnp.zeros_like(x)))
+    assert not np.allclose(np.asarray(base_logits), np.asarray(edited_logits))
+    np.testing.assert_array_equal(np.asarray(tapped["residual.1"]), 0.0)
+
+
+def test_gpt2_logits_match(gpt2_pair):
+    import torch
+
+    hf_model, params, cfg = gpt2_pair
+    toks = _tokens(cfg)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(toks)).logits.numpy()
+    logits, _ = jgpt2.forward(params, jnp.asarray(toks), cfg)
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, **TOL)
+
+
+def test_gpt2_hidden_states_match(gpt2_pair):
+    import torch
+
+    hf_model, params, cfg = gpt2_pair
+    toks = _tokens(cfg)
+    with torch.no_grad():
+        out = hf_model(torch.tensor(toks), output_hidden_states=True)
+    taps = tuple(f"residual.{i}" for i in range(cfg.n_layers))
+    _, tapped = jgpt2.forward(params, jnp.asarray(toks), cfg, taps=taps)
+    # last entry is post-final-LN in HF; logits cover the last layer
+    for i in range(cfg.n_layers - 1):
+        np.testing.assert_allclose(
+            np.asarray(tapped[f"residual.{i}"]),
+            out.hidden_states[i + 1].numpy(), **TOL,
+            err_msg=f"residual mismatch at layer {i}")
