@@ -1,0 +1,34 @@
+"""Activation-dataset generation CLI.
+
+Re-design of the reference's `generate_test_data.py:30-67` (GenTestArgs
+driving setup_data/setup_data_new): load a preset model + text dataset,
+tokenize/pack, harvest all requested layers in one pass.
+
+    python -m sparse_coding_tpu.data.generate --model_name gpt2 \
+        --layers '[1,2]' --layer_loc residual --dataset_folder out/
+"""
+
+from __future__ import annotations
+
+from sparse_coding_tpu.config import DataArgs
+
+
+def main(argv=None) -> None:
+    cfg = DataArgs.from_cli(argv)
+
+    from transformers import AutoTokenizer
+
+    from sparse_coding_tpu.data.harvest import setup_data
+    from sparse_coding_tpu.data.tokenize import load_text_dataset
+    from sparse_coding_tpu.lm.convert import load_model
+
+    params, lm_cfg = load_model(cfg.model_name)
+    tokenizer = AutoTokenizer.from_pretrained(cfg.model_name)
+    texts = load_text_dataset(cfg.dataset_name, max_docs=cfg.max_docs)
+    written = setup_data(cfg, params, lm_cfg, texts, tokenizer)
+    for tap, n in written.items():
+        print(f"{tap}: {n} chunks -> {cfg.dataset_folder}/{tap}/")
+
+
+if __name__ == "__main__":
+    main()

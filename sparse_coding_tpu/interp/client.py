@@ -1,0 +1,135 @@
+"""Provider-neutral explainer/simulator clients.
+
+The reference hard-wires OpenAI's neuron-explainer (GPT-4 explainer +
+davinci simulator) and reads secrets.json AT IMPORT TIME
+(reference: interpret.py:30-57,334-358) — SURVEY.md §7 explicitly says not to
+replicate that. Here:
+
+- `Explainer` protocol: explain(records) -> str and
+  simulate(explanation, tokens) -> predicted activations;
+- `OfflineExplainer`: deterministic token-overlap heuristic, so the whole
+  interpretation pipeline (incl. scoring) runs and tests offline;
+- `OpenAIExplainer`: lazy, opt-in; credentials are read only when
+  constructed, never at import.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Protocol, Sequence
+
+import numpy as np
+
+
+@dataclass
+class ActivationRecord:
+    """One fragment shown to the explainer: decoded tokens + that feature's
+    per-token activations."""
+
+    tokens: list[str]
+    activations: list[float]
+
+
+class Explainer(Protocol):
+    def explain(self, records: Sequence[ActivationRecord]) -> str: ...
+
+    def simulate(self, explanation: str, tokens: Sequence[str]) -> list[float]: ...
+
+
+@dataclass
+class OfflineExplainer:
+    """Deterministic mock protocol: the 'explanation' is the set of tokens
+    that most activate the feature; simulation predicts activation
+    proportional to token membership. Good enough to exercise scoring
+    end-to-end and to regression-test the pipeline without any API."""
+
+    top_n_tokens: int = 5
+
+    _MARKER = "activates on tokens: "
+
+    def explain(self, records: Sequence[ActivationRecord]) -> str:
+        weights: dict[str, float] = {}
+        for rec in records:
+            for tok, act in zip(rec.tokens, rec.activations):
+                weights[tok] = weights.get(tok, 0.0) + float(act)
+        top = sorted(weights, key=weights.get, reverse=True)[:self.top_n_tokens]
+        # JSON-encoded token list: unambiguous even when tokens contain
+        # commas/quotes (a plain comma-join mis-parses "','" tokens)
+        return self._MARKER + json.dumps(top)
+
+    def simulate(self, explanation: str, tokens: Sequence[str]) -> list[float]:
+        listed = explanation.split(self._MARKER, 1)[-1]
+        try:
+            vocab = set(json.loads(listed))
+        except json.JSONDecodeError:
+            vocab = set()
+        return [1.0 if t in vocab else 0.0 for t in tokens]
+
+
+@dataclass
+class OpenAIExplainer:
+    """Thin client over the OpenAI API mirroring the reference's
+    TokenActivationPairExplainer + UncalibratedNeuronSimulator roles
+    (interpret.py:334-358). Lazy: importing this module never touches
+    credentials; construction requires them explicitly or via env."""
+
+    explainer_model: str = "gpt-4"
+    simulator_model: str = "gpt-3.5-turbo-instruct"
+    api_key: str | None = None
+    max_tokens: int = 256
+    _client: object = field(default=None, repr=False)
+
+    def __post_init__(self):
+        import os
+
+        key = self.api_key or os.environ.get("OPENAI_API_KEY")
+        if not key:
+            raise ValueError("OpenAIExplainer needs api_key or OPENAI_API_KEY")
+        try:
+            import openai
+
+            self._client = openai.OpenAI(api_key=key)
+        except ImportError as e:
+            raise ImportError("openai package not installed; use "
+                              "OfflineExplainer or install openai") from e
+
+    def explain(self, records: Sequence[ActivationRecord]) -> str:
+        lines = []
+        for rec in records:
+            pairs = [f"{t}\t{a:.2f}" for t, a in zip(rec.tokens, rec.activations)]
+            lines.append("\n".join(pairs))
+        prompt = ("We're studying a neuron in a language model. For each "
+                  "excerpt below, each line is a token and the neuron's "
+                  "activation on it. Summarize in one phrase what the neuron "
+                  "fires on.\n\n" + "\n---\n".join(lines) + "\n\nExplanation:")
+        resp = self._client.chat.completions.create(
+            model=self.explainer_model,
+            messages=[{"role": "user", "content": prompt}],
+            max_tokens=self.max_tokens)
+        return resp.choices[0].message.content.strip()
+
+    def simulate(self, explanation: str, tokens: Sequence[str]) -> list[float]:
+        prompt = (f"A neuron fires on: {explanation}\nFor each token below, "
+                  "output a number 0-10 for how strongly the neuron fires, "
+                  "one per line, nothing else.\n" + "\n".join(tokens))
+        resp = self._client.completions.create(
+            model=self.simulator_model, prompt=prompt,
+            max_tokens=4 * len(tokens), temperature=0.0)
+        vals = []
+        for line in resp.choices[0].text.strip().splitlines():
+            try:
+                vals.append(float(line.strip()))
+            except ValueError:
+                vals.append(0.0)
+        vals += [0.0] * (len(tokens) - len(vals))
+        return vals[:len(tokens)]
+
+
+def get_explainer(provider: str, **kwargs) -> Explainer:
+    if provider == "offline":
+        return OfflineExplainer()
+    if provider == "openai":
+        return OpenAIExplainer(**kwargs)
+    raise ValueError(f"unknown interpretation provider {provider!r}")

@@ -1,0 +1,118 @@
+"""Feature-activation fragment dataset.
+
+Re-design of the reference's `make_feature_activation_dataset`
+(reference: interpret.py:82-212): the reference streams openwebtext, takes one
+random 64-token fragment per document, runs the LM, encodes with the
+dictionary, and materializes a giant pandas DataFrame (cached as HDF,
+:215-262). Here only the per-fragment per-feature MAXES ([N, F]) stay
+resident — the top-k selection input — while per-token activations are
+recomputed lazily for just the fragments a feature's explanation actually
+reads (top-k + random ≈ 20 of N), so device memory never scales with
+n_fragments × fragment_len × n_feats.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.lm.hooks import tap_name
+from sparse_coding_tpu.lm.model_config import LMConfig
+from sparse_coding_tpu.models.learned_dict import LearnedDict
+
+Array = jax.Array
+
+
+def sample_fragments(token_rows: np.ndarray, fragment_len: int,
+                     n_fragments: int, seed: int = 0) -> np.ndarray:
+    """One random fragment per row (reference: interpret.py:141-150 takes a
+    random 64-token window per document)."""
+    if token_rows.shape[1] < fragment_len:
+        raise ValueError(
+            f"token rows have length {token_rows.shape[1]} < fragment_len "
+            f"{fragment_len}; harvest with a longer context or lower "
+            "cfg.fragment_len")
+    rng = np.random.default_rng(seed)
+    n = min(n_fragments, token_rows.shape[0])
+    rows = rng.permutation(token_rows.shape[0])[:n]
+    out = np.zeros((n, fragment_len), token_rows.dtype)
+    for i, r in enumerate(rows):
+        max_start = token_rows.shape[1] - fragment_len
+        s = rng.integers(0, max_start + 1) if max_start > 0 else 0
+        out[i] = token_rows[r, s:s + fragment_len]
+    return out
+
+
+class FragmentActivations(struct.PyTreeNode):
+    """Per-feature interpretation inputs: fragments + per-fragment maxes."""
+
+    fragments: Array  # [N, L] token ids
+    max_per_fragment: Array  # [N, F] max activation of each feature per fragment
+    n_feats: int = struct.field(pytree_node=False, default=0)
+
+    def top_fragments(self, feature: int, k: int) -> tuple[Array, Array]:
+        """(fragment indices, their max activations) for one feature."""
+        k = min(k, int(self.fragments.shape[0]))
+        vals, idx = jax.lax.top_k(self.max_per_fragment[:, feature], k)
+        return idx, vals
+
+    def random_fragments(self, k: int, seed: int = 0) -> Array:
+        rng = np.random.default_rng(seed)
+        return jnp.asarray(rng.permutation(self.fragments.shape[0])[:k])
+
+
+class TokenActivationLookup:
+    """Lazy per-token activations: recomputes codes for just the requested
+    fragments (a handful per feature) instead of holding [N, L, F] on device."""
+
+    def __init__(self, fragments: Array, encode_batch: Callable[[Array], Array]):
+        self._fragments = fragments
+        self._encode_batch = encode_batch
+        self._cache: dict[int, np.ndarray] = {}
+
+    def _codes_for(self, fragment_idx: int) -> np.ndarray:
+        if fragment_idx not in self._cache:
+            c = self._encode_batch(self._fragments[fragment_idx][None, :])
+            self._cache[fragment_idx] = np.asarray(jax.device_get(c[0]))
+        return self._cache[fragment_idx]
+
+    def tokens_activations(self, fragment_idx: int, feature: int) -> np.ndarray:
+        return self._codes_for(int(fragment_idx))[:, feature]
+
+
+def build_fragment_activations(
+    params, lm_cfg: LMConfig, model: LearnedDict, fragments: np.ndarray,
+    layer: int, layer_loc: str = "residual", batch_size: int = 64,
+    forward=None,
+) -> tuple[FragmentActivations, TokenActivationLookup]:
+    """Run the LM over ALL fragments (tail batch included), keeping only the
+    per-fragment maxes on device; returns the maxes plus a lazy lookup."""
+    if fragments.shape[0] == 0:
+        raise ValueError("no fragments to process")
+    if forward is None:
+        from sparse_coding_tpu.lm.convert import forward_fn
+        forward = forward_fn(lm_cfg)
+    tap = tap_name(layer, layer_loc)
+
+    @jax.jit
+    def encode_batch(toks):
+        _, tapped = forward(params, toks, lm_cfg, taps=(tap,),
+                            stop_at_layer=layer + 1)
+        acts = tapped[tap]
+        b, s, d = acts.shape
+        return model.encode(model.center(acts.reshape(b * s, d))).reshape(b, s, -1)
+
+    maxes = []
+    for lo in range(0, fragments.shape[0], batch_size):
+        c = encode_batch(jnp.asarray(fragments[lo:lo + batch_size]))
+        maxes.append(jnp.max(c, axis=1))
+    max_per_fragment = jnp.concatenate(maxes, axis=0)
+    fragments_dev = jnp.asarray(fragments)
+    fa = FragmentActivations(fragments=fragments_dev,
+                             max_per_fragment=max_per_fragment,
+                             n_feats=int(max_per_fragment.shape[-1]))
+    return fa, TokenActivationLookup(fragments_dev, encode_batch)

@@ -1,0 +1,160 @@
+"""Auto-interpretation drivers and score persistence.
+
+Re-design of the reference's `interpret()` loop and batch drivers
+(reference: interpret.py:265-386 per-feature explain→simulate→score;
+:414-688 folder/sweep/baseline/chunk drivers; :456-501 score readers).
+Artifact layout mirrors the reference: `{output}/feature_{i}/explanation.txt`
++ `scores.json`, with skip-if-exists idempotence (interpret.py:267-269).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from sparse_coding_tpu.config import InterpArgs
+from sparse_coding_tpu.interp.client import ActivationRecord, Explainer, get_explainer
+from sparse_coding_tpu.interp.fragments import (
+    FragmentActivations,
+    TokenActivationLookup,
+    build_fragment_activations,
+    sample_fragments,
+)
+
+
+def correlation_score(true: np.ndarray, predicted: np.ndarray) -> float:
+    """Pearson correlation between simulated and true activations — the
+    reference's correlation scoring (interpret.py:350-358)."""
+    t = np.asarray(true, np.float64).ravel()
+    p = np.asarray(predicted, np.float64).ravel()
+    if t.std() == 0 or p.std() == 0:
+        return 0.0
+    return float(np.corrcoef(t, p)[0, 1])
+
+
+def _records_for(fragment_idx, feature: int, fa: FragmentActivations,
+                 lookup: TokenActivationLookup, decode_token) -> list[ActivationRecord]:
+    records = []
+    for fi in np.asarray(fragment_idx):
+        toks = [decode_token(int(t)) for t in np.asarray(fa.fragments[fi])]
+        acts = [float(a) for a in lookup.tokens_activations(int(fi), feature)]
+        records.append(ActivationRecord(tokens=toks, activations=acts))
+    return records
+
+
+def interpret_feature(feature: int, fa: FragmentActivations,
+                      lookup: TokenActivationLookup, explainer: Explainer,
+                      decode_token, top_k: int = 10, n_random: int = 10,
+                      seed: int = 0) -> dict:
+    """Explain one feature from its top fragments; score the explanation on
+    top, random, and combined fragments (reference: interpret.py:265-386)."""
+    top_idx, top_vals = fa.top_fragments(feature, top_k)
+    rand_idx = fa.random_fragments(n_random, seed=seed + feature)
+
+    top_records = _records_for(top_idx, feature, fa, lookup, decode_token)
+    explanation = explainer.explain(top_records)
+
+    def score(idx):
+        true, pred = [], []
+        for rec in _records_for(idx, feature, fa, lookup, decode_token):
+            true.extend(rec.activations)
+            pred.extend(explainer.simulate(explanation, rec.tokens))
+        return correlation_score(np.asarray(true), np.asarray(pred))
+
+    return {
+        "feature": feature,
+        "explanation": explanation,
+        "top_score": score(top_idx),
+        "random_score": score(rand_idx),
+        "top_random_score": score(np.concatenate([np.asarray(top_idx),
+                                                  np.asarray(rand_idx)])),
+        "max_activation": float(top_vals[0]),
+    }
+
+
+def run(learned_dict, cfg: InterpArgs, params, lm_cfg, token_rows: np.ndarray,
+        decode_token, forward=None,
+        feature_indices: Optional[Sequence[int]] = None) -> list[dict]:
+    """Main driver (reference: run(), interpret.py:388-411): build the
+    fragment dataset once, interpret the requested features, persist
+    per-feature artifacts."""
+    out = Path(cfg.output_folder)
+    out.mkdir(parents=True, exist_ok=True)
+    explainer = get_explainer(cfg.provider,
+                              **({} if cfg.provider == "offline" else
+                                 {"explainer_model": cfg.explainer_model,
+                                  "simulator_model": cfg.simulator_model}))
+
+    fragments = sample_fragments(token_rows, cfg.fragment_len, cfg.n_fragments,
+                                 seed=cfg.seed)
+    fa, lookup = build_fragment_activations(
+        params, lm_cfg, learned_dict, fragments, cfg.layer, cfg.layer_loc,
+        batch_size=cfg.batch_size, forward=forward)
+
+    if feature_indices is None:
+        # features with the highest activation mass, as a sensible default
+        mass = np.asarray(jax.device_get(fa.max_per_fragment)).sum(axis=0)
+        feature_indices = list(np.argsort(-mass)[:cfg.n_feats_to_explain])
+
+    results = []
+    for feat in feature_indices:
+        feat_dir = out / f"feature_{feat}"
+        if (feat_dir / "scores.json").exists():  # idempotent re-runs
+            results.append(json.loads((feat_dir / "scores.json").read_text()))
+            continue
+        rec = interpret_feature(int(feat), fa, lookup, explainer, decode_token,
+                                top_k=cfg.top_k_fragments,
+                                n_random=cfg.n_random_fragments, seed=cfg.seed)
+        feat_dir.mkdir(parents=True, exist_ok=True)
+        (feat_dir / "explanation.txt").write_text(rec["explanation"])
+        (feat_dir / "scores.json").write_text(json.dumps(rec, indent=2))
+        results.append(rec)
+    (out / "summary.json").write_text(json.dumps(results, indent=2))
+    return results
+
+
+def run_folder(dict_paths: Sequence[str], cfg: InterpArgs, params, lm_cfg,
+               token_rows, decode_token, forward=None) -> dict[str, list]:
+    """Interpret every saved dict artifact in a folder
+    (reference: run_folder/run_from_grouped, interpret.py:414-455)."""
+    from sparse_coding_tpu.utils.artifacts import load_learned_dicts
+
+    all_results = {}
+    for path in dict_paths:
+        for i, (ld, hyper) in enumerate(load_learned_dicts(path)):
+            sub_cfg = cfg.replace(output_folder=str(
+                Path(cfg.output_folder) / f"{Path(path).stem}_{i}"))
+            all_results[f"{path}:{i}"] = run(ld, sub_cfg, params, lm_cfg,
+                                             token_rows, decode_token,
+                                             forward=forward)
+    return all_results
+
+
+def read_scores(output_folder: str | Path) -> dict[int, dict]:
+    """Parse per-feature artifacts back (reference: read_scores,
+    interpret.py:456-501)."""
+    out = {}
+    for feat_dir in sorted(Path(output_folder).glob("feature_*")):
+        scores_path = feat_dir / "scores.json"
+        if scores_path.exists():
+            rec = json.loads(scores_path.read_text())
+            out[int(rec["feature"])] = rec
+    return out
+
+
+def read_transform_scores(root: str | Path) -> dict[str, list[float]]:
+    """Collect top_random scores per transform directory for comparison plots
+    (reference: read_transform_scores, interpret.py:456-483)."""
+    results = {}
+    for transform_dir in sorted(Path(root).iterdir()):
+        if not transform_dir.is_dir():
+            continue
+        scores = [rec["top_random_score"]
+                  for rec in read_scores(transform_dir).values()]
+        if scores:
+            results[transform_dir.name] = scores
+    return results

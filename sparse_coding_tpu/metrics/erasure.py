@@ -1,0 +1,160 @@
+"""Concept-erasure evaluation harness.
+
+The reference *implies* this capability — `ErasureArgs` (config.py:71-79) and
+`plotting/erasure_plot.py` consume `erasure_scores_layer_*.pt` files holding
+(probe-ability vs edit-magnitude vs KL, incl. a LEACE baseline) — but the
+script computing them is missing from the repo (SURVEY.md §2.6). This module
+reconstructs it TPU-natively:
+
+- `feature_erasure_curve`: progressively ablate the dictionary features most
+  predictive of a binary concept (by point-biserial correlation), measuring
+  probe AUROC on the erased activations, mean edit magnitude, and the KL
+  divergence of the LM's next-token distribution under the edit.
+- `LeaceEraser`: the closed-form least-squares concept-erasure projection
+  (Belrose et al. 2023) as the linear baseline the reference's plots compare
+  against (erasure_plot.py:198-278).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.models.learned_dict import LearnedDict
+
+Array = jax.Array
+
+
+class LeaceEraser(struct.PyTreeNode):
+    """x ↦ x − P(x − μ) with P the LEACE oblique projection wiping the
+    class-mean direction in whitened space."""
+
+    proj: Array  # [d, d]
+    mean: Array  # [d]
+
+    @classmethod
+    def fit(cls, x: Array, labels: Array, eps: float = 1e-4) -> "LeaceEraser":
+        x = jnp.asarray(x, jnp.float32)
+        z = jnp.asarray(labels, jnp.float32)
+        z = z[:, None] if z.ndim == 1 else z
+        mu = jnp.mean(x, axis=0)
+        xc = x - mu
+        zc = z - jnp.mean(z, axis=0)
+        n = x.shape[0]
+        sigma = xc.T @ xc / n + eps * jnp.eye(x.shape[1])
+        sigma_xz = xc.T @ zc / n  # [d, k]
+        evals, evecs = jnp.linalg.eigh(sigma)
+        w = evecs @ jnp.diag(evals**-0.5) @ evecs.T  # Σ^{-1/2}
+        w_inv = evecs @ jnp.diag(evals**0.5) @ evecs.T
+        wx = w @ sigma_xz  # whitened cross-covariance [d, k]
+        q, _ = jnp.linalg.qr(wx)
+        proj = w_inv @ (q @ q.T) @ w  # oblique projection in original space
+        return cls(proj=proj, mean=mu)
+
+    def __call__(self, x: Array) -> Array:
+        return x - (x - self.mean) @ self.proj.T
+
+
+def concept_feature_scores(model: LearnedDict, acts: Array,
+                           labels: Array) -> Array:
+    """Point-biserial correlation of each dictionary feature with the binary
+    concept — the ranking used to pick which features to erase."""
+    c = model.encode(model.center(acts))
+    z = jnp.asarray(labels, jnp.float32)
+    zc = (z - jnp.mean(z)) / (jnp.std(z) + 1e-8)
+    cc = (c - jnp.mean(c, axis=0)) / (jnp.std(c, axis=0) + 1e-8)
+    return jnp.abs(cc.T @ zc) / c.shape[0]
+
+
+def erase_features(model: LearnedDict, acts: Array,
+                   feature_idx: Array) -> Array:
+    """Subtract the selected features' contributions from the activations
+    (computed in the dict's centered space, mapped back through uncenter)."""
+    xc = model.center(acts)
+    c = model.encode(xc)
+    mask = jnp.zeros((model.n_feats,), acts.dtype).at[feature_idx].set(1.0)
+    removal = (c * mask) @ model.get_learned_dict()
+    return model.uncenter(xc - removal)
+
+
+def _kl_div(p_logits: Array, q_logits: Array) -> Array:
+    p = jax.nn.log_softmax(p_logits.astype(jnp.float32), axis=-1)
+    q = jax.nn.log_softmax(q_logits.astype(jnp.float32), axis=-1)
+    return jnp.mean(jnp.sum(jnp.exp(p) * (p - q), axis=-1))
+
+
+def feature_erasure_curve(
+    model: LearnedDict,
+    acts: Array,
+    labels: Array,
+    n_features_grid: Sequence[int] = (1, 2, 4, 8, 16, 32, 64),
+    lm_eval: Optional[dict] = None,
+    probe_fn=None,
+) -> list[dict]:
+    """For each m in the grid: erase the top-m concept features, record probe
+    AUROC, mean edit magnitude, and (when `lm_eval` provides
+    {params, lm_cfg, tokens, location, forward}) the LM's KL-under-edit —
+    the erasure_scores content erasure_plot.py expects."""
+    if probe_fn is None:
+        from sparse_coding_tpu.metrics.core import logistic_regression_auroc
+        probe_fn = logistic_regression_auroc
+
+    scores = concept_feature_scores(model, acts, labels)
+    order = jnp.argsort(-scores)
+    base_auroc = probe_fn(acts, labels, max_iter=200)
+
+    base_row = {"n_erased": 0, "auroc": base_auroc, "edit_magnitude": 0.0}
+    if lm_eval is not None:  # keep the record schema uniform across rows
+        base_row["kl"] = 0.0
+    results = [base_row]
+    for m in n_features_grid:
+        m = min(m, int(model.n_feats))
+        idx = order[:m]
+        erased = erase_features(model, acts, idx)
+        rec = {
+            "n_erased": m,
+            "auroc": probe_fn(erased, labels, max_iter=200),
+            "edit_magnitude": float(jnp.mean(jnp.linalg.norm(erased - acts, axis=-1))),
+        }
+        if lm_eval is not None:
+            rec["kl"] = _lm_kl_under_erasure(model, idx, **lm_eval)
+        results.append(rec)
+    return results
+
+
+def leace_baseline(acts: Array, labels: Array, probe_fn=None) -> dict:
+    """AUROC + edit magnitude after LEACE — the linear-eraser baseline
+    (erasure_plot.py's 'leace' series)."""
+    if probe_fn is None:
+        from sparse_coding_tpu.metrics.core import logistic_regression_auroc
+        probe_fn = logistic_regression_auroc
+    eraser = LeaceEraser.fit(acts, labels)
+    erased = eraser(acts)
+    return {"auroc": probe_fn(erased, labels, max_iter=200),
+            "edit_magnitude": float(jnp.mean(jnp.linalg.norm(erased - acts, axis=-1)))}
+
+
+def _lm_kl_under_erasure(model: LearnedDict, feature_idx: Array, params=None,
+                         lm_cfg=None, tokens=None, location=None,
+                         forward=None) -> float:
+    """KL(base ‖ erased) of next-token distributions when the erasure is
+    applied to the tapped activation in-flight."""
+    from sparse_coding_tpu.metrics.intervention import _loc_tap
+
+    if forward is None:
+        from sparse_coding_tpu.lm.convert import forward_fn
+        forward = forward_fn(lm_cfg)
+
+    def edit(tensor: Array) -> Array:
+        b, s, d = tensor.shape
+        flat = tensor.reshape(b * s, d)
+        return erase_features(model, flat, feature_idx).reshape(b, s, d)
+
+    base_logits, _ = forward(params, tokens, lm_cfg)
+    erased_logits, _ = forward(params, tokens, lm_cfg,
+                               edit=(_loc_tap(location), edit))
+    return float(_kl_div(base_logits, erased_logits))

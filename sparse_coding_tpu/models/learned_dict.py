@@ -32,8 +32,18 @@ def normalize_rows(d: Array, eps: float = _NORM_EPS) -> Array:
     return d / (jnp.linalg.norm(d, axis=-1, keepdims=True) + eps)
 
 
+# Every LearnedDict subclass auto-registers here (by class name) so artifact
+# files can be reconstructed without hand-maintained registries
+# (utils/artifacts.py reads this).
+LEARNED_DICT_REGISTRY: dict[str, type] = {}
+
+
 class LearnedDict(struct.PyTreeNode):
     """Base class: subclasses provide `encode` and `get_learned_dict`."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        LEARNED_DICT_REGISTRY[cls.__name__] = cls
 
     @property
     def n_feats(self) -> int:

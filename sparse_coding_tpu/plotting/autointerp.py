@@ -1,0 +1,63 @@
+"""Auto-interpretation comparison plots.
+
+Consolidates the reference's six plot_autointerp_vs_* variants and the
+violin-plot results reader (reference: plotting/plot_autointerp_vs_baselines.py,
+interpret.py:691-761 `read_results`). Axis conventions match the reference:
+score range −0.2…0.6 (interpret.py:720-722), per-location mean-score caps 0.2
+(residual) / 0.35 (MLP) (plot_autointerp_vs_baselines.py:60-62).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+
+SCORE_RANGE = (-0.2, 0.6)  # reference: interpret.py:720-722
+MEAN_SCORE_CAP = {"residual": 0.2, "mlp": 0.35}  # plot_autointerp_vs_baselines.py:60-62
+
+
+def plot_score_violins(scores_by_transform: dict[str, Sequence[float]],
+                       save_path: Optional[str | Path] = None,
+                       title: str = "auto-interpretation scores"):
+    """Violin plot with bootstrap CIs per transform
+    (reference: read_results, interpret.py:691-761)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    names = sorted(scores_by_transform)
+    data = [np.asarray(scores_by_transform[n], float) for n in names]
+    fig, ax = plt.subplots(figsize=(1.2 * len(names) + 3, 5))
+    ax.violinplot(data, showmeans=True)
+    for i, vals in enumerate(data, start=1):
+        boot = [np.mean(np.random.default_rng(s).choice(vals, len(vals)))
+                for s in range(200)]
+        lo, hi = np.percentile(boot, [2.5, 97.5])
+        ax.plot([i, i], [lo, hi], color="black", lw=2)
+    ax.set_xticks(range(1, len(names) + 1), names, rotation=30, ha="right")
+    ax.set_ylim(*SCORE_RANGE)
+    ax.set_ylabel("top-and-random correlation score")
+    ax.set_title(title)
+    fig.tight_layout()
+    if save_path is not None:
+        Path(save_path).parent.mkdir(parents=True, exist_ok=True)
+        fig.savefig(save_path, dpi=150)
+    plt.close(fig)
+    return {n: (float(np.mean(d)), float(np.std(d))) for n, d in
+            zip(names, data)}
+
+
+def plot_autointerp_vs_baselines(results_root: str | Path,
+                                 save_path: Optional[str | Path] = None,
+                                 layer_loc: str = "residual"):
+    """Read per-transform score folders and render the comparison
+    (reference: plot_autointerp_vs_baselines.py:35-62)."""
+    from sparse_coding_tpu.interp.run import read_transform_scores
+
+    scores = read_transform_scores(results_root)
+    summary = plot_score_violins(scores, save_path=save_path,
+                                 title=f"autointerp vs baselines ({layer_loc})")
+    return summary

@@ -1,0 +1,40 @@
+"""Concept-erasure comparison plots
+(reference: plotting/erasure_plot.py:12-342 — probe-ability vs edit magnitude
+vs KL, with the LEACE point; consumes metrics/erasure.py outputs)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+
+def plot_erasure_tradeoff(curve: Sequence[dict], leace: Optional[dict] = None,
+                          x_key: str = "edit_magnitude", y_key: str = "auroc",
+                          save_path: Optional[str | Path] = None,
+                          title: str = "concept erasure tradeoff"):
+    """Probe AUROC (or KL) vs edit magnitude along the feature-erasure curve,
+    with LEACE as a reference point (erasure_plot.py:198-278)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7, 5))
+    pts = sorted(curve, key=lambda r: r[x_key])
+    ax.plot([p[x_key] for p in pts], [p[y_key] for p in pts], marker="o",
+            label="feature erasure")
+    for p in pts:
+        ax.annotate(str(p.get("n_erased", "")), (p[x_key], p[y_key]),
+                    fontsize=7, xytext=(3, 3), textcoords="offset points")
+    if leace is not None and x_key in leace and y_key in leace:
+        ax.scatter([leace[x_key]], [leace[y_key]], marker="*", s=150,
+                   color="crimson", label="LEACE", zorder=3)
+    ax.set_xlabel(x_key)
+    ax.set_ylabel(y_key)
+    ax.set_title(title)
+    ax.legend()
+    fig.tight_layout()
+    if save_path is not None:
+        Path(save_path).parent.mkdir(parents=True, exist_ok=True)
+        fig.savefig(save_path, dpi=150)
+    plt.close(fig)

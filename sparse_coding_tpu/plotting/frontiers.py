@@ -1,0 +1,75 @@
+"""FVU-vs-sparsity frontier plots + score generation.
+
+Consolidates the reference's per-model plot scripts
+(reference: plotting/fvu_sparsity_plot.py:104-186 `generate_scores` and its
+`_gpt2sm` / `_mlp_center` clones) into one parameterized module: a score
+generator that evaluates every saved dict on an eval slab, and a frontier
+renderer. Matplotlib is imported lazily so headless metric-only use never
+touches a display backend.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.metrics.core import fraction_variance_unexplained, mean_l0
+from sparse_coding_tpu.utils.artifacts import load_learned_dicts
+
+
+def generate_scores(dict_files: Sequence[str | Path], eval_batch,
+                    out_path: Optional[str | Path] = None) -> list[dict]:
+    """FVU + L0 for every (dict, hyperparams) across artifact files
+    (reference: fvu_sparsity_plot.py:104-186)."""
+    eval_batch = jnp.asarray(eval_batch)
+    scores = []
+    for path in dict_files:
+        for ld, hyper in load_learned_dicts(path):
+            scores.append({
+                "file": str(path),
+                **{k: v for k, v in hyper.items()
+                   if isinstance(v, (int, float, str, bool))},
+                "fvu": float(fraction_variance_unexplained(ld, eval_batch)),
+                "l0": float(mean_l0(ld, eval_batch)),
+            })
+    if out_path is not None:
+        Path(out_path).parent.mkdir(parents=True, exist_ok=True)
+        Path(out_path).write_text(json.dumps(scores, indent=2))
+    return scores
+
+
+def plot_fvu_sparsity(scores: Sequence[dict], group_by: str = "dict_size",
+                      save_path: Optional[str | Path] = None, show: bool = False,
+                      title: str = "FVU vs sparsity"):
+    """Frontier scatter: x = L0, y = FVU, one series per group
+    (reference: fvu_sparsity_plot.py rendering loop)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7, 5))
+    groups: dict = {}
+    for s in scores:
+        groups.setdefault(s.get(group_by, "all"), []).append(s)
+    for key in sorted(groups, key=str):
+        pts = sorted(groups[key], key=lambda s: s["l0"])
+        ax.plot([p["l0"] for p in pts], [p["fvu"] for p in pts],
+                marker="o", ms=4, label=f"{group_by}={key}")
+    ax.set_xlabel("mean L0 (active features/sample)")
+    ax.set_ylabel("fraction of variance unexplained")
+    ax.set_title(title)
+    ax.set_xscale("log")
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    if save_path is not None:
+        Path(save_path).parent.mkdir(parents=True, exist_ok=True)
+        fig.savefig(save_path, dpi=150)
+    if show:  # pragma: no cover
+        plt.show()
+    plt.close(fig)
+    return fig

@@ -1,0 +1,111 @@
+"""Sweep-grid, activity, and dead-feature plots.
+
+Consolidates the reference's plot_sweep_results.py:28-104, the seven
+plot_n_active* variants, and num_dead_plot.py into parameterized functions.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.metrics.core import mean_nonzero_activations
+from sparse_coding_tpu.utils.artifacts import load_learned_dicts
+
+
+def _plt():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def sweep_grid(scores: Sequence[dict], x_key: str = "l1_alpha",
+               y_key: str = "dict_size", value_key: str = "fvu") -> tuple:
+    """Pivot sweep scores into a (x_vals, y_vals, grid) heatmap input
+    (reference: plot_sweep_results.py:28-104)."""
+    xs = sorted({s[x_key] for s in scores})
+    ys = sorted({s[y_key] for s in scores})
+    grid = np.full((len(ys), len(xs)), np.nan)
+    for s in scores:
+        grid[ys.index(s[y_key]), xs.index(s[x_key])] = s[value_key]
+    return xs, ys, grid
+
+
+def plot_sweep_grid(scores, x_key="l1_alpha", y_key="dict_size",
+                    value_key="fvu", save_path: Optional[str | Path] = None):
+    plt = _plt()
+    xs, ys, grid = sweep_grid(scores, x_key, y_key, value_key)
+    fig, ax = plt.subplots(figsize=(7, 5))
+    im = ax.imshow(grid, aspect="auto", origin="lower", cmap="viridis")
+    ax.set_xticks(range(len(xs)), [f"{x:.1e}" if isinstance(x, float) else x
+                                   for x in xs], rotation=45, fontsize=7)
+    ax.set_yticks(range(len(ys)), ys, fontsize=7)
+    ax.set_xlabel(x_key)
+    ax.set_ylabel(y_key)
+    fig.colorbar(im, label=value_key)
+    fig.tight_layout()
+    if save_path is not None:
+        Path(save_path).parent.mkdir(parents=True, exist_ok=True)
+        fig.savefig(save_path, dpi=150)
+    plt.close(fig)
+    return xs, ys, grid
+
+
+def n_active_features(dict_files: Sequence[str | Path], eval_batch,
+                      threshold: float = 0.0) -> list[dict]:
+    """Active-feature counts per dict (reference: plot_n_active*.py family)."""
+    eval_batch = jnp.asarray(eval_batch)
+    out = []
+    for path in dict_files:
+        for ld, hyper in load_learned_dicts(path):
+            freq = mean_nonzero_activations(ld, eval_batch)
+            out.append({
+                **{k: v for k, v in hyper.items()
+                   if isinstance(v, (int, float, str, bool))},
+                "n_active": int(jnp.sum(freq > threshold)),
+                "n_feats": int(ld.n_feats),
+            })
+    return out
+
+
+def plot_n_active(records: Sequence[dict], x_key: str = "l1_alpha",
+                  save_path: Optional[str | Path] = None):
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(7, 5))
+    pts = sorted(records, key=lambda r: r[x_key])
+    ax.plot([p[x_key] for p in pts], [p["n_active"] for p in pts], marker="o")
+    ax.plot([p[x_key] for p in pts], [p["n_feats"] for p in pts], ls="--",
+            color="gray", label="dict size")
+    ax.set_xscale("log")
+    ax.set_xlabel(x_key)
+    ax.set_ylabel("active features")
+    ax.legend()
+    fig.tight_layout()
+    if save_path is not None:
+        Path(save_path).parent.mkdir(parents=True, exist_ok=True)
+        fig.savefig(save_path, dpi=150)
+    plt.close(fig)
+
+
+def plot_num_dead(records: Sequence[dict], x_key: str = "l1_alpha",
+                  save_path: Optional[str | Path] = None):
+    """Dead-feature counts (reference: num_dead_plot.py)."""
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(7, 5))
+    pts = sorted(records, key=lambda r: r[x_key])
+    ax.plot([p[x_key] for p in pts],
+            [p["n_feats"] - p["n_active"] for p in pts], marker="o", color="crimson")
+    ax.set_xscale("log")
+    ax.set_xlabel(x_key)
+    ax.set_ylabel("dead features")
+    fig.tight_layout()
+    if save_path is not None:
+        Path(save_path).parent.mkdir(parents=True, exist_ok=True)
+        fig.savefig(save_path, dpi=150)
+    plt.close(fig)

@@ -1,0 +1,97 @@
+"""Counterfactual IOI dataset with template families and padded batches.
+
+Same capability as the reference's `test_datasets/ioi_counterfact.py`
+(Redwood-derived): BABA/ABBA template families with place/object slot
+substitution, counterfactual pairs swapping the indirect object, and padded
+token tensors with per-sequence lengths (`gen_ioi_dataset`, reference
+:338-373). Template wording here is this framework's own.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from sparse_coding_tpu.tasks.ioi import CANDIDATE_NAMES, _single_token_filter
+
+PLACES = ["garden", "market", "library", "harbor", "square"]
+OBJECTS = ["coin", "map", "rose", "kite", "drum"]
+
+# [A]/[B] name slots, [PLACE]/[OBJECT] content slots. BABA ordering: B first.
+BABA_TEMPLATES = [
+    "Later, [B] and [A] met near the [PLACE], and [B] offered the [OBJECT] to [A]",
+    "While [B] and [A] waited at the [PLACE], [B] passed the [OBJECT] to [A]",
+    "Once [B] and [A] arrived at the [PLACE], [B] showed the [OBJECT] to [A]",
+    "After [B] and [A] left the [PLACE], [B] returned the [OBJECT] to [A]",
+]
+
+
+def _swap_first_clause(template: str) -> str:
+    """ABBA variant: swap [A]/[B] in the first clause only (the reference
+    builds ABBA from BABA the same way, ioi_counterfact.py:201-213)."""
+    cut = template.index(",")
+    first, rest = template[:cut], template[cut:]
+    first = first.replace("[A]", "[TMP]").replace("[B]", "[A]").replace("[TMP]", "[B]")
+    return first + rest
+
+
+ABBA_TEMPLATES = [_swap_first_clause(t) for t in BABA_TEMPLATES]
+
+
+@dataclass
+class CounterfactPrompt:
+    text: str
+    counterfact: str  # same prompt with the recipient swapped
+    subject: str  # the repeated (subject) name
+    indirect_object: str  # the correct completion name
+
+
+def fill_template(template: str, name_a: str, name_b: str, place: str,
+                  obj: str) -> str:
+    return (template.replace("[A]", name_a).replace("[B]", name_b)
+            .replace("[PLACE]", place).replace("[OBJECT]", obj))
+
+
+def gen_prompt_counterfact(tokenizer, n_prompts: int, family: str = "baba",
+                           seed: int = 0) -> list[CounterfactPrompt]:
+    """(reference: gen_prompt_counterfact, ioi_counterfact.py:282-336)."""
+    rng = np.random.default_rng(seed)
+    names = _single_token_filter(tokenizer, CANDIDATE_NAMES, "names", strict=False)
+    templates = BABA_TEMPLATES if family == "baba" else ABBA_TEMPLATES
+    prompts = []
+    for _ in range(n_prompts):
+        name_a, name_b, name_c = rng.choice(names, size=3, replace=False)
+        t = templates[rng.integers(len(templates))]
+        place = PLACES[rng.integers(len(PLACES))]
+        obj = OBJECTS[rng.integers(len(OBJECTS))]
+        text = fill_template(t, name_a, name_b, place, obj)
+        counterfact = fill_template(t, name_c, name_b, place, obj)
+        prompts.append(CounterfactPrompt(text=text, counterfact=counterfact,
+                                         subject=name_b,
+                                         indirect_object=name_a))
+    return prompts
+
+
+def gen_ioi_dataset(tokenizer, n_prompts: int, family: str = "baba",
+                    seed: int = 0):
+    """Padded tensors + lengths (reference: gen_ioi_dataset,
+    ioi_counterfact.py:338-373). Returns
+    (tokens [n, max_len], counterfact_tokens, lengths [n], target_ids [n])."""
+    prompts = gen_prompt_counterfact(tokenizer, n_prompts, family, seed)
+    tok = [tokenizer(p.text)["input_ids"] for p in prompts]
+    ctok = [tokenizer(p.counterfact)["input_ids"] for p in prompts]
+    max_len = max(max(map(len, tok)), max(map(len, ctok)))
+    pad = getattr(tokenizer, "pad_token_id", None) or 0
+
+    def padded(seqs):
+        out = np.full((len(seqs), max_len), pad, np.int32)
+        for i, s in enumerate(seqs):
+            out[i, :len(s)] = s
+        return out
+
+    lengths = np.asarray([len(s) for s in tok], np.int32)
+    target_ids = np.asarray(
+        [tokenizer(" " + p.indirect_object)["input_ids"][0] for p in prompts],
+        np.int32)
+    return padded(tok), padded(ctok), lengths, target_ids

@@ -22,21 +22,15 @@ ARTIFACT_NAME = "learned_dicts.pkl"
 
 
 def _dict_registry() -> dict[str, type]:
-    """Every LearnedDict class in the package, across all model modules."""
-    import sparse_coding_tpu.models as m
-    from sparse_coding_tpu.models import direct_coef, ica, lista, nmf, pca, rica, semilinear
-    from sparse_coding_tpu.models.learned_dict import LearnedDict
-    from sparse_coding_tpu.models.sae import ThresholdingSAE
+    """Every LearnedDict subclass auto-registers at class-creation time
+    (models/learned_dict.py LEARNED_DICT_REGISTRY); importing the defining
+    modules here triggers registration for classes living outside
+    sparse_coding_tpu.models."""
+    import sparse_coding_tpu.models  # noqa: F401  (imports the full zoo)
+    import sparse_coding_tpu.train.big_sae  # noqa: F401  (BigSAEDict)
+    from sparse_coding_tpu.models.learned_dict import LEARNED_DICT_REGISTRY
 
-    reg = {name: getattr(m, name) for name in dir(m)
-           if isinstance(getattr(m, name), type)}
-    for mod in (direct_coef, ica, lista, nmf, pca, rica, semilinear):
-        for name in dir(mod):
-            obj = getattr(mod, name)
-            if isinstance(obj, type) and issubclass(obj, LearnedDict):
-                reg[name] = obj
-    reg["ThresholdingSAE"] = ThresholdingSAE
-    return reg
+    return dict(LEARNED_DICT_REGISTRY)
 
 
 def _to_numpy_tree(v):

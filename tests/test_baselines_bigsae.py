@@ -1,0 +1,147 @@
+"""Baseline suite + big-SAE trainer tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.data.chunk_store import ChunkWriter
+from sparse_coding_tpu.data.synthetic import RandomDatasetGenerator
+from sparse_coding_tpu.models.pca import BatchedPCA, PCAEncoder, fit_pca
+from sparse_coding_tpu.train.big_sae import (
+    init_big_sae,
+    make_big_sae_step,
+    resurrect_dead_features,
+    shard_big_sae,
+    to_learned_dict,
+)
+
+D = 32
+
+
+@pytest.fixture(scope="module")
+def synth_chunks(tmp_path_factory):
+    folder = tmp_path_factory.mktemp("chunks")
+    gen = RandomDatasetGenerator.create(jax.random.PRNGKey(0), D, 64, 5, 0.99)
+    w = ChunkWriter(folder, D, chunk_size_gb=D * 4096 * 2 / 2**30, dtype="float16")
+    key = jax.random.PRNGKey(1)
+    for _ in range(4):
+        key, sub = jax.random.split(key)
+        w.add(np.asarray(gen.batch(sub, 4096)))
+    w.finalize()
+    return folder, gen
+
+
+def test_batched_pca_matches_direct(rng):
+    x = jax.random.normal(rng, (2000, D)) * jnp.arange(1, D + 1)
+    pca = BatchedPCA(D)
+    pca.state = fit_pca(x, batch_size=256)
+    # streaming covariance == direct covariance
+    direct_cov = jnp.cov(x.T, bias=True)
+    np.testing.assert_allclose(np.asarray(pca.state.cov), np.asarray(direct_cov),
+                               rtol=1e-3, atol=1e-3)
+    # top eigenvector aligns with the largest-variance axis
+    top = np.asarray(pca.get_dict()[0])
+    assert abs(top[-1]) > 0.9
+
+
+def test_pca_encoder_topk(rng):
+    x = jax.random.normal(rng, (256, D))
+    pca = BatchedPCA(D)
+    pca.train_batch(x)
+    enc = pca.to_learned_dict(sparsity=4)
+    c = enc.encode(x)
+    assert jnp.all(jnp.sum(c != 0, axis=-1) <= 4)
+    # signed values kept (unlike ReLU topk)
+    assert jnp.any(c < 0)
+
+
+def test_pca_centering_transform(rng):
+    x = jax.random.normal(rng, (4096, D)) * 3.0 + 1.0
+    pca = BatchedPCA(D)
+    pca.state = fit_pca(x, batch_size=512)
+    mean, rot, scale = pca.get_centering_transform()
+    whitened = ((x - mean) @ rot) * scale
+    cov = jnp.cov(whitened.T, bias=True)
+    np.testing.assert_allclose(np.asarray(cov), np.eye(D), atol=0.15)
+
+
+def test_run_layer_baselines(tmp_path, synth_chunks):
+    from sparse_coding_tpu.train.baselines import run_layer_baselines
+
+    folder, gen = synth_chunks
+    results = run_layer_baselines(folder, tmp_path, sparsity=8,
+                                  max_ica_samples=4000)
+    assert {"pca", "pca_topk", "ica", "ica_topk", "random",
+            "identity_relu"} <= set(results)
+    # idempotence: second call loads instead of refitting
+    results2 = run_layer_baselines(folder, tmp_path, sparsity=8)
+    assert isinstance(results2["pca"], PCAEncoder)
+
+
+def test_big_sae_trains(rng):
+    state, optimizer, l1 = init_big_sae(rng, D, 64, l1_alpha=1e-4, lr=1e-2,
+                                        n_worst=32)
+    step = make_big_sae_step(optimizer, l1)
+    gen = RandomDatasetGenerator.create(jax.random.PRNGKey(5), D, 48, 5, 0.99)
+    key = jax.random.PRNGKey(6)
+    first = None
+    for i in range(600):
+        key, sub = jax.random.split(key)
+        state, metrics = step(state, gen.batch(sub, 256))
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+    ld = to_learned_dict(state)
+    assert ld.encode(gen.batch(key, 16)).shape == (16, 64)
+    # the export must reproduce the training objective (a dropped centering
+    # term once made export FVU ~7 while training FVU was 0.13)
+    from sparse_coding_tpu.metrics.core import fraction_variance_unexplained
+
+    eval_batch = gen.batch(jax.random.PRNGKey(99), 2048)
+    export_fvu = float(fraction_variance_unexplained(ld, eval_batch))
+    assert export_fvu < 1.0, f"export FVU {export_fvu} inconsistent with training"
+
+
+def test_dead_feature_resurrection(rng):
+    state, optimizer, l1 = init_big_sae(rng, D, 64, l1_alpha=1e-4, n_worst=32)
+    step = make_big_sae_step(optimizer, l1)
+    batch = jax.random.normal(jax.random.PRNGKey(7), (128, D))
+    state, _ = step(state, batch)
+    # kill half the features' history artificially
+    dead_mask = jnp.arange(64) < 20
+    state = state.replace(c_totals=jnp.where(dead_mask, 0.0, state.c_totals + 1.0))
+    old_encoder = np.asarray(state.params["encoder"])
+    new_state, n_dead = resurrect_dead_features(state)
+    assert int(n_dead) == 20
+    new_encoder = np.asarray(new_state.params["encoder"])
+    # dead columns replaced, live columns untouched
+    assert not np.allclose(new_encoder[:, :20], old_encoder[:, :20])
+    np.testing.assert_array_equal(new_encoder[:, 20:], old_encoder[:, 20:])
+    # dead features' Adam moments zeroed
+    mu = new_state.opt_state[0].mu
+    assert float(jnp.max(jnp.abs(mu["encoder"][:, :20]))) == 0.0
+    # tracking buffers reset
+    assert float(jnp.max(new_state.c_totals)) == 0.0
+
+
+def test_big_sae_sharded(rng, devices8):
+    from sparse_coding_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(2, 4)
+    state, optimizer, l1 = init_big_sae(rng, D, 64, l1_alpha=1e-4, n_worst=32)
+    # independent second state: device_put can alias buffers, and the donating
+    # step would otherwise delete the plain copy's arrays
+    plain_state, _, _ = init_big_sae(rng, D, 64, l1_alpha=1e-4, n_worst=32)
+    state = shard_big_sae(state, mesh)
+    step = make_big_sae_step(optimizer, l1, mesh)
+    plain_step = make_big_sae_step(optimizer, l1)
+    batch = jax.random.normal(jax.random.PRNGKey(8), (64, D))
+    for _ in range(5):
+        state, m_sharded = step(state, batch)
+        plain_state, m_plain = plain_step(plain_state, batch)
+    np.testing.assert_allclose(float(m_sharded["loss"]), float(m_plain["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(state.params["dict"]),
+                               np.asarray(plain_state.params["dict"]),
+                               rtol=1e-5, atol=1e-6)

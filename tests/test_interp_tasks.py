@@ -1,0 +1,182 @@
+"""Interpretation pipeline (offline provider) + task datasets + erasure tests."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.config import InterpArgs
+from sparse_coding_tpu.interp.client import ActivationRecord, OfflineExplainer
+from sparse_coding_tpu.interp.run import correlation_score, read_scores, read_transform_scores, run
+from sparse_coding_tpu.lm import gptneox
+from sparse_coding_tpu.lm.model_config import tiny_test_config
+from sparse_coding_tpu.metrics.erasure import (
+    LeaceEraser,
+    erase_features,
+    feature_erasure_curve,
+    leace_baseline,
+)
+from sparse_coding_tpu.models import RandomDict, TiedSAE
+
+
+class _CharTokenizer:
+    """Maps chars to ids; enough for task-template tests."""
+
+    pad_token_id = 0
+    eos_token_id = 0
+
+    def __call__(self, texts):
+        if isinstance(texts, str):
+            return {"input_ids": self._encode(texts)}
+        return {"input_ids": [self._encode(t) for t in texts]}
+
+    def _encode(self, text):
+        # word-level: single token per word
+        return [hash(w) % 1000 + 1 for w in text.split()]
+
+
+def test_offline_explainer_roundtrip():
+    ex = OfflineExplainer(top_n_tokens=2)
+    records = [ActivationRecord(tokens=["the", "cat", "sat"],
+                                activations=[0.0, 5.0, 0.1]),
+               ActivationRecord(tokens=["a", "cat", "ran"],
+                                activations=[0.0, 4.0, 0.0])]
+    expl = ex.explain(records)
+    assert "cat" in expl
+    sim = ex.simulate(expl, ["dog", "cat"])
+    assert sim == [0.0, 1.0]
+
+
+def test_offline_explainer_comma_tokens():
+    """Tokens containing commas/quotes must survive the explanation format."""
+    ex = OfflineExplainer(top_n_tokens=2)
+    records = [ActivationRecord(tokens=[",", "'", "cat"],
+                                activations=[5.0, 4.0, 0.0])]
+    expl = ex.explain(records)
+    sim = ex.simulate(expl, [",", "'", "cat"])
+    assert sim == [1.0, 1.0, 0.0]
+
+
+def test_fragment_len_too_long_raises():
+    from sparse_coding_tpu.interp.fragments import sample_fragments
+    rows = np.zeros((4, 8), np.int32)
+    with pytest.raises(ValueError, match="fragment_len"):
+        sample_fragments(rows, fragment_len=16, n_fragments=2)
+
+
+def test_correlation_score():
+    assert correlation_score(np.array([1, 2, 3]), np.array([2, 4, 6])) == pytest.approx(1.0)
+    assert correlation_score(np.array([1, 2, 3]), np.array([3, 2, 1])) == pytest.approx(-1.0)
+    assert correlation_score(np.array([1, 1, 1]), np.array([1, 2, 3])) == 0.0
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = tiny_test_config("gptneox")
+    params = gptneox.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def test_interp_run_offline(tmp_path, tiny_lm):
+    """Whole interpretation pipeline offline: fragments → explain → simulate
+    → scores → artifacts → reader."""
+    params, lm_cfg = tiny_lm
+    token_rows = np.random.default_rng(0).integers(
+        0, lm_cfg.vocab_size, size=(64, 24))
+    ld = RandomDict.create(jax.random.PRNGKey(1), lm_cfg.d_model, n_feats=16)
+    cfg = InterpArgs(output_folder=str(tmp_path), layer=1, layer_loc="residual",
+                     n_feats_to_explain=3, fragment_len=8, n_fragments=32,
+                     top_k_fragments=4, n_random_fragments=4, batch_size=8,
+                     provider="offline")
+    results = run(ld, cfg, params, lm_cfg, token_rows,
+                  decode_token=lambda t: f"tok{t}", forward=gptneox.forward)
+    assert len(results) == 3
+    for rec in results:
+        assert "explanation" in rec and "top_random_score" in rec
+        assert (tmp_path / f"feature_{rec['feature']}" / "explanation.txt").exists()
+    # reader roundtrip
+    scores = read_scores(tmp_path)
+    assert set(scores) == {r["feature"] for r in results}
+    # idempotence: re-run loads cached
+    results2 = run(ld, cfg, params, lm_cfg, token_rows,
+                   decode_token=lambda t: f"tok{t}", forward=gptneox.forward)
+    assert results2 == results
+
+
+def test_read_transform_scores(tmp_path):
+    for name, score in [("sae", 0.5), ("pca", 0.2)]:
+        d = tmp_path / name / "feature_0"
+        d.mkdir(parents=True)
+        (d / "scores.json").write_text(json.dumps(
+            {"feature": 0, "top_random_score": score}))
+    out = read_transform_scores(tmp_path)
+    assert out == {"sae": [0.5], "pca": [0.2]}
+
+
+def test_ioi_dataset():
+    from sparse_coding_tpu.tasks.ioi import generate_ioi_dataset
+
+    tok = _CharTokenizer()
+    clean, corrupted = generate_ioi_dataset(tok, n_abb_a=4, n_abb_b=4)
+    assert clean.shape == corrupted.shape
+    assert clean.shape[0] == 8
+    # clean and corrupted differ only in the final name ordering
+    assert not np.array_equal(clean, corrupted)
+
+
+def test_ioi_counterfact_dataset():
+    from sparse_coding_tpu.tasks.ioi_counterfact import gen_ioi_dataset
+
+    tok = _CharTokenizer()
+    tokens, ctokens, lengths, targets = gen_ioi_dataset(tok, 6, family="baba")
+    assert tokens.shape == ctokens.shape
+    assert lengths.shape == (6,) and targets.shape == (6,)
+    assert np.all(lengths <= tokens.shape[1])
+
+
+def test_gender_probe_arrays():
+    from sparse_coding_tpu.tasks.gender import gender_probe_arrays
+
+    entries = [["Alice", "F", "100", "0.9"], ["Bob", "M", "90", "0.8"],
+               ["Carol", "F", "80", "0.9"], ["Dan", "M", "70", "0.8"]]
+    toks, labels = gender_probe_arrays(entries, _CharTokenizer())
+    assert toks.shape == (4,)
+    assert labels.sum() == 2
+
+
+def test_leace_removes_linear_concept(rng):
+    """After LEACE, a linear probe can't recover the concept."""
+    k1, k2 = jax.random.split(rng)
+    n, d = 2000, 16
+    labels = jnp.asarray(np.random.default_rng(0).integers(0, 2, n))
+    direction = jax.random.normal(k1, (d,))
+    x = jax.random.normal(k2, (n, d)) + 3.0 * labels[:, None] * direction
+    from sparse_coding_tpu.metrics.core import logistic_regression_auroc
+
+    base = logistic_regression_auroc(x, labels, max_iter=200)
+    assert base > 0.95
+    eraser = LeaceEraser.fit(x, labels)
+    erased_auroc = logistic_regression_auroc(eraser(x), labels, max_iter=200)
+    assert erased_auroc < 0.6
+
+
+def test_feature_erasure_curve(rng):
+    """Erasing concept-correlated SAE features degrades the probe."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    n, d, f = 1000, 16, 32
+    labels = jnp.asarray(np.random.default_rng(1).integers(0, 2, n))
+    sae = TiedSAE(dictionary=jax.random.normal(k1, (f, d)),
+                  encoder_bias=jnp.zeros(f))
+    # construct activations where one dictionary atom carries the concept
+    atom = sae.get_learned_dict()[3]
+    x = jax.random.normal(k2, (n, d)) * 0.3 + 4.0 * labels[:, None] * atom
+    curve = feature_erasure_curve(sae, x, labels, n_features_grid=(1, 4))
+    aurocs = [r["auroc"] for r in curve]
+    assert aurocs[0] > 0.9  # probe works before erasure
+    assert min(aurocs[1:]) < aurocs[0]  # erasure hurts the probe
+    mags = [r["edit_magnitude"] for r in curve]
+    assert mags[0] == 0.0 and mags[-1] > 0.0
+    base = leace_baseline(x, labels)
+    assert base["auroc"] < 0.7

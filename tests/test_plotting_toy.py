@@ -1,0 +1,98 @@
+"""Plotting-module and toy-replication tests (data paths, not pixels)."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.config import ToyArgs
+from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+
+
+@pytest.fixture
+def dict_file(tmp_path, rng):
+    members = []
+    for i, l1 in enumerate([1e-4, 1e-3]):
+        p, b = FunctionalTiedSAE.init(jax.random.fold_in(rng, i), 16, 32,
+                                      l1_alpha=l1)
+        members.append((FunctionalTiedSAE.to_learned_dict(p, b),
+                        {"l1_alpha": l1, "dict_size": 32}))
+    path = tmp_path / "learned_dicts.pkl"
+    save_learned_dicts(members, path)
+    return path
+
+
+def test_generate_scores_and_frontier(tmp_path, dict_file, rng):
+    from sparse_coding_tpu.plotting.frontiers import generate_scores, plot_fvu_sparsity
+
+    eval_batch = jax.random.normal(rng, (256, 16))
+    scores = generate_scores([dict_file], eval_batch,
+                             out_path=tmp_path / "scores.json")
+    assert len(scores) == 2
+    assert all(0 <= s["fvu"] for s in scores)
+    assert json.loads((tmp_path / "scores.json").read_text()) == scores
+    plot_fvu_sparsity(scores, save_path=tmp_path / "frontier.png")
+    assert (tmp_path / "frontier.png").stat().st_size > 0
+
+
+def test_sweep_grid_pivot():
+    from sparse_coding_tpu.plotting.sweeps import sweep_grid
+
+    scores = [{"l1_alpha": a, "dict_size": d, "fvu": a * d}
+              for a in (1e-4, 1e-3) for d in (32, 64)]
+    xs, ys, grid = sweep_grid(scores)
+    assert xs == [1e-4, 1e-3] and ys == [32, 64]
+    np.testing.assert_allclose(grid, [[1e-4 * 32, 1e-3 * 32],
+                                      [1e-4 * 64, 1e-3 * 64]])
+
+
+def test_n_active_and_plots(tmp_path, dict_file, rng):
+    from sparse_coding_tpu.plotting.sweeps import (
+        n_active_features,
+        plot_n_active,
+        plot_num_dead,
+    )
+
+    eval_batch = jax.random.normal(rng, (128, 16))
+    recs = n_active_features([dict_file], eval_batch)
+    assert len(recs) == 2
+    assert all(0 <= r["n_active"] <= r["n_feats"] for r in recs)
+    plot_n_active(recs, save_path=tmp_path / "na.png")
+    plot_num_dead(recs, save_path=tmp_path / "nd.png")
+    assert (tmp_path / "na.png").exists() and (tmp_path / "nd.png").exists()
+
+
+def test_score_violins(tmp_path):
+    from sparse_coding_tpu.plotting.autointerp import plot_score_violins
+
+    summary = plot_score_violins(
+        {"sae": [0.3, 0.4, 0.5], "pca": [0.1, 0.15, 0.2]},
+        save_path=tmp_path / "violin.png")
+    assert summary["sae"][0] > summary["pca"][0]
+    assert (tmp_path / "violin.png").exists()
+
+
+def test_erasure_plot(tmp_path):
+    from sparse_coding_tpu.plotting.erasure import plot_erasure_tradeoff
+
+    curve = [{"n_erased": n, "edit_magnitude": 0.1 * n, "auroc": 1.0 - 0.05 * n}
+             for n in (0, 1, 4)]
+    plot_erasure_tradeoff(curve, leace={"edit_magnitude": 0.3, "auroc": 0.55},
+                          save_path=tmp_path / "erasure.png")
+    assert (tmp_path / "erasure.png").exists()
+
+
+@pytest.mark.slow
+def test_toy_replication_gate(tmp_path):
+    from sparse_coding_tpu.train.toy_models import run_toy_replication
+
+    cfg = ToyArgs(activation_dim=48, n_ground_truth_features=64,
+                  feature_num_nonzero=5, learned_dict_ratio=1.5,
+                  l1_alpha=1e-3, lr=3e-3, batch_size=512, epochs=3,
+                  dataset_size=120_000)
+    results = run_toy_replication(cfg, output_folder=tmp_path)
+    assert (tmp_path / "toy_recovery.json").exists()
+    assert (tmp_path / "toy_recovery.png").exists()
+    assert max(r["representedness"] for r in results) > 0.85
