@@ -208,6 +208,38 @@ class ErasureArgs(BaseArgs):
 
 
 @dataclass
+class InterpGraphArgs(BaseArgs):
+    """Ablation-graph interpretation config (reference: config.py
+    InterpGraphArgs:129-136)."""
+
+    model_name: str = "EleutherAI/pythia-70m-deduped"
+    layers: list[int] = field(default_factory=lambda: [0, 2])
+    layer_loc: str = "residual"
+    dict_paths: list[str] = field(default_factory=list)
+    output_folder: str = "interp_graph_output"
+    n_fragments: int = 64
+    fragment_len: int = 32
+    positional: bool = False
+    seed: int = 0
+
+
+@dataclass
+class InvestigateArgs(BaseArgs):
+    """Single-feature investigation config (reference: config.py
+    InvestigateArgs:137-143)."""
+
+    model_name: str = "EleutherAI/pythia-70m-deduped"
+    layer: int = 2
+    layer_loc: str = "residual"
+    learned_dict_path: str = ""
+    feature_indices: list[int] = field(default_factory=list)
+    n_fragments: int = 1000
+    fragment_len: int = 64
+    output_folder: str = "investigate_output"
+    seed: int = 0
+
+
+@dataclass
 class BigSAEArgs(BaseArgs):
     """Large single-SAE trainer (reference: experiments/huge_batch_size.py
     config at :163-175,259-274): big batch, dead-feature resurrection."""

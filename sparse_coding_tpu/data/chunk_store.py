@@ -135,11 +135,7 @@ class ChunkStore:
                 rng: np.random.Generator, drop_last: bool = True) -> Iterator[np.ndarray]:
         """Shuffled fixed-size batches from an in-RAM chunk (reference:
         BatchSampler(RandomSampler), cluster_runs.py:26-32)."""
-        n = chunk.shape[0]
-        perm = rng.permutation(n)
-        end = n - (n % batch_size) if drop_last else n
-        for lo in range(0, end, batch_size):
-            yield chunk[perm[lo:lo + batch_size]]
+        return shuffled_batches(chunk, batch_size, rng, drop_last)
 
     def epoch(self, batch_size: int, rng: np.random.Generator,
               n_repetitions: int = 1, dtype=np.float32) -> Iterator[np.ndarray]:
@@ -150,6 +146,18 @@ class ChunkStore:
         for ci in order:
             chunk = self.load_chunk(int(ci), dtype)
             yield from self.batches(chunk, batch_size, rng)
+
+
+def shuffled_batches(chunk: np.ndarray, batch_size: int,
+                     rng: np.random.Generator,
+                     drop_last: bool = True) -> Iterator[np.ndarray]:
+    """Shuffled fixed-size batches over an in-RAM array (shared by ChunkStore
+    and train/dispatch.py)."""
+    n = chunk.shape[0]
+    perm = rng.permutation(n)
+    end = n - (n % batch_size) if drop_last else n
+    for lo in range(0, end, batch_size):
+        yield chunk[perm[lo:lo + batch_size]]
 
 
 def device_prefetch(batches: Iterable[np.ndarray], sharding=None,

@@ -109,6 +109,20 @@ def harvest_activations(
     return out
 
 
+def make_one_chunk_per_layer(params, lm_cfg: LMConfig, token_rows: np.ndarray,
+                             layers: Sequence[int], layer_loc: str,
+                             output_folder: str | Path,
+                             chunk_size_gb: float = 0.5,
+                             model_batch_size: int = 4,
+                             forward=None) -> dict[str, int]:
+    """One eval chunk per layer for metric sweeps
+    (reference: standard_metrics.py:582-619 make_one_chunk_per_layer[_gpt2sm])."""
+    return harvest_activations(params, lm_cfg, token_rows, layers, layer_loc,
+                               output_folder, model_batch_size=model_batch_size,
+                               chunk_size_gb=chunk_size_gb, n_chunks=1,
+                               forward=forward)
+
+
 def setup_data(cfg: DataArgs, params, lm_cfg: LMConfig, texts, tokenizer,
                forward=None) -> dict[str, int]:
     """End-to-end orchestrator: tokenize/pack then harvest

@@ -90,6 +90,65 @@ def adam_optimizer(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> opt
     return optax.scale_by_adam(b1=b1, b2=b2, eps=eps, eps_root=0.0)
 
 
+def make_fused_tied_step(
+    optimizer: optax.GradientTransformation,
+    donate: bool = True,
+    interpret: bool = False,
+) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
+    """Fused-kernel step for identity-centered FunctionalTiedSAE buckets:
+    loss + exact grads come from one Pallas pass (ops/fused_sae.py) instead of
+    vmap(value_and_grad); the optimizer update stays vmapped optax."""
+    from sparse_coding_tpu.ops.fused_sae import fused_tied_sae_loss_and_grads
+
+    def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
+        losses, grads, activity = fused_tied_sae_loss_and_grads(
+            {"encoder": state.params["encoder"],
+             "encoder_bias": state.params["encoder_bias"]},
+            state.buffers["l1_alpha"], batch, interpret=interpret)
+        total = losses["mse"] + losses["l1"]
+
+        def member_update(g, opt_state, params, lr):
+            updates, opt_state = optimizer.update(g, opt_state, params)
+            updates = jax.tree.map(lambda u: -lr * u, updates)
+            return optax.apply_updates(params, updates), opt_state
+
+        params, opt_state = jax.vmap(member_update)(
+            grads, state.opt_state, state.params, state.lrs)
+        aux = AuxData(
+            losses={"loss": total, "l_reconstruction": losses["mse"],
+                    "l_l1": losses["l1"]},
+            l0=losses["l0"],
+            feat_activity=activity.astype(jnp.int32))
+        new_state = state.replace(params=params, opt_state=opt_state,
+                                  step=state.step + 1)
+        return new_state, aux
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def can_use_fused_tied_step(sig: Any, members, interpret: bool = False) -> bool:
+    """Fused path preconditions checkable at construction: tied SAE, identity
+    centering, zero bias decay, TPU backend (or interpret mode for tests).
+    The VMEM-fitting batch tile is checked against the REAL batch on the
+    first step (Ensemble.step_batch), not guessed here."""
+    import numpy as np
+
+    if getattr(sig, "signature_name", None) != "tied_sae":
+        return False
+    if not interpret and jax.default_backend() != "tpu":
+        return False
+    params0, _ = members[0]
+    d = params0["encoder"].shape[1]
+    for _, b in members:
+        if float(jnp.max(jnp.abs(b.get("bias_decay", jnp.zeros(()))))) != 0.0:
+            return False
+        if not (np.allclose(b["center_rot"], np.eye(d))
+                and np.allclose(b["center_trans"], 0.0)
+                and np.allclose(b["center_scale"], 1.0)):
+            return False
+    return True
+
+
 def make_train_step(
     sig: Any,
     optimizer: optax.GradientTransformation,
@@ -141,6 +200,8 @@ class Ensemble:
         adam_eps: float = 1e-8,
         mesh: Optional[Mesh] = None,
         donate: bool = True,
+        use_fused: str | bool = "auto",
+        fused_interpret: bool = False,
     ):
         if not members:
             raise ValueError("ensemble needs at least one member")
@@ -173,8 +234,30 @@ class Ensemble:
         )
         if mesh is not None:
             self.state = shard_ensemble_state(self.state, mesh)
-        self._step_fn = make_train_step(sig, self.optimizer, statics=statics0,
-                                        donate=donate)
+
+        self._standard_step = make_train_step(sig, self.optimizer,
+                                              statics=statics0, donate=donate)
+        self._fused_step = None
+        if use_fused is True:
+            # explicit request: fail fast with a clear message if ineligible
+            ok = mesh is None and can_use_fused_tied_step(
+                sig, members, interpret=fused_interpret)
+            if not ok:
+                raise ValueError(
+                    "use_fused=True requires an identity-centered tied_sae "
+                    "bucket with zero bias_decay, no mesh, and a TPU backend "
+                    "(or fused_interpret=True)")
+            self._fused_step = make_fused_tied_step(
+                self.optimizer, donate=donate, interpret=fused_interpret)
+        elif use_fused == "auto" and mesh is None and can_use_fused_tied_step(
+                sig, members, interpret=fused_interpret):
+            self._fused_step = make_fused_tied_step(
+                self.optimizer, donate=donate, interpret=fused_interpret)
+        # the fused kernel additionally needs a VMEM-fitting batch tile — only
+        # known once the real batch arrives, so the final choice happens on
+        # the first step_batch call
+        self.fused = self._fused_step is not None
+        self._step_fn = self._standard_step
 
     @property
     def n_members(self) -> int:
@@ -183,6 +266,17 @@ class Ensemble:
     def step_batch(self, batch: Array) -> AuxData:
         """One training step on a [batch, d] activation slab shared by every
         member (reference: ensemble.py:175-193). Returns stacked per-member aux."""
+        if self.fused and self._step_fn is self._standard_step:
+            # first batch: confirm the fused kernel has a VMEM-fitting tile
+            # for this batch size; otherwise quietly keep the autodiff path
+            from sparse_coding_tpu.ops.fused_sae import pick_batch_tile
+
+            n_feats = self.state.params["encoder"].shape[1]
+            d = self.state.params["encoder"].shape[2]
+            if pick_batch_tile(batch.shape[0], n_feats, d) is not None:
+                self._step_fn = self._fused_step
+            else:
+                self.fused = False
         if self.mesh is not None:
             n_data = self.mesh.shape["data"]
             if batch.shape[0] % n_data != 0:

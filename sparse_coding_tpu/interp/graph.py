@@ -1,0 +1,73 @@
+"""Config-driven ablation-graph and feature-investigation drivers.
+
+Consumers of `InterpGraphArgs` and `InvestigateArgs` (config.py) — the
+counterparts of the reference's graph-interp entry points
+(reference: config.py InterpGraphArgs:129-136, InvestigateArgs:137-143, used
+by the interp_notebooks/ workflows and the missing ioi_feature_ident.py).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional
+
+import jax
+import numpy as np
+
+from sparse_coding_tpu.config import InterpGraphArgs, InvestigateArgs
+from sparse_coding_tpu.interp.fragments import sample_fragments
+from sparse_coding_tpu.metrics.intervention import (
+    build_ablation_graph,
+    build_ablation_graph_non_positional,
+)
+from sparse_coding_tpu.utils.artifacts import load_learned_dicts
+
+
+def run_interp_graph(cfg: InterpGraphArgs, params, lm_cfg,
+                     token_rows: np.ndarray, forward=None,
+                     features_to_ablate=None, target_features=None) -> dict:
+    """Build an ablation graph between the dicts named in cfg.dict_paths
+    (one per layer in cfg.layers) and persist it as JSON."""
+    if len(cfg.dict_paths) != len(cfg.layers):
+        raise ValueError(
+            f"need one dict per layer: {len(cfg.dict_paths)} paths for "
+            f"{len(cfg.layers)} layers")
+    models = {}
+    for layer, path in zip(cfg.layers, cfg.dict_paths):
+        ld, _ = load_learned_dicts(path)[0]
+        models[(layer, cfg.layer_loc)] = ld
+
+    fragments = sample_fragments(token_rows, cfg.fragment_len, cfg.n_fragments,
+                                 seed=cfg.seed)
+    tokens = jax.numpy.asarray(fragments)
+    builder = (build_ablation_graph if cfg.positional
+               else build_ablation_graph_non_positional)
+    graph = builder(params, lm_cfg, models, tokens,
+                    features_to_ablate=features_to_ablate,
+                    target_features=target_features, forward=forward)
+
+    out = Path(cfg.output_folder)
+    out.mkdir(parents=True, exist_ok=True)
+    serializable = {repr(k): v for k, v in graph.items()}
+    (out / "ablation_graph.json").write_text(json.dumps(serializable, indent=2))
+    return graph
+
+
+def investigate_features(cfg: InvestigateArgs, params, lm_cfg,
+                         token_rows: np.ndarray, decode_token,
+                         forward=None) -> list[dict]:
+    """Deep-dive specific features of one dict: interpretation records for
+    exactly cfg.feature_indices (the single-feature investigation workflow)."""
+    from sparse_coding_tpu.config import InterpArgs
+    from sparse_coding_tpu.interp.run import run
+
+    ld, _ = load_learned_dicts(cfg.learned_dict_path)[0]
+    interp_cfg = InterpArgs(
+        model_name=cfg.model_name, layer=cfg.layer, layer_loc=cfg.layer_loc,
+        output_folder=cfg.output_folder, fragment_len=cfg.fragment_len,
+        n_fragments=cfg.n_fragments, provider="offline", seed=cfg.seed,
+        n_feats_to_explain=len(cfg.feature_indices) or 1)
+    return run(ld, interp_cfg, params, lm_cfg, token_rows, decode_token,
+               forward=forward,
+               feature_indices=cfg.feature_indices or None)

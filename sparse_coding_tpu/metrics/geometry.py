@@ -1,0 +1,102 @@
+"""Dictionary-geometry analyses: clustering and activity sweeps.
+
+Covers the remaining standard_metrics.py surface:
+- `cluster_vectors` (t-SNE + KMeans over dictionary atoms,
+  reference: standard_metrics.py:534-568),
+- `hierarchical_cluster_vectors` (reference: :570-580),
+- `activity_sweep` — the per-layer dead/active-feature census the reference
+  runs with an mp.Pool over GPUs (`calc_for_layer`/`calc_all_activities`,
+  reference: :711-756) collapsed into one jitted scan per dict,
+- `kurtosis_sweep` (reference: calc_kurtosis_for_layer/calc_all_kurtosis,
+  :758-809).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.metrics.core import calc_moments_streaming, n_ever_active
+from sparse_coding_tpu.models.learned_dict import LearnedDict
+from sparse_coding_tpu.utils.artifacts import load_learned_dicts
+
+
+def cluster_vectors(model: LearnedDict, n_clusters: int = 100,
+                    top_clusters: int = 10, perplexity: float = 30.0,
+                    seed: int = 0,
+                    save_loc: Optional[str | Path] = None) -> list[list[int]]:
+    """t-SNE embed dictionary atoms, KMeans them, return the largest clusters'
+    member indices (reference: standard_metrics.py:534-568)."""
+    from sklearn.cluster import KMeans
+    from sklearn.manifold import TSNE
+
+    d = np.asarray(jax.device_get(model.get_learned_dict()))
+    n = d.shape[0]
+    perplexity = min(perplexity, max(2.0, (n - 1) / 3))
+    emb = TSNE(n_components=2, perplexity=perplexity,
+               random_state=seed).fit_transform(d)
+    n_clusters = min(n_clusters, n)
+    km = KMeans(n_clusters=n_clusters, random_state=seed, n_init=4).fit(emb)
+    clusters: dict[int, list[int]] = {}
+    for idx, label in enumerate(km.labels_):
+        clusters.setdefault(int(label), []).append(idx)
+    largest = sorted(clusters.values(), key=len, reverse=True)[:top_clusters]
+    if save_loc is not None:
+        Path(save_loc).parent.mkdir(parents=True, exist_ok=True)
+        with open(save_loc, "w") as fh:
+            for ci, members in enumerate(largest):
+                fh.write(f"cluster {ci} (n={len(members)}): {members}\n")
+    return largest
+
+
+def hierarchical_cluster_vectors(vectors, n_clusters: int = 100) -> np.ndarray:
+    """Agglomerative clustering labels over atom vectors
+    (reference: standard_metrics.py:570-580)."""
+    from sklearn.cluster import AgglomerativeClustering
+
+    v = np.asarray(jax.device_get(vectors))
+    n_clusters = min(n_clusters, v.shape[0])
+    return AgglomerativeClustering(n_clusters=n_clusters).fit(v).labels_
+
+
+def activity_sweep(dict_files: Sequence[str | Path], activations,
+                   threshold: int = 10, batch_size: int = 1000) -> list[dict]:
+    """Ever-active feature counts for every dict across artifact files — the
+    reference's multi-GPU mp.Pool census (standard_metrics.py:711-756) as a
+    serial loop of jitted scans."""
+    acts = jnp.asarray(activations)
+    out = []
+    for path in dict_files:
+        for ld, hyper in load_learned_dicts(path):
+            out.append({
+                **{k: v for k, v in hyper.items()
+                   if isinstance(v, (int, float, str, bool))},
+                "n_ever_active": n_ever_active(ld, acts, batch_size=batch_size,
+                                               threshold=threshold),
+                "n_feats": int(ld.n_feats),
+            })
+    return out
+
+
+def kurtosis_sweep(dict_files: Sequence[str | Path], activations,
+                   batch_size: int = 1000) -> list[dict]:
+    """Per-dict feature-kurtosis summaries (reference:
+    calc_kurtosis_for_layer, standard_metrics.py:758-809)."""
+    acts = jnp.asarray(activations)
+    out = []
+    for path in dict_files:
+        for ld, hyper in load_learned_dicts(path):
+            times_active, mean, var, skew, kurt, m4 = calc_moments_streaming(
+                ld, acts, batch_size=batch_size)
+            out.append({
+                **{k: v for k, v in hyper.items()
+                   if isinstance(v, (int, float, str, bool))},
+                "mean_kurtosis": float(jnp.mean(kurt)),
+                "median_kurtosis": float(jnp.median(kurt)),
+                "mean_skew": float(jnp.mean(skew)),
+            })
+    return out

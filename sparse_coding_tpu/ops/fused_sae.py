@@ -1,0 +1,188 @@
+"""Fused tied-SAE train-step kernel (Pallas/TPU).
+
+The vmapped ensemble step's HBM traffic is dominated by the [batch, n_feats]
+code matrix: XLA materializes it in the forward, again for the ReLU mask in
+the backward, plus the reconstruction and residual — ~4 round trips of
+batch×n_feats×4B per member per step. This kernel computes the tied-SAE loss
+AND its exact parameter gradients in ONE pass per (member, batch-tile): codes,
+reconstruction, and residual live only in VMEM; HBM sees x once and the
+[n, d] gradient accumulators once.
+
+Math (matching models/sae.py FunctionalTiedSAE.loss with identity centering,
+reference: sae_ensemble.py:134-162):
+    W = E / ‖E‖₂ (rows)        (normalization grads applied OUTSIDE, cheap)
+    pre = x Wᵀ + b,  c = relu(pre),  x̂ = c W,  r = x̂ − x
+    L = mean(r²) + α·mean(Σ|c|)
+    ∂L/∂pre = (2/(B·d) · r Wᵀ + α/B) ⊙ [pre > 0]
+    ∂L/∂W   = ∂L/∂preᵀ x  +  2/(B·d) · cᵀ r
+    ∂L/∂b   = Σ_batch ∂L/∂pre
+
+Grid: (n_members, n_batch_tiles); batch tiles accumulate into member-indexed
+output blocks (TPU sequential grid revisiting). Falls back to the jax.grad
+path for shapes whose per-member working set exceeds the VMEM budget.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+VMEM_BUDGET_BYTES = 12 * 2**20  # leave headroom out of ~16 MB/core
+
+
+def _working_set(batch_tile: int, n_feats: int, d: int) -> int:
+    f32 = 4
+    return (
+        n_feats * d * f32 * 2      # W + dW accumulator
+        + batch_tile * n_feats * f32 * 2  # c and r@Wᵀ
+        + batch_tile * d * f32 * 3  # x tile, x̂, r
+        + n_feats * f32 * 2        # b, db
+    )
+
+
+def pick_batch_tile(batch: int, n_feats: int, d: int) -> Optional[int]:
+    """Largest batch tile (≥64) that fits the VMEM budget and divides the
+    batch; None if even 64 doesn't fit."""
+    for tile in (512, 256, 128, 64):
+        if batch % tile == 0 and _working_set(tile, n_feats, d) <= VMEM_BUDGET_BYTES:
+            return tile
+    return None
+
+
+def fused_supported(n_members: int, batch: int, n_feats: int, d: int) -> bool:
+    return pick_batch_tile(batch, n_feats, d) is not None
+
+
+def _kernel(alpha_ref, x_ref, w_ref, b_ref, dw_ref, db_ref, act_ref, loss_ref,
+            *, total_batch: int, d_act: int):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(1)
+    w = w_ref[0]  # [n, d]
+    xb = x_ref[...]  # [Bt, d]
+    b = b_ref[0]  # [n]
+    alpha = alpha_ref[0, 0]
+
+    pre = jnp.dot(xb, w.T, preferred_element_type=jnp.float32) + b[None, :]
+    c = jnp.maximum(pre, 0.0)
+    x_hat = jnp.dot(c, w, preferred_element_type=jnp.float32)
+    r = x_hat - xb
+
+    coef = 2.0 / (total_batch * d_act)
+    mask = (pre > 0.0).astype(jnp.float32)
+    dpre = (coef * jnp.dot(r, w.T, preferred_element_type=jnp.float32)
+            + alpha / total_batch) * mask
+    dw = (jnp.dot(dpre.T, xb, preferred_element_type=jnp.float32)
+          + coef * jnp.dot(c.T, r, preferred_element_type=jnp.float32))
+    db = jnp.sum(dpre, axis=0)
+    activity = jnp.sum(mask, axis=0)  # [n] samples activating each feature
+    mse_part = jnp.sum(r * r) / (total_batch * d_act)
+    l1_part = alpha * jnp.sum(c) / total_batch
+    l0_part = jnp.sum(mask) / total_batch
+    part = jnp.stack([mse_part, l1_part, l0_part])[None, :]
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[0] = dw
+        db_ref[0] = db
+        act_ref[0] = activity
+        loss_ref[...] = part
+
+    @pl.when(i > 0)
+    def _acc():
+        dw_ref[0] += dw
+        db_ref[0] += db
+        act_ref[0] += activity
+        loss_ref[...] += part
+
+
+@functools.partial(jax.jit, static_argnames=("batch_tile", "interpret"))
+def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
+                         batch: Array, batch_tile: int = 256,
+                         interpret: bool = False):
+    """All-member losses and gradients wrt (normalized W, bias).
+
+    Args:
+      w_normed: [N, n, d] row-normalized dictionaries.
+      bias: [N, n]; alphas: [N] l1 coefficients; batch: [B, d] shared.
+    Returns:
+      (losses {mse [N], l1 [N], l0 [N]}, dW [N, n, d], db [N, n],
+       activity [N, n] per-feature active-sample counts)
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_members, n_feats, d = w_normed.shape
+    total_batch = batch.shape[0]
+    n_tiles = total_batch // batch_tile
+    assert n_tiles * batch_tile == total_batch
+
+    kernel = functools.partial(_kernel, total_batch=total_batch, d_act=d)
+    grid = (n_members, n_tiles)
+
+    dw, db, activity, losses = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda m, i: (m, 0),
+                         memory_space=pltpu.SMEM),  # alphas [N, 1]
+            pl.BlockSpec((batch_tile, d), lambda m, i: (i, 0)),  # x
+            pl.BlockSpec((1, n_feats, d), lambda m, i: (m, 0, 0)),  # W
+            pl.BlockSpec((1, n_feats), lambda m, i: (m, 0)),  # b
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n_feats, d), lambda m, i: (m, 0, 0)),
+            pl.BlockSpec((1, n_feats), lambda m, i: (m, 0)),
+            pl.BlockSpec((1, n_feats), lambda m, i: (m, 0)),
+            pl.BlockSpec((1, 3), lambda m, i: (m, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_members, n_feats, d), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, n_feats), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, n_feats), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, 3), jnp.float32),
+        ],
+        interpret=interpret,
+    )(alphas.reshape(n_members, 1).astype(jnp.float32), batch, w_normed, bias)
+
+    loss_dict = {"mse": losses[:, 0], "l1": losses[:, 1], "l0": losses[:, 2]}
+    return loss_dict, dw, db, activity
+
+
+def normalize_with_vjp(e: Array, dw: Array, eps: float = 1e-8):
+    """Chain dL/dW (W = row-normalized E) back to dL/dE:
+    dE = (dW − Ŵ·⟨dW, Ŵ⟩_row) / ‖E‖. Cheap [N, n, d] elementwise+reduce,
+    left outside the kernel."""
+    norms = jnp.clip(jnp.linalg.norm(e, axis=-1, keepdims=True), eps)
+    w_hat = e / norms
+    radial = jnp.sum(dw * w_hat, axis=-1, keepdims=True)
+    return (dw - w_hat * radial) / norms
+
+
+def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
+                                  batch: Array, batch_tile: Optional[int] = None,
+                                  interpret: bool = False):
+    """Drop-in producer of (aux-style losses, grads wrt raw stacked params)
+    for the ensemble engine's fused path. params_stacked:
+    {"encoder": [N, n, d], "encoder_bias": [N, n]}."""
+    e = params_stacked["encoder"]
+    if batch_tile is None:
+        batch_tile = pick_batch_tile(batch.shape[0], e.shape[1], e.shape[2])
+        if batch_tile is None:
+            raise ValueError(
+                f"no VMEM-fitting batch tile for shapes n={e.shape[1]} "
+                f"d={e.shape[2]} batch={batch.shape[0]}; use the autodiff path")
+    norms = jnp.clip(jnp.linalg.norm(e, axis=-1, keepdims=True), 1e-8)
+    w_normed = e / norms
+    losses, dw, db, activity = fused_tied_sae_grads(
+        w_normed, params_stacked["encoder_bias"], alphas, batch,
+        batch_tile=batch_tile, interpret=interpret)
+    grads = {"encoder": normalize_with_vjp(e, dw),
+             "encoder_bias": db}
+    return losses, grads, activity

@@ -1,0 +1,166 @@
+"""Small render-to-image helpers used by loggers and notebooks
+(reference: standard_metrics.py:411-439 plot_hist/plot_scatter, :514-531
+plot_grid, :364-408 capacity plots; plotting/plot_kl_div.py,
+plotting/bottleneck_plot.py)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+def _fig_to_array(fig) -> np.ndarray:
+    """Rasterize a figure to an RGB array (the reference renders to PIL for
+    wandb image panels, standard_metrics.py:418-424)."""
+    fig.canvas.draw()
+    buf = np.asarray(fig.canvas.buffer_rgba())
+    return buf[..., :3].copy()
+
+
+def _new_fig(**kwargs):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt, plt.subplots(**kwargs)
+
+
+def plot_hist(scores, x_label: str = "", y_label: str = "", bins: int = 50,
+              save_path: Optional[str | Path] = None, **kwargs) -> np.ndarray:
+    """(reference: standard_metrics.py:411-424)."""
+    plt, (fig, ax) = _new_fig(figsize=(5, 4))
+    ax.hist(np.asarray(jax.device_get(scores)).ravel(), bins=bins, **kwargs)
+    ax.set_xlabel(x_label)
+    ax.set_ylabel(y_label)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=120)
+    img = _fig_to_array(fig)
+    plt.close(fig)
+    return img
+
+
+def plot_scatter(scores_x, scores_y, x_label: str = "", y_label: str = "",
+                 save_path: Optional[str | Path] = None, **kwargs) -> np.ndarray:
+    """(reference: standard_metrics.py:426-439)."""
+    plt, (fig, ax) = _new_fig(figsize=(5, 4))
+    ax.scatter(np.asarray(jax.device_get(scores_x)).ravel(),
+               np.asarray(jax.device_get(scores_y)).ravel(), s=6, **kwargs)
+    ax.set_xlabel(x_label)
+    ax.set_ylabel(y_label)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=120)
+    img = _fig_to_array(fig)
+    plt.close(fig)
+    return img
+
+
+def plot_grid(scores: np.ndarray, first_tick_labels, second_tick_labels,
+              first_label: str, second_label: str,
+              save_path: Optional[str | Path] = None, **kwargs) -> np.ndarray:
+    """Annotated heatmap (reference: standard_metrics.py:514-531)."""
+    plt, (fig, ax) = _new_fig(figsize=(6, 5))
+    im = ax.imshow(np.asarray(scores), origin="lower", aspect="auto", **kwargs)
+    ax.set_xticks(range(len(first_tick_labels)), first_tick_labels,
+                  rotation=45, fontsize=7)
+    ax.set_yticks(range(len(second_tick_labels)), second_tick_labels, fontsize=7)
+    ax.set_xlabel(first_label)
+    ax.set_ylabel(second_label)
+    fig.colorbar(im)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=120)
+    img = _fig_to_array(fig)
+    plt.close(fig)
+    return img
+
+
+def plot_capacities(dicts: List[Tuple[Any, Dict]], save_path: Optional[str | Path] = None):
+    """Capacity distribution per dict (reference: standard_metrics.py:364-381)."""
+    from sparse_coding_tpu.metrics.core import capacity_per_feature
+
+    plt, (fig, ax) = _new_fig(figsize=(7, 5))
+    for ld, hyper in dicts:
+        caps = np.sort(np.asarray(jax.device_get(capacity_per_feature(ld))))[::-1]
+        label = ", ".join(f"{k}={v:.2g}" if isinstance(v, float) else f"{k}={v}"
+                          for k, v in hyper.items()
+                          if isinstance(v, (int, float)))
+        ax.plot(caps, label=label)
+    ax.set_xlabel("feature rank")
+    ax.set_ylabel("capacity")
+    ax.legend(fontsize=7)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=120)
+    img = _fig_to_array(fig)
+    plt.close(fig)
+    return img
+
+
+def plot_capacity_scatter(dicts: List[Tuple[Any, Dict]], eval_batch,
+                          save_path: Optional[str | Path] = None):
+    """Capacity vs firing frequency per feature
+    (reference: standard_metrics.py:382-408)."""
+    from sparse_coding_tpu.metrics.core import (
+        capacity_per_feature,
+        mean_nonzero_activations,
+    )
+
+    plt, (fig, ax) = _new_fig(figsize=(6, 5))
+    for ld, hyper in dicts:
+        caps = np.asarray(jax.device_get(capacity_per_feature(ld)))
+        freq = np.asarray(jax.device_get(mean_nonzero_activations(ld, eval_batch)))
+        ax.scatter(freq, caps, s=4, alpha=0.5,
+                   label=str(hyper.get("l1_alpha", "")))
+    ax.set_xlabel("firing frequency")
+    ax.set_ylabel("capacity")
+    ax.set_xscale("symlog", linthresh=1e-4)
+    ax.legend(fontsize=7)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=120)
+    img = _fig_to_array(fig)
+    plt.close(fig)
+    return img
+
+
+def plot_kl_div(records: Sequence[dict], x_key: str = "l0", kl_key: str = "kl",
+                save_path: Optional[str | Path] = None):
+    """KL-divergence-under-patching curves (reference: plotting/plot_kl_div.py)."""
+    plt, (fig, ax) = _new_fig(figsize=(6, 4))
+    pts = sorted(records, key=lambda r: r[x_key])
+    ax.plot([p[x_key] for p in pts], [p[kl_key] for p in pts], marker="o")
+    ax.set_xlabel(x_key)
+    ax.set_ylabel("KL divergence")
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=120)
+    img = _fig_to_array(fig)
+    plt.close(fig)
+    return img
+
+
+def bottleneck_plot(series: Dict[str, Sequence[Tuple[float, float]]],
+                    x_label: str = "bottleneck size", y_label: str = "metric",
+                    save_path: Optional[str | Path] = None):
+    """Metric-vs-bottleneck-size comparison (reference:
+    plotting/bottleneck_plot.py)."""
+    plt, (fig, ax) = _new_fig(figsize=(6, 4))
+    for name, pts in sorted(series.items()):
+        pts = sorted(pts)
+        ax.plot([p[0] for p in pts], [p[1] for p in pts], marker="o", label=name)
+    ax.set_xlabel(x_label)
+    ax.set_ylabel(y_label)
+    ax.set_xscale("log")
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=120)
+    img = _fig_to_array(fig)
+    plt.close(fig)
+    return img

@@ -1,0 +1,85 @@
+"""Chunk dispatch: drive many ensembles through one in-RAM chunk.
+
+API-parity layer for the reference's scheduler (reference:
+cluster_runs.py:100-157 `dispatch_job_on_chunk`, :50-98
+`dispatch_lite`/`collect_lite`). The reference pins the chunk into POSIX
+shared memory and forks one OS process per ensemble/GPU; on TPU the same
+concurrency comes from XLA's async dispatch — each ensemble's jitted step is
+enqueued without blocking the host, so interleaving step calls pipelines all
+ensembles on the device with zero processes. These helpers keep the
+reference's call shape (incl. the non-blocking lite variant) for users
+porting scripts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from sparse_coding_tpu.data.chunk_store import device_prefetch, shuffled_batches
+from sparse_coding_tpu.ensemble import Ensemble, EnsembleGroup
+
+
+def dispatch_job_on_chunk(ensembles: Sequence[Ensemble | EnsembleGroup],
+                          chunk: np.ndarray, batch_size: int = 1024,
+                          seed: int = 0, sharding=None,
+                          progress: Optional[Callable[[int, int], None]] = None
+                          ) -> dict[str, Any]:
+    """Train every ensemble over one shuffled pass of the chunk; blocks until
+    all device work is done (the reference's join, cluster_runs.py:145-157).
+    Returns the last aux per ensemble index."""
+    rng = np.random.default_rng(seed)
+    total = (chunk.shape[0] // batch_size)
+    last_aux: dict[str, Any] = {}
+    for i, batch in enumerate(device_prefetch(
+            shuffled_batches(chunk, batch_size, rng), sharding)):
+        for j, ens in enumerate(ensembles):
+            last_aux[str(j)] = ens.step_batch(batch)  # async dispatch
+        if progress is not None:
+            progress(i + 1, total)
+    # barrier: materialize the final losses (join-equivalent)
+    for aux in last_aux.values():
+        if isinstance(aux, dict):
+            for a in aux.values():
+                jax.block_until_ready(a.losses["loss"])
+        else:
+            jax.block_until_ready(aux.losses["loss"])
+    return last_aux
+
+
+class LiteJob:
+    """Non-blocking handle (reference: dispatch_lite/collect_lite,
+    cluster_runs.py:50-98): work is enqueued asynchronously; `collect()` is
+    the barrier."""
+
+    def __init__(self, ensembles, last_aux):
+        self.ensembles = ensembles
+        self.last_aux = last_aux
+
+    def collect(self):
+        for aux in self.last_aux.values():
+            if isinstance(aux, dict):
+                for a in aux.values():
+                    jax.block_until_ready(a.losses["loss"])
+            else:
+                jax.block_until_ready(aux.losses["loss"])
+        return self.last_aux
+
+
+def dispatch_lite(ensembles: Sequence[Ensemble | EnsembleGroup],
+                  chunk: np.ndarray, batch_size: int = 1024,
+                  seed: int = 0, sharding=None) -> LiteJob:
+    """Enqueue a full chunk pass without waiting (device work proceeds while
+    the host e.g. loads the next chunk)."""
+    rng = np.random.default_rng(seed)
+    last_aux: dict[str, Any] = {}
+    for batch in device_prefetch(shuffled_batches(chunk, batch_size, rng), sharding):
+        for j, ens in enumerate(ensembles):
+            last_aux[str(j)] = ens.step_batch(batch)
+    return LiteJob(ensembles, last_aux)
+
+
+def collect_lite(job: LiteJob):
+    return job.collect()

@@ -133,6 +133,28 @@ def long_l1_range_experiment(cfg: EnsembleArgs, mesh=None,
                                      activation_dim=activation_dim)
 
 
+def residual_denoising_experiment(cfg: EnsembleArgs, mesh=None,
+                                  l1_range: Optional[Sequence[float]] = None,
+                                  n_hidden_layers: int = 2,
+                                  activation_dim: Optional[int] = None):
+    """LISTA-denoising encoder sweep
+    (reference: big_sweep_experiments.py:341-433)."""
+    from sparse_coding_tpu.models.lista import FunctionalLISTADenoisingSAE
+
+    l1s = list(l1_range if l1_range is not None else np.logspace(-4, -2, 8))
+    d = activation_dim or _activation_dim(cfg)
+    n_dict = int(d * cfg.learned_dict_ratio)
+    keys = jax.random.split(jax.random.PRNGKey(cfg.seed), len(l1s))
+    members = [FunctionalLISTADenoisingSAE.init(
+        k, d, n_dict, l1_alpha=float(l1), n_hidden_layers=n_hidden_layers)
+        for k, l1 in zip(keys, l1s)]
+    group = EnsembleGroup.build(FunctionalLISTADenoisingSAE, members,
+                                lr=cfg.lr, mesh=mesh)
+    hypers = [{"l1_alpha": float(l1), "dict_size": n_dict,
+               "n_hidden_layers": n_hidden_layers} for l1 in l1s]
+    return [(group, hypers, "residual_denoising")]
+
+
 EXPERIMENTS = {
     "dense_l1_range": dense_l1_range_experiment,
     "tied_vs_not": tied_vs_not_experiment,
@@ -140,8 +162,75 @@ EXPERIMENTS = {
     "dict_ratio": dict_ratio_experiment,
     "zero_l1_baseline": zero_l1_baseline_experiment,
     "long_l1_range": long_l1_range_experiment,
+    "residual_denoising": residual_denoising_experiment,
 }
 
 
 def get_experiment(name: str):
     return EXPERIMENTS[name]
+
+
+# ---------------------------------------------------------------------------
+# Concrete launchers: named configurations binding the reference's canonical
+# scales (reference: big_sweep_experiments.py:435-1280 run_* functions).
+# Each returns (experiment_fn, EnsembleArgs) ready for train.sweep.sweep().
+# ---------------------------------------------------------------------------
+
+def _cfg(model_name: str, layer: int, layer_loc: str, ratio: float,
+         tied: bool = True, n_chunks: int = 10, **overrides) -> EnsembleArgs:
+    base = dict(
+        output_folder=f"output_{model_name.split('/')[-1]}_{layer_loc}_l{layer}_r{ratio:g}",
+        dataset_folder=f"activation_data/{layer_loc}.{layer}",
+        layer=layer, layer_loc=layer_loc, learned_dict_ratio=ratio,
+        tied_ae=tied, batch_size=1024, lr=1e-3, n_chunks=n_chunks)
+    base.update(overrides)
+    return EnsembleArgs(**base)
+
+
+def run_pythia70m_resid(layer: int = 2, ratio: float = 4.0):
+    """Pythia-70M residual sweep — the paper's canonical config
+    (reference: big_sweep_experiments.py:620-676)."""
+    return dense_l1_range_experiment, _cfg("EleutherAI/pythia-70m-deduped",
+                                           layer, "residual", ratio)
+
+
+def run_pythia70m_mlp(layer: int = 2, ratio: float = 4.0):
+    return dense_l1_range_experiment, _cfg("EleutherAI/pythia-70m-deduped",
+                                           layer, "mlp", ratio)
+
+
+def run_pythia410m_mlpout_topk(layer: int = 12):
+    """Pythia-410M MLP-out TopK sweep (BASELINE.json config #3)."""
+    return topk_experiment, _cfg("EleutherAI/pythia-410m-deduped", layer,
+                                 "mlpout", 4.0)
+
+
+def run_pythia14b_resid(layer: int = 6, ratio: float = 6.0):
+    """Largest reference sweep: Pythia-1.4B residual
+    (reference: big_sweep_experiments.py:851-907)."""
+    return dense_l1_range_experiment, _cfg("EleutherAI/pythia-1.4b-deduped",
+                                           layer, "residual", ratio,
+                                           n_chunks=30, n_repetitions=10)
+
+
+def run_gpt2sm_resid(layer: int = 0, ratio: float = 32.0):
+    """GPT-2-small residual sweeps at ratios 32/64/96
+    (reference: big_sweep_experiments.py:1239-1269)."""
+    return dense_l1_range_experiment, _cfg("gpt2", layer, "residual", ratio)
+
+
+def run_dict_ratio_series(layer: int = 2):
+    """Masked mixed-size series 0.5-32x (reference:
+    big_sweep_experiments.py:543-618 + standard_metrics.py:745 ratios)."""
+    return dict_ratio_experiment, _cfg("EleutherAI/pythia-70m-deduped",
+                                       layer, "residual", 32.0)
+
+
+LAUNCHERS = {
+    "pythia70m_resid": run_pythia70m_resid,
+    "pythia70m_mlp": run_pythia70m_mlp,
+    "pythia410m_mlpout_topk": run_pythia410m_mlpout_topk,
+    "pythia14b_resid": run_pythia14b_resid,
+    "gpt2sm_resid": run_gpt2sm_resid,
+    "dict_ratio_series": run_dict_ratio_series,
+}

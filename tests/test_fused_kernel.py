@@ -1,0 +1,115 @@
+"""Fused tied-SAE kernel vs the autodiff reference path (Pallas interpret
+mode on the CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.ensemble import Ensemble
+from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+from sparse_coding_tpu.models.signatures import make_aux
+from sparse_coding_tpu.ops.fused_sae import (
+    fused_supported,
+    fused_tied_sae_loss_and_grads,
+)
+from sparse_coding_tpu.utils.trees import stack_trees
+
+N_MEMBERS, N_FEATS, D, BATCH = 3, 64, 32, 512
+
+
+def _stacked_members(key):
+    keys = jax.random.split(key, N_MEMBERS)
+    l1s = [1e-4, 1e-3, 3e-3]
+    members = [FunctionalTiedSAE.init(k, D, N_FEATS, l1_alpha=l1)
+               for k, l1 in zip(keys, l1s)]
+    params = stack_trees([p for p, _ in members])
+    alphas = jnp.asarray(l1s)
+    return members, params, alphas
+
+
+def test_fused_matches_autodiff(rng):
+    k_init, k_data = jax.random.split(rng)
+    members, params, alphas = _stacked_members(k_init)
+    batch = jax.random.normal(k_data, (BATCH, D))
+
+    losses, grads, activity = fused_tied_sae_loss_and_grads(
+        params, alphas, batch, batch_tile=128, interpret=True)
+
+    # reference: vmapped autodiff through the signature loss
+    def member_loss(p, buffers, x):
+        return FunctionalTiedSAE.loss(p, buffers, x)
+
+    buffers = stack_trees([b for _, b in members])
+    (ref_loss, ref_aux), ref_grads = jax.vmap(
+        jax.value_and_grad(member_loss, has_aux=True), in_axes=(0, 0, None)
+    )(params, buffers, batch)
+
+    total = losses["mse"] + losses["l1"]
+    np.testing.assert_allclose(np.asarray(total), np.asarray(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(losses["mse"]),
+                               np.asarray(ref_aux.losses["l_reconstruction"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(losses["l0"]), np.asarray(ref_aux.l0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(activity),
+                               np.asarray(ref_aux.feat_activity), atol=0.5)
+    for name in ("encoder", "encoder_bias"):
+        np.testing.assert_allclose(np.asarray(grads[name]),
+                                   np.asarray(ref_grads[name]),
+                                   rtol=2e-4, atol=1e-6,
+                                   err_msg=f"grad mismatch: {name}")
+
+
+def test_fused_training_matches_standard(rng):
+    """Whole fused training runs track the autodiff path step-for-step."""
+    k_init, k_data = jax.random.split(rng)
+    keys = jax.random.split(k_init, 2)
+    members = [FunctionalTiedSAE.init(k, D, N_FEATS, l1_alpha=1e-3)
+               for k in keys]
+    batch = jax.random.normal(k_data, (512, D))
+
+    fused = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=True,
+                     fused_interpret=True, donate=False)
+    standard = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=False,
+                        donate=False)
+    assert fused.fused and not standard.fused
+    for _ in range(5):
+        aux_f = fused.step_batch(batch)
+        aux_s = standard.step_batch(batch)
+    np.testing.assert_allclose(np.asarray(aux_f.losses["loss"]),
+                               np.asarray(aux_s.losses["loss"]),
+                               rtol=1e-4)
+    p_f = jax.device_get(fused.state.params)
+    p_s = jax.device_get(standard.state.params)
+    for name in p_f:
+        np.testing.assert_allclose(p_f[name], p_s[name], rtol=1e-4, atol=1e-6,
+                                   err_msg=f"param drift: {name}")
+
+
+def test_fused_auto_gating(rng):
+    """auto mode stays off on CPU backend / non-identity centering."""
+    keys = jax.random.split(rng, 2)
+    members = [FunctionalTiedSAE.init(k, D, N_FEATS, l1_alpha=1e-3)
+               for k in keys]
+    ens = Ensemble(members, FunctionalTiedSAE)  # auto, cpu backend
+    assert not ens.fused
+
+    centered = [FunctionalTiedSAE.init(k, D, N_FEATS, l1_alpha=1e-3,
+                                       translation=jnp.ones(D))
+                for k in keys]
+    from sparse_coding_tpu.ensemble import can_use_fused_tied_step
+
+    assert not can_use_fused_tied_step(FunctionalTiedSAE, centered,
+                                       interpret=True)
+    assert can_use_fused_tied_step(FunctionalTiedSAE, members, interpret=True)
+
+
+def test_fused_supported_budget():
+    from sparse_coding_tpu.ops.fused_sae import pick_batch_tile
+
+    assert fused_supported(32, 2048, 2048, 512)  # bench config fits (tile 128)
+    assert pick_batch_tile(2048, 2048, 512) == 128
+    assert not fused_supported(1, 2048, 65536, 2048)  # too big for VMEM
+    assert not fused_supported(1, 1000, 64, 32)  # no dividing tile

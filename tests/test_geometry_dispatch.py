@@ -1,0 +1,138 @@
+"""Geometry metrics, dispatch helpers, plot helpers, ops utilities."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.ensemble import Ensemble
+from sparse_coding_tpu.models import TiedSAE
+from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+
+
+@pytest.fixture
+def dict_file(tmp_path, rng):
+    p, b = FunctionalTiedSAE.init(rng, 16, 32, l1_alpha=1e-3)
+    save_learned_dicts([(FunctionalTiedSAE.to_learned_dict(p, b),
+                         {"l1_alpha": 1e-3})], tmp_path / "d.pkl")
+    return tmp_path / "d.pkl"
+
+
+def test_cluster_vectors(rng, tmp_path):
+    from sparse_coding_tpu.metrics.geometry import cluster_vectors
+
+    ld = TiedSAE(dictionary=jax.random.normal(rng, (40, 16)),
+                 encoder_bias=jnp.zeros(40))
+    clusters = cluster_vectors(ld, n_clusters=5, top_clusters=3,
+                               save_loc=tmp_path / "clusters.txt")
+    assert len(clusters) == 3
+    assert (tmp_path / "clusters.txt").exists()
+    all_members = [i for c in clusters for i in c]
+    assert len(set(all_members)) == len(all_members)
+
+
+def test_hierarchical_clustering(rng):
+    from sparse_coding_tpu.metrics.geometry import hierarchical_cluster_vectors
+
+    labels = hierarchical_cluster_vectors(jax.random.normal(rng, (30, 8)),
+                                          n_clusters=4)
+    assert labels.shape == (30,)
+    assert len(set(labels)) == 4
+
+
+def test_activity_and_kurtosis_sweeps(dict_file, rng):
+    from sparse_coding_tpu.metrics.geometry import activity_sweep, kurtosis_sweep
+
+    acts = jax.random.normal(rng, (4000, 16))
+    act_recs = activity_sweep([dict_file], acts, threshold=5)
+    assert act_recs[0]["n_ever_active"] <= act_recs[0]["n_feats"]
+    kurt_recs = kurtosis_sweep([dict_file], acts)
+    assert np.isfinite(kurt_recs[0]["mean_kurtosis"])
+
+
+def test_dispatch_job_on_chunk(rng):
+    from sparse_coding_tpu.train.dispatch import (
+        collect_lite,
+        dispatch_job_on_chunk,
+        dispatch_lite,
+    )
+
+    keys = jax.random.split(rng, 2)
+    ens_a = Ensemble([FunctionalTiedSAE.init(keys[0], 16, 32, l1_alpha=1e-3)],
+                     FunctionalTiedSAE)
+    ens_b = Ensemble([FunctionalTiedSAE.init(keys[1], 16, 32, l1_alpha=1e-4)],
+                     FunctionalTiedSAE)
+    chunk = np.random.default_rng(0).normal(size=(512, 16)).astype(np.float32)
+
+    progress_calls = []
+    aux = dispatch_job_on_chunk([ens_a, ens_b], chunk, batch_size=128,
+                                progress=lambda i, n: progress_calls.append((i, n)))
+    assert set(aux) == {"0", "1"}
+    assert progress_calls[-1] == (4, 4)
+
+    job = dispatch_lite([ens_a], chunk, batch_size=128)
+    out = collect_lite(job)
+    assert "0" in out
+
+
+def test_plot_helpers(rng, tmp_path, dict_file):
+    from sparse_coding_tpu.plotting.helpers import (
+        bottleneck_plot,
+        plot_capacities,
+        plot_grid,
+        plot_hist,
+        plot_kl_div,
+        plot_scatter,
+    )
+    from sparse_coding_tpu.utils.artifacts import load_learned_dicts
+
+    img = plot_hist(jax.random.normal(rng, (100,)), "x", "count")
+    assert img.ndim == 3 and img.shape[-1] == 3
+    img = plot_scatter(jnp.arange(10.0), jnp.arange(10.0) ** 2, "x", "y")
+    assert img.shape[-1] == 3
+    img = plot_grid(np.eye(3), ["a", "b", "c"], ["d", "e", "f"], "X", "Y")
+    assert img.shape[-1] == 3
+    dicts = load_learned_dicts(dict_file)
+    img = plot_capacities(dicts, save_path=tmp_path / "cap.png")
+    assert (tmp_path / "cap.png").exists()
+    plot_kl_div([{"l0": 1, "kl": 0.5}, {"l0": 4, "kl": 0.2}])
+    bottleneck_plot({"sae": [(8, 0.1), (32, 0.05)]})
+
+
+def test_ops_utilities(tmp_path):
+    from sparse_coding_tpu.utils.ops import dotdict, load_secrets, sync
+
+    d = dotdict({"a": 1})
+    assert d.a == 1
+    d.b = 2
+    assert d["b"] == 2
+    assert load_secrets(tmp_path / "missing.json") == {}
+    (tmp_path / "secrets.json").write_text('{"wandb_key": "k"}')
+    assert load_secrets(tmp_path / "secrets.json")["wandb_key"] == "k"
+    cmd = sync("host", local_dir=tmp_path, dry_run=True, port=2222)
+    assert "rsync" in cmd[0] and "-e" in cmd
+
+
+def test_make_one_chunk_per_layer(tmp_path):
+    from sparse_coding_tpu.data.chunk_store import ChunkStore
+    from sparse_coding_tpu.data.harvest import make_one_chunk_per_layer
+    from sparse_coding_tpu.lm import gptneox
+    from sparse_coding_tpu.lm.model_config import tiny_test_config
+
+    cfg = tiny_test_config("gptneox")
+    params = gptneox.init_params(jax.random.PRNGKey(0), cfg)
+    rows = np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 16))
+    out = make_one_chunk_per_layer(params, cfg, rows, [0, 1], "residual",
+                                   tmp_path, forward=gptneox.forward)
+    assert out == {"residual.0": 1, "residual.1": 1}
+    assert ChunkStore(tmp_path / "residual.0").n_chunks == 1
+
+
+def test_launchers_registry():
+    from sparse_coding_tpu.train.experiments import LAUNCHERS
+
+    fn, cfg = LAUNCHERS["pythia70m_resid"]()
+    assert cfg.layer_loc == "residual" and cfg.learned_dict_ratio == 4.0
+    fn, cfg = LAUNCHERS["pythia14b_resid"]()
+    assert cfg.n_chunks == 30 and cfg.n_repetitions == 10

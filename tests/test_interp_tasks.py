@@ -180,3 +180,47 @@ def test_feature_erasure_curve(rng):
     assert mags[0] == 0.0 and mags[-1] > 0.0
     base = leace_baseline(x, labels)
     assert base["auroc"] < 0.7
+
+
+def test_interp_graph_driver(tmp_path, tiny_lm):
+    from sparse_coding_tpu.config import InterpGraphArgs
+    from sparse_coding_tpu.interp.graph import run_interp_graph
+    from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+
+    params, lm_cfg = tiny_lm
+    paths = []
+    for i in range(2):
+        ld = RandomDict.create(jax.random.PRNGKey(i), lm_cfg.d_model, 4)
+        p = tmp_path / f"d{i}.pkl"
+        save_learned_dicts([(ld, {})], p)
+        paths.append(str(p))
+    cfg = InterpGraphArgs(layers=[0, 2], dict_paths=paths,
+                          output_folder=str(tmp_path / "graph"),
+                          n_fragments=4, fragment_len=8)
+    rows = np.random.default_rng(0).integers(0, lm_cfg.vocab_size, (8, 16))
+    graph = run_interp_graph(cfg, params, lm_cfg, rows,
+                             forward=gptneox.forward,
+                             features_to_ablate={(0, "residual"): [0]},
+                             target_features={(2, "residual"): [0, 1]})
+    assert len(graph) > 0
+    assert (tmp_path / "graph" / "ablation_graph.json").exists()
+
+
+def test_investigate_features_driver(tmp_path, tiny_lm):
+    from sparse_coding_tpu.config import InvestigateArgs
+    from sparse_coding_tpu.interp.graph import investigate_features
+    from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+
+    params, lm_cfg = tiny_lm
+    ld = RandomDict.create(jax.random.PRNGKey(0), lm_cfg.d_model, 8)
+    p = tmp_path / "d.pkl"
+    save_learned_dicts([(ld, {})], p)
+    cfg = InvestigateArgs(layer=1, learned_dict_path=str(p),
+                          feature_indices=[2, 5], n_fragments=16,
+                          fragment_len=8,
+                          output_folder=str(tmp_path / "inv"))
+    rows = np.random.default_rng(0).integers(0, lm_cfg.vocab_size, (32, 16))
+    recs = investigate_features(cfg, params, lm_cfg, rows,
+                                decode_token=lambda t: f"t{t}",
+                                forward=gptneox.forward)
+    assert [r["feature"] for r in recs] == [2, 5]
