@@ -1,0 +1,123 @@
+// Native chunk-file IO for the activation store.
+//
+// The framework's runtime-around-the-compute is native where it matters:
+// activation chunks are multi-GB files (reference geometry: 2 GB fp16,
+// activation_dataset.py:25-27) and single-threaded np.load leaves disk /
+// page-cache bandwidth on the table while the TPU waits between chunks.
+// This library provides:
+//   - parallel_read: T-way threaded pread into a caller-owned buffer
+//     (each thread owns a disjoint range; pread is thread-safe);
+//   - a background prefetch handle (start/wait) so the NEXT chunk streams
+//     from disk while the current one trains — the host-side half of the
+//     double-buffering whose device half is data/chunk_store.py's
+//     device_prefetch.
+//
+// Exposed via a plain C ABI for ctypes (no pybind11 in this image).
+// Build: g++ -O3 -shared -fPIC -std=c++17 chunkio.cpp -o libchunkio.so -lpthread
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <string>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+// Read [offset, offset+size) of fd into dst using nthreads parallel pread
+// ranges. Returns bytes read (== size on success), -1 on error.
+int64_t parallel_pread(int fd, char *dst, int64_t offset, int64_t size,
+                       int nthreads) {
+  if (size <= 0) return 0;
+  if (nthreads < 1) nthreads = 1;
+  const int64_t min_per_thread = 4 << 20;  // don't spawn threads for small IO
+  int64_t want = (size + min_per_thread - 1) / min_per_thread;
+  if (want < nthreads) nthreads = static_cast<int>(want);
+
+  std::atomic<int64_t> total{0};
+  std::atomic<bool> failed{false};
+  std::vector<std::thread> threads;
+  int64_t per = size / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    int64_t lo = t * per;
+    int64_t hi = (t == nthreads - 1) ? size : lo + per;
+    threads.emplace_back([&, lo, hi]() {
+      int64_t pos = lo;
+      while (pos < hi && !failed.load(std::memory_order_relaxed)) {
+        ssize_t n = pread(fd, dst + pos, hi - pos, offset + pos);
+        if (n <= 0) {
+          failed.store(true, std::memory_order_relaxed);
+          return;
+        }
+        pos += n;
+        total.fetch_add(n, std::memory_order_relaxed);
+      }
+    });
+  }
+  for (auto &th : threads) th.join();
+  return failed.load() ? -1 : total.load();
+}
+
+struct PrefetchJob {
+  std::thread worker;
+  std::atomic<int64_t> result{0};
+};
+
+}  // namespace
+
+extern "C" {
+
+// Synchronous parallel read of a file range into dst.
+int64_t chunkio_read(const char *path, char *dst, int64_t offset, int64_t size,
+                     int nthreads) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  int64_t n = parallel_pread(fd, dst, offset, size, nthreads);
+  close(fd);
+  return n;
+}
+
+int64_t chunkio_file_size(const char *path) {
+  struct stat st;
+  if (stat(path, &st) != 0) return -1;
+  return static_cast<int64_t>(st.st_size);
+}
+
+// Start reading [offset, offset+size) of path on a background thread DIRECTLY
+// into dst (caller-owned, e.g. a numpy buffer that must stay alive until
+// wait/cancel) — zero-copy handoff. Returns an opaque handle (NULL on error).
+void *chunkio_prefetch_start(const char *path, char *dst, int64_t offset,
+                             int64_t size, int nthreads) {
+  auto *job = new PrefetchJob();
+  std::string path_copy(path);
+  job->worker = std::thread([job, path_copy, dst, offset, size, nthreads]() {
+    int fd = open(path_copy.c_str(), O_RDONLY);
+    if (fd < 0) {
+      job->result.store(-1);
+      return;
+    }
+    int64_t n = parallel_pread(fd, dst, offset, size, nthreads);
+    close(fd);
+    job->result.store(n == size ? n : -1);
+  });
+  return job;
+}
+
+// Block until the prefetch finishes (data is already in the caller's dst).
+// Frees the handle. Returns bytes read, -1 on error.
+int64_t chunkio_prefetch_wait(void *handle) {
+  auto *job = static_cast<PrefetchJob *>(handle);
+  job->worker.join();
+  int64_t result = job->result.load();
+  delete job;
+  return result;
+}
+
+// Abandon a prefetch (still joins the worker so dst outlives all writes).
+void chunkio_prefetch_cancel(void *handle) { chunkio_prefetch_wait(handle); }
+
+}  // extern "C"

@@ -112,19 +112,12 @@ class ChunkStore:
         return len(self.chunk_paths)
 
     def load_chunk(self, i: int, dtype=np.float32) -> np.ndarray:
-        raw = np.load(self.chunk_paths[i])
-        if raw.dtype == np.uint16:
-            # bfloat16 chunks are stored as uint16 bit patterns; without the
-            # meta.json dtype tag (e.g. a crash before finalize()) the values
-            # would be silently garbage — fail loudly instead
-            if self.meta.get("dtype") != "bfloat16":
-                raise ValueError(
-                    f"{self.chunk_paths[i]} holds uint16 (bfloat16 bit "
-                    "patterns) but meta.json is missing or lacks "
-                    "dtype=bfloat16 — likely an interrupted harvest; re-run "
-                    "it or write meta.json by hand")
-            raw = raw.view(jnp.bfloat16)
-        return raw.astype(dtype)
+        from sparse_coding_tpu.data.native_io import read_npy_native
+
+        raw = read_npy_native(self.chunk_paths[i])
+        if raw is None:  # no compiler / native lib: plain numpy IO
+            raw = np.load(self.chunk_paths[i])
+        return self._finish_raw(raw, dtype, self.chunk_paths[i])
 
     def chunk_mean(self, i: int = 0) -> np.ndarray:
         """Mean of one chunk — the reference's first-chunk centering
@@ -137,15 +130,44 @@ class ChunkStore:
         BatchSampler(RandomSampler), cluster_runs.py:26-32)."""
         return shuffled_batches(chunk, batch_size, rng, drop_last)
 
+    def _finish_raw(self, raw: np.ndarray, dtype, path) -> np.ndarray:
+        """Single dtype gate for BOTH the numpy and native-prefetch paths:
+        uint16 data is bfloat16 bit patterns only if meta.json says so —
+        otherwise fail loudly (likely an interrupted harvest)."""
+        if raw.dtype == np.uint16:
+            if self.meta.get("dtype") != "bfloat16":
+                raise ValueError(
+                    f"{path} holds uint16 (bfloat16 bit patterns) but "
+                    "meta.json is missing or lacks dtype=bfloat16 — likely an "
+                    "interrupted harvest; re-run it or write meta.json by hand")
+            raw = raw.view(jnp.bfloat16)
+        return raw.astype(dtype)
+
     def epoch(self, batch_size: int, rng: np.random.Generator,
               n_repetitions: int = 1, dtype=np.float32) -> Iterator[np.ndarray]:
         """Stream batches over all chunks, chunk order shuffled per repetition
-        (reference: big_sweep.py:349-357)."""
+        (reference: big_sweep.py:349-357). The NEXT chunk's file streams from
+        disk on native background threads while the current chunk trains
+        (native/chunkio.cpp; silently sequential without it)."""
+        from sparse_coding_tpu.data.native_io import NativePrefetcher
+
         order = np.concatenate([rng.permutation(self.n_chunks)
                                 for _ in range(n_repetitions)])
-        for ci in order:
-            chunk = self.load_chunk(int(ci), dtype)
-            yield from self.batches(chunk, batch_size, rng)
+        prefetcher = NativePrefetcher()
+        try:
+            prefetching = prefetcher.start(self.chunk_paths[int(order[0])])
+            for pos, ci in enumerate(order):
+                path = self.chunk_paths[int(ci)]
+                raw = prefetcher.wait() if prefetching else None
+                chunk = (self._finish_raw(raw, dtype, path) if raw is not None
+                         else self.load_chunk(int(ci), dtype))
+                if pos + 1 < len(order):
+                    prefetching = prefetcher.start(
+                        self.chunk_paths[int(order[pos + 1])])
+                yield from self.batches(chunk, batch_size, rng)
+        finally:
+            # early generator exit must not leak the in-flight native read
+            prefetcher.cancel()
 
 
 def shuffled_batches(chunk: np.ndarray, batch_size: int,

@@ -1,0 +1,146 @@
+"""ctypes bindings for the native chunk-IO library (native/chunkio.cpp).
+
+Auto-builds `libchunkio.so` with g++ on first use (cached next to the
+source); everything degrades gracefully to numpy IO when no compiler is
+available, so the native layer is a pure acceleration, never a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libchunkio.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+DEFAULT_THREADS = 8
+
+
+def _build() -> bool:
+    src = _NATIVE_DIR / "chunkio.cpp"
+    if not src.exists():
+        return False
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(src),
+           "-o", str(_LIB_PATH), "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it if needed; None if unavailable."""
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if not _LIB_PATH.exists() and not _build():
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.chunkio_read.restype = ctypes.c_int64
+        lib.chunkio_read.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                     ctypes.c_int64, ctypes.c_int64,
+                                     ctypes.c_int]
+        lib.chunkio_file_size.restype = ctypes.c_int64
+        lib.chunkio_file_size.argtypes = [ctypes.c_char_p]
+        lib.chunkio_prefetch_start.restype = ctypes.c_void_p
+        lib.chunkio_prefetch_start.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                               ctypes.c_int64, ctypes.c_int64,
+                                               ctypes.c_int]
+        lib.chunkio_prefetch_wait.restype = ctypes.c_int64
+        lib.chunkio_prefetch_wait.argtypes = [ctypes.c_void_p]
+        lib.chunkio_prefetch_cancel.restype = None
+        lib.chunkio_prefetch_cancel.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def _npy_header(path: Path) -> tuple[np.dtype, tuple, int]:
+    """Parse a .npy header; returns (dtype, shape, payload offset)."""
+    with open(path, "rb") as fh:
+        version = np.lib.format.read_magic(fh)
+        shape, fortran, dtype = np.lib.format._read_array_header(fh, version)
+        if fortran:
+            raise ValueError(f"{path}: fortran-order arrays unsupported")
+        return dtype, shape, fh.tell()
+
+
+def read_npy_native(path: str | Path,
+                    nthreads: int = DEFAULT_THREADS) -> Optional[np.ndarray]:
+    """Threaded read of a .npy file; None when the native lib is missing
+    (caller falls back to np.load)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    path = Path(path)
+    dtype, shape, offset = _npy_header(path)
+    out = np.empty(shape, dtype)
+    size = out.nbytes
+    n = lib.chunkio_read(str(path).encode(),
+                         out.ctypes.data_as(ctypes.c_char_p),
+                         offset, size, nthreads)
+    if n != size:
+        return None
+    return out
+
+
+class NativePrefetcher:
+    """Background-thread prefetch of the next chunk file into a caller-owned
+    numpy buffer (zero-copy): `start(path)` while the current chunk trains,
+    `wait()` to get the array."""
+
+    def __init__(self, nthreads: int = DEFAULT_THREADS):
+        self.nthreads = nthreads
+        self._handle = None
+        self._buffer: Optional[np.ndarray] = None  # keeps dst alive for C
+        self._size = 0
+
+    def start(self, path: str | Path) -> bool:
+        lib = get_lib()
+        if lib is None or self._handle is not None:
+            return False
+        path = Path(path)
+        dtype, shape, offset = _npy_header(path)
+        out = np.empty(shape, dtype)
+        handle = lib.chunkio_prefetch_start(
+            str(path).encode(), out.ctypes.data_as(ctypes.c_char_p),
+            offset, out.nbytes, self.nthreads)
+        if not handle:
+            return False
+        self._handle = handle
+        self._buffer = out
+        self._size = out.nbytes
+        return True
+
+    def wait(self) -> Optional[np.ndarray]:
+        if self._handle is None:
+            return None
+        n = get_lib().chunkio_prefetch_wait(ctypes.c_void_p(self._handle))
+        out = self._buffer if n == self._size else None
+        self._handle, self._buffer, self._size = None, None, 0
+        return out
+
+    def cancel(self) -> None:
+        if self._handle is not None:
+            get_lib().chunkio_prefetch_cancel(ctypes.c_void_p(self._handle))
+            self._handle, self._buffer, self._size = None, None, 0
+
+    def __del__(self):  # last-resort leak guard
+        try:
+            self.cancel()
+        except Exception:
+            pass

@@ -257,6 +257,7 @@ class Ensemble:
         # known once the real batch arrives, so the final choice happens on
         # the first step_batch call
         self.fused = self._fused_step is not None
+        self._fused_explicit = use_fused is True
         self._step_fn = self._standard_step
 
     @property
@@ -275,8 +276,13 @@ class Ensemble:
             d = self.state.params["encoder"].shape[2]
             if pick_batch_tile(batch.shape[0], n_feats, d) is not None:
                 self._step_fn = self._fused_step
+            elif self._fused_explicit:
+                raise ValueError(
+                    f"use_fused=True but no VMEM-fitting batch tile exists for "
+                    f"batch={batch.shape[0]}, n_feats={n_feats}, d={d}; choose "
+                    "a batch size divisible by 64/128/256/512 or drop use_fused")
             else:
-                self.fused = False
+                self.fused = False  # auto mode: quietly keep autodiff
         if self.mesh is not None:
             n_data = self.mesh.shape["data"]
             if batch.shape[0] % n_data != 0:

@@ -1,0 +1,139 @@
+"""Sequence-parallel GPT-NeoX forward for long-context harvesting.
+
+Shards the SEQUENCE axis of a forward pass across a mesh axis with
+`jax.shard_map`: every device holds S/P tokens, attention is exact full-
+sequence causal attention via ring_attention (KV blocks rotate over ICI), and
+all other ops (LN, MLP, embeddings) are token-local. This lets activation
+harvesting run at context lengths that don't fit one chip — a capability the
+reference lacks entirely (contexts capped at 256-2048,
+activation_dataset.py:27,516).
+
+Taps come back sequence-sharded and are reassembled by the caller (the
+harvest writer consumes [b·s, d] rows, so order within a fragment is
+preserved by construction).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparse_coding_tpu.lm.gptneox import (
+    _layernorm,
+    _mlp,
+    _rotary_cos_sin,
+    _apply_rotary,
+)
+from sparse_coding_tpu.lm.model_config import LMConfig
+from sparse_coding_tpu.lm.ring_attention import ring_attention
+
+Array = jax.Array
+
+SEQ_AXIS = "data"  # sequence parallelism rides the data axis of the mesh
+
+
+def _sp_attention(x_ln: Array, layer: dict, cfg: LMConfig, cos: Array,
+                  sin: Array, axis_name: str) -> tuple[Array, Array]:
+    """Sequence-sharded attention: local qkv projection + ring attention."""
+    b, s_local, _ = x_ln.shape
+    h, dh = cfg.n_heads, cfg.d_head
+    qkv = x_ln @ layer["qkv_w"].T + layer["qkv_b"]
+    qkv = qkv.reshape(b, s_local, h, 3 * dh)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    rotary_ndims = int(dh * cfg.rotary_pct)
+    q, k = _apply_rotary(q, k, cos, sin, rotary_ndims)
+    z = ring_attention(q, k, v, axis_name, scale=dh ** -0.5)
+    z_flat = z.reshape(b, s_local, h * dh)
+    return z_flat @ layer["dense_w"].T + layer["dense_b"], z_flat
+
+
+def _sp_forward_local(params: dict, tokens: Array, cfg: LMConfig,
+                      taps: Sequence[str], stop_at_layer: Optional[int],
+                      axis_name: str):
+    """Per-shard body run under shard_map; tokens: [B, S/P]."""
+    collected = {}
+    s_local = tokens.shape[1]
+    shard = jax.lax.axis_index(axis_name)
+    offset = shard * s_local
+
+    x = params["embed_in"][tokens]
+    rotary_ndims = int(cfg.d_head * cfg.rotary_pct)
+    total_s = s_local * jax.lax.axis_size(axis_name)
+    cos_full, sin_full = _rotary_cos_sin(total_s, rotary_ndims, dtype=x.dtype)
+    cos = jax.lax.dynamic_slice_in_dim(cos_full, offset, s_local)
+    sin = jax.lax.dynamic_slice_in_dim(sin_full, offset, s_local)
+
+    n_layers = cfg.n_layers if stop_at_layer is None else min(stop_at_layer,
+                                                              cfg.n_layers)
+    for i in range(n_layers):
+        layer = params["layers"][i]
+        x_ln1 = _layernorm(x, layer["ln1_w"], layer["ln1_b"], cfg.layernorm_eps)
+        attn_out, z_flat = _sp_attention(x_ln1, layer, cfg, cos, sin, axis_name)
+        if f"attn_concat.{i}" in taps:
+            collected[f"attn_concat.{i}"] = z_flat
+        if cfg.parallel_residual:
+            x_ln2 = _layernorm(x, layer["ln2_w"], layer["ln2_b"], cfg.layernorm_eps)
+            mlp_out, post_act = _mlp(x_ln2, layer)
+            if f"mlp.{i}" in taps:
+                collected[f"mlp.{i}"] = post_act
+            if f"mlpout.{i}" in taps:
+                collected[f"mlpout.{i}"] = mlp_out
+            x = x + attn_out + mlp_out
+        else:
+            x = x + attn_out
+            x_ln2 = _layernorm(x, layer["ln2_w"], layer["ln2_b"], cfg.layernorm_eps)
+            mlp_out, post_act = _mlp(x_ln2, layer)
+            if f"mlp.{i}" in taps:
+                collected[f"mlp.{i}"] = post_act
+            if f"mlpout.{i}" in taps:
+                collected[f"mlpout.{i}"] = mlp_out
+            x = x + mlp_out
+        if f"residual.{i}" in taps:
+            collected[f"residual.{i}"] = x
+        if f"attn.{i}" in taps:
+            collected[f"attn.{i}"] = x
+
+    if stop_at_layer is not None and stop_at_layer < cfg.n_layers:
+        return None, collected
+    x = _layernorm(x, params["final_ln_w"], params["final_ln_b"],
+                   cfg.layernorm_eps)
+    logits = x @ params["embed_out"].T
+    return logits, collected
+
+
+def sequence_parallel_forward(params: dict, tokens: Array, cfg: LMConfig,
+                              mesh: Mesh, taps: Sequence[str] = (),
+                              stop_at_layer: Optional[int] = None,
+                              axis_name: str = SEQ_AXIS):
+    """Exact GPT-NeoX forward with the sequence axis sharded over
+    mesh[axis_name]. tokens: [B, S] with S divisible by the axis size.
+    Returns (logits or None, {tap: [B, S, width]}) with outputs sharded along
+    the sequence axis."""
+    taps = tuple(taps)
+    n_shards = mesh.shape[axis_name]
+    if tokens.shape[1] % n_shards != 0:
+        raise ValueError(f"sequence length {tokens.shape[1]} not divisible by "
+                         f"mesh axis {axis_name}={n_shards}")
+
+    body = partial(_sp_forward_local, cfg=cfg, taps=taps,
+                   stop_at_layer=stop_at_layer, axis_name=axis_name)
+    seq_sharded = P(None, axis_name)
+    early_stop = stop_at_layer is not None and stop_at_layer < cfg.n_layers
+
+    if early_stop:
+        fn = jax.shard_map(
+            lambda p, t: body(p, t)[1],  # taps only; logits is None
+            mesh=mesh, in_specs=(P(), seq_sharded), out_specs=seq_sharded,
+            check_vma=False)
+        return None, fn(params, tokens)
+
+    fn = jax.shard_map(
+        lambda p, t: body(p, t),
+        mesh=mesh, in_specs=(P(), seq_sharded),
+        out_specs=(seq_sharded, seq_sharded), check_vma=False)
+    logits, tapped = fn(params, tokens)
+    return logits, tapped

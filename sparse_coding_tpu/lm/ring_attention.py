@@ -1,0 +1,86 @@
+"""Ring attention: causal attention over a sequence-sharded axis.
+
+The reference caps harvesting contexts at 256-2048 tokens and has no
+long-context machinery (SURVEY.md §5); this framework makes long-context
+harvesting first-class. Sequences shard across a mesh axis; each device holds
+a query block and the key/value blocks rotate around the ring via
+`jax.lax.ppermute`, with flash-style numerically-stable online-softmax
+accumulation — O(S/P) memory per device, full-sequence attention semantics,
+and compute/communication overlap left to XLA's scheduler.
+
+Used by lm/long_context.py's sequence-parallel GPT-NeoX forward; correctness
+is tested against full attention on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q: Array, k: Array, v: Array, q_offset: Array,
+                  kv_offset: Array, scale: float,
+                  m: Array, l: Array, o: Array):
+    """One (q-block × kv-block) flash-attention update.
+
+    q: [B, Sq, H, Dh]; k, v: [B, Sk, H, Dh]; m, l: [B, H, Sq]; o like q.
+    Global causal mask: position(q)=q_offset+i attends position(kv)=kv_offset+j
+    iff q_pos >= kv_pos."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    sq, sk = q.shape[1], k.shape[1]
+    q_pos = q_offset + jnp.arange(sq)
+    kv_pos = kv_offset + jnp.arange(sk)
+    causal = q_pos[:, None] >= kv_pos[None, :]
+    scores = jnp.where(causal[None, None], scores, _NEG_INF)
+
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    # fully-masked rows: p is exp(-1e30 - m) ≈ 0 — harmless
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: Array, k: Array, v: Array, axis_name: str,
+                   scale: float | None = None) -> Array:
+    """Causal ring attention inside shard_map.
+
+    q, k, v: [B, S_local, H, Dh], sequence-sharded over `axis_name`.
+    Returns [B, S_local, H, Dh]."""
+    n_shards = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    q_offset = my_idx * s_local
+
+    b, sq, h, dh = q.shape
+    m = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    o = jnp.zeros((b, sq, h, dh), jnp.float32)
+
+    # step 0: the local block (no rotation needed)
+    m, l, o = _block_attend(q, k, v, q_offset, q_offset, scale, m, l, o)
+
+    def body(step, carry):
+        m, l, o, k_blk, v_blk = carry
+        # rotate kv to the next device (device i sends to i+1), then attend;
+        # rotating first means exactly n_shards-1 transfers total
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        kv_idx = (my_idx - step) % n_shards
+        kv_offset = kv_idx * s_local
+        m, l, o = _block_attend(q, k_blk, v_blk, q_offset, kv_offset, scale,
+                                m, l, o)
+        return m, l, o, k_blk, v_blk
+
+    m, l, o, _, _ = jax.lax.fori_loop(1, n_shards, body, (m, l, o, k, v))
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

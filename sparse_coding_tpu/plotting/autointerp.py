@@ -25,7 +25,7 @@ def plot_score_violins(scores_by_transform: dict[str, Sequence[float]],
     (reference: read_results, interpret.py:691-761)."""
     import matplotlib
 
-    matplotlib.use("Agg")
+    matplotlib.use("Agg", force=False)
     import matplotlib.pyplot as plt
 
     names = sorted(scores_by_transform)

@@ -16,7 +16,7 @@ def plot_erasure_tradeoff(curve: Sequence[dict], leace: Optional[dict] = None,
     with LEACE as a reference point (erasure_plot.py:198-278)."""
     import matplotlib
 
-    matplotlib.use("Agg")
+    matplotlib.use("Agg", force=False)
     import matplotlib.pyplot as plt
 
     fig, ax = plt.subplots(figsize=(7, 5))

@@ -49,7 +49,7 @@ def plot_fvu_sparsity(scores: Sequence[dict], group_by: str = "dict_size",
     (reference: fvu_sparsity_plot.py rendering loop)."""
     import matplotlib
 
-    matplotlib.use("Agg")
+    matplotlib.use("Agg", force=False)
     import matplotlib.pyplot as plt
 
     fig, ax = plt.subplots(figsize=(7, 5))

@@ -20,13 +20,23 @@ def _fig_to_array(fig) -> np.ndarray:
     return buf[..., :3].copy()
 
 
+class _NoopPlt:
+    """Stands in for pyplot so helpers never mutate the process-global
+    backend (Figure+Agg canvas render headlessly on their own)."""
+
+    @staticmethod
+    def close(fig):  # Figure objects are GC'd; nothing to close
+        pass
+
+
 def _new_fig(**kwargs):
-    import matplotlib
+    from matplotlib.backends.backend_agg import FigureCanvasAgg
+    from matplotlib.figure import Figure
 
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
-
-    return plt, plt.subplots(**kwargs)
+    fig = Figure(**kwargs)
+    FigureCanvasAgg(fig)
+    ax = fig.subplots()
+    return _NoopPlt, (fig, ax)
 
 
 def plot_hist(scores, x_label: str = "", y_label: str = "", bins: int = 50,

@@ -19,7 +19,7 @@ from sparse_coding_tpu.utils.artifacts import load_learned_dicts
 def _plt():
     import matplotlib
 
-    matplotlib.use("Agg")
+    matplotlib.use("Agg", force=False)
     import matplotlib.pyplot as plt
 
     return plt

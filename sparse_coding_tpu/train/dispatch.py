@@ -40,13 +40,7 @@ def dispatch_job_on_chunk(ensembles: Sequence[Ensemble | EnsembleGroup],
         if progress is not None:
             progress(i + 1, total)
     # barrier: materialize the final losses (join-equivalent)
-    for aux in last_aux.values():
-        if isinstance(aux, dict):
-            for a in aux.values():
-                jax.block_until_ready(a.losses["loss"])
-        else:
-            jax.block_until_ready(aux.losses["loss"])
-    return last_aux
+    return LiteJob(ensembles, last_aux).collect()
 
 
 class LiteJob:

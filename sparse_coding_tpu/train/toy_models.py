@@ -77,7 +77,7 @@ def run_toy_replication(cfg: ToyArgs, l1_values=None,
 def _plot_recovery(results, save_path):
     import matplotlib
 
-    matplotlib.use("Agg")
+    matplotlib.use("Agg", force=False)
     import matplotlib.pyplot as plt
 
     fig, ax = plt.subplots(figsize=(6, 4))

@@ -1,0 +1,75 @@
+"""Tracing / profiling instrumentation.
+
+The reference has none (SURVEY.md §5: progressbar counters only). Here:
+- `trace(path)`: context manager around `jax.profiler` for TensorBoard-
+  readable device traces of any training region;
+- `StepTimer`: wall-clock + throughput (activations/sec) tracking with
+  warmup skipping — the north-star metric feed for bench.py and sweep logs;
+- `annotate`: named trace regions (shows up in the profiler timeline).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from pathlib import Path
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | Path) -> Iterator[None]:
+    """Capture a device trace viewable in TensorBoard/XProf."""
+    Path(log_dir).mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (jax.profiler.TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Throughput meter: call `tick(n_items)` once per step; read
+    `items_per_sec`. Skips `warmup` steps so compile time doesn't pollute the
+    rate; `block_on` forces device sync before timestamps when exact per-step
+    walls are needed."""
+
+    def __init__(self, warmup: int = 3):
+        self.warmup = warmup
+        self.reset()
+
+    def reset(self) -> None:
+        self._steps = 0
+        self._items = 0
+        self._t0: Optional[float] = None
+        self.last_dt: Optional[float] = None
+        self._last_tick: Optional[float] = None
+
+    def tick(self, n_items: int = 1, block_on=None) -> None:
+        if block_on is not None:
+            jax.block_until_ready(block_on)
+        now = time.perf_counter()
+        self._steps += 1
+        if self._steps == self.warmup + 1:
+            self._t0 = now
+        elif self._steps > self.warmup + 1:
+            self._items += n_items
+            self.last_dt = now - (self._last_tick or now)
+        self._last_tick = now
+
+    @property
+    def items_per_sec(self) -> float:
+        if self._t0 is None or self._last_tick is None or self._items == 0:
+            return 0.0
+        dt = self._last_tick - self._t0
+        return self._items / dt if dt > 0 else 0.0
+
+    @property
+    def measured_steps(self) -> int:
+        return max(0, self._steps - self.warmup - 1)

@@ -1,0 +1,113 @@
+"""Ring attention + sequence-parallel forward vs the single-device reference
+on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from sparse_coding_tpu.lm import gptneox
+from sparse_coding_tpu.lm.model_config import tiny_test_config
+from sparse_coding_tpu.lm.ring_attention import ring_attention
+from sparse_coding_tpu.parallel.mesh import make_mesh
+
+
+def _full_causal_attention(q, k, v):
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    s = q.shape[1]
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def test_ring_attention_matches_full(rng, devices8):
+    mesh = make_mesh(1, 8)
+    b, s, h, dh = 2, 64, 4, 16
+    keys = jax.random.split(rng, 3)
+    q = jax.random.normal(keys[0], (b, s, h, dh))
+    k = jax.random.normal(keys[1], (b, s, h, dh))
+    v = jax.random.normal(keys[2], (b, s, h, dh))
+
+    ring = jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="data"),
+        mesh=mesh,
+        in_specs=(P(None, "data"), P(None, "data"), P(None, "data")),
+        out_specs=P(None, "data"), check_vma=False)
+    out_ring = ring(q, k, v)
+    out_full = _full_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_full),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_single_shard(rng, devices8):
+    """P=1 ring == plain attention (degenerate ring)."""
+    mesh = make_mesh(8, 1)
+    b, s, h, dh = 1, 16, 2, 8
+    keys = jax.random.split(rng, 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, dh)) for kk in keys)
+    ring = jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="data"),
+        mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(), check_vma=False)
+    np.testing.assert_allclose(np.asarray(ring(q, k, v)),
+                               np.asarray(_full_causal_attention(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = tiny_test_config("gptneox")
+    params = gptneox.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def test_sequence_parallel_forward_matches(tiny_lm, devices8):
+    from sparse_coding_tpu.lm.long_context import sequence_parallel_forward
+
+    params, cfg = tiny_lm
+    mesh = make_mesh(1, 8)
+    toks = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 32))
+    toks = jnp.asarray(toks)
+
+    ref_logits, ref_taps = gptneox.forward(params, toks, cfg,
+                                           taps=("residual.1", "mlp.1"))
+    sp_logits, sp_taps = sequence_parallel_forward(
+        params, toks, cfg, mesh, taps=("residual.1", "mlp.1"))
+
+    np.testing.assert_allclose(np.asarray(sp_logits), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)
+    for name in ref_taps:
+        np.testing.assert_allclose(np.asarray(sp_taps[name]),
+                                   np.asarray(ref_taps[name]),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_sequence_parallel_stop_at_layer(tiny_lm, devices8):
+    from sparse_coding_tpu.lm.long_context import sequence_parallel_forward
+
+    params, cfg = tiny_lm
+    mesh = make_mesh(1, 8)
+    toks = jnp.asarray(np.random.default_rng(1).integers(
+        0, cfg.vocab_size, (2, 16)))
+    logits, taps = sequence_parallel_forward(params, toks, cfg, mesh,
+                                             taps=("residual.0",),
+                                             stop_at_layer=1)
+    assert logits is None
+    ref_logits, ref_taps = gptneox.forward(params, toks, cfg,
+                                           taps=("residual.0",),
+                                           stop_at_layer=1)
+    np.testing.assert_allclose(np.asarray(taps["residual.0"]),
+                               np.asarray(ref_taps["residual.0"]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_sequence_parallel_rejects_ragged(tiny_lm, devices8):
+    from sparse_coding_tpu.lm.long_context import sequence_parallel_forward
+
+    params, cfg = tiny_lm
+    mesh = make_mesh(1, 8)
+    toks = jnp.zeros((1, 30), jnp.int32)  # 30 % 8 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        sequence_parallel_forward(params, toks, cfg, mesh)
