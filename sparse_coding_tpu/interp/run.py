@@ -18,6 +18,7 @@ import numpy as np
 
 from sparse_coding_tpu.config import InterpArgs
 from sparse_coding_tpu.interp.client import ActivationRecord, Explainer, get_explainer
+from sparse_coding_tpu.utils.artifacts import load_learned_dicts
 from sparse_coding_tpu.interp.fragments import (
     FragmentActivations,
     TokenActivationLookup,
@@ -121,8 +122,6 @@ def run_folder(dict_paths: Sequence[str], cfg: InterpArgs, params, lm_cfg,
                token_rows, decode_token, forward=None) -> dict[str, list]:
     """Interpret every saved dict artifact in a folder
     (reference: run_folder/run_from_grouped, interpret.py:414-455)."""
-    from sparse_coding_tpu.utils.artifacts import load_learned_dicts
-
     all_results = {}
     for path in dict_paths:
         for i, (ld, hyper) in enumerate(load_learned_dicts(path)):
@@ -132,6 +131,82 @@ def run_folder(dict_paths: Sequence[str], cfg: InterpArgs, params, lm_cfg,
                                              token_rows, decode_token,
                                              forward=forward)
     return all_results
+
+
+def interpret_across_baselines(baseline_root: str | Path, cfg: InterpArgs,
+                               params, lm_cfg, token_rows, decode_token,
+                               forward=None) -> dict[str, list]:
+    """Interpret every baseline artifact under a sweep_baselines output tree
+    (reference: interpret_across_baselines, interpret.py:541-580 — its
+    multi-GPU queue+workers collapse into sequential jitted runs here).
+
+    Layer-aware: artifacts under `l{N}_{loc}/` subfolders (the
+    run_all_baselines layout) are interpreted at THEIR layer/loc, like the
+    reference's folder-name parsing (interpret.py:552-558); outputs are
+    namespaced by the artifact's relative path so same-named pkls from
+    different layers never collide."""
+    import re
+
+    baseline_root = Path(baseline_root)
+    all_results = {}
+    for path in sorted(baseline_root.rglob("*.pkl")):
+        rel = path.relative_to(baseline_root)
+        m = re.match(r"l(\d+)_(\w+)", rel.parts[0]) if len(rel.parts) > 1 else None
+        sub_cfg = cfg
+        if m:
+            sub_cfg = cfg.replace(layer=int(m.group(1)), layer_loc=m.group(2))
+        ns = "_".join(rel.with_suffix("").parts)
+        for i, (ld, hyper) in enumerate(load_learned_dicts(path)):
+            member_cfg = sub_cfg.replace(output_folder=str(
+                Path(cfg.output_folder) / f"{ns}_{i}"))
+            all_results[f"{rel}:{i}"] = run(ld, member_cfg, params, lm_cfg,
+                                            token_rows, decode_token,
+                                            forward=forward)
+    return all_results
+
+
+def interpret_across_big_sweep(sweep_output: str | Path, cfg: InterpArgs,
+                               params, lm_cfg, token_rows, decode_token,
+                               forward=None) -> dict[str, list]:
+    """Interpret the FINAL snapshot's dicts of a big sweep
+    (reference: interpret_across_big_sweep, interpret.py:583-640)."""
+    snapshots = sorted(Path(sweep_output).glob("_*"),
+                       key=lambda p: int(p.name[1:]))
+    if not snapshots:
+        raise FileNotFoundError(f"no _N snapshots under {sweep_output}")
+    paths = sorted(str(p) for p in snapshots[-1].glob("*_learned_dicts.pkl"))
+    return run_folder(paths, cfg, params, lm_cfg, token_rows, decode_token,
+                      forward=forward)
+
+
+def interpret_across_chunks(sweep_output: str | Path, cfg: InterpArgs, params,
+                            lm_cfg, token_rows, decode_token,
+                            forward=None) -> dict[str, list]:
+    """Time-series interpretation: interpret the SAME features at each saved
+    training snapshot (`_N/` folders) of a sweep — how interpretability
+    evolves over training (reference: interpret_across_chunks,
+    interpret.py:643-688)."""
+    sweep_output = Path(sweep_output)
+    snapshots = sorted(sweep_output.glob("_*"), key=lambda p: int(p.name[1:]))
+    results: dict[str, dict] = {}
+    # per (artifact, member) pinned feature sets, so the series tracks the
+    # SAME features of the SAME ensemble member across training
+    pinned: dict[str, Sequence[int]] = {}
+    for snap in snapshots:
+        snap_results = {}
+        for artifact in sorted(snap.glob("*_learned_dicts.pkl")):
+            for i, (ld, hyper) in enumerate(load_learned_dicts(artifact)):
+                member_key = f"{artifact.name}:{i}"
+                sub_cfg = cfg.replace(output_folder=str(
+                    Path(cfg.output_folder) / snap.name /
+                    f"{artifact.stem}_{i}"))
+                recs = run(ld, sub_cfg, params, lm_cfg, token_rows,
+                           decode_token, forward=forward,
+                           feature_indices=pinned.get(member_key))
+                pinned.setdefault(member_key, [r["feature"] for r in recs])
+                snap_results[member_key] = recs
+        results[snap.name] = snap_results
+    return results
 
 
 def read_scores(output_folder: str | Path) -> dict[int, dict]:

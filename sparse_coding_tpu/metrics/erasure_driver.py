@@ -1,0 +1,100 @@
+"""Config-driven concept-erasure experiment.
+
+Consumes `ErasureArgs` (config.py) and produces the per-layer
+`erasure_scores_layer_{L}.json` artifacts + tradeoff plots that the
+reference's plotting expects but whose computing script is missing from its
+repo (SURVEY.md §2.6; reference: config.py:71-79, plotting/erasure_plot.py).
+
+Pipeline per layer: harvest (or reuse) activations at the probe tokens,
+label them with the concept, sweep the feature-erasure curve for each dict,
+add the LEACE baseline, optionally measure LM KL under the edit.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.config import ErasureArgs
+from sparse_coding_tpu.lm.hooks import tap_name
+from sparse_coding_tpu.metrics.erasure import feature_erasure_curve, leace_baseline
+from sparse_coding_tpu.utils.artifacts import load_learned_dicts
+
+
+def probe_activations(params, lm_cfg, tokens: np.ndarray, layer: int,
+                      layer_loc: str, position: int = -1, forward=None,
+                      model_batch_size: int = 64):
+    """Activations at one position of each probe prompt [n, d]. Accepts
+    [n, s] prompts or [n] bare token ids (e.g. tasks/gender.py probe arrays,
+    promoted to single-token prompts); runs in model_batch_size slices like
+    every other harvester."""
+    if forward is None:
+        from sparse_coding_tpu.lm.convert import forward_fn
+        forward = forward_fn(lm_cfg)
+    tokens = np.asarray(tokens)
+    if tokens.ndim == 1:
+        tokens = tokens[:, None]
+    tap = tap_name(layer, layer_loc)
+
+    @jax.jit
+    def harvest(batch):
+        _, tapped = forward(params, batch, lm_cfg, taps=(tap,),
+                            stop_at_layer=layer + 1)
+        return tapped[tap][:, position, :]
+
+    outs = []
+    for lo in range(0, tokens.shape[0], model_batch_size):
+        outs.append(harvest(jnp.asarray(tokens[lo:lo + model_batch_size])))
+    return jnp.concatenate(outs, axis=0)
+
+
+def run_erasure(cfg: ErasureArgs, params, lm_cfg, probe_tokens: np.ndarray,
+                labels: np.ndarray, forward=None,
+                kl_tokens: Optional[np.ndarray] = None) -> dict[int, dict]:
+    """Full erasure experiment over cfg.layers; writes
+    `{output}/erasure_scores_layer_{L}.json` + plots. Returns the records.
+
+    probe_tokens: [n, s] prompts whose final-position activation carries the
+    concept (e.g. gendered names from tasks/gender.py); labels: [n] binary.
+    """
+    from sparse_coding_tpu.plotting.erasure import plot_erasure_tradeoff
+
+    dicts = load_learned_dicts(cfg.dict_path)
+    out = Path(cfg.output_folder)
+    out.mkdir(parents=True, exist_ok=True)
+    grid = [1, 2, 4, 8, 16, 32, 64]
+    grid = [g for g in grid if g <= cfg.max_edit_feats]
+
+    results: dict[int, dict] = {}
+    for layer in cfg.layers:
+        acts = probe_activations(params, lm_cfg, probe_tokens, layer,
+                                 cfg.layer_loc, forward=forward)
+        lm_eval = None
+        if kl_tokens is not None:
+            lm_eval = {"params": params, "lm_cfg": lm_cfg,
+                       "tokens": jnp.asarray(kl_tokens),
+                       "location": (layer, cfg.layer_loc), "forward": forward}
+        layer_rec = {"layer": layer, "dicts": [],
+                     "leace": leace_baseline(acts, labels)}
+        for ld, hyper in dicts:
+            curve = feature_erasure_curve(ld, acts, labels,
+                                          n_features_grid=grid,
+                                          lm_eval=lm_eval)
+            layer_rec["dicts"].append({
+                "hyperparams": {k: v for k, v in hyper.items()
+                                if isinstance(v, (int, float, str, bool))},
+                "curve": curve,
+            })
+        path = out / f"erasure_scores_layer_{layer}.json"
+        path.write_text(json.dumps(layer_rec, indent=2, default=float))
+        plot_erasure_tradeoff(layer_rec["dicts"][0]["curve"],
+                              leace=layer_rec["leace"],
+                              save_path=out / f"erasure_layer_{layer}.png",
+                              title=f"erasure tradeoff (layer {layer})")
+        results[layer] = layer_rec
+    return results

@@ -36,14 +36,16 @@ class ICAEncoder(LearnedDict):
 
     @classmethod
     def train(cls, dataset: Array, n_components: Optional[int] = None,
-              max_iter: int = 500) -> "ICAEncoder":
+              max_iter: int = 500,
+              random_state: Optional[int] = None) -> "ICAEncoder":
         from sklearn.decomposition import FastICA
         from sklearn.preprocessing import StandardScaler
 
         x = np.asarray(jax.device_get(dataset), np.float64)
         scaler = StandardScaler()
         x_std = scaler.fit_transform(x)
-        ica = FastICA(n_components=n_components, max_iter=max_iter)
+        ica = FastICA(n_components=n_components, max_iter=max_iter,
+                      random_state=random_state)
         ica.fit(x_std)
         return cls(
             components=jnp.asarray(ica.components_, jnp.float32),

@@ -224,3 +224,55 @@ def test_investigate_features_driver(tmp_path, tiny_lm):
                                 decode_token=lambda t: f"t{t}",
                                 forward=gptneox.forward)
     assert [r["feature"] for r in recs] == [2, 5]
+
+
+def test_erasure_driver(tmp_path, tiny_lm):
+    from sparse_coding_tpu.config import ErasureArgs
+    from sparse_coding_tpu.metrics.erasure_driver import run_erasure
+    from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+
+    params, lm_cfg = tiny_lm
+    ld = TiedSAE(dictionary=jax.random.normal(jax.random.PRNGKey(0),
+                                              (16, lm_cfg.d_model)),
+                 encoder_bias=jnp.zeros(16))
+    save_learned_dicts([(ld, {"l1_alpha": 1e-3})], tmp_path / "d.pkl")
+    cfg = ErasureArgs(layers=[1], layer_loc="residual",
+                      dict_path=str(tmp_path / "d.pkl"),
+                      output_folder=str(tmp_path / "erasure"),
+                      max_edit_feats=4)
+    rng_np = np.random.default_rng(0)
+    probe_tokens = rng_np.integers(0, lm_cfg.vocab_size, (64, 8))
+    labels = rng_np.integers(0, 2, 64)
+    results = run_erasure(cfg, params, lm_cfg, probe_tokens, labels,
+                          forward=gptneox.forward)
+    assert 1 in results
+    assert (tmp_path / "erasure" / "erasure_scores_layer_1.json").exists()
+    assert (tmp_path / "erasure" / "erasure_layer_1.png").exists()
+    rec = results[1]
+    assert "leace" in rec and len(rec["dicts"][0]["curve"]) == 4
+
+
+def test_interpret_across_chunks(tmp_path, tiny_lm):
+    from sparse_coding_tpu.interp.run import interpret_across_chunks
+    from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+
+    params, lm_cfg = tiny_lm
+    # fake sweep snapshots _0/_1 with one dict artifact each
+    for i in range(2):
+        ld = RandomDict.create(jax.random.PRNGKey(i), lm_cfg.d_model, 8)
+        snap = tmp_path / "sweep" / f"_{i}"
+        snap.mkdir(parents=True)
+        save_learned_dicts([(ld, {})], snap / "e_learned_dicts.pkl")
+    cfg = InterpArgs(output_folder=str(tmp_path / "interp"), layer=1,
+                     n_feats_to_explain=2, fragment_len=8, n_fragments=16,
+                     top_k_fragments=3, n_random_fragments=3, batch_size=8,
+                     provider="offline")
+    rows = np.random.default_rng(0).integers(0, lm_cfg.vocab_size, (32, 16))
+    series = interpret_across_chunks(tmp_path / "sweep", cfg, params, lm_cfg,
+                                     rows, decode_token=lambda t: f"t{t}",
+                                     forward=gptneox.forward)
+    assert set(series) == {"_0", "_1"}
+    member = "e_learned_dicts.pkl:0"
+    # same features of the same member tracked across snapshots
+    assert ([r["feature"] for r in series["_0"][member]] ==
+            [r["feature"] for r in series["_1"][member]])

@@ -1,0 +1,89 @@
+"""Property tests mirroring the reference's unit suites
+(reference: test/test_stats_batched.py, test/test_ica.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.metrics.core import calc_moments_streaming, feature_moments
+from sparse_coding_tpu.models import Identity
+from sparse_coding_tpu.models.ica import ICAEncoder
+
+
+def test_streaming_moments_match_exact(rng):
+    """Streaming accumulation == one-shot moments on an identity dict
+    (reference: test_stats_batched.py:13-27 with its inline fake dict)."""
+    x = jax.random.normal(rng, (10_000, 4)) * jnp.asarray([1.0, 2.0, 0.5, 3.0])
+    ident = Identity.create(4)
+    times_active, mean, var, skew, kurt, m4 = calc_moments_streaming(
+        ident, x, batch_size=1000)
+    codes = ident.encode(x)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(jnp.mean(codes, 0)),
+                               rtol=1e-4, atol=1e-5)
+    exact_var = jnp.mean(codes**2, 0) - jnp.mean(codes, 0) ** 2
+    np.testing.assert_allclose(np.asarray(var), np.asarray(exact_var),
+                               rtol=1e-3, atol=1e-5)
+    exact_kurt = jnp.mean(codes**4, 0) / jnp.clip(exact_var**2, 1e-8)
+    np.testing.assert_allclose(np.asarray(kurt), np.asarray(exact_kurt),
+                               rtol=1e-3)
+
+
+def test_streaming_moments_batch_invariance(rng):
+    """Result independent of batch size."""
+    x = jax.random.normal(rng, (4000, 3))
+    ident = Identity.create(3)
+    _, m1, v1, s1, k1, _ = calc_moments_streaming(ident, x, batch_size=500)
+    _, m2, v2, s2, k2, _ = calc_moments_streaming(ident, x, batch_size=2000)
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(m2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(k1), np.asarray(k2), rtol=1e-4)
+
+
+def test_ica_recovers_identity_on_laplace():
+    """ICA on independent Laplace sources recovers an axis-aligned (signed
+    permutation) unmixing (reference: test_ica.py:14-32)."""
+    rng = np.random.default_rng(0)
+    x = rng.laplace(size=(8000, 4)).astype(np.float32)
+    enc = ICAEncoder.train(jnp.asarray(x), max_iter=1000, random_state=0)
+    d = np.abs(np.asarray(enc.get_learned_dict()))
+    # each row should be dominated by a single coordinate
+    row_max = d.max(axis=1)
+    row_rest = d.sum(axis=1) - row_max
+    assert np.all(row_max > 1.5 * row_rest), d
+
+
+def test_ica_identifiability_gaussian_vs_laplace():
+    """Identifiability, measured where it's visible: fits on two independent
+    samples recover the SAME sources for non-Gaussian (Laplace) data, but
+    different rotations for Gaussian data (reference capability:
+    test_ica.py:34-69). Comparison happens at the recovered-source level on a
+    common held-out set — raw component cosines in original coordinates are
+    swamped by the shared whitening geometry and can't distinguish the cases.
+    """
+    rng = np.random.default_rng(1)
+    mix = rng.normal(size=(3, 3)).astype(np.float32)
+
+    def source_match(dist):
+        a = dist(size=(6000, 3)).astype(np.float32) @ mix
+        b = dist(size=(6000, 3)).astype(np.float32) @ mix
+        common = dist(size=(2000, 3)).astype(np.float32) @ mix
+        e1 = ICAEncoder.train(jnp.asarray(a), max_iter=1000, random_state=0)
+        e2 = ICAEncoder.train(jnp.asarray(b), max_iter=1000, random_state=1)
+        s1 = np.asarray(e1.encode(jnp.asarray(common)))
+        s2 = np.asarray(e2.encode(jnp.asarray(common)))
+        s1 = (s1 - s1.mean(0)) / s1.std(0)
+        s2 = (s2 - s2.mean(0)) / s2.std(0)
+        corr = np.abs(s1.T @ s2) / len(common)
+        return corr.max(axis=1)  # per-source best |corr|, up to perm/sign
+
+    lmatch = source_match(rng.laplace)
+    assert np.all(lmatch > 0.99), lmatch
+    gmatch = source_match(rng.normal)
+    assert np.any(gmatch < 0.95), gmatch
+
+
+def test_feature_moments_shapes(rng):
+    codes = jax.random.normal(rng, (500, 8)) ** 2
+    moments = feature_moments(codes)
+    assert all(moments[k].shape == (8,) for k in ("mean", "var", "skew",
+                                                  "kurtosis"))
