@@ -23,10 +23,34 @@ from sparse_coding_tpu.lm import hooks
 from sparse_coding_tpu.lm.model_config import LMConfig
 
 
-def make_harvest_fn(params, cfg: LMConfig, taps: Sequence[str], forward=None):
+def make_harvest_fn(params, cfg: LMConfig, taps: Sequence[str], forward=None,
+                    mesh=None):
     """Jitted tokens[b,s] -> {tap: [b*s, width]} harvesting step
     (the reference's run_with_cache + rearrange "b s n -> (b s) n",
-    activation_dataset.py:361-368)."""
+    activation_dataset.py:361-368).
+
+    With a mesh, contexts run SEQUENCE-PARALLEL (lm/long_context.py): the
+    sequence axis shards over the mesh's data axis with ring attention, so
+    harvesting contexts can exceed a single chip's memory — long-context
+    support the reference lacks (its contexts cap at 256-2048 tokens)."""
+    if mesh is not None:
+        if forward is not None:
+            raise ValueError(
+                "forward= and mesh= are mutually exclusive: the mesh path "
+                "always uses the sequence-parallel GPT-NeoX forward "
+                "(lm/long_context.py)")
+        from sparse_coding_tpu.lm.long_context import sequence_parallel_forward
+
+        stop = hooks.max_tap_layer(taps) + 1
+
+        def harvest_sp(tokens):
+            _, tapped = sequence_parallel_forward(params, tokens, cfg, mesh,
+                                                  taps=taps, stop_at_layer=stop)
+            return {name: acts.reshape(-1, acts.shape[-1])
+                    for name, acts in tapped.items()}
+
+        return jax.jit(harvest_sp)
+
     if forward is None:
         from sparse_coding_tpu.lm.convert import forward_fn
         forward = forward_fn(cfg)
@@ -54,6 +78,7 @@ def harvest_activations(
     center: bool = False,
     dtype: str = "bfloat16",
     forward=None,
+    mesh=None,
 ) -> dict[str, int]:
     """Run the LM over packed token rows, streaming each tap's activations to
     its own chunk folder `{output_folder}/{tap}/`. Multi-layer in one pass
@@ -63,7 +88,7 @@ def harvest_activations(
     by skipping already-harvested leading chunks (reference:
     activation_dataset.py:348,433)."""
     taps = hooks.taps_for(layers, layer_loc)
-    harvest = make_harvest_fn(params, cfg, taps, forward=forward)
+    harvest = make_harvest_fn(params, cfg, taps, forward=forward, mesh=mesh)
     width = hooks.get_activation_size(layer_loc, cfg)
 
     seq_len = token_rows.shape[1]

@@ -259,30 +259,36 @@ class Ensemble:
         self.fused = self._fused_step is not None
         self._fused_explicit = use_fused is True
         self._step_fn = self._standard_step
+        self._scan_fn = None
+        self._donate = donate
 
     @property
     def n_members(self) -> int:
         return self.state.n_members
 
+    def _resolve_step(self, batch_size: int):
+        """First real batch: confirm the fused kernel has a VMEM-fitting tile
+        for this batch size; otherwise keep the autodiff path."""
+        if not (self.fused and self._step_fn is self._standard_step):
+            return
+        from sparse_coding_tpu.ops.fused_sae import pick_batch_tile
+
+        n_feats = self.state.params["encoder"].shape[1]
+        d = self.state.params["encoder"].shape[2]
+        if pick_batch_tile(batch_size, n_feats, d) is not None:
+            self._step_fn = self._fused_step
+        elif self._fused_explicit:
+            raise ValueError(
+                f"use_fused=True but no VMEM-fitting batch tile exists for "
+                f"batch={batch_size}, n_feats={n_feats}, d={d}; choose "
+                "a batch size divisible by 64/128/256/512 or drop use_fused")
+        else:
+            self.fused = False  # auto mode: quietly keep autodiff
+
     def step_batch(self, batch: Array) -> AuxData:
         """One training step on a [batch, d] activation slab shared by every
         member (reference: ensemble.py:175-193). Returns stacked per-member aux."""
-        if self.fused and self._step_fn is self._standard_step:
-            # first batch: confirm the fused kernel has a VMEM-fitting tile
-            # for this batch size; otherwise quietly keep the autodiff path
-            from sparse_coding_tpu.ops.fused_sae import pick_batch_tile
-
-            n_feats = self.state.params["encoder"].shape[1]
-            d = self.state.params["encoder"].shape[2]
-            if pick_batch_tile(batch.shape[0], n_feats, d) is not None:
-                self._step_fn = self._fused_step
-            elif self._fused_explicit:
-                raise ValueError(
-                    f"use_fused=True but no VMEM-fitting batch tile exists for "
-                    f"batch={batch.shape[0]}, n_feats={n_feats}, d={d}; choose "
-                    "a batch size divisible by 64/128/256/512 or drop use_fused")
-            else:
-                self.fused = False  # auto mode: quietly keep autodiff
+        self._resolve_step(batch.shape[0])
         if self.mesh is not None:
             n_data = self.mesh.shape["data"]
             if batch.shape[0] % n_data != 0:
@@ -291,6 +297,31 @@ class Ensemble:
                     f"axis {n_data}; drop the remainder or pad the batch")
             batch = jax.device_put(batch, NamedSharding(self.mesh, P("data")))
         self.state, aux = self._step_fn(self.state, batch)
+        return aux
+
+    def run_steps(self, batches: Array) -> AuxData:
+        """K training steps in ONE device program via lax.scan over a
+        [K, B, d] batch stack — no per-step Python dispatch (useful when the
+        step is fast enough that host overhead would bottleneck, e.g. the
+        bench loop). Returns aux stacked on a leading K axis."""
+        self._resolve_step(int(batches.shape[1]))
+        if self.mesh is not None:
+            n_data = self.mesh.shape["data"]
+            if batches.shape[1] % n_data != 0:
+                raise ValueError(
+                    f"batch size {batches.shape[1]} not divisible by mesh "
+                    f"data axis {n_data}")
+            batches = jax.device_put(
+                batches, NamedSharding(self.mesh, P(None, "data")))
+        if self._scan_fn is None:
+            step_fn = self._step_fn  # jitted; inlines under the outer jit
+
+            def run(state, batches):
+                return jax.lax.scan(step_fn, state, batches)
+
+            self._scan_fn = jax.jit(
+                run, donate_argnums=(0,) if self._donate else ())
+        self.state, aux = self._scan_fn(self.state, batches)
         return aux
 
     def unstack(self) -> list[tuple[Pytree, dict]]:

@@ -121,3 +121,22 @@ def test_to_learned_dicts_roundtrip(rng):
     assert len(dicts) == 3
     for d in dicts:
         assert d.encode(batch).shape == (BATCH, N_DICT)
+
+
+def test_run_steps_matches_loop(rng):
+    """lax.scan multi-step runner == per-step Python loop."""
+    k_init, k_data = jax.random.split(rng)
+    members = _members(k_init, FunctionalTiedSAE, 2, l1_alpha=1e-3)
+    batches = jax.random.normal(k_data, (6, BATCH, D))
+
+    scan_ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, donate=False)
+    loop_ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, donate=False)
+    aux = scan_ens.run_steps(batches)
+    assert aux.losses["loss"].shape == (6, 2)  # [K, N]
+    for i in range(6):
+        loop_aux = loop_ens.step_batch(batches[i])
+    p_scan = jax.device_get(scan_ens.state.params)
+    p_loop = jax.device_get(loop_ens.state.params)
+    for name in p_scan:
+        np.testing.assert_allclose(p_scan[name], p_loop[name], rtol=1e-5,
+                                   atol=1e-7, err_msg=name)

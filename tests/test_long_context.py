@@ -111,3 +111,23 @@ def test_sequence_parallel_rejects_ragged(tiny_lm, devices8):
     toks = jnp.zeros((1, 30), jnp.int32)  # 30 % 8 != 0
     with pytest.raises(ValueError, match="divisible"):
         sequence_parallel_forward(params, toks, cfg, mesh)
+
+
+def test_sequence_parallel_harvest(tiny_lm, devices8, tmp_path):
+    """Long-context harvesting: chunks written via the sequence-parallel
+    forward equal the single-device harvest."""
+    from sparse_coding_tpu.data.chunk_store import ChunkStore
+    from sparse_coding_tpu.data.harvest import harvest_activations
+
+    params, cfg = tiny_lm
+    mesh = make_mesh(1, 8)
+    rows = np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 32))
+    harvest_activations(params, cfg, rows, layers=[1], layer_loc="residual",
+                        output_folder=tmp_path / "sp", model_batch_size=4,
+                        dtype="float16", mesh=mesh)
+    harvest_activations(params, cfg, rows, layers=[1], layer_loc="residual",
+                        output_folder=tmp_path / "plain", model_batch_size=4,
+                        dtype="float16", forward=gptneox.forward)
+    sp = ChunkStore(tmp_path / "sp" / "residual.1").load_chunk(0)
+    plain = ChunkStore(tmp_path / "plain" / "residual.1").load_chunk(0)
+    np.testing.assert_allclose(sp, plain, atol=2e-2, rtol=2e-2)

@@ -1,0 +1,110 @@
+"""Checkpoint, logging, config, and remaining-metric unit tests."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.config import EnsembleArgs, SyntheticEnsembleArgs
+from sparse_coding_tpu.ensemble import Ensemble
+from sparse_coding_tpu.models import TiedSAE
+from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+from sparse_coding_tpu.utils.checkpoint import restore_ensemble, save_ensemble
+from sparse_coding_tpu.utils.logging import MetricsLogger, make_hyperparam_name
+
+
+def test_checkpoint_roundtrip(rng, tmp_path):
+    k_init, k_data = jax.random.split(rng)
+    members = [FunctionalTiedSAE.init(k, 16, 32, l1_alpha=1e-3)
+               for k in jax.random.split(k_init, 2)]
+    ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, donate=False)
+    batch = jax.random.normal(k_data, (64, 16))
+    for _ in range(5):
+        ens.step_batch(batch)
+    save_ensemble(ens, tmp_path / "ck.msgpack", extra={"chunks_done": 3})
+
+    fresh = Ensemble(members, FunctionalTiedSAE, lr=1e-3, donate=False)
+    meta = restore_ensemble(fresh, tmp_path / "ck.msgpack")
+    assert meta["chunks_done"] == 3
+    # restored state continues identically to the original
+    a1 = ens.step_batch(batch)
+    a2 = fresh.step_batch(batch)
+    np.testing.assert_allclose(np.asarray(a1.losses["loss"]),
+                               np.asarray(a2.losses["loss"]), rtol=1e-6)
+    # optimizer state restored too (first moments nonzero)
+    mu = fresh.state.opt_state.mu["encoder"]
+    assert float(jnp.max(jnp.abs(mu))) > 0
+
+
+def test_metrics_logger_jsonl(tmp_path):
+    logger = MetricsLogger(tmp_path, use_wandb=False)
+    logger.log({"loss": 0.5}, step=1)
+    logger.log({"loss": jnp.asarray(0.25)}, step=2)
+    logger.close()
+    lines = [json.loads(l) for l in (tmp_path / "metrics.jsonl").open()]
+    assert lines[0]["loss"] == 0.5 and lines[0]["step"] == 1
+    assert lines[1]["loss"] == 0.25
+
+
+def test_make_hyperparam_name():
+    name = make_hyperparam_name({"l1_alpha": 8.577e-4, "dict_size": 2048})
+    assert "dict_size2048" in name and "l1_alpha" in name
+
+
+def test_config_cli_and_roundtrip(tmp_path):
+    cfg = EnsembleArgs.from_cli(["--batch_size", "512", "--tied_ae", "true",
+                                 "--learned_dict_ratio", "8.0"])
+    assert cfg.batch_size == 512 and cfg.tied_ae and cfg.learned_dict_ratio == 8.0
+    cfg.save(tmp_path / "c.json")
+    loaded = EnsembleArgs.load(tmp_path / "c.json")
+    assert loaded == cfg
+    # subclass keeps parent fields
+    syn = SyntheticEnsembleArgs.from_cli(["--activation_dim", "128"])
+    assert syn.activation_dim == 128 and syn.batch_size == 1024
+
+
+def test_mmcs_with_larger_grid(rng):
+    from sparse_coding_tpu.metrics.core import mmcs_with_larger_grid
+
+    keys = jax.random.split(rng, 4)
+    grid = [[jax.random.normal(keys[0], (8, 16)),
+             jax.random.normal(keys[1], (16, 16))],
+            [jax.random.normal(keys[2], (8, 16)),
+             jax.random.normal(keys[3], (16, 16))]]
+    av, above, hists = mmcs_with_larger_grid(grid, threshold=0.5)
+    assert av.shape == (2, 2)
+    assert np.all((0 <= av[:, 0]) & (av[:, 0] <= 1))
+    assert av[0, 1] == 0  # last column unused, matching the reference
+    assert hists[0][0].shape == (8,)
+
+
+def test_hungarian_self_match(rng):
+    from sparse_coding_tpu.metrics.core import hungarian_mcs
+
+    d = jax.random.normal(rng, (12, 16))
+    sims = hungarian_mcs(d, d)
+    np.testing.assert_allclose(np.asarray(sims), 1.0, atol=1e-5)
+
+
+def test_capacity_bounds(rng):
+    from sparse_coding_tpu.metrics.core import capacity_per_feature, neurons_per_feature
+
+    ld = TiedSAE(dictionary=jax.random.normal(rng, (32, 16)),
+                 encoder_bias=jnp.zeros(32))
+    caps = capacity_per_feature(ld)
+    assert caps.shape == (32,)
+    assert jnp.all((caps > 0) & (caps <= 1))
+    npf = neurons_per_feature(ld)
+    assert 1.0 <= float(npf) <= 16.0
+
+
+def test_fvu_top_split(rng):
+    from sparse_coding_tpu.metrics.core import fvu_top_activating
+
+    ld = TiedSAE(dictionary=jax.random.normal(rng, (32, 16)),
+                 encoder_bias=jnp.zeros(32))
+    batch = jax.random.normal(jax.random.PRNGKey(1), (256, 16))
+    top, rest = fvu_top_activating(ld, batch, n_top=4)
+    assert np.isfinite(float(top)) and np.isfinite(float(rest))
