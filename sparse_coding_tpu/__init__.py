@@ -9,7 +9,8 @@ A ground-up re-design of the capabilities of HoagyC/sparse_coding (see
   process-per-GPU scheduler (cluster_runs.py) and gloo DDP
   (experiments/huge_batch_size.py),
 - a pure-JAX LM forward with activation taps replacing transformer_lens
-  `run_with_cache` (activation_dataset.py),
+  `run_with_cache` (activation_dataset.py), incl. a sequence-parallel
+  ring-attention path for long contexts,
 - metrics, interpretation, and plotting layers mirroring standard_metrics.py,
   interpret.py and plotting/.
 """
@@ -19,3 +20,5 @@ __version__ = "0.1.0"
 from sparse_coding_tpu import config as config
 from sparse_coding_tpu import ensemble as ensemble
 from sparse_coding_tpu import models as models
+from sparse_coding_tpu.ensemble import Ensemble, EnsembleGroup
+from sparse_coding_tpu.parallel.mesh import make_mesh

@@ -210,6 +210,36 @@ def _save_artifacts(ensembles, folder: Path, chunk: np.ndarray,
             np.save(folder / f"{name}_mmcs_grid.npy", grid)
 
 
+def main(argv=None) -> None:
+    """CLI: python -m sparse_coding_tpu.train.sweep --experiment dense_l1_range
+    --dataset_folder chunks/ --output_folder out/ [--synthetic true ...]"""
+    import argparse
+    import sys
+
+    from sparse_coding_tpu.config import _parse_value
+    from sparse_coding_tpu.train.experiments import EXPERIMENTS
+
+    argv_list = list(argv) if argv is not None else sys.argv[1:]
+    if "-h" in argv_list or "--help" in argv_list:
+        # the config parser prints the dataclass-field options and exits;
+        # document the driver-level flags it doesn't know about first
+        print(f"driver flags: --experiment {{{','.join(sorted(EXPERIMENTS))}}} "
+              "--synthetic BOOL --resume BOOL\nconfig flags:")
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument("--experiment", default="dense_l1_range",
+                        choices=sorted(EXPERIMENTS))
+    parser.add_argument("--synthetic", default="false")
+    parser.add_argument("--resume", default="false")
+    ns, rest = parser.parse_known_args(argv_list)
+
+    synthetic = _parse_value(ns.synthetic, bool)
+    cfg = (SyntheticEnsembleArgs if synthetic else EnsembleArgs).from_cli(rest)
+    result = sweep(EXPERIMENTS[ns.experiment], cfg,
+                   resume=_parse_value(ns.resume, bool))
+    for name, dicts in result.items():
+        print(f"{name}: {len(dicts)} dicts -> {cfg.output_folder}")
+
+
 def resume_sweep_state(ensembles: Sequence[tuple[EnsembleLike, list, str]],
                        out_dir: str | Path) -> tuple[int, Optional[dict]]:
     """Restore all ensembles from the newest checkpoints; returns
@@ -227,3 +257,7 @@ def resume_sweep_state(ensembles: Sequence[tuple[EnsembleLike, list, str]],
                     chunks_done = int(meta.get("chunks_done", 0))
                     rng_state = meta.get("rng_state", rng_state)
     return chunks_done, rng_state
+
+
+if __name__ == "__main__":
+    main()
