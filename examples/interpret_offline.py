@@ -1,0 +1,60 @@
+"""Example: offline auto-interpretation of a trained dictionary.
+
+Trains a small tied SAE on activations of a (random-weight) GPT-NeoX, then
+runs the interpretation pipeline with the deterministic offline provider —
+the zero-API-cost path for smoke-testing interpretation experiments. Swap
+`provider="openai"` (plus OPENAI_API_KEY) for the real explainer/simulator.
+
+    python examples/interpret_offline.py
+"""
+
+import jax
+import numpy as np
+
+from sparse_coding_tpu.config import InterpArgs
+from sparse_coding_tpu.data.harvest import harvest_activations
+from sparse_coding_tpu.data.tokenize import pack_tokens
+from sparse_coding_tpu.ensemble import Ensemble
+from sparse_coding_tpu.interp.run import read_scores, run
+from sparse_coding_tpu.lm import gptneox
+from sparse_coding_tpu.lm.model_config import tiny_test_config
+from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+
+lm_cfg = tiny_test_config("gptneox")
+params = gptneox.init_params(jax.random.PRNGKey(0), lm_cfg)
+
+# fake corpus -> packed rows -> harvested activations
+rng = np.random.default_rng(0)
+docs = [list(rng.integers(1, lm_cfg.vocab_size, rng.integers(20, 60)))
+        for _ in range(200)]
+rows = pack_tokens(docs, max_length=32, eos_token_id=lm_cfg.eos_token_id)
+harvest_activations(params, lm_cfg, rows, layers=[1], layer_loc="residual",
+                    output_folder="interp_example_acts", model_batch_size=8,
+                    dtype="float16", forward=gptneox.forward)
+
+# quick SAE training on the harvested chunks
+from sparse_coding_tpu.data.chunk_store import ChunkStore, device_prefetch
+
+store = ChunkStore("interp_example_acts/residual.1")
+member = FunctionalTiedSAE.init(jax.random.PRNGKey(1), lm_cfg.d_model,
+                                2 * lm_cfg.d_model, l1_alpha=1e-3)
+ens = Ensemble([member], FunctionalTiedSAE, lr=3e-3)
+for epoch in range(3):
+    for batch in device_prefetch(store.epoch(256, np.random.default_rng(epoch))):
+        ens.step_batch(batch)
+sae = ens.to_learned_dicts()[0]
+
+# interpretation with the offline provider
+cfg = InterpArgs(output_folder="interp_example_out", layer=1,
+                 layer_loc="residual", n_feats_to_explain=5, fragment_len=16,
+                 n_fragments=128, top_k_fragments=8, n_random_fragments=8,
+                 batch_size=16, provider="offline")
+results = run(sae, cfg, params, lm_cfg, rows,
+              decode_token=lambda t: f"tok{t}", forward=gptneox.forward)
+
+print(f"{'feature':>8} {'top':>7} {'random':>7} {'top+rand':>9}  explanation")
+for rec in sorted(read_scores("interp_example_out").values(),
+                  key=lambda r: -r["top_random_score"]):
+    print(f"{rec['feature']:>8} {rec['top_score']:>7.3f} "
+          f"{rec['random_score']:>7.3f} {rec['top_random_score']:>9.3f}  "
+          f"{rec['explanation'][:60]}")
