@@ -27,7 +27,9 @@ def _layernorm(x: Array, w: Array, b: Array, eps: float) -> Array:
     return (out * w + b).astype(x.dtype)
 
 
-def _attention(x_ln: Array, layer: dict, cfg: LMConfig) -> tuple[Array, Array]:
+def _attention_z(x_ln: Array, layer: dict, cfg: LMConfig) -> Array:
+    """Pre-c_proj z vectors [b, s, h*dh] (the attn_concat tap point), kept
+    separate from the output projection so edits at this hook propagate."""
     b, s, d = x_ln.shape
     h, dh = cfg.n_heads, cfg.d_head
     # HF GPT-2 Conv1D: y = x @ W + b with W [d, 3d]; heads blocked q|k|v
@@ -41,9 +43,7 @@ def _attention(x_ln: Array, layer: dict, cfg: LMConfig) -> tuple[Array, Array]:
     scores = jnp.where(causal, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     z = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-    z_flat = z.reshape(b, s, h * dh)
-    attn_out = z_flat @ layer["c_proj_w"] + layer["c_proj_b"]
-    return attn_out, z_flat
+    return z.reshape(b, s, h * dh)
 
 
 def forward(
@@ -72,14 +72,17 @@ def forward(
     for i in range(n_layers):
         layer = params["layers"][i]
         x_ln1 = _layernorm(x, layer["ln1_w"], layer["ln1_b"], cfg.layernorm_eps)
-        attn_out, z_flat = _attention(x_ln1, layer, cfg)
+        z_flat = _attention_z(x_ln1, layer, cfg)
+        # edit BEFORE the output projection so attn_concat interventions
+        # actually reach the residual stream
         z_flat = maybe_edit(f"attn_concat.{i}", z_flat)
+        attn_out = z_flat @ layer["c_proj_w"] + layer["c_proj_b"]
         x = x + attn_out
 
         x_ln2 = _layernorm(x, layer["ln2_w"], layer["ln2_b"], cfg.layernorm_eps)
         h = x_ln2 @ layer["c_fc_w"] + layer["c_fc_b"]
         post_act = jax.nn.gelu(h, approximate=True)  # gelu_new
-        post_act = maybe_edit(f"mlp.{i}", post_act)
+        post_act = maybe_edit(f"mlp.{i}", post_act)  # pre-projection: edits propagate
         mlp_out = post_act @ layer["mlp_c_proj_w"] + layer["mlp_c_proj_b"]
         mlp_out = maybe_edit(f"mlpout.{i}", mlp_out)
         x = x + mlp_out

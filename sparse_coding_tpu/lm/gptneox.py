@@ -68,9 +68,11 @@ def _apply_rotary(q: Array, k: Array, cos: Array, sin: Array,
             jnp.concatenate([k_rot, k_pass], axis=-1))
 
 
-def _attention(x_ln: Array, layer: dict, cfg: LMConfig,
-               cos: Array, sin: Array) -> tuple[Array, Array]:
-    """Returns (attn branch output [b,s,d], z pre-W_O [b,s,h*dh])."""
+def _attention_z(x_ln: Array, layer: dict, cfg: LMConfig,
+                 cos: Array, sin: Array) -> Array:
+    """Pre-W_O z vectors, heads flattened [b, s, h*dh] (the attn_concat tap
+    point). Kept separate from the output projection so edits at this hook
+    propagate into the block output."""
     b, s, _ = x_ln.shape
     h, dh = cfg.n_heads, cfg.d_head
     qkv = x_ln @ layer["qkv_w"].T + layer["qkv_b"]  # [b, s, 3d] in HF head-blocked layout
@@ -85,17 +87,24 @@ def _attention(x_ln: Array, layer: dict, cfg: LMConfig,
     scores = jnp.where(causal, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     z = jnp.einsum("bhqk,bkhd->bqhd", probs, v)  # [b, s, h, dh]
-    z_flat = z.reshape(b, s, h * dh)
-    attn_out = z_flat @ layer["dense_w"].T + layer["dense_b"]
-    return attn_out, z_flat
+    return z.reshape(b, s, h * dh)
+
+
+def _mlp_post_act(x_ln: Array, layer: dict) -> Array:
+    """Post-activation hidden [b, s, d_mlp] (the mlp tap point), kept
+    separate from the down-projection so edits at this hook propagate."""
+    h = x_ln @ layer["h_to_4h_w"].T + layer["h_to_4h_b"]
+    return jax.nn.gelu(h, approximate=False)  # HF pythia uses exact gelu
+
+
+def _mlp_out(post_act: Array, layer: dict) -> Array:
+    return post_act @ layer["fourh_to_h_w"].T + layer["fourh_to_h_b"]
 
 
 def _mlp(x_ln: Array, layer: dict) -> tuple[Array, Array]:
     """Returns (mlp branch output [b,s,d], post-activation [b,s,d_mlp])."""
-    h = x_ln @ layer["h_to_4h_w"].T + layer["h_to_4h_b"]
-    post_act = jax.nn.gelu(h, approximate=False)  # HF pythia uses exact gelu
-    out = post_act @ layer["fourh_to_h_w"].T + layer["fourh_to_h_b"]
-    return out, post_act
+    post_act = _mlp_post_act(x_ln, layer)
+    return _mlp_out(post_act, layer), post_act
 
 
 def forward(
@@ -130,21 +139,22 @@ def forward(
     for i in range(n_layers):
         layer = params["layers"][i]
         x_ln1 = _layernorm(x, layer["ln1_w"], layer["ln1_b"], cfg.layernorm_eps)
-        attn_out, z_flat = _attention(x_ln1, layer, cfg, cos, sin)
+        z_flat = _attention_z(x_ln1, layer, cfg, cos, sin)
+        # edit BEFORE the output projection so attn_concat interventions
+        # actually reach the residual stream
         z_flat = maybe_edit(f"attn_concat.{i}", z_flat)
+        attn_out = z_flat @ layer["dense_w"].T + layer["dense_b"]
 
         if cfg.parallel_residual:
             x_ln2 = _layernorm(x, layer["ln2_w"], layer["ln2_b"], cfg.layernorm_eps)
-            mlp_out, post_act = _mlp(x_ln2, layer)
-            post_act = maybe_edit(f"mlp.{i}", post_act)
-            mlp_out = maybe_edit(f"mlpout.{i}", mlp_out)
+            post_act = maybe_edit(f"mlp.{i}", _mlp_post_act(x_ln2, layer))
+            mlp_out = maybe_edit(f"mlpout.{i}", _mlp_out(post_act, layer))
             x = x + attn_out + mlp_out
         else:
             x = x + attn_out
             x_ln2 = _layernorm(x, layer["ln2_w"], layer["ln2_b"], cfg.layernorm_eps)
-            mlp_out, post_act = _mlp(x_ln2, layer)
-            post_act = maybe_edit(f"mlp.{i}", post_act)
-            mlp_out = maybe_edit(f"mlpout.{i}", mlp_out)
+            post_act = maybe_edit(f"mlp.{i}", _mlp_post_act(x_ln2, layer))
+            mlp_out = maybe_edit(f"mlpout.{i}", _mlp_out(post_act, layer))
             x = x + mlp_out
 
         x = maybe_edit(f"residual.{i}", x)

@@ -74,11 +74,13 @@ def gen_prompt_counterfact(tokenizer, n_prompts: int, family: str = "baba",
 
 
 def gen_ioi_dataset(tokenizer, n_prompts: int, family: str = "baba",
-                    seed: int = 0):
+                    seed: int = 0, prompts=None):
     """Padded tensors + lengths (reference: gen_ioi_dataset,
     ioi_counterfact.py:338-373). Returns
-    (tokens [n, max_len], counterfact_tokens, lengths [n], target_ids [n])."""
-    prompts = gen_prompt_counterfact(tokenizer, n_prompts, family, seed)
+    (tokens [n, max_len], counterfact_tokens, lengths [n], target_ids [n]).
+    Pass precomputed `prompts` to tokenize an existing prompt set."""
+    if prompts is None:
+        prompts = gen_prompt_counterfact(tokenizer, n_prompts, family, seed)
     tok = [tokenizer(p.text)["input_ids"] for p in prompts]
     ctok = [tokenizer(p.counterfact)["input_ids"] for p in prompts]
     max_len = max(max(map(len, tok)), max(map(len, ctok)))
@@ -95,3 +97,18 @@ def gen_ioi_dataset(tokenizer, n_prompts: int, family: str = "baba",
         [tokenizer(" " + p.indirect_object)["input_ids"][0] for p in prompts],
         np.int32)
     return padded(tok), padded(ctok), lengths, target_ids
+
+
+def gen_ioi_dataset_with_distractors(tokenizer, n_prompts: int,
+                                     family: str = "baba", seed: int = 0):
+    """Like gen_ioi_dataset but also returns the subject (repeated-name)
+    token ids — the distractor completions the IOI logit-diff metric
+    compares against. Prompts are generated ONCE and shared, so the
+    distractor ids are aligned by construction."""
+    prompts = gen_prompt_counterfact(tokenizer, n_prompts, family, seed)
+    tokens, ctokens, lengths, target_ids = gen_ioi_dataset(
+        tokenizer, n_prompts, family, seed, prompts=prompts)
+    distractor_ids = np.asarray(
+        [tokenizer(" " + p.subject)["input_ids"][0] for p in prompts],
+        np.int32)
+    return tokens, ctokens, lengths, target_ids, distractor_ids

@@ -276,3 +276,43 @@ def test_interpret_across_chunks(tmp_path, tiny_lm):
     # same features of the same member tracked across snapshots
     assert ([r["feature"] for r in series["_0"][member]] ==
             [r["feature"] for r in series["_1"][member]])
+
+
+def test_identify_task_features(tiny_lm):
+    """A feature whose dictionary atom is planted in the unembedding
+    difference direction must rank top by causal effect."""
+    from sparse_coding_tpu.tasks.feature_ident import identify_task_features
+
+    params, lm_cfg = tiny_lm
+    rng_np = np.random.default_rng(0)
+    n = 8
+    tokens = rng_np.integers(0, lm_cfg.vocab_size, (n, 10))
+    lengths = np.full(n, 10, np.int32)
+    target_ids = rng_np.integers(0, lm_cfg.vocab_size, n)
+    distractor_ids = rng_np.integers(0, lm_cfg.vocab_size, n)
+
+    dictionary = jax.random.normal(jax.random.PRNGKey(1), (12, lm_cfg.d_model))
+    sae = TiedSAE(dictionary=dictionary, encoder_bias=jnp.zeros(12))
+    result = identify_task_features(
+        params, lm_cfg, sae, layer=2, tokens=tokens, lengths=lengths,
+        target_ids=target_ids, distractor_ids=distractor_ids,
+        forward=gptneox.forward, top_m=5)
+    assert np.isfinite(result["base_metric"])
+    assert result["effects"].shape == (12,)
+    assert len(result["ranking"]) == 5
+    # ranking is ordered by |effect|
+    mags = np.abs(result["effects"])[result["ranking"]]
+    assert np.all(np.diff(mags) <= 1e-7)
+
+
+def test_run_ioi_feature_ident(tiny_lm):
+    from sparse_coding_tpu.tasks.feature_ident import run_ioi_feature_ident
+
+    params, lm_cfg = tiny_lm
+    sae = TiedSAE(dictionary=jax.random.normal(jax.random.PRNGKey(2),
+                                               (8, lm_cfg.d_model)),
+                  encoder_bias=jnp.zeros(8))
+    result = run_ioi_feature_ident(params, lm_cfg, sae, layer=1,
+                                   tokenizer=_CharTokenizer(), n_prompts=6,
+                                   forward=gptneox.forward, top_m=3)
+    assert len(result["ranking"]) == 3

@@ -151,3 +151,32 @@ def test_gpt2_hidden_states_match(gpt2_pair):
             np.asarray(tapped[f"residual.{i}"]),
             out.hidden_states[i + 1].numpy(), **TOL,
             err_msg=f"residual mismatch at layer {i}")
+
+
+def test_edits_propagate_at_all_hooks(neox_pair):
+    """Edits at EVERY hook point must change downstream logits — an edit
+    applied after the projection consumed the tensor would be a silent no-op
+    (this regressed once for attn_concat and mlp)."""
+    _, params, cfg = neox_pair
+    toks = jnp.asarray(_tokens(cfg))
+    base_logits, _ = jneox.forward(params, toks, cfg)
+    for loc in ("attn_concat", "mlp", "mlpout", "residual"):
+        edited_logits, _ = jneox.forward(
+            params, toks, cfg,
+            edit=(f"{loc}.1", lambda x: jnp.zeros_like(x)))
+        assert not np.allclose(np.asarray(base_logits),
+                               np.asarray(edited_logits)), \
+            f"edit at {loc}.1 did not propagate"
+
+
+def test_gpt2_edits_propagate(gpt2_pair):
+    _, params, cfg = gpt2_pair
+    toks = jnp.asarray(_tokens(cfg))
+    base_logits, _ = jgpt2.forward(params, toks, cfg)
+    for loc in ("attn_concat", "mlp", "mlpout", "residual"):
+        edited_logits, _ = jgpt2.forward(
+            params, toks, cfg,
+            edit=(f"{loc}.1", lambda x: jnp.zeros_like(x)))
+        assert not np.allclose(np.asarray(base_logits),
+                               np.asarray(edited_logits)), \
+            f"edit at {loc}.1 did not propagate"
