@@ -1,0 +1,92 @@
+"""Example: single-feature case study.
+
+The script equivalent of the reference's research notebooks
+(minimal_feature_interp.ipynb / case_studies_loop.ipynb): pick a trained
+dictionary feature and characterize it from every angle the framework offers —
+top activating fragments with per-token activations, firing statistics,
+nearest dictionary neighbors, and its causal effect on the LM's loss when
+ablated.
+
+    python examples/feature_case_study.py [feature_index]
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.data.chunk_store import ChunkStore, device_prefetch
+from sparse_coding_tpu.data.harvest import harvest_activations
+from sparse_coding_tpu.data.tokenize import pack_tokens
+from sparse_coding_tpu.ensemble import Ensemble
+from sparse_coding_tpu.interp.fragments import build_fragment_activations, sample_fragments
+from sparse_coding_tpu.lm import gptneox
+from sparse_coding_tpu.lm.model_config import tiny_test_config
+from sparse_coding_tpu.metrics.intervention import ablate_feature_edit, lm_loss
+from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+
+LAYER = 1
+
+lm_cfg = tiny_test_config("gptneox")
+params = gptneox.init_params(jax.random.PRNGKey(0), lm_cfg)
+
+# corpus -> activations -> quick SAE
+rng = np.random.default_rng(0)
+docs = [list(rng.integers(1, lm_cfg.vocab_size, rng.integers(20, 60)))
+        for _ in range(200)]
+rows = pack_tokens(docs, max_length=32, eos_token_id=lm_cfg.eos_token_id)
+harvest_activations(params, lm_cfg, rows, layers=[LAYER], layer_loc="residual",
+                    output_folder="case_study_acts", model_batch_size=8,
+                    dtype="float16", forward=gptneox.forward)
+store = ChunkStore(f"case_study_acts/residual.{LAYER}")
+member = FunctionalTiedSAE.init(jax.random.PRNGKey(1), lm_cfg.d_model,
+                                2 * lm_cfg.d_model, l1_alpha=1e-3)
+ens = Ensemble([member], FunctionalTiedSAE, lr=3e-3)
+for epoch in range(3):
+    for batch in device_prefetch(store.epoch(256, np.random.default_rng(epoch))):
+        ens.step_batch(batch)
+sae = ens.to_learned_dicts()[0]
+
+# fragment activations for the case study
+fragments = sample_fragments(rows, fragment_len=16, n_fragments=128)
+fa, lookup = build_fragment_activations(params, lm_cfg, sae, fragments, LAYER,
+                                        batch_size=16, forward=gptneox.forward)
+
+feature = int(sys.argv[1]) if len(sys.argv) > 1 else int(
+    jnp.argmax(jnp.sum(fa.max_per_fragment, axis=0)))
+if not 0 <= feature < sae.n_feats:  # jnp indexing would silently clamp
+    raise SystemExit(f"feature {feature} out of range [0, {sae.n_feats})")
+print(f"=== case study: feature {feature} ===")
+
+# 1. firing statistics over the corpus
+chunk = jnp.asarray(store.load_chunk(0))
+codes = sae.encode(sae.center(chunk))
+freq = float(jnp.mean(codes[:, feature] > 0))
+print(f"firing frequency: {freq:.4f}; mean active value: "
+      f"{float(jnp.sum(codes[:, feature]) / (1e-9 + jnp.sum(codes[:, feature] > 0))):.4f}")
+
+# 2. top activating fragments with per-token breakdown
+top_idx, top_vals = fa.top_fragments(feature, 3)
+for rank, (fi, val) in enumerate(zip(np.asarray(top_idx), np.asarray(top_vals))):
+    acts = lookup.tokens_activations(int(fi), feature)
+    toks = [f"t{int(t)}" for t in np.asarray(fa.fragments[fi])]
+    marked = " ".join(f"[{t}:{a:.1f}]" if a > 0 else t
+                      for t, a in zip(toks, acts))
+    print(f"top-{rank + 1} fragment (max {val:.3f}): {marked}")
+
+# 3. nearest dictionary neighbors (cosine)
+d = sae.get_learned_dict()
+sims = np.asarray(d @ d[feature])
+order = np.argsort(-sims)[1:4]
+print("nearest atoms:", [(int(i), round(float(sims[i]), 3)) for i in order])
+
+# 4. causal effect: LM loss with the feature ablated everywhere
+toks = jnp.asarray(rows[:16])
+base = float(lm_loss(gptneox.forward(params, toks, lm_cfg)[0], toks))
+edited_logits, _ = gptneox.forward(
+    params, toks, lm_cfg,
+    edit=(f"residual.{LAYER}", ablate_feature_edit(sae, feature)))
+ablated = float(lm_loss(edited_logits, toks))
+print(f"LM loss base={base:.5f} ablated={ablated:.5f} "
+      f"(delta {ablated - base:+.5f})")

@@ -40,6 +40,7 @@ from sparse_coding_tpu.parallel.mesh import batch_sharding, make_mesh
 from sparse_coding_tpu.utils.artifacts import save_learned_dicts
 from sparse_coding_tpu.utils.checkpoint import restore_ensemble, save_ensemble
 from sparse_coding_tpu.utils.logging import MetricsLogger
+from sparse_coding_tpu.utils.profiling import StepTimer
 
 EnsembleLike = Union[Ensemble, EnsembleGroup]
 # ensemble_init_fn(cfg, mesh) -> list of (ensemble, per-member hyperparams, name)
@@ -139,10 +140,14 @@ def sweep(
     else:
         save_points = {2**k - 1 for k in range(3, 10)}
     step = 0
+    timer = StepTimer(warmup=3)  # activations/sec — the north-star metric
 
     for ci, chunk_idx in enumerate(chunk_order):
         if ci < chunks_done:
             continue
+        # fresh throughput window per chunk: checkpoint/artifact wall time
+        # between chunks must not dilute the training-rate signal
+        timer.reset()
         chunk = store.load_chunk(int(chunk_idx))
         if center is not None:
             chunk = chunk - center
@@ -163,6 +168,10 @@ def sweep(
                                     f"{sub_name}/loss_max": float(np.max(losses)),
                                     f"{sub_name}/l0_mean": float(np.mean(l0))},
                                    step=step)
+            timer.tick(batch.shape[0])
+            if step % log_every == 0:
+                logger.log({"activations_per_sec": timer.items_per_sec},
+                           step=step)
         # checkpoint + periodic artifact saves; the RNG state makes the data
         # stream resume exactly where it stopped
         rng_state = rng.bit_generator.state
