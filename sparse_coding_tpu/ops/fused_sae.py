@@ -63,11 +63,12 @@ def _kernel(alpha_ref, x_ref, w_ref, b_ref, dw_ref, db_ref, act_ref, loss_ref,
             *, total_batch: int, d_act: int):
     import jax.experimental.pallas as pl
 
+    m = pl.program_id(0)
     i = pl.program_id(1)
     w = w_ref[0]  # [n, d]
     xb = x_ref[...]  # [Bt, d]
-    b = b_ref[0]  # [n]
-    alpha = alpha_ref[0, 0]
+    b = b_ref[0, 0]  # [n]  (operand carried as [N, 1, n] for Mosaic tiling)
+    alpha = alpha_ref[m]  # scalar-prefetched [N] array in SMEM
 
     pre = jnp.dot(xb, w.T, preferred_element_type=jnp.float32) + b[None, :]
     c = jnp.maximum(pre, 0.0)
@@ -85,20 +86,20 @@ def _kernel(alpha_ref, x_ref, w_ref, b_ref, dw_ref, db_ref, act_ref, loss_ref,
     mse_part = jnp.sum(r * r) / (total_batch * d_act)
     l1_part = alpha * jnp.sum(c) / total_batch
     l0_part = jnp.sum(mask) / total_batch
-    part = jnp.stack([mse_part, l1_part, l0_part])[None, :]
+    part = jnp.stack([mse_part, l1_part, l0_part])[None, None, :]
 
     @pl.when(i == 0)
     def _init():
         dw_ref[0] = dw
-        db_ref[0] = db
-        act_ref[0] = activity
+        db_ref[0, 0] = db
+        act_ref[0, 0] = activity
         loss_ref[...] = part
 
     @pl.when(i > 0)
     def _acc():
         dw_ref[0] += dw
-        db_ref[0] += db
-        act_ref[0] += activity
+        db_ref[0, 0] += db
+        act_ref[0, 0] += activity
         loss_ref[...] += part
 
 
@@ -124,33 +125,44 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
     assert n_tiles * batch_tile == total_batch
 
     kernel = functools.partial(_kernel, total_batch=total_batch, d_act=d)
-    grid = (n_members, n_tiles)
+
+    # alphas ride scalar prefetch (SMEM, whole [N] array) — ordinary SMEM
+    # blocks can't tile a [N, 1] array per-member (Mosaic requires the
+    # sublane dim to match or divide by 8, caught by AOT TPU lowering)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_members, n_tiles),
+        in_specs=[
+            pl.BlockSpec((batch_tile, d), lambda m, i, *_: (i, 0)),  # x
+            pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0)),  # W
+            # [N, n] operands ride as [N, 1, n]: a (1, n) 2-D block would
+            # violate Mosaic's sublane rule (1 ∤ 8 and 1 != N)
+            pl.BlockSpec((1, 1, n_feats), lambda m, i, *_: (m, 0, 0)),  # b
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0)),
+            pl.BlockSpec((1, 1, n_feats), lambda m, i, *_: (m, 0, 0)),
+            pl.BlockSpec((1, 1, n_feats), lambda m, i, *_: (m, 0, 0)),
+            pl.BlockSpec((1, 1, 3), lambda m, i, *_: (m, 0, 0)),
+        ],
+    )
 
     dw, db, activity, losses = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda m, i: (m, 0),
-                         memory_space=pltpu.SMEM),  # alphas [N, 1]
-            pl.BlockSpec((batch_tile, d), lambda m, i: (i, 0)),  # x
-            pl.BlockSpec((1, n_feats, d), lambda m, i: (m, 0, 0)),  # W
-            pl.BlockSpec((1, n_feats), lambda m, i: (m, 0)),  # b
-        ],
-        out_specs=[
-            pl.BlockSpec((1, n_feats, d), lambda m, i: (m, 0, 0)),
-            pl.BlockSpec((1, n_feats), lambda m, i: (m, 0)),
-            pl.BlockSpec((1, n_feats), lambda m, i: (m, 0)),
-            pl.BlockSpec((1, 3), lambda m, i: (m, 0)),
-        ],
+        grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((n_members, n_feats, d), jnp.float32),
-            jax.ShapeDtypeStruct((n_members, n_feats), jnp.float32),
-            jax.ShapeDtypeStruct((n_members, n_feats), jnp.float32),
-            jax.ShapeDtypeStruct((n_members, 3), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, 1, n_feats), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, 1, n_feats), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, 1, 3), jnp.float32),
         ],
         interpret=interpret,
-    )(alphas.reshape(n_members, 1).astype(jnp.float32), batch, w_normed, bias)
+    )(alphas.astype(jnp.float32), batch, w_normed,
+      bias.reshape(n_members, 1, n_feats))
 
+    db = db.reshape(n_members, n_feats)
+    activity = activity.reshape(n_members, n_feats)
+    losses = losses.reshape(n_members, 3)
     loss_dict = {"mse": losses[:, 0], "l1": losses[:, 1], "l0": losses[:, 2]}
     return loss_dict, dw, db, activity
 

@@ -11,6 +11,7 @@ from sparse_coding_tpu.models.sae import FunctionalTiedSAE
 from sparse_coding_tpu.models.signatures import make_aux
 from sparse_coding_tpu.ops.fused_sae import (
     fused_supported,
+    fused_tied_sae_grads,
     fused_tied_sae_loss_and_grads,
 )
 from sparse_coding_tpu.utils.trees import stack_trees
@@ -113,3 +114,16 @@ def test_fused_supported_budget():
     assert pick_batch_tile(2048, 2048, 512) == 128
     assert not fused_supported(1, 2048, 65536, 2048)  # too big for VMEM
     assert not fused_supported(1, 1000, 64, 32)  # no dividing tile
+
+
+def test_kernel_lowers_for_tpu():
+    """AOT Mosaic lowering check — catches TPU tiling-rule violations that
+    interpret mode can't see (SMEM block shapes, sublane rules), without
+    needing hardware."""
+    shapes = [((2, 64, 32), (2, 64), (2,), (256, 32)),
+              ((32, 2048, 512), (32, 2048), (32,), (2048, 512))]
+    for ws, bs, as_, xs in shapes:
+        w, b, a, x = (jnp.zeros(s) for s in (ws, bs, as_, xs))
+        jax.jit(
+            lambda w, b, a, x: fused_tied_sae_grads(w, b, a, x, batch_tile=64)
+        ).trace(w, b, a, x).lower(lowering_platforms=("tpu",))
