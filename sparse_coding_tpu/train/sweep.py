@@ -34,6 +34,7 @@ from sparse_coding_tpu.ensemble import Ensemble, EnsembleGroup
 from sparse_coding_tpu.metrics.core import (
     fraction_variance_unexplained,
     mean_l0,
+    mean_nonzero_activations,
     mmcs_from_list,
 )
 from sparse_coding_tpu.parallel.mesh import batch_sharding, make_mesh
@@ -212,11 +213,19 @@ def _save_artifacts(ensembles, folder: Path, chunk: np.ndarray,
                           "fvu": float(fraction_variance_unexplained(ld, eval_batch)),
                           "l0": float(mean_l0(ld, eval_batch))})
         (folder / f"{name}_eval.json").write_text(json.dumps(evals, indent=2))
-        if image_metrics and len(dicts) > 1:
-            # MMCS grid vs the other members (reference's image panel,
-            # big_sweep.py:96-133, as data rather than a wandb image)
-            grid = np.asarray(mmcs_from_list(dicts[: min(len(dicts), 8)]))
-            np.save(folder / f"{name}_mmcs_grid.npy", grid)
+        if image_metrics:
+            # MMCS grid + per-dict sparsity histograms (reference's wandb
+            # image panels, big_sweep.py:86-156, as files)
+            from sparse_coding_tpu.plotting.helpers import plot_hist
+
+            if len(dicts) > 1:
+                grid = np.asarray(mmcs_from_list(dicts[: min(len(dicts), 8)]))
+                np.save(folder / f"{name}_mmcs_grid.npy", grid)
+            for di, ld in enumerate(dicts):
+                freqs = mean_nonzero_activations(ld, eval_batch)
+                plot_hist(jnp.log10(jnp.clip(freqs, 1e-6)),
+                          x_label="log10 firing frequency", y_label="features",
+                          save_path=folder / f"{name}_{di}_sparsity_hist.png")
 
 
 def main(argv=None) -> None:
