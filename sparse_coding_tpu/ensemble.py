@@ -342,6 +342,60 @@ class Ensemble:
         return [self.sig.to_learned_dict(p, b) for p, b in self.unstack()]
 
 
+@jax.jit
+def resurrect_ensemble_features(state: EnsembleState, dead_mask: Array,
+                                key: Array) -> EnsembleState:
+    """Reinitialize dead features across ALL ensemble members in one vmapped
+    pass: dead dictionary rows get fresh random unit directions scaled to the
+    member's mean row norm, their biases reset to 0, and their Adam moments
+    zeroed. Generalizes the reference's single-model resurrection
+    (huge_batch_size.py:224-250) to the vmapped ensemble; track deadness by
+    accumulating `aux.feat_activity` between calls.
+
+    dead_mask: [N, n_feats] bool."""
+    params = dict(state.params)
+    n_members, n_feats = dead_mask.shape
+
+    # per-feature scalar params reset to their init values when dead
+    # (covers every signature's per-feature extras, e.g. the thresholding
+    # SAE's gate scale/gain — a dead gate would otherwise stay closed)
+    reset_defaults = {"activation_scale": 1.0}
+
+    def refresh_rows(w, sub_key):  # w: [N, n, d]
+        fresh = jax.random.normal(sub_key, w.shape, w.dtype)
+        fresh = fresh / jnp.linalg.norm(fresh, axis=-1, keepdims=True)
+        # scale to the member's mean LIVE-row norm: including dead rows would
+        # shrink reinits progressively across resurrection cycles
+        norms = jnp.linalg.norm(w, axis=-1)  # [N, n]
+        live = ~dead_mask
+        live_count = jnp.maximum(jnp.sum(live, axis=-1), 1)
+        scale = jnp.sum(norms * live, axis=-1) / live_count  # [N]
+        fresh = fresh * scale[:, None, None]
+        return jnp.where(dead_mask[..., None], fresh, w)
+
+    keys = iter(jax.random.split(key, len(params)))
+    for name, leaf in params.items():
+        if leaf.ndim == 3 and leaf.shape[:2] == (n_members, n_feats):
+            params[name] = refresh_rows(leaf, next(keys))
+        elif leaf.shape == (n_members, n_feats):
+            params[name] = jnp.where(dead_mask,
+                                     reset_defaults.get(name, 0.0), leaf)
+        # other shapes (e.g. learnable centers [N, d]) are not per-feature
+
+    def reset_moment(tree):
+        def reset(m):
+            if m.ndim == 3 and m.shape[:2] == (n_members, n_feats):
+                return jnp.where(dead_mask[..., None], 0.0, m)
+            if m.shape == (n_members, n_feats):
+                return jnp.where(dead_mask, 0.0, m)
+            return m
+        return {k: reset(v) for k, v in tree.items()}
+
+    opt_state = state.opt_state._replace(mu=reset_moment(state.opt_state.mu),
+                                         nu=reset_moment(state.opt_state.nu))
+    return state.replace(params=params, opt_state=opt_state)
+
+
 def shard_ensemble_state(state: EnsembleState, mesh: Mesh) -> EnsembleState:
     """Place a stacked state on a mesh: ensemble axis over "model"
     (each model-shard owns N/mesh_model members, the analogue of one

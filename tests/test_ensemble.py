@@ -140,3 +140,36 @@ def test_run_steps_matches_loop(rng):
     for name in p_scan:
         np.testing.assert_allclose(p_scan[name], p_loop[name], rtol=1e-5,
                                    atol=1e-7, err_msg=name)
+
+
+def test_resurrect_ensemble_features(rng):
+    """Dead rows get fresh directions + zeroed bias/Adam moments; live rows
+    untouched; training continues finite afterwards."""
+    from sparse_coding_tpu.ensemble import resurrect_ensemble_features
+
+    k_init, k_data, k_res = jax.random.split(rng, 3)
+    members = _members(k_init, FunctionalTiedSAE, 2, l1_alpha=1e-3)
+    ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, donate=False)
+    batch = jax.random.normal(k_data, (BATCH, D))
+    for _ in range(3):
+        ens.step_batch(batch)
+
+    dead = np.zeros((2, N_DICT), bool)
+    dead[0, :5] = True
+    dead[1, 10:12] = True
+    old = jax.device_get(ens.state.params)
+    ens.state = resurrect_ensemble_features(ens.state, jnp.asarray(dead),
+                                            k_res)
+    new = jax.device_get(ens.state.params)
+
+    assert not np.allclose(new["encoder"][0, :5], old["encoder"][0, :5])
+    np.testing.assert_array_equal(new["encoder"][0, 5:], old["encoder"][0, 5:])
+    np.testing.assert_array_equal(new["encoder"][1, :10],
+                                  old["encoder"][1, :10])
+    assert np.all(new["encoder_bias"][0, :5] == 0.0)
+    mu = jax.device_get(ens.state.opt_state.mu)
+    assert np.max(np.abs(mu["encoder"][0, :5])) == 0.0
+    assert np.max(np.abs(mu["encoder"][0, 5:])) > 0.0
+    # training continues cleanly on the resurrected state
+    aux = ens.step_batch(batch)
+    assert np.all(np.isfinite(np.asarray(aux.losses["loss"])))
