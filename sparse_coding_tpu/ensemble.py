@@ -25,6 +25,7 @@ is still vmapped internally.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional, Sequence
 
 import flax.struct as struct
@@ -342,24 +343,40 @@ class Ensemble:
         return [self.sig.to_learned_dict(p, b) for p, b in self.unstack()]
 
 
-@jax.jit
-def resurrect_ensemble_features(state: EnsembleState, dead_mask: Array,
-                                key: Array) -> EnsembleState:
-    """Reinitialize dead features across ALL ensemble members in one vmapped
-    pass: dead dictionary rows get fresh random unit directions scaled to the
-    member's mean row norm, their biases reset to 0, and their Adam moments
-    zeroed. Generalizes the reference's single-model resurrection
-    (huge_batch_size.py:224-250) to the vmapped ensemble; track deadness by
-    accumulating `aux.feat_activity` between calls.
+# Per-feature param contract for resurrection: which TOP-LEVEL param names
+# are dictionary rows (refreshed with new directions) and which are
+# per-feature scalars (reset to their signature's init value). Name-based on
+# purpose — shape-based guessing collides (a learnable center [N, d] equals
+# [N, n_feats] whenever the dict ratio is 1). Signatures with other
+# per-feature params pass their own `scalar_defaults`.
+_RESURRECT_ROW_PARAMS = ("encoder", "decoder")
+_RESURRECT_SCALAR_DEFAULTS = {
+    "encoder_bias": 0.0,
+    "activation_scale": 1.0,  # thresholding gate (models/sae.py init)
+    "activation_gain": 0.0,
+    "threshold": 0.0,
+}
 
-    dead_mask: [N, n_feats] bool."""
+
+@functools.partial(jax.jit, static_argnames=("scalar_defaults",))
+def resurrect_ensemble_features(
+        state: EnsembleState, dead_mask: Array, key: Array,
+        scalar_defaults: tuple = tuple(sorted(_RESURRECT_SCALAR_DEFAULTS.items())),
+) -> EnsembleState:
+    """Reinitialize dead features across ALL ensemble members in one vmapped
+    pass: dead dictionary rows ("encoder"/"decoder") get fresh random unit
+    directions scaled to the member's mean LIVE-row norm, per-feature scalars
+    reset to their init values, and their Adam moments zeroed. Generalizes
+    the reference's single-model resurrection (huge_batch_size.py:224-250)
+    to the vmapped ensemble; track deadness by accumulating
+    `aux.feat_activity` between calls.
+
+    Only the named top-level params are touched — nested pytrees (e.g.
+    LISTA's encoder_layers) and non-per-feature params (learnable centers)
+    are left alone by design. dead_mask: [N, n_feats] bool."""
     params = dict(state.params)
     n_members, n_feats = dead_mask.shape
-
-    # per-feature scalar params reset to their init values when dead
-    # (covers every signature's per-feature extras, e.g. the thresholding
-    # SAE's gate scale/gain — a dead gate would otherwise stay closed)
-    reset_defaults = {"activation_scale": 1.0}
+    defaults = dict(scalar_defaults)
 
     def refresh_rows(w, sub_key):  # w: [N, n, d]
         fresh = jax.random.normal(sub_key, w.shape, w.dtype)
@@ -373,23 +390,24 @@ def resurrect_ensemble_features(state: EnsembleState, dead_mask: Array,
         fresh = fresh * scale[:, None, None]
         return jnp.where(dead_mask[..., None], fresh, w)
 
-    keys = iter(jax.random.split(key, len(params)))
-    for name, leaf in params.items():
-        if leaf.ndim == 3 and leaf.shape[:2] == (n_members, n_feats):
-            params[name] = refresh_rows(leaf, next(keys))
-        elif leaf.shape == (n_members, n_feats):
-            params[name] = jnp.where(dead_mask,
-                                     reset_defaults.get(name, 0.0), leaf)
-        # other shapes (e.g. learnable centers [N, d]) are not per-feature
+    keys = iter(jax.random.split(key, len(_RESURRECT_ROW_PARAMS)))
+    for name in _RESURRECT_ROW_PARAMS:
+        if name in params:
+            params[name] = refresh_rows(params[name], next(keys))
+    for name, default in defaults.items():
+        if name in params:
+            params[name] = jnp.where(dead_mask, default, params[name])
+
+    touched = set(_RESURRECT_ROW_PARAMS) | set(defaults)
 
     def reset_moment(tree):
-        def reset(m):
-            if m.ndim == 3 and m.shape[:2] == (n_members, n_feats):
+        def reset(name, m):
+            if name not in touched or not hasattr(m, "ndim"):
+                return m
+            if name in _RESURRECT_ROW_PARAMS:
                 return jnp.where(dead_mask[..., None], 0.0, m)
-            if m.shape == (n_members, n_feats):
-                return jnp.where(dead_mask, 0.0, m)
-            return m
-        return {k: reset(v) for k, v in tree.items()}
+            return jnp.where(dead_mask, 0.0, m)
+        return {k: reset(k, v) for k, v in tree.items()}
 
     opt_state = state.opt_state._replace(mu=reset_moment(state.opt_state.mu),
                                          nu=reset_moment(state.opt_state.nu))

@@ -173,3 +173,30 @@ def test_resurrect_ensemble_features(rng):
     # training continues cleanly on the resurrected state
     aux = ens.step_batch(batch)
     assert np.all(np.isfinite(np.asarray(aux.losses["loss"])))
+
+
+def test_resurrect_lista_and_centered_are_safe(rng):
+    """Nested-pytree params (LISTA) don't crash resurrection, and a learnable
+    center [N, d] with d == n_feats is NOT mistaken for a per-feature param."""
+    from sparse_coding_tpu.ensemble import resurrect_ensemble_features
+    from sparse_coding_tpu.models.lista import FunctionalLISTADenoisingSAE
+    from sparse_coding_tpu.models.sae import FunctionalTiedCenteredSAE
+
+    keys = jax.random.split(rng, 3)
+    lista = Ensemble([FunctionalLISTADenoisingSAE.init(keys[0], D, N_DICT,
+                                                       l1_alpha=1e-3)],
+                     FunctionalLISTADenoisingSAE, donate=False)
+    dead = jnp.zeros((1, N_DICT), bool).at[0, :4].set(True)
+    lista.state = resurrect_ensemble_features(lista.state, dead, keys[1])
+    aux = lista.step_batch(jax.random.normal(keys[2], (BATCH, D)))
+    assert np.all(np.isfinite(np.asarray(aux.losses["loss"])))
+
+    # dict ratio 1: center [N, d] has the same shape as [N, n_feats]
+    centered = Ensemble([FunctionalTiedCenteredSAE.init(
+        keys[0], D, D, l1_alpha=1e-3,
+        center=jnp.full((D,), 0.7))], FunctionalTiedCenteredSAE, donate=False)
+    dead = jnp.zeros((1, D), bool).at[0, :3].set(True)
+    centered.state = resurrect_ensemble_features(centered.state, dead, keys[1])
+    center = np.asarray(centered.state.params["center"])
+    np.testing.assert_allclose(center, 0.7, rtol=1e-6,
+                               err_msg="center corrupted by resurrection")
