@@ -345,35 +345,51 @@ class Ensemble:
 
 # Per-feature param contract for resurrection: which TOP-LEVEL param names
 # are dictionary rows (refreshed with new directions) and which are
-# per-feature scalars (reset to their signature's init value). Name-based on
-# purpose — shape-based guessing collides (a learnable center [N, d] equals
-# [N, n_feats] whenever the dict ratio is 1). Signatures with other
-# per-feature params pass their own `scalar_defaults`.
-_RESURRECT_ROW_PARAMS = ("encoder", "decoder")
+# per-feature scalars (reset when dead). Name-based on purpose — shape-based
+# guessing collides (a learnable center [N, d] equals [N, n_feats] whenever
+# the dict ratio is 1). Covers the built-in zoo: encoder/decoder (SAEs),
+# weights (RICA), enc1_w (semilinear second encoder layer). Signatures with
+# other per-feature params pass their own row_params / scalar_defaults.
+_RESURRECT_ROW_PARAMS = ("encoder", "decoder", "weights", "enc1_w")
 _RESURRECT_SCALAR_DEFAULTS = {
     "encoder_bias": 0.0,
+    "enc1_b": 0.0,
     "activation_scale": 1.0,  # thresholding gate (models/sae.py init)
     "activation_gain": 0.0,
-    "threshold": 0.0,
+}
+# signatures whose per-feature scalar init is a nonzero constant
+_SIG_SCALAR_OVERRIDES = {
+    "positive_tied_sae": {"encoder_bias": -1.0},  # models/positive.py init
 }
 
 
-@functools.partial(jax.jit, static_argnames=("scalar_defaults",))
 def resurrect_ensemble_features(
         state: EnsembleState, dead_mask: Array, key: Array,
-        scalar_defaults: tuple = tuple(sorted(_RESURRECT_SCALAR_DEFAULTS.items())),
-) -> EnsembleState:
+        row_params=None, scalar_defaults=None) -> EnsembleState:
     """Reinitialize dead features across ALL ensemble members in one vmapped
-    pass: dead dictionary rows ("encoder"/"decoder") get fresh random unit
-    directions scaled to the member's mean LIVE-row norm, per-feature scalars
-    reset to their init values, and their Adam moments zeroed. Generalizes
-    the reference's single-model resurrection (huge_batch_size.py:224-250)
-    to the vmapped ensemble; track deadness by accumulating
-    `aux.feat_activity` between calls.
+    pass: dead dictionary rows get fresh random unit directions scaled to the
+    member's mean LIVE-row norm, per-feature scalars reset (to the
+    signature's constant init where known, 0 otherwise), and their Adam
+    moments zeroed. Generalizes the reference's single-model resurrection
+    (huge_batch_size.py:224-250) to the vmapped ensemble; track deadness by
+    accumulating `aux.feat_activity` between calls.
 
-    Only the named top-level params are touched — nested pytrees (e.g.
-    LISTA's encoder_layers) and non-per-feature params (learnable centers)
-    are left alone by design. dead_mask: [N, n_feats] bool."""
+    Only named top-level params are touched — nested pytrees (LISTA's
+    encoder_layers) and non-per-feature params (learnable centers) are left
+    alone by design. `row_params` / `scalar_defaults` accept any iterable /
+    mapping and extend the built-in contract. dead_mask: [N, n_feats] bool."""
+    rows = tuple(row_params) if row_params is not None else _RESURRECT_ROW_PARAMS
+    defaults = dict(_RESURRECT_SCALAR_DEFAULTS)
+    defaults.update(_SIG_SCALAR_OVERRIDES.get(state.sig_name, {}))
+    if scalar_defaults is not None:
+        defaults.update(dict(scalar_defaults))
+    return _resurrect_jit(state, dead_mask, key, rows,
+                          tuple(sorted(defaults.items())))
+
+
+@functools.partial(jax.jit, static_argnames=("row_params", "scalar_defaults"))
+def _resurrect_jit(state: EnsembleState, dead_mask: Array, key: Array,
+                   row_params: tuple, scalar_defaults: tuple) -> EnsembleState:
     params = dict(state.params)
     n_members, n_feats = dead_mask.shape
     defaults = dict(scalar_defaults)
@@ -390,21 +406,21 @@ def resurrect_ensemble_features(
         fresh = fresh * scale[:, None, None]
         return jnp.where(dead_mask[..., None], fresh, w)
 
-    keys = iter(jax.random.split(key, len(_RESURRECT_ROW_PARAMS)))
-    for name in _RESURRECT_ROW_PARAMS:
+    keys = iter(jax.random.split(key, len(row_params)))
+    for name in row_params:
         if name in params:
             params[name] = refresh_rows(params[name], next(keys))
     for name, default in defaults.items():
         if name in params:
             params[name] = jnp.where(dead_mask, default, params[name])
 
-    touched = set(_RESURRECT_ROW_PARAMS) | set(defaults)
+    touched = set(row_params) | set(defaults)
 
     def reset_moment(tree):
         def reset(name, m):
             if name not in touched or not hasattr(m, "ndim"):
                 return m
-            if name in _RESURRECT_ROW_PARAMS:
+            if name in row_params:
                 return jnp.where(dead_mask[..., None], 0.0, m)
             return jnp.where(dead_mask, 0.0, m)
         return {k: reset(k, v) for k, v in tree.items()}

@@ -200,3 +200,30 @@ def test_resurrect_lista_and_centered_are_safe(rng):
     center = np.asarray(centered.state.params["center"])
     np.testing.assert_allclose(center, 0.7, rtol=1e-6,
                                err_msg="center corrupted by resurrection")
+
+
+def test_resurrect_rica_and_positive(rng):
+    """RICA's 'weights' rows refresh; positive-tied bias resets to its -1
+    init, not 0; dict scalar_defaults accepted directly."""
+    from sparse_coding_tpu.ensemble import resurrect_ensemble_features
+    from sparse_coding_tpu.models.positive import FunctionalPositiveTiedSAE
+    from sparse_coding_tpu.models.rica import RICA
+
+    keys = jax.random.split(rng, 3)
+    rica = Ensemble([RICA.init(keys[0], D, N_DICT, sparsity_coef=0.1)],
+                    RICA, donate=False)
+    dead = jnp.zeros((1, N_DICT), bool).at[0, :4].set(True)
+    old_w = np.asarray(rica.state.params["weights"])
+    rica.state = resurrect_ensemble_features(rica.state, dead, keys[1])
+    new_w = np.asarray(rica.state.params["weights"])
+    assert not np.allclose(new_w[0, :4], old_w[0, :4])
+    np.testing.assert_array_equal(new_w[0, 4:], old_w[0, 4:])
+
+    pos = Ensemble([FunctionalPositiveTiedSAE.init(keys[0], D, N_DICT,
+                                                   l1_alpha=1e-3)],
+                   FunctionalPositiveTiedSAE, donate=False)
+    pos.step_batch(jax.random.normal(keys[2], (BATCH, D)))
+    pos.state = resurrect_ensemble_features(pos.state, dead, keys[1],
+                                            scalar_defaults={"extra": 0.0})
+    bias = np.asarray(pos.state.params["encoder_bias"])
+    np.testing.assert_allclose(bias[0, :4], -1.0, rtol=1e-6)
