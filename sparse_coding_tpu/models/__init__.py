@@ -4,6 +4,7 @@ from sparse_coding_tpu.models import sae as sae
 from sparse_coding_tpu.models import topk as topk
 # imported for their @register side effects so the string signature registry
 # covers the full model zoo
+from sparse_coding_tpu.models import combination as combination
 from sparse_coding_tpu.models import direct_coef as direct_coef
 from sparse_coding_tpu.models import ica as ica
 from sparse_coding_tpu.models import lista as lista

@@ -1,0 +1,68 @@
+"""Ensemble-combination inference dictionary.
+
+Since this framework trains many SAEs per sweep anyway, combining them at
+inference is nearly free — the "ensembling SAEs" direction from the
+retrieved literature (PAPERS.md: arXiv:2505.16077, bagging/concatenation of
+independently-trained SAEs improves reconstruction and feature coverage;
+technique reference only, no code taken).
+
+`ConcatEnsembleDict` keeps the full `LearnedDict` contract
+(decode(c) == c @ get_learned_dict(), learned_dict.py): the combined
+dictionary is the members' normalized atoms stacked, and `encode` scales
+each member's codes by 1/n_members — so the SUM reconstruction of the
+combined codes equals the MEAN of member reconstructions (bagging), and
+every downstream metric/intervention/erasure path that manipulates
+individual features stays exactly consistent with predict().
+
+Members must use identity centering (enforced at create): with per-member
+affine centering the member atoms would live in different spaces and no
+single combined dictionary could satisfy the contract.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.models.learned_dict import LearnedDict
+
+Array = jax.Array
+
+
+class ConcatEnsembleDict(LearnedDict):
+    """Union-of-features combination: n_feats = Σ member n_feats; codes are
+    member codes scaled by 1/n_members."""
+
+    members: tuple  # of LearnedDict pytrees
+
+    @classmethod
+    def create(cls, members: Sequence[LearnedDict]) -> "ConcatEnsembleDict":
+        if not members:
+            raise ValueError("need at least one member dict")
+        widths = {m.activation_size for m in members}
+        if len(widths) != 1:
+            raise ValueError(f"members disagree on activation size: {widths}")
+        d = widths.pop()
+        probe = jnp.asarray(np.random.default_rng(0).normal(size=(4, d)),
+                            jnp.float32)
+        for i, m in enumerate(members):
+            if not bool(jnp.allclose(m.center(probe), probe, atol=1e-6)):
+                raise ValueError(
+                    f"member {i} has non-identity centering; the combined "
+                    "dictionary contract requires all members in raw space")
+        return cls(members=tuple(members))
+
+    def get_learned_dict(self) -> Array:
+        return jnp.concatenate([m.get_learned_dict() for m in self.members],
+                               axis=0)
+
+    def encode(self, x: Array) -> Array:
+        scale = 1.0 / len(self.members)
+        return jnp.concatenate([m.encode(x) * scale for m in self.members],
+                               axis=-1)
+
+    # decode/predict inherit from LearnedDict: decode(c) = c @ dict, and with
+    # the 1/n_members code scaling that equals the mean member reconstruction

@@ -123,3 +123,68 @@ def test_normalize_rows_handles_zero():
     d = jnp.zeros((4, 8))
     out = normalize_rows(d)
     assert jnp.all(jnp.isfinite(out))
+
+
+def test_concat_ensemble_dict(rng):
+    """Combining trained members improves (or matches) each member's FVU and
+    round-trips through artifacts."""
+    from sparse_coding_tpu.data.synthetic import RandomDatasetGenerator
+    from sparse_coding_tpu.ensemble import Ensemble
+    from sparse_coding_tpu.metrics.core import fraction_variance_unexplained
+    from sparse_coding_tpu.models.combination import ConcatEnsembleDict
+    from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+
+    k_gen, k_init, k_train = jax.random.split(rng, 3)
+    gen = RandomDatasetGenerator.create(k_gen, 24, 32, 5, 0.99)
+    members = [FunctionalTiedSAE.init(k, 24, 48, l1_alpha=1e-3)
+               for k in jax.random.split(k_init, 3)]
+    ens = Ensemble(members, FunctionalTiedSAE, lr=3e-3)
+    key = k_train
+    for _ in range(300):
+        key, sub = jax.random.split(key)
+        ens.step_batch(gen.batch(sub, 256))
+    dicts = ens.to_learned_dicts()
+
+    combo = ConcatEnsembleDict.create(dicts)
+    assert combo.n_feats == 3 * 48
+    key, sub = jax.random.split(key)
+    batch = gen.batch(sub, 2048)
+    member_fvus = [float(fraction_variance_unexplained(d, batch))
+                   for d in dicts]
+    combo_fvu = float(fraction_variance_unexplained(combo, batch))
+    # bagging guarantee is convexity: no worse than the MEAN member FVU
+    assert combo_fvu <= np.mean(member_fvus) + 1e-3, (combo_fvu, member_fvus)
+
+    # the LearnedDict contract holds exactly: decode(c) == c @ dict and
+    # predict is the mean member reconstruction
+    c = combo.encode(batch[:8])
+    assert c.shape == (8, 144)
+    np.testing.assert_allclose(np.asarray(combo.decode(c)),
+                               np.asarray(c @ combo.get_learned_dict()),
+                               rtol=1e-5, atol=1e-6)
+    mean_recon = np.mean([np.asarray(d.predict(batch[:8])) for d in dicts],
+                         axis=0)
+    np.testing.assert_allclose(np.asarray(combo.predict(batch[:8])),
+                               mean_recon, rtol=1e-4, atol=1e-5)
+
+    # centered members are rejected
+    from sparse_coding_tpu.models import TiedSAE
+    centered = TiedSAE(dictionary=dicts[0].dictionary,
+                       encoder_bias=dicts[0].encoder_bias,
+                       centering_trans=jnp.ones(24))
+    with pytest.raises(ValueError, match="centering"):
+        ConcatEnsembleDict.create([dicts[0], centered])
+
+    # artifact roundtrip
+    from sparse_coding_tpu.utils.artifacts import (
+        load_learned_dicts,
+        save_learned_dicts,
+    )
+    import tempfile, pathlib
+    with tempfile.TemporaryDirectory() as td:
+        path = pathlib.Path(td) / "combo.pkl"
+        save_learned_dicts([(combo, {"kind": "concat"})], path)
+        loaded, hyper = load_learned_dicts(path)[0]
+        np.testing.assert_allclose(np.asarray(loaded.predict(batch[:4])),
+                                   np.asarray(combo.predict(batch[:4])),
+                                   rtol=1e-6)
