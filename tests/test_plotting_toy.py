@@ -96,3 +96,37 @@ def test_toy_replication_gate(tmp_path):
     assert (tmp_path / "toy_recovery.json").exists()
     assert (tmp_path / "toy_recovery.png").exists()
     assert max(r["representedness"] for r in results) > 0.85
+
+
+def test_plot_autointerp_vs_baselines(tmp_path):
+    from sparse_coding_tpu.plotting.autointerp import plot_autointerp_vs_baselines
+
+    for name, scores in [("sae", [0.4, 0.5]), ("pca", [0.1, 0.2])]:
+        for i, sc in enumerate(scores):
+            d = tmp_path / "results" / name / f"feature_{i}"
+            d.mkdir(parents=True)
+            (d / "scores.json").write_text(json.dumps(
+                {"feature": i, "top_random_score": sc}))
+    summary = plot_autointerp_vs_baselines(tmp_path / "results",
+                                           save_path=tmp_path / "cmp.png")
+    assert summary["sae"][0] > summary["pca"][0]
+    assert (tmp_path / "cmp.png").exists()
+
+
+def test_s3_transfer_gated_without_boto3(tmp_path, monkeypatch):
+    import builtins
+    import sys
+
+    from sparse_coding_tpu.utils import ops
+
+    real_import = builtins.__import__
+
+    def no_boto(name, *a, **k):
+        if name == "boto3":
+            raise ImportError("gated")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_boto)
+    monkeypatch.delitem(sys.modules, "boto3", raising=False)
+    with pytest.raises(ImportError, match="boto3"):
+        ops.upload_to_aws(tmp_path / "x", "bucket")
