@@ -107,17 +107,35 @@ def harvest_activations(
     target_rows_per_chunk = next(iter(writers.values())).rows_per_chunk
     skip_rows = skip_chunks * (target_rows_per_chunk // seq_len)
 
+    # device→host double buffering: batch i's activations stream back while
+    # batch i+1 computes, so the host-side chunk writer never stalls the LM
+    from collections import deque
+
+    pending: deque = deque()
+
+    def drain_one() -> bool:
+        tapped = pending.popleft()
+        for name, acts in tapped.items():
+            writers[name].add(np.asarray(acts))
+        return (n_chunks is not None and all(
+            w.chunk_index - skip_chunks >= n_chunks for w in writers.values()))
+
+    done = False
     for lo in range(skip_rows, n_rows, model_batch_size):
         batch = jnp.asarray(token_rows[lo:lo + model_batch_size])
         if batch.shape[0] < model_batch_size:
             break  # keep shapes static for jit
         tapped = harvest(batch)
-        for name, acts in tapped.items():
-            writers[name].add(jax.device_get(acts))
+        for acts in tapped.values():
+            acts.copy_to_host_async()
+        pending.append(tapped)
         rows_done += batch.shape[0]
-        if n_chunks is not None and all(
-                w.chunk_index - skip_chunks >= n_chunks for w in writers.values()):
-            break
+        if len(pending) > 1:
+            if drain_one():
+                done = True
+                break
+    while pending and not done:
+        done = drain_one()
 
     out = {}
     for name, w in writers.items():

@@ -67,18 +67,22 @@ class FragmentActivations(struct.PyTreeNode):
 
 class TokenActivationLookup:
     """Lazy per-token activations: recomputes codes for just the requested
-    fragments (a handful per feature) instead of holding [N, L, F] on device."""
+    fragments (a handful per feature) instead of holding [N, L, F] on device.
+    The host cache is LRU-bounded so interpreting thousands of features over
+    a large fragment pool can't grow without limit."""
 
-    def __init__(self, fragments: Array, encode_batch: Callable[[Array], Array]):
+    def __init__(self, fragments: Array, encode_batch: Callable[[Array], Array],
+                 cache_size: int = 512):
+        import functools
+
         self._fragments = fragments
         self._encode_batch = encode_batch
-        self._cache: dict[int, np.ndarray] = {}
+        self._codes_for = functools.lru_cache(maxsize=max(1, cache_size))(
+            self._compute_codes)
 
-    def _codes_for(self, fragment_idx: int) -> np.ndarray:
-        if fragment_idx not in self._cache:
-            c = self._encode_batch(self._fragments[fragment_idx][None, :])
-            self._cache[fragment_idx] = np.asarray(jax.device_get(c[0]))
-        return self._cache[fragment_idx]
+    def _compute_codes(self, fragment_idx: int) -> np.ndarray:
+        c = self._encode_batch(self._fragments[fragment_idx][None, :])
+        return np.asarray(jax.device_get(c[0]))
 
     def tokens_activations(self, fragment_idx: int, feature: int) -> np.ndarray:
         return self._codes_for(int(fragment_idx))[:, feature]

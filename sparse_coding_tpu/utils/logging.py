@@ -21,6 +21,7 @@ class MetricsLogger:
         self.folder.mkdir(parents=True, exist_ok=True)
         self.path = self.folder / "metrics.jsonl"
         self._fh = self.path.open("a")
+        self._writes = 0
         self.wandb = None
         if use_wandb:
             try:
@@ -31,10 +32,15 @@ class MetricsLogger:
             except Exception:
                 self.wandb = None  # offline image: silently fall back to JSONL
 
+    _FLUSH_EVERY = 50  # bound crash-loss of buffered JSONL records
+
     def log(self, metrics: dict[str, Any], step: Optional[int] = None) -> None:
         rec = {"ts": time.time(), **({"step": step} if step is not None else {}),
                **metrics}
         self._fh.write(json.dumps(rec, default=float) + "\n")
+        self._writes += 1
+        if self._writes % self._FLUSH_EVERY == 0:
+            self._fh.flush()
         if self.wandb is not None:
             self.wandb.log(metrics, step=step)
 
