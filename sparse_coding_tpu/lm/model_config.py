@@ -36,11 +36,18 @@ PRESETS: dict[str, LMConfig] = {
     "EleutherAI/pythia-70m-deduped": _pythia(512, 6, 8),
     "EleutherAI/pythia-70m": _pythia(512, 6, 8),
     "EleutherAI/pythia-160m-deduped": _pythia(768, 12, 12),
+    "EleutherAI/pythia-160m": _pythia(768, 12, 12),
     "EleutherAI/pythia-410m-deduped": _pythia(1024, 24, 16),
+    "EleutherAI/pythia-410m": _pythia(1024, 24, 16),
+    "EleutherAI/pythia-1b-deduped": _pythia(2048, 16, 8),
     "EleutherAI/pythia-1.4b-deduped": _pythia(2048, 24, 16),
+    "EleutherAI/pythia-1.4b": _pythia(2048, 24, 16),
     "gpt2": LMConfig(arch="gpt2", vocab_size=50257, d_model=768, n_layers=12,
                      n_heads=12, d_mlp=3072, max_seq_len=1024,
                      eos_token_id=50256),
+    "gpt2-medium": LMConfig(arch="gpt2", vocab_size=50257, d_model=1024,
+                            n_layers=24, n_heads=16, d_mlp=4096,
+                            max_seq_len=1024, eos_token_id=50256),
 }
 
 
