@@ -43,32 +43,43 @@ BENCH_STEPS = 50
 SCAN_CHUNK = 10  # steps fused into one device program (amortizes dispatch)
 
 
-def _time_ensemble(use_fused, matmul_precision=None) -> float:
+def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
+                   n_members=None, batch=None, bench_steps=None,
+                   scan_chunk=None) -> float:
+    """Shared ensemble-throughput measurement (bench_suite.py reuses it with
+    its own scales)."""
     import contextlib
 
     from sparse_coding_tpu.ensemble import Ensemble
     from sparse_coding_tpu.models.sae import FunctionalTiedSAE
 
+    d_act = d_act or D_ACT
+    n_dict = n_dict or N_DICT
+    n_members = n_members or N_MEMBERS
+    batch = batch or BATCH
+    bench_steps = bench_steps or BENCH_STEPS
+    scan_chunk = scan_chunk or SCAN_CHUNK
+
     ctx = (jax.default_matmul_precision(matmul_precision)
            if matmul_precision else contextlib.nullcontext())
     with ctx:
-        keys = jax.random.split(jax.random.PRNGKey(0), N_MEMBERS)
-        l1s = jnp.logspace(-4, -2, N_MEMBERS)
-        members = [FunctionalTiedSAE.init(k, D_ACT, N_DICT, l1_alpha=float(l1))
+        keys = jax.random.split(jax.random.PRNGKey(0), n_members)
+        l1s = jnp.logspace(-4, -2, n_members)
+        members = [FunctionalTiedSAE.init(k, d_act, n_dict, l1_alpha=float(l1))
                    for k, l1 in zip(keys, l1s)]
         ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=use_fused)
 
         batches = jax.random.normal(jax.random.PRNGKey(1),
-                                    (SCAN_CHUNK, BATCH, D_ACT))
+                                    (scan_chunk, batch, d_act))
         aux = ens.run_steps(batches)  # warmup: compiles the scanned step
         jax.block_until_ready(aux.losses["loss"])
 
-        n_chunks = max(1, BENCH_STEPS // SCAN_CHUNK)
+        n_chunks = max(1, bench_steps // scan_chunk)
         t0 = time.perf_counter()
         for _ in range(n_chunks):
             aux = ens.run_steps(batches)
         jax.block_until_ready(aux.losses["loss"])
-        return n_chunks * SCAN_CHUNK * BATCH / (time.perf_counter() - t0)
+        return n_chunks * scan_chunk * batch / (time.perf_counter() - t0)
 
 
 def main() -> None:

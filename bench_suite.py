@@ -1,0 +1,170 @@
+"""Extended benchmark suite (developer tool; the driver runs bench.py).
+
+Measures every throughput-relevant path at the reference's canonical scales
+(BASELINE.md) and prints one JSON object per line, so next-round tuning on
+real hardware starts from a complete profile:
+
+    python bench_suite.py [--quick]
+
+Suites: ensemble train (autodiff + fused + bf16-precision variants), big-SAE
+train (single giant dict), activation harvesting (tokens/s through the LM
+with taps), sequence-parallel long-context forward (over whatever mesh the
+host offers), and chunk-store IO.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _timed(fn, n_iters: int, payload: float, warmup: int = 2) -> float:
+    """items/sec for fn() processing `payload` items per call."""
+    for _ in range(warmup):
+        out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        out = fn()
+    jax.block_until_ready(out)
+    return n_iters * payload / (time.perf_counter() - t0)
+
+
+def _emit(suite: str, value: float, unit: str, **extra) -> None:
+    print(json.dumps({"suite": suite, "value": round(value, 1), "unit": unit,
+                      **extra}))
+
+
+def bench_ensemble(quick: bool) -> None:
+    from bench import _time_ensemble  # single shared implementation
+
+    d, ratio, n_members, batch = (256, 2, 8, 512) if quick else (512, 4, 32, 2048)
+    steps, scan = (15, 5) if quick else (200, 10)
+    variants = [("autodiff", False, None)]
+    if jax.default_backend() == "tpu":
+        variants += [("fused", True, None),
+                     ("autodiff_bf16", False, "bfloat16"),
+                     ("fused_bf16", True, "bfloat16")]
+    for name, fused, precision in variants:
+        try:
+            rate = _time_ensemble(use_fused=fused, matmul_precision=precision,
+                                  d_act=d, n_dict=d * ratio,
+                                  n_members=n_members, batch=batch,
+                                  bench_steps=steps, scan_chunk=scan)
+            _emit("ensemble_train", rate, "activations/s", variant=name,
+                  n_members=n_members, d=d, n_dict=d * ratio, batch=batch)
+        except Exception as e:
+            print(f"ensemble variant {name} failed: {e!r}", file=sys.stderr)
+
+
+def bench_big_sae(quick: bool) -> None:
+    from sparse_coding_tpu.train.big_sae import init_big_sae, make_big_sae_step
+
+    d, n_feats, batch = (512, 4096, 4096) if quick else (1024, 16384, 16384)
+    n_iters = 3 if quick else 15
+    state, optimizer, l1 = init_big_sae(jax.random.PRNGKey(0), d, n_feats,
+                                        l1_alpha=1e-3, n_worst=1024)
+    step = make_big_sae_step(optimizer, l1)
+    batch_data = jax.random.normal(jax.random.PRNGKey(1), (batch, d))
+
+    holder = {"state": state}
+
+    def one():
+        holder["state"], metrics = step(holder["state"], batch_data)
+        return metrics["loss"]
+
+    rate = _timed(one, n_iters, batch)
+    _emit("big_sae_train", rate, "activations/s", d=d, n_feats=n_feats,
+          batch=batch)
+
+
+def bench_harvest(quick: bool) -> None:
+    from sparse_coding_tpu.data.harvest import make_harvest_fn
+    from sparse_coding_tpu.lm import gptneox
+    from sparse_coding_tpu.lm.model_config import get_config, tiny_test_config
+
+    if quick:
+        cfg = tiny_test_config("gptneox")
+    else:
+        cfg = get_config("EleutherAI/pythia-70m-deduped")
+    params = gptneox.init_params(jax.random.PRNGKey(0), cfg)
+    b, s = (8, 64) if quick else (8, 256)
+    toks = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (b, s)))
+    fn = make_harvest_fn(params, cfg, ("residual.2",) if not quick
+                         else ("residual.1",), forward=gptneox.forward)
+    rate = _timed(lambda: next(iter(fn(toks).values())), 3 if quick else 15,
+                  b * s)
+    _emit("harvest", rate, "tokens/s", d_model=cfg.d_model,
+          n_layers=cfg.n_layers, context=s)
+
+
+def bench_chunk_io(quick: bool) -> None:
+    import tempfile
+    from pathlib import Path
+
+    from sparse_coding_tpu.data.chunk_store import ChunkStore, ChunkWriter
+
+    rows = 100_000 if quick else 1_000_000
+    d = 512
+    with tempfile.TemporaryDirectory() as td:
+        w = ChunkWriter(td, d, chunk_size_gb=rows * d * 2 / 2**30,
+                        dtype="float16")
+        w.add(np.random.default_rng(0).standard_normal(
+            (rows, d), dtype=np.float32).astype(np.float16))
+        w.finalize()
+        store = ChunkStore(td)
+        file_bytes = store.chunk_paths[0].stat().st_size
+        t0 = time.perf_counter()
+        store.load_chunk(0)
+        dt = time.perf_counter() - t0
+        # NOTE: warm page cache (file just written) — measures decode+cast
+        # throughput, not cold-disk reads
+        _emit("chunk_io", file_bytes / dt / 2**20,
+              "MB/s (warm-cache read + f32 cast)", rows=rows, d=d)
+
+
+def bench_seq_parallel(quick: bool) -> None:
+    from sparse_coding_tpu.lm import gptneox
+    from sparse_coding_tpu.lm.long_context import sequence_parallel_forward
+    from sparse_coding_tpu.lm.model_config import get_config, tiny_test_config
+    from sparse_coding_tpu.parallel.mesh import make_mesh
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(1, n_dev)
+    cfg = tiny_test_config("gptneox") if quick else get_config(
+        "EleutherAI/pythia-70m-deduped")
+    params = gptneox.init_params(jax.random.PRNGKey(0), cfg)
+    b, s = (2, 64 * n_dev) if quick else (2, 512 * n_dev)
+    toks = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (b, s)))
+
+    def one():
+        logits, _ = sequence_parallel_forward(params, toks, cfg, mesh)
+        return logits
+
+    rate = _timed(one, 3 if quick else 10, b * s)
+    _emit("seq_parallel_forward", rate, "tokens/s", context=s,
+          n_shards=n_dev, d_model=cfg.d_model)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+    for suite in (bench_ensemble, bench_big_sae, bench_harvest,
+                  bench_seq_parallel, bench_chunk_io):
+        try:
+            suite(args.quick)
+        except Exception as e:
+            print(f"{suite.__name__} failed: {e!r}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
