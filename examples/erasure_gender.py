@@ -1,0 +1,96 @@
+"""Example: gender concept-erasure experiment end to end.
+
+The full erasure workflow the reference implies but doesn't ship (its
+compute script is missing; see PARITY.md §2.6): prepare a gender-by-name
+probe set (tasks/gender.py, here with a synthesized CSV standing in for the
+UCI download), train an SAE on the probe layer's activations, sweep the
+feature-erasure curve against the LEACE baseline, and render the tradeoff
+plot.
+
+    python examples/erasure_gender.py
+"""
+
+import csv
+import pathlib
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.config import ErasureArgs
+from sparse_coding_tpu.data.chunk_store import device_prefetch
+from sparse_coding_tpu.ensemble import Ensemble
+from sparse_coding_tpu.lm import gptneox
+from sparse_coding_tpu.lm.model_config import tiny_test_config
+from sparse_coding_tpu.metrics.erasure_driver import probe_activations, run_erasure
+from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+from sparse_coding_tpu.tasks.gender import gender_probe_arrays, preprocess_gender_dataset
+from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+
+out = pathlib.Path("erasure_example")
+out.mkdir(exist_ok=True)
+
+lm_cfg = tiny_test_config("gptneox")
+params = gptneox.init_params(jax.random.PRNGKey(0), lm_cfg)
+
+
+class _WordTokenizer:
+    pad_token_id = 0
+    eos_token_id = 0
+
+    def __call__(self, text):
+        if isinstance(text, str):
+            # crc32, not hash(): PYTHONHASHSEED would make runs nondeterministic
+            return {"input_ids": [zlib.crc32(w.encode()) % (lm_cfg.vocab_size - 1) + 1
+                                  for w in text.split()]}
+        return {"input_ids": [self(t)["input_ids"] for t in text]}
+
+
+# 1. synthesize a gender-by-name CSV (stands in for the UCI dataset the
+# reference preprocesses) and run the reference's filtering step
+rng = np.random.default_rng(0)
+names_f = [f"Fname{i}" for i in range(60)]
+names_m = [f"Mname{i}" for i in range(60)]
+with open(out / "name_gender.csv", "w", newline="") as fh:
+    w = csv.writer(fh)
+    w.writerow(["Name", "Gender", "Count", "Probability"])
+    for n in names_f:
+        w.writerow([n, "F", rng.integers(10, 1000), 0.95])
+    for n in names_m:
+        w.writerow([n, "M", rng.integers(10, 1000), 0.95])
+tok = _WordTokenizer()
+_, entries = preprocess_gender_dataset(out / "name_gender.csv", tok)
+tokens, labels = gender_probe_arrays(entries, tok)
+print(f"probe set: {len(tokens)} names ({int(labels.sum())} F)")
+
+# 2. harvest probe-layer activations and train a quick SAE on them
+LAYER = 1
+acts = probe_activations(params, lm_cfg, tokens, LAYER, "residual",
+                         forward=gptneox.forward)
+member = FunctionalTiedSAE.init(jax.random.PRNGKey(1), lm_cfg.d_model,
+                                2 * lm_cfg.d_model, l1_alpha=1e-3)
+ens = Ensemble([member], FunctionalTiedSAE, lr=3e-3)
+acts_np = np.asarray(acts)
+for epoch in range(200):
+    order = np.random.default_rng(epoch).permutation(len(acts_np))
+    ens.step_batch(jnp.asarray(acts_np[order]))
+sae = ens.to_learned_dicts()[0]
+save_learned_dicts([(sae, {"l1_alpha": 1e-3})], out / "sae.pkl")
+
+# 3. the erasure experiment: feature curve + LEACE + KL + plots
+cfg = ErasureArgs(layers=[LAYER], layer_loc="residual",
+                  dict_path=str(out / "sae.pkl"),
+                  output_folder=str(out / "scores"), max_edit_feats=16)
+kl_tokens = rng.integers(0, lm_cfg.vocab_size, (4, 8))
+results = run_erasure(cfg, params, lm_cfg, tokens, labels,
+                      forward=gptneox.forward, kl_tokens=kl_tokens)
+
+rec = results[LAYER]
+print(f"{'n_erased':>9} {'AUROC':>7} {'edit':>7} {'KL':>8}")
+for point in rec["dicts"][0]["curve"]:
+    print(f"{point['n_erased']:>9} {point['auroc']:>7.3f} "
+          f"{point['edit_magnitude']:>7.3f} {point.get('kl', 0):>8.5f}")
+print(f"{'LEACE':>9} {rec['leace']['auroc']:>7.3f} "
+      f"{rec['leace']['edit_magnitude']:>7.3f}")
+print(f"artifacts in {out}/scores/")

@@ -56,6 +56,26 @@ def chunk_and_tokenize(texts: Iterable[str], tokenizer, max_length: int = 256,
     return rows, ratio
 
 
+def save_token_dataset(rows: np.ndarray, path: str | Path,
+                       metadata: Optional[dict] = None) -> None:
+    """Persist packed token rows for reuse across harvesting runs
+    (reference: setup_token_data, activation_dataset.py:607)."""
+    import json
+    from pathlib import Path
+
+    path = Path(path).with_suffix(".npy")  # np.save appends it anyway
+    path.parent.mkdir(parents=True, exist_ok=True)
+    np.save(path, rows)
+    if metadata:
+        path.with_suffix(".meta.json").write_text(json.dumps(metadata, indent=2))
+
+
+def load_token_dataset(path: str | Path) -> np.ndarray:
+    from pathlib import Path
+
+    return np.load(Path(path).with_suffix(".npy"))
+
+
 def load_text_dataset(dataset_name: str, split: str = "train",
                       text_key: str = "text",
                       max_docs: Optional[int] = None) -> list[str]:

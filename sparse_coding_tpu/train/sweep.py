@@ -76,6 +76,31 @@ def init_synthetic_dataset(cfg: SyntheticEnsembleArgs) -> ChunkStore:
     return ChunkStore(folder)
 
 
+def _member_names(hypers: Sequence[dict], n_members: int) -> list[str]:
+    """Stable, UNIQUE per-member stream names from hyperparams
+    (reference: make_hyperparam_name, big_sweep.py:75-83). Colliding names
+    (equal scalars, or floats rounding to the same %.2e) get an index suffix
+    so log streams never silently merge."""
+    from sparse_coding_tpu.utils.logging import make_hyperparam_name
+
+    names = []
+    for i in range(n_members):
+        name = f"member{i}"
+        if i < len(hypers):
+            scalars = {k: v for k, v in hypers[i].items()
+                       if isinstance(v, (int, float)) and not isinstance(v, bool)}
+            if scalars:
+                name = make_hyperparam_name(scalars)
+        names.append(name)
+    seen: dict[str, int] = {}
+    unique = []
+    for i, name in enumerate(names):
+        if names.count(name) > 1:
+            name = f"{name}_{i}"
+        unique.append(name)
+    return unique
+
+
 def _ensembles_of(e: EnsembleLike) -> list[Ensemble]:
     return list(e.ensembles.values()) if isinstance(e, EnsembleGroup) else [e]
 
@@ -116,6 +141,8 @@ def sweep(
         mesh = make_mesh(cfg.mesh_model, cfg.mesh_data)
 
     ensembles = ensemble_init_fn(cfg, mesh)
+    member_names = [_member_names(hypers, len(hypers))
+                    for _, hypers, _ in ensembles]
     logger = MetricsLogger(out_dir, use_wandb=cfg.use_wandb,
                            run_name=out_dir.name, config=cfg.to_dict())
 
@@ -155,8 +182,9 @@ def sweep(
         batches = store.batches(chunk, cfg.batch_size, rng)
         for batch in device_prefetch(batches, sharding):
             step += 1
-            for ensemble, hypers, name in ensembles:
-                if isinstance(ensemble, EnsembleGroup):
+            for ens_idx, (ensemble, hypers, name) in enumerate(ensembles):
+                is_group = isinstance(ensemble, EnsembleGroup)
+                if is_group:
                     auxes = ensemble.step_batch(batch)
                     aux_items = list(auxes.items())
                 else:
@@ -165,10 +193,23 @@ def sweep(
                     for sub_name, aux in aux_items:
                         losses = jax.device_get(aux.losses["loss"])
                         l0 = jax.device_get(aux.l0)
-                        logger.log({f"{sub_name}/loss_mean": float(np.mean(losses)),
-                                    f"{sub_name}/loss_max": float(np.max(losses)),
-                                    f"{sub_name}/l0_mean": float(np.mean(l0))},
-                                   step=step)
+                        rec = {f"{sub_name}/loss_mean": float(np.mean(losses)),
+                               f"{sub_name}/loss_max": float(np.max(losses)),
+                               f"{sub_name}/l0_mean": float(np.mean(l0))}
+                        # per-member streams, named from hyperparams like the
+                        # reference's per-model wandb logs (big_sweep.py:
+                        # 173-197). Group buckets use positional names — the
+                        # flat hypers list doesn't align with bucket-local
+                        # member indices (the bucket name carries the static
+                        # hyperparameter already).
+                        names_i = member_names[ens_idx]
+                        for mi, (loss_i, l0_i) in enumerate(zip(losses, l0)):
+                            member = (f"member{mi}" if is_group
+                                      else names_i[mi] if mi < len(names_i)
+                                      else f"member{mi}")
+                            rec[f"{sub_name}/{member}/loss"] = float(loss_i)
+                            rec[f"{sub_name}/{member}/l0"] = float(l0_i)
+                        logger.log(rec, step=step)
             timer.tick(batch.shape[0])
             if step % log_every == 0:
                 logger.log({"activations_per_sec": timer.items_per_sec},

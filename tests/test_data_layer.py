@@ -139,3 +139,16 @@ def test_harvest_centering_metadata(tmp_path, tiny_lm):
     center = np.load(tmp_path / "residual.0" / "center.npy")
     store = ChunkStore(tmp_path / "residual.0")
     np.testing.assert_allclose(center, store.chunk_mean(0), rtol=1e-5)
+
+
+def test_token_dataset_roundtrip(tmp_path):
+    from sparse_coding_tpu.data.tokenize import (
+        load_token_dataset,
+        save_token_dataset,
+    )
+
+    rows = np.arange(64, dtype=np.int32).reshape(4, 16)
+    save_token_dataset(rows, tmp_path / "toks.npy", {"dataset": "test"})
+    np.testing.assert_array_equal(load_token_dataset(tmp_path / "toks.npy"),
+                                  rows)
+    assert (tmp_path / "toks.meta.json").exists()

@@ -108,3 +108,24 @@ def test_fvu_top_split(rng):
     batch = jax.random.normal(jax.random.PRNGKey(1), (256, 16))
     top, rest = fvu_top_activating(ld, batch, n_top=4)
     assert np.isfinite(float(top)) and np.isfinite(float(rest))
+
+
+def test_sweep_logs_per_member_streams(tmp_path):
+    """Per-member log streams keyed by hyperparams (reference: per-model
+    wandb logs, big_sweep.py:173-197)."""
+    from sparse_coding_tpu.config import SyntheticEnsembleArgs
+    from sparse_coding_tpu.train.experiments import dense_l1_range_experiment
+    from sparse_coding_tpu.train.sweep import sweep
+
+    cfg = SyntheticEnsembleArgs(
+        output_folder=str(tmp_path / "out"),
+        dataset_folder=str(tmp_path / "chunks"), batch_size=128,
+        n_chunks=2, activation_dim=16, n_ground_truth_features=24,
+        dataset_size=3000, learned_dict_ratio=2.0)
+    sweep(lambda c, m: dense_l1_range_experiment(c, m, l1_range=[1e-4, 1e-3],
+                                                 activation_dim=16),
+          cfg, log_every=5)
+    recs = [json.loads(l) for l in (tmp_path / "out" / "metrics.jsonl").open()]
+    member_keys = {k for r in recs for k in r
+                   if "l1_alpha" in k and k.endswith("/loss")}
+    assert len(member_keys) == 2, member_keys  # one stream per member
