@@ -73,3 +73,36 @@ def test_full_pipeline(tmp_path):
     assert all(p >= orig * 0.9 for p in per_dict)
     # the better-reconstructing (lower-l1) dict hurts perplexity less
     assert per_dict[0] <= per_dict[1] * 1.5
+
+
+@pytest.mark.slow
+def test_pipeline_gpt2_arch(tmp_path):
+    """The harvest→train slice works identically for the GPT-2 architecture
+    (attn_concat tap — the trickiest hook — included)."""
+    from sparse_coding_tpu.lm import gpt2
+
+    lm_cfg = tiny_test_config("gpt2")
+    params = gpt2.init_params(jax.random.PRNGKey(0), lm_cfg)
+    rng = np.random.default_rng(0)
+    rows = np.asarray([list(rng.integers(1, lm_cfg.vocab_size, 16))
+                       for _ in range(64)], np.int32)
+    written = harvest_activations(
+        params, lm_cfg, rows, layers=[1], layer_loc="attn_concat",
+        output_folder=tmp_path / "acts", model_batch_size=8,
+        dtype="float16", forward=gpt2.forward)
+    assert written["attn_concat.1"] >= 1
+    store = ChunkStore(tmp_path / "acts" / "attn_concat.1")
+    assert store.activation_dim == lm_cfg.n_heads * lm_cfg.d_head
+
+    from sparse_coding_tpu.train.basic_sweep import basic_l1_sweep
+
+    dicts = basic_l1_sweep(tmp_path / "acts" / "attn_concat.1",
+                           tmp_path / "out", [1e-4, 1e-3], dict_ratio=2.0,
+                           batch_size=128, lr=3e-3, n_epochs=2)
+    assert len(dicts) == 2
+    from sparse_coding_tpu.metrics.core import fraction_variance_unexplained
+
+    eval_batch = jnp.asarray(store.load_chunk(0)[:512])
+    fvu = min(float(fraction_variance_unexplained(ld, eval_batch))
+              for ld, _ in dicts)
+    assert fvu < 0.6, fvu
