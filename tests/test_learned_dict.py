@@ -188,3 +188,16 @@ def test_concat_ensemble_dict(rng):
         np.testing.assert_allclose(np.asarray(loaded.predict(batch[:4])),
                                    np.asarray(combo.predict(batch[:4])),
                                    rtol=1e-6)
+
+
+def test_added_noise_baseline(rng):
+    from sparse_coding_tpu.models import AddedNoise
+
+    k1, kx = jax.random.split(rng)
+    d = AddedNoise.create(k1, 16, noise_mag=0.5)
+    x = jax.random.normal(kx, (32, 16))
+    pred = d.predict(x)
+    # additive-noise null model: prediction is x plus noise of the set scale
+    resid = np.asarray(pred - x)
+    assert 0.2 < resid.std() < 0.8
+    np.testing.assert_array_equal(np.asarray(d.encode(x)), np.asarray(x))

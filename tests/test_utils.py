@@ -129,3 +129,17 @@ def test_sweep_logs_per_member_streams(tmp_path):
     member_keys = {k for r in recs for k in r
                    if "l1_alpha" in k and k.endswith("/loss")}
     assert len(member_keys) == 2, member_keys  # one stream per member
+
+
+def test_config_parse_value_edge_cases():
+    from sparse_coding_tpu.config import DataArgs, _parse_value
+
+    assert _parse_value("t", bool) is True
+    assert _parse_value("no", bool) is False
+    assert _parse_value("3", int) == 3
+    assert _parse_value("[1, 2]", list) == [1, 2]
+    # Optional[int] field parses via JSON fallback
+    cfg = DataArgs.from_cli(["--max_docs", "250"])
+    assert cfg.max_docs == 250
+    cfg = DataArgs.from_cli([])
+    assert cfg.max_docs is None
