@@ -143,3 +143,34 @@ def test_config_parse_value_edge_cases():
     assert cfg.max_docs == 250
     cfg = DataArgs.from_cli([])
     assert cfg.max_docs is None
+
+
+def test_step_timer_rate_and_warmup():
+    import time
+
+    from sparse_coding_tpu.utils.profiling import StepTimer
+
+    t = StepTimer(warmup=2)
+    for _ in range(2):  # warmup ticks: excluded from the rate
+        t.tick(1000)
+    assert t.items_per_sec == 0.0
+    t.tick(100)  # starts the clock
+    for _ in range(3):
+        time.sleep(0.01)
+        t.tick(100)
+    assert t.measured_steps == 3
+    assert 0 < t.items_per_sec < 100 / 0.01 * 1.5
+    t.reset()
+    assert t.items_per_sec == 0.0 and t.measured_steps == 0
+
+
+def test_profiler_trace_writes_artifacts(tmp_path):
+    import jax.numpy as jnp
+
+    from sparse_coding_tpu.utils.profiling import annotate, trace
+
+    with trace(tmp_path / "tr"):
+        with annotate("square"):
+            (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+    files = [p for p in (tmp_path / "tr").rglob("*") if p.is_file()]
+    assert files, "no trace artifacts written"
