@@ -32,12 +32,41 @@ import jax.numpy as jnp
 # real target is the ≥5x north star in BASELINE.json.
 GPU_BASELINE_ACTS_PER_SEC = 37_000.0
 
+# bf16 MXU peak flops/s by TPU generation (public spec sheets), used for the
+# measured-MFU figure: mfu = acts/s × flops-per-activation ÷ chip peak. JAX's
+# DEFAULT f32 matmul precision on TPU runs bf16 passes on the MXU, so the
+# bf16 peak is the honest denominator for every variant benched here.
+TPU_PEAK_FLOPS = {
+    "v2": 45e12, "v3": 123e12, "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+}
+
 D_ACT = 512          # pythia-70m residual width
 DICT_RATIO = 4
 N_DICT = D_ACT * DICT_RATIO
 N_MEMBERS = 32       # 32-point l1 grid (BASELINE.md canonical scale)
 BATCH = 2048
 BENCH_STEPS = 50
+
+# CPU fallback scale (full scale on CPU takes >10 min; this finishes in ~1
+# min and yields a clearly-labeled non-TPU number instead of no artifact)
+CPU_FALLBACK = dict(n_members=8, batch=1024, bench_steps=10, scan_chunk=5)
+
+
+def flops_per_activation(n_members: int = N_MEMBERS, n_dict: int = N_DICT,
+                         d_act: int = D_ACT) -> float:
+    """~12·n·d flops per activation per member (encode+decode matmuls fwd,
+    ~2x for backward; see the baseline-estimate comment above)."""
+    return 12.0 * n_dict * d_act * n_members
+
+
+def chip_peak_flops() -> float | None:
+    kind = jax.devices()[0].device_kind.lower()
+    for tag, peak in sorted(TPU_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if tag in kind:
+            return peak
+    return None
 
 
 SCAN_CHUNK = 10  # steps fused into one device program (amortizes dispatch)
@@ -82,11 +111,77 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
         return n_chunks * scan_chunk * batch / (time.perf_counter() - t0)
 
 
+def _emit(acts_per_sec_per_chip: float, *, backend: str,
+          fpa: float, note: str | None = None) -> None:
+    peak = chip_peak_flops()
+    mfu = (acts_per_sec_per_chip * fpa / peak) if peak else None
+    if mfu is not None:
+        print(f"bench: MFU = {mfu:.4f} (bf16 peak "
+              f"{peak/1e12:.0f} TFLOP/s, {jax.devices()[0].device_kind})",
+              file=sys.stderr)
+    # flops-normalized vs the canonical 32-member workload: a reduced-scale
+    # run counts cheaper "activations", so scale by fpa before dividing
+    vs = (acts_per_sec_per_chip * fpa
+          / (GPU_BASELINE_ACTS_PER_SEC * flops_per_activation()))
+    record = {
+        "metric": "ensemble_train_activations_per_sec_per_chip",
+        "value": round(acts_per_sec_per_chip, 1),
+        "unit": "activations/s/chip",
+        "vs_baseline": round(vs, 3),
+        "backend": backend,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+    }
+    if note:
+        record["note"] = note
+    print(json.dumps(record))
+
+
+def _cpu_fallback_main() -> None:
+    """Reduced-scale CPU measurement: the escape hatch the driver lands on
+    when the TPU tunnel is down, so every round still produces a parseable
+    (clearly-labeled non-TPU) JSON line instead of rc=1/null."""
+    cfg = CPU_FALLBACK
+    rate = _time_ensemble(use_fused=False, **cfg)
+    fpa = flops_per_activation(n_members=cfg["n_members"])
+    _emit(rate, backend="cpu-fallback", fpa=fpa,
+          note="TPU tunnel down; reduced scale "
+               f"(members={cfg['n_members']}, batch={cfg['batch']}) on CPU")
+
+
+def _spawn_cpu_fallback(init_done) -> None:
+    """Re-run this script on pure CPU in a child with the axon plugin
+    stripped (the child never touches the tunnel, so the single-process rule
+    holds), forward its JSON line, and exit cleanly. Called from the watchdog
+    thread while the main thread is stuck inside make_c_api_client. If
+    backend init turns out to have succeeded after all (slow tunnel), abort
+    silently so the real TPU bench emits the single JSON line."""
+    import os
+    import subprocess
+
+    if init_done.is_set():
+        return
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--cpu-fallback"],
+        env=env, capture_output=True, text=True, timeout=1200)
+    if init_done.is_set():
+        return
+    sys.stderr.write(out.stderr)
+    line = out.stdout.strip().splitlines()
+    if out.returncode == 0 and line:
+        print(line[-1])
+        sys.stdout.flush()
+        os._exit(0)
+    print("bench: cpu fallback also failed", file=sys.stderr)
+    os._exit(1)
+
+
 def main() -> None:
     # the axon TPU tunnel blocks forever in backend init when its terminal is
-    # down — fail fast with a diagnostic instead of hanging the driver. A
-    # watchdog THREAD (not SIGALRM: the main thread is stuck inside a C call
-    # and never runs the Python signal handler) hard-exits on timeout.
+    # down — instead of hanging the driver, a watchdog THREAD (not SIGALRM:
+    # the main thread is stuck inside a C call and never runs the Python
+    # signal handler) runs the CPU fallback and exits.
     import os
     import threading
 
@@ -95,15 +190,21 @@ def main() -> None:
 
     def _watchdog():
         if not init_done.wait(timeout_s):
-            print("bench: jax backend init timed out (TPU tunnel down?)",
-                  file=sys.stderr)
+            print("bench: jax backend init timed out (TPU tunnel down?); "
+                  "falling back to CPU", file=sys.stderr)
             sys.stderr.flush()
-            os._exit(1)
+            try:
+                _spawn_cpu_fallback(init_done)
+            except Exception as e:
+                print(f"bench: cpu fallback crashed: {e!r}", file=sys.stderr)
+                os._exit(1)
 
     threading.Thread(target=_watchdog, daemon=True).start()
     n_chips = len(jax.devices())
     init_done.set()
     acts_per_sec = _time_ensemble(use_fused=False)  # XLA autodiff path
+    fpa = flops_per_activation()
+    peak = chip_peak_flops()
     if jax.default_backend() == "tpu":
         # candidate fast paths; report the best that works, never crash the
         # bench over an optional optimization (diagnostics go to stderr)
@@ -112,19 +213,18 @@ def main() -> None:
                        {"use_fused": True, "matmul_precision": "bfloat16"}):
             try:
                 rate = _time_ensemble(**kwargs)
-                print(f"bench variant {kwargs}: {rate:.0f} acts/s",
+                mfu_s = (f", mfu={rate * fpa / peak / n_chips:.4f}"
+                         if peak else "")
+                print(f"bench variant {kwargs}: {rate:.0f} acts/s{mfu_s}",
                       file=sys.stderr)
                 acts_per_sec = max(acts_per_sec, rate)
             except Exception as e:
                 print(f"bench variant {kwargs} failed: {e!r}", file=sys.stderr)
-    acts_per_sec_per_chip = acts_per_sec / n_chips
-    print(json.dumps({
-        "metric": "ensemble_train_activations_per_sec_per_chip",
-        "value": round(acts_per_sec_per_chip, 1),
-        "unit": "activations/s/chip",
-        "vs_baseline": round(acts_per_sec_per_chip / GPU_BASELINE_ACTS_PER_SEC, 3),
-    }))
+    _emit(acts_per_sec / n_chips, backend=jax.default_backend(), fpa=fpa)
 
 
 if __name__ == "__main__":
-    main()
+    if "--cpu-fallback" in sys.argv:
+        _cpu_fallback_main()
+    else:
+        main()
