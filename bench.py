@@ -1,6 +1,9 @@
 """Benchmark harness: ensemble-training throughput on real hardware.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
+labeling keys {"backend", "mfu", "note"?} — backend records where the number
+was measured ("tpu", or "cpu-fallback" when the axon tunnel is down), mfu is
+measured model-flops utilization against the chip's bf16 peak (null off-TPU).
 
 Metric: activations/sec/chip through the vmapped tied-SAE ensemble train step
 at the reference's canonical sweep scale (BASELINE.md: Pythia-70M residual

@@ -91,6 +91,27 @@ def adam_optimizer(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> opt
     return optax.scale_by_adam(b1=b1, b2=b2, eps=eps, eps_root=0.0)
 
 
+def _apply_fused_updates(optimizer, losses, grads, activity,
+                         params, opt_state, lrs):
+    """Shared tail of both fused steps: vmapped per-member Adam update from
+    kernel-produced grads + AuxData assembly (loss fields match the autodiff
+    path, locked by tests/test_torch_loss_parity.py)."""
+    total = losses["mse"] + losses["l1"]
+
+    def member_update(g, opt_state, params, lr):
+        updates, opt_state = optimizer.update(g, opt_state, params)
+        updates = jax.tree.map(lambda u: -lr * u, updates)
+        return optax.apply_updates(params, updates), opt_state
+
+    params, opt_state = jax.vmap(member_update)(grads, opt_state, params, lrs)
+    aux = AuxData(
+        losses={"loss": total, "l_reconstruction": losses["mse"],
+                "l_l1": losses["l1"]},
+        l0=losses["l0"],
+        feat_activity=activity.astype(jnp.int32))
+    return params, opt_state, aux
+
+
 def make_fused_tied_step(
     optimizer: optax.GradientTransformation,
     donate: bool = True,
@@ -106,20 +127,55 @@ def make_fused_tied_step(
             {"encoder": state.params["encoder"],
              "encoder_bias": state.params["encoder_bias"]},
             state.buffers["l1_alpha"], batch, interpret=interpret)
-        total = losses["mse"] + losses["l1"]
+        params, opt_state, aux = _apply_fused_updates(
+            optimizer, losses, grads, activity,
+            state.params, state.opt_state, state.lrs)
+        new_state = state.replace(params=params, opt_state=opt_state,
+                                  step=state.step + 1)
+        return new_state, aux
 
-        def member_update(g, opt_state, params, lr):
-            updates, opt_state = optimizer.update(g, opt_state, params)
-            updates = jax.tree.map(lambda u: -lr * u, updates)
-            return optax.apply_updates(params, updates), opt_state
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
 
-        params, opt_state = jax.vmap(member_update)(
-            grads, state.opt_state, state.params, state.lrs)
-        aux = AuxData(
-            losses={"loss": total, "l_reconstruction": losses["mse"],
-                    "l_l1": losses["l1"]},
-            l0=losses["l0"],
-            feat_activity=activity.astype(jnp.int32))
+
+def make_fused_tied_step_sharded(
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    donate: bool = True,
+    interpret: bool = False,
+) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
+    """Mesh-composed fused step: the flagship multi-chip configuration
+    (replacing /root/reference/cluster_runs.py:100-157's all-GPUs-training
+    scheduler at full scale). Under shard_map each device owns N/mesh_model
+    members ("model" axis) and B/mesh_data batch rows ("data" axis) and runs
+    the SAME Pallas kernel as the single-chip path on its local slice — the
+    kernel normalizes by the GLOBAL batch size, so one psum over "data"
+    yields exact full-batch losses/grads, then the optimizer update runs
+    locally per member shard. HBM/ICI traffic per step: x once into VMEM,
+    one [N_local, n, d] grad reduce-scatter-shaped psum riding ICI."""
+    from jax import shard_map
+    from sparse_coding_tpu.ops.fused_sae import fused_tied_sae_loss_and_grads
+
+    def local_step(params, buffers, opt_state, lrs, local_batch, total_batch):
+        losses, grads, activity = fused_tied_sae_loss_and_grads(
+            {"encoder": params["encoder"],
+             "encoder_bias": params["encoder_bias"]},
+            buffers["l1_alpha"], local_batch, interpret=interpret,
+            total_batch=total_batch)
+        losses, grads, activity = jax.lax.psum((losses, grads, activity),
+                                               "data")
+        return _apply_fused_updates(optimizer, losses, grads, activity,
+                                    params, opt_state, lrs)
+
+    def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
+        sharded = shard_map(
+            functools.partial(local_step, total_batch=batch.shape[0]),
+            mesh=mesh,
+            in_specs=(P("model"), P("model"), P("model"), P("model"),
+                      P("data")),
+            out_specs=(P("model"), P("model"), P("model")),
+            check_vma=False)
+        params, opt_state, aux = sharded(
+            state.params, state.buffers, state.opt_state, state.lrs, batch)
         new_state = state.replace(params=params, opt_state=opt_state,
                                   step=state.step + 1)
         return new_state, aux
@@ -239,28 +295,32 @@ class Ensemble:
         self._standard_step = make_train_step(sig, self.optimizer,
                                               statics=statics0, donate=donate)
         self._fused_step = None
-        if use_fused is True:
+        # the eligibility scan costs per-member host syncs — skip it entirely
+        # when the fused path was not requested
+        eligible = use_fused is not False and can_use_fused_tied_step(
+            sig, members, interpret=fused_interpret)
+        if use_fused is True and not eligible:
             # explicit request: fail fast with a clear message if ineligible
-            ok = mesh is None and can_use_fused_tied_step(
-                sig, members, interpret=fused_interpret)
-            if not ok:
-                raise ValueError(
-                    "use_fused=True requires an identity-centered tied_sae "
-                    "bucket with zero bias_decay, no mesh, and a TPU backend "
-                    "(or fused_interpret=True)")
-            self._fused_step = make_fused_tied_step(
-                self.optimizer, donate=donate, interpret=fused_interpret)
-        elif use_fused == "auto" and mesh is None and can_use_fused_tied_step(
-                sig, members, interpret=fused_interpret):
-            self._fused_step = make_fused_tied_step(
-                self.optimizer, donate=donate, interpret=fused_interpret)
+            raise ValueError(
+                "use_fused=True requires an identity-centered tied_sae "
+                "bucket with zero bias_decay and a TPU backend "
+                "(or fused_interpret=True)")
+        if eligible and (use_fused is True or use_fused == "auto"):
+            self._fused_step = (
+                make_fused_tied_step_sharded(self.optimizer, mesh,
+                                             donate=donate,
+                                             interpret=fused_interpret)
+                if mesh is not None else
+                make_fused_tied_step(self.optimizer, donate=donate,
+                                     interpret=fused_interpret))
         # the fused kernel additionally needs a VMEM-fitting batch tile — only
         # known once the real batch arrives, so the final choice happens on
-        # the first step_batch call
+        # the first step_batch call (and is re-checked per batch size)
         self.fused = self._fused_step is not None
         self._fused_explicit = use_fused is True
         self._step_fn = self._standard_step
         self._scan_fn = None
+        self._resolved_batch: Optional[int] = None
         self._donate = donate
 
     @property
@@ -268,34 +328,46 @@ class Ensemble:
         return self.state.n_members
 
     def _resolve_step(self, batch_size: int):
-        """First real batch: confirm the fused kernel has a VMEM-fitting tile
-        for this batch size; otherwise keep the autodiff path."""
-        if not (self.fused and self._step_fn is self._standard_step):
+        """Pick fused vs autodiff for this batch size: the fused kernel needs
+        a VMEM-fitting tile of the PER-DEVICE batch slice. Re-checked whenever
+        the incoming batch size changes (a later batch with no fitting tile
+        quietly falls back in auto mode instead of erroring mid-sweep), and
+        the scanned-step cache is invalidated when the choice flips."""
+        if self._fused_step is None or batch_size == self._resolved_batch:
             return
         from sparse_coding_tpu.ops.fused_sae import pick_batch_tile
 
         n_feats = self.state.params["encoder"].shape[1]
         d = self.state.params["encoder"].shape[2]
-        if pick_batch_tile(batch_size, n_feats, d) is not None:
+        local = (batch_size // self.mesh.shape["data"]
+                 if self.mesh is not None else batch_size)
+        prev_fn = self._step_fn
+        if pick_batch_tile(local, n_feats, d) is not None:
             self._step_fn = self._fused_step
+            self.fused = True
         elif self._fused_explicit:
             raise ValueError(
                 f"use_fused=True but no VMEM-fitting batch tile exists for "
-                f"batch={batch_size}, n_feats={n_feats}, d={d}; choose "
+                f"per-device batch={local}, n_feats={n_feats}, d={d}; choose "
                 "a batch size divisible by 64/128/256/512 or drop use_fused")
         else:
+            self._step_fn = self._standard_step
             self.fused = False  # auto mode: quietly keep autodiff
+        if self._step_fn is not prev_fn:
+            self._scan_fn = None
+        self._resolved_batch = batch_size
 
     def step_batch(self, batch: Array) -> AuxData:
         """One training step on a [batch, d] activation slab shared by every
         member (reference: ensemble.py:175-193). Returns stacked per-member aux."""
-        self._resolve_step(batch.shape[0])
         if self.mesh is not None:
             n_data = self.mesh.shape["data"]
             if batch.shape[0] % n_data != 0:
                 raise ValueError(
                     f"batch size {batch.shape[0]} not divisible by mesh data "
                     f"axis {n_data}; drop the remainder or pad the batch")
+        self._resolve_step(batch.shape[0])
+        if self.mesh is not None:
             batch = jax.device_put(batch, NamedSharding(self.mesh, P("data")))
         self.state, aux = self._step_fn(self.state, batch)
         return aux
@@ -305,13 +377,14 @@ class Ensemble:
         [K, B, d] batch stack — no per-step Python dispatch (useful when the
         step is fast enough that host overhead would bottleneck, e.g. the
         bench loop). Returns aux stacked on a leading K axis."""
-        self._resolve_step(int(batches.shape[1]))
         if self.mesh is not None:
             n_data = self.mesh.shape["data"]
             if batches.shape[1] % n_data != 0:
                 raise ValueError(
                     f"batch size {batches.shape[1]} not divisible by mesh "
                     f"data axis {n_data}")
+        self._resolve_step(int(batches.shape[1]))
+        if self.mesh is not None:
             batches = jax.device_put(
                 batches, NamedSharding(self.mesh, P(None, "data")))
         if self._scan_fn is None:

@@ -103,15 +103,21 @@ def _kernel(alpha_ref, x_ref, w_ref, b_ref, dw_ref, db_ref, act_ref, loss_ref,
         loss_ref[...] += part
 
 
-@functools.partial(jax.jit, static_argnames=("batch_tile", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("batch_tile", "interpret", "total_batch"))
 def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
                          batch: Array, batch_tile: int = 256,
-                         interpret: bool = False):
+                         interpret: bool = False,
+                         total_batch: Optional[int] = None):
     """All-member losses and gradients wrt (normalized W, bias).
 
     Args:
       w_normed: [N, n, d] row-normalized dictionaries.
       bias: [N, n]; alphas: [N] l1 coefficients; batch: [B, d] shared.
+      total_batch: loss-normalization denominator; defaults to the batch
+        actually passed. A shard_map caller hands each device its LOCAL batch
+        slice but the GLOBAL size here, so per-device partial sums psum to
+        the exact full-batch loss/grads (see ensemble.make_fused_tied_step_sharded).
     Returns:
       (losses {mse [N], l1 [N], l0 [N]}, dW [N, n, d], db [N, n],
        activity [N, n] per-feature active-sample counts)
@@ -120,9 +126,11 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
     from jax.experimental.pallas import tpu as pltpu
 
     n_members, n_feats, d = w_normed.shape
-    total_batch = batch.shape[0]
-    n_tiles = total_batch // batch_tile
-    assert n_tiles * batch_tile == total_batch
+    if total_batch is None:
+        total_batch = batch.shape[0]
+    local_batch = batch.shape[0]  # == total_batch except under shard_map
+    n_tiles = local_batch // batch_tile
+    assert n_tiles * batch_tile == local_batch
 
     kernel = functools.partial(_kernel, total_batch=total_batch, d_act=d)
 
@@ -179,10 +187,12 @@ def normalize_with_vjp(e: Array, dw: Array, eps: float = 1e-8):
 
 def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
                                   batch: Array, batch_tile: Optional[int] = None,
-                                  interpret: bool = False):
+                                  interpret: bool = False,
+                                  total_batch: Optional[int] = None):
     """Drop-in producer of (aux-style losses, grads wrt raw stacked params)
     for the ensemble engine's fused path. params_stacked:
-    {"encoder": [N, n, d], "encoder_bias": [N, n]}."""
+    {"encoder": [N, n, d], "encoder_bias": [N, n]}. total_batch: see
+    fused_tied_sae_grads (global batch size when called on a shard)."""
     e = params_stacked["encoder"]
     if batch_tile is None:
         batch_tile = pick_batch_tile(batch.shape[0], e.shape[1], e.shape[2])
@@ -194,7 +204,7 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
     w_normed = e / norms
     losses, dw, db, activity = fused_tied_sae_grads(
         w_normed, params_stacked["encoder_bias"], alphas, batch,
-        batch_tile=batch_tile, interpret=interpret)
+        batch_tile=batch_tile, interpret=interpret, total_batch=total_batch)
     grads = {"encoder": normalize_with_vjp(e, dw),
              "encoder_bias": db}
     return losses, grads, activity

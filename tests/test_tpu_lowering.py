@@ -39,6 +39,21 @@ def test_untied_and_topk_steps_lower(rng):
         _lower_tpu(lambda s, b, e=ens: e._standard_step(s, b), ens.state, batch)
 
 
+def test_sharded_fused_step_lowers(rng):
+    """AOT TPU lowering of the mesh-composed fused step: shard_map +
+    Pallas kernel + psum through the Mosaic pipeline in one program."""
+    from sparse_coding_tpu.ensemble import make_fused_tied_step_sharded, adam_optimizer
+    from sparse_coding_tpu.parallel.mesh import make_mesh
+
+    members = [FunctionalTiedSAE.init(k, 32, 64, l1_alpha=1e-3)
+               for k in jax.random.split(rng, 4)]
+    mesh = make_mesh(2, 4)
+    ens = Ensemble(members, FunctionalTiedSAE, mesh=mesh, donate=False)
+    step = make_fused_tied_step_sharded(adam_optimizer(), mesh, donate=False)
+    batch = jnp.zeros((512, 32))  # per-device 128: tile exists
+    step.trace(ens.state, batch).lower(lowering_platforms=("tpu",))
+
+
 def test_big_sae_step_lowers(rng):
     from sparse_coding_tpu.train.big_sae import init_big_sae, make_big_sae_step
 
