@@ -130,6 +130,41 @@ def bench_chunk_io(quick: bool) -> None:
               "MB/s (warm-cache read + f32 cast)", rows=rows, d=d)
 
 
+def bench_streaming_eval(quick: bool) -> None:
+    """Dataset-scale metric sweep over a multi-chunk ChunkStore (bounded
+    memory): activations/s through n_ever_active + moment accumulation."""
+    import tempfile
+
+    from sparse_coding_tpu.data.chunk_store import ChunkStore, ChunkWriter
+    from sparse_coding_tpu.metrics.core import (
+        calc_moments_streaming,
+        n_ever_active,
+    )
+    from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+
+    # batch divides rows so the remainder-carry path processes every row and
+    # the activations/s numerator is exact
+    rows, d, ratio, bs = (60_000, 256, 2, 4000) if quick else (400_000, 512, 4, 4000)
+    ld = FunctionalTiedSAE.to_learned_dict(
+        *FunctionalTiedSAE.init(jax.random.PRNGKey(0), d, d * ratio,
+                                l1_alpha=1e-3))
+    with tempfile.TemporaryDirectory() as td:
+        w = ChunkWriter(td, d, chunk_size_gb=(rows // 4) * d * 2 / 2**30,
+                        dtype="float16")
+        w.add(np.random.default_rng(0).standard_normal(
+            (rows, d)).astype(np.float16))
+        w.finalize()
+        store = ChunkStore(td)
+        n_ever_active(ld, store, batch_size=bs)  # warmup compiles
+        calc_moments_streaming(ld, store, batch_size=bs)
+        t0 = time.perf_counter()
+        n_ever_active(ld, store, batch_size=bs)
+        calc_moments_streaming(ld, store, batch_size=bs)
+        dt = time.perf_counter() - t0
+        _emit("streaming_eval", 2 * rows / dt, "activations/s",
+              n_chunks=store.n_chunks, d=d, n_feats=d * ratio)
+
+
 def bench_seq_parallel(quick: bool) -> None:
     from sparse_coding_tpu.lm import gptneox
     from sparse_coding_tpu.lm.long_context import sequence_parallel_forward
@@ -159,7 +194,7 @@ def main() -> None:
     parser.add_argument("--quick", action="store_true")
     args = parser.parse_args()
     for suite in (bench_ensemble, bench_big_sae, bench_harvest,
-                  bench_seq_parallel, bench_chunk_io):
+                  bench_seq_parallel, bench_chunk_io, bench_streaming_eval):
         try:
             suite(args.quick)
         except Exception as e:

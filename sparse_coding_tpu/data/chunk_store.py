@@ -37,7 +37,8 @@ class ChunkWriter:
 
     def __init__(self, folder: str | Path, activation_dim: int,
                  chunk_size_gb: float = 2.0, dtype: str = "bfloat16",
-                 start_index: int = 0, round_rows_to: int = 1):
+                 start_index: int = 0, round_rows_to: int = 1,
+                 center: bool = False):
         self.folder = Path(folder)
         self.folder.mkdir(parents=True, exist_ok=True)
         self.activation_dim = activation_dim
@@ -52,6 +53,24 @@ class ChunkWriter:
         self._buffer: list[np.ndarray] = []
         self._buffered_rows = 0
         self.chunk_index = start_index
+        # center=True: the FIRST flushed chunk's mean is subtracted from every
+        # chunk written (including that first one), so on-disk data is
+        # actually centered — the reference's first-chunk centering
+        # (activation_dataset.py:379-381). The mean lands in center.npy at
+        # finalize for exports that need the translation. A skip_chunks-style
+        # resume (start_index>0) MUST reuse the original run's mean, or the
+        # two halves of the dataset would be centered by different
+        # translations.
+        self.center = center
+        self._center_mean: Optional[np.ndarray] = None
+        if center and start_index > 0:
+            prior = self.folder / "center.npy"
+            if not prior.exists():
+                raise ValueError(
+                    f"resuming a centered harvest at chunk {start_index} but "
+                    f"{prior} is missing — the original centering mean is "
+                    "unrecoverable; re-harvest from chunk 0")
+            self._center_mean = np.load(prior)
 
     def add(self, acts) -> None:
         arr = np.asarray(acts).reshape(-1, self.activation_dim).astype(self.dtype)
@@ -61,6 +80,11 @@ class ChunkWriter:
             self._flush_chunk()
 
     def _write(self, arr: np.ndarray) -> None:
+        if self.center:
+            f32 = arr.astype(np.float32)
+            if self._center_mean is None:
+                self._center_mean = f32.mean(axis=0)
+            arr = (f32 - self._center_mean).astype(self.dtype)
         # np.save can't round-trip ml_dtypes bfloat16 — store the raw bit
         # pattern as uint16; ChunkStore views it back via meta["dtype"]
         if self.dtype == jnp.bfloat16:
@@ -83,9 +107,17 @@ class ChunkWriter:
             flat = np.concatenate(self._buffer, axis=0)
             self._write(flat)
             self._buffer, self._buffered_rows = [], 0
+        if self._center_mean is not None:
+            np.save(self.folder / "center.npy", self._center_mean)
+        centered = self.center and self._center_mean is not None
         meta = {"activation_dim": self.activation_dim,
                 "dtype": str(np.dtype(self.dtype)),
-                "n_chunks": self.chunk_index}
+                "n_chunks": self.chunk_index,
+                "centered": centered,
+                # format marker: distinguishes stores whose chunks are
+                # ACTUALLY mean-subtracted on disk from any older artifact
+                # that stamped centered=true without subtracting
+                **({"center_format": "subtracted-v2"} if centered else {})}
         meta.update(metadata or {})
         (self.folder / "meta.json").write_text(json.dumps(meta, indent=2))
         return self.chunk_index
@@ -123,6 +155,26 @@ class ChunkStore:
         """Mean of one chunk — the reference's first-chunk centering
         (activation_dataset.py:379-381, big_sweep.py:359-364)."""
         return self.load_chunk(i).mean(axis=0)
+
+    @property
+    def center(self) -> Optional[np.ndarray]:
+        """The translation subtracted at harvest when the store was written
+        with center=True (center.npy), else None. Chunks on disk are ALREADY
+        centered — this is for exports needing the original-space offset
+        (e.g. models/pca.py get_centering_transform translations). Refuses
+        legacy stores that claim centered=true without the subtracted-v2
+        format marker (their chunks were written WITHOUT subtraction)."""
+        path = self.folder / "center.npy"
+        if not path.exists():
+            return None
+        if (self.meta.get("centered")
+                and self.meta.get("center_format") != "subtracted-v2"):
+            raise ValueError(
+                f"{self.folder} claims centered=true but lacks the "
+                "subtracted-v2 marker: it predates on-disk centering and its "
+                "chunks are raw; re-harvest it (or subtract center.npy "
+                "manually and stamp center_format)")
+        return np.load(path)
 
     def batches(self, chunk: np.ndarray, batch_size: int,
                 rng: np.random.Generator, drop_last: bool = True) -> Iterator[np.ndarray]:

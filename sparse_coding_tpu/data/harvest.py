@@ -98,7 +98,8 @@ def harvest_activations(
         t: ChunkWriter(Path(output_folder) / t, width,
                        chunk_size_gb=chunk_size_gb, dtype=dtype,
                        start_index=skip_chunks,
-                       round_rows_to=model_batch_size * seq_len)
+                       round_rows_to=model_batch_size * seq_len,
+                       center=center)
         for t in taps
     }
 
@@ -137,19 +138,11 @@ def harvest_activations(
     while pending and not done:
         done = drain_one()
 
-    out = {}
-    for name, w in writers.items():
-        n_written = w.finalize({"model": cfg.arch, "layer_loc": layer_loc,
-                                "centered": center})
-        out[name] = n_written
-    if center:
-        # first-chunk-mean centering metadata (reference:
-        # activation_dataset.py:379-381 subtracts the first chunk's mean)
-        for name in out:
-            store = ChunkStore(Path(output_folder) / name)
-            mean = store.chunk_mean(0)
-            np.save(Path(output_folder) / name / "center.npy", mean)
-    return out
+    # centering happens INSIDE the writers (first flushed chunk's mean
+    # subtracted from every chunk, reference: activation_dataset.py:379-381);
+    # the writer stamps the truthful "centered" flag and saves center.npy
+    return {name: w.finalize({"model": cfg.arch, "layer_loc": layer_loc})
+            for name, w in writers.items()}
 
 
 def make_one_chunk_per_layer(params, lm_cfg: LMConfig, token_rows: np.ndarray,

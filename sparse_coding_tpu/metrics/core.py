@@ -74,17 +74,54 @@ def calc_feature_n_active(codes: Array) -> Array:
     return jnp.sum(codes != 0, axis=0)
 
 
-def n_ever_active(model: LearnedDict, activations: Array, batch_size: int = 1000,
-                  threshold: int = 10) -> int:
-    """Number of features active more than `threshold` times across a dataset
-    (reference: standard_metrics.py:446-454), scanned in fixed-size batches."""
-    n = (activations.shape[0] // batch_size) * batch_size
-    batches = activations[:n].reshape(-1, batch_size, activations.shape[-1])
+def _iter_slabs(activations, batch_size: int):
+    """Uniform slab iterator over the dataset-scale metric inputs: a
+    ChunkStore streams one chunk at a time (bounded memory — the reference's
+    whole-dataset sweeps stream chunk files the same way,
+    standard_metrics.py:711-756); an in-RAM array is a single slab. Rows left
+    over when batch_size doesn't divide a chunk CARRY into the next chunk, so
+    the store path consumes exactly the same floor(total/batch_size)·batch
+    rows, in order, as the in-RAM path — only the final dataset-level
+    remainder is dropped."""
+    from sparse_coding_tpu.data.chunk_store import ChunkStore
+
+    if isinstance(activations, ChunkStore):
+        left = None
+        for i in range(activations.n_chunks):
+            slab = jnp.asarray(activations.load_chunk(i))
+            if left is not None and left.shape[0]:
+                slab = jnp.concatenate([left, slab], axis=0)
+            n = (slab.shape[0] // batch_size) * batch_size
+            left = slab[n:]
+            if n:
+                yield slab[:n]
+    else:
+        yield jnp.asarray(activations)
+
+
+def _count_active_scan(model: LearnedDict, acts: Array,
+                       batch_size: int) -> Array:
+    n = (acts.shape[0] // batch_size) * batch_size
+    batches = acts[:n].reshape(-1, batch_size, acts.shape[-1])
 
     def body(count, batch):
         return count + calc_feature_n_active(model.encode(batch)), None
 
-    counts, _ = jax.lax.scan(body, jnp.zeros(model.n_feats, jnp.int32), batches)
+    counts, _ = jax.lax.scan(body, jnp.zeros(model.n_feats, jnp.int32),
+                             batches)
+    return counts
+
+
+def n_ever_active(model: LearnedDict, activations, batch_size: int = 1000,
+                  threshold: int = 10) -> int:
+    """Number of features active more than `threshold` times across a dataset
+    (reference: standard_metrics.py:446-454), scanned in fixed-size batches.
+    `activations` may be an in-RAM array OR a ChunkStore, which streams chunk
+    by chunk with bounded memory (a 40×2 GB store never materializes)."""
+    counts = None
+    for slab in _iter_slabs(activations, batch_size):
+        c = _count_active_scan(model, slab, batch_size)
+        counts = c if counts is None else counts + c
     return int(jnp.sum(counts > threshold))
 
 
@@ -173,15 +210,12 @@ def feature_moments(codes: Array) -> dict[str, Array]:
     return {"mean": mean, "var": var, "skew": skew, "kurtosis": kurtosis}
 
 
-def calc_moments_streaming(model: LearnedDict, activations: Array,
-                           batch_size: int = 1000):
-    """Streaming raw-moment accumulation over a dataset, one jitted scan
-    (reference: standard_metrics.py:482-511). Returns
-    (times_active, mean, var, skew, kurtosis, m4) with the reference's
-    population-variance (m2 − mean²) semantics."""
-    n = (activations.shape[0] // batch_size) * batch_size
-    batches = activations[:n].reshape(-1, batch_size, activations.shape[-1])
-    zeros = jnp.zeros(model.n_feats, jnp.float32)
+def _moment_sums_scan(model: LearnedDict, acts: Array, batch_size: int,
+                      carry):
+    """One slab's worth of the moment accumulation (jitted scan), threading
+    the (times_active, m1..m4 sums) carry across slabs."""
+    n = (acts.shape[0] // batch_size) * batch_size
+    batches = acts[:n].reshape(-1, batch_size, acts.shape[-1])
 
     def body(carry, batch):
         times_active, m1, m2, m3, m4 = carry
@@ -191,9 +225,24 @@ def calc_moments_streaming(model: LearnedDict, activations: Array,
                 m1 + jnp.mean(c, axis=0), m2 + jnp.mean(c**2, axis=0),
                 m3 + jnp.mean(c**3, axis=0), m4 + jnp.mean(c**4, axis=0)), None
 
-    (times_active, m1, m2, m3, m4), _ = jax.lax.scan(
-        body, (zeros, zeros, zeros, zeros, zeros), batches)
-    k = batches.shape[0]
+    carry, _ = jax.lax.scan(body, carry, batches)
+    return carry, batches.shape[0]
+
+
+def calc_moments_streaming(model: LearnedDict, activations,
+                           batch_size: int = 1000):
+    """Streaming raw-moment accumulation over a dataset, one jitted scan per
+    slab (reference: standard_metrics.py:482-511). Returns
+    (times_active, mean, var, skew, kurtosis, m4) with the reference's
+    population-variance (m2 − mean²) semantics. `activations` may be an
+    in-RAM array OR a ChunkStore (streams chunk by chunk, bounded memory)."""
+    zeros = jnp.zeros(model.n_feats, jnp.float32)
+    carry = (zeros, zeros, zeros, zeros, zeros)
+    k = 0
+    for slab in _iter_slabs(activations, batch_size):
+        carry, k_slab = _moment_sums_scan(model, slab, batch_size, carry)
+        k += k_slab
+    times_active, m1, m2, m3, m4 = carry
     mean, m2, m3, m4 = m1 / k, m2 / k, m3 / k, m4 / k
     var = m2 - mean**2
     skew = m3 / jnp.clip(var**1.5, 1e-8)

@@ -67,8 +67,11 @@ def activity_sweep(dict_files: Sequence[str | Path], activations,
                    threshold: int = 10, batch_size: int = 1000) -> list[dict]:
     """Ever-active feature counts for every dict across artifact files — the
     reference's multi-GPU mp.Pool census (standard_metrics.py:711-756) as a
-    serial loop of jitted scans."""
-    acts = jnp.asarray(activations)
+    serial loop of jitted scans. `activations` may be an array or a
+    ChunkStore — the store path streams chunk by chunk per dict (bounded
+    memory; re-reads ride the OS page cache across dicts)."""
+    acts = (activations if _is_store(activations)
+            else jnp.asarray(activations))
     out = []
     for path in dict_files:
         for ld, hyper in load_learned_dicts(path):
@@ -82,11 +85,19 @@ def activity_sweep(dict_files: Sequence[str | Path], activations,
     return out
 
 
+def _is_store(activations) -> bool:
+    from sparse_coding_tpu.data.chunk_store import ChunkStore
+
+    return isinstance(activations, ChunkStore)
+
+
 def kurtosis_sweep(dict_files: Sequence[str | Path], activations,
                    batch_size: int = 1000) -> list[dict]:
     """Per-dict feature-kurtosis summaries (reference:
-    calc_kurtosis_for_layer, standard_metrics.py:758-809)."""
-    acts = jnp.asarray(activations)
+    calc_kurtosis_for_layer, standard_metrics.py:758-809). `activations` may
+    be an array or a ChunkStore (streamed, bounded memory)."""
+    acts = (activations if _is_store(activations)
+            else jnp.asarray(activations))
     out = []
     for path in dict_files:
         for ld, hyper in load_learned_dicts(path):

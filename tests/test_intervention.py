@@ -96,3 +96,31 @@ def test_ablation_graph_nonpositional(tiny_lm):
     # upstream ablation must influence at least one downstream feature
     down = [v for (src, dst), v in graph.items() if dst[0] == (2, "residual")]
     assert max(down) > 0.0
+
+
+def test_ablation_graph_transfers_scale_with_features_not_edges(
+        tiny_lm, monkeypatch):
+    """Graph assembly pulls ONE stacked delta array per ablated feature
+    (O(F) device→host transfers), never one per (source, target) edge
+    (VERDICT r1 weak#3). 256-feature dict, 8 ablated → 8 device_gets."""
+    params, cfg = tiny_lm
+    toks = jnp.asarray(_tokens(cfg, n=2, s=8))
+    models = {(0, "residual"): RandomDict.create(jax.random.PRNGKey(3),
+                                                 cfg.d_model, 256)}
+    n_ablate = 8
+    calls = {"n": 0}
+    real_device_get = jax.device_get
+
+    def counting_device_get(x):
+        calls["n"] += 1
+        return real_device_get(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_device_get)
+    graph = build_ablation_graph_non_positional(
+        params, cfg, models, toks,
+        features_to_ablate={(0, "residual"): list(range(n_ablate))},
+        target_features={(0, "residual"): list(range(256))},
+        forward=gptneox.forward)
+    # n_ablate source rows × 255 targets each, but only n_ablate pulls
+    assert len(graph) == n_ablate * 255
+    assert calls["n"] <= n_ablate + 2  # +slack for the base cache
