@@ -221,6 +221,94 @@ def read_scores(output_folder: str | Path) -> dict[int, dict]:
     return out
 
 
+def _load_lm(model_name: str):
+    """(params, lm_cfg, decode_token, forward) for the CLI. `tiny-gptneox` /
+    `tiny-gpt2` are hermetic random-weight models (no network; tokens decode
+    to their ids) — the CLI analogue of the test-suite LMs; anything else
+    resolves through the HF cache (lm/convert.load_model)."""
+    if model_name.startswith("tiny-"):
+        arch = model_name.removeprefix("tiny-")
+        from sparse_coding_tpu.lm import gpt2, gptneox
+        from sparse_coding_tpu.lm.model_config import tiny_test_config
+
+        mod = {"gptneox": gptneox, "gpt2": gpt2}[arch]
+        lm_cfg = tiny_test_config(arch)
+        params = mod.init_params(jax.random.PRNGKey(0), lm_cfg)
+        return params, lm_cfg, str, mod.forward
+    from transformers import AutoTokenizer
+
+    from sparse_coding_tpu.lm.convert import forward_fn, load_model
+
+    params, lm_cfg = load_model(model_name)
+    tok = AutoTokenizer.from_pretrained(model_name)
+    return params, lm_cfg, (lambda t: tok.decode([t])), forward_fn(lm_cfg)
+
+
+def main(argv=None) -> None:
+    """`python -m sparse_coding_tpu.interp.run [subcommand] ...` — the
+    reference's CLI dispatch (interpret.py:764-815):
+
+      (default)       interpret cfg.learned_dict_path's dict(s)
+      read_results    print collected scores for cfg.output_folder
+      run_group       interpret every *.pkl under --target
+      big_sweep       final-snapshot dicts of a sweep output tree (--target)
+      all_baselines   every baseline artifact under --target
+      chunks          same features across each training snapshot (--target)
+
+    Token rows come from --tokens (a .npy saved by
+    data.tokenize.save_token_dataset)."""
+    import argparse
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    sub = "interpret"
+    if argv and not argv[0].startswith("-"):
+        sub = argv.pop(0)
+    known_subs = {"interpret", "read_results", "run_group", "big_sweep",
+                  "all_baselines", "chunks"}
+    if sub not in known_subs:
+        raise SystemExit(f"unknown subcommand {sub!r}; one of {sorted(known_subs)}")
+
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--tokens", default="", help=".npy token dataset")
+    pre.add_argument("--target", default="", help="root folder for the batch "
+                     "drivers (run_group/big_sweep/all_baselines/chunks)")
+    extra, rest = pre.parse_known_args(argv)
+    cfg = InterpArgs.from_cli(rest)
+
+    if sub == "read_results":
+        print(json.dumps(read_scores(cfg.output_folder), indent=2))
+        return
+
+    from sparse_coding_tpu.data.tokenize import load_token_dataset
+
+    if not extra.tokens:
+        raise SystemExit("--tokens TOKENS.npy is required for this subcommand")
+    token_rows = load_token_dataset(extra.tokens)
+    params, lm_cfg, decode_token, forward = _load_lm(cfg.model_name)
+    common = dict(params=params, lm_cfg=lm_cfg, token_rows=token_rows,
+                  decode_token=decode_token, forward=forward)
+
+    if sub != "interpret" and not extra.target:
+        raise SystemExit(f"--target ROOT is required for {sub}")
+    if sub == "interpret":
+        if not cfg.learned_dict_path:
+            raise SystemExit("--learned_dict_path is required")
+        results = run_folder([cfg.learned_dict_path], cfg, **common)
+    elif sub == "run_group":
+        paths = sorted(str(p) for p in Path(extra.target).rglob("*.pkl"))
+        results = run_folder(paths, cfg, **common)
+    elif sub == "big_sweep":
+        results = interpret_across_big_sweep(extra.target, cfg, **common)
+    elif sub == "all_baselines":
+        results = interpret_across_baselines(extra.target, cfg, **common)
+    else:  # chunks
+        results = interpret_across_chunks(extra.target, cfg, **common)
+    n = sum(len(v) for v in results.values())
+    print(f"interp {sub}: {len(results)} dict(s), {n} feature records -> "
+          f"{cfg.output_folder}")
+
+
 def read_transform_scores(root: str | Path) -> dict[str, list[float]]:
     """Collect top_random scores per transform directory for comparison plots
     (reference: read_transform_scores, interpret.py:456-483)."""
@@ -233,3 +321,7 @@ def read_transform_scores(root: str | Path) -> dict[str, list[float]]:
         if scores:
             results[transform_dir.name] = scores
     return results
+
+
+if __name__ == "__main__":
+    main()

@@ -28,8 +28,11 @@ _NORM_EPS = 1e-8
 
 
 def normalize_rows(d: Array, eps: float = _NORM_EPS) -> Array:
-    """Row-normalize a dictionary to unit L2 norm."""
-    return d / (jnp.linalg.norm(d, axis=-1, keepdims=True) + eps)
+    """Row-normalize a dictionary to unit L2 norm. clip (not +eps) matches
+    the training-side _normalize (models/sae.py) and the reference's
+    torch.clamp, so exported inference dictionaries equal the ones the loss
+    saw even for degenerate near-zero rows (ADVICE r1 #1)."""
+    return d / jnp.clip(jnp.linalg.norm(d, axis=-1, keepdims=True), eps)
 
 
 # Every LearnedDict subclass auto-registers here (by class name) so artifact
@@ -172,12 +175,24 @@ class AddedNoise(LearnedDict):
     def get_learned_dict(self) -> Array:
         return self.eye
 
+    def _noised(self, x: Array) -> Array:
+        # the reference draws FRESH noise every encode() call; a frozen
+        # pytree has no mutable key, so the key is folded with a
+        # batch-content salt instead: different batches get independent
+        # noise, repeated calls on the same batch are deterministic
+        # (deviation noted in PARITY.md; ADVICE r1 #2)
+        # bitcast (not clip/round) keeps distinct sums distinct at any scale
+        salt = jax.lax.bitcast_convert_type(
+            jnp.sum(x).astype(jnp.float32), jnp.int32)
+        k = jax.random.fold_in(self.key, salt.astype(jnp.uint32))
+        return x + self.noise_mag * jax.random.normal(k, x.shape,
+                                                      dtype=x.dtype)
+
     def encode(self, x: Array) -> Array:
-        return x
+        return self._noised(x)
 
     def predict(self, x: Array) -> Array:
-        noise = jax.random.normal(self.key, x.shape, dtype=x.dtype)
-        return x + self.noise_mag * noise
+        return self._noised(x)
 
 
 class UntiedSAE(LearnedDict):

@@ -128,7 +128,10 @@ class FunctionalTiedSAE:
 
     @staticmethod
     def encode(params, buffers, batch: Array) -> Array:
+        # centering applied exactly as in loss(), so public encode() is
+        # consistent with training for non-identity transforms (ADVICE r1 #3)
         dictionary = _normalize(params["encoder"])
+        batch = FunctionalTiedSAE.center(buffers, batch)
         return jax.nn.relu(batch @ dictionary.T + params["encoder_bias"])
 
     @staticmethod

@@ -155,6 +155,123 @@ def residual_denoising_experiment(cfg: EnsembleArgs, mesh=None,
     return [(group, hypers, "residual_denoising")]
 
 
+def centered_l1_range_experiment(cfg: EnsembleArgs, mesh=None,
+                                 l1_range: Optional[Sequence[float]] = None,
+                                 activation_dim: Optional[int] = None,
+                                 whiten: bool = True,
+                                 centering=None):
+    """Centered/whitened TiedSAE sweep — the reference's mlp-center workflow
+    (big_sweep.py:359-364 computes the transform from the dataset;
+    plotting/fvu_sparsity_plot_mlp_center.py consumes it): a PCA whitening
+    transform fitted on the dataset's first chunk becomes fixed
+    rotation/translation/scaling buffers of every member, so the SAE trains
+    in whitened space. Pass `centering=(mean, rot, scale)` to skip the PCA
+    fit (tests, precomputed transforms); whiten=False keeps the rotation but
+    unit scaling (pure centering)."""
+    import jax.numpy as jnp
+
+    from sparse_coding_tpu.models.pca import BatchedPCA
+
+    l1s = list(l1_range if l1_range is not None else DEFAULT_L1_RANGE)
+    if getattr(cfg, "center_activations", False):
+        raise ValueError(
+            "centered_l1_range centers via member buffers; combining it with "
+            "cfg.center_activations would double-shift the data relative to "
+            "the stored transform")
+    if centering is None:
+        from sparse_coding_tpu.data.chunk_store import ChunkStore
+
+        acts = ChunkStore(cfg.dataset_folder).load_chunk(0)
+        pca = BatchedPCA(acts.shape[-1])
+        pca.train_batch(acts)
+        mean, rot, inv_std = pca.get_centering_transform()
+        # get_centering_transform returns eigvecs as columns; center() applies
+        # rot as rows (x @ rot.T), so transpose into row-vector form
+        rot = rot.T
+    else:
+        mean, rot, inv_std = centering
+    d = activation_dim or int(mean.shape[-1])
+    scale = inv_std if whiten else jnp.ones_like(inv_std)
+    n_dict = int(d * cfg.learned_dict_ratio)
+    keys = jax.random.split(jax.random.PRNGKey(cfg.seed), len(l1s))
+    members = [FunctionalTiedSAE.init(k, d, n_dict, l1_alpha=float(l1),
+                                      rotation=rot, translation=mean,
+                                      scaling=scale)
+               for k, l1 in zip(keys, l1s)]
+    ens = Ensemble(members, FunctionalTiedSAE, lr=cfg.lr,
+                   adam_eps=cfg.adam_epsilon, mesh=mesh)
+    hypers = [{"l1_alpha": float(l1), "dict_size": n_dict, "tied": True,
+               "centered": True, "whitened": whiten} for l1 in l1s]
+    return [(ens, hypers, "centered_l1_range")]
+
+
+def _simple_grid_experiment(sig, name, cfg: EnsembleArgs, mesh, l1s, d,
+                            init_kwargs=None, hyper_key: str = "l1_alpha"):
+    """Shared shape of the one-signature l1-grid builders below."""
+    n_dict = int(d * cfg.learned_dict_ratio)
+    keys = jax.random.split(jax.random.PRNGKey(cfg.seed), len(l1s))
+    members = [sig.init(k, d, n_dict, float(l1), **(init_kwargs or {}))
+               for k, l1 in zip(keys, l1s)]
+    group = EnsembleGroup.build(sig, members, lr=cfg.lr, mesh=mesh,
+                                adam_eps=cfg.adam_epsilon)
+    hypers = [{hyper_key: float(l1), "dict_size": n_dict} for l1 in l1s]
+    return [(group, hypers, name)]
+
+
+def reverse_l1_range_experiment(cfg: EnsembleArgs, mesh=None,
+                                l1_range: Optional[Sequence[float]] = None,
+                                activation_dim: Optional[int] = None):
+    """ReverseSAE (bias-subtracting decode) sweep
+    (reference: big_sweep_experiments.py reverse-SAE runs via
+    sae_ensemble.py:447-503)."""
+    from sparse_coding_tpu.models.sae import FunctionalReverseSAE
+
+    l1s = list(l1_range if l1_range is not None else DEFAULT_L1_RANGE)
+    d = activation_dim or _activation_dim(cfg)
+    return _simple_grid_experiment(FunctionalReverseSAE, "reverse_l1_range",
+                                   cfg, mesh, l1s, d)
+
+
+def positive_l1_range_experiment(cfg: EnsembleArgs, mesh=None,
+                                 l1_range: Optional[Sequence[float]] = None,
+                                 activation_dim: Optional[int] = None):
+    """Nonnegative-dictionary shifted-input TiedSAE sweep
+    (reference: mlp_tests.py:80-115 positive SAE workflow)."""
+    from sparse_coding_tpu.models.positive import FunctionalPositiveTiedSAE
+
+    l1s = list(l1_range if l1_range is not None else DEFAULT_L1_RANGE)
+    d = activation_dim or _activation_dim(cfg)
+    return _simple_grid_experiment(FunctionalPositiveTiedSAE,
+                                   "positive_l1_range", cfg, mesh, l1s, d)
+
+
+def semilinear_l1_range_experiment(cfg: EnsembleArgs, mesh=None,
+                                   l1_range: Optional[Sequence[float]] = None,
+                                   activation_dim: Optional[int] = None):
+    """Two-layer-encoder SemiLinearSAE sweep
+    (reference: semilinear autoencoder runs, big_sweep_experiments.py)."""
+    from sparse_coding_tpu.models.semilinear import SemiLinearSAE
+
+    l1s = list(l1_range if l1_range is not None else DEFAULT_L1_RANGE)
+    d = activation_dim or _activation_dim(cfg)
+    return _simple_grid_experiment(SemiLinearSAE, "semilinear_l1_range",
+                                   cfg, mesh, l1s, d)
+
+
+def rica_experiment(cfg: EnsembleArgs, mesh=None,
+                    sparsity_range: Optional[Sequence[float]] = None,
+                    activation_dim: Optional[int] = None):
+    """RICA (reconstruction ICA) sweep over the sparsity coefficient
+    (reference: untied_ica_topk et al., big_sweep_experiments.py RICA runs)."""
+    from sparse_coding_tpu.models.rica import RICA
+
+    coefs = list(sparsity_range if sparsity_range is not None
+                 else np.logspace(-4, -2, 8))
+    d = activation_dim or _activation_dim(cfg)
+    return _simple_grid_experiment(RICA, "rica", cfg, mesh, coefs, d,
+                                   hyper_key="sparsity_coef")
+
+
 EXPERIMENTS = {
     "dense_l1_range": dense_l1_range_experiment,
     "tied_vs_not": tied_vs_not_experiment,
@@ -163,6 +280,11 @@ EXPERIMENTS = {
     "zero_l1_baseline": zero_l1_baseline_experiment,
     "long_l1_range": long_l1_range_experiment,
     "residual_denoising": residual_denoising_experiment,
+    "centered_l1_range": centered_l1_range_experiment,
+    "reverse_l1_range": reverse_l1_range_experiment,
+    "positive_l1_range": positive_l1_range_experiment,
+    "semilinear_l1_range": semilinear_l1_range_experiment,
+    "rica": rica_experiment,
 }
 
 
@@ -226,9 +348,17 @@ def run_dict_ratio_series(layer: int = 2):
                                        layer, "residual", 32.0)
 
 
+def run_pythia70m_mlp_center(layer: int = 2, ratio: float = 4.0):
+    """Whitened-centered MLP sweep — the reference's _mlp_center workflow
+    (big_sweep.py:359-364 + plotting/fvu_sparsity_plot_mlp_center.py)."""
+    return centered_l1_range_experiment, _cfg("EleutherAI/pythia-70m-deduped",
+                                              layer, "mlp", ratio)
+
+
 LAUNCHERS = {
     "pythia70m_resid": run_pythia70m_resid,
     "pythia70m_mlp": run_pythia70m_mlp,
+    "pythia70m_mlp_center": run_pythia70m_mlp_center,
     "pythia410m_mlpout_topk": run_pythia410m_mlpout_topk,
     "pythia14b_resid": run_pythia14b_resid,
     "gpt2sm_resid": run_gpt2sm_resid,

