@@ -1,0 +1,117 @@
+"""Pythia-70M layer-2 residual FVU-vs-L0 frontier, end to end.
+
+The reference's canonical headline experiment
+(reference: big_sweep_experiments.py:620-676 sweep config + fvu_sparsity
+plotting): load pretrained weights -> tokenize/pack pile text -> harvest
+layer-2 residual activations -> 16-point dense l1 sweep -> frontier scores
+JSON + plot.
+
+    python examples/pythia70m_frontier.py            # real weights (HF cache)
+    python examples/pythia70m_frontier.py --tiny     # hermetic tiny-LM drill
+                                                     # of the identical chain
+
+Real-weights mode needs `EleutherAI/pythia-70m-deduped` and the
+`NeelNanda/pile-10k` dataset in the local HF cache (this image has zero
+network egress; pre-populate the cache to run it). `--tiny` swaps ONLY the
+model/data for a random tiny GPT-NeoX + random tokens, exercising every stage
+at toy scale — artifacts land under frontier_out_tiny/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tiny", action="store_true")
+    parser.add_argument("--layer", type=int, default=2)
+    parser.add_argument("--ratio", type=float, default=4.0)
+    parser.add_argument("--n-chunks", type=int, default=10)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+
+    from sparse_coding_tpu.config import EnsembleArgs
+    from sparse_coding_tpu.data.chunk_store import ChunkStore
+    from sparse_coding_tpu.data.harvest import harvest_activations
+    from sparse_coding_tpu.plotting.frontiers import (
+        generate_scores,
+        plot_fvu_sparsity,
+    )
+    from sparse_coding_tpu.train.experiments import dense_l1_range_experiment
+    from sparse_coding_tpu.train.sweep import sweep
+
+    if args.tiny:
+        from sparse_coding_tpu.lm import gptneox
+        from sparse_coding_tpu.lm.model_config import tiny_test_config
+
+        lm_cfg = tiny_test_config("gptneox")
+        params = gptneox.init_params(jax.random.PRNGKey(0), lm_cfg)
+        token_rows = np.random.default_rng(0).integers(
+            0, lm_cfg.vocab_size, (64, 32)).astype(np.int32)
+        forward = gptneox.forward
+        out_root = Path(args.out or "frontier_out_tiny")
+        layer, context_note = 1, "tiny"
+        chunk_gb, batch_size, l1_range = 0.0005, 256, [1e-4, 1e-3, 1e-2]
+    else:
+        from transformers import AutoTokenizer
+
+        from sparse_coding_tpu.data.tokenize import (
+            chunk_and_tokenize,
+            load_text_dataset,
+        )
+        from sparse_coding_tpu.lm.convert import forward_fn, load_model
+
+        model_name = "EleutherAI/pythia-70m-deduped"
+        params, lm_cfg = load_model(model_name)
+        tok = AutoTokenizer.from_pretrained(model_name)
+        texts = load_text_dataset("NeelNanda/pile-10k")
+        token_rows, _ = chunk_and_tokenize(texts, tok, max_length=256,
+                                           eos_token_id=lm_cfg.eos_token_id)
+        forward = forward_fn(lm_cfg)
+        out_root = Path(args.out or "frontier_out_pythia70m")
+        layer, context_note = args.layer, "pile-10k ctx256"
+        chunk_gb, batch_size, l1_range = 2.0, 1024, None
+
+    acts_dir = out_root / "activations"
+    tap = f"residual.{layer}"
+    if not (acts_dir / tap / "meta.json").exists():
+        harvest_activations(params, lm_cfg, token_rows, layers=[layer],
+                            layer_loc="residual", output_folder=acts_dir,
+                            model_batch_size=4, chunk_size_gb=chunk_gb,
+                            forward=forward)
+    store = ChunkStore(acts_dir / tap)
+    print(f"harvested {store.n_chunks} chunk(s) at {tap}", file=sys.stderr)
+
+    cfg = EnsembleArgs(
+        output_folder=str(out_root / "sweep"),
+        dataset_folder=str(acts_dir / tap),
+        layer=layer, layer_loc="residual",
+        learned_dict_ratio=args.ratio, batch_size=batch_size,
+        lr=1e-3, n_chunks=args.n_chunks)
+    sweep(lambda c, m: dense_l1_range_experiment(
+        c, m, l1_range=l1_range, activation_dim=store.activation_dim),
+        cfg, log_every=100)
+
+    snaps = sorted((out_root / "sweep").glob("_*"),
+                   key=lambda p: int(p.name[1:]))
+    dict_files = sorted(snaps[-1].glob("*_learned_dicts.pkl"))
+    eval_batch = store.load_chunk(0)[:8192]
+    scores = generate_scores(dict_files, eval_batch,
+                             out_path=out_root / "frontier_scores.json")
+    plot_fvu_sparsity(scores, group_by="dict_size",
+                      save_path=out_root / "frontier.png",
+                      title=f"pythia-70m L{layer} residual frontier "
+                            f"({context_note})")
+    best = min(scores, key=lambda s: s["fvu"])
+    print(f"frontier: {len(scores)} dicts -> {out_root}/frontier_scores.json "
+          f"(best FVU {best['fvu']:.4f} @ L0 {best['l0']:.1f})")
+
+
+if __name__ == "__main__":
+    main()

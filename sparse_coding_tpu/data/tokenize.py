@@ -9,6 +9,7 @@ array plus the nats/byte ratio used for bits-per-byte perplexity conversion.
 
 from __future__ import annotations
 
+from pathlib import Path
 from typing import Iterable, Optional
 
 import numpy as np
@@ -76,15 +77,93 @@ def load_token_dataset(path: str | Path) -> np.ndarray:
     return np.load(Path(path).with_suffix(".npy"))
 
 
+PILE_SHARD_URL = "https://the-eye.eu/public/AI/pile/train/{shard:02d}.jsonl.zst"
+_PILE_NAMES = {"the_pile", "eleutherai/pile", "pile"}
+
+
+def load_pile_shard(shard: Optional[int] = None,
+                    cache_dir: str | Path = "~/.cache/sparse_coding_tpu/pile",
+                    max_docs: Optional[int] = None,
+                    allow_download: bool = False) -> list[str]:
+    """Manual Pile-shard loader — the reference's curl+unzstd fallback when
+    the HF pile dataset is unavailable (activation_dataset.py:124-129).
+    Looks for `{NN}.jsonl(.zst)` under cache_dir (shard=None uses the lowest
+    shard present); with allow_download=True (meaningless in a zero-egress
+    image, but the capability exists) fetches shard 0 via curl first. .zst
+    decompression streams through the zstandard module — no zstd binary
+    needed. Shards are TRAIN-split jsonl with a fixed "text" field."""
+    import json as _json
+
+    cache_dir = Path(cache_dir).expanduser()
+    if shard is None:
+        found = sorted(cache_dir.glob("[0-9][0-9].jsonl*"))
+        shard = int(found[0].name[:2]) if found else 0
+    plain = cache_dir / f"{shard:02d}.jsonl"
+    compressed = cache_dir / f"{shard:02d}.jsonl.zst"
+    if not plain.exists() and not compressed.exists():
+        if not allow_download:
+            raise FileNotFoundError(
+                f"no pile shard {shard:02d}.jsonl(.zst) under {cache_dir}; "
+                "download one (PILE_SHARD_URL) or pass allow_download=True")
+        import subprocess
+
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        url = PILE_SHARD_URL.format(shard=shard)
+        # download to a temp name: an interrupted transfer must never leave
+        # a truncated file where the cache check would trust it
+        tmp = compressed.with_suffix(".zst.part")
+        subprocess.run(["curl", "-fL", "-o", str(tmp), url], check=True)
+        tmp.rename(compressed)
+
+    texts: list[str] = []
+
+    def take(lines) -> list[str]:
+        for line in lines:
+            if not line.strip():
+                continue
+            texts.append(_json.loads(line)["text"])
+            if max_docs is not None and len(texts) >= max_docs:
+                break
+        return texts
+
+    if plain.exists():
+        with open(plain, encoding="utf-8") as fh:
+            return take(fh)
+    import io
+
+    import zstandard
+
+    with open(compressed, "rb") as fh:
+        stream = zstandard.ZstdDecompressor().stream_reader(fh)
+        return take(io.TextIOWrapper(stream, encoding="utf-8"))
+
+
 def load_text_dataset(dataset_name: str, split: str = "train",
                       text_key: str = "text",
-                      max_docs: Optional[int] = None) -> list[str]:
+                      max_docs: Optional[int] = None,
+                      pile_shard_dir: Optional[str | Path] = None) -> list[str]:
     """HF-datasets loader (reference: make_sentence_dataset,
     activation_dataset.py:121-134). Requires a populated local HF cache in
-    this zero-egress image."""
+    this zero-egress image. For pile datasets a manually-downloaded shard
+    (load_pile_shard; reference's curl+unzstd path,
+    activation_dataset.py:124-129) is the fallback when the HF load fails."""
     from datasets import load_dataset
 
-    ds = load_dataset(dataset_name, split=split)
+    try:
+        ds = load_dataset(dataset_name, split=split)
+    except Exception as hf_err:
+        # manual shards are train-split only: never silently substitute
+        # train text for another requested split
+        if dataset_name.lower() in _PILE_NAMES and split == "train":
+            kwargs = {} if pile_shard_dir is None else {"cache_dir": pile_shard_dir}
+            try:
+                return load_pile_shard(max_docs=max_docs, **kwargs)
+            except FileNotFoundError as shard_err:
+                raise RuntimeError(
+                    f"HF load of {dataset_name} failed ({hf_err}) and the "
+                    f"manual-shard fallback found nothing ({shard_err})"
+                ) from hf_err
+        raise
     if max_docs is not None:
         ds = ds.select(range(min(max_docs, len(ds))))
     return ds[text_key]

@@ -280,20 +280,22 @@ def main(argv=None) -> None:
         print(json.dumps(read_scores(cfg.output_folder), indent=2))
         return
 
-    from sparse_coding_tpu.data.tokenize import load_token_dataset
-
+    # all cheap argument validation BEFORE paying for token/LM loading
     if not extra.tokens:
         raise SystemExit("--tokens TOKENS.npy is required for this subcommand")
+    if sub != "interpret" and not extra.target:
+        raise SystemExit(f"--target ROOT is required for {sub}")
+    if sub == "interpret" and not cfg.learned_dict_path:
+        raise SystemExit("--learned_dict_path is required")
+
+    from sparse_coding_tpu.data.tokenize import load_token_dataset
+
     token_rows = load_token_dataset(extra.tokens)
     params, lm_cfg, decode_token, forward = _load_lm(cfg.model_name)
     common = dict(params=params, lm_cfg=lm_cfg, token_rows=token_rows,
                   decode_token=decode_token, forward=forward)
 
-    if sub != "interpret" and not extra.target:
-        raise SystemExit(f"--target ROOT is required for {sub}")
     if sub == "interpret":
-        if not cfg.learned_dict_path:
-            raise SystemExit("--learned_dict_path is required")
         results = run_folder([cfg.learned_dict_path], cfg, **common)
     elif sub == "run_group":
         paths = sorted(str(p) for p in Path(extra.target).rglob("*.pkl"))

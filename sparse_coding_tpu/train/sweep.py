@@ -234,8 +234,11 @@ def sweep(
                                          "rng_state": rng_state})
             ckpt_dir = out_dir / "ckpt"
             prev = out_dir / "ckpt_prev"
-            shutil.rmtree(prev, ignore_errors=True)
+            # drop the old prev only while ckpt/ still exists, so at every
+            # instant at least one COMPLETE set (ckpt or ckpt_prev) survives
+            # a crash anywhere in this swap
             if ckpt_dir.exists():
+                shutil.rmtree(prev, ignore_errors=True)
                 ckpt_dir.rename(prev)
             staging.rename(ckpt_dir)
             shutil.rmtree(prev, ignore_errors=True)

