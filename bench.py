@@ -77,7 +77,7 @@ SCAN_CHUNK = 10  # steps fused into one device program (amortizes dispatch)
 
 def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
                    n_members=None, batch=None, bench_steps=None,
-                   scan_chunk=None) -> float:
+                   scan_chunk=None, batch_dtype=None) -> float:
     """Shared ensemble-throughput measurement (bench_suite.py reuses it with
     its own scales)."""
     import contextlib
@@ -103,6 +103,10 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
 
         batches = jax.random.normal(jax.random.PRNGKey(1),
                                     (scan_chunk, batch, d_act))
+        if batch_dtype is not None:
+            # half-width activation stream (sweep train_dtype analogue):
+            # halves the per-step HBM read of the batch stack
+            batches = batches.astype(batch_dtype)
         aux = ens.run_steps(batches)  # warmup: compiles the scanned step
         jax.block_until_ready(aux.losses["loss"])
 
@@ -213,7 +217,9 @@ def main() -> None:
         # bench over an optional optimization (diagnostics go to stderr)
         for kwargs in ({"use_fused": True},
                        {"use_fused": False, "matmul_precision": "bfloat16"},
-                       {"use_fused": True, "matmul_precision": "bfloat16"}):
+                       {"use_fused": True, "matmul_precision": "bfloat16"},
+                       {"use_fused": True, "matmul_precision": "bfloat16",
+                        "batch_dtype": "bfloat16"}):
             try:
                 rate = _time_ensemble(**kwargs)
                 mfu_s = (f", mfu={rate * fpa / peak / n_chips:.4f}"

@@ -142,6 +142,10 @@ class EnsembleArgs(BaseArgs):
     # state per 2 GB chunk would dominate wall time; <=0 checkpoints only
     # after the final chunk (VERDICT r1 weak#6)
     checkpoint_every_chunks: int = 1
+    # activation dtype through host RAM + host→device transfer during
+    # training ("float32" | "bfloat16"); params/optimizer stay f32 and the
+    # jitted step promotes, so only input precision drops
+    train_dtype: str = "float32"
 
 
 @dataclass

@@ -155,6 +155,13 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
         ],
     )
 
+    # member axis is embarrassingly parallel (each m owns disjoint output
+    # blocks); batch-tile axis accumulates into them and must stay
+    # sequential. "parallel" lets Mosaic split members across cores on
+    # multi-core chips (e.g. v4); harmless on single-core generations.
+    compiler_params = (None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary")))
+
     dw, db, activity, losses = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -165,6 +172,8 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
             jax.ShapeDtypeStruct((n_members, 1, 3), jnp.float32),
         ],
         interpret=interpret,
+        **({} if compiler_params is None else
+           {"compiler_params": compiler_params}),
     )(alphas.astype(jnp.float32), batch, w_normed,
       bias.reshape(n_members, 1, n_feats))
 
@@ -202,9 +211,13 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
                 f"d={e.shape[2]} batch={batch.shape[0]}; use the autodiff path")
     norms = jnp.clip(jnp.linalg.norm(e, axis=-1, keepdims=True), 1e-8)
     w_normed = e / norms
+    # a bf16 activation stream (sweep train_dtype) is cast up on device —
+    # the kernel's dots want matching f32 operands; the host→device saving
+    # already happened
     losses, dw, db, activity = fused_tied_sae_grads(
-        w_normed, params_stacked["encoder_bias"], alphas, batch,
-        batch_tile=batch_tile, interpret=interpret, total_batch=total_batch)
+        w_normed, params_stacked["encoder_bias"], alphas,
+        batch.astype(jnp.float32), batch_tile=batch_tile,
+        interpret=interpret, total_batch=total_batch)
     grads = {"encoder": normalize_with_vjp(e, dw),
              "encoder_bias": db}
     return losses, grads, activity

@@ -355,10 +355,62 @@ def run_pythia70m_mlp_center(layer: int = 2, ratio: float = 4.0):
                                               layer, "mlp", ratio)
 
 
+def run_pythia70m_resid_denoising(layer: int = 2):
+    """LISTA residual-denoising sweep at the canonical location
+    (reference: big_sweep_experiments.py:341-433 residual_denoising runs)."""
+    return residual_denoising_experiment, _cfg("EleutherAI/pythia-70m-deduped",
+                                               layer, "residual", 4.0)
+
+
+def run_pythia70m_zero_l1(layer: int = 2):
+    """Pure-reconstruction control next to small l1s
+    (reference: big_sweep_experiments.py:497-541)."""
+    return zero_l1_baseline_experiment, _cfg("EleutherAI/pythia-70m-deduped",
+                                             layer, "residual", 4.0)
+
+
+def run_pythia70m_long_l1(layer: int = 2):
+    """32-point l1 grid (reference's wider-grid sweeps)."""
+    return long_l1_range_experiment, _cfg("EleutherAI/pythia-70m-deduped",
+                                          layer, "residual", 4.0)
+
+
+def run_pythia70m_reverse(layer: int = 2):
+    """ReverseSAE family at the canonical location
+    (reference: sae_ensemble.py:447-503 consumers)."""
+    return reverse_l1_range_experiment, _cfg("EleutherAI/pythia-70m-deduped",
+                                             layer, "residual", 4.0)
+
+
+def run_pythia70m_positive_mlp(layer: int = 2):
+    """Positive (nonneg-dict, shifted-input) SAEs on MLP activations
+    (reference: mlp_tests.py:80-115)."""
+    return positive_l1_range_experiment, _cfg("EleutherAI/pythia-70m-deduped",
+                                              layer, "mlp", 4.0)
+
+
+def run_pythia70m_semilinear(layer: int = 2):
+    return semilinear_l1_range_experiment, _cfg("EleutherAI/pythia-70m-deduped",
+                                                layer, "residual", 4.0)
+
+
+def run_pythia70m_rica(layer: int = 2):
+    """RICA family (reference: big_sweep_experiments.py RICA/ICA-topk runs)."""
+    return rica_experiment, _cfg("EleutherAI/pythia-70m-deduped",
+                                 layer, "residual", 4.0)
+
+
 LAUNCHERS = {
     "pythia70m_resid": run_pythia70m_resid,
     "pythia70m_mlp": run_pythia70m_mlp,
     "pythia70m_mlp_center": run_pythia70m_mlp_center,
+    "pythia70m_resid_denoising": run_pythia70m_resid_denoising,
+    "pythia70m_zero_l1": run_pythia70m_zero_l1,
+    "pythia70m_long_l1": run_pythia70m_long_l1,
+    "pythia70m_reverse": run_pythia70m_reverse,
+    "pythia70m_positive_mlp": run_pythia70m_positive_mlp,
+    "pythia70m_semilinear": run_pythia70m_semilinear,
+    "pythia70m_rica": run_pythia70m_rica,
     "pythia410m_mlpout_topk": run_pythia410m_mlpout_topk,
     "pythia14b_resid": run_pythia14b_resid,
     "gpt2sm_resid": run_gpt2sm_resid,

@@ -162,6 +162,12 @@ def sweep(
     if cfg.center_activations:
         center = store.chunk_mean(0)  # (reference: big_sweep.py:359-364)
 
+    # bf16 keeps activations half-width from disk through the host→device
+    # pipe; the jitted step promotes to f32 against the f32 params, so only
+    # input precision (not accumulation) drops
+    train_np_dtype = (jnp.bfloat16 if cfg.train_dtype == "bfloat16"
+                      else np.dtype(cfg.train_dtype))
+
     sharding = batch_sharding(mesh) if mesh is not None else None
     if cfg.save_every_chunks:
         save_points = set(range(cfg.save_every_chunks - 1, len(chunk_order),
@@ -177,9 +183,11 @@ def sweep(
         # fresh throughput window per chunk: checkpoint/artifact wall time
         # between chunks must not dilute the training-rate signal
         timer.reset()
-        chunk = store.load_chunk(int(chunk_idx))
+        chunk = store.load_chunk(int(chunk_idx), dtype=train_np_dtype)
         if center is not None:
-            chunk = chunk - center
+            # cast the mean down rather than the chunk up: keeps the bf16
+            # path bf16 end to end (host RAM + host→device traffic halved)
+            chunk = chunk - center.astype(train_np_dtype)
         batches = store.batches(chunk, cfg.batch_size, rng)
         for batch in device_prefetch(batches, sharding):
             step += 1
@@ -262,7 +270,9 @@ def _save_artifacts(ensembles, folder: Path, chunk: np.ndarray,
     log_standard_metrics :86-156)."""
     folder.mkdir(parents=True, exist_ok=True)
     rng = np.random.default_rng(0)
-    eval_batch = jnp.asarray(chunk[rng.permutation(chunk.shape[0])[:4096]])
+    # evals always run in f32 even when training streams bf16 activations
+    eval_batch = jnp.asarray(chunk[rng.permutation(chunk.shape[0])[:4096]],
+                             jnp.float32)
     for ensemble, hypers, name in ensembles:
         dicts = _flat_dicts(ensemble)
         tagged = list(zip(dicts, hypers))

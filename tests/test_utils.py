@@ -168,6 +168,29 @@ def test_sweep_checkpoint_cadence(tmp_path, monkeypatch):
     assert len(counts) == 4
 
 
+def test_sweep_bf16_train_dtype(tmp_path):
+    """train_dtype=bfloat16 streams half-width activations through the host
+    pipe; training stays finite and lands near the f32 run (params/optimizer
+    remain f32, so only input precision drops)."""
+    from sparse_coding_tpu.metrics.core import fraction_variance_unexplained
+    from sparse_coding_tpu.data.chunk_store import ChunkStore
+    from sparse_coding_tpu.train.experiments import dense_l1_range_experiment
+    from sparse_coding_tpu.train.sweep import sweep
+
+    build = lambda c, m: dense_l1_range_experiment(c, m, l1_range=[3e-4],
+                                                   activation_dim=16)
+    out = {}
+    for dtype in ("float32", "bfloat16"):
+        result = sweep(build, _sweep_cfg(tmp_path, dtype, train_dtype=dtype,
+                                         n_chunks=3), log_every=50)
+        ld, _ = result["dense_l1_range"][0]
+        eval_batch = ChunkStore(tmp_path / "chunks").load_chunk(0)[:2048]
+        out[dtype] = float(fraction_variance_unexplained(ld, eval_batch))
+    assert np.isfinite(out["bfloat16"])
+    # same data, same steps: bf16 inputs shouldn't move FVU materially
+    assert abs(out["bfloat16"] - out["float32"]) < 0.05, out
+
+
 def test_sweep_crash_resume_bitwise(tmp_path, monkeypatch):
     """Kill a sweep mid-run; resume=True completes it with final params
     BITWISE identical to an uninterrupted run. The staged checkpoint-set
