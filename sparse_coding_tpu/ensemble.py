@@ -320,20 +320,25 @@ class Ensemble:
         self._fused_explicit = use_fused is True
         self._step_fn = self._standard_step
         self._scan_fn = None
-        self._resolved_batch: Optional[int] = None
+        self._resolved_batch: Optional[tuple[int, int]] = None
         self._donate = donate
 
     @property
     def n_members(self) -> int:
         return self.state.n_members
 
-    def _resolve_step(self, batch_size: int):
+    def _resolve_step(self, batch_size: int, batch_itemsize: int = 4):
         """Pick fused vs autodiff for this batch size: the fused kernel needs
-        a VMEM-fitting tile of the PER-DEVICE batch slice. Re-checked whenever
-        the incoming batch size changes (a later batch with no fitting tile
-        quietly falls back in auto mode instead of erroring mid-sweep), and
-        the scanned-step cache is invalidated when the choice flips."""
-        if self._fused_step is None or batch_size == self._resolved_batch:
+        a VMEM-fitting tile of the PER-DEVICE batch slice. `batch_itemsize`
+        must be the itemsize the KERNEL will see (2 only for bf16 — every
+        other dtype is cast to f32 before the kernel, see
+        fused_tied_sae_loss_and_grads), so this check and the kernel's own
+        tile pick always agree. Re-checked whenever the incoming batch
+        size/dtype changes (a later batch with no fitting tile quietly falls
+        back in auto mode instead of erroring mid-sweep), and the
+        scanned-step cache is invalidated when the choice flips."""
+        if (self._fused_step is None
+                or (batch_size, batch_itemsize) == self._resolved_batch):
             return
         from sparse_coding_tpu.ops.fused_sae import pick_batch_tile
 
@@ -342,7 +347,8 @@ class Ensemble:
         local = (batch_size // self.mesh.shape["data"]
                  if self.mesh is not None else batch_size)
         prev_fn = self._step_fn
-        if pick_batch_tile(local, n_feats, d) is not None:
+        if pick_batch_tile(local, n_feats, d,
+                           batch_itemsize=batch_itemsize) is not None:
             self._step_fn = self._fused_step
             self.fused = True
         elif self._fused_explicit:
@@ -355,7 +361,7 @@ class Ensemble:
             self.fused = False  # auto mode: quietly keep autodiff
         if self._step_fn is not prev_fn:
             self._scan_fn = None
-        self._resolved_batch = batch_size
+        self._resolved_batch = (batch_size, batch_itemsize)
 
     def step_batch(self, batch: Array) -> AuxData:
         """One training step on a [batch, d] activation slab shared by every
@@ -366,7 +372,9 @@ class Ensemble:
                 raise ValueError(
                     f"batch size {batch.shape[0]} not divisible by mesh data "
                     f"axis {n_data}; drop the remainder or pad the batch")
-        self._resolve_step(batch.shape[0])
+        from sparse_coding_tpu.ops.fused_sae import kernel_batch_itemsize
+
+        self._resolve_step(batch.shape[0], kernel_batch_itemsize(batch.dtype))
         if self.mesh is not None:
             batch = jax.device_put(batch, NamedSharding(self.mesh, P("data")))
         self.state, aux = self._step_fn(self.state, batch)
@@ -383,7 +391,10 @@ class Ensemble:
                 raise ValueError(
                     f"batch size {batches.shape[1]} not divisible by mesh "
                     f"data axis {n_data}")
-        self._resolve_step(int(batches.shape[1]))
+        from sparse_coding_tpu.ops.fused_sae import kernel_batch_itemsize
+
+        self._resolve_step(int(batches.shape[1]),
+                           kernel_batch_itemsize(batches.dtype))
         if self.mesh is not None:
             batches = jax.device_put(
                 batches, NamedSharding(self.mesh, P(None, "data")))

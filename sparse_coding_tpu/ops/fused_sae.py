@@ -36,27 +36,45 @@ Array = jax.Array
 VMEM_BUDGET_BYTES = 12 * 2**20  # leave headroom out of ~16 MB/core
 
 
-def _working_set(batch_tile: int, n_feats: int, d: int) -> int:
+def _working_set(batch_tile: int, n_feats: int, d: int,
+                 batch_itemsize: int = 4) -> int:
     f32 = 4
+    # a sub-f32 x tile is cast up INSIDE the kernel, so its f32 copy
+    # coexists with the half-width input tile in VMEM: bf16 saves HBM
+    # traffic, not VMEM (14 B/elem peak vs 12 for f32)
+    cast_copy = f32 if batch_itemsize < f32 else 0
     return (
         n_feats * d * f32 * 2      # W + dW accumulator
         + batch_tile * n_feats * f32 * 2  # c and r@Wᵀ
-        + batch_tile * d * f32 * 3  # x tile, x̂, r
+        + batch_tile * d * (batch_itemsize + cast_copy + 2 * f32)  # x, x̂, r
         + n_feats * f32 * 2        # b, db
     )
 
 
-def pick_batch_tile(batch: int, n_feats: int, d: int) -> Optional[int]:
+def pick_batch_tile(batch: int, n_feats: int, d: int,
+                    batch_itemsize: int = 4) -> Optional[int]:
     """Largest batch tile (≥64) that fits the VMEM budget and divides the
-    batch; None if even 64 doesn't fit."""
+    batch; None if even 64 doesn't fit. `batch_itemsize` is the on-HBM width
+    of the activation stream (2 for bf16); the in-VMEM f32 cast copy is
+    accounted for, so bf16 tiles are never larger than f32 ones."""
     for tile in (512, 256, 128, 64):
-        if batch % tile == 0 and _working_set(tile, n_feats, d) <= VMEM_BUDGET_BYTES:
+        if batch % tile == 0 and _working_set(
+                tile, n_feats, d, batch_itemsize) <= VMEM_BUDGET_BYTES:
             return tile
     return None
 
 
 def fused_supported(n_members: int, batch: int, n_feats: int, d: int) -> bool:
     return pick_batch_tile(batch, n_feats, d) is not None
+
+
+def kernel_batch_itemsize(dtype) -> int:
+    """On-HBM itemsize of the batch AS THE KERNEL SEES IT: bf16 passes
+    through half-width; every other dtype is cast to f32 before the kernel
+    (fused_tied_sae_loss_and_grads). The single source of truth for VMEM
+    admission checks — keep callers (ensemble._resolve_step) on this helper
+    so the tile check can never disagree with the kernel's input dtype."""
+    return 2 if dtype == jnp.bfloat16 else 4
 
 
 def _kernel(alpha_ref, x_ref, w_ref, b_ref, dw_ref, db_ref, act_ref, loss_ref,
@@ -66,7 +84,9 @@ def _kernel(alpha_ref, x_ref, w_ref, b_ref, dw_ref, db_ref, act_ref, loss_ref,
     m = pl.program_id(0)
     i = pl.program_id(1)
     w = w_ref[0]  # [n, d]
-    xb = x_ref[...]  # [Bt, d]
+    # a bf16 activation stream rides HBM→VMEM half-width and is cast up
+    # HERE (exact, f32 ⊃ bf16): the f32 copy never exists outside VMEM
+    xb = x_ref[...].astype(jnp.float32)  # [Bt, d]
     b = b_ref[0, 0]  # [n]  (operand carried as [N, 1, n] for Mosaic tiling)
     alpha = alpha_ref[m]  # scalar-prefetched [N] array in SMEM
 
@@ -113,7 +133,8 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
 
     Args:
       w_normed: [N, n, d] row-normalized dictionaries.
-      bias: [N, n]; alphas: [N] l1 coefficients; batch: [B, d] shared.
+      bias: [N, n]; alphas: [N] l1 coefficients; batch: [B, d] shared
+        (f32 or bf16 — bf16 is read half-width and cast up in VMEM).
       total_batch: loss-normalization denominator; defaults to the batch
         actually passed. A shard_map caller hands each device its LOCAL batch
         slice but the GLOBAL size here, so per-device partial sums psum to
@@ -203,21 +224,24 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
     {"encoder": [N, n, d], "encoder_bias": [N, n]}. total_batch: see
     fused_tied_sae_grads (global batch size when called on a shard)."""
     e = params_stacked["encoder"]
+    # bf16 batches enter the kernel AS bf16 (cast up per-tile in VMEM):
+    # the x HBM read is half-width and no device-wide f32 copy of the batch
+    # is ever materialized. Anything else (f16/f64/int) is cast to f32 —
+    # bf16 is the only sub-f32 dtype the MXU path wants.
+    if batch.dtype != jnp.bfloat16:
+        batch = batch.astype(jnp.float32)
     if batch_tile is None:
-        batch_tile = pick_batch_tile(batch.shape[0], e.shape[1], e.shape[2])
+        batch_tile = pick_batch_tile(batch.shape[0], e.shape[1], e.shape[2],
+                                     batch_itemsize=batch.dtype.itemsize)
         if batch_tile is None:
             raise ValueError(
                 f"no VMEM-fitting batch tile for shapes n={e.shape[1]} "
                 f"d={e.shape[2]} batch={batch.shape[0]}; use the autodiff path")
     norms = jnp.clip(jnp.linalg.norm(e, axis=-1, keepdims=True), 1e-8)
     w_normed = e / norms
-    # a bf16 activation stream (sweep train_dtype) is cast up on device —
-    # the kernel's dots want matching f32 operands; the host→device saving
-    # already happened
     losses, dw, db, activity = fused_tied_sae_grads(
-        w_normed, params_stacked["encoder_bias"], alphas,
-        batch.astype(jnp.float32), batch_tile=batch_tile,
-        interpret=interpret, total_batch=total_batch)
+        w_normed, params_stacked["encoder_bias"], alphas, batch,
+        batch_tile=batch_tile, interpret=interpret, total_batch=total_batch)
     grads = {"encoder": normalize_with_vjp(e, dw),
              "encoder_bias": db}
     return losses, grads, activity

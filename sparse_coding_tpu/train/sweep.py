@@ -165,6 +165,10 @@ def sweep(
     # bf16 keeps activations half-width from disk through the host→device
     # pipe; the jitted step promotes to f32 against the f32 params, so only
     # input precision (not accumulation) drops
+    if cfg.train_dtype not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"train_dtype must be 'float32' or 'bfloat16', got "
+            f"{cfg.train_dtype!r}")
     train_np_dtype = (jnp.bfloat16 if cfg.train_dtype == "bfloat16"
                       else np.dtype(cfg.train_dtype))
 
@@ -186,8 +190,10 @@ def sweep(
         chunk = store.load_chunk(int(chunk_idx), dtype=train_np_dtype)
         if center is not None:
             # cast the mean down rather than the chunk up: keeps the bf16
-            # path bf16 end to end (host RAM + host→device traffic halved)
-            chunk = chunk - center.astype(train_np_dtype)
+            # path bf16 end to end (host RAM + host→device traffic halved).
+            # In place: load_chunk returns a fresh array, and out-of-place
+            # would briefly hold two full chunks in host RAM
+            chunk -= center.astype(train_np_dtype)
         batches = store.batches(chunk, cfg.batch_size, rng)
         for batch in device_prefetch(batches, sharding):
             step += 1

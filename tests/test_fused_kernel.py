@@ -156,6 +156,46 @@ def test_fused_auto_gating(rng):
     assert can_use_fused_tied_step(FunctionalTiedSAE, members, interpret=True)
 
 
+def test_fused_bf16_batch_matches_f32_cast(rng):
+    """A bf16 activation stream enters the kernel half-width and is cast up
+    per-tile in VMEM — numerically identical to casting the whole batch to
+    f32 first (bf16→f32 is exact), so the only difference is HBM traffic."""
+    k_init, k_data = jax.random.split(rng)
+    _, params, alphas = _stacked_members(k_init)
+    batch_bf16 = jax.random.normal(k_data, (BATCH, D)).astype(jnp.bfloat16)
+
+    losses_h, grads_h, act_h = fused_tied_sae_loss_and_grads(
+        params, alphas, batch_bf16, batch_tile=128, interpret=True)
+    losses_f, grads_f, act_f = fused_tied_sae_loss_and_grads(
+        params, alphas, batch_bf16.astype(jnp.float32), batch_tile=128,
+        interpret=True)
+
+    for k in losses_h:
+        np.testing.assert_array_equal(np.asarray(losses_h[k]),
+                                      np.asarray(losses_f[k]))
+    for name in grads_h:
+        np.testing.assert_array_equal(np.asarray(grads_h[name]),
+                                      np.asarray(grads_f[name]))
+    np.testing.assert_array_equal(np.asarray(act_h), np.asarray(act_f))
+
+
+def test_fused_bf16_tile_accounting():
+    """bf16 saves HBM traffic, NOT VMEM: the kernel casts the half-width x
+    tile up in VMEM, so its f32 copy coexists with the input tile
+    (14 B/elem peak vs 12 for f32). The budget model must count that copy —
+    bf16 working sets are strictly LARGER and bf16 tiles never exceed f32
+    ones, so a tile admitted for bf16 always fits the real VMEM."""
+    from sparse_coding_tpu.ops.fused_sae import _working_set, pick_batch_tile
+
+    for tile in (64, 128, 256, 512):
+        assert (_working_set(tile, 2048, 512, batch_itemsize=2)
+                > _working_set(tile, 2048, 512, batch_itemsize=4))
+    for n_feats in (1024, 2048, 4096, 8192):
+        f32_tile = pick_batch_tile(2048, n_feats, 512) or 0
+        bf16_tile = pick_batch_tile(2048, n_feats, 512, batch_itemsize=2) or 0
+        assert bf16_tile <= f32_tile
+
+
 def test_fused_supported_budget():
     from sparse_coding_tpu.ops.fused_sae import pick_batch_tile
 
@@ -171,8 +211,11 @@ def test_kernel_lowers_for_tpu():
     needing hardware."""
     shapes = [((2, 64, 32), (2, 64), (2,), (256, 32)),
               ((32, 2048, 512), (32, 2048), (32,), (2048, 512))]
-    for ws, bs, as_, xs in shapes:
-        w, b, a, x = (jnp.zeros(s) for s in (ws, bs, as_, xs))
-        jax.jit(
-            lambda w, b, a, x: fused_tied_sae_grads(w, b, a, x, batch_tile=64)
-        ).trace(w, b, a, x).lower(lowering_platforms=("tpu",))
+    for x_dtype in (jnp.float32, jnp.bfloat16):
+        for ws, bs, as_, xs in shapes:
+            w, b, a = (jnp.zeros(s) for s in (ws, bs, as_))
+            x = jnp.zeros(xs, x_dtype)
+            jax.jit(
+                lambda w, b, a, x: fused_tied_sae_grads(w, b, a, x,
+                                                        batch_tile=64)
+            ).trace(w, b, a, x).lower(lowering_platforms=("tpu",))
