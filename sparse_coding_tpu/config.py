@@ -146,6 +146,10 @@ class EnsembleArgs(BaseArgs):
     # training ("float32" | "bfloat16"); params/optimizer stay f32 and the
     # jitted step promotes, so only input precision drops
     train_dtype: str = "float32"
+    # "msgpack" (host-gathered, single file — fine for small sweeps) or
+    # "orbax" (sharded per-host async writes, restores straight onto the
+    # mesh — the right choice at big-SAE/multi-host scale; utils/orbax_ckpt)
+    checkpoint_backend: str = "msgpack"
 
 
 @dataclass

@@ -1,0 +1,158 @@
+"""Orbax checkpoint backend — the TPU-native alternative to msgpack.
+
+The msgpack path (utils/checkpoint.py) gathers the full training state to
+host 0 and serializes it inline, which is fine at small-sweep scale but
+wrong for the flagship multi-chip configuration: a big-SAE ensemble's
+params + Adam moments are sharded over the mesh, and a gather-then-write
+checkpoint (a) materializes the whole state in one host's RAM and (b)
+blocks training for the full serialization. This backend keeps the
+reference capability (full-state exact resume, SURVEY.md §5; the reference
+itself never persists training state — big_sweep.py:378-384 saves only
+converted artifacts) but writes the TPU way:
+
+- **sharded**: each host writes exactly its own array shards (OCDBT);
+  restore places shards directly back onto the mesh with their recorded
+  NamedShardings — no host-side gather or scatter ever happens;
+- **async with real overlap**: one orbax ``AsyncCheckpointer`` per target
+  path (an AsyncCheckpointer serializes ITS OWN saves — ``save()`` blocks
+  on its previous write — so a shared instance would fully serialize a
+  multi-ensemble checkpoint round). ``save`` returns once device arrays are
+  snapshotted to host buffers; disk writes proceed in background across
+  paths concurrently, and training continues. Call ``wait()`` before
+  relying on the files (e.g. the sweep's staged-set swap — which the sweep
+  defers to the NEXT checkpoint round precisely so the writes overlap a
+  full round of training);
+- **atomic**: orbax writes to a temp dir and renames on commit, so a crash
+  mid-write never leaves a torn checkpoint;
+- **multi-host aware**: the orbax save itself is collective (every process
+  must call it); the metadata sidecar is written by process 0 only.
+  Cross-host barriers around directory swaps are the caller's job
+  (train/sweep.py uses sync_global_processes).
+
+Metadata (sig_name, chunks_done, RNG cursor, ...) rides a JSON sidecar next
+to the checkpoint directory, mirroring the msgpack backend's contract so
+`train/sweep.py::resume_sweep_state` treats both backends uniformly.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional
+
+import jax
+
+from sparse_coding_tpu.ensemble import Ensemble, EnsembleState
+
+_SUFFIX = ".orbax"
+
+
+def _state_tree(state: EnsembleState) -> dict:
+    return {"params": state.params, "buffers": state.buffers,
+            "opt_state": state.opt_state, "lrs": state.lrs,
+            "step": state.step}
+
+
+def _meta_path(path: Path) -> Path:
+    return path.with_suffix(path.suffix + ".meta.json")
+
+
+def checkpoint_path(base: Path, name: str) -> Path:
+    """Canonical on-disk location for one ensemble's orbax checkpoint —
+    train/sweep.py builds both save and resume paths through this."""
+    return Path(base) / f"{name}{_SUFFIX}"
+
+
+class AsyncEnsembleCheckpointer:
+    """Async orbax checkpointing for ensemble training state.
+
+    Holds one orbax ``AsyncCheckpointer`` PER TARGET PATH (lazily created,
+    reused across checkpoint rounds) so saves to different paths overlap on
+    disk; a save to the same path naturally serializes behind that path's
+    previous write. Share one instance per training loop and `close()` it
+    when done (the sweep does so in a finally block, so no background write
+    ever outlives the run and races a resume).
+    """
+
+    def __init__(self, use_async: bool = True):
+        self._use_async = use_async
+        self._ckptrs: dict[str, object] = {}
+
+    def _ckptr_for(self, path: Path):
+        import orbax.checkpoint as ocp
+
+        key = str(path)
+        if key not in self._ckptrs:
+            self._ckptrs[key] = (
+                ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+                if self._use_async else ocp.StandardCheckpointer())
+        return self._ckptrs[key]
+
+    def save(self, ens: Ensemble, path: str | Path,
+             extra: Optional[dict] = None) -> None:
+        path = Path(path)
+        if jax.process_index() == 0:
+            path.parent.mkdir(parents=True, exist_ok=True)
+        state = ens.state
+        # orbax commits via temp-dir rename and refuses to overwrite; a
+        # same-path re-save (e.g. re-running a crashed chunk) replaces it
+        self._ckptr_for(path).save(path.absolute(), _state_tree(state),
+                                   force=True)
+        if jax.process_index() == 0:
+            meta = {"sig_name": state.sig_name,
+                    "static_buffers": list(state.static_buffers),
+                    **(extra or {})}
+            _meta_path(path).write_text(
+                json.dumps(meta, indent=2, default=str))
+
+    def restore(self, ens: Ensemble, path: str | Path) -> dict:
+        """Restore in-place into a freshly-constructed, same-shape Ensemble
+        (same contract as utils/checkpoint.py::restore_ensemble). The
+        abstract template is built from the live state, so every array is
+        restored straight onto its current device/mesh placement."""
+        import orbax.checkpoint as ocp
+
+        path = Path(path)
+        self.wait()
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
+                                _state_tree(ens.state))
+        tree = self._ckptr_for(path).restore(path.absolute(), abstract)
+        ens.state = EnsembleState(
+            params=tree["params"], buffers=tree["buffers"],
+            opt_state=tree["opt_state"], lrs=tree["lrs"], step=tree["step"],
+            static_buffers=ens.state.static_buffers,
+            sig_name=ens.state.sig_name)
+        meta = _meta_path(path)
+        return json.loads(meta.read_text()) if meta.exists() else {}
+
+    def wait(self) -> None:
+        """Block until every pending write (across all paths) is durable."""
+        for ckptr in self._ckptrs.values():
+            wait = getattr(ckptr, "wait_until_finished", None)
+            if wait is not None:
+                wait()
+
+    def close(self) -> None:
+        self.wait()
+        for ckptr in self._ckptrs.values():
+            ckptr.close()
+        self._ckptrs.clear()
+
+
+def save_ensemble_orbax(ens: Ensemble, path: str | Path,
+                        extra: Optional[dict] = None) -> None:
+    """One-shot synchronous save (module-level convenience mirroring
+    utils/checkpoint.py::save_ensemble)."""
+    ckptr = AsyncEnsembleCheckpointer(use_async=False)
+    try:
+        ckptr.save(ens, path, extra)
+    finally:
+        ckptr.close()
+
+
+def restore_ensemble_orbax(ens: Ensemble, path: str | Path) -> dict:
+    ckptr = AsyncEnsembleCheckpointer(use_async=False)
+    try:
+        return ckptr.restore(ens, path)
+    finally:
+        ckptr.close()
