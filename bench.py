@@ -77,9 +77,11 @@ SCAN_CHUNK = 10  # steps fused into one device program (amortizes dispatch)
 
 def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
                    n_members=None, batch=None, bench_steps=None,
-                   scan_chunk=None, batch_dtype=None) -> float:
-    """Shared ensemble-throughput measurement (bench_suite.py reuses it with
-    its own scales)."""
+                   scan_chunk=None, batch_dtype=None,
+                   batch_tile=None) -> float:
+    """Shared ensemble-throughput measurement (bench_suite.py and tune.py
+    reuse it with their own scales; batch_tile forces the fused kernel's
+    batch tile, None = auto-pick)."""
     import contextlib
 
     from sparse_coding_tpu.ensemble import Ensemble
@@ -99,7 +101,8 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
         l1s = jnp.logspace(-4, -2, n_members)
         members = [FunctionalTiedSAE.init(k, d_act, n_dict, l1_alpha=float(l1))
                    for k, l1 in zip(keys, l1s)]
-        ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=use_fused)
+        ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=use_fused,
+                       fused_batch_tile=batch_tile)
 
         batches = jax.random.normal(jax.random.PRNGKey(1),
                                     (scan_chunk, batch, d_act))
@@ -184,6 +187,33 @@ def _spawn_cpu_fallback(init_done) -> None:
     os._exit(1)
 
 
+def _load_tuned_variant(path: str | None = None) -> dict | None:
+    """Best configuration from tune.py's TUNE.json, if present and produced
+    on a real TPU: the bench then measures the tuned configuration too, so
+    the driver's end-of-round number benefits from tuning automatically."""
+    import os
+
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "TUNE.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if data.get("quick") or data.get("backend") != "tpu":
+        return None
+    best = data.get("best") or {}
+    keys = ("use_fused", "matmul_precision", "batch_dtype", "scan_chunk",
+            "batch_tile")
+    variant = {k: v for k, v in best.items() if k in keys and v is not None}
+    if variant.get("scan_chunk") == SCAN_CHUNK:
+        del variant["scan_chunk"]  # default — keep the variant dedupable
+    return variant
+
+
 def main() -> None:
     # the axon TPU tunnel blocks forever in backend init when its terminal is
     # down — instead of hanging the driver, a watchdog THREAD (not SIGALRM:
@@ -215,11 +245,17 @@ def main() -> None:
     if jax.default_backend() == "tpu":
         # candidate fast paths; report the best that works, never crash the
         # bench over an optional optimization (diagnostics go to stderr)
-        for kwargs in ({"use_fused": True},
-                       {"use_fused": False, "matmul_precision": "bfloat16"},
-                       {"use_fused": True, "matmul_precision": "bfloat16"},
-                       {"use_fused": True, "matmul_precision": "bfloat16",
-                        "batch_dtype": "bfloat16"}):
+        variants = [{"use_fused": True},
+                    {"use_fused": False, "matmul_precision": "bfloat16"},
+                    {"use_fused": True, "matmul_precision": "bfloat16"},
+                    {"use_fused": True, "matmul_precision": "bfloat16",
+                     "batch_dtype": "bfloat16"}]
+        tuned = _load_tuned_variant()
+        if tuned is not None and tuned not in variants:
+            print(f"bench: adding tuned variant from TUNE.json: {tuned}",
+                  file=sys.stderr)
+            variants.append(tuned)
+        for kwargs in variants:
             try:
                 rate = _time_ensemble(**kwargs)
                 mfu_s = (f", mfu={rate * fpa / peak / n_chips:.4f}"

@@ -116,17 +116,20 @@ def make_fused_tied_step(
     optimizer: optax.GradientTransformation,
     donate: bool = True,
     interpret: bool = False,
+    batch_tile: Optional[int] = None,
 ) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
     """Fused-kernel step for identity-centered FunctionalTiedSAE buckets:
     loss + exact grads come from one Pallas pass (ops/fused_sae.py) instead of
-    vmap(value_and_grad); the optimizer update stays vmapped optax."""
+    vmap(value_and_grad); the optimizer update stays vmapped optax.
+    batch_tile=None lets the kernel pick the largest fitting tile."""
     from sparse_coding_tpu.ops.fused_sae import fused_tied_sae_loss_and_grads
 
     def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
         losses, grads, activity = fused_tied_sae_loss_and_grads(
             {"encoder": state.params["encoder"],
              "encoder_bias": state.params["encoder_bias"]},
-            state.buffers["l1_alpha"], batch, interpret=interpret)
+            state.buffers["l1_alpha"], batch, batch_tile=batch_tile,
+            interpret=interpret)
         params, opt_state, aux = _apply_fused_updates(
             optimizer, losses, grads, activity,
             state.params, state.opt_state, state.lrs)
@@ -142,6 +145,7 @@ def make_fused_tied_step_sharded(
     mesh: Mesh,
     donate: bool = True,
     interpret: bool = False,
+    batch_tile: Optional[int] = None,
 ) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
     """Mesh-composed fused step: the flagship multi-chip configuration
     (replacing /root/reference/cluster_runs.py:100-157's all-GPUs-training
@@ -159,8 +163,8 @@ def make_fused_tied_step_sharded(
         losses, grads, activity = fused_tied_sae_loss_and_grads(
             {"encoder": params["encoder"],
              "encoder_bias": params["encoder_bias"]},
-            buffers["l1_alpha"], local_batch, interpret=interpret,
-            total_batch=total_batch)
+            buffers["l1_alpha"], local_batch, batch_tile=batch_tile,
+            interpret=interpret, total_batch=total_batch)
         losses, grads, activity = jax.lax.psum((losses, grads, activity),
                                                "data")
         return _apply_fused_updates(optimizer, losses, grads, activity,
@@ -259,6 +263,7 @@ class Ensemble:
         donate: bool = True,
         use_fused: str | bool = "auto",
         fused_interpret: bool = False,
+        fused_batch_tile: Optional[int] = None,
     ):
         if not members:
             raise ValueError("ensemble needs at least one member")
@@ -309,15 +314,18 @@ class Ensemble:
             self._fused_step = (
                 make_fused_tied_step_sharded(self.optimizer, mesh,
                                              donate=donate,
-                                             interpret=fused_interpret)
+                                             interpret=fused_interpret,
+                                             batch_tile=fused_batch_tile)
                 if mesh is not None else
                 make_fused_tied_step(self.optimizer, donate=donate,
-                                     interpret=fused_interpret))
+                                     interpret=fused_interpret,
+                                     batch_tile=fused_batch_tile))
         # the fused kernel additionally needs a VMEM-fitting batch tile — only
         # known once the real batch arrives, so the final choice happens on
         # the first step_batch call (and is re-checked per batch size)
         self.fused = self._fused_step is not None
         self._fused_explicit = use_fused is True
+        self._fused_batch_tile = fused_batch_tile
         self._step_fn = self._standard_step
         self._scan_fn = None
         self._resolved_batch: Optional[tuple[int, int]] = None
@@ -340,15 +348,21 @@ class Ensemble:
         if (self._fused_step is None
                 or (batch_size, batch_itemsize) == self._resolved_batch):
             return
-        from sparse_coding_tpu.ops.fused_sae import pick_batch_tile
+        from sparse_coding_tpu.ops.fused_sae import pick_batch_tile, tile_fits
 
         n_feats = self.state.params["encoder"].shape[1]
         d = self.state.params["encoder"].shape[2]
         local = (batch_size // self.mesh.shape["data"]
                  if self.mesh is not None else batch_size)
         prev_fn = self._step_fn
-        if pick_batch_tile(local, n_feats, d,
-                           batch_itemsize=batch_itemsize) is not None:
+        # an explicit fused_batch_tile must itself pass admission (divide
+        # the local batch, fit VMEM) — same rule the kernel will apply
+        workable = (tile_fits(local, self._fused_batch_tile, n_feats, d,
+                              batch_itemsize)
+                    if self._fused_batch_tile is not None else
+                    pick_batch_tile(local, n_feats, d,
+                                    batch_itemsize=batch_itemsize) is not None)
+        if workable:
             self._step_fn = self._fused_step
             self.fused = True
         elif self._fused_explicit:

@@ -35,6 +35,11 @@ Array = jax.Array
 
 VMEM_BUDGET_BYTES = 12 * 2**20  # leave headroom out of ~16 MB/core
 
+# batch-tile candidates in preference order (the first VMEM-fitting,
+# batch-dividing entry wins); an explicit tile (Ensemble fused_batch_tile /
+# tune.py's tile scan) bypasses this list via tile_fits
+PREFERRED_TILES: tuple = (512, 256, 128, 64)
+
 
 def _working_set(batch_tile: int, n_feats: int, d: int,
                  batch_itemsize: int = 4) -> int:
@@ -57,11 +62,21 @@ def pick_batch_tile(batch: int, n_feats: int, d: int,
     batch; None if even 64 doesn't fit. `batch_itemsize` is the on-HBM width
     of the activation stream (2 for bf16); the in-VMEM f32 cast copy is
     accounted for, so bf16 tiles are never larger than f32 ones."""
-    for tile in (512, 256, 128, 64):
+    for tile in PREFERRED_TILES:
         if batch % tile == 0 and _working_set(
                 tile, n_feats, d, batch_itemsize) <= VMEM_BUDGET_BYTES:
             return tile
     return None
+
+
+def tile_fits(batch: int, tile: int, n_feats: int, d: int,
+              batch_itemsize: int = 4) -> bool:
+    """Would this EXPLICIT batch tile work for these shapes? (divides the
+    batch and fits the VMEM budget — the admission rule pick_batch_tile
+    applies to its candidates, exposed for callers forcing a tile.)"""
+    return (batch % tile == 0
+            and _working_set(tile, n_feats, d, batch_itemsize)
+            <= VMEM_BUDGET_BYTES)
 
 
 def fused_supported(n_members: int, batch: int, n_feats: int, d: int) -> bool:

@@ -1,0 +1,100 @@
+"""Autotune harness (tune.py) + its bench.py integration, smoke-run on CPU
+(real tuning needs the TPU; --quick exercises the full grid/record/select
+logic at tiny shapes)."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parents[1]))  # repo root: tune, bench
+
+
+def test_tune_quick_writes_best(tmp_path, monkeypatch, capsys):
+    import tune
+
+    out = tmp_path / "TUNE.json"
+    monkeypatch.setattr(sys, "argv",
+                        ["tune.py", "--quick", "--out", str(out)])
+    tune.main()
+    data = json.loads(out.read_text())
+    assert data["quick"] is True
+    assert data["best"]["acts_per_sec"] > 0
+    # results sorted best-first and the best is their max
+    rates = [r["acts_per_sec"] for r in data["results"]]
+    assert rates == sorted(rates, reverse=True)
+    assert data["best"]["acts_per_sec"] == rates[0]
+    # one JSON line per configuration on stdout
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    assert len(lines) == len(data["results"])
+
+
+def test_bench_ignores_non_tpu_tune_file(tmp_path):
+    from bench import _load_tuned_variant
+
+    quick = tmp_path / "quick.json"
+    quick.write_text(json.dumps({"backend": "tpu", "quick": True,
+                                 "best": {"use_fused": True}}))
+    assert _load_tuned_variant(str(quick)) is None
+
+    cpu = tmp_path / "cpu.json"
+    cpu.write_text(json.dumps({"backend": "cpu", "quick": False,
+                               "best": {"use_fused": True}}))
+    assert _load_tuned_variant(str(cpu)) is None
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({
+        "backend": "tpu", "quick": False,
+        "best": {"use_fused": True, "batch_tile": 256,
+                 "batch_dtype": "bfloat16", "matmul_precision": None,
+                 "scan_chunk": 10, "acts_per_sec": 1e6, "mfu": 0.5}}))
+    variant = _load_tuned_variant(str(good))
+    # only step-config keys survive; None values and the default scan_chunk
+    # are dropped (keeps the variant dedupable against the built-ins)
+    assert variant == {"use_fused": True, "batch_tile": 256,
+                       "batch_dtype": "bfloat16"}
+
+    assert _load_tuned_variant(str(tmp_path / "missing.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert _load_tuned_variant(str(bad)) is None
+
+
+def test_explicit_fused_batch_tile(rng):
+    """fused_batch_tile forces the kernel tile, scoped to that Ensemble;
+    a tile that can't divide the batch falls back in auto mode (same
+    admission rule the kernel applies, so no mid-run ValueError)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparse_coding_tpu.ensemble import Ensemble
+    from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+    from sparse_coding_tpu.ops.fused_sae import tile_fits
+
+    assert tile_fits(512, 128, 64, 32)
+    assert not tile_fits(512, 100, 64, 32)  # 100 doesn't divide 512
+    assert not tile_fits(512, 512, 8192, 2048)  # too big for VMEM
+
+    members = [FunctionalTiedSAE.init(k, 32, 64, l1_alpha=1e-3)
+               for k in jax.random.split(rng, 2)]
+    forced = Ensemble(members, FunctionalTiedSAE, use_fused=True,
+                      fused_interpret=True, fused_batch_tile=64,
+                      donate=False)
+    auto = Ensemble(members, FunctionalTiedSAE, use_fused=True,
+                    fused_interpret=True, donate=False)
+    batch = jax.random.normal(rng, (512, 32))
+    a_f = forced.step_batch(batch)
+    a_a = auto.step_batch(batch)
+    assert forced.fused and auto.fused
+    np.testing.assert_allclose(np.asarray(a_f.losses["loss"]),
+                               np.asarray(a_a.losses["loss"]), rtol=1e-5)
+
+    # 96 can't be tiled by the forced 64: auto mode quietly falls back
+    fallback = Ensemble(members, FunctionalTiedSAE, use_fused="auto",
+                        fused_interpret=True, fused_batch_tile=64,
+                        donate=False)
+    fallback.step_batch(jnp.ones((96, 32)))
+    assert not fallback.fused

@@ -1,0 +1,129 @@
+"""Autotune harness for first TPU contact (developer tool).
+
+The moment the TPU tunnel is healthy, `python tune.py` scans the
+throughput-relevant knobs of the flagship ensemble train step at the
+canonical bench scale (bench.py / BASELINE.md) and records the winner:
+
+  stage 1 — step implementation (XLA autodiff vs fused Pallas kernel),
+    matmul precision (default vs explicit bfloat16), activation-stream
+    dtype (f32 vs bf16, halving the x HBM read), and for the fused kernel
+    every VMEM-fitting batch tile;
+  stage 2 — scan chunk (steps fused into one device program) for the
+    stage-1 winner.
+
+One JSON line per configuration goes to stdout as it finishes (stderr
+carries diagnostics), and the best configuration is written to TUNE.json —
+which bench.py picks up automatically, so the driver's end-of-round bench
+runs the tuned configuration without further plumbing.
+
+`--quick` shrinks shapes so the grid smoke-runs on CPU in ~a minute (used
+by the test suite) and defaults its output to TUNE.quick.json so a smoke
+run can never clobber a real TPU tuning record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import sys
+from pathlib import Path
+
+import jax
+
+from bench import _time_ensemble, chip_peak_flops, flops_per_activation
+
+TUNE_PATH = Path(__file__).parent / "TUNE.json"
+QUICK_TUNE_PATH = Path(__file__).parent / "TUNE.quick.json"
+
+SCAN_CHUNKS = (5, 10, 25, 50)
+
+
+def stage1_grid(on_tpu: bool, quick: bool) -> list[dict]:
+    """Implementation × precision × stream-dtype × (fused) batch tile.
+    Fused/tile/bf16-stream variants only make sense on TPU (the kernel is
+    gated to the TPU backend outside interpret mode)."""
+    configs: list[dict] = [
+        {"use_fused": False},
+        {"use_fused": False, "matmul_precision": "bfloat16"},
+    ]
+    if not on_tpu:
+        return configs
+    tiles = (None, 512, 256, 128, 64)
+    for tile, precision, batch_dtype in itertools.product(
+            tiles, (None, "bfloat16"), (None, "bfloat16")):
+        configs.append({"use_fused": True, "batch_tile": tile,
+                        "matmul_precision": precision,
+                        "batch_dtype": batch_dtype})
+    return configs
+
+
+def run_config(cfg: dict, quick: bool) -> float:
+    kwargs = {k: v for k, v in cfg.items() if v is not None}
+    if quick:
+        kwargs.update(d_act=64, n_dict=128, n_members=4, batch=256,
+                      bench_steps=10)
+        kwargs.setdefault("scan_chunk", 5)
+    return _time_ensemble(**kwargs)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="tiny shapes (CPU smoke of the grid logic); "
+                             "writes TUNE.quick.json unless --out is given")
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+    out_path = Path(args.out) if args.out else (
+        QUICK_TUNE_PATH if args.quick else TUNE_PATH)
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    if not on_tpu and not args.quick:
+        print(f"tune: backend is {backend!r}, not tpu — real tuning needs "
+              "the TPU; pass --quick for a CPU smoke run", file=sys.stderr)
+        sys.exit(1)
+
+    n_chips = len(jax.devices())
+    fpa = (flops_per_activation(n_members=4, n_dict=128, d_act=64)
+           if args.quick else flops_per_activation())
+    peak = chip_peak_flops()
+
+    def measure(cfg: dict) -> dict | None:
+        try:
+            rate = run_config(cfg, args.quick)
+        except Exception as e:
+            print(f"tune: config {cfg} failed: {e!r}", file=sys.stderr)
+            return None
+        rec = {**cfg, "acts_per_sec": round(rate, 1),
+               "mfu": (round(rate * fpa / peak / n_chips, 4)
+                       if peak else None)}
+        print(json.dumps(rec), flush=True)
+        return rec
+
+    results = [r for cfg in stage1_grid(on_tpu, args.quick)
+               if (r := measure(cfg)) is not None]
+    if not results:
+        print("tune: every stage-1 configuration failed", file=sys.stderr)
+        sys.exit(1)
+    best = max(results, key=lambda r: r["acts_per_sec"])
+
+    # stage 2: scan-chunk sweep for the winner (roughly independent of the
+    # stage-1 knobs, so sweeping it only here keeps the grid tractable)
+    base = {k: v for k, v in best.items() if k not in ("acts_per_sec", "mfu")}
+    scan_chunks = (5,) if args.quick else SCAN_CHUNKS
+    for scan_chunk in scan_chunks:
+        rec = measure({**base, "scan_chunk": scan_chunk})
+        if rec is not None:
+            results.append(rec)
+            if rec["acts_per_sec"] > best["acts_per_sec"]:
+                best = rec
+
+    out = {"backend": backend, "quick": args.quick, "best": best,
+           "results": sorted(results, key=lambda r: -r["acts_per_sec"])}
+    out_path.write_text(json.dumps(out, indent=2))
+    print(f"tune: best {best} -> {out_path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
