@@ -1,0 +1,91 @@
+"""Are learned dictionary features just token (un)embedding directions?
+
+TPU-native counterpart of the reference's hardcoded-path analysis
+`/root/reference/experiments/check_l0_tokens.py` (layer-0 residual SAE
+features vs Pythia's W_E / W_U rows): for each learned dict, the mean max
+cosine similarity of its features against the normalized embedding matrix
+and against the normalized unembedding columns. High embed-MCS at layer 0
+means the dictionary rediscovered the token basis rather than composed
+features.
+
+    python examples/embedding_direction_check.py \
+        --dict_path out/sweep/_9/dense_l1_range_learned_dicts.pkl \
+        [--model_name EleutherAI/pythia-70m-deduped] [--tiny]
+
+--tiny runs the identical analysis on a random tiny model + random-init
+tiny dicts (hermetic, no HF cache or training needed — it smokes the
+analysis chain, not dictionary quality), same convention as
+examples/pythia70m_frontier.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+
+def embedding_mcs(learned_dicts, embed: jnp.ndarray, unembed_t: jnp.ndarray):
+    """[(tag, embed_mcs, unembed_mcs)] per dict — mean over features of the
+    max cosine sim to any (un)embedding row (reference:
+    experiments/check_l0_tokens.py:36-40 mcs_to_fixed calls)."""
+    from sparse_coding_tpu.metrics.core import mcs_to_fixed
+    from sparse_coding_tpu.models.learned_dict import normalize_rows
+
+    embed, unembed_t = normalize_rows(embed), normalize_rows(unembed_t)
+    out = []
+    for tag, ld in learned_dicts:
+        out.append((tag, float(jnp.mean(mcs_to_fixed(ld, embed))),
+                    float(jnp.mean(mcs_to_fixed(ld, unembed_t)))))
+    return out
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dict_path", default=None,
+                        help=".pkl from a sweep (utils/artifacts.py format)")
+    parser.add_argument("--model_name",
+                        default="EleutherAI/pythia-70m-deduped")
+    parser.add_argument("--out", default="embedding_mcs.json")
+    parser.add_argument("--tiny", action="store_true")
+    args = parser.parse_args()
+
+    if args.tiny:
+        from sparse_coding_tpu.lm import gptneox
+        from sparse_coding_tpu.lm.model_config import tiny_test_config
+        from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+
+        cfg = tiny_test_config("gptneox")
+        params = gptneox.init_params(jax.random.PRNGKey(0), cfg)
+        keys = jax.random.split(jax.random.PRNGKey(1), 2)
+        dicts = []
+        for i, (k, l1) in enumerate(zip(keys, (1e-4, 1e-3))):
+            p, b = FunctionalTiedSAE.init(k, cfg.d_model, 2 * cfg.d_model,
+                                          l1_alpha=l1)
+            dicts.append((f"l1={l1:g}", FunctionalTiedSAE.to_learned_dict(p, b)))
+    else:
+        from sparse_coding_tpu.lm.convert import load_model
+        from sparse_coding_tpu.utils.artifacts import load_learned_dicts
+
+        if args.dict_path is None:
+            raise SystemExit("--dict_path is required without --tiny")
+        params, cfg = load_model(args.model_name)
+        dicts = [(json.dumps({k: v for k, v in hyper.items()
+                              if isinstance(v, (int, float, str))}), ld)
+                 for ld, hyper in load_learned_dicts(args.dict_path)]
+
+    # W_E rows and W_U columns both live in d_model space
+    rows = embedding_mcs(dicts, params["embed_in"], params["embed_out"])
+    for tag, e_mcs, u_mcs in rows:
+        print(f"{tag}: embed_mcs={e_mcs:.4f} unembed_mcs={u_mcs:.4f}")
+    Path(args.out).write_text(json.dumps(
+        [{"dict": t, "embed_mcs": e, "unembed_mcs": u}
+         for t, e, u in rows], indent=2))
+    print(f"-> {args.out}")
+
+
+if __name__ == "__main__":
+    main()

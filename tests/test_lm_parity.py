@@ -225,3 +225,26 @@ def test_frontier_chain_tiny(tmp_path):
     scores = json.loads((tmp_path / "frontier_scores.json").read_text())
     assert len(scores) == 3
     assert (tmp_path / "frontier.png").exists()
+
+
+def test_embedding_direction_check_tiny(tmp_path):
+    """The embedding-direction analysis (reference:
+    experiments/check_l0_tokens.py) runs hermetically at tiny scale."""
+    import json
+    import runpy
+    import sys
+
+    example = (Path(__file__).resolve().parent.parent / "examples"
+               / "embedding_direction_check.py")
+    out = tmp_path / "emb.json"
+    argv = sys.argv
+    sys.argv = [str(example), "--tiny", "--out", str(out)]
+    try:
+        runpy.run_path(str(example), run_name="__main__")
+    finally:
+        sys.argv = argv
+    rows = json.loads(out.read_text())
+    assert len(rows) == 2
+    for r in rows:
+        assert 0.0 <= r["embed_mcs"] <= 1.0
+        assert 0.0 <= r["unembed_mcs"] <= 1.0
