@@ -77,8 +77,16 @@ def main() -> None:
                               if isinstance(v, (int, float, str))}), ld)
                  for ld, hyper in load_learned_dicts(args.dict_path)]
 
-    # W_E rows and W_U columns both live in d_model space
-    rows = embedding_mcs(dicts, params["embed_in"], params["embed_out"])
+    # W_E rows and W_U columns both live in d_model space; gptneox names
+    # them embed_in/embed_out, gpt2 ties the unembed to wte
+    if "embed_in" in params:
+        w_e, w_u_t = params["embed_in"], params["embed_out"]
+    elif "wte" in params:
+        w_e = w_u_t = params["wte"]
+    else:
+        raise SystemExit(f"unrecognized param layout for {args.model_name}: "
+                         f"{sorted(params)[:5]}...")
+    rows = embedding_mcs(dicts, w_e, w_u_t)
     for tag, e_mcs, u_mcs in rows:
         print(f"{tag}: embed_mcs={e_mcs:.4f} unembed_mcs={u_mcs:.4f}")
     Path(args.out).write_text(json.dumps(

@@ -207,21 +207,28 @@ def test_real_pythia70m_logits_parity(monkeypatch):
     np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-3, rtol=2e-3)
 
 
+def _run_example(name: str, *argv: str) -> None:
+    """Drive examples/<name> as a CLI (__main__ semantics) with argv
+    swapped in and restored."""
+    import runpy
+    import sys
+
+    example = Path(__file__).resolve().parent.parent / "examples" / name
+    saved = sys.argv
+    sys.argv = [str(example), *argv]
+    try:
+        runpy.run_path(str(example), run_name="__main__")
+    finally:
+        sys.argv = saved
+
+
 def test_frontier_chain_tiny(tmp_path):
     """The canonical frontier experiment's full chain (harvest -> sweep ->
     scores -> plot) runs hermetically at tiny scale
     (examples/pythia70m_frontier.py --tiny)."""
     import json
-    import runpy
-    import sys
 
-    example = Path(__file__).resolve().parent.parent / "examples" / "pythia70m_frontier.py"
-    argv = sys.argv
-    sys.argv = [str(example), "--tiny", "--out", str(tmp_path)]
-    try:
-        runpy.run_path(str(example), run_name="__main__")
-    finally:
-        sys.argv = argv
+    _run_example("pythia70m_frontier.py", "--tiny", "--out", str(tmp_path))
     scores = json.loads((tmp_path / "frontier_scores.json").read_text())
     assert len(scores) == 3
     assert (tmp_path / "frontier.png").exists()
@@ -231,18 +238,9 @@ def test_embedding_direction_check_tiny(tmp_path):
     """The embedding-direction analysis (reference:
     experiments/check_l0_tokens.py) runs hermetically at tiny scale."""
     import json
-    import runpy
-    import sys
 
-    example = (Path(__file__).resolve().parent.parent / "examples"
-               / "embedding_direction_check.py")
     out = tmp_path / "emb.json"
-    argv = sys.argv
-    sys.argv = [str(example), "--tiny", "--out", str(out)]
-    try:
-        runpy.run_path(str(example), run_name="__main__")
-    finally:
-        sys.argv = argv
+    _run_example("embedding_direction_check.py", "--tiny", "--out", str(out))
     rows = json.loads(out.read_text())
     assert len(rows) == 2
     for r in rows:
