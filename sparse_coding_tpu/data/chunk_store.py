@@ -195,31 +195,43 @@ class ChunkStore:
             raw = raw.view(jnp.bfloat16)
         return raw.astype(dtype)
 
-    def epoch(self, batch_size: int, rng: np.random.Generator,
-              n_repetitions: int = 1, dtype=np.float32) -> Iterator[np.ndarray]:
-        """Stream batches over all chunks, chunk order shuffled per repetition
-        (reference: big_sweep.py:349-357). The NEXT chunk's file streams from
-        disk on native background threads while the current chunk trains
-        (native/chunkio.cpp; silently sequential without it)."""
+    def chunk_reader(self, indices, dtype=np.float32) -> Iterator[np.ndarray]:
+        """Yield in-RAM chunks for the given index sequence with disk
+        readahead: the NEXT chunk's file streams from disk on native
+        background threads while the caller trains on the current one
+        (native/chunkio.cpp; silently sequential without it). Holds at most
+        two chunks in host RAM (current + in-flight)."""
         from sparse_coding_tpu.data.native_io import NativePrefetcher
 
-        order = np.concatenate([rng.permutation(self.n_chunks)
-                                for _ in range(n_repetitions)])
+        indices = [int(i) for i in indices]
         prefetcher = NativePrefetcher()
         try:
-            prefetching = prefetcher.start(self.chunk_paths[int(order[0])])
-            for pos, ci in enumerate(order):
-                path = self.chunk_paths[int(ci)]
+            prefetching = (prefetcher.start(self.chunk_paths[indices[0]])
+                           if indices else False)
+            for pos, ci in enumerate(indices):
                 raw = prefetcher.wait() if prefetching else None
-                chunk = (self._finish_raw(raw, dtype, path) if raw is not None
-                         else self.load_chunk(int(ci), dtype))
-                if pos + 1 < len(order):
+                chunk = (self._finish_raw(raw, dtype, self.chunk_paths[ci])
+                         if raw is not None else self.load_chunk(ci, dtype))
+                # _finish_raw copied: drop the on-disk dtype buffer before
+                # the yield (keeps the documented two-chunk RAM bound)
+                raw = None
+                if pos + 1 < len(indices):
                     prefetching = prefetcher.start(
-                        self.chunk_paths[int(order[pos + 1])])
-                yield from self.batches(chunk, batch_size, rng)
+                        self.chunk_paths[indices[pos + 1]])
+                yield chunk
         finally:
             # early generator exit must not leak the in-flight native read
             prefetcher.cancel()
+
+    def epoch(self, batch_size: int, rng: np.random.Generator,
+              n_repetitions: int = 1, dtype=np.float32) -> Iterator[np.ndarray]:
+        """Stream batches over all chunks, chunk order shuffled per repetition
+        (reference: big_sweep.py:349-357), with chunk_reader's disk
+        readahead."""
+        order = np.concatenate([rng.permutation(self.n_chunks)
+                                for _ in range(n_repetitions)])
+        for chunk in self.chunk_reader(order, dtype):
+            yield from self.batches(chunk, batch_size, rng)
 
 
 def shuffled_batches(chunk: np.ndarray, batch_size: int,

@@ -87,8 +87,10 @@ def _iter_slabs(activations, batch_size: int):
 
     if isinstance(activations, ChunkStore):
         left = None
-        for i in range(activations.n_chunks):
-            slab = jnp.asarray(activations.load_chunk(i))
+        # chunk_reader streams the NEXT chunk from disk while the current
+        # one is being encoded on device
+        for chunk in activations.chunk_reader(range(activations.n_chunks)):
+            slab = jnp.asarray(chunk)
             if left is not None and left.shape[0]:
                 slab = jnp.concatenate([left, slab], axis=0)
             n = (slab.shape[0] // batch_size) * batch_size

@@ -224,14 +224,17 @@ def sweep(
     # its disk writes overlap the next chunk's training
     pending_staging: Optional[Path] = None
 
+    # remaining chunks stream through chunk_reader: the next chunk's disk
+    # read overlaps the current chunk's training (native/chunkio.cpp
+    # background threads; sequential without the lib)
+    todo = list(range(chunks_done, len(chunk_order)))
+    reader = store.chunk_reader([int(chunk_order[ci]) for ci in todo],
+                                dtype=train_np_dtype)
     try:
-        for ci, chunk_idx in enumerate(chunk_order):
-            if ci < chunks_done:
-                continue
+        for ci, chunk in zip(todo, reader):
             # fresh throughput window per chunk: checkpoint/artifact wall
             # time between chunks must not dilute the training-rate signal
             timer.reset()
-            chunk = store.load_chunk(int(chunk_idx), dtype=train_np_dtype)
             if center is not None:
                 # cast the mean down rather than the chunk up: keeps the
                 # bf16 path bf16 end to end (host RAM + host→device traffic
@@ -328,6 +331,7 @@ def sweep(
         clean_exit = False
         raise
     finally:
+        reader.close()  # release any in-flight native chunk read
         if orbax_ckptr is not None:
             # a FULLY-ISSUED async set is waited on and swapped in even on
             # a crash (it reflects completed training) — but cross-host

@@ -47,6 +47,29 @@ def test_chunk_writer_row_alignment(tmp_path):
     assert w.rows_per_chunk == 64  # 100 rounded down to batch multiple
 
 
+def test_chunk_reader_matches_load(tmp_path):
+    """chunk_reader (disk-readahead path used by sweep/epoch) yields exactly
+    what load_chunk returns, in the requested order/dtype, and an early
+    generator close releases any in-flight native read without error."""
+    w = ChunkWriter(tmp_path, 8, chunk_size_gb=8 * 64 * 2 / 2**30,
+                    dtype="float16")
+    w.add(np.random.default_rng(1).normal(size=(256, 8)).astype(np.float32))
+    w.finalize()
+    store = ChunkStore(tmp_path)
+    order = [3, 0, 2, 1]
+    for dtype in (np.float32, jnp.bfloat16):
+        got = list(store.chunk_reader(order, dtype=dtype))
+        for ci, chunk in zip(order, got):
+            assert chunk.dtype == dtype
+            np.testing.assert_array_equal(
+                np.asarray(chunk, np.float32),
+                np.asarray(store.load_chunk(ci, dtype=dtype), np.float32))
+    reader = store.chunk_reader([0, 1, 2, 3])
+    next(reader)
+    reader.close()  # in-flight prefetch of chunk 1 must be cancelled cleanly
+    assert list(store.chunk_reader([])) == []
+
+
 def test_store_epoch_batches(tmp_path):
     w = ChunkWriter(tmp_path, 8, chunk_size_gb=8 * 128 * 2 / 2**30, dtype="float16")
     w.add(np.arange(256 * 8, dtype=np.float32).reshape(256, 8))

@@ -275,19 +275,22 @@ def test_sweep_crash_resume_bitwise(tmp_path, monkeypatch, backend):
     full = sweep_mod.sweep(build, _sweep_cfg(tmp_path, "full"), log_every=50)
 
     crash_cfg = _sweep_cfg(tmp_path, "crashed", checkpoint_backend=backend)
-    real_load = ChunkStore.load_chunk
+    # _finish_raw is the single dtype gate BOTH chunk paths (native prefetch
+    # and numpy fallback) go through, so the simulated crash fires no matter
+    # which read path served the chunk
+    real_finish = ChunkStore._finish_raw
     calls = {"n": 0}
 
-    def flaky_load(self, i, dtype=np.float32):
+    def flaky_finish(self, raw, dtype, path):
         calls["n"] += 1
         if calls["n"] == 3:  # third training chunk never arrives
             raise RuntimeError("simulated crash")
-        return real_load(self, i, dtype)
+        return real_finish(self, raw, dtype, path)
 
-    monkeypatch.setattr(ChunkStore, "load_chunk", flaky_load)
+    monkeypatch.setattr(ChunkStore, "_finish_raw", flaky_finish)
     with pytest.raises(RuntimeError, match="simulated crash"):
         sweep_mod.sweep(build, crash_cfg, log_every=50)
-    monkeypatch.setattr(ChunkStore, "load_chunk", real_load)
+    monkeypatch.setattr(ChunkStore, "_finish_raw", real_finish)
     assert (tmp_path / "crashed" / "ckpt").exists()
     assert not (tmp_path / "crashed" / "ckpt_staging").exists()
 
