@@ -68,20 +68,28 @@ def bench_big_sae(quick: bool) -> None:
 
     d, n_feats, batch = (512, 4096, 4096) if quick else (1024, 16384, 16384)
     n_iters = 3 if quick else 15
-    state, optimizer, l1 = init_big_sae(jax.random.PRNGKey(0), d, n_feats,
-                                        l1_alpha=1e-3, n_worst=1024)
-    step = make_big_sae_step(optimizer, l1)
     batch_data = jax.random.normal(jax.random.PRNGKey(1), (batch, d))
 
-    holder = {"state": state}
+    variants = [("autodiff", False)]
+    if jax.default_backend() == "tpu":
+        variants.append(("fused", True))  # flash-style kernel pair
+    for name, fused in variants:
+        try:
+            state, optimizer, l1 = init_big_sae(
+                jax.random.PRNGKey(0), d, n_feats, l1_alpha=1e-3,
+                n_worst=1024)
+            step = make_big_sae_step(optimizer, l1, use_fused=fused)
+            holder = {"state": state}
 
-    def one():
-        holder["state"], metrics = step(holder["state"], batch_data)
-        return metrics["loss"]
+            def one():
+                holder["state"], metrics = step(holder["state"], batch_data)
+                return metrics["loss"]
 
-    rate = _timed(one, n_iters, batch)
-    _emit("big_sae_train", rate, "activations/s", d=d, n_feats=n_feats,
-          batch=batch)
+            rate = _timed(one, n_iters, batch)
+            _emit("big_sae_train", rate, "activations/s", variant=name, d=d,
+                  n_feats=n_feats, batch=batch)
+        except Exception as e:
+            print(f"big_sae variant {name} failed: {e!r}", file=sys.stderr)
 
 
 def bench_harvest(quick: bool) -> None:

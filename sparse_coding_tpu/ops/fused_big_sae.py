@@ -1,0 +1,303 @@
+"""Flash-style fused train-step kernels for the GIANT single SAE (Pallas/TPU).
+
+The big-SAE step (train/big_sae.py::_sae_loss; reference:
+experiments/huge_batch_size.py:50-98) is HBM-bound under XLA autodiff for
+exactly one reason: the [batch, n_feats] code matrix. At the reference's DDP
+scale (batch 16384, n_feats 16384) that is a ~1 GB array which XLA
+materializes in the forward, reads back for the ReLU mask in the backward,
+plus the same again for the L1 subgradient — several full HBM round trips
+per step. These kernels never materialize it:
+
+- **forward kernel**: grid (batch_tiles, feat_tiles); each program computes
+  its code tile in VMEM and accumulates `x̂[batch_tile] += c_tile @ Wn_tile`.
+  Only x̂ [B, d] ever reaches HBM.
+- **backward kernel**: grid (feat_tiles, batch_tiles); each program
+  RECOMPUTES its code tile (the flash-attention trade: ~2·B·n·d extra MXU
+  flops to skip ~4 HBM round trips of B·n·4 bytes) and accumulates all
+  parameter grads + the training metrics:
+      pre = xc Eₜ + tₜ,  c = relu(pre)
+      dc  = (2/(B·d))·r Wnₜᵀ + α/B          (L1: c ≥ 0 so ∂|c| = mask)
+      dpre = dc ⊙ [pre > 0]
+      dEₜ  += xcᵀ dpre        dWnₜ += (2/(B·d))·cᵀ r
+      dtₜ  += Σ_b dpre        dctr_enc += −Σ_b dpre Eₜᵀ
+      c_totalsₜ += Σ_b c      l1 += Σ c      l0 += Σ mask
+  Grid order matters on TPU: an output block must be revisited on
+  CONSECUTIVE grid steps to accumulate in VMEM, so per-feature outputs live
+  in the (feat, batch)-ordered backward grid and the per-batch x̂ lives in
+  the (batch, feat)-ordered forward grid.
+
+Everything cheap or shape-small stays outside in XLA: centering subtract,
+r = x̂ (+ctr if tied) − x, per-example MSEs (worst-example tracking), the
+dict-normalization VJP chain (ops/fused_sae.normalize_with_vjp), and the
+tied decode-centering gradient Σ (2/(B·d))·r.
+
+Gradient semantics match jax.grad of train/big_sae.py::_sae_loss exactly
+(locked by tests/test_fused_big_sae.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu.ops.fused_sae import VMEM_BUDGET_BYTES, normalize_with_vjp
+
+Array = jax.Array
+
+
+def _bwd_working_set(bt: int, ft: int, d: int) -> int:
+    f32 = 4
+    return (
+        d * ft * f32 * 2      # E tile + dE accumulator
+        + ft * d * f32 * 2    # Wn tile + dWn accumulator
+        + bt * d * f32 * 3    # xc, r, dpre@Eᵀ
+        + bt * ft * f32 * 3   # pre/c, r@Wnᵀ/dpre, mask
+        + ft * f32 * 3        # t, dt, c_totals
+        + d * f32             # dctr
+    )
+
+
+def _fwd_working_set(bt: int, ft: int, d: int) -> int:
+    f32 = 4
+    return (
+        d * ft * f32          # E tile
+        + ft * d * f32        # Wn tile
+        + bt * d * f32 * 2    # xc tile + x̂ accumulator
+        + bt * ft * f32 * 2   # pre/c
+        + ft * f32            # t
+    )
+
+
+def pick_big_sae_tiles(batch: int, n_feats: int, d: int
+                       ) -> Optional[tuple[int, int]]:
+    """Largest (batch_tile, feat_tile) whose BACKWARD working set (the
+    bigger of the two kernels) fits the VMEM budget and which divide the
+    problem; None if nothing fits (caller uses the autodiff path).
+    Lane-dim sanity: d and the feat tile should be multiples of 128 for
+    clean Mosaic tiling — non-multiples fall back."""
+    if d % 128 != 0:
+        return None
+    for bt in (512, 256, 128, 64):
+        if batch % bt:
+            continue
+        for ft in (1024, 512, 256, 128):
+            if n_feats % ft:
+                continue
+            if (_bwd_working_set(bt, ft, d) <= VMEM_BUDGET_BYTES
+                    and _fwd_working_set(bt, ft, d) <= VMEM_BUDGET_BYTES):
+                return bt, ft
+    return None
+
+
+def _fwd_kernel(xc_ref, e_ref, w_ref, t_ref, xhat_ref):
+    import jax.experimental.pallas as pl
+
+    ft = pl.program_id(1)
+    xc = xc_ref[...]                      # [Bt, d]
+    pre = (jnp.dot(xc, e_ref[...], preferred_element_type=jnp.float32)
+           + t_ref[0][None, :])           # [Bt, Ft]
+    c = jnp.maximum(pre, 0.0)
+    part = jnp.dot(c, w_ref[...], preferred_element_type=jnp.float32)
+
+    @pl.when(ft == 0)
+    def _init():
+        xhat_ref[...] = part
+
+    @pl.when(ft > 0)
+    def _acc():
+        xhat_ref[...] += part
+
+
+def _bwd_kernel(alpha_ref, xc_ref, r_ref, e_ref, w_ref, t_ref,
+                de_ref, dw_ref, dt_ref, dctr_ref, act_ref, scal_ref,
+                *, total_batch: int, d_act: int):
+    import jax.experimental.pallas as pl
+
+    bt_idx = pl.program_id(1)
+    xc = xc_ref[...]          # [Bt, d]
+    r = r_ref[...]            # [Bt, d]
+    e = e_ref[...]            # [d, Ft]
+    w = w_ref[...]            # [Ft, d]
+    alpha = alpha_ref[0]
+
+    pre = (jnp.dot(xc, e, preferred_element_type=jnp.float32)
+           + t_ref[0][None, :])
+    c = jnp.maximum(pre, 0.0)
+    mask = (pre > 0.0).astype(jnp.float32)
+    coef = 2.0 / (total_batch * d_act)
+    dc = (coef * jnp.dot(r, w.T, preferred_element_type=jnp.float32)
+          + alpha / total_batch)
+    dpre = dc * mask
+    de = jnp.dot(xc.T, dpre, preferred_element_type=jnp.float32)
+    dw = coef * jnp.dot(c.T, r, preferred_element_type=jnp.float32)
+    dt = jnp.sum(dpre, axis=0)
+    dctr = -jnp.sum(jnp.dot(dpre, e.T, preferred_element_type=jnp.float32),
+                    axis=0)
+    activity = jnp.sum(c, axis=0)
+    scal = jnp.stack([jnp.sum(c), jnp.sum(mask)])[None, :]  # l1, l0 sums
+
+    @pl.when(bt_idx == 0)
+    def _init():
+        de_ref[...] = de
+        dw_ref[...] = dw
+        dt_ref[0] = dt
+        act_ref[0] = activity
+
+    @pl.when(bt_idx > 0)
+    def _acc():
+        de_ref[...] += de
+        dw_ref[...] += dw
+        dt_ref[0] += dt
+        act_ref[0] += activity
+
+    first = jnp.logical_and(bt_idx == 0, pl.program_id(0) == 0)
+
+    @pl.when(first)
+    def _init_global():
+        dctr_ref[0] = dctr
+        scal_ref[...] = scal
+
+    @pl.when(jnp.logical_not(first))
+    def _acc_global():
+        dctr_ref[0] += dctr
+        scal_ref[...] += scal
+
+
+@functools.partial(jax.jit, static_argnames=("batch_tile", "feat_tile",
+                                             "interpret"))
+def big_sae_forward(params: dict, xc: Array, batch_tile: int, feat_tile: int,
+                    interpret: bool = False) -> Array:
+    """x̂ = relu(xc E + t) @ Wn without materializing the codes. `params`
+    holds raw big-SAE params (dict/encoder/threshold); xc is pre-centered."""
+    import jax.experimental.pallas as pl
+
+    b, d = xc.shape
+    n = params["dict"].shape[0]
+    wn = params["dict"] / jnp.linalg.norm(params["dict"], axis=-1,
+                                          keepdims=True)
+    return pl.pallas_call(
+        _fwd_kernel,
+        grid=(b // batch_tile, n // feat_tile),
+        in_specs=[
+            pl.BlockSpec((batch_tile, d), lambda bt, ft: (bt, 0)),   # xc
+            pl.BlockSpec((d, feat_tile), lambda bt, ft: (0, ft)),    # E
+            pl.BlockSpec((feat_tile, d), lambda bt, ft: (ft, 0)),    # Wn
+            pl.BlockSpec((1, feat_tile), lambda bt, ft: (0, ft)),    # t
+        ],
+        out_specs=pl.BlockSpec((batch_tile, d), lambda bt, ft: (bt, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, d), jnp.float32),
+        interpret=interpret,
+    )(xc, params["encoder"], wn, params["threshold"].reshape(1, n))
+
+
+@functools.partial(jax.jit, static_argnames=("batch_tile", "feat_tile",
+                                             "interpret", "total_batch"))
+def big_sae_backward(params: dict, alpha: Array, xc: Array, r: Array,
+                     batch_tile: int, feat_tile: int,
+                     interpret: bool = False,
+                     total_batch: Optional[int] = None):
+    """All parameter grads (wrt raw E/t/normalized Wn/encode-side ctr) plus
+    c_totals and the l1/l0 sums, one pass, codes recomputed per tile.
+    total_batch: global batch for loss normalization (≠ local under
+    shard_map, same convention as ops/fused_sae.fused_tied_sae_grads)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, d = xc.shape
+    n = params["dict"].shape[0]
+    if total_batch is None:
+        total_batch = b
+    wn = params["dict"] / jnp.linalg.norm(params["dict"], axis=-1,
+                                          keepdims=True)
+    kernel = functools.partial(_bwd_kernel, total_batch=total_batch,
+                               d_act=d)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // feat_tile, b // batch_tile),
+        in_specs=[
+            pl.BlockSpec((batch_tile, d), lambda ft, bt, *_: (bt, 0)),  # xc
+            pl.BlockSpec((batch_tile, d), lambda ft, bt, *_: (bt, 0)),  # r
+            pl.BlockSpec((d, feat_tile), lambda ft, bt, *_: (0, ft)),   # E
+            pl.BlockSpec((feat_tile, d), lambda ft, bt, *_: (ft, 0)),   # Wn
+            pl.BlockSpec((1, feat_tile), lambda ft, bt, *_: (0, ft)),   # t
+        ],
+        out_specs=[
+            pl.BlockSpec((d, feat_tile), lambda ft, bt, *_: (0, ft)),   # dE
+            pl.BlockSpec((feat_tile, d), lambda ft, bt, *_: (ft, 0)),   # dWn
+            pl.BlockSpec((1, feat_tile), lambda ft, bt, *_: (0, ft)),   # dt
+            pl.BlockSpec((1, d), lambda ft, bt, *_: (0, 0)),            # dctr
+            pl.BlockSpec((1, feat_tile), lambda ft, bt, *_: (0, ft)),   # act
+            pl.BlockSpec((1, 2), lambda ft, bt, *_: (0, 0)),            # l1/l0
+        ],
+    )
+    de, dwn, dt, dctr_enc, c_totals, scal = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((d, n), jnp.float32),
+            jax.ShapeDtypeStruct((n, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, 2), jnp.float32),
+        ],
+        interpret=interpret,
+    )(alpha.reshape(1), xc, r, params["encoder"], wn,
+      params["threshold"].reshape(1, n))
+    return (de, dwn, dt.reshape(n), dctr_enc.reshape(d),
+            c_totals.reshape(n), scal.reshape(2))
+
+
+def fused_big_sae_loss_and_grads(params: dict, batch: Array, l1_alpha: Array,
+                                 tied: bool,
+                                 batch_tile: Optional[int] = None,
+                                 feat_tile: Optional[int] = None,
+                                 interpret: bool = False,
+                                 total_batch: Optional[int] = None):
+    """Drop-in replacement for value_and_grad(_sae_loss) in the big-SAE step
+    (train/big_sae.py): returns (loss, aux, grads) where aux is the dict
+    {"mse", "sparsity", "c_totals_delta", "mse_losses", "l0_mean"} and
+    grads is wrt the RAW param tree {dict, encoder, threshold, centering}."""
+    b, d = batch.shape
+    n = params["dict"].shape[0]
+    if batch_tile is None or feat_tile is None:
+        tiles = pick_big_sae_tiles(b, n, d)
+        if tiles is None:
+            raise ValueError(
+                f"no VMEM-fitting (batch, feature) tiles for batch={b} "
+                f"n_feats={n} d={d}; use the autodiff path")
+        batch_tile, feat_tile = tiles
+    if total_batch is None:
+        total_batch = b
+
+    batch = batch.astype(jnp.float32)
+    xc = batch - params["centering"]
+    x_hat = big_sae_forward(params, xc, batch_tile, feat_tile,
+                            interpret=interpret)
+    if tied:
+        x_hat = x_hat + params["centering"]
+    resid = x_hat - batch  # r in the kernel math
+    mse_losses = jnp.mean(jnp.square(resid), axis=-1)  # per example
+    mse = jnp.sum(jnp.square(resid)) / (total_batch * d)
+
+    de, dwn, dt, dctr_enc, c_totals, scal = big_sae_backward(
+        params, jnp.asarray(l1_alpha, jnp.float32), xc, resid,
+        batch_tile, feat_tile, interpret=interpret, total_batch=total_batch)
+    l1_sum, l0_sum = scal[0], scal[1]
+    sparsity = jnp.asarray(l1_alpha, jnp.float32) * l1_sum / total_batch
+    loss = mse + sparsity
+
+    coef = 2.0 / (total_batch * d)
+    dctr = dctr_enc + (coef * jnp.sum(resid, axis=0) if tied else 0.0)
+    grads = {
+        "dict": normalize_with_vjp(params["dict"], dwn),
+        "encoder": de,
+        "threshold": dt,
+        "centering": dctr,
+    }
+    aux = {"mse": mse, "sparsity": sparsity, "c_totals_delta": c_totals,
+           "mse_losses": mse_losses, "l0_mean": l0_sum / total_batch}
+    return loss, aux, grads

@@ -91,10 +91,24 @@ def _sae_loss(params: dict, batch: Array, l1_alpha: Array, tied: bool):
 
 
 def make_big_sae_step(optimizer: optax.GradientTransformation,
-                      l1_alpha: Array, mesh: Optional[Mesh] = None):
+                      l1_alpha: Array, mesh: Optional[Mesh] = None,
+                      use_fused: str | bool = "auto",
+                      fused_interpret: bool = False):
     """Jitted (state, batch) -> (state, metrics). With a mesh, the batch is
     data-sharded; grads reduce via XLA collectives (replacing DDP all-reduce,
-    huge_batch_size.py:274,322)."""
+    huge_batch_size.py:274,322).
+
+    use_fused: "auto" routes single-chip TPU steps through the flash-style
+    kernel pair (ops/fused_big_sae.py — codes recomputed per tile, never
+    materialized in HBM) whenever VMEM-fitting tiles exist for the shapes;
+    True fails fast if they don't; False always uses XLA autodiff. The mesh
+    path stays on autodiff (pallas_call doesn't auto-partition)."""
+    from sparse_coding_tpu.ops.fused_big_sae import (
+        fused_big_sae_loss_and_grads,
+        pick_big_sae_tiles,
+    )
+
+    fused_wanted = use_fused is True or use_fused == "auto"
 
     def step(state: BigSAEState, batch: Array):
         if mesh is not None:
@@ -102,17 +116,42 @@ def make_big_sae_step(optimizer: optax.GradientTransformation,
             # device_put it — grads then reduce over "data" as documented
             batch = jax.lax.with_sharding_constraint(
                 batch, NamedSharding(mesh, P("data")))
-        (loss, (mse, sparsity, c, mse_losses)), grads = jax.value_and_grad(
-            _sae_loss, has_aux=True)(state.params, batch, l1_alpha, state.tied)
+        n, d = state.params["dict"].shape
+        # shapes are static at trace time, so the path choice re-resolves
+        # per compiled batch shape, like ensemble._resolve_step
+        fused_ok = (fused_wanted and mesh is None
+                    and (fused_interpret or jax.default_backend() == "tpu")
+                    and pick_big_sae_tiles(batch.shape[0], n, d) is not None)
+        if use_fused is True and not fused_ok:
+            raise ValueError(
+                f"use_fused=True but the fused big-SAE step is unavailable "
+                f"(mesh={mesh is not None}, backend={jax.default_backend()}, "
+                f"batch={batch.shape[0]}, n={n}, d={d} — d must be a "
+                "multiple of 128 with VMEM-fitting tiles)")
+        if fused_ok:
+            loss, aux, grads = fused_big_sae_loss_and_grads(
+                state.params, batch, l1_alpha, state.tied,
+                interpret=fused_interpret)
+            mse, sparsity = aux["mse"], aux["sparsity"]
+            mse_losses = aux["mse_losses"]
+            c_totals_delta = aux["c_totals_delta"]
+            l0 = aux["l0_mean"]
+        else:
+            (loss, (mse, sparsity, c, mse_losses)), grads = jax.value_and_grad(
+                _sae_loss, has_aux=True)(state.params, batch, l1_alpha,
+                                         state.tied)
+            c_totals_delta = jnp.sum(c, axis=0)
+            l0 = jnp.mean(jnp.sum(c > 0, axis=-1).astype(jnp.float32))
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
 
         # dead-feature tracking (reference: c_totals += c.sum(0), :206;
         # WorstIndices.update streaming top-k, :120-146 — here one fused
         # top_k over the merged buffer)
-        c_totals = state.c_totals + jnp.sum(c, axis=0)
+        c_totals = state.c_totals + c_totals_delta
         all_losses = jnp.concatenate([state.worst_losses, mse_losses])
-        all_vectors = jnp.concatenate([state.worst_vectors, batch])
+        all_vectors = jnp.concatenate([state.worst_vectors,
+                                       batch.astype(state.worst_vectors.dtype)])
         top_losses, top_idx = jax.lax.top_k(all_losses, state.worst_losses.shape[0])
         worst_vectors = all_vectors[top_idx]
 
@@ -121,7 +160,7 @@ def make_big_sae_step(optimizer: optax.GradientTransformation,
                                   worst_vectors=worst_vectors,
                                   step=state.step + 1)
         metrics = {"loss": loss, "mse": mse, "sparsity": sparsity,
-                   "l0": jnp.mean(jnp.sum(c > 0, axis=-1).astype(jnp.float32)),
+                   "l0": l0,
                    "center_norm": jnp.linalg.norm(params["centering"])}
         return new_state, metrics
 
