@@ -19,6 +19,7 @@ jitted functions, so they run on device with no host sync.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import flax.struct as struct
@@ -98,11 +99,12 @@ def make_big_sae_step(optimizer: optax.GradientTransformation,
     data-sharded; grads reduce via XLA collectives (replacing DDP all-reduce,
     huge_batch_size.py:274,322).
 
-    use_fused: "auto" routes single-chip TPU steps through the flash-style
-    kernel pair (ops/fused_big_sae.py — codes recomputed per tile, never
-    materialized in HBM) whenever VMEM-fitting tiles exist for the shapes;
-    True fails fast if they don't; False always uses XLA autodiff. The mesh
-    path stays on autodiff (pallas_call doesn't auto-partition)."""
+    use_fused: "auto" routes TPU steps through the flash-style kernel pair
+    (ops/fused_big_sae.py — codes recomputed per tile, never materialized
+    in HBM) whenever VMEM-fitting tiles exist for the PER-DEVICE shapes;
+    True fails fast if they don't; False always uses XLA autodiff. With a
+    mesh the kernels run per shard under shard_map (features over "model",
+    batch over "data" — _sharded_fused_loss_and_grads)."""
     from sparse_coding_tpu.ops.fused_big_sae import (
         fused_big_sae_loss_and_grads,
         pick_big_sae_tiles,
@@ -117,21 +119,35 @@ def make_big_sae_step(optimizer: optax.GradientTransformation,
             batch = jax.lax.with_sharding_constraint(
                 batch, NamedSharding(mesh, P("data")))
         n, d = state.params["dict"].shape
+        # the fused kernels see PER-DEVICE shapes under shard_map: features
+        # sharded over "model", batch over "data" — which also requires the
+        # global shapes to divide the mesh axes (GSPMD pads for autodiff,
+        # shard_map does not)
+        divisible = (mesh is None
+                     or (batch.shape[0] % mesh.shape["data"] == 0
+                         and n % mesh.shape["model"] == 0))
+        local_b = (batch.shape[0] // mesh.shape["data"] if mesh is not None
+                   else batch.shape[0])
+        local_n = n // mesh.shape["model"] if mesh is not None else n
         # shapes are static at trace time, so the path choice re-resolves
         # per compiled batch shape, like ensemble._resolve_step
-        fused_ok = (fused_wanted and mesh is None
+        fused_ok = (fused_wanted and divisible
                     and (fused_interpret or jax.default_backend() == "tpu")
-                    and pick_big_sae_tiles(batch.shape[0], n, d) is not None)
+                    and pick_big_sae_tiles(local_b, local_n, d) is not None)
         if use_fused is True and not fused_ok:
             raise ValueError(
                 f"use_fused=True but the fused big-SAE step is unavailable "
-                f"(mesh={mesh is not None}, backend={jax.default_backend()}, "
-                f"batch={batch.shape[0]}, n={n}, d={d} — d must be a "
-                "multiple of 128 with VMEM-fitting tiles)")
+                f"(backend={jax.default_backend()}, per-device "
+                f"batch={local_b}, n={local_n}, d={d} — shapes must divide "
+                "the mesh axes and d must be a multiple of 128 with "
+                "VMEM-fitting tiles)")
         if fused_ok:
-            loss, aux, grads = fused_big_sae_loss_and_grads(
-                state.params, batch, l1_alpha, state.tied,
-                interpret=fused_interpret)
+            fused_fn = (functools.partial(_sharded_fused_loss_and_grads,
+                                          mesh=mesh)
+                        if mesh is not None else fused_big_sae_loss_and_grads)
+            loss, aux, grads = fused_fn(state.params, batch, l1_alpha,
+                                        state.tied,
+                                        interpret=fused_interpret)
             mse, sparsity = aux["mse"], aux["sparsity"]
             mse_losses = aux["mse_losses"]
             c_totals_delta = aux["c_totals_delta"]
@@ -212,6 +228,78 @@ def resurrect_dead_features(state: BigSAEState) -> tuple[BigSAEState, Array]:
         worst_losses=jnp.full_like(state.worst_losses, -jnp.inf),
         worst_vectors=jnp.zeros_like(state.worst_vectors))
     return new_state, n_dead
+
+
+def _sharded_fused_loss_and_grads(params: dict, batch: Array, l1_alpha,
+                                  tied: bool, mesh: Mesh,
+                                  interpret: bool = False):
+    """Mesh-composed fused big-SAE loss/grads: under shard_map each device
+    owns n/mesh_model FEATURES (tensor parallel — dict rows, encoder
+    columns, thresholds) and B/mesh_data batch rows. Per-shard flash
+    kernels compute partial x̂ (psum over "model" completes the decode sum),
+    then per-shard backward; grads reduce over "data" only (feature-sharded
+    leaves stay local to their shard), the centering grad and scalar
+    metrics over both axes. Same global-batch normalization convention as
+    ensemble.make_fused_tied_step_sharded."""
+    from jax import shard_map
+
+    from sparse_coding_tpu.ops.fused_big_sae import (
+        big_sae_backward,
+        big_sae_forward,
+        pick_big_sae_tiles,
+    )
+    from sparse_coding_tpu.ops.fused_sae import normalize_with_vjp
+
+    total_b = batch.shape[0]
+    n, d = params["dict"].shape
+    tiles = pick_big_sae_tiles(total_b // mesh.shape["data"],
+                               n // mesh.shape["model"], d)
+    if tiles is None:
+        raise ValueError(
+            f"no VMEM-fitting (batch, feature) tiles for per-device "
+            f"batch={total_b // mesh.shape['data']} "
+            f"n_feats={n // mesh.shape['model']} d={d}; use the autodiff "
+            "path")
+    bt, ft = tiles
+
+    def local_fn(p, alpha, local_batch):
+        local_batch = local_batch.astype(jnp.float32)
+        xc = local_batch - p["centering"]
+        partial = big_sae_forward(p, xc, bt, ft, interpret=interpret)
+        x_hat = jax.lax.psum(partial, "model")  # decode sums over features
+        if tied:
+            x_hat = x_hat + p["centering"]
+        r = x_hat - local_batch  # replicated over "model"
+        mse_losses = jnp.mean(jnp.square(r), axis=-1)
+        mse = jax.lax.psum(jnp.sum(jnp.square(r)), "data") / (total_b * d)
+        de, dwn, dt, dctr_enc, c_totals, scal = big_sae_backward(
+            p, alpha, xc, r, bt, ft, interpret=interpret,
+            total_batch=total_b)
+        de, dwn, dt, c_totals = jax.lax.psum((de, dwn, dt, c_totals), "data")
+        scal = jax.lax.psum(scal, ("model", "data"))
+        dctr = jax.lax.psum(dctr_enc, ("model", "data"))
+        if tied:
+            coef = 2.0 / (total_b * d)
+            dctr = dctr + jax.lax.psum(coef * jnp.sum(r, axis=0), "data")
+        l1_sum, l0_sum = scal[0], scal[1]
+        sparsity = alpha * l1_sum / total_b
+        grads = {"dict": normalize_with_vjp(p["dict"], dwn),
+                 "encoder": de, "threshold": dt, "centering": dctr}
+        aux = {"mse": mse, "sparsity": sparsity,
+               "c_totals_delta": c_totals, "mse_losses": mse_losses,
+               "l0_mean": l0_sum / total_b}
+        return mse + sparsity, aux, grads
+
+    param_specs = {"dict": P("model", None), "encoder": P(None, "model"),
+                   "threshold": P("model"), "centering": P()}
+    aux_specs = {"mse": P(), "sparsity": P(), "c_totals_delta": P("model"),
+                 "mse_losses": P("data"), "l0_mean": P()}
+    grad_specs = dict(param_specs)
+    fn = shard_map(local_fn, mesh=mesh,
+                   in_specs=(param_specs, P(), P("data")),
+                   out_specs=(P(), aux_specs, grad_specs),
+                   check_vma=False)
+    return fn(params, jnp.asarray(l1_alpha, jnp.float32), batch)
 
 
 def shard_big_sae(state: BigSAEState, mesh: Mesh) -> BigSAEState:

@@ -64,6 +64,29 @@ def test_big_sae_step_lowers(rng):
     _lower_tpu(step, state, batch)
 
 
+def test_sharded_fused_big_sae_step_lowers(rng):
+    """AOT TPU lowering of the mesh-composed fused big-SAE path: shard_map
+    + BOTH flash kernels (real Mosaic lowering, not interpret) + psums in
+    one program. Calls _sharded_fused_loss_and_grads directly — the step's
+    auto gate would route a CPU host to autodiff."""
+    from sparse_coding_tpu.parallel.mesh import make_mesh
+    from sparse_coding_tpu.train.big_sae import (
+        _sharded_fused_loss_and_grads,
+        init_big_sae,
+        shard_big_sae,
+    )
+
+    mesh = make_mesh(2, 4)
+    state, optimizer, l1 = init_big_sae(rng, 128, 256, l1_alpha=1e-3,
+                                        n_worst=32)
+    state = shard_big_sae(state, mesh)
+    batch = jnp.zeros((512, 128))  # per-device (128, 128-feat) tiles exist
+    for tied in (False, True):
+        fn = jax.jit(lambda p, b, t=tied: _sharded_fused_loss_and_grads(
+            p, b, l1, t, mesh))
+        fn.trace(state.params, batch).lower(lowering_platforms=("tpu",))
+
+
 def test_lm_forward_lowers(rng):
     from sparse_coding_tpu.lm import gpt2, gptneox
     from sparse_coding_tpu.lm.model_config import tiny_test_config
