@@ -78,10 +78,12 @@ SCAN_CHUNK = 10  # steps fused into one device program (amortizes dispatch)
 def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
                    n_members=None, batch=None, bench_steps=None,
                    scan_chunk=None, batch_dtype=None,
-                   batch_tile=None) -> float:
+                   batch_tile=None, fused_compute_dtype=None) -> float:
     """Shared ensemble-throughput measurement (bench_suite.py and tune.py
     reuse it with their own scales; batch_tile forces the fused kernel's
-    batch tile, None = auto-pick)."""
+    batch tile, None = auto-pick; fused_compute_dtype="bfloat16" runs the
+    kernel's dots on the MXU bf16 path — matmul_precision does not reach
+    Pallas dots)."""
     import contextlib
 
     from sparse_coding_tpu.ensemble import Ensemble
@@ -102,7 +104,8 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
         members = [FunctionalTiedSAE.init(k, d_act, n_dict, l1_alpha=float(l1))
                    for k, l1 in zip(keys, l1s)]
         ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=use_fused,
-                       fused_batch_tile=batch_tile)
+                       fused_batch_tile=batch_tile,
+                       fused_compute_dtype=fused_compute_dtype or "float32")
 
         batches = jax.random.normal(jax.random.PRNGKey(1),
                                     (scan_chunk, batch, d_act))
@@ -207,7 +210,7 @@ def _load_tuned_variant(path: str | None = None) -> dict | None:
         return None
     best = data.get("best") or {}
     keys = ("use_fused", "matmul_precision", "batch_dtype", "scan_chunk",
-            "batch_tile")
+            "batch_tile", "fused_compute_dtype")
     variant = {k: v for k, v in best.items() if k in keys and v is not None}
     if variant.get("scan_chunk") == SCAN_CHUNK:
         del variant["scan_chunk"]  # default — keep the variant dedupable
@@ -247,8 +250,8 @@ def main() -> None:
         # bench over an optional optimization (diagnostics go to stderr)
         variants = [{"use_fused": True},
                     {"use_fused": False, "matmul_precision": "bfloat16"},
-                    {"use_fused": True, "matmul_precision": "bfloat16"},
-                    {"use_fused": True, "matmul_precision": "bfloat16",
+                    {"use_fused": True, "fused_compute_dtype": "bfloat16"},
+                    {"use_fused": True, "fused_compute_dtype": "bfloat16",
                      "batch_dtype": "bfloat16"}]
         tuned = _load_tuned_variant()
         if tuned is not None and tuned not in variants:

@@ -46,17 +46,23 @@ def bench_ensemble(quick: bool) -> None:
 
     d, ratio, n_members, batch = (256, 2, 8, 512) if quick else (512, 4, 32, 2048)
     steps, scan = (15, 5) if quick else (200, 10)
-    variants = [("autodiff", False, None)]
+    # (matmul_precision governs only the autodiff path; Pallas kernel dots
+    # take the bf16 MXU path via fused_compute_dtype instead)
+    variants = [("autodiff", dict(use_fused=False))]
     if jax.default_backend() == "tpu":
-        variants += [("fused", True, None),
-                     ("autodiff_bf16", False, "bfloat16"),
-                     ("fused_bf16", True, "bfloat16")]
-    for name, fused, precision in variants:
+        variants += [
+            ("fused", dict(use_fused=True)),
+            ("autodiff_bf16", dict(use_fused=False,
+                                   matmul_precision="bfloat16")),
+            ("fused_bf16", dict(use_fused=True,
+                                fused_compute_dtype="bfloat16")),
+        ]
+    for name, kwargs in variants:
         try:
-            rate = _time_ensemble(use_fused=fused, matmul_precision=precision,
-                                  d_act=d, n_dict=d * ratio,
+            rate = _time_ensemble(d_act=d, n_dict=d * ratio,
                                   n_members=n_members, batch=batch,
-                                  bench_steps=steps, scan_chunk=scan)
+                                  bench_steps=steps, scan_chunk=scan,
+                                  **kwargs)
             _emit("ensemble_train", rate, "activations/s", variant=name,
                   n_members=n_members, d=d, n_dict=d * ratio, batch=batch)
         except Exception as e:

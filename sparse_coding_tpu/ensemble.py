@@ -117,6 +117,7 @@ def make_fused_tied_step(
     donate: bool = True,
     interpret: bool = False,
     batch_tile: Optional[int] = None,
+    compute_dtype: str = "float32",
 ) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
     """Fused-kernel step for identity-centered FunctionalTiedSAE buckets:
     loss + exact grads come from one Pallas pass (ops/fused_sae.py) instead of
@@ -129,7 +130,7 @@ def make_fused_tied_step(
             {"encoder": state.params["encoder"],
              "encoder_bias": state.params["encoder_bias"]},
             state.buffers["l1_alpha"], batch, batch_tile=batch_tile,
-            interpret=interpret)
+            interpret=interpret, compute_dtype=compute_dtype)
         params, opt_state, aux = _apply_fused_updates(
             optimizer, losses, grads, activity,
             state.params, state.opt_state, state.lrs)
@@ -146,6 +147,7 @@ def make_fused_tied_step_sharded(
     donate: bool = True,
     interpret: bool = False,
     batch_tile: Optional[int] = None,
+    compute_dtype: str = "float32",
 ) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
     """Mesh-composed fused step: the flagship multi-chip configuration
     (replacing /root/reference/cluster_runs.py:100-157's all-GPUs-training
@@ -164,7 +166,8 @@ def make_fused_tied_step_sharded(
             {"encoder": params["encoder"],
              "encoder_bias": params["encoder_bias"]},
             buffers["l1_alpha"], local_batch, batch_tile=batch_tile,
-            interpret=interpret, total_batch=total_batch)
+            interpret=interpret, total_batch=total_batch,
+            compute_dtype=compute_dtype)
         losses, grads, activity = jax.lax.psum((losses, grads, activity),
                                                "data")
         return _apply_fused_updates(optimizer, losses, grads, activity,
@@ -264,6 +267,7 @@ class Ensemble:
         use_fused: str | bool = "auto",
         fused_interpret: bool = False,
         fused_batch_tile: Optional[int] = None,
+        fused_compute_dtype: str = "float32",
     ):
         if not members:
             raise ValueError("ensemble needs at least one member")
@@ -315,17 +319,21 @@ class Ensemble:
                 make_fused_tied_step_sharded(self.optimizer, mesh,
                                              donate=donate,
                                              interpret=fused_interpret,
-                                             batch_tile=fused_batch_tile)
+                                             batch_tile=fused_batch_tile,
+                                             compute_dtype=fused_compute_dtype)
                 if mesh is not None else
                 make_fused_tied_step(self.optimizer, donate=donate,
                                      interpret=fused_interpret,
-                                     batch_tile=fused_batch_tile))
+                                     batch_tile=fused_batch_tile,
+                                     compute_dtype=fused_compute_dtype))
         # the fused kernel additionally needs a VMEM-fitting batch tile — only
         # known once the real batch arrives, so the final choice happens on
         # the first step_batch call (and is re-checked per batch size)
         self.fused = self._fused_step is not None
         self._fused_explicit = use_fused is True
         self._fused_batch_tile = fused_batch_tile
+        self._fused_compute_itemsize = (
+            2 if fused_compute_dtype == "bfloat16" else 4)
         self._step_fn = self._standard_step
         self._scan_fn = None
         self._resolved_batch: Optional[tuple[int, int]] = None
@@ -357,11 +365,13 @@ class Ensemble:
         prev_fn = self._step_fn
         # an explicit fused_batch_tile must itself pass admission (divide
         # the local batch, fit VMEM) — same rule the kernel will apply
+        ci = self._fused_compute_itemsize
         workable = (tile_fits(local, self._fused_batch_tile, n_feats, d,
-                              batch_itemsize)
+                              batch_itemsize, compute_itemsize=ci)
                     if self._fused_batch_tile is not None else
                     pick_batch_tile(local, n_feats, d,
-                                    batch_itemsize=batch_itemsize) is not None)
+                                    batch_itemsize=batch_itemsize,
+                                    compute_itemsize=ci) is not None)
         if workable:
             self._step_fn = self._fused_step
             self.fused = True

@@ -42,41 +42,55 @@ PREFERRED_TILES: tuple = (512, 256, 128, 64)
 
 
 def _working_set(batch_tile: int, n_feats: int, d: int,
-                 batch_itemsize: int = 4) -> int:
+                 batch_itemsize: int = 4, compute_itemsize: int = 4) -> int:
     f32 = 4
     # a sub-f32 x tile is cast up INSIDE the kernel, so its f32 copy
     # coexists with the half-width input tile in VMEM: bf16 saves HBM
     # traffic, not VMEM (14 B/elem peak vs 12 for f32)
     cast_copy = f32 if batch_itemsize < f32 else 0
+    extra = 0
+    if compute_itemsize < f32:
+        # compute_dtype=bf16 materializes bf16 copies of the dot operands:
+        # w, rc, the c/dpre casts, and xc (free when the input tile already
+        # IS the compute dtype — the kernel reuses it directly)
+        extra = (n_feats * d * compute_itemsize            # w cast
+                 + batch_tile * d * compute_itemsize       # rc
+                 + batch_tile * n_feats * compute_itemsize * 2  # c, dpre
+                 + (0 if batch_itemsize == compute_itemsize
+                    else batch_tile * d * compute_itemsize))    # xc
     return (
         n_feats * d * f32 * 2      # W + dW accumulator
         + batch_tile * n_feats * f32 * 2  # c and r@Wᵀ
         + batch_tile * d * (batch_itemsize + cast_copy + 2 * f32)  # x, x̂, r
         + n_feats * f32 * 2        # b, db
+        + extra
     )
 
 
 def pick_batch_tile(batch: int, n_feats: int, d: int,
-                    batch_itemsize: int = 4) -> Optional[int]:
+                    batch_itemsize: int = 4,
+                    compute_itemsize: int = 4) -> Optional[int]:
     """Largest batch tile (≥64) that fits the VMEM budget and divides the
     batch; None if even 64 doesn't fit. `batch_itemsize` is the on-HBM width
-    of the activation stream (2 for bf16); the in-VMEM f32 cast copy is
-    accounted for, so bf16 tiles are never larger than f32 ones."""
+    of the activation stream (2 for bf16); `compute_itemsize` the in-kernel
+    dot-operand width (2 for compute_dtype=bfloat16). All in-VMEM cast
+    copies are accounted for, so an admitted tile always fits."""
     for tile in PREFERRED_TILES:
         if batch % tile == 0 and _working_set(
-                tile, n_feats, d, batch_itemsize) <= VMEM_BUDGET_BYTES:
+                tile, n_feats, d, batch_itemsize,
+                compute_itemsize) <= VMEM_BUDGET_BYTES:
             return tile
     return None
 
 
 def tile_fits(batch: int, tile: int, n_feats: int, d: int,
-              batch_itemsize: int = 4) -> bool:
+              batch_itemsize: int = 4, compute_itemsize: int = 4) -> bool:
     """Would this EXPLICIT batch tile work for these shapes? (divides the
     batch and fits the VMEM budget — the admission rule pick_batch_tile
     applies to its candidates, exposed for callers forcing a tile.)"""
     return (batch % tile == 0
-            and _working_set(tile, n_feats, d, batch_itemsize)
-            <= VMEM_BUDGET_BYTES)
+            and _working_set(tile, n_feats, d, batch_itemsize,
+                             compute_itemsize) <= VMEM_BUDGET_BYTES)
 
 
 def fused_supported(n_members: int, batch: int, n_feats: int, d: int) -> bool:
@@ -93,29 +107,40 @@ def kernel_batch_itemsize(dtype) -> int:
 
 
 def _kernel(alpha_ref, x_ref, w_ref, b_ref, dw_ref, db_ref, act_ref, loss_ref,
-            *, total_batch: int, d_act: int):
+            *, total_batch: int, d_act: int, compute_dtype):
     import jax.experimental.pallas as pl
 
     m = pl.program_id(0)
     i = pl.program_id(1)
-    w = w_ref[0]  # [n, d]
+    # compute_dtype=bf16 runs every dot on the MXU's native bf16 path
+    # (~2x f32 throughput) with f32 accumulation — the in-kernel analogue
+    # of jax.default_matmul_precision("bfloat16"), which does NOT reach
+    # Pallas dots. Elementwise math and accumulators stay f32.
+    w = w_ref[0].astype(compute_dtype)  # [n, d]
     # a bf16 activation stream rides HBM→VMEM half-width and is cast up
     # HERE (exact, f32 ⊃ bf16): the f32 copy never exists outside VMEM
-    xb = x_ref[...].astype(jnp.float32)  # [Bt, d]
+    x_in = x_ref[...]  # [Bt, d]
+    xb = x_in.astype(jnp.float32)
+    # bf16 stream + bf16 compute reuses the input tile as the dot operand
+    xc = x_in if x_in.dtype == compute_dtype else xb.astype(compute_dtype)
     b = b_ref[0, 0]  # [n]  (operand carried as [N, 1, n] for Mosaic tiling)
     alpha = alpha_ref[m]  # scalar-prefetched [N] array in SMEM
 
-    pre = jnp.dot(xb, w.T, preferred_element_type=jnp.float32) + b[None, :]
+    pre = jnp.dot(xc, w.T, preferred_element_type=jnp.float32) + b[None, :]
     c = jnp.maximum(pre, 0.0)
-    x_hat = jnp.dot(c, w, preferred_element_type=jnp.float32)
+    x_hat = jnp.dot(c.astype(compute_dtype), w,
+                    preferred_element_type=jnp.float32)
     r = x_hat - xb
 
     coef = 2.0 / (total_batch * d_act)
     mask = (pre > 0.0).astype(jnp.float32)
-    dpre = (coef * jnp.dot(r, w.T, preferred_element_type=jnp.float32)
+    rc = r.astype(compute_dtype)
+    dpre = (coef * jnp.dot(rc, w.T, preferred_element_type=jnp.float32)
             + alpha / total_batch) * mask
-    dw = (jnp.dot(dpre.T, xb, preferred_element_type=jnp.float32)
-          + coef * jnp.dot(c.T, r, preferred_element_type=jnp.float32))
+    dw = (jnp.dot(dpre.astype(compute_dtype).T, xc,
+                  preferred_element_type=jnp.float32)
+          + coef * jnp.dot(c.astype(compute_dtype).T, rc,
+                           preferred_element_type=jnp.float32))
     db = jnp.sum(dpre, axis=0)
     activity = jnp.sum(mask, axis=0)  # [n] samples activating each feature
     mse_part = jnp.sum(r * r) / (total_batch * d_act)
@@ -139,11 +164,13 @@ def _kernel(alpha_ref, x_ref, w_ref, b_ref, dw_ref, db_ref, act_ref, loss_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("batch_tile", "interpret", "total_batch"))
+                   static_argnames=("batch_tile", "interpret", "total_batch",
+                                    "compute_dtype"))
 def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
                          batch: Array, batch_tile: int = 256,
                          interpret: bool = False,
-                         total_batch: Optional[int] = None):
+                         total_batch: Optional[int] = None,
+                         compute_dtype: str = "float32"):
     """All-member losses and gradients wrt (normalized W, bias).
 
     Args:
@@ -154,6 +181,9 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
         actually passed. A shard_map caller hands each device its LOCAL batch
         slice but the GLOBAL size here, so per-device partial sums psum to
         the exact full-batch loss/grads (see ensemble.make_fused_tied_step_sharded).
+      compute_dtype: "float32" (exact) or "bfloat16" — dot operands cast to
+        bf16 in VMEM for the MXU's native fast path, f32 accumulation (the
+        in-kernel analogue of jax.default_matmul_precision("bfloat16")).
     Returns:
       (losses {mse [N], l1 [N], l0 [N]}, dW [N, n, d], db [N, n],
        activity [N, n] per-feature active-sample counts)
@@ -168,7 +198,8 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
     n_tiles = local_batch // batch_tile
     assert n_tiles * batch_tile == local_batch
 
-    kernel = functools.partial(_kernel, total_batch=total_batch, d_act=d)
+    kernel = functools.partial(_kernel, total_batch=total_batch, d_act=d,
+                               compute_dtype=jnp.dtype(compute_dtype))
 
     # alphas ride scalar prefetch (SMEM, whole [N] array) — ordinary SMEM
     # blocks can't tile a [N, 1] array per-member (Mosaic requires the
@@ -233,11 +264,13 @@ def normalize_with_vjp(e: Array, dw: Array, eps: float = 1e-8):
 def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
                                   batch: Array, batch_tile: Optional[int] = None,
                                   interpret: bool = False,
-                                  total_batch: Optional[int] = None):
+                                  total_batch: Optional[int] = None,
+                                  compute_dtype: str = "float32"):
     """Drop-in producer of (aux-style losses, grads wrt raw stacked params)
     for the ensemble engine's fused path. params_stacked:
     {"encoder": [N, n, d], "encoder_bias": [N, n]}. total_batch: see
-    fused_tied_sae_grads (global batch size when called on a shard)."""
+    fused_tied_sae_grads (global batch size when called on a shard);
+    compute_dtype: bf16 runs the dots on the MXU's native fast path."""
     e = params_stacked["encoder"]
     # bf16 batches enter the kernel AS bf16 (cast up per-tile in VMEM):
     # the x HBM read is half-width and no device-wide f32 copy of the batch
@@ -246,8 +279,10 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
     if batch.dtype != jnp.bfloat16:
         batch = batch.astype(jnp.float32)
     if batch_tile is None:
-        batch_tile = pick_batch_tile(batch.shape[0], e.shape[1], e.shape[2],
-                                     batch_itemsize=batch.dtype.itemsize)
+        batch_tile = pick_batch_tile(
+            batch.shape[0], e.shape[1], e.shape[2],
+            batch_itemsize=batch.dtype.itemsize,
+            compute_itemsize=jnp.dtype(compute_dtype).itemsize)
         if batch_tile is None:
             raise ValueError(
                 f"no VMEM-fitting batch tile for shapes n={e.shape[1]} "
@@ -256,7 +291,8 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
     w_normed = e / norms
     losses, dw, db, activity = fused_tied_sae_grads(
         w_normed, params_stacked["encoder_bias"], alphas, batch,
-        batch_tile=batch_tile, interpret=interpret, total_batch=total_batch)
+        batch_tile=batch_tile, interpret=interpret, total_batch=total_batch,
+        compute_dtype=compute_dtype)
     grads = {"encoder": normalize_with_vjp(e, dw),
              "encoder_bias": db}
     return losses, grads, activity

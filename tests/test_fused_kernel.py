@@ -179,6 +179,30 @@ def test_fused_bf16_batch_matches_f32_cast(rng):
     np.testing.assert_array_equal(np.asarray(act_h), np.asarray(act_f))
 
 
+def test_fused_bf16_compute_dtype_close(rng):
+    """compute_dtype=bfloat16 (MXU-native dots, f32 accumulation) tracks the
+    f32 kernel within bf16 mantissa tolerance — same contract as
+    jax.default_matmul_precision("bfloat16") on the autodiff path."""
+    k_init, k_data = jax.random.split(rng)
+    _, params, alphas = _stacked_members(k_init)
+    batch = jax.random.normal(k_data, (BATCH, D))
+
+    losses_f, grads_f, _ = fused_tied_sae_loss_and_grads(
+        params, alphas, batch, batch_tile=128, interpret=True)
+    losses_h, grads_h, _ = fused_tied_sae_loss_and_grads(
+        params, alphas, batch, batch_tile=128, interpret=True,
+        compute_dtype="bfloat16")
+    total_f = losses_f["mse"] + losses_f["l1"]
+    total_h = losses_h["mse"] + losses_h["l1"]
+    np.testing.assert_allclose(np.asarray(total_h), np.asarray(total_f),
+                               rtol=2e-2)
+    for name in grads_f:
+        np.testing.assert_allclose(np.asarray(grads_h[name]),
+                                   np.asarray(grads_f[name]),
+                                   rtol=0.1, atol=2e-3,
+                                   err_msg=f"bf16-compute grad drift: {name}")
+
+
 def test_fused_bf16_tile_accounting():
     """bf16 saves HBM traffic, NOT VMEM: the kernel casts the half-width x
     tile up in VMEM, so its f32 copy coexists with the input tile
@@ -194,6 +218,16 @@ def test_fused_bf16_tile_accounting():
         f32_tile = pick_batch_tile(2048, n_feats, 512) or 0
         bf16_tile = pick_batch_tile(2048, n_feats, 512, batch_itemsize=2) or 0
         assert bf16_tile <= f32_tile
+    # compute_dtype=bf16 adds operand cast copies (w, rc, c/dpre, xc)...
+    assert (_working_set(128, 2048, 512, compute_itemsize=2)
+            > _working_set(128, 2048, 512, compute_itemsize=4))
+    # ...except xc, which is free when the stream already IS the compute
+    # dtype (the kernel reuses the input tile as the dot operand): the
+    # saved xc copy exactly offsets the bf16 stream's extra f32 upcast, so
+    # bf16-stream + bf16-compute costs no more VMEM than f32-stream +
+    # bf16-compute
+    assert (_working_set(128, 2048, 512, 2, 2)
+            == _working_set(128, 2048, 512, 4, 2))
 
 
 def test_fused_supported_budget():
@@ -212,10 +246,11 @@ def test_kernel_lowers_for_tpu():
     shapes = [((2, 64, 32), (2, 64), (2,), (256, 32)),
               ((32, 2048, 512), (32, 2048), (32,), (2048, 512))]
     for x_dtype in (jnp.float32, jnp.bfloat16):
-        for ws, bs, as_, xs in shapes:
-            w, b, a = (jnp.zeros(s) for s in (ws, bs, as_))
-            x = jnp.zeros(xs, x_dtype)
-            jax.jit(
-                lambda w, b, a, x: fused_tied_sae_grads(w, b, a, x,
-                                                        batch_tile=64)
-            ).trace(w, b, a, x).lower(lowering_platforms=("tpu",))
+        for compute in ("float32", "bfloat16"):
+            for ws, bs, as_, xs in shapes:
+                w, b, a = (jnp.zeros(s) for s in (ws, bs, as_))
+                x = jnp.zeros(xs, x_dtype)
+                jax.jit(
+                    lambda w, b, a, x, cd=compute: fused_tied_sae_grads(
+                        w, b, a, x, batch_tile=64, compute_dtype=cd)
+                ).trace(w, b, a, x).lower(lowering_platforms=("tpu",))

@@ -4,10 +4,12 @@ The moment the TPU tunnel is healthy, `python tune.py` scans the
 throughput-relevant knobs of the flagship ensemble train step at the
 canonical bench scale (bench.py / BASELINE.md) and records the winner:
 
-  stage 1 — step implementation (XLA autodiff vs fused Pallas kernel),
-    matmul precision (default vs explicit bfloat16), activation-stream
-    dtype (f32 vs bf16, halving the x HBM read), and for the fused kernel
-    every VMEM-fitting batch tile;
+  stage 1 — step implementation (XLA autodiff vs fused Pallas kernel);
+    for autodiff the matmul precision (default vs bfloat16); for the fused
+    kernel the activation-stream dtype (f32 vs bf16, halving the x HBM
+    read), the in-kernel MXU compute dtype (f32 vs bf16 — Pallas dots
+    ignore jax.default_matmul_precision), and every VMEM-fitting batch
+    tile;
   stage 2 — scan chunk (steps fused into one device program) for the
     stage-1 winner.
 
@@ -49,11 +51,13 @@ def stage1_grid(on_tpu: bool, quick: bool) -> list[dict]:
     ]
     if not on_tpu:
         return configs
+    # matmul_precision doesn't reach Pallas dots; the fused knobs are the
+    # batch tile, the HBM stream dtype, and the in-kernel MXU compute dtype
     tiles = (None, 512, 256, 128, 64)
-    for tile, precision, batch_dtype in itertools.product(
+    for tile, compute, batch_dtype in itertools.product(
             tiles, (None, "bfloat16"), (None, "bfloat16")):
         configs.append({"use_fused": True, "batch_tile": tile,
-                        "matmul_precision": precision,
+                        "fused_compute_dtype": compute,
                         "batch_dtype": batch_dtype})
     return configs
 
