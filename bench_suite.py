@@ -76,15 +76,17 @@ def bench_big_sae(quick: bool) -> None:
     n_iters = 3 if quick else 15
     batch_data = jax.random.normal(jax.random.PRNGKey(1), (batch, d))
 
-    variants = [("autodiff", False)]
+    variants = [("autodiff", dict(use_fused=False))]
     if jax.default_backend() == "tpu":
-        variants.append(("fused", True))  # flash-style kernel pair
-    for name, fused in variants:
+        variants += [("fused", dict(use_fused=True)),
+                     ("fused_bf16", dict(use_fused=True,
+                                         fused_compute_dtype="bfloat16"))]
+    for name, kwargs in variants:
         try:
             state, optimizer, l1 = init_big_sae(
                 jax.random.PRNGKey(0), d, n_feats, l1_alpha=1e-3,
                 n_worst=1024)
-            step = make_big_sae_step(optimizer, l1, use_fused=fused)
+            step = make_big_sae_step(optimizer, l1, **kwargs)
             holder = {"state": state}
 
             def one():

@@ -332,8 +332,9 @@ class Ensemble:
         self.fused = self._fused_step is not None
         self._fused_explicit = use_fused is True
         self._fused_batch_tile = fused_batch_tile
-        self._fused_compute_itemsize = (
-            2 if fused_compute_dtype == "bfloat16" else 4)
+        # same derivation fused_tied_sae_loss_and_grads uses for its own
+        # tile pick, so resolution and kernel admission can never disagree
+        self._fused_compute_itemsize = jnp.dtype(fused_compute_dtype).itemsize
         self._step_fn = self._standard_step
         self._scan_fn = None
         self._resolved_batch: Optional[tuple[int, int]] = None

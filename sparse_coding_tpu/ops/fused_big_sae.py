@@ -48,8 +48,13 @@ from sparse_coding_tpu.ops.fused_sae import VMEM_BUDGET_BYTES, normalize_with_vj
 Array = jax.Array
 
 
-def _bwd_working_set(bt: int, ft: int, d: int) -> int:
+def _bwd_working_set(bt: int, ft: int, d: int,
+                     compute_itemsize: int = 4) -> int:
     f32 = 4
+    # compute_dtype=bf16 materializes bf16 copies of the dot operands:
+    # xc, rc, E, Wn, the c cast, and dprec
+    extra = (0 if compute_itemsize >= f32 else
+             (bt * d * 2 + d * ft + ft * d + bt * ft * 2) * compute_itemsize)
     return (
         d * ft * f32 * 2      # E tile + dE accumulator
         + ft * d * f32 * 2    # Wn tile + dWn accumulator
@@ -57,27 +62,35 @@ def _bwd_working_set(bt: int, ft: int, d: int) -> int:
         + bt * ft * f32 * 3   # pre/c, r@Wnᵀ/dpre, mask
         + ft * f32 * 3        # t, dt, c_totals
         + d * f32             # dctr
+        + extra
     )
 
 
-def _fwd_working_set(bt: int, ft: int, d: int) -> int:
+def _fwd_working_set(bt: int, ft: int, d: int,
+                     compute_itemsize: int = 4) -> int:
     f32 = 4
+    extra = (0 if compute_itemsize >= f32 else
+             (bt * d + d * ft + ft * d + bt * ft) * compute_itemsize)
     return (
         d * ft * f32          # E tile
         + ft * d * f32        # Wn tile
         + bt * d * f32 * 2    # xc tile + x̂ accumulator
         + bt * ft * f32 * 2   # pre/c
         + ft * f32            # t
+        + extra
     )
 
 
-def pick_big_sae_tiles(batch: int, n_feats: int, d: int
+def pick_big_sae_tiles(batch: int, n_feats: int, d: int,
+                       compute_itemsize: int = 4
                        ) -> Optional[tuple[int, int]]:
     """Largest (batch_tile, feat_tile) whose BACKWARD working set (the
     bigger of the two kernels) fits the VMEM budget and which divide the
     problem; None if nothing fits (caller uses the autodiff path).
-    Lane-dim sanity: d and the feat tile should be multiples of 128 for
-    clean Mosaic tiling — non-multiples fall back."""
+    `compute_itemsize` is 2 for compute_dtype=bfloat16 (in-VMEM operand
+    cast copies are counted). Lane-dim sanity: d and the feat tile should
+    be multiples of 128 for clean Mosaic tiling — non-multiples fall
+    back."""
     if d % 128 != 0:
         return None
     for bt in (512, 256, 128, 64):
@@ -86,21 +99,27 @@ def pick_big_sae_tiles(batch: int, n_feats: int, d: int
         for ft in (1024, 512, 256, 128):
             if n_feats % ft:
                 continue
-            if (_bwd_working_set(bt, ft, d) <= VMEM_BUDGET_BYTES
-                    and _fwd_working_set(bt, ft, d) <= VMEM_BUDGET_BYTES):
+            if (_bwd_working_set(bt, ft, d, compute_itemsize)
+                    <= VMEM_BUDGET_BYTES
+                    and _fwd_working_set(bt, ft, d, compute_itemsize)
+                    <= VMEM_BUDGET_BYTES):
                 return bt, ft
     return None
 
 
-def _fwd_kernel(xc_ref, e_ref, w_ref, t_ref, xhat_ref):
+def _fwd_kernel(xc_ref, e_ref, w_ref, t_ref, xhat_ref, *, compute_dtype):
     import jax.experimental.pallas as pl
 
     ft = pl.program_id(1)
-    xc = xc_ref[...]                      # [Bt, d]
-    pre = (jnp.dot(xc, e_ref[...], preferred_element_type=jnp.float32)
+    # compute_dtype=bf16: dot operands cast to bf16 in VMEM for the MXU's
+    # native fast path, f32 accumulation (same contract as fused_sae._kernel)
+    xc = xc_ref[...].astype(compute_dtype)  # [Bt, d]
+    pre = (jnp.dot(xc, e_ref[...].astype(compute_dtype),
+                   preferred_element_type=jnp.float32)
            + t_ref[0][None, :])           # [Bt, Ft]
     c = jnp.maximum(pre, 0.0)
-    part = jnp.dot(c, w_ref[...], preferred_element_type=jnp.float32)
+    part = jnp.dot(c.astype(compute_dtype), w_ref[...].astype(compute_dtype),
+                   preferred_element_type=jnp.float32)
 
     @pl.when(ft == 0)
     def _init():
@@ -113,14 +132,15 @@ def _fwd_kernel(xc_ref, e_ref, w_ref, t_ref, xhat_ref):
 
 def _bwd_kernel(alpha_ref, xc_ref, r_ref, e_ref, w_ref, t_ref,
                 de_ref, dw_ref, dt_ref, dctr_ref, act_ref, scal_ref,
-                *, total_batch: int, d_act: int):
+                *, total_batch: int, d_act: int, compute_dtype):
     import jax.experimental.pallas as pl
 
     bt_idx = pl.program_id(1)
-    xc = xc_ref[...]          # [Bt, d]
-    r = r_ref[...]            # [Bt, d]
-    e = e_ref[...]            # [d, Ft]
-    w = w_ref[...]            # [Ft, d]
+    xc = xc_ref[...].astype(compute_dtype)   # [Bt, d]
+    r = r_ref[...]                           # [Bt, d] (f32: metrics source)
+    rc = r.astype(compute_dtype)
+    e = e_ref[...].astype(compute_dtype)     # [d, Ft]
+    w = w_ref[...].astype(compute_dtype)     # [Ft, d]
     alpha = alpha_ref[0]
 
     pre = (jnp.dot(xc, e, preferred_element_type=jnp.float32)
@@ -128,13 +148,15 @@ def _bwd_kernel(alpha_ref, xc_ref, r_ref, e_ref, w_ref, t_ref,
     c = jnp.maximum(pre, 0.0)
     mask = (pre > 0.0).astype(jnp.float32)
     coef = 2.0 / (total_batch * d_act)
-    dc = (coef * jnp.dot(r, w.T, preferred_element_type=jnp.float32)
+    dc = (coef * jnp.dot(rc, w.T, preferred_element_type=jnp.float32)
           + alpha / total_batch)
     dpre = dc * mask
-    de = jnp.dot(xc.T, dpre, preferred_element_type=jnp.float32)
-    dw = coef * jnp.dot(c.T, r, preferred_element_type=jnp.float32)
+    dprec = dpre.astype(compute_dtype)
+    de = jnp.dot(xc.T, dprec, preferred_element_type=jnp.float32)
+    dw = coef * jnp.dot(c.astype(compute_dtype).T, rc,
+                        preferred_element_type=jnp.float32)
     dt = jnp.sum(dpre, axis=0)
-    dctr = -jnp.sum(jnp.dot(dpre, e.T, preferred_element_type=jnp.float32),
+    dctr = -jnp.sum(jnp.dot(dprec, e.T, preferred_element_type=jnp.float32),
                     axis=0)
     activity = jnp.sum(c, axis=0)
     scal = jnp.stack([jnp.sum(c), jnp.sum(mask)])[None, :]  # l1, l0 sums
@@ -167,9 +189,10 @@ def _bwd_kernel(alpha_ref, xc_ref, r_ref, e_ref, w_ref, t_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("batch_tile", "feat_tile",
-                                             "interpret"))
+                                             "interpret", "compute_dtype"))
 def big_sae_forward(params: dict, xc: Array, batch_tile: int, feat_tile: int,
-                    interpret: bool = False) -> Array:
+                    interpret: bool = False,
+                    compute_dtype: str = "float32") -> Array:
     """x̂ = relu(xc E + t) @ Wn without materializing the codes. `params`
     holds raw big-SAE params (dict/encoder/threshold); xc is pre-centered."""
     import jax.experimental.pallas as pl
@@ -178,8 +201,10 @@ def big_sae_forward(params: dict, xc: Array, batch_tile: int, feat_tile: int,
     n = params["dict"].shape[0]
     wn = params["dict"] / jnp.linalg.norm(params["dict"], axis=-1,
                                           keepdims=True)
+    kernel = functools.partial(_fwd_kernel,
+                               compute_dtype=jnp.dtype(compute_dtype))
     return pl.pallas_call(
-        _fwd_kernel,
+        kernel,
         grid=(b // batch_tile, n // feat_tile),
         in_specs=[
             pl.BlockSpec((batch_tile, d), lambda bt, ft: (bt, 0)),   # xc
@@ -194,11 +219,13 @@ def big_sae_forward(params: dict, xc: Array, batch_tile: int, feat_tile: int,
 
 
 @functools.partial(jax.jit, static_argnames=("batch_tile", "feat_tile",
-                                             "interpret", "total_batch"))
+                                             "interpret", "total_batch",
+                                             "compute_dtype"))
 def big_sae_backward(params: dict, alpha: Array, xc: Array, r: Array,
                      batch_tile: int, feat_tile: int,
                      interpret: bool = False,
-                     total_batch: Optional[int] = None):
+                     total_batch: Optional[int] = None,
+                     compute_dtype: str = "float32"):
     """All parameter grads (wrt raw E/t/normalized Wn/encode-side ctr) plus
     c_totals and the l1/l0 sums, one pass, codes recomputed per tile.
     total_batch: global batch for loss normalization (≠ local under
@@ -213,7 +240,8 @@ def big_sae_backward(params: dict, alpha: Array, xc: Array, r: Array,
     wn = params["dict"] / jnp.linalg.norm(params["dict"], axis=-1,
                                           keepdims=True)
     kernel = functools.partial(_bwd_kernel, total_batch=total_batch,
-                               d_act=d)
+                               d_act=d,
+                               compute_dtype=jnp.dtype(compute_dtype))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n // feat_tile, b // batch_tile),
@@ -256,7 +284,8 @@ def fused_big_sae_loss_and_grads(params: dict, batch: Array, l1_alpha: Array,
                                  batch_tile: Optional[int] = None,
                                  feat_tile: Optional[int] = None,
                                  interpret: bool = False,
-                                 total_batch: Optional[int] = None):
+                                 total_batch: Optional[int] = None,
+                                 compute_dtype: str = "float32"):
     """Drop-in replacement for value_and_grad(_sae_loss) in the big-SAE step
     (train/big_sae.py): returns (loss, aux, grads) where aux is the dict
     {"mse", "sparsity", "c_totals_delta", "mse_losses", "l0_mean"} and
@@ -264,7 +293,8 @@ def fused_big_sae_loss_and_grads(params: dict, batch: Array, l1_alpha: Array,
     b, d = batch.shape
     n = params["dict"].shape[0]
     if batch_tile is None or feat_tile is None:
-        tiles = pick_big_sae_tiles(b, n, d)
+        tiles = pick_big_sae_tiles(
+            b, n, d, compute_itemsize=jnp.dtype(compute_dtype).itemsize)
         if tiles is None:
             raise ValueError(
                 f"no VMEM-fitting (batch, feature) tiles for batch={b} "
@@ -276,7 +306,7 @@ def fused_big_sae_loss_and_grads(params: dict, batch: Array, l1_alpha: Array,
     batch = batch.astype(jnp.float32)
     xc = batch - params["centering"]
     x_hat = big_sae_forward(params, xc, batch_tile, feat_tile,
-                            interpret=interpret)
+                            interpret=interpret, compute_dtype=compute_dtype)
     if tied:
         x_hat = x_hat + params["centering"]
     resid = x_hat - batch  # r in the kernel math
@@ -285,7 +315,8 @@ def fused_big_sae_loss_and_grads(params: dict, batch: Array, l1_alpha: Array,
 
     de, dwn, dt, dctr_enc, c_totals, scal = big_sae_backward(
         params, jnp.asarray(l1_alpha, jnp.float32), xc, resid,
-        batch_tile, feat_tile, interpret=interpret, total_batch=total_batch)
+        batch_tile, feat_tile, interpret=interpret, total_batch=total_batch,
+        compute_dtype=compute_dtype)
     l1_sum, l0_sum = scal[0], scal[1]
     sparsity = jnp.asarray(l1_alpha, jnp.float32) * l1_sum / total_batch
     loss = mse + sparsity

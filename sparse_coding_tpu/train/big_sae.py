@@ -94,7 +94,8 @@ def _sae_loss(params: dict, batch: Array, l1_alpha: Array, tied: bool):
 def make_big_sae_step(optimizer: optax.GradientTransformation,
                       l1_alpha: Array, mesh: Optional[Mesh] = None,
                       use_fused: str | bool = "auto",
-                      fused_interpret: bool = False):
+                      fused_interpret: bool = False,
+                      fused_compute_dtype: str = "float32"):
     """Jitted (state, batch) -> (state, metrics). With a mesh, the batch is
     data-sharded; grads reduce via XLA collectives (replacing DDP all-reduce,
     huge_batch_size.py:274,322).
@@ -131,9 +132,14 @@ def make_big_sae_step(optimizer: optax.GradientTransformation,
         local_n = n // mesh.shape["model"] if mesh is not None else n
         # shapes are static at trace time, so the path choice re-resolves
         # per compiled batch shape, like ensemble._resolve_step
+        # same derivation the kernel's own tile pick uses, so the gate and
+        # the inner admission can never disagree
+        compute_itemsize = jnp.dtype(fused_compute_dtype).itemsize
         fused_ok = (fused_wanted and divisible
                     and (fused_interpret or jax.default_backend() == "tpu")
-                    and pick_big_sae_tiles(local_b, local_n, d) is not None)
+                    and pick_big_sae_tiles(
+                        local_b, local_n, d,
+                        compute_itemsize=compute_itemsize) is not None)
         if use_fused is True and not fused_ok:
             raise ValueError(
                 f"use_fused=True but the fused big-SAE step is unavailable "
@@ -147,7 +153,8 @@ def make_big_sae_step(optimizer: optax.GradientTransformation,
                         if mesh is not None else fused_big_sae_loss_and_grads)
             loss, aux, grads = fused_fn(state.params, batch, l1_alpha,
                                         state.tied,
-                                        interpret=fused_interpret)
+                                        interpret=fused_interpret,
+                                        compute_dtype=fused_compute_dtype)
             mse, sparsity = aux["mse"], aux["sparsity"]
             mse_losses = aux["mse_losses"]
             c_totals_delta = aux["c_totals_delta"]
@@ -232,7 +239,8 @@ def resurrect_dead_features(state: BigSAEState) -> tuple[BigSAEState, Array]:
 
 def _sharded_fused_loss_and_grads(params: dict, batch: Array, l1_alpha,
                                   tied: bool, mesh: Mesh,
-                                  interpret: bool = False):
+                                  interpret: bool = False,
+                                  compute_dtype: str = "float32"):
     """Mesh-composed fused big-SAE loss/grads: under shard_map each device
     owns n/mesh_model FEATURES (tensor parallel — dict rows, encoder
     columns, thresholds) and B/mesh_data batch rows. Per-shard flash
@@ -252,8 +260,9 @@ def _sharded_fused_loss_and_grads(params: dict, batch: Array, l1_alpha,
 
     total_b = batch.shape[0]
     n, d = params["dict"].shape
-    tiles = pick_big_sae_tiles(total_b // mesh.shape["data"],
-                               n // mesh.shape["model"], d)
+    tiles = pick_big_sae_tiles(
+        total_b // mesh.shape["data"], n // mesh.shape["model"], d,
+        compute_itemsize=jnp.dtype(compute_dtype).itemsize)
     if tiles is None:
         raise ValueError(
             f"no VMEM-fitting (batch, feature) tiles for per-device "
@@ -265,7 +274,8 @@ def _sharded_fused_loss_and_grads(params: dict, batch: Array, l1_alpha,
     def local_fn(p, alpha, local_batch):
         local_batch = local_batch.astype(jnp.float32)
         xc = local_batch - p["centering"]
-        partial = big_sae_forward(p, xc, bt, ft, interpret=interpret)
+        partial = big_sae_forward(p, xc, bt, ft, interpret=interpret,
+                                  compute_dtype=compute_dtype)
         x_hat = jax.lax.psum(partial, "model")  # decode sums over features
         if tied:
             x_hat = x_hat + p["centering"]
@@ -274,7 +284,7 @@ def _sharded_fused_loss_and_grads(params: dict, batch: Array, l1_alpha,
         mse = jax.lax.psum(jnp.sum(jnp.square(r)), "data") / (total_b * d)
         de, dwn, dt, dctr_enc, c_totals, scal = big_sae_backward(
             p, alpha, xc, r, bt, ft, interpret=interpret,
-            total_batch=total_b)
+            total_batch=total_b, compute_dtype=compute_dtype)
         de, dwn, dt, c_totals = jax.lax.psum((de, dwn, dt, c_totals), "data")
         scal = jax.lax.psum(scal, ("model", "data"))
         dctr = jax.lax.psum(dctr_enc, ("model", "data"))

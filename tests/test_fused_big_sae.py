@@ -148,6 +148,32 @@ def test_fused_big_sae_sharded_matches_standard(rng, tied):
                                rtol=1e-4, atol=1e-7)
 
 
+def test_fused_big_sae_bf16_compute_close(rng):
+    """compute_dtype=bfloat16 (MXU-native dots, f32 accumulation) tracks the
+    f32 kernels within bf16 mantissa tolerance."""
+    k_init, k_data = jax.random.split(rng)
+    state, _, l1 = _params(k_init)
+    batch = jax.random.normal(k_data, (B, D))
+    loss_f, aux_f, grads_f = fused_big_sae_loss_and_grads(
+        state.params, batch, l1, False, batch_tile=64, feat_tile=128,
+        interpret=True)
+    loss_h, aux_h, grads_h = fused_big_sae_loss_and_grads(
+        state.params, batch, l1, False, batch_tile=64, feat_tile=128,
+        interpret=True, compute_dtype="bfloat16")
+    np.testing.assert_allclose(float(loss_h), float(loss_f), rtol=2e-2)
+    for name in grads_f:
+        ref = np.asarray(grads_f[name])
+        # absolute floor scaled to the gradient's own magnitude: nearly
+        # cancelling sums (the centering grad sums B·n bf16-rounded
+        # products) make pure relative error meaningless at ~zero entries,
+        # and bf16 pre-activations can flip the ReLU mask for samples
+        # sitting on the boundary (an element-sized jump by construction)
+        atol = 6e-2 * max(float(np.max(np.abs(ref))), 1e-3)
+        np.testing.assert_allclose(np.asarray(grads_h[name]), ref,
+                                   rtol=0.15, atol=atol,
+                                   err_msg=f"bf16-compute drift: {name}")
+
+
 def test_fused_big_sae_gating(rng):
     """auto mode silently uses autodiff off-TPU / for unfittable shapes;
     use_fused=True fails fast."""
