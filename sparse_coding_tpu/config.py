@@ -150,6 +150,10 @@ class EnsembleArgs(BaseArgs):
     # "orbax" (sharded per-host async writes, restores straight onto the
     # mesh — the right choice at big-SAE/multi-host scale; utils/orbax_ckpt)
     checkpoint_backend: str = "msgpack"
+    # >0: capture a jax.profiler device trace of that many training steps
+    # (after compile/warmup) into <output_folder>/trace — TensorBoard/XProf
+    # readable, the on-hardware tuning loop's first artifact
+    profile_steps: int = 0
 
 
 @dataclass

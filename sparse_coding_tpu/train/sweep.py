@@ -223,6 +223,11 @@ def sweep(
     # orbax: a fully-issued async checkpoint set whose swap is deferred so
     # its disk writes overlap the next chunk's training
     pending_staging: Optional[Path] = None
+    # cfg.profile_steps > 0: one jax.profiler trace window opens once the
+    # first step has compiled (step 2) and closes profile_steps later —
+    # early enough that even a tiny debugging sweep produces its trace
+    profile_start = 2
+    profiling = False
 
     # remaining chunks stream through chunk_reader: the next chunk's disk
     # read overlaps the current chunk's training (native/chunkio.cpp
@@ -244,6 +249,12 @@ def sweep(
             batches = store.batches(chunk, cfg.batch_size, rng)
             for batch in device_prefetch(batches, sharding):
                 step += 1
+                if cfg.profile_steps > 0 and step == profile_start:
+                    jax.profiler.start_trace(str(out_dir / "trace"))
+                    profiling = True
+                elif profiling and step == profile_start + cfg.profile_steps:
+                    jax.profiler.stop_trace()
+                    profiling = False
                 for ens_idx, (ensemble, hypers, name) in enumerate(ensembles):
                     is_group = isinstance(ensemble, EnsembleGroup)
                     if is_group:
@@ -332,6 +343,10 @@ def sweep(
         raise
     finally:
         reader.close()  # release any in-flight native chunk read
+        if profiling:
+            # short sweeps / crashes inside the window: the trace is still
+            # flushed so the steps it did capture are viewable
+            jax.profiler.stop_trace()
         if orbax_ckptr is not None:
             # a FULLY-ISSUED async set is waited on and swapped in even on
             # a crash (it reflects completed training) — but cross-host

@@ -200,14 +200,17 @@ def test_big_sae_kernels_lower_for_tpu():
     """AOT Mosaic lowering for both kernels at a small and the canonical DDP
     scale (catches tiling-rule violations interpret mode can't see)."""
     shapes = [(256, 256, 128, 64, 128), (2048, 4096, 1024, 256, 512)]
-    for b, n, d, bt, ft in shapes:
-        params = {"dict": jnp.zeros((n, d)), "encoder": jnp.zeros((d, n)),
-                  "threshold": jnp.zeros((n,)),
-                  "centering": jnp.zeros((d,))}
-        xc = jnp.zeros((b, d))
-        jax.jit(lambda p, x: big_sae_forward(p, x, bt, ft)).trace(
-            params, xc).lower(lowering_platforms=("tpu",))
-        jax.jit(
-            lambda p, a, x, r: big_sae_backward(p, a, x, r, bt, ft)
-        ).trace(params, jnp.zeros(()), xc, xc).lower(
-            lowering_platforms=("tpu",))
+    for compute in ("float32", "bfloat16"):
+        for b, n, d, bt, ft in shapes:
+            params = {"dict": jnp.zeros((n, d)), "encoder": jnp.zeros((d, n)),
+                      "threshold": jnp.zeros((n,)),
+                      "centering": jnp.zeros((d,))}
+            xc = jnp.zeros((b, d))
+            jax.jit(lambda p, x, cd=compute: big_sae_forward(
+                p, x, bt, ft, compute_dtype=cd)).trace(
+                params, xc).lower(lowering_platforms=("tpu",))
+            jax.jit(
+                lambda p, a, x, r, cd=compute: big_sae_backward(
+                    p, a, x, r, bt, ft, compute_dtype=cd)
+            ).trace(params, jnp.zeros(()), xc, xc).lower(
+                lowering_platforms=("tpu",))

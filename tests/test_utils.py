@@ -259,6 +259,22 @@ def test_sweep_bf16_train_dtype(tmp_path):
     assert abs(out["bfloat16"] - out["float32"]) < 0.05, out
 
 
+def test_sweep_profile_window(tmp_path):
+    """profile_steps>0 captures a TensorBoard-readable jax.profiler trace
+    into <output_folder>/trace and closes the window cleanly."""
+    from sparse_coding_tpu.train.experiments import dense_l1_range_experiment
+    from sparse_coding_tpu.train.sweep import sweep
+
+    build = lambda c, m: dense_l1_range_experiment(c, m, l1_range=[1e-3],
+                                                   activation_dim=16)
+    sweep(build, _sweep_cfg(tmp_path, "prof", n_chunks=2, profile_steps=3),
+          log_every=50)
+    trace_dir = tmp_path / "prof" / "trace"
+    assert trace_dir.exists()
+    # xplane artifacts land under plugins/profile/<run>/
+    assert list(trace_dir.rglob("*.xplane.pb")), list(trace_dir.rglob("*"))
+
+
 @pytest.mark.parametrize("backend", ["msgpack", "orbax"])
 def test_sweep_crash_resume_bitwise(tmp_path, monkeypatch, backend):
     """Kill a sweep mid-run; resume=True completes it with final params
