@@ -43,7 +43,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from sparse_coding_tpu.ops.fused_sae import VMEM_BUDGET_BYTES, normalize_with_vjp
+from sparse_coding_tpu.ops.fused_sae import (
+    _DB,
+    VMEM_BUDGET_BYTES,
+    VMEM_LIMIT_BYTES,
+    normalize_with_vjp,
+)
 
 Array = jax.Array
 
@@ -55,15 +60,21 @@ def _bwd_working_set(bt: int, ft: int, d: int,
     # xc, rc, E, Wn, the c cast, and dprec
     extra = (0 if compute_itemsize >= f32 else
              (bt * d * 2 + d * ft + ft * d + bt * ft * 2) * compute_itemsize)
-    return (
+    # in/out blocks ×_DB (Mosaic double-buffering, see fused_sae budget
+    # comment); in-kernel intermediates single
+    blocks = (
         d * ft * f32 * 2      # E tile + dE accumulator
         + ft * d * f32 * 2    # Wn tile + dWn accumulator
-        + bt * d * f32 * 3    # xc, r, dpre@Eᵀ
-        + bt * ft * f32 * 3   # pre/c, r@Wnᵀ/dpre, mask
-        + ft * f32 * 3        # t, dt, c_totals
+        + bt * d * f32 * 2    # xc, r input tiles
+        + ft * f32 * 4        # t, dt, c_totals, act
         + d * f32             # dctr
+    )
+    interm = (
+        bt * d * f32          # dpre@Eᵀ
+        + bt * ft * f32 * 3   # pre/c, r@Wnᵀ/dpre, mask
         + extra
     )
+    return _DB * blocks + interm
 
 
 def _fwd_working_set(bt: int, ft: int, d: int,
@@ -71,14 +82,14 @@ def _fwd_working_set(bt: int, ft: int, d: int,
     f32 = 4
     extra = (0 if compute_itemsize >= f32 else
              (bt * d + d * ft + ft * d + bt * ft) * compute_itemsize)
-    return (
+    blocks = (
         d * ft * f32          # E tile
         + ft * d * f32        # Wn tile
         + bt * d * f32 * 2    # xc tile + x̂ accumulator
-        + bt * ft * f32 * 2   # pre/c
         + ft * f32            # t
-        + extra
     )
+    interm = bt * ft * f32 * 2 + extra  # pre/c
+    return _DB * blocks + interm
 
 
 def pick_big_sae_tiles(batch: int, n_feats: int, d: int,
@@ -201,11 +212,19 @@ def big_sae_forward(params: dict, xc: Array, batch_tile: int, feat_tile: int,
     n = params["dict"].shape[0]
     wn = params["dict"] / jnp.linalg.norm(params["dict"], axis=-1,
                                           keepdims=True)
+    from jax.experimental.pallas import tpu as pltpu
+
     kernel = functools.partial(_fwd_kernel,
                                compute_dtype=jnp.dtype(compute_dtype))
+    # batch axis is parallel (disjoint x̂ blocks); feat axis accumulates
+    # into them sequentially. vmem_limit_bytes: see fused_sae budget comment.
+    compiler_params = (None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary"),
+        vmem_limit_bytes=VMEM_LIMIT_BYTES))
     return pl.pallas_call(
         kernel,
         grid=(b // batch_tile, n // feat_tile),
+        compiler_params=compiler_params,
         in_specs=[
             pl.BlockSpec((batch_tile, d), lambda bt, ft: (bt, 0)),   # xc
             pl.BlockSpec((d, feat_tile), lambda bt, ft: (0, ft)),    # E
@@ -261,9 +280,15 @@ def big_sae_backward(params: dict, alpha: Array, xc: Array, r: Array,
             pl.BlockSpec((1, 2), lambda ft, bt, *_: (0, 0)),            # l1/l0
         ],
     )
+    # no dimension_semantics here: dctr/scal blocks are shared across the
+    # feat axis (every program accumulates into them), so neither grid axis
+    # may be declared parallel
+    compiler_params = (None if interpret else pltpu.CompilerParams(
+        vmem_limit_bytes=VMEM_LIMIT_BYTES))
     de, dwn, dt, dctr_enc, c_totals, scal = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
+        compiler_params=compiler_params,
         out_shape=[
             jax.ShapeDtypeStruct((d, n), jnp.float32),
             jax.ShapeDtypeStruct((n, d), jnp.float32),

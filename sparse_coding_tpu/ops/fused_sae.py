@@ -33,7 +33,17 @@ import numpy as np
 
 Array = jax.Array
 
-VMEM_BUDGET_BYTES = 12 * 2**20  # leave headroom out of ~16 MB/core
+# Mosaic's DEFAULT scoped-VMEM window is only 16 MiB — far below the
+# 128 MiB/core of v4/v5e. The kernels request a larger window via
+# CompilerParams(vmem_limit_bytes=VMEM_LIMIT_BYTES); the admission model
+# below keeps modeled usage under VMEM_BUDGET_BYTES (margin left for
+# compiler scratch). Real usage ≈ single-buffered block bytes × 2 because
+# Mosaic double-buffers every grid-varying input/output block — measured on
+# a v5e: 20.8 MiB actual vs an 11.3 MiB single-buffer estimate at tile 128,
+# bench shapes (n=2048, d=512); the model's _DB factor reproduces that.
+VMEM_LIMIT_BYTES = 100 * 2**20  # requested scoped-VMEM window per kernel
+VMEM_BUDGET_BYTES = 80 * 2**20  # admission ceiling for the modeled set
+_DB = 2  # Mosaic double-buffer factor on in/out blocks
 
 # batch-tile candidates in preference order (the first VMEM-fitting,
 # batch-dividing entry wins); an explicit tile (Ensemble fused_batch_tile /
@@ -44,9 +54,10 @@ PREFERRED_TILES: tuple = (512, 256, 128, 64)
 def _working_set(batch_tile: int, n_feats: int, d: int,
                  batch_itemsize: int = 4, compute_itemsize: int = 4) -> int:
     f32 = 4
-    # a sub-f32 x tile is cast up INSIDE the kernel, so its f32 copy
-    # coexists with the half-width input tile in VMEM: bf16 saves HBM
-    # traffic, not VMEM (14 B/elem peak vs 12 for f32)
+    # a sub-f32 x tile is cast up INSIDE the kernel, so its single f32 copy
+    # coexists with the half-width input block; the double-buffered block's
+    # saving (_DB × 2 B/elem) offsets the +4 B/elem copy, so bf16 streams
+    # never cost extra VMEM
     cast_copy = f32 if batch_itemsize < f32 else 0
     extra = 0
     if compute_itemsize < f32:
@@ -58,13 +69,19 @@ def _working_set(batch_tile: int, n_feats: int, d: int,
                  + batch_tile * n_feats * compute_itemsize * 2  # c, dpre
                  + (0 if batch_itemsize == compute_itemsize
                     else batch_tile * d * compute_itemsize))    # xc
-    return (
-        n_feats * d * f32 * 2      # W + dW accumulator
-        + batch_tile * n_feats * f32 * 2  # c and r@Wᵀ
-        + batch_tile * d * (batch_itemsize + cast_copy + 2 * f32)  # x, x̂, r
-        + n_feats * f32 * 2        # b, db
+    # in/out BLOCKS are double-buffered by Mosaic's pipeline (×_DB);
+    # in-kernel intermediates are single copies
+    blocks = (
+        n_feats * d * f32 * 2           # W in + dW out
+        + batch_tile * d * batch_itemsize  # x tile (stream width)
+        + n_feats * f32 * 3             # b, db, activity (+tiny losses)
+    )
+    interm = (
+        batch_tile * n_feats * f32 * 2  # c and r@Wᵀ/dpre
+        + batch_tile * d * (cast_copy + 2 * f32)  # x upcast, x̂, r
         + extra
     )
+    return _DB * blocks + interm
 
 
 def pick_batch_tile(batch: int, n_feats: int, d: int,
@@ -227,7 +244,8 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
     # sequential. "parallel" lets Mosaic split members across cores on
     # multi-core chips (e.g. v4); harmless on single-core generations.
     compiler_params = (None if interpret else pltpu.CompilerParams(
-        dimension_semantics=("parallel", "arbitrary")))
+        dimension_semantics=("parallel", "arbitrary"),
+        vmem_limit_bytes=VMEM_LIMIT_BYTES))
 
     dw, db, activity, losses = pl.pallas_call(
         kernel,
@@ -239,8 +257,7 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
             jax.ShapeDtypeStruct((n_members, 1, 3), jnp.float32),
         ],
         interpret=interpret,
-        **({} if compiler_params is None else
-           {"compiler_params": compiler_params}),
+        compiler_params=compiler_params,
     )(alphas.astype(jnp.float32), batch, w_normed,
       bias.reshape(n_members, 1, n_feats))
 

@@ -53,7 +53,7 @@ def stage1_grid(on_tpu: bool, quick: bool) -> list[dict]:
         return configs
     # matmul_precision doesn't reach Pallas dots; the fused knobs are the
     # batch tile, the HBM stream dtype, and the in-kernel MXU compute dtype
-    tiles = (None, 512, 256, 128, 64)
+    tiles = (None, 2048, 1024, 512, 256, 128, 64)
     for tile, compute, batch_dtype in itertools.product(
             tiles, (None, "bfloat16"), (None, "bfloat16")):
         configs.append({"use_fused": True, "batch_tile": tile,
