@@ -78,16 +78,17 @@ SCAN_CHUNK = 10  # steps fused into one device program (amortizes dispatch)
 def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
                    n_members=None, batch=None, bench_steps=None,
                    scan_chunk=None, batch_dtype=None,
-                   batch_tile=None, fused_compute_dtype=None) -> float:
+                   batch_tile=None, fused_compute_dtype=None,
+                   sig="tied_sae") -> float:
     """Shared ensemble-throughput measurement (bench_suite.py and tune.py
     reuse it with their own scales; batch_tile forces the fused kernel's
     batch tile, None = auto-pick; fused_compute_dtype="bfloat16" runs the
     kernel's dots on the MXU bf16 path — matmul_precision does not reach
-    Pallas dots)."""
+    Pallas dots; sig="sae" times the untied FunctionalSAE family instead)."""
     import contextlib
 
     from sparse_coding_tpu.ensemble import Ensemble
-    from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+    from sparse_coding_tpu.models.sae import FunctionalSAE, FunctionalTiedSAE
 
     d_act = d_act or D_ACT
     n_dict = n_dict or N_DICT
@@ -95,15 +96,16 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
     batch = batch or BATCH
     bench_steps = bench_steps or BENCH_STEPS
     scan_chunk = scan_chunk or SCAN_CHUNK
+    sig_cls = {"tied_sae": FunctionalTiedSAE, "sae": FunctionalSAE}[sig]
 
     ctx = (jax.default_matmul_precision(matmul_precision)
            if matmul_precision else contextlib.nullcontext())
     with ctx:
         keys = jax.random.split(jax.random.PRNGKey(0), n_members)
         l1s = jnp.logspace(-4, -2, n_members)
-        members = [FunctionalTiedSAE.init(k, d_act, n_dict, l1_alpha=float(l1))
+        members = [sig_cls.init(k, d_act, n_dict, l1_alpha=float(l1))
                    for k, l1 in zip(keys, l1s)]
-        ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=use_fused,
+        ens = Ensemble(members, sig_cls, lr=1e-3, use_fused=use_fused,
                        fused_batch_tile=batch_tile,
                        fused_compute_dtype=fused_compute_dtype or "float32")
 

@@ -48,7 +48,10 @@ def bench_ensemble(quick: bool) -> None:
     steps, scan = (15, 5) if quick else (200, 10)
     # (matmul_precision governs only the autodiff path; Pallas kernel dots
     # take the bf16 MXU path via fused_compute_dtype instead)
-    variants = [("autodiff", dict(use_fused=False))]
+    # tied family plus the untied FunctionalSAE family (the reference's
+    # default SAE), each with its own fused kernel on TPU
+    variants = [("autodiff", dict(use_fused=False)),
+                ("untied_autodiff", dict(use_fused=False, sig="sae"))]
     if jax.default_backend() == "tpu":
         variants += [
             ("fused", dict(use_fused=True)),
@@ -56,6 +59,9 @@ def bench_ensemble(quick: bool) -> None:
                                    matmul_precision="bfloat16")),
             ("fused_bf16", dict(use_fused=True,
                                 fused_compute_dtype="bfloat16")),
+            ("untied_fused", dict(use_fused=True, sig="sae")),
+            ("untied_fused_bf16", dict(use_fused=True, sig="sae",
+                                       fused_compute_dtype="bfloat16")),
         ]
     for name, kwargs in variants:
         try:

@@ -93,10 +93,16 @@ def adam_optimizer(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> opt
 
 def _apply_fused_updates(optimizer, losses, grads, activity,
                          params, opt_state, lrs):
-    """Shared tail of both fused steps: vmapped per-member Adam update from
+    """Shared tail of the fused steps: vmapped per-member Adam update from
     kernel-produced grads + AuxData assembly (loss fields match the autodiff
-    path, locked by tests/test_torch_loss_parity.py)."""
+    path, locked by tests/test_torch_loss_parity.py). An optional
+    "bias_decay" loss entry (untied family) is folded into the total and
+    reported under the autodiff path's "l_bias_decay" key."""
     total = losses["mse"] + losses["l1"]
+    loss_fields = {"l_reconstruction": losses["mse"], "l_l1": losses["l1"]}
+    if "bias_decay" in losses:
+        total = total + losses["bias_decay"]
+        loss_fields["l_bias_decay"] = losses["bias_decay"]
 
     def member_update(g, opt_state, params, lr):
         updates, opt_state = optimizer.update(g, opt_state, params)
@@ -105,32 +111,56 @@ def _apply_fused_updates(optimizer, losses, grads, activity,
 
     params, opt_state = jax.vmap(member_update)(grads, opt_state, params, lrs)
     aux = AuxData(
-        losses={"loss": total, "l_reconstruction": losses["mse"],
-                "l_l1": losses["l1"]},
+        losses={"loss": total, **loss_fields},
         l0=losses["l0"],
         feat_activity=activity.astype(jnp.int32))
     return params, opt_state, aux
 
 
-def make_fused_tied_step(
-    optimizer: optax.GradientTransformation,
-    donate: bool = True,
-    interpret: bool = False,
-    batch_tile: Optional[int] = None,
-    compute_dtype: str = "float32",
-) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
-    """Fused-kernel step for identity-centered FunctionalTiedSAE buckets:
-    loss + exact grads come from one Pallas pass (ops/fused_sae.py) instead of
-    vmap(value_and_grad); the optimizer update stays vmapped optax.
-    batch_tile=None lets the kernel pick the largest fitting tile."""
+def _tied_producer(batch_tile, interpret, compute_dtype):
+    """(params, buffers, batch, total_batch, psum_axis) -> (losses, grads,
+    activity) via the tied kernel (ops/fused_sae.fused_tied_sae_loss_and_grads)."""
     from sparse_coding_tpu.ops.fused_sae import fused_tied_sae_loss_and_grads
 
+    def producer(params, buffers, batch, total_batch=None, psum_axis=None):
+        return fused_tied_sae_loss_and_grads(
+            {"encoder": params["encoder"],
+             "encoder_bias": params["encoder_bias"]},
+            buffers["l1_alpha"], batch, batch_tile=batch_tile,
+            interpret=interpret, total_batch=total_batch,
+            compute_dtype=compute_dtype, psum_axis=psum_axis)
+
+    return producer
+
+
+def _untied_producer(batch_tile, interpret, compute_dtype):
+    """Untied-family producer (ops/fused_sae.fused_untied_sae_loss_and_grads);
+    any bias_decay is exact — the decay term is applied outside the kernel,
+    AFTER the in-wrapper psum, so it counts once per member, not once per
+    data shard."""
+    from sparse_coding_tpu.ops.fused_sae import fused_untied_sae_loss_and_grads
+
+    def producer(params, buffers, batch, total_batch=None, psum_axis=None):
+        return fused_untied_sae_loss_and_grads(
+            params, buffers["l1_alpha"], buffers["bias_decay"], batch,
+            batch_tile=batch_tile, interpret=interpret,
+            total_batch=total_batch, compute_dtype=compute_dtype,
+            psum_axis=psum_axis)
+
+    return producer
+
+
+def make_fused_step(
+    producer: Callable,
+    optimizer: optax.GradientTransformation,
+    donate: bool = True,
+) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
+    """Single-device fused-kernel step: loss + exact grads come from one
+    Pallas pass (via `producer`, see _tied_producer/_untied_producer) instead
+    of vmap(value_and_grad); the optimizer update stays vmapped optax."""
+
     def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
-        losses, grads, activity = fused_tied_sae_loss_and_grads(
-            {"encoder": state.params["encoder"],
-             "encoder_bias": state.params["encoder_bias"]},
-            state.buffers["l1_alpha"], batch, batch_tile=batch_tile,
-            interpret=interpret, compute_dtype=compute_dtype)
+        losses, grads, activity = producer(state.params, state.buffers, batch)
         params, opt_state, aux = _apply_fused_updates(
             optimizer, losses, grads, activity,
             state.params, state.opt_state, state.lrs)
@@ -141,13 +171,11 @@ def make_fused_tied_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
-def make_fused_tied_step_sharded(
+def make_fused_step_sharded(
+    producer: Callable,
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
     donate: bool = True,
-    interpret: bool = False,
-    batch_tile: Optional[int] = None,
-    compute_dtype: str = "float32",
 ) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
     """Mesh-composed fused step: the flagship multi-chip configuration
     (replacing /root/reference/cluster_runs.py:100-157's all-GPUs-training
@@ -155,21 +183,16 @@ def make_fused_tied_step_sharded(
     members ("model" axis) and B/mesh_data batch rows ("data" axis) and runs
     the SAME Pallas kernel as the single-chip path on its local slice — the
     kernel normalizes by the GLOBAL batch size, so one psum over "data"
-    yields exact full-batch losses/grads, then the optimizer update runs
+    (inside the producer: batch-independent loss terms must be added after
+    it) yields exact full-batch losses/grads, then the optimizer update runs
     locally per member shard. HBM/ICI traffic per step: x once into VMEM,
     one [N_local, n, d] grad reduce-scatter-shaped psum riding ICI."""
     from jax import shard_map
-    from sparse_coding_tpu.ops.fused_sae import fused_tied_sae_loss_and_grads
 
     def local_step(params, buffers, opt_state, lrs, local_batch, total_batch):
-        losses, grads, activity = fused_tied_sae_loss_and_grads(
-            {"encoder": params["encoder"],
-             "encoder_bias": params["encoder_bias"]},
-            buffers["l1_alpha"], local_batch, batch_tile=batch_tile,
-            interpret=interpret, total_batch=total_batch,
-            compute_dtype=compute_dtype)
-        losses, grads, activity = jax.lax.psum((losses, grads, activity),
-                                               "data")
+        losses, grads, activity = producer(params, buffers, local_batch,
+                                           total_batch=total_batch,
+                                           psum_axis="data")
         return _apply_fused_updates(optimizer, losses, grads, activity,
                                     params, opt_state, lrs)
 
@@ -188,6 +211,45 @@ def make_fused_tied_step_sharded(
         return new_state, aux
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_fused_tied_step(optimizer, donate=True, interpret=False,
+                         batch_tile=None, compute_dtype="float32"):
+    return make_fused_step(
+        _tied_producer(batch_tile, interpret, compute_dtype), optimizer,
+        donate=donate)
+
+
+def make_fused_tied_step_sharded(optimizer, mesh, donate=True, interpret=False,
+                                 batch_tile=None, compute_dtype="float32"):
+    return make_fused_step_sharded(
+        _tied_producer(batch_tile, interpret, compute_dtype), optimizer, mesh,
+        donate=donate)
+
+
+def make_fused_untied_step(optimizer, donate=True, interpret=False,
+                           batch_tile=None, compute_dtype="float32"):
+    return make_fused_step(
+        _untied_producer(batch_tile, interpret, compute_dtype), optimizer,
+        donate=donate)
+
+
+def make_fused_untied_step_sharded(optimizer, mesh, donate=True,
+                                   interpret=False, batch_tile=None,
+                                   compute_dtype="float32"):
+    return make_fused_step_sharded(
+        _untied_producer(batch_tile, interpret, compute_dtype), optimizer,
+        mesh, donate=donate)
+
+
+def can_use_fused_untied_step(sig: Any, members,
+                              interpret: bool = False) -> bool:
+    """Untied fused-path preconditions: plain "sae" signature + TPU backend
+    (or interpret mode). bias_decay needs no gate — its term lives outside
+    the kernel. VMEM tile admission happens per-batch in Ensemble."""
+    if getattr(sig, "signature_name", None) != "sae":
+        return False
+    return interpret or jax.default_backend() == "tpu"
 
 
 def can_use_fused_tied_step(sig: Any, members, interpret: bool = False) -> bool:
@@ -304,28 +366,38 @@ class Ensemble:
         self._standard_step = make_train_step(sig, self.optimizer,
                                               statics=statics0, donate=donate)
         self._fused_step = None
-        # the eligibility scan costs per-member host syncs — skip it entirely
-        # when the fused path was not requested
-        eligible = use_fused is not False and can_use_fused_tied_step(
-            sig, members, interpret=fused_interpret)
-        if use_fused is True and not eligible:
+        # pick the fused family for this signature, if any: tied_sae (one
+        # weight matrix resident per member) or plain sae (two). The
+        # eligibility scan costs per-member host syncs — skip it entirely
+        # when the fused path was not requested.
+        self._fused_n_mats = 1
+        builders = None
+        if use_fused is not False:
+            if can_use_fused_tied_step(sig, members, interpret=fused_interpret):
+                builders = (make_fused_tied_step, make_fused_tied_step_sharded)
+            elif can_use_fused_untied_step(sig, members,
+                                           interpret=fused_interpret):
+                builders = (make_fused_untied_step,
+                            make_fused_untied_step_sharded)
+                self._fused_n_mats = 2
+        if use_fused is True and builders is None:
             # explicit request: fail fast with a clear message if ineligible
             raise ValueError(
-                "use_fused=True requires an identity-centered tied_sae "
-                "bucket with zero bias_decay and a TPU backend "
-                "(or fused_interpret=True)")
-        if eligible and (use_fused is True or use_fused == "auto"):
+                "use_fused=True requires a TPU backend (or "
+                "fused_interpret=True) and either an identity-centered "
+                "tied_sae bucket with zero bias_decay or a plain sae bucket")
+        if builders is not None and (use_fused is True or use_fused == "auto"):
+            make_single, make_sharded = builders
             self._fused_step = (
-                make_fused_tied_step_sharded(self.optimizer, mesh,
-                                             donate=donate,
-                                             interpret=fused_interpret,
-                                             batch_tile=fused_batch_tile,
-                                             compute_dtype=fused_compute_dtype)
+                make_sharded(self.optimizer, mesh, donate=donate,
+                             interpret=fused_interpret,
+                             batch_tile=fused_batch_tile,
+                             compute_dtype=fused_compute_dtype)
                 if mesh is not None else
-                make_fused_tied_step(self.optimizer, donate=donate,
-                                     interpret=fused_interpret,
-                                     batch_tile=fused_batch_tile,
-                                     compute_dtype=fused_compute_dtype))
+                make_single(self.optimizer, donate=donate,
+                            interpret=fused_interpret,
+                            batch_tile=fused_batch_tile,
+                            compute_dtype=fused_compute_dtype))
         # the fused kernel additionally needs a VMEM-fitting batch tile — only
         # known once the real batch arrives, so the final choice happens on
         # the first step_batch call (and is re-checked per batch size)
@@ -367,12 +439,13 @@ class Ensemble:
         # an explicit fused_batch_tile must itself pass admission (divide
         # the local batch, fit VMEM) — same rule the kernel will apply
         ci = self._fused_compute_itemsize
+        nm = self._fused_n_mats
         workable = (tile_fits(local, self._fused_batch_tile, n_feats, d,
-                              batch_itemsize, compute_itemsize=ci)
+                              batch_itemsize, compute_itemsize=ci, n_mats=nm)
                     if self._fused_batch_tile is not None else
                     pick_batch_tile(local, n_feats, d,
                                     batch_itemsize=batch_itemsize,
-                                    compute_itemsize=ci) is not None)
+                                    compute_itemsize=ci, n_mats=nm) is not None)
         if workable:
             self._step_fn = self._fused_step
             self.fused = True

@@ -52,19 +52,22 @@ PREFERRED_TILES: tuple = (512, 256, 128, 64)
 
 
 def _working_set(batch_tile: int, n_feats: int, d: int,
-                 batch_itemsize: int = 4, compute_itemsize: int = 4) -> int:
+                 batch_itemsize: int = 4, compute_itemsize: int = 4,
+                 n_mats: int = 1) -> int:
     f32 = 4
     # a sub-f32 x tile is cast up INSIDE the kernel, so its single f32 copy
     # coexists with the half-width input block; the double-buffered block's
     # saving (_DB × 2 B/elem) offsets the +4 B/elem copy, so bf16 streams
-    # never cost extra VMEM
+    # never cost extra VMEM. n_mats: [n, d] weight matrices resident per
+    # member (1 = tied kernel's W; 2 = untied's E + Wn), each with a grad
+    # accumulator block.
     cast_copy = f32 if batch_itemsize < f32 else 0
     extra = 0
     if compute_itemsize < f32:
         # compute_dtype=bf16 materializes bf16 copies of the dot operands:
-        # w, rc, the c/dpre casts, and xc (free when the input tile already
-        # IS the compute dtype — the kernel reuses it directly)
-        extra = (n_feats * d * compute_itemsize            # w cast
+        # each weight matrix, rc, the c/dpre casts, and xc (free when the
+        # input tile already IS the compute dtype — the kernel reuses it)
+        extra = (n_feats * d * compute_itemsize * n_mats   # weight casts
                  + batch_tile * d * compute_itemsize       # rc
                  + batch_tile * n_feats * compute_itemsize * 2  # c, dpre
                  + (0 if batch_itemsize == compute_itemsize
@@ -72,7 +75,7 @@ def _working_set(batch_tile: int, n_feats: int, d: int,
     # in/out BLOCKS are double-buffered by Mosaic's pipeline (×_DB);
     # in-kernel intermediates are single copies
     blocks = (
-        n_feats * d * f32 * 2           # W in + dW out
+        n_feats * d * f32 * 2 * n_mats  # weights in + grad accumulators out
         + batch_tile * d * batch_itemsize  # x tile (stream width)
         + n_feats * f32 * 3             # b, db, activity (+tiny losses)
     )
@@ -86,28 +89,31 @@ def _working_set(batch_tile: int, n_feats: int, d: int,
 
 def pick_batch_tile(batch: int, n_feats: int, d: int,
                     batch_itemsize: int = 4,
-                    compute_itemsize: int = 4) -> Optional[int]:
+                    compute_itemsize: int = 4,
+                    n_mats: int = 1) -> Optional[int]:
     """Largest batch tile (≥64) that fits the VMEM budget and divides the
     batch; None if even 64 doesn't fit. `batch_itemsize` is the on-HBM width
     of the activation stream (2 for bf16); `compute_itemsize` the in-kernel
-    dot-operand width (2 for compute_dtype=bfloat16). All in-VMEM cast
-    copies are accounted for, so an admitted tile always fits."""
+    dot-operand width (2 for compute_dtype=bfloat16); `n_mats` the per-member
+    weight-matrix count (2 for the untied kernel). All in-VMEM cast copies
+    are accounted for, so an admitted tile always fits."""
     for tile in PREFERRED_TILES:
         if batch % tile == 0 and _working_set(
                 tile, n_feats, d, batch_itemsize,
-                compute_itemsize) <= VMEM_BUDGET_BYTES:
+                compute_itemsize, n_mats) <= VMEM_BUDGET_BYTES:
             return tile
     return None
 
 
 def tile_fits(batch: int, tile: int, n_feats: int, d: int,
-              batch_itemsize: int = 4, compute_itemsize: int = 4) -> bool:
+              batch_itemsize: int = 4, compute_itemsize: int = 4,
+              n_mats: int = 1) -> bool:
     """Would this EXPLICIT batch tile work for these shapes? (divides the
     batch and fits the VMEM budget — the admission rule pick_batch_tile
     applies to its candidates, exposed for callers forcing a tile.)"""
     return (batch % tile == 0
             and _working_set(tile, n_feats, d, batch_itemsize,
-                             compute_itemsize) <= VMEM_BUDGET_BYTES)
+                             compute_itemsize, n_mats) <= VMEM_BUDGET_BYTES)
 
 
 def fused_supported(n_members: int, batch: int, n_feats: int, d: int) -> bool:
@@ -282,12 +288,15 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
                                   batch: Array, batch_tile: Optional[int] = None,
                                   interpret: bool = False,
                                   total_batch: Optional[int] = None,
-                                  compute_dtype: str = "float32"):
+                                  compute_dtype: str = "float32",
+                                  psum_axis: Optional[str] = None):
     """Drop-in producer of (aux-style losses, grads wrt raw stacked params)
     for the ensemble engine's fused path. params_stacked:
     {"encoder": [N, n, d], "encoder_bias": [N, n]}. total_batch: see
     fused_tied_sae_grads (global batch size when called on a shard);
-    compute_dtype: bf16 runs the dots on the MXU's native fast path."""
+    compute_dtype: bf16 runs the dots on the MXU's native fast path;
+    psum_axis: reduce the per-shard partial sums over this mesh axis inside
+    the wrapper (shard_map callers — same convention as the untied family)."""
     e = params_stacked["encoder"]
     # bf16 batches enter the kernel AS bf16 (cast up per-tile in VMEM):
     # the x HBM read is half-width and no device-wide f32 copy of the batch
@@ -310,6 +319,193 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
         w_normed, params_stacked["encoder_bias"], alphas, batch,
         batch_tile=batch_tile, interpret=interpret, total_batch=total_batch,
         compute_dtype=compute_dtype)
+    if psum_axis is not None:
+        # the normalization VJP below is linear in dw and e is replicated
+        # across the data axis, so psum-then-chain equals chain-then-psum
+        losses, dw, db, activity = jax.lax.psum((losses, dw, db, activity),
+                                                psum_axis)
     grads = {"encoder": normalize_with_vjp(e, dw),
              "encoder_bias": db}
+    return losses, grads, activity
+
+
+# --- untied kernel -----------------------------------------------------------
+
+def _untied_kernel(alpha_ref, x_ref, e_ref, w_ref, b_ref,
+                   de_ref, dw_ref, db_ref, act_ref, loss_ref,
+                   *, total_batch: int, d_act: int, compute_dtype):
+    """Per-(member, batch-tile) fused loss+grads for the UNTIED SAE
+    (models/sae.py FunctionalSAE.loss; reference: sae_ensemble.py:41-56):
+        pre = x Eᵀ + b,  c = relu(pre),  x̂ = c Wn   (Wn = decoder normalized)
+        L = mean(r²) + α·mean(Σ|c|)           (bias decay added OUTSIDE)
+        ∂L/∂pre = (2/(B·d) · r Wnᵀ + α/B) ⊙ [pre > 0]
+        ∂L/∂E   = ∂L/∂preᵀ x     ∂L/∂Wn = 2/(B·d) · cᵀ r
+        ∂L/∂b   = Σ_batch ∂L/∂pre
+    Same dtype contract as the tied kernel: bf16 x streams cast up per-tile,
+    compute_dtype=bf16 runs the dots on the MXU bf16 path, f32 accumulation."""
+    import jax.experimental.pallas as pl
+
+    m = pl.program_id(0)
+    i = pl.program_id(1)
+    e = e_ref[0].astype(compute_dtype)   # [n, d] raw encoder
+    w = w_ref[0].astype(compute_dtype)   # [n, d] normalized decoder
+    x_in = x_ref[...]
+    xb = x_in.astype(jnp.float32)
+    xc = x_in if x_in.dtype == compute_dtype else xb.astype(compute_dtype)
+    b = b_ref[0, 0]
+    alpha = alpha_ref[m]
+
+    pre = jnp.dot(xc, e.T, preferred_element_type=jnp.float32) + b[None, :]
+    c = jnp.maximum(pre, 0.0)
+    x_hat = jnp.dot(c.astype(compute_dtype), w,
+                    preferred_element_type=jnp.float32)
+    r = x_hat - xb
+
+    coef = 2.0 / (total_batch * d_act)
+    mask = (pre > 0.0).astype(jnp.float32)
+    rc = r.astype(compute_dtype)
+    dpre = (coef * jnp.dot(rc, w.T, preferred_element_type=jnp.float32)
+            + alpha / total_batch) * mask
+    de = jnp.dot(dpre.astype(compute_dtype).T, xc,
+                 preferred_element_type=jnp.float32)
+    dw = coef * jnp.dot(c.astype(compute_dtype).T, rc,
+                        preferred_element_type=jnp.float32)
+    db = jnp.sum(dpre, axis=0)
+    activity = jnp.sum(mask, axis=0)
+    mse_part = jnp.sum(r * r) / (total_batch * d_act)
+    l1_part = alpha * jnp.sum(c) / total_batch
+    l0_part = jnp.sum(mask) / total_batch
+    part = jnp.stack([mse_part, l1_part, l0_part])[None, None, :]
+
+    @pl.when(i == 0)
+    def _init():
+        de_ref[0] = de
+        dw_ref[0] = dw
+        db_ref[0, 0] = db
+        act_ref[0, 0] = activity
+        loss_ref[...] = part
+
+    @pl.when(i > 0)
+    def _acc():
+        de_ref[0] += de
+        dw_ref[0] += dw
+        db_ref[0, 0] += db
+        act_ref[0, 0] += activity
+        loss_ref[...] += part
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("batch_tile", "interpret", "total_batch",
+                                    "compute_dtype"))
+def fused_untied_sae_grads(encoder: Array, w_normed: Array, bias: Array,
+                           alphas: Array, batch: Array, batch_tile: int = 256,
+                           interpret: bool = False,
+                           total_batch: Optional[int] = None,
+                           compute_dtype: str = "float32"):
+    """All-member losses and gradients wrt (raw encoder E, normalized decoder
+    Wn, bias) for the untied SAE. Same grid/blocking/accumulation scheme as
+    fused_tied_sae_grads with a second weight matrix resident (VMEM admission
+    uses n_mats=2). Returns (losses {mse, l1, l0}, dE, dWn, db, activity)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_members, n_feats, d = encoder.shape
+    if total_batch is None:
+        total_batch = batch.shape[0]
+    local_batch = batch.shape[0]
+    n_tiles = local_batch // batch_tile
+    assert n_tiles * batch_tile == local_batch
+
+    kernel = functools.partial(_untied_kernel, total_batch=total_batch,
+                               d_act=d, compute_dtype=jnp.dtype(compute_dtype))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_members, n_tiles),
+        in_specs=[
+            pl.BlockSpec((batch_tile, d), lambda m, i, *_: (i, 0)),      # x
+            pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0)),   # E
+            pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0)),   # Wn
+            pl.BlockSpec((1, 1, n_feats), lambda m, i, *_: (m, 0, 0)),   # b
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0)),   # dE
+            pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0)),   # dWn
+            pl.BlockSpec((1, 1, n_feats), lambda m, i, *_: (m, 0, 0)),   # db
+            pl.BlockSpec((1, 1, n_feats), lambda m, i, *_: (m, 0, 0)),   # act
+            pl.BlockSpec((1, 1, 3), lambda m, i, *_: (m, 0, 0)),         # loss
+        ],
+    )
+    compiler_params = (None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary"),
+        vmem_limit_bytes=VMEM_LIMIT_BYTES))
+    de, dw, db, activity, losses = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((n_members, n_feats, d), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, n_feats, d), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, 1, n_feats), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, 1, n_feats), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, 1, 3), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=compiler_params,
+    )(alphas.astype(jnp.float32), batch, encoder, w_normed,
+      bias.reshape(n_members, 1, n_feats))
+
+    db = db.reshape(n_members, n_feats)
+    activity = activity.reshape(n_members, n_feats)
+    losses = losses.reshape(n_members, 3)
+    loss_dict = {"mse": losses[:, 0], "l1": losses[:, 1], "l0": losses[:, 2]}
+    return loss_dict, de, dw, db, activity
+
+
+def fused_untied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
+                                    bias_decays: Array, batch: Array,
+                                    batch_tile: Optional[int] = None,
+                                    interpret: bool = False,
+                                    total_batch: Optional[int] = None,
+                                    compute_dtype: str = "float32",
+                                    psum_axis: Optional[str] = None):
+    """Fused-path producer for untied FunctionalSAE buckets. params_stacked:
+    {"encoder": [N, n, d], "encoder_bias": [N, n], "decoder": [N, n, d]}.
+    The bias-decay term (bd·‖b‖₂-safe, models/sae.py _safe_norm) is applied
+    OUTSIDE the kernel — cheap [N, n] elementwise — so any bias_decay value
+    is exact; losses gains a "bias_decay" entry folded into the total by the
+    ensemble tail.
+
+    psum_axis: when called on a data shard inside shard_map, the kernel's
+    per-shard partial sums must be psum'd BEFORE the batch-independent
+    bias-decay terms are added (psumming those too would scale them by the
+    shard count) — pass the data axis name here instead of psumming the
+    result at the call site."""
+    e = params_stacked["encoder"]
+    dec = params_stacked["decoder"]
+    if batch.dtype != jnp.bfloat16:
+        batch = batch.astype(jnp.float32)
+    if batch_tile is None:
+        batch_tile = pick_batch_tile(
+            batch.shape[0], e.shape[1], e.shape[2],
+            batch_itemsize=batch.dtype.itemsize,
+            compute_itemsize=jnp.dtype(compute_dtype).itemsize, n_mats=2)
+        if batch_tile is None:
+            raise ValueError(
+                f"no VMEM-fitting batch tile for shapes n={e.shape[1]} "
+                f"d={e.shape[2]} batch={batch.shape[0]}; use the autodiff path")
+    norms = jnp.clip(jnp.linalg.norm(dec, axis=-1, keepdims=True), 1e-8)
+    w_normed = dec / norms
+    losses, de, dw, db, activity = fused_untied_sae_grads(
+        e, w_normed, params_stacked["encoder_bias"], alphas, batch,
+        batch_tile=batch_tile, interpret=interpret, total_batch=total_batch,
+        compute_dtype=compute_dtype)
+    if psum_axis is not None:
+        losses, de, dw, db, activity = jax.lax.psum(
+            (losses, de, dw, db, activity), psum_axis)
+    bias = params_stacked["encoder_bias"]
+    # _safe_norm: sqrt(Σb² + eps²) — finite gradient at b = 0
+    safe = jnp.sqrt(jnp.sum(bias * bias, axis=-1) + 1e-8 ** 2)  # [N]
+    losses["bias_decay"] = bias_decays * safe
+    grads = {"encoder": de,
+             "encoder_bias": db + (bias_decays / safe)[:, None] * bias,
+             "decoder": normalize_with_vjp(dec, dw)}
     return losses, grads, activity

@@ -252,3 +252,148 @@ def test_kernel_lowers_for_tpu():
                     lambda w, b, a, x, cd=compute: fused_tied_sae_grads(
                         w, b, a, x, batch_tile=64, compute_dtype=cd)
                 ).trace(w, b, a, x).lower(lowering_platforms=("tpu",))
+
+
+# --- untied kernel -----------------------------------------------------------
+
+def _stacked_untied_members(key, bias_decay=0.0):
+    from sparse_coding_tpu.models.sae import FunctionalSAE
+
+    keys = jax.random.split(key, N_MEMBERS)
+    l1s = [1e-4, 1e-3, 3e-3]
+    members = [FunctionalSAE.init(k, D, N_FEATS, l1_alpha=l1,
+                                  bias_decay=bias_decay)
+               for k, l1 in zip(keys, l1s)]
+    params = stack_trees([p for p, _ in members])
+    return members, params, jnp.asarray(l1s)
+
+
+@pytest.mark.parametrize("bias_decay", [0.0, 0.03])
+def test_fused_untied_matches_autodiff(rng, bias_decay):
+    """Untied kernel (+ outside-the-kernel bias-decay term) reproduces
+    vmapped autodiff through FunctionalSAE.loss exactly — grads for encoder,
+    decoder (through the normalization VJP), and bias."""
+    from sparse_coding_tpu.models.sae import FunctionalSAE
+    from sparse_coding_tpu.ops.fused_sae import fused_untied_sae_loss_and_grads
+
+    k_init, k_data = jax.random.split(rng)
+    members, params, alphas = _stacked_untied_members(k_init, bias_decay)
+    bds = jnp.full((N_MEMBERS,), bias_decay)
+    batch = jax.random.normal(k_data, (BATCH, D))
+
+    losses, grads, activity = fused_untied_sae_loss_and_grads(
+        params, alphas, bds, batch, batch_tile=128, interpret=True)
+
+    buffers = stack_trees([b for _, b in members])
+    (ref_loss, ref_aux), ref_grads = jax.vmap(
+        jax.value_and_grad(FunctionalSAE.loss, has_aux=True),
+        in_axes=(0, 0, None))(params, buffers, batch)
+
+    total = losses["mse"] + losses["l1"] + losses["bias_decay"]
+    np.testing.assert_allclose(np.asarray(total), np.asarray(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(losses["bias_decay"]),
+        np.asarray(ref_aux.losses["l_bias_decay"]), rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(losses["l0"]),
+                               np.asarray(ref_aux.l0), rtol=1e-5)
+    for name in ("encoder", "encoder_bias", "decoder"):
+        np.testing.assert_allclose(np.asarray(grads[name]),
+                                   np.asarray(ref_grads[name]),
+                                   rtol=2e-4, atol=1e-6,
+                                   err_msg=f"grad mismatch: {name}")
+
+
+def test_fused_untied_training_matches_standard(rng):
+    """Whole untied fused runs track the autodiff path step-for-step,
+    including the l_bias_decay aux stream."""
+    from sparse_coding_tpu.models.sae import FunctionalSAE
+
+    k_init, k_data = jax.random.split(rng)
+    keys = jax.random.split(k_init, 2)
+    members = [FunctionalSAE.init(k, D, N_FEATS, l1_alpha=1e-3,
+                                  bias_decay=0.01) for k in keys]
+    batch = jax.random.normal(k_data, (512, D))
+
+    fused = Ensemble(members, FunctionalSAE, lr=1e-3, use_fused=True,
+                     fused_interpret=True, donate=False)
+    standard = Ensemble(members, FunctionalSAE, lr=1e-3, use_fused=False,
+                        donate=False)
+    for _ in range(5):
+        aux_f = fused.step_batch(batch)
+        aux_s = standard.step_batch(batch)
+    assert fused.fused and not standard.fused
+    for key in ("loss", "l_reconstruction", "l_l1", "l_bias_decay"):
+        np.testing.assert_allclose(np.asarray(aux_f.losses[key]),
+                                   np.asarray(aux_s.losses[key]),
+                                   rtol=1e-4, atol=1e-7, err_msg=key)
+    p_f = jax.device_get(fused.state.params)
+    p_s = jax.device_get(standard.state.params)
+    for name in p_f:
+        np.testing.assert_allclose(p_f[name], p_s[name], rtol=1e-4, atol=1e-6,
+                                   err_msg=f"param drift: {name}")
+
+
+def test_fused_untied_sharded_matches_standard(rng):
+    """Mesh-composed untied fused step with NONZERO bias decay: the psum over
+    "data" runs inside the wrapper BEFORE the batch-independent decay terms
+    are added, so they count exactly once (not mesh_data times)."""
+    from sparse_coding_tpu.models.sae import FunctionalSAE
+    from sparse_coding_tpu.parallel.mesh import make_mesh
+
+    k_init, k_data = jax.random.split(rng)
+    keys = jax.random.split(k_init, 4)
+    members = [FunctionalSAE.init(k, D, N_FEATS, l1_alpha=1e-3,
+                                  bias_decay=0.01) for k in keys]
+    batch = jax.random.normal(k_data, (512, D))
+
+    mesh = make_mesh(2, 4)
+    sharded = Ensemble(members, FunctionalSAE, lr=1e-3, use_fused=True,
+                       fused_interpret=True, mesh=mesh, donate=False)
+    standard = Ensemble(members, FunctionalSAE, lr=1e-3, use_fused=False,
+                        donate=False)
+    for _ in range(3):
+        aux_f = sharded.step_batch(batch)
+        aux_s = standard.step_batch(batch)
+    assert sharded.fused
+    for key in ("loss", "l_bias_decay"):
+        np.testing.assert_allclose(np.asarray(aux_f.losses[key]),
+                                   np.asarray(aux_s.losses[key]),
+                                   rtol=1e-4, atol=1e-7, err_msg=key)
+    p_f = jax.device_get(sharded.state.params)
+    p_s = jax.device_get(standard.state.params)
+    for name in p_f:
+        np.testing.assert_allclose(p_f[name], p_s[name], rtol=1e-4, atol=1e-6,
+                                   err_msg=f"param drift: {name}")
+
+
+def test_untied_tile_admission():
+    """Two resident weight matrices halve what fits: an untied (n_mats=2)
+    tile never exceeds the tied tile for the same shapes."""
+    from sparse_coding_tpu.ops.fused_sae import pick_batch_tile
+
+    for n_feats in (1024, 2048, 4096, 8192):
+        tied = pick_batch_tile(2048, n_feats, 512) or 0
+        untied = pick_batch_tile(2048, n_feats, 512, n_mats=2) or 0
+        assert untied <= tied
+    # bench shapes still admit a tile for the untied kernel
+    assert pick_batch_tile(2048, 2048, 512, n_mats=2) is not None
+
+
+def test_untied_kernel_lowers_for_tpu():
+    """AOT Mosaic lowering for the untied kernel at small and bench scale,
+    f32/bf16 streams x f32/bf16 compute."""
+    from sparse_coding_tpu.ops.fused_sae import fused_untied_sae_grads
+
+    shapes = [((2, 64, 32), (2, 64), (2,), (256, 32)),
+              ((32, 2048, 512), (32, 2048), (32,), (2048, 512))]
+    for x_dtype in (jnp.float32, jnp.bfloat16):
+        for compute in ("float32", "bfloat16"):
+            for ws, bs, as_, xs in shapes:
+                e, b, a = (jnp.zeros(s) for s in (ws, bs, as_))
+                w = jnp.zeros(ws)
+                x = jnp.zeros(xs, x_dtype)
+                jax.jit(
+                    lambda e, w, b, a, x, cd=compute: fused_untied_sae_grads(
+                        e, w, b, a, x, batch_tile=64, compute_dtype=cd)
+                ).trace(e, w, b, a, x).lower(lowering_platforms=("tpu",))
