@@ -143,6 +143,7 @@ def bench_chunk_io(quick: bool) -> None:
         w.finalize()
         store = ChunkStore(td)
         file_bytes = store.chunk_paths[0].stat().st_size
+        store.load_chunk(0)  # warm lazy imports (torch cast bridge) + cache
         t0 = time.perf_counter()
         store.load_chunk(0)
         dt = time.perf_counter() - t0

@@ -144,10 +144,16 @@ class ChunkStore:
         return len(self.chunk_paths)
 
     def load_chunk(self, i: int, dtype=np.float32) -> np.ndarray:
-        from sparse_coding_tpu.data.native_io import read_npy_native
+        from sparse_coding_tpu.data.native_io import (
+            DEFAULT_THREADS,
+            read_npy_native,
+        )
 
-        raw = read_npy_native(self.chunk_paths[i])
-        if raw is None:  # no compiler / native lib: plain numpy IO
+        # foreground reads: threaded pread only beats np.load with real
+        # cores to spread over — the native layer's 1-CPU value is the
+        # BACKGROUND overlap in chunk_reader, not raw read speed
+        raw = read_npy_native(self.chunk_paths[i]) if DEFAULT_THREADS > 1 else None
+        if raw is None:  # no compiler / native lib / single-CPU host
             raw = np.load(self.chunk_paths[i])
         return self._finish_raw(raw, dtype, self.chunk_paths[i])
 
@@ -193,7 +199,9 @@ class ChunkStore:
                     "meta.json is missing or lacks dtype=bfloat16 — likely an "
                     "interrupted harvest; re-run it or write meta.json by hand")
             raw = raw.view(jnp.bfloat16)
-        return raw.astype(dtype)
+        from sparse_coding_tpu.data.native_io import fast_astype
+
+        return fast_astype(raw, dtype)
 
     def chunk_reader(self, indices, dtype=np.float32) -> Iterator[np.ndarray]:
         """Yield in-RAM chunks for the given index sequence with disk

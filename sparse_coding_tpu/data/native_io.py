@@ -8,6 +8,7 @@ available, so the native layer is a pure acceleration, never a dependency.
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 import threading
 from pathlib import Path
@@ -21,7 +22,40 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _lib_failed = False
 
-DEFAULT_THREADS = 8
+# threaded pread only pays with real cores; on a 1-CPU host the slices just
+# contend (measured 170 MB/s vs np.load's 1.4 GB/s warm-cache on this image).
+# sched_getaffinity respects cgroup/taskset pinning where cpu_count() reports
+# all host cores.
+def _usable_cpus() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # non-Linux
+        return os.cpu_count() or 1
+
+
+DEFAULT_THREADS = max(1, min(8, _usable_cpus()))
+
+
+def fast_astype(raw: np.ndarray, dtype) -> np.ndarray:
+    """Chunk-dtype conversion for the load path. numpy's half/bfloat16 →
+    float32 converters are SCALAR loops (~140 MB/s measured here — slower
+    than the disk read they follow); torch's are vectorized (~460 MB/s on
+    the same single core), so the hot f16/bf16 → f32 conversions route
+    through the CPU torch bridge when torch is importable. Semantics are
+    identical to raw.astype(dtype) (widening casts are exact)."""
+    dtype = np.dtype(dtype)
+    if dtype != np.float32 or raw.dtype == np.float32:
+        return raw.astype(dtype)
+    try:
+        import torch
+    except ImportError:
+        return raw.astype(dtype)
+    if raw.dtype == np.float16:
+        return torch.from_numpy(raw).to(torch.float32).numpy()
+    if raw.dtype.itemsize == 2 and raw.dtype.name == "bfloat16":
+        t = torch.from_numpy(raw.view(np.int16)).view(torch.bfloat16)
+        return t.to(torch.float32).numpy()
+    return raw.astype(dtype)
 
 
 def _build() -> bool:

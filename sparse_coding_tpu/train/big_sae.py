@@ -91,6 +91,29 @@ def _sae_loss(params: dict, batch: Array, l1_alpha: Array, tied: bool):
     return mse + sparsity, (mse, sparsity, c, mse_losses)
 
 
+# auto-mode threshold for the flash kernels: per-device [local_b, local_n]
+# codes bytes the autodiff path would have to materialize before auto
+# switches to the never-materialize kernels (v5e HBM is 16 GiB; XLA's 2-3
+# resident copies of a >=2 GiB codes block start crowding out params/opt
+# state and activation slabs)
+FUSED_AUTO_CODES_BYTES = 2 * 2**30
+
+
+def fused_auto_choice(use_fused, fused_possible: bool,
+                      local_b: int, local_n: int,
+                      codes_itemsize: int = 4) -> bool:
+    """The fused-vs-autodiff decision given admissibility: explicit True
+    always takes the kernels, explicit False never does; auto takes them
+    only when the per-device codes block autodiff would materialize
+    (local_b × local_n × codes_itemsize — pass the promoted batch/params
+    itemsize for bf16 SAEs) crosses FUSED_AUTO_CODES_BYTES (they run at
+    measured parity below it — BENCH_SUITE_TPU.json)."""
+    if use_fused is False or not fused_possible:
+        return False
+    return (use_fused is True
+            or local_b * local_n * codes_itemsize >= FUSED_AUTO_CODES_BYTES)
+
+
 def make_big_sae_step(optimizer: optax.GradientTransformation,
                       l1_alpha: Array, mesh: Optional[Mesh] = None,
                       use_fused: str | bool = "auto",
@@ -135,18 +158,30 @@ def make_big_sae_step(optimizer: optax.GradientTransformation,
         # same derivation the kernel's own tile pick uses, so the gate and
         # the inner admission can never disagree
         compute_itemsize = jnp.dtype(fused_compute_dtype).itemsize
-        fused_ok = (fused_wanted and divisible
-                    and (fused_interpret or jax.default_backend() == "tpu")
-                    and pick_big_sae_tiles(
-                        local_b, local_n, d,
-                        compute_itemsize=compute_itemsize) is not None)
-        if use_fused is True and not fused_ok:
+        fused_possible = (fused_wanted and divisible
+                          and (fused_interpret
+                               or jax.default_backend() == "tpu")
+                          and pick_big_sae_tiles(
+                              local_b, local_n, d,
+                              compute_itemsize=compute_itemsize) is not None)
+        if use_fused is True and not fused_possible:
             raise ValueError(
                 f"use_fused=True but the fused big-SAE step is unavailable "
                 f"(backend={jax.default_backend()}, per-device "
                 f"batch={local_b}, n={local_n}, d={d} — shapes must divide "
                 "the mesh axes and d must be a multiple of 128 with "
                 "VMEM-fitting tiles)")
+        # auto mode gates on HBM CAPACITY, not bandwidth: measured on a v5e
+        # (BENCH_SUITE_TPU.json) XLA autodiff and the flash kernels run at
+        # parity (~0.67 MFU) while the codes matrix fits — XLA overlaps its
+        # HBM round trips well — so the kernels' win is enabling per-device
+        # codes blocks autodiff could not even allocate. Below the threshold
+        # auto keeps the (marginally faster, simpler) autodiff path;
+        # use_fused=True still forces the kernels at any scale.
+        codes_itemsize = jnp.promote_types(
+            batch.dtype, state.params["dict"].dtype).itemsize
+        fused_ok = fused_auto_choice(use_fused, fused_possible,
+                                     local_b, local_n, codes_itemsize)
         if fused_ok:
             fused_fn = (functools.partial(_sharded_fused_loss_and_grads,
                                           mesh=mesh)

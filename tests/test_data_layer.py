@@ -228,3 +228,22 @@ def test_token_dataset_roundtrip(tmp_path):
     np.testing.assert_array_equal(load_token_dataset(tmp_path / "toks.npy"),
                                   rows)
     assert (tmp_path / "toks.meta.json").exists()
+
+
+def test_fast_astype_matches_numpy():
+    """The torch-bridged f16/bf16 -> f32 conversions are bit-identical to
+    numpy's (widening casts are exact in both), for every dtype the chunk
+    store writes."""
+    import jax.numpy as jnp
+
+    from sparse_coding_tpu.data.native_io import fast_astype
+
+    rng = np.random.default_rng(0)
+    base = rng.standard_normal((257, 64)).astype(np.float32)
+    for raw in (base.astype(np.float16), base.astype(jnp.bfloat16), base):
+        out = fast_astype(raw, np.float32)
+        np.testing.assert_array_equal(out, raw.astype(np.float32))
+        assert out.dtype == np.float32
+    # non-f32 targets fall through to plain astype semantics
+    out16 = fast_astype(base, np.float16)
+    np.testing.assert_array_equal(out16, base.astype(np.float16))

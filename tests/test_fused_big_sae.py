@@ -214,3 +214,28 @@ def test_big_sae_kernels_lower_for_tpu():
                     p, a, x, r, bt, ft, compute_dtype=cd)
             ).trace(params, jnp.zeros(()), xc, xc).lower(
                 lowering_platforms=("tpu",))
+
+
+def test_fused_auto_capacity_gate():
+    """auto routes to the kernels only past the HBM-capacity threshold
+    (measured parity below it); explicit True forces them at any scale."""
+    from sparse_coding_tpu.train.big_sae import (
+        FUSED_AUTO_CODES_BYTES,
+        fused_auto_choice,
+    )
+
+    # reference DDP scale: 16384 x 16384 codes = 1 GiB < threshold -> autodiff
+    assert not fused_auto_choice("auto", True, 16384, 16384)
+    # 4x the batch crosses 2 GiB -> kernels
+    assert 65536 * 16384 * 4 >= FUSED_AUTO_CODES_BYTES
+    assert fused_auto_choice("auto", True, 65536, 16384)
+    # explicit True forces the kernels at tiny scale; inadmissible never runs
+    assert fused_auto_choice(True, True, 64, 128)
+    assert not fused_auto_choice(True, False, 65536, 16384)
+    assert not fused_auto_choice("auto", False, 65536, 16384)
+    # explicit False never takes the kernels, whatever the scale
+    assert not fused_auto_choice(False, True, 65536, 16384)
+    # bf16 codes are half the bytes: an element count whose f32 block
+    # crosses the threshold stays autodiff at itemsize 2
+    assert fused_auto_choice("auto", True, 49152, 16384, codes_itemsize=4)
+    assert not fused_auto_choice("auto", True, 49152, 16384, codes_itemsize=2)
