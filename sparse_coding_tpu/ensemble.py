@@ -91,11 +91,9 @@ def adam_optimizer(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> opt
     return optax.scale_by_adam(b1=b1, b2=b2, eps=eps, eps_root=0.0)
 
 
-def _apply_fused_updates(optimizer, losses, grads, activity,
-                         params, opt_state, lrs):
-    """Shared tail of the fused steps: vmapped per-member Adam update from
-    kernel-produced grads + AuxData assembly (loss fields match the autodiff
-    path, locked by tests/test_torch_loss_parity.py). An optional
+def _fused_aux(losses: dict, activity: Array) -> AuxData:
+    """AuxData assembly shared by every fused path (loss fields match the
+    autodiff path, locked by tests/test_torch_loss_parity.py). An optional
     "bias_decay" loss entry (untied family) is folded into the total and
     reported under the autodiff path's "l_bias_decay" key."""
     total = losses["mse"] + losses["l1"]
@@ -103,6 +101,16 @@ def _apply_fused_updates(optimizer, losses, grads, activity,
     if "bias_decay" in losses:
         total = total + losses["bias_decay"]
         loss_fields["l_bias_decay"] = losses["bias_decay"]
+    return AuxData(
+        losses={"loss": total, **loss_fields},
+        l0=losses["l0"],
+        feat_activity=activity.astype(jnp.int32))
+
+
+def _apply_fused_updates(optimizer, losses, grads, activity,
+                         params, opt_state, lrs):
+    """Shared tail of the two-stage fused steps: vmapped per-member Adam
+    update from kernel-produced grads + shared AuxData assembly."""
 
     def member_update(g, opt_state, params, lr):
         updates, opt_state = optimizer.update(g, opt_state, params)
@@ -110,11 +118,7 @@ def _apply_fused_updates(optimizer, losses, grads, activity,
         return optax.apply_updates(params, updates), opt_state
 
     params, opt_state = jax.vmap(member_update)(grads, opt_state, params, lrs)
-    aux = AuxData(
-        losses={"loss": total, **loss_fields},
-        l0=losses["l0"],
-        feat_activity=activity.astype(jnp.int32))
-    return params, opt_state, aux
+    return params, opt_state, _fused_aux(losses, activity)
 
 
 def _tied_producer(batch_tile, interpret, compute_dtype):
@@ -206,6 +210,54 @@ def make_fused_step_sharded(
             check_vma=False)
         params, opt_state, aux = sharded(
             state.params, state.buffers, state.opt_state, state.lrs, batch)
+        new_state = state.replace(params=params, opt_state=opt_state,
+                                  step=state.step + 1)
+        return new_state, aux
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_fullfused_tied_step(
+    adam_hypers: tuple[float, float, float],
+    donate: bool = True,
+    interpret: bool = False,
+    batch_tile: Optional[int] = None,
+    compute_dtype: str = "float32",
+) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
+    """Single-device tied-SAE step where the WHOLE step — normalization,
+    loss, exact grads, normalization VJP, and the optax-Adam update — runs in
+    one Pallas pass (ops/fused_sae.fused_tied_sae_train_step). No XLA
+    prologue/epilogue remains; optimizer-state DMA hides under the kernel's
+    MXU time. Bias corrections are precomputed here exactly as optax's
+    scale_by_adam does, so this step is numerically the two-stage path."""
+    from sparse_coding_tpu.ops.fused_sae import (
+        fused_tied_sae_train_step, pick_train_step_tile, prepare_kernel_batch)
+
+    b1, b2, eps = adam_hypers
+
+    def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
+        batch, tile = prepare_kernel_batch(
+            batch, state.params["encoder"].shape[1],
+            state.params["encoder"].shape[2], batch_tile, compute_dtype,
+            picker=pick_train_step_tile)
+        opt = state.opt_state
+        count_inc = optax.safe_increment(opt.count)
+        bc1 = 1.0 - b1 ** count_inc
+        bc2 = 1.0 - b2 ** count_inc
+        losses, e2, bias2, mu_e, nu_e, mu_b, nu_b, activity = (
+            fused_tied_sae_train_step(
+                state.params["encoder"], state.params["encoder_bias"],
+                opt.mu["encoder"], opt.nu["encoder"],
+                opt.mu["encoder_bias"], opt.nu["encoder_bias"],
+                state.buffers["l1_alpha"], state.lrs, bc1, bc2, batch,
+                batch_tile=tile, interpret=interpret,
+                compute_dtype=compute_dtype, b1=b1, b2=b2, eps=eps))
+        params = {"encoder": e2, "encoder_bias": bias2}
+        opt_state = opt._replace(
+            count=count_inc,
+            mu={"encoder": mu_e, "encoder_bias": mu_b},
+            nu={"encoder": nu_e, "encoder_bias": nu_b})
+        aux = _fused_aux(losses, activity)
         new_state = state.replace(params=params, opt_state=opt_state,
                                   step=state.step + 1)
         return new_state, aux
@@ -336,6 +388,7 @@ class Ensemble:
         self.sig = sig
         self.sig_name = getattr(sig, "signature_name", sig.__name__)
         self.optimizer = adam_optimizer(adam_b1, adam_b2, adam_eps)
+        self._adam_hypers = (adam_b1, adam_b2, adam_eps)
         self.mesh = mesh
 
         split = [split_buffers(b) for _, b in members]
@@ -386,6 +439,7 @@ class Ensemble:
                 "use_fused=True requires a TPU backend (or "
                 "fused_interpret=True) and either an identity-centered "
                 "tied_sae bucket with zero bias_decay or a plain sae bucket")
+        self._fullfused_step = None
         if builders is not None and (use_fused is True or use_fused == "auto"):
             make_single, make_sharded = builders
             self._fused_step = (
@@ -398,10 +452,22 @@ class Ensemble:
                             interpret=fused_interpret,
                             batch_tile=fused_batch_tile,
                             compute_dtype=fused_compute_dtype))
+            if mesh is None and make_single is make_fused_tied_step:
+                # tied family, single device: the whole-step kernel (grads +
+                # normalization VJP + Adam in one Pallas pass) replaces the
+                # two-stage path whenever its (larger) working set admits a
+                # tile — resolved per batch in _resolve_step
+                self._fullfused_step = make_fullfused_tied_step(
+                    self._adam_hypers, donate=donate,
+                    interpret=fused_interpret, batch_tile=fused_batch_tile,
+                    compute_dtype=fused_compute_dtype)
         # the fused kernel additionally needs a VMEM-fitting batch tile — only
         # known once the real batch arrives, so the final choice happens on
-        # the first step_batch call (and is re-checked per batch size)
+        # the first step_batch call (and is re-checked per batch size).
+        # fused_path records WHICH fused kernel actually resolved
+        # ("train_step" | "two_stage" | None) for bench/tune labeling.
         self.fused = self._fused_step is not None
+        self.fused_path = None
         self._fused_explicit = use_fused is True
         self._fused_batch_tile = fused_batch_tile
         # same derivation fused_tied_sae_loss_and_grads uses for its own
@@ -429,7 +495,8 @@ class Ensemble:
         if (self._fused_step is None
                 or (batch_size, batch_itemsize) == self._resolved_batch):
             return
-        from sparse_coding_tpu.ops.fused_sae import pick_batch_tile, tile_fits
+        from sparse_coding_tpu.ops.fused_sae import (
+            pick_batch_tile, pick_train_step_tile, tile_fits, train_tile_fits)
 
         n_feats = self.state.params["encoder"].shape[1]
         d = self.state.params["encoder"].shape[2]
@@ -446,9 +513,24 @@ class Ensemble:
                     pick_batch_tile(local, n_feats, d,
                                     batch_itemsize=batch_itemsize,
                                     compute_itemsize=ci, n_mats=nm) is not None)
-        if workable:
+        # the whole-step kernel carries the Adam state through VMEM too, so
+        # its admission is separate (larger working set); when it fits it
+        # wins, else the two-stage fused path, else autodiff
+        workable_full = self._fullfused_step is not None and (
+            train_tile_fits(local, self._fused_batch_tile, n_feats, d,
+                            batch_itemsize, compute_itemsize=ci, n_mats=nm)
+            if self._fused_batch_tile is not None else
+            pick_train_step_tile(local, n_feats, d,
+                                 batch_itemsize=batch_itemsize,
+                                 compute_itemsize=ci, n_mats=nm) is not None)
+        if workable_full:
+            self._step_fn = self._fullfused_step
+            self.fused = True
+            self.fused_path = "train_step"
+        elif workable:
             self._step_fn = self._fused_step
             self.fused = True
+            self.fused_path = "two_stage"
         elif self._fused_explicit:
             raise ValueError(
                 f"use_fused=True but no VMEM-fitting batch tile exists for "
@@ -457,6 +539,7 @@ class Ensemble:
         else:
             self._step_fn = self._standard_step
             self.fused = False  # auto mode: quietly keep autodiff
+            self.fused_path = None
         if self._step_fn is not prev_fn:
             self._scan_fn = None
         self._resolved_batch = (batch_size, batch_itemsize)

@@ -129,25 +129,21 @@ def kernel_batch_itemsize(dtype) -> int:
     return 2 if dtype == jnp.bfloat16 else 4
 
 
-def _kernel(alpha_ref, x_ref, w_ref, b_ref, dw_ref, db_ref, act_ref, loss_ref,
-            *, total_batch: int, d_act: int, compute_dtype):
-    import jax.experimental.pallas as pl
+def _tied_tile_grads(x_in, w, b, alpha, *, total_batch: int, d_act: int,
+                     compute_dtype):
+    """The torch-parity-locked per-tile math of the tied-SAE kernels (loss
+    partials + exact grads for one batch tile) — single copy shared by the
+    two-stage kernel and the whole-step train kernel.
 
-    m = pl.program_id(0)
-    i = pl.program_id(1)
-    # compute_dtype=bf16 runs every dot on the MXU's native bf16 path
-    # (~2x f32 throughput) with f32 accumulation — the in-kernel analogue
-    # of jax.default_matmul_precision("bfloat16"), which does NOT reach
-    # Pallas dots. Elementwise math and accumulators stay f32.
-    w = w_ref[0].astype(compute_dtype)  # [n, d]
-    # a bf16 activation stream rides HBM→VMEM half-width and is cast up
-    # HERE (exact, f32 ⊃ bf16): the f32 copy never exists outside VMEM
-    x_in = x_ref[...]  # [Bt, d]
+    compute_dtype=bf16 runs every dot on the MXU's native bf16 path
+    (~2x f32 throughput) with f32 accumulation — the in-kernel analogue
+    of jax.default_matmul_precision("bfloat16"), which does NOT reach
+    Pallas dots. Elementwise math and accumulators stay f32. A bf16
+    activation stream rides HBM→VMEM half-width and is cast up HERE
+    (exact, f32 ⊃ bf16): the f32 copy never exists outside VMEM; bf16
+    stream + bf16 compute reuses the input tile as the dot operand."""
     xb = x_in.astype(jnp.float32)
-    # bf16 stream + bf16 compute reuses the input tile as the dot operand
     xc = x_in if x_in.dtype == compute_dtype else xb.astype(compute_dtype)
-    b = b_ref[0, 0]  # [n]  (operand carried as [N, 1, n] for Mosaic tiling)
-    alpha = alpha_ref[m]  # scalar-prefetched [N] array in SMEM
 
     pre = jnp.dot(xc, w.T, preferred_element_type=jnp.float32) + b[None, :]
     c = jnp.maximum(pre, 0.0)
@@ -170,6 +166,19 @@ def _kernel(alpha_ref, x_ref, w_ref, b_ref, dw_ref, db_ref, act_ref, loss_ref,
     l1_part = alpha * jnp.sum(c) / total_batch
     l0_part = jnp.sum(mask) / total_batch
     part = jnp.stack([mse_part, l1_part, l0_part])[None, None, :]
+    return dw, db, activity, part
+
+
+def _kernel(alpha_ref, x_ref, w_ref, b_ref, dw_ref, db_ref, act_ref, loss_ref,
+            *, total_batch: int, d_act: int, compute_dtype):
+    import jax.experimental.pallas as pl
+
+    m = pl.program_id(0)
+    i = pl.program_id(1)
+    dw, db, activity, part = _tied_tile_grads(
+        x_ref[...], w_ref[0].astype(compute_dtype), b_ref[0, 0],
+        alpha_ref[m], total_batch=total_batch, d_act=d_act,
+        compute_dtype=compute_dtype)
 
     @pl.when(i == 0)
     def _init():
@@ -274,6 +283,29 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
     return loss_dict, dw, db, activity
 
 
+def prepare_kernel_batch(batch: Array, n_feats: int, d: int,
+                         batch_tile: Optional[int], compute_dtype: str,
+                         n_mats: int = 1, picker=None) -> tuple[Array, int]:
+    """Shared entry contract for every fused-kernel wrapper: bf16 batches
+    pass through half-width (cast up per-tile in VMEM), anything else is cast
+    to f32; then the batch tile is picked by `picker` (pick_batch_tile for
+    the two-stage kernels, pick_train_step_tile for the whole-step kernel)
+    unless the caller forced one. One copy of the cast rule so the admission
+    checks and the kernels can never disagree."""
+    if batch.dtype != jnp.bfloat16:
+        batch = batch.astype(jnp.float32)
+    if batch_tile is None:
+        batch_tile = (picker or pick_batch_tile)(
+            batch.shape[0], n_feats, d,
+            batch_itemsize=batch.dtype.itemsize,
+            compute_itemsize=jnp.dtype(compute_dtype).itemsize, n_mats=n_mats)
+        if batch_tile is None:
+            raise ValueError(
+                f"no VMEM-fitting batch tile for shapes n={n_feats} "
+                f"d={d} batch={batch.shape[0]}; use the autodiff path")
+    return batch, batch_tile
+
+
 def normalize_with_vjp(e: Array, dw: Array, eps: float = 1e-8):
     """Chain dL/dW (W = row-normalized E) back to dL/dE:
     dE = (dW − Ŵ·⟨dW, Ŵ⟩_row) / ‖E‖. Cheap [N, n, d] elementwise+reduce,
@@ -298,21 +330,8 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
     psum_axis: reduce the per-shard partial sums over this mesh axis inside
     the wrapper (shard_map callers — same convention as the untied family)."""
     e = params_stacked["encoder"]
-    # bf16 batches enter the kernel AS bf16 (cast up per-tile in VMEM):
-    # the x HBM read is half-width and no device-wide f32 copy of the batch
-    # is ever materialized. Anything else (f16/f64/int) is cast to f32 —
-    # bf16 is the only sub-f32 dtype the MXU path wants.
-    if batch.dtype != jnp.bfloat16:
-        batch = batch.astype(jnp.float32)
-    if batch_tile is None:
-        batch_tile = pick_batch_tile(
-            batch.shape[0], e.shape[1], e.shape[2],
-            batch_itemsize=batch.dtype.itemsize,
-            compute_itemsize=jnp.dtype(compute_dtype).itemsize)
-        if batch_tile is None:
-            raise ValueError(
-                f"no VMEM-fitting batch tile for shapes n={e.shape[1]} "
-                f"d={e.shape[2]} batch={batch.shape[0]}; use the autodiff path")
+    batch, batch_tile = prepare_kernel_batch(
+        batch, e.shape[1], e.shape[2], batch_tile, compute_dtype)
     norms = jnp.clip(jnp.linalg.norm(e, axis=-1, keepdims=True), 1e-8)
     w_normed = e / norms
     losses, dw, db, activity = fused_tied_sae_grads(
@@ -327,6 +346,230 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
     grads = {"encoder": normalize_with_vjp(e, dw),
              "encoder_bias": db}
     return losses, grads, activity
+
+
+# --- fully-fused train-step kernel (tied family) -----------------------------
+#
+# The two-stage fused path still leaves ~1/3 of the step to XLA: normalizing E
+# (read 134 MB + write 134 MB at bench scale), the dW HBM round trip, and the
+# Adam + normalization-VJP epilogue (~940 MB of f32 state traffic). This
+# kernel runs the ENTIRE training step per member in one Pallas pass:
+#   i == 0:       normalize the resident E block into VMEM scratch
+#   every tile:   loss + grads, dW accumulated in scratch (never HBM)
+#   i == last:    chain dW through the normalization VJP, then apply the
+#                 exact optax scale_by_adam update (bias corrections
+#                 prefetched) to E and b — moments stream through member-
+#                 indexed blocks whose DMA hides under the MXU time of the
+#                 NEXT member's tiles.
+# HBM per step: x once, params+moments read+written once. No XLA prologue or
+# epilogue remains. Single-device only: under shard_map the data-axis psum
+# must happen between grads and Adam, so sharded meshes keep the two-stage
+# path (ensemble.make_fused_step_sharded).
+
+
+def _train_working_set(batch_tile: int, n_feats: int, d: int,
+                       batch_itemsize: int = 4, compute_itemsize: int = 4,
+                       n_mats: int = 1) -> int:
+    """VMEM model for the train-step kernel: the two-stage model plus the
+    moment in/out blocks and the wn/dW scratch, minus the dW output block."""
+    f32 = 4
+    cast_copy = f32 if batch_itemsize < f32 else 0
+    extra = 0
+    if compute_itemsize < f32:
+        extra = (n_feats * d * compute_itemsize * n_mats
+                 + batch_tile * d * compute_itemsize
+                 + batch_tile * n_feats * compute_itemsize * 2
+                 + (0 if batch_itemsize == compute_itemsize
+                    else batch_tile * d * compute_itemsize))
+    big = n_feats * d * f32
+    in_blocks = (3 * n_mats * big              # params + 2 moments per matrix
+                 + batch_tile * d * batch_itemsize
+                 + n_feats * f32 * 3)          # b, mu_b, nu_b
+    out_blocks = (3 * n_mats * big             # updated params + moments
+                  + n_feats * f32 * 5)         # b', mu_b', nu_b', act, losses
+    scratch = (1 + n_mats) * big + n_feats * f32  # wn + grad accum(s) + db
+    interm = (batch_tile * n_feats * f32 * 2
+              + batch_tile * d * (cast_copy + 2 * f32)
+              + extra)
+    return _DB * (in_blocks + out_blocks) + scratch + interm
+
+
+def pick_train_step_tile(batch: int, n_feats: int, d: int,
+                         batch_itemsize: int = 4, compute_itemsize: int = 4,
+                         n_mats: int = 1) -> Optional[int]:
+    for tile in PREFERRED_TILES:
+        if batch % tile == 0 and _train_working_set(
+                tile, n_feats, d, batch_itemsize,
+                compute_itemsize, n_mats) <= VMEM_BUDGET_BYTES:
+            return tile
+    return None
+
+
+def train_tile_fits(batch: int, tile: int, n_feats: int, d: int,
+                    batch_itemsize: int = 4, compute_itemsize: int = 4,
+                    n_mats: int = 1) -> bool:
+    return (batch % tile == 0
+            and _train_working_set(tile, n_feats, d, batch_itemsize,
+                                   compute_itemsize, n_mats)
+            <= VMEM_BUDGET_BYTES)
+
+
+def _tied_train_kernel(alpha_ref, lr_ref, bc1_ref, bc2_ref,
+                       x_ref, e_ref, b_ref, mu_ref, nu_ref, mub_ref, nub_ref,
+                       e_out, b_out, mu_out, nu_out, mub_out, nub_out,
+                       act_ref, loss_ref,
+                       wn_s, dw_s, db_s,
+                       *, total_batch: int, d_act: int, compute_dtype,
+                       n_tiles: int, b1: float, b2: float, eps: float):
+    import jax.experimental.pallas as pl
+
+    m = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _norm():
+        e = e_ref[0]
+        norms = jnp.sqrt(jnp.sum(e * e, axis=-1, keepdims=True))
+        wn_s[...] = e / jnp.clip(norms, 1e-8)
+
+    dw, db_row, activity, part = _tied_tile_grads(
+        x_ref[...], wn_s[...].astype(compute_dtype), b_ref[0, 0],
+        alpha_ref[m], total_batch=total_batch, d_act=d_act,
+        compute_dtype=compute_dtype)
+    db = db_row[None, :]
+
+    @pl.when(i == 0)
+    def _init():
+        dw_s[...] = dw
+        db_s[...] = db
+        act_ref[0, 0] = activity
+        loss_ref[...] = part
+
+    @pl.when(i > 0)
+    def _acc():
+        dw_s[...] += dw
+        db_s[...] += db
+        act_ref[0, 0] += activity
+        loss_ref[...] += part
+
+    @pl.when(i == n_tiles - 1)
+    def _update():
+        # normalization VJP: dE = (dW − Ŵ·⟨dW, Ŵ⟩_row)/‖E‖ — Ŵ is the wn
+        # scratch, ‖E‖ recomputed from the still-resident E block
+        e = e_ref[0]
+        w_hat = wn_s[...]
+        norms = jnp.clip(jnp.sqrt(jnp.sum(e * e, axis=-1, keepdims=True)),
+                         1e-8)
+        dw_acc = dw_s[...]
+        radial = jnp.sum(dw_acc * w_hat, axis=-1, keepdims=True)
+        de = (dw_acc - w_hat * radial) / norms
+        # exact optax scale_by_adam (eps_root=0) + engine lr application
+        lr = lr_ref[m]
+        bc1 = bc1_ref[m]
+        bc2 = bc2_ref[m]
+        mu = b1 * mu_ref[0] + (1.0 - b1) * de
+        nu = b2 * nu_ref[0] + (1.0 - b2) * de * de
+        mu_out[0] = mu
+        nu_out[0] = nu
+        e_out[0] = e - lr * (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
+        db_acc = db_s[...][0]
+        mub = b1 * mub_ref[0, 0] + (1.0 - b1) * db_acc
+        nub = b2 * nub_ref[0, 0] + (1.0 - b2) * db_acc * db_acc
+        mub_out[0, 0] = mub
+        nub_out[0, 0] = nub
+        b_out[0, 0] = (b_ref[0, 0]
+                       - lr * (mub / bc1) / (jnp.sqrt(nub / bc2) + eps))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("batch_tile", "interpret", "compute_dtype",
+                                    "b1", "b2", "eps"))
+def fused_tied_sae_train_step(encoder: Array, bias: Array,
+                              mu_e: Array, nu_e: Array,
+                              mu_b: Array, nu_b: Array,
+                              alphas: Array, lrs: Array,
+                              bc1: Array, bc2: Array, batch: Array,
+                              batch_tile: int = 256, interpret: bool = False,
+                              compute_dtype: str = "float32",
+                              b1: float = 0.9, b2: float = 0.999,
+                              eps: float = 1e-8):
+    """One COMPLETE tied-SAE ensemble training step in a single Pallas pass:
+    losses + exact grads + normalization VJP + per-member Adam update.
+
+    Args:
+      encoder: [N, n, d] RAW (unnormalized) dictionaries; bias [N, n];
+      mu_e/nu_e/mu_b/nu_b: optax scale_by_adam moments for encoder and bias;
+      alphas/lrs: [N] per-member l1 coefficient and learning rate;
+      bc1/bc2: [N] bias corrections 1−β^count_inc, precomputed by the caller
+        from the optimizer count so the in-kernel math is exactly optax's.
+    Returns:
+      (losses {mse, l1, l0} [N], new_encoder, new_bias, new_mu_e, new_nu_e,
+       new_mu_b, new_nu_b, activity [N, n])
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_members, n_feats, d = encoder.shape
+    total_batch = batch.shape[0]
+    n_tiles = total_batch // batch_tile
+    assert n_tiles * batch_tile == total_batch
+
+    kernel = functools.partial(
+        _tied_train_kernel, total_batch=total_batch, d_act=d,
+        compute_dtype=jnp.dtype(compute_dtype), n_tiles=n_tiles,
+        b1=b1, b2=b2, eps=eps)
+
+    big = pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0))
+    vec = pl.BlockSpec((1, 1, n_feats), lambda m, i, *_: (m, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(n_members, n_tiles),
+        in_specs=[
+            pl.BlockSpec((batch_tile, d), lambda m, i, *_: (i, 0)),  # x
+            big, vec,            # E, b
+            big, big, vec, vec,  # mu_e, nu_e, mu_b, nu_b
+        ],
+        out_specs=[
+            big, vec,            # E', b'
+            big, big, vec, vec,  # mu', nu', mu_b', nu_b'
+            vec,                                              # activity
+            pl.BlockSpec((1, 1, 3), lambda m, i, *_: (m, 0, 0)),  # losses
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n_feats, d), jnp.float32),  # wn
+            pltpu.VMEM((n_feats, d), jnp.float32),  # dW accumulator
+            pltpu.VMEM((1, n_feats), jnp.float32),  # db accumulator
+        ],
+    )
+    compiler_params = (None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary"),
+        vmem_limit_bytes=VMEM_LIMIT_BYTES))
+
+    vec3 = lambda a: a.reshape(n_members, 1, n_feats)
+    e2, b2_, mu2, nu2, mub2, nub2, act, losses = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((n_members, n_feats, d), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, 1, n_feats), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, n_feats, d), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, n_feats, d), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, 1, n_feats), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, 1, n_feats), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, 1, n_feats), jnp.float32),
+            jax.ShapeDtypeStruct((n_members, 1, 3), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=compiler_params,
+    )(alphas.astype(jnp.float32), lrs.astype(jnp.float32),
+      bc1.astype(jnp.float32), bc2.astype(jnp.float32),
+      batch, encoder, vec3(bias), mu_e, nu_e, vec3(mu_b), vec3(nu_b))
+
+    losses = losses.reshape(n_members, 3)
+    loss_dict = {"mse": losses[:, 0], "l1": losses[:, 1], "l0": losses[:, 2]}
+    unvec = lambda a: a.reshape(n_members, n_feats)
+    return (loss_dict, e2, unvec(b2_), mu2, nu2, unvec(mub2), unvec(nub2),
+            unvec(act))
 
 
 # --- untied kernel -----------------------------------------------------------
@@ -481,17 +724,8 @@ def fused_untied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
     result at the call site."""
     e = params_stacked["encoder"]
     dec = params_stacked["decoder"]
-    if batch.dtype != jnp.bfloat16:
-        batch = batch.astype(jnp.float32)
-    if batch_tile is None:
-        batch_tile = pick_batch_tile(
-            batch.shape[0], e.shape[1], e.shape[2],
-            batch_itemsize=batch.dtype.itemsize,
-            compute_itemsize=jnp.dtype(compute_dtype).itemsize, n_mats=2)
-        if batch_tile is None:
-            raise ValueError(
-                f"no VMEM-fitting batch tile for shapes n={e.shape[1]} "
-                f"d={e.shape[2]} batch={batch.shape[0]}; use the autodiff path")
+    batch, batch_tile = prepare_kernel_batch(
+        batch, e.shape[1], e.shape[2], batch_tile, compute_dtype, n_mats=2)
     norms = jnp.clip(jnp.linalg.norm(dec, axis=-1, keepdims=True), 1e-8)
     w_normed = dec / norms
     losses, de, dw, db, activity = fused_untied_sae_grads(

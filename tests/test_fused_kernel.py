@@ -254,6 +254,134 @@ def test_kernel_lowers_for_tpu():
                 ).trace(w, b, a, x).lower(lowering_platforms=("tpu",))
 
 
+# --- fully-fused train-step kernel -------------------------------------------
+
+def test_train_step_kernel_matches_two_stage_and_autodiff(rng):
+    """The whole-step kernel (in-kernel normalize + grads + VJP + Adam) is
+    numerically the two-stage fused path and the autodiff path, step for
+    step, including the optimizer state it carries through VMEM."""
+    from sparse_coding_tpu.ensemble import make_fused_tied_step
+
+    k_init, k_data = jax.random.split(rng)
+    keys = jax.random.split(k_init, 2)
+    members = [FunctionalTiedSAE.init(k, D, N_FEATS, l1_alpha=1e-3)
+               for k in keys]
+    batch = jax.random.normal(k_data, (512, D))
+
+    full = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=True,
+                    fused_interpret=True, donate=False)
+    standard = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=False,
+                        donate=False)
+    # two-stage path, forced by swapping the resolved step fn
+    two_stage = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=True,
+                         fused_interpret=True, donate=False)
+    two_stage._fullfused_step = None
+
+    for _ in range(5):
+        aux_full = full.step_batch(batch)
+        aux_two = two_stage.step_batch(batch)
+        aux_std = standard.step_batch(batch)
+    # the ensembles really took different paths
+    assert full._step_fn is full._fullfused_step
+    assert two_stage._step_fn is two_stage._fused_step
+
+    for aux in (aux_two, aux_std):
+        np.testing.assert_allclose(np.asarray(aux_full.losses["loss"]),
+                                   np.asarray(aux.losses["loss"]), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(aux_full.feat_activity),
+                               np.asarray(aux_std.feat_activity), atol=0.5)
+    p_full = jax.device_get(full.state.params)
+    for other in (two_stage, standard):
+        p_o = jax.device_get(other.state.params)
+        for name in p_full:
+            np.testing.assert_allclose(p_full[name], p_o[name],
+                                       rtol=1e-4, atol=1e-6,
+                                       err_msg=f"param drift: {name}")
+    # optimizer moments match optax's exactly (same formulas in-kernel)
+    mu_full = jax.device_get(full.state.opt_state.mu)
+    mu_std = jax.device_get(standard.state.opt_state.mu)
+    for name in mu_full:
+        np.testing.assert_allclose(mu_full[name], mu_std[name],
+                                   rtol=1e-4, atol=1e-7,
+                                   err_msg=f"adam mu drift: {name}")
+    np.testing.assert_array_equal(
+        np.asarray(full.state.opt_state.count),
+        np.asarray(standard.state.opt_state.count))
+
+
+def test_train_step_kernel_single_tile(rng):
+    """n_tiles == 1 (batch == tile): init/accumulate/update all fire on the
+    same grid step."""
+    from sparse_coding_tpu.ops.fused_sae import fused_tied_sae_train_step
+
+    k_init, k_data = jax.random.split(rng)
+    _, params, alphas = _stacked_members(k_init)
+    batch = jax.random.normal(k_data, (128, D))
+    zeros_e = jnp.zeros_like(params["encoder"])
+    zeros_b = jnp.zeros_like(params["encoder_bias"])
+    lrs = jnp.full((N_MEMBERS,), 1e-3)
+    bc = jnp.full((N_MEMBERS,), 0.1)
+
+    one = fused_tied_sae_train_step(
+        params["encoder"], params["encoder_bias"], zeros_e, zeros_e,
+        zeros_b, zeros_b, alphas, lrs, bc, bc, batch,
+        batch_tile=128, interpret=True)
+    two = fused_tied_sae_train_step(
+        params["encoder"], params["encoder_bias"], zeros_e, zeros_e,
+        zeros_b, zeros_b, alphas, lrs, bc, bc, batch,
+        batch_tile=64, interpret=True)
+    # multi-tile loss accumulation (loss_ref += part) must equal single-tile
+    for k in ("mse", "l1", "l0"):
+        np.testing.assert_allclose(np.asarray(one[0][k]),
+                                   np.asarray(two[0][k]),
+                                   rtol=1e-5, atol=1e-7, err_msg=k)
+    for a, b in zip(one[1:], two[1:]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_train_step_admission_larger_than_two_stage():
+    """The whole-step kernel's working set strictly contains the two-stage
+    kernel's, so its admitted tile can never be larger."""
+    from sparse_coding_tpu.ops.fused_sae import (
+        _train_working_set, _working_set, pick_batch_tile,
+        pick_train_step_tile)
+
+    for tile in (64, 128, 256, 512):
+        assert (_train_working_set(tile, 2048, 512)
+                > _working_set(tile, 2048, 512))
+    for n_feats in (1024, 2048, 4096, 8192):
+        two = pick_batch_tile(2048, n_feats, 512) or 0
+        full = pick_train_step_tile(2048, n_feats, 512) or 0
+        assert full <= two
+    # the bench configuration still admits the whole-step kernel
+    assert pick_train_step_tile(2048, 2048, 512) is not None
+
+
+def test_train_step_kernel_lowers_for_tpu():
+    """AOT Mosaic lowering for the whole-step kernel (scratch accumulators,
+    scalar-prefetched Adam corrections) at small and bench scale."""
+    from sparse_coding_tpu.ops.fused_sae import fused_tied_sae_train_step
+
+    shapes = [((2, 64, 32), (2, 64), (2,), (256, 32)),
+              ((32, 2048, 512), (32, 2048), (32,), (2048, 512))]
+    for x_dtype in (jnp.float32, jnp.bfloat16):
+        for compute in ("float32", "bfloat16"):
+            for ws, bs, as_, xs in shapes:
+                e = jnp.zeros(ws)
+                b, a = jnp.zeros(bs), jnp.zeros(as_)
+                x = jnp.zeros(xs, x_dtype)
+                lrs = jnp.zeros(as_)
+                jax.jit(
+                    lambda e, b, a, lrs, x, cd=compute:
+                    fused_tied_sae_train_step(
+                        e, b, jnp.zeros_like(e), jnp.zeros_like(e),
+                        jnp.zeros_like(b), jnp.zeros_like(b), a, lrs,
+                        jnp.ones_like(a), jnp.ones_like(a), x,
+                        batch_tile=64, compute_dtype=cd)
+                ).trace(e, b, a, lrs, x).lower(lowering_platforms=("tpu",))
+
+
 # --- untied kernel -----------------------------------------------------------
 
 def _stacked_untied_members(key, bias_decay=0.0):
