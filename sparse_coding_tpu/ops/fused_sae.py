@@ -129,11 +129,16 @@ def kernel_batch_itemsize(dtype) -> int:
     return 2 if dtype == jnp.bfloat16 else 4
 
 
-def _tied_tile_grads(x_in, w, b, alpha, *, total_batch: int, d_act: int,
-                     compute_dtype):
+def _tied_tile_grads(x_in, w, b, alpha, coef_mask=None, *, total_batch: int,
+                     d_act: int, compute_dtype):
     """The torch-parity-locked per-tile math of the tied-SAE kernels (loss
     partials + exact grads for one batch tile) — single copy shared by the
     two-stage kernel and the whole-step train kernel.
+
+    coef_mask ([n] 0/1, or None): the masked family's per-member coefficient
+    mask (models/sae.py FunctionalMaskedTiedSAE; reference:
+    sae_ensemble.py:309-373) — multiplied into the codes and the pre-act
+    gradient, exactly autodiff through c = where(mask, relu(pre), 0).
 
     compute_dtype=bf16 runs every dot on the MXU's native bf16 path
     (~2x f32 throughput) with f32 accumulation — the in-kernel analogue
@@ -147,12 +152,15 @@ def _tied_tile_grads(x_in, w, b, alpha, *, total_batch: int, d_act: int,
 
     pre = jnp.dot(xc, w.T, preferred_element_type=jnp.float32) + b[None, :]
     c = jnp.maximum(pre, 0.0)
+    mask = (pre > 0.0).astype(jnp.float32)
+    if coef_mask is not None:
+        c = c * coef_mask[None, :]
+        mask = mask * coef_mask[None, :]
     x_hat = jnp.dot(c.astype(compute_dtype), w,
                     preferred_element_type=jnp.float32)
     r = x_hat - xb
 
     coef = 2.0 / (total_batch * d_act)
-    mask = (pre > 0.0).astype(jnp.float32)
     rc = r.astype(compute_dtype)
     dpre = (coef * jnp.dot(rc, w.T, preferred_element_type=jnp.float32)
             + alpha / total_batch) * mask
@@ -169,16 +177,20 @@ def _tied_tile_grads(x_in, w, b, alpha, *, total_batch: int, d_act: int,
     return dw, db, activity, part
 
 
-def _kernel(alpha_ref, x_ref, w_ref, b_ref, dw_ref, db_ref, act_ref, loss_ref,
-            *, total_batch: int, d_act: int, compute_dtype):
+def _kernel(alpha_ref, x_ref, w_ref, b_ref, *rest,
+            total_batch: int, d_act: int, compute_dtype, masked: bool = False):
     import jax.experimental.pallas as pl
 
+    if masked:
+        mask_ref, dw_ref, db_ref, act_ref, loss_ref = rest
+    else:
+        mask_ref, (dw_ref, db_ref, act_ref, loss_ref) = None, rest
     m = pl.program_id(0)
     i = pl.program_id(1)
     dw, db, activity, part = _tied_tile_grads(
         x_ref[...], w_ref[0].astype(compute_dtype), b_ref[0, 0],
-        alpha_ref[m], total_batch=total_batch, d_act=d_act,
-        compute_dtype=compute_dtype)
+        alpha_ref[m], None if mask_ref is None else mask_ref[0, 0],
+        total_batch=total_batch, d_act=d_act, compute_dtype=compute_dtype)
 
     @pl.when(i == 0)
     def _init():
@@ -202,7 +214,8 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
                          batch: Array, batch_tile: int = 256,
                          interpret: bool = False,
                          total_batch: Optional[int] = None,
-                         compute_dtype: str = "float32"):
+                         compute_dtype: str = "float32",
+                         coef_mask: Optional[Array] = None):
     """All-member losses and gradients wrt (normalized W, bias).
 
     Args:
@@ -216,6 +229,8 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
       compute_dtype: "float32" (exact) or "bfloat16" — dot operands cast to
         bf16 in VMEM for the MXU's native fast path, f32 accumulation (the
         in-kernel analogue of jax.default_matmul_precision("bfloat16")).
+      coef_mask: optional [N, n] per-member coefficient mask (the masked
+        family, FunctionalMaskedTiedSAE) — one extra VMEM vector per member.
     Returns:
       (losses {mse [N], l1 [N], l0 [N]}, dW [N, n, d], db [N, n],
        activity [N, n] per-feature active-sample counts)
@@ -230,9 +245,14 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
     n_tiles = local_batch // batch_tile
     assert n_tiles * batch_tile == local_batch
 
+    masked = coef_mask is not None
     kernel = functools.partial(_kernel, total_batch=total_batch, d_act=d,
-                               compute_dtype=jnp.dtype(compute_dtype))
+                               compute_dtype=jnp.dtype(compute_dtype),
+                               masked=masked)
 
+    # [N, n] operands ride as [N, 1, n]: a (1, n) 2-D block would violate
+    # Mosaic's sublane rule (1 ∤ 8 and 1 != N)
+    vec = pl.BlockSpec((1, 1, n_feats), lambda m, i, *_: (m, 0, 0))
     # alphas ride scalar prefetch (SMEM, whole [N] array) — ordinary SMEM
     # blocks can't tile a [N, 1] array per-member (Mosaic requires the
     # sublane dim to match or divide by 8, caught by AOT TPU lowering)
@@ -242,14 +262,11 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
         in_specs=[
             pl.BlockSpec((batch_tile, d), lambda m, i, *_: (i, 0)),  # x
             pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0)),  # W
-            # [N, n] operands ride as [N, 1, n]: a (1, n) 2-D block would
-            # violate Mosaic's sublane rule (1 ∤ 8 and 1 != N)
-            pl.BlockSpec((1, 1, n_feats), lambda m, i, *_: (m, 0, 0)),  # b
-        ],
+            vec,  # b
+        ] + ([vec] if masked else []),
         out_specs=[
             pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0)),
-            pl.BlockSpec((1, 1, n_feats), lambda m, i, *_: (m, 0, 0)),
-            pl.BlockSpec((1, 1, n_feats), lambda m, i, *_: (m, 0, 0)),
+            vec, vec,
             pl.BlockSpec((1, 1, 3), lambda m, i, *_: (m, 0, 0)),
         ],
     )
@@ -262,6 +279,11 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
         dimension_semantics=("parallel", "arbitrary"),
         vmem_limit_bytes=VMEM_LIMIT_BYTES))
 
+    operands = [alphas.astype(jnp.float32), batch, w_normed,
+                bias.reshape(n_members, 1, n_feats)]
+    if masked:
+        operands.append(coef_mask.astype(jnp.float32)
+                        .reshape(n_members, 1, n_feats))
     dw, db, activity, losses = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -273,8 +295,7 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
         ],
         interpret=interpret,
         compiler_params=compiler_params,
-    )(alphas.astype(jnp.float32), batch, w_normed,
-      bias.reshape(n_members, 1, n_feats))
+    )(*operands)
 
     db = db.reshape(n_members, n_feats)
     activity = activity.reshape(n_members, n_feats)
@@ -321,14 +342,16 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
                                   interpret: bool = False,
                                   total_batch: Optional[int] = None,
                                   compute_dtype: str = "float32",
-                                  psum_axis: Optional[str] = None):
+                                  psum_axis: Optional[str] = None,
+                                  coef_mask: Optional[Array] = None):
     """Drop-in producer of (aux-style losses, grads wrt raw stacked params)
     for the ensemble engine's fused path. params_stacked:
     {"encoder": [N, n, d], "encoder_bias": [N, n]}. total_batch: see
     fused_tied_sae_grads (global batch size when called on a shard);
     compute_dtype: bf16 runs the dots on the MXU's native fast path;
     psum_axis: reduce the per-shard partial sums over this mesh axis inside
-    the wrapper (shard_map callers — same convention as the untied family)."""
+    the wrapper (shard_map callers — same convention as the untied family);
+    coef_mask: [N, n] for masked buckets (FunctionalMaskedTiedSAE)."""
     e = params_stacked["encoder"]
     batch, batch_tile = prepare_kernel_batch(
         batch, e.shape[1], e.shape[2], batch_tile, compute_dtype)
@@ -337,7 +360,7 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
     losses, dw, db, activity = fused_tied_sae_grads(
         w_normed, params_stacked["encoder_bias"], alphas, batch,
         batch_tile=batch_tile, interpret=interpret, total_batch=total_batch,
-        compute_dtype=compute_dtype)
+        compute_dtype=compute_dtype, coef_mask=coef_mask)
     if psum_axis is not None:
         # the normalization VJP below is linear in dw and e is replicated
         # across the data axis, so psum-then-chain equals chain-then-psum
@@ -416,13 +439,19 @@ def train_tile_fits(batch: int, tile: int, n_feats: int, d: int,
 
 def _tied_train_kernel(alpha_ref, lr_ref, bc1_ref, bc2_ref,
                        x_ref, e_ref, b_ref, mu_ref, nu_ref, mub_ref, nub_ref,
-                       e_out, b_out, mu_out, nu_out, mub_out, nub_out,
-                       act_ref, loss_ref,
-                       wn_s, dw_s, db_s,
-                       *, total_batch: int, d_act: int, compute_dtype,
-                       n_tiles: int, b1: float, b2: float, eps: float):
+                       *rest,
+                       total_batch: int, d_act: int, compute_dtype,
+                       n_tiles: int, b1: float, b2: float, eps: float,
+                       masked: bool = False):
     import jax.experimental.pallas as pl
 
+    if masked:
+        (mask_ref, e_out, b_out, mu_out, nu_out, mub_out, nub_out,
+         act_ref, loss_ref, wn_s, dw_s, db_s) = rest
+    else:
+        mask_ref = None
+        (e_out, b_out, mu_out, nu_out, mub_out, nub_out,
+         act_ref, loss_ref, wn_s, dw_s, db_s) = rest
     m = pl.program_id(0)
     i = pl.program_id(1)
 
@@ -434,8 +463,8 @@ def _tied_train_kernel(alpha_ref, lr_ref, bc1_ref, bc2_ref,
 
     dw, db_row, activity, part = _tied_tile_grads(
         x_ref[...], wn_s[...].astype(compute_dtype), b_ref[0, 0],
-        alpha_ref[m], total_batch=total_batch, d_act=d_act,
-        compute_dtype=compute_dtype)
+        alpha_ref[m], None if mask_ref is None else mask_ref[0, 0],
+        total_batch=total_batch, d_act=d_act, compute_dtype=compute_dtype)
     db = db_row[None, :]
 
     @pl.when(i == 0)
