@@ -54,7 +54,10 @@ def bench_ensemble(quick: bool) -> None:
                 ("untied_autodiff", dict(use_fused=False, sig="sae"))]
     if jax.default_backend() == "tpu":
         variants += [
-            ("fused", dict(use_fused=True)),
+            ("fused_two_stage", dict(use_fused=True,
+                                     fused_path="two_stage")),
+            ("fused_train_step", dict(use_fused=True,
+                                      fused_path="train_step")),
             ("autodiff_bf16", dict(use_fused=False,
                                    matmul_precision="bfloat16")),
             ("fused_bf16", dict(use_fused=True,

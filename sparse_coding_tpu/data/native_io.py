@@ -50,10 +50,20 @@ def fast_astype(raw: np.ndarray, dtype) -> np.ndarray:
         import torch
     except ImportError:
         return raw.astype(dtype)
+
+    def torch_ready(a: np.ndarray) -> np.ndarray:
+        # torch.from_numpy needs a writable C-contiguous buffer (read-only
+        # np.load mmaps and strided views are neither); one host copy keeps
+        # the vectorized cast path available. Only the torch branches pay
+        # it — fall-through dtypes go straight to astype.
+        if a.flags.c_contiguous and a.flags.writeable:
+            return a
+        return a.copy()
+
     if raw.dtype == np.float16:
-        return torch.from_numpy(raw).to(torch.float32).numpy()
+        return torch.from_numpy(torch_ready(raw)).to(torch.float32).numpy()
     if raw.dtype.itemsize == 2 and raw.dtype.name == "bfloat16":
-        t = torch.from_numpy(raw.view(np.int16)).view(torch.bfloat16)
+        t = torch.from_numpy(torch_ready(raw).view(np.int16)).view(torch.bfloat16)
         return t.to(torch.float32).numpy()
     return raw.astype(dtype)
 

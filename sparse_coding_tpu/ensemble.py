@@ -123,7 +123,10 @@ def _apply_fused_updates(optimizer, losses, grads, activity,
 
 def _tied_producer(batch_tile, interpret, compute_dtype):
     """(params, buffers, batch, total_batch, psum_axis) -> (losses, grads,
-    activity) via the tied kernel (ops/fused_sae.fused_tied_sae_loss_and_grads)."""
+    activity) via the tied kernel (ops/fused_sae.fused_tied_sae_loss_and_grads).
+    Serves both the plain tied family and the masked family
+    (FunctionalMaskedTiedSAE): when the bucket's buffers carry a coef_mask it
+    rides into the kernel as one extra [N, n] operand."""
     from sparse_coding_tpu.ops.fused_sae import fused_tied_sae_loss_and_grads
 
     def producer(params, buffers, batch, total_batch=None, psum_axis=None):
@@ -132,7 +135,8 @@ def _tied_producer(batch_tile, interpret, compute_dtype):
              "encoder_bias": params["encoder_bias"]},
             buffers["l1_alpha"], batch, batch_tile=batch_tile,
             interpret=interpret, total_batch=total_batch,
-            compute_dtype=compute_dtype, psum_axis=psum_axis)
+            compute_dtype=compute_dtype, psum_axis=psum_axis,
+            coef_mask=buffers.get("coef_mask"))
 
     return producer
 
@@ -296,26 +300,41 @@ def make_fused_untied_step_sharded(optimizer, mesh, donate=True,
 
 def can_use_fused_untied_step(sig: Any, members,
                               interpret: bool = False) -> bool:
-    """Untied fused-path preconditions: plain "sae" signature + TPU backend
-    (or interpret mode). bias_decay needs no gate — its term lives outside
+    """Untied fused-path preconditions: plain "sae" signature whose members
+    carry exactly the param/buffer structure the kernel computes gradients
+    for (a name match alone could admit a subclassed signature with extra
+    trainable params, silently dropping their grads), + TPU backend (or
+    interpret mode). bias_decay needs no value gate — its term lives outside
     the kernel. VMEM tile admission happens per-batch in Ensemble."""
     if getattr(sig, "signature_name", None) != "sae":
         return False
-    return interpret or jax.default_backend() == "tpu"
+    if not (interpret or jax.default_backend() == "tpu"):
+        return False
+    params0, buffers0 = members[0]
+    return (set(params0) == {"encoder", "encoder_bias", "decoder"}
+            and {"l1_alpha", "bias_decay"} <= set(buffers0))
 
 
 def can_use_fused_tied_step(sig: Any, members, interpret: bool = False) -> bool:
-    """Fused path preconditions checkable at construction: tied SAE, identity
-    centering, zero bias decay, TPU backend (or interpret mode for tests).
-    The VMEM-fitting batch tile is checked against the REAL batch on the
-    first step (Ensemble.step_batch), not guessed here."""
+    """Fused path preconditions checkable at construction: tied SAE (plain,
+    with identity centering and zero bias decay) OR the masked family
+    (FunctionalMaskedTiedSAE — the kernel takes its coef_mask as one extra
+    operand; its loss has no centering/bias-decay terms to gate on), TPU
+    backend (or interpret mode for tests). The VMEM-fitting batch tile is
+    checked against the REAL batch on the first step (Ensemble.step_batch),
+    not guessed here."""
     import numpy as np
 
-    if getattr(sig, "signature_name", None) != "tied_sae":
+    name = getattr(sig, "signature_name", None)
+    if name not in ("tied_sae", "masked_tied_sae"):
         return False
     if not interpret and jax.default_backend() != "tpu":
         return False
-    params0, _ = members[0]
+    params0, buffers0 = members[0]
+    if set(params0) != {"encoder", "encoder_bias"}:
+        return False  # same structure guard as the untied gate
+    if name == "masked_tied_sae":
+        return "coef_mask" in buffers0
     d = params0["encoder"].shape[1]
     for _, b in members:
         if float(jnp.max(jnp.abs(b.get("bias_decay", jnp.zeros(()))))) != 0.0:
@@ -382,7 +401,14 @@ class Ensemble:
         fused_interpret: bool = False,
         fused_batch_tile: Optional[int] = None,
         fused_compute_dtype: str = "float32",
+        fused_path: Optional[str] = None,
     ):
+        if fused_path not in (None, "two_stage", "train_step"):
+            raise ValueError(
+                f"fused_path must be None, 'two_stage' or 'train_step', got "
+                f"{fused_path!r}")
+        if fused_path is not None and use_fused is False:
+            raise ValueError("fused_path requires use_fused=True or 'auto'")
         if not members:
             raise ValueError("ensemble needs at least one member")
         self.sig = sig
@@ -452,11 +478,14 @@ class Ensemble:
                             interpret=fused_interpret,
                             batch_tile=fused_batch_tile,
                             compute_dtype=fused_compute_dtype))
-            if mesh is None and make_single is make_fused_tied_step:
-                # tied family, single device: the whole-step kernel (grads +
-                # normalization VJP + Adam in one Pallas pass) replaces the
-                # two-stage path whenever its (larger) working set admits a
-                # tile — resolved per batch in _resolve_step
+            if (mesh is None and make_single is make_fused_tied_step
+                    and self.sig_name == "tied_sae"):
+                # plain tied family, single device: the whole-step kernel
+                # (grads + normalization VJP + Adam in one Pallas pass) is
+                # available behind fused_path="train_step" — per-batch
+                # resolution in _resolve_step, two_stage preferred in auto
+                # mode; the masked family has no train-step kernel (its
+                # coef_mask operand is two-stage only)
                 self._fullfused_step = make_fullfused_tied_step(
                     self._adam_hypers, donate=donate,
                     interpret=fused_interpret, batch_tile=fused_batch_tile,
@@ -465,7 +494,20 @@ class Ensemble:
         # known once the real batch arrives, so the final choice happens on
         # the first step_batch call (and is re-checked per batch size).
         # fused_path records WHICH fused kernel actually resolved
-        # ("train_step" | "two_stage" | None) for bench/tune labeling.
+        # ("train_step" | "two_stage" | None) for bench/tune labeling; the
+        # fused_path CONSTRUCTOR arg forces that choice (the bench/tune A/B
+        # knob — a perf-regressing default must stay measurable).
+        self._forced_fused_path = fused_path
+        if fused_path == "train_step" and self._fullfused_step is None:
+            raise ValueError(
+                "fused_path='train_step' requires a single-device "
+                "identity-centered tied_sae bucket with the fused path "
+                "enabled (the whole-step kernel has no sharded or untied "
+                "variant)")
+        if fused_path == "two_stage" and self._fused_step is None:
+            raise ValueError(
+                "fused_path='two_stage' but no fused kernel is eligible for "
+                "this bucket (see use_fused=True error for the conditions)")
         self.fused = self._fused_step is not None
         self.fused_path = None
         self._fused_explicit = use_fused is True
@@ -514,8 +556,12 @@ class Ensemble:
                                     batch_itemsize=batch_itemsize,
                                     compute_itemsize=ci, n_mats=nm) is not None)
         # the whole-step kernel carries the Adam state through VMEM too, so
-        # its admission is separate (larger working set); when it fits it
-        # wins, else the two-stage fused path, else autodiff
+        # its admission is separate (larger working set). A fused_path
+        # override pins the choice (the bench/tune A/B knob); in auto mode
+        # two_stage is preferred when both admit — the r4 on-chip A/B
+        # (BENCH_VARIANTS.json) measured the whole-step kernel slower at
+        # bench scale, so it must be asked for explicitly.
+        force = self._forced_fused_path
         workable_full = self._fullfused_step is not None and (
             train_tile_fits(local, self._fused_batch_tile, n_feats, d,
                             batch_itemsize, compute_itemsize=ci, n_mats=nm)
@@ -523,7 +569,18 @@ class Ensemble:
             pick_train_step_tile(local, n_feats, d,
                                  batch_itemsize=batch_itemsize,
                                  compute_itemsize=ci, n_mats=nm) is not None)
-        if workable_full:
+        if force == "train_step" and not workable_full:
+            raise ValueError(
+                f"fused_path='train_step' but no VMEM-fitting train-step "
+                f"tile exists for per-device batch={local}, "
+                f"n_feats={n_feats}, d={d}")
+        if force == "two_stage" and not workable:
+            raise ValueError(
+                f"fused_path='two_stage' but no VMEM-fitting batch tile "
+                f"exists for per-device batch={local}, n_feats={n_feats}, "
+                f"d={d}")
+        if force == "train_step" or (force is None and workable_full
+                                     and not workable):
             self._step_fn = self._fullfused_step
             self.fused = True
             self.fused_path = "train_step"

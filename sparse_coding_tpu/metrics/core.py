@@ -87,6 +87,8 @@ def _iter_slabs(activations, batch_size: int):
     remainder is dropped."""
     from sparse_coding_tpu.data.chunk_store import ChunkStore
 
+    import numpy as np
+
     if isinstance(activations, ChunkStore):
         left = None
         # chunks ship as f32 on purpose: measured on the axon tunnel,
@@ -94,15 +96,20 @@ def _iter_slabs(activations, batch_size: int):
         # 1.2 GB/s for f32), and the host-side f16→f32 decode is cheap
         # (torch-bridged cast, data/native_io.fast_astype).
         # chunk_reader streams the NEXT chunk from disk while the current
-        # one is being encoded on device
+        # one is being encoded on device. The remainder rows carry on the
+        # HOST: only whole-batch-multiple prefixes are device_put, so for
+        # equal-size chunks the yielded shape takes at most TWO values
+        # (⌊C/b⌋·b and (⌊C/b⌋+1)·b) and the jitted per-slab scans compile at
+        # most twice — a device-side carry re-concatenated every chunk both
+        # copied the full slab and grew the shape set unboundedly.
         for chunk in activations.chunk_reader(range(activations.n_chunks)):
-            slab = jnp.asarray(chunk)
+            arr = np.asarray(chunk)
             if left is not None and left.shape[0]:
-                slab = jnp.concatenate([left, slab], axis=0)
-            n = (slab.shape[0] // batch_size) * batch_size
-            left = slab[n:]
+                arr = np.concatenate([left, arr], axis=0)
+            n = (arr.shape[0] // batch_size) * batch_size
+            left = arr[n:].copy()  # not a view: don't pin the whole chunk
             if n:
-                yield slab[:n]
+                yield jnp.asarray(arr[:n])
     else:
         yield jnp.asarray(activations)
 

@@ -441,17 +441,13 @@ def _tied_train_kernel(alpha_ref, lr_ref, bc1_ref, bc2_ref,
                        x_ref, e_ref, b_ref, mu_ref, nu_ref, mub_ref, nub_ref,
                        *rest,
                        total_batch: int, d_act: int, compute_dtype,
-                       n_tiles: int, b1: float, b2: float, eps: float,
-                       masked: bool = False):
+                       n_tiles: int, b1: float, b2: float, eps: float):
+    # plain tied family only — masked buckets (coef_mask) ride the two-stage
+    # kernel, which the engine prefers anyway (see ensemble._resolve_step)
     import jax.experimental.pallas as pl
 
-    if masked:
-        (mask_ref, e_out, b_out, mu_out, nu_out, mub_out, nub_out,
-         act_ref, loss_ref, wn_s, dw_s, db_s) = rest
-    else:
-        mask_ref = None
-        (e_out, b_out, mu_out, nu_out, mub_out, nub_out,
-         act_ref, loss_ref, wn_s, dw_s, db_s) = rest
+    (e_out, b_out, mu_out, nu_out, mub_out, nub_out,
+     act_ref, loss_ref, wn_s, dw_s, db_s) = rest
     m = pl.program_id(0)
     i = pl.program_id(1)
 
@@ -463,7 +459,7 @@ def _tied_train_kernel(alpha_ref, lr_ref, bc1_ref, bc2_ref,
 
     dw, db_row, activity, part = _tied_tile_grads(
         x_ref[...], wn_s[...].astype(compute_dtype), b_ref[0, 0],
-        alpha_ref[m], None if mask_ref is None else mask_ref[0, 0],
+        alpha_ref[m], None,
         total_batch=total_batch, d_act=d_act, compute_dtype=compute_dtype)
     db = db_row[None, :]
 

@@ -269,13 +269,13 @@ def test_train_step_kernel_matches_two_stage_and_autodiff(rng):
     batch = jax.random.normal(k_data, (512, D))
 
     full = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=True,
-                    fused_interpret=True, donate=False)
+                    fused_interpret=True, donate=False,
+                    fused_path="train_step")
     standard = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=False,
                         donate=False)
-    # two-stage path, forced by swapping the resolved step fn
     two_stage = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=True,
-                         fused_interpret=True, donate=False)
-    two_stage._fullfused_step = None
+                         fused_interpret=True, donate=False,
+                         fused_path="two_stage")
 
     for _ in range(5):
         aux_full = full.step_batch(batch)
@@ -525,3 +525,127 @@ def test_untied_kernel_lowers_for_tpu():
                     lambda e, w, b, a, x, cd=compute: fused_untied_sae_grads(
                         e, w, b, a, x, batch_tile=64, compute_dtype=cd)
                 ).trace(e, w, b, a, x).lower(lowering_platforms=("tpu",))
+
+
+def test_fused_path_override_knob(rng):
+    """The fused_path constructor knob (the bench/tune A/B): forces each
+    tied kernel, auto prefers two_stage, and invalid combinations fail
+    fast at construction."""
+    k_init, k_data = jax.random.split(rng)
+    members, _, _ = _stacked_members(k_init)
+    batch = jax.random.normal(k_data, (BATCH, D))
+
+    forced_two = Ensemble(members, FunctionalTiedSAE, use_fused=True,
+                          fused_interpret=True, donate=False,
+                          fused_path="two_stage")
+    forced_two.step_batch(batch)
+    assert forced_two.fused_path == "two_stage"
+    assert forced_two._step_fn is forced_two._fused_step
+
+    forced_full = Ensemble(members, FunctionalTiedSAE, use_fused=True,
+                           fused_interpret=True, donate=False,
+                           fused_path="train_step")
+    forced_full.step_batch(batch)
+    assert forced_full.fused_path == "train_step"
+    assert forced_full._step_fn is forced_full._fullfused_step
+
+    # auto mode prefers two_stage even when the train-step kernel admits
+    # (demoted after the r4 on-chip A/B — see _resolve_step)
+    auto = Ensemble(members, FunctionalTiedSAE, use_fused=True,
+                    fused_interpret=True, donate=False)
+    auto.step_batch(batch)
+    assert auto.fused_path == "two_stage"
+
+    with pytest.raises(ValueError, match="fused_path must be"):
+        Ensemble(members, FunctionalTiedSAE, use_fused=True,
+                 fused_interpret=True, fused_path="bogus")
+    with pytest.raises(ValueError, match="requires use_fused"):
+        Ensemble(members, FunctionalTiedSAE, use_fused=False,
+                 fused_path="two_stage")
+
+
+def test_fused_gates_check_member_structure():
+    """Eligibility gates verify the members' param/buffer structure, not the
+    signature name alone — a subclassed signature with an extra trainable
+    param must ride autodiff, or the kernel would silently drop its grads
+    (ADVICE r2)."""
+    from sparse_coding_tpu.ensemble import (
+        can_use_fused_tied_step,
+        can_use_fused_untied_step,
+    )
+
+    class FakeUntied:
+        signature_name = "sae"
+
+    good = [({"encoder": jnp.zeros((4, 2)), "encoder_bias": jnp.zeros(4),
+              "decoder": jnp.zeros((4, 2))},
+             {"l1_alpha": jnp.asarray(0.1), "bias_decay": jnp.asarray(0.0)})]
+    extra = [({**good[0][0], "gate": jnp.zeros(4)}, good[0][1])]
+    assert can_use_fused_untied_step(FakeUntied, good, interpret=True)
+    assert not can_use_fused_untied_step(FakeUntied, extra, interpret=True)
+
+    class FakeTied:
+        signature_name = "tied_sae"
+
+    d = 2
+    tied_good = [({"encoder": jnp.zeros((4, d)), "encoder_bias": jnp.zeros(4)},
+                  {"l1_alpha": jnp.asarray(0.1),
+                   "center_rot": jnp.eye(d), "center_trans": jnp.zeros(d),
+                   "center_scale": jnp.asarray(1.0)})]
+    tied_extra = [({**tied_good[0][0], "gate": jnp.zeros(4)}, tied_good[0][1])]
+    assert can_use_fused_tied_step(FakeTied, tied_good, interpret=True)
+    assert not can_use_fused_tied_step(FakeTied, tied_extra, interpret=True)
+
+
+def test_masked_tied_fused_matches_autodiff(rng):
+    """A FunctionalMaskedTiedSAE bucket (mixed dict sizes padded to one
+    stack, reference: sae_ensemble.py:309-373 / the dict-ratio sweep at
+    big_sweep_experiments.py:543) rides the fused kernel with its coef_mask
+    as an operand, step-for-step equal to the autodiff path."""
+    from sparse_coding_tpu.models.sae import FunctionalMaskedTiedSAE
+
+    k_init, k_data = jax.random.split(rng)
+    keys = jax.random.split(k_init, 3)
+    sizes = [16, 32, 64]
+    members = [FunctionalMaskedTiedSAE.init(k, D, n, 64, l1_alpha=l1)
+               for k, n, l1 in zip(keys, sizes, [1e-4, 1e-3, 3e-3])]
+    batch = jax.random.normal(k_data, (BATCH, D))
+
+    fused = Ensemble(members, FunctionalMaskedTiedSAE, lr=1e-3,
+                     use_fused=True, fused_interpret=True, donate=False)
+    std = Ensemble(members, FunctionalMaskedTiedSAE, lr=1e-3,
+                   use_fused=False, donate=False)
+    for _ in range(3):
+        aux_f = fused.step_batch(batch)
+        aux_s = std.step_batch(batch)
+    assert fused.fused_path == "two_stage"
+
+    for key_ in ("loss", "l_reconstruction", "l_l1"):
+        np.testing.assert_allclose(np.asarray(aux_f.losses[key_]),
+                                   np.asarray(aux_s.losses[key_]),
+                                   rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(aux_f.feat_activity),
+                               np.asarray(aux_s.feat_activity), atol=0.5)
+    p_f = jax.device_get(fused.state.params)
+    p_s = jax.device_get(std.state.params)
+    for name in p_f:
+        np.testing.assert_allclose(p_f[name], p_s[name], rtol=1e-4,
+                                   atol=1e-6, err_msg=f"param drift: {name}")
+    # padded (masked-off) features must never move or fire
+    coef_mask = np.asarray(jnp.stack([b["coef_mask"] for _, b in members]))
+    assert not np.asarray(aux_f.feat_activity)[~coef_mask].any()
+
+
+def test_masked_kernel_lowers_for_tpu():
+    """AOT Mosaic lowering of the tied kernel WITH the coef_mask operand, at
+    small and bench scale."""
+    shapes = [((2, 64, 32), (2, 64), (2,), (256, 32)),
+              ((32, 2048, 512), (32, 2048), (32,), (2048, 512))]
+    for ws, bs, as_, xs in shapes:
+        w, b, a = (jnp.zeros(s) for s in (ws, bs, as_))
+        cm = jnp.ones(bs)
+        x = jnp.zeros(xs)
+        jax.jit(
+            lambda w, b, a, x, cm: fused_tied_sae_grads(
+                w, b, a, x, batch_tile=64, coef_mask=cm)
+        ).trace(w, b, a, x, cm).lower(lowering_platforms=("tpu",))

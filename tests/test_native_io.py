@@ -65,3 +65,23 @@ def test_chunk_store_epoch_uses_native(tmp_path):
     finally:
         nio._lib, nio._lib_failed = lib, False
     np.testing.assert_array_equal(native_rows, numpy_rows)
+
+
+def test_fast_astype_readonly_and_strided():
+    """The torch cast bridge guards against buffers torch.from_numpy cannot
+    take (read-only np.load mmaps, strided views) by copying first — the
+    result must equal plain astype with no warning either way (ADVICE r2)."""
+    import warnings
+
+    from sparse_coding_tpu.data.native_io import fast_astype
+
+    x = np.random.default_rng(0).standard_normal((64, 8)).astype(np.float16)
+    readonly = x.copy()
+    readonly.setflags(write=False)
+    strided = x[::2]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        np.testing.assert_array_equal(fast_astype(readonly, np.float32),
+                                      x.astype(np.float32))
+        np.testing.assert_array_equal(fast_astype(strided, np.float32),
+                                      x[::2].astype(np.float32))

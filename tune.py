@@ -42,22 +42,46 @@ SCAN_CHUNKS = (5, 10, 25, 50)
 
 
 def stage1_grid(on_tpu: bool, quick: bool) -> list[dict]:
-    """Implementation × precision × stream-dtype × (fused) batch tile.
-    Fused/tile/bf16-stream variants only make sense on TPU (the kernel is
-    gated to the TPU backend outside interpret mode)."""
+    """Stage 1: step IMPLEMENTATION scan — autodiff (default / bf16 matmul
+    precision) vs both tied fused kernels (two_stage and the whole-step
+    train_step), auto tile, f32 everywhere. Tile/dtype refinement happens in
+    stage 1b for the winner only, keeping the grid tractable."""
     configs: list[dict] = [
         {"use_fused": False},
         {"use_fused": False, "matmul_precision": "bfloat16"},
     ]
     if not on_tpu:
         return configs
-    # matmul_precision doesn't reach Pallas dots; the fused knobs are the
-    # batch tile, the HBM stream dtype, and the in-kernel MXU compute dtype
-    tiles = (None, 2048, 1024, 512, 256, 128, 64)
-    for tile, compute, batch_dtype in itertools.product(
-            tiles, (None, "bfloat16"), (None, "bfloat16")):
-        configs.append({"use_fused": True, "batch_tile": tile,
-                        "fused_compute_dtype": compute,
+    configs.append({"use_fused": True, "fused_path": "two_stage"})
+    configs.append({"use_fused": True, "fused_path": "train_step"})
+    return configs
+
+
+def tile_grid(best: dict) -> list[dict]:
+    """Stage 1b (fused winners only): explicit batch tiles for the winning
+    kernel path (auto pick = the stage-1 winner itself)."""
+    if not best.get("use_fused"):
+        return []
+    return [{"use_fused": True, "fused_path": best.get("fused_path"),
+             "batch_tile": t} for t in (2048, 1024, 512, 256, 128, 64)]
+
+
+def dtype_grid(best: dict) -> list[dict]:
+    """Stage 1c (fused winners only): MXU compute dtype × HBM stream dtype
+    ON TOP of the tile winner — tile and dtype interact through VMEM
+    admission, so the combination is measured, not inferred.
+    matmul_precision doesn't reach Pallas dots; fused_compute_dtype is the
+    in-kernel analogue."""
+    if not best.get("use_fused"):
+        return []
+    base = {"use_fused": True, "fused_path": best.get("fused_path"),
+            "batch_tile": best.get("batch_tile")}
+    configs = []
+    for compute, batch_dtype in itertools.product(
+            (None, "bfloat16"), (None, "bfloat16")):
+        if compute is None and batch_dtype is None:
+            continue  # == the tile winner itself
+        configs.append({**base, "fused_compute_dtype": compute,
                         "batch_dtype": batch_dtype})
     return configs
 
@@ -111,6 +135,19 @@ def main() -> None:
         print("tune: every stage-1 configuration failed", file=sys.stderr)
         sys.exit(1)
     best = max(results, key=lambda r: r["acts_per_sec"])
+
+    # stage 1b/1c: tile then dtype refinement for the winning implementation
+    # (dtype configs inherit the tile winner, so combos are measured)
+    def strip(rec: dict) -> dict:
+        return {k: v for k, v in rec.items() if k not in ("acts_per_sec", "mfu")}
+
+    for grid_fn in (tile_grid, dtype_grid):
+        for cfg in grid_fn(strip(best)):
+            rec = measure(cfg)
+            if rec is not None:
+                results.append(rec)
+                if rec["acts_per_sec"] > best["acts_per_sec"]:
+                    best = rec
 
     # stage 2: scan-chunk sweep for the winner (roughly independent of the
     # stage-1 knobs, so sweeping it only here keeps the grid tractable)
