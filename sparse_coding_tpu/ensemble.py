@@ -481,10 +481,10 @@ class Ensemble:
             if (mesh is None and make_single is make_fused_tied_step
                     and self.sig_name == "tied_sae"):
                 # plain tied family, single device: the whole-step kernel
-                # (grads + normalization VJP + Adam in one Pallas pass) is
-                # available behind fused_path="train_step" — per-batch
-                # resolution in _resolve_step, two_stage preferred in auto
-                # mode; the masked family has no train-step kernel (its
+                # (grads + normalization VJP + Adam in one Pallas pass) —
+                # per-batch resolution in _resolve_step, preferred in auto
+                # mode when its tile admits (r4 on-chip A/B: ~9% faster);
+                # the masked family has no train-step kernel (its
                 # coef_mask operand is two-stage only)
                 self._fullfused_step = make_fullfused_tied_step(
                     self._adam_hypers, donate=donate,
@@ -558,9 +558,9 @@ class Ensemble:
         # the whole-step kernel carries the Adam state through VMEM too, so
         # its admission is separate (larger working set). A fused_path
         # override pins the choice (the bench/tune A/B knob); in auto mode
-        # two_stage is preferred when both admit — the r4 on-chip A/B
-        # (BENCH_VARIANTS.json) measured the whole-step kernel slower at
-        # bench scale, so it must be asked for explicitly.
+        # train_step wins when it admits — the r4 on-chip A/B
+        # (BENCH_VARIANTS.json) measured it ~9% faster than two_stage at
+        # bench scale, consistently across dtype variants.
         force = self._forced_fused_path
         workable_full = self._fullfused_step is not None and (
             train_tile_fits(local, self._fused_batch_tile, n_feats, d,
@@ -579,8 +579,7 @@ class Ensemble:
                 f"fused_path='two_stage' but no VMEM-fitting batch tile "
                 f"exists for per-device batch={local}, n_feats={n_feats}, "
                 f"d={d}")
-        if force == "train_step" or (force is None and workable_full
-                                     and not workable):
+        if force == "train_step" or (force is None and workable_full):
             self._step_fn = self._fullfused_step
             self.fused = True
             self.fused_path = "train_step"

@@ -549,12 +549,13 @@ def test_fused_path_override_knob(rng):
     assert forced_full.fused_path == "train_step"
     assert forced_full._step_fn is forced_full._fullfused_step
 
-    # auto mode prefers two_stage even when the train-step kernel admits
-    # (demoted after the r4 on-chip A/B — see _resolve_step)
+    # auto mode prefers train_step when its (larger) tile admits — the r4
+    # on-chip A/B (BENCH_VARIANTS.json) measured it ~9% faster than
+    # two_stage at bench scale
     auto = Ensemble(members, FunctionalTiedSAE, use_fused=True,
                     fused_interpret=True, donate=False)
     auto.step_batch(batch)
-    assert auto.fused_path == "two_stage"
+    assert auto.fused_path == "train_step"
 
     with pytest.raises(ValueError, match="fused_path must be"):
         Ensemble(members, FunctionalTiedSAE, use_fused=True,
