@@ -38,17 +38,15 @@ def data(rng):
 
 
 def test_untied_sae_loss_matches_torch(data):
-    """reference: sae_ensemble.py:52-78."""
-    t = {k: torch.tensor(v) for k, v in data.items()}
-    c = torch.clamp(torch.einsum("nd,bd->bn", t["encoder"], t["batch"])
-                    + t["bias"], min=0.0)
-    norms = torch.clamp(torch.norm(t["decoder"], 2, dim=-1), 1e-8)
-    ld = t["decoder"] / norms[:, None]
-    x_hat = torch.einsum("nd,bn->bd", ld, c)
+    """reference: sae_ensemble.py:52-78 (formula in _untied_loss_torch —
+    single-sourced with the trajectory gate)."""
     l1_alpha, bias_decay = 1e-3, 0.01
-    ref = ((x_hat - t["batch"]).pow(2).mean()
-           + l1_alpha * torch.norm(c, 1, dim=-1).mean()
-           + bias_decay * torch.norm(t["bias"], 2))
+    ref = _untied_loss_torch(
+        {"encoder": torch.tensor(data["encoder"]),
+         "encoder_bias": torch.tensor(data["bias"]),
+         "decoder": torch.tensor(data["decoder"])},
+        {"l1_alpha": l1_alpha, "bias_decay": bias_decay},
+        torch.tensor(data["batch"]))
 
     params = {"encoder": jnp.asarray(data["encoder"]),
               "encoder_bias": jnp.asarray(data["bias"]),
@@ -60,16 +58,14 @@ def test_untied_sae_loss_matches_torch(data):
 
 
 def test_tied_sae_loss_matches_torch(data):
-    """reference: sae_ensemble.py:134-162 (identity centering)."""
-    t = {k: torch.tensor(v) for k, v in data.items()}
-    norms = torch.clamp(torch.norm(t["encoder"], 2, dim=-1), 1e-8)
-    ld = t["encoder"] / norms[:, None]
-    c = torch.clamp(torch.einsum("nd,bd->bn", ld, t["batch"]) + t["bias"],
-                    min=0.0)
-    x_hat = torch.einsum("nd,bn->bd", ld, c)
+    """reference: sae_ensemble.py:134-162, identity centering (formula in
+    _tied_loss_torch — single-sourced with the trajectory gate)."""
     l1_alpha = 8.577e-4  # the reference's canonical operating point
-    ref = ((x_hat - t["batch"]).pow(2).mean()
-           + l1_alpha * torch.norm(c, 1, dim=-1).mean())
+    parts = {}
+    ref = _tied_loss_torch(
+        {"encoder": torch.tensor(data["encoder"]),
+         "encoder_bias": torch.tensor(data["bias"])},
+        {"l1_alpha": l1_alpha}, torch.tensor(data["batch"]), parts=parts)
 
     params = {"encoder": jnp.asarray(data["encoder"]),
               "encoder_bias": jnp.asarray(data["bias"])}
@@ -81,7 +77,7 @@ def test_tied_sae_loss_matches_torch(data):
     # component split matches too
     np.testing.assert_allclose(
         float(aux.losses["l_reconstruction"]),
-        float((x_hat - t["batch"]).pow(2).mean()), rtol=1e-5)
+        float(parts["l_reconstruction"]), rtol=1e-5)
 
 
 def test_masked_tied_sae_loss_matches_torch(data):
@@ -109,6 +105,118 @@ def test_masked_tied_sae_loss_matches_torch(data):
     ours, _ = FunctionalMaskedTiedSAE.loss(params, buffers,
                                            jnp.asarray(data["batch"]))
     np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+
+
+def _torch_adam_trajectory(sig_loss_torch, members_np, batches_np, lr,
+                           b1=0.9, b2=0.999, eps=1e-8):
+    """The reference training loop in torch: per-member autograd grads +
+    torchopt-Adam semantics (reference: autoencoders/ensemble.py:85,175-193 —
+    torchopt.adam mirrors optax scale_by_adam with eps_root=0, lr applied
+    as p -= lr * m̂ / (√v̂ + eps)). Returns [K, n_members] losses and the
+    final per-member params."""
+    histories, finals = [], []
+    for params_np, buffers_np in members_np:
+        params = {k: torch.tensor(v, requires_grad=True)
+                  for k, v in params_np.items()}
+        buffers = {k: torch.tensor(v) for k, v in buffers_np.items()}
+        m = {k: torch.zeros_like(v) for k, v in params.items()}
+        v2 = {k: torch.zeros_like(v) for k, v in params.items()}
+        losses = []
+        for t, batch in enumerate(batches_np, start=1):
+            for p in params.values():
+                if p.grad is not None:
+                    p.grad = None
+            loss = sig_loss_torch(params, buffers, torch.tensor(batch))
+            loss.backward()
+            with torch.no_grad():
+                for k, p in params.items():
+                    g = p.grad
+                    m[k] = b1 * m[k] + (1 - b1) * g
+                    v2[k] = b2 * v2[k] + (1 - b2) * g * g
+                    mhat = m[k] / (1 - b1 ** t)
+                    vhat = v2[k] / (1 - b2 ** t)
+                    p -= lr * mhat / (vhat.sqrt() + eps)
+            losses.append(float(loss.detach()))
+        histories.append(losses)
+        finals.append({k: p.detach().numpy() for k, p in params.items()})
+    return np.asarray(histories).T, finals  # [K, n_members]
+
+
+def _tied_loss_torch(params, buffers, batch, parts=None):
+    """reference: sae_ensemble.py:134-162, identity centering. The single
+    golden formula for the tied family — the single-loss test and the
+    trajectory gate both call it (parts, when given, collects components)."""
+    norms = torch.clamp(torch.norm(params["encoder"], 2, dim=-1), 1e-8)
+    ld = params["encoder"] / norms[:, None]
+    c = torch.clamp(torch.einsum("nd,bd->bn", ld, batch)
+                    + params["encoder_bias"], min=0.0)
+    x_hat = torch.einsum("nd,bn->bd", ld, c)
+    mse = (x_hat - batch).pow(2).mean()
+    if parts is not None:
+        parts["l_reconstruction"] = mse
+    return mse + buffers["l1_alpha"] * torch.norm(c, 1, dim=-1).mean()
+
+
+def _untied_loss_torch(params, buffers, batch):
+    """reference: sae_ensemble.py:52-78 — the single golden formula for the
+    untied family; bias term uses the documented safe-norm deviation
+    (models/sae.py::_safe_norm, PARITY.md) so the gradient at the zero-bias
+    init is finite on both sides."""
+    c = torch.clamp(torch.einsum("nd,bd->bn", params["encoder"], batch)
+                    + params["encoder_bias"], min=0.0)
+    norms = torch.clamp(torch.norm(params["decoder"], 2, dim=-1), 1e-8)
+    ld = params["decoder"] / norms[:, None]
+    x_hat = torch.einsum("nd,bn->bd", ld, c)
+    safe_norm = (params["encoder_bias"].pow(2).sum() + 1e-16).sqrt()
+    return ((x_hat - batch).pow(2).mean()
+            + buffers["l1_alpha"] * torch.norm(c, 1, dim=-1).mean()
+            + buffers["bias_decay"] * safe_norm)
+
+
+@pytest.mark.parametrize("family", ["tied", "untied"])
+def test_adam_trajectory_matches_torch(rng, family):
+    """K-step optimizer-TRAJECTORY parity vs the reference loop (reference:
+    autoencoders/ensemble.py:119-123,175-193): a torch loop with
+    torchopt-Adam semantics and our jitted Ensemble step the same members on
+    the same batch stream; per-member loss curves and final params must
+    agree. This is the hermetic substitute for the blocked real-Pythia
+    frontier — it locks the in-place-update semantics end to end, not just
+    single-loss values."""
+    from sparse_coding_tpu.ensemble import Ensemble
+
+    K, lr = 8, 3e-3
+    k_init, k_data = jax.random.split(rng)
+    keys = jax.random.split(k_init, 3)
+    if family == "tied":
+        members = [FunctionalTiedSAE.init(k, D, N, l1_alpha=l1)
+                   for k, l1 in zip(keys, [1e-4, 8.577e-4, 3e-3])]
+        sig, loss_torch = FunctionalTiedSAE, _tied_loss_torch
+    else:
+        members = [FunctionalSAE.init(k, D, N, l1_alpha=l1, bias_decay=0.01)
+                   for k, l1 in zip(keys, [1e-4, 1e-3, 3e-3])]
+        sig, loss_torch = FunctionalSAE, _untied_loss_torch
+    members_np = [
+        ({k_: np.asarray(v) for k_, v in p.items()},
+         {k_: np.asarray(v) for k_, v in b.items()
+          if np.asarray(v).dtype.kind == "f" and np.asarray(v).ndim == 0})
+        for p, b in members]
+    batches_np = np.asarray(
+        jax.random.normal(k_data, (K, B, D)), np.float32)
+
+    ref_losses, ref_finals = _torch_adam_trajectory(
+        loss_torch, members_np, batches_np, lr)
+
+    ens = Ensemble(members, sig, lr=lr, use_fused=False, donate=False)
+    ours = np.asarray([
+        np.asarray(ens.step_batch(jnp.asarray(b)).losses["loss"])
+        for b in batches_np])
+
+    np.testing.assert_allclose(ours, ref_losses, rtol=5e-5, atol=1e-6)
+    final_members = ens.unstack()
+    for (ref_p, (our_p, _)) in zip(ref_finals, final_members):
+        for k_ in ref_p:
+            np.testing.assert_allclose(np.asarray(our_p[k_]), ref_p[k_],
+                                       rtol=5e-4, atol=2e-5)
 
 
 def test_topk_loss_matches_torch(data):
