@@ -198,15 +198,12 @@ def bench_seq_parallel(quick: bool) -> None:
     from sparse_coding_tpu.parallel.mesh import make_mesh
 
     n_dev = len(jax.devices())
-    if n_dev == 1:
-        # a 1-shard "sequence-parallel" forward measures nothing (degenerate
-        # ppermute ring) on any backend; on a single-chip TPU tunnel the
-        # axon remote-compile helper has additionally hung indefinitely on
-        # this shard_map program — the multi-device CPU mesh in tests
-        # covers the path instead
-        print("seq_parallel: skipped (1 device: degenerate ring)",
-              file=sys.stderr)
-        return
+    # n_dev == 1 is a degenerate ring (no ppermute traffic) but still runs
+    # the full shard_map + ring-attention program on the chip. The r3 "hang"
+    # on this suite was eager shard_map compiling every body op as its own
+    # remote program through the tunnel; sequence_parallel_forward now jits
+    # the whole program (lm/long_context.py::_sp_program, repro in
+    # scripts/repro_seqpar_hang.py).
     mesh = make_mesh(1, n_dev)
     cfg = tiny_test_config("gptneox") if quick else get_config(
         "EleutherAI/pythia-70m-deduped")
@@ -216,8 +213,11 @@ def bench_seq_parallel(quick: bool) -> None:
         0, cfg.vocab_size, (b, s)))
 
     def one():
+        # reduce ON DEVICE: at pythia-70m scale the full logits are ~1.2 GB
+        # and returning them ships every byte through the axon tunnel each
+        # iteration — the sync would time tunnel bandwidth, not the forward
         logits, _ = sequence_parallel_forward(params, toks, cfg, mesh)
-        return logits
+        return jnp.sum(jnp.square(logits))
 
     rate = _timed(one, 3 if quick else 10, b * s)
     _emit("seq_parallel_forward", rate, "tokens/s", context=s,

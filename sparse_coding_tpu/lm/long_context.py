@@ -15,7 +15,7 @@ preserved by construction).
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional, Sequence
 
 import jax
@@ -105,6 +105,33 @@ def _sp_forward_local(params: dict, tokens: Array, cfg: LMConfig,
     return logits, collected
 
 
+@lru_cache(maxsize=32)
+def _sp_program(cfg: LMConfig, mesh: Mesh, taps: tuple,
+                stop_at_layer: Optional[int], axis_name: str):
+    """Build-and-cache the JITTED shard_map program for one (config, mesh,
+    taps) combination. The jit wrapper is load-bearing on TPU: run eagerly,
+    shard_map executes its body op by op and every op becomes its own
+    XLA compilation — behind the axon tunnel that is hundreds of remote
+    compile round-trips and presents as an indefinite hang (measured:
+    jitted tiny-NeoX compiles+runs in ~10s where the eager form exceeded a
+    5-minute watchdog; scripts/repro_seqpar_hang.py). Caching keeps repeat
+    calls from re-tracing through a fresh jit wrapper."""
+    body = partial(_sp_forward_local, cfg=cfg, taps=taps,
+                   stop_at_layer=stop_at_layer, axis_name=axis_name)
+    seq_sharded = P(None, axis_name)
+    early_stop = stop_at_layer is not None and stop_at_layer < cfg.n_layers
+
+    if early_stop:
+        return early_stop, jax.jit(jax.shard_map(
+            lambda p, t: body(p, t)[1],  # taps only; logits is None
+            mesh=mesh, in_specs=(P(), seq_sharded), out_specs=seq_sharded,
+            check_vma=False))
+    return early_stop, jax.jit(jax.shard_map(
+        lambda p, t: body(p, t),
+        mesh=mesh, in_specs=(P(), seq_sharded),
+        out_specs=(seq_sharded, seq_sharded), check_vma=False))
+
+
 def sequence_parallel_forward(params: dict, tokens: Array, cfg: LMConfig,
                               mesh: Mesh, taps: Sequence[str] = (),
                               stop_at_layer: Optional[int] = None,
@@ -113,27 +140,14 @@ def sequence_parallel_forward(params: dict, tokens: Array, cfg: LMConfig,
     mesh[axis_name]. tokens: [B, S] with S divisible by the axis size.
     Returns (logits or None, {tap: [B, S, width]}) with outputs sharded along
     the sequence axis."""
-    taps = tuple(taps)
     n_shards = mesh.shape[axis_name]
     if tokens.shape[1] % n_shards != 0:
         raise ValueError(f"sequence length {tokens.shape[1]} not divisible by "
                          f"mesh axis {axis_name}={n_shards}")
 
-    body = partial(_sp_forward_local, cfg=cfg, taps=taps,
-                   stop_at_layer=stop_at_layer, axis_name=axis_name)
-    seq_sharded = P(None, axis_name)
-    early_stop = stop_at_layer is not None and stop_at_layer < cfg.n_layers
-
+    early_stop, fn = _sp_program(cfg, mesh, tuple(taps), stop_at_layer,
+                                 axis_name)
     if early_stop:
-        fn = jax.shard_map(
-            lambda p, t: body(p, t)[1],  # taps only; logits is None
-            mesh=mesh, in_specs=(P(), seq_sharded), out_specs=seq_sharded,
-            check_vma=False)
         return None, fn(params, tokens)
-
-    fn = jax.shard_map(
-        lambda p, t: body(p, t),
-        mesh=mesh, in_specs=(P(), seq_sharded),
-        out_specs=(seq_sharded, seq_sharded), check_vma=False)
     logits, tapped = fn(params, tokens)
     return logits, tapped

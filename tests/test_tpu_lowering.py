@@ -54,6 +54,35 @@ def test_sharded_fused_step_lowers(rng):
     step.trace(ens.state, batch).lower(lowering_platforms=("tpu",))
 
 
+def test_ring_attention_seq_parallel_lowers(rng):
+    """AOT TPU lowering of the full sequence-parallel program: shard_map +
+    ring attention (ppermute ring inside fori_loop) + the NeoX layer stack
+    in one traced computation. Complements the on-chip run: a single-chip
+    tunnel only exercises the degenerate 1-shard ring, so the multi-shard
+    program's TPU pipeline is proven here. (The r3 on-chip "hang" was eager
+    shard_map compiling per-op through the tunnel — fixed by jitting in
+    long_context._sp_program; repro in scripts/repro_seqpar_hang.py.)"""
+    from sparse_coding_tpu.lm import gptneox
+    from sparse_coding_tpu.lm.long_context import sequence_parallel_forward
+    from sparse_coding_tpu.lm.model_config import tiny_test_config
+    from sparse_coding_tpu.parallel.mesh import make_mesh
+
+    cfg = tiny_test_config("gptneox")
+    params = gptneox.init_params(rng, cfg)
+    mesh = make_mesh(1, 4)
+    toks = jnp.zeros((2, 64 * 4), jnp.int32)
+
+    jax.jit(
+        lambda p, t: sequence_parallel_forward(p, t, cfg, mesh)
+    ).trace(params, toks).lower(lowering_platforms=("tpu",))
+
+    # the tap-only early-stop program (the harvesting path) lowers too
+    jax.jit(
+        lambda p, t: sequence_parallel_forward(
+            p, t, cfg, mesh, taps=("residual.1",), stop_at_layer=2)[1]
+    ).trace(params, toks).lower(lowering_platforms=("tpu",))
+
+
 def test_big_sae_step_lowers(rng):
     from sparse_coding_tpu.train.big_sae import init_big_sae, make_big_sae_step
 
