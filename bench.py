@@ -150,7 +150,9 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
         # and comparable to the r1/r2 whole-run averages) and the best
         # window is kept as a separately-labeled peak figure.
         window_times = []
-        for _ in range(max(1, bench_steps // scan_chunk)):
+        # at least 3 windows so the median is meaningful even when one scan
+        # chunk covers the whole nominal step budget (scan_chunk >= 50)
+        for _ in range(max(3, bench_steps // scan_chunk)):
             t0 = time.perf_counter()
             aux = ens.run_steps(batches)
             np.asarray(aux.losses["loss"])
@@ -162,7 +164,8 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
 
 def _emit(acts_per_sec_per_chip: float, *, backend: str,
           fpa: float, note: str | None = None,
-          best_window: float | None = None) -> None:
+          best_window: float | None = None,
+          variant: dict | None = None) -> None:
     peak = chip_peak_flops()
     mfu = (acts_per_sec_per_chip * fpa / peak) if peak else None
     if mfu is not None:
@@ -187,6 +190,12 @@ def _emit(acts_per_sec_per_chip: float, *, backend: str,
         # driver's documented key set.
         "timing": "median_window",
     }
+    if variant is not None:
+        # the headline is whichever variant won — self-label it so a win by
+        # e.g. scan-chunk dispatch amortization (a real system capability on
+        # a tunnel-attached chip, but a different config than round history)
+        # is visible in the artifact of record, not only in stderr
+        record["variant"] = variant
     if best_window is not None:
         print(f"bench: best sustained window = {best_window:.1f} acts/s/chip",
               file=sys.stderr)
@@ -289,6 +298,7 @@ def main() -> None:
     n_chips = len(jax.devices())
     init_done.set()
     best_rate = _time_ensemble(use_fused=False)  # XLA autodiff path
+    best_variant = {"use_fused": False}
     records = [{"variant": {"use_fused": False}, "acts_per_sec": round(float(best_rate), 1),
                 "best_window": round(best_rate.best, 1),
                 "windows": best_rate.windows}]
@@ -299,8 +309,14 @@ def main() -> None:
         # bench over an optional optimization (diagnostics go to stderr).
         # Both tied fused kernels are benched EXPLICITLY so the two_stage /
         # train_step A/B stays measurable from round artifacts.
+        # the scan_chunk=50 autodiff/fused pair isolates the kernel win from
+        # the tunnel's per-dispatch overhead (~54ms measured r4, ~45% of a
+        # 10-step window): their ratio is pool-state- and dispatch-invariant
         variants = [{"use_fused": True, "fused_path": "two_stage"},
                     {"use_fused": True, "fused_path": "train_step"},
+                    {"use_fused": False, "scan_chunk": 50},
+                    {"use_fused": True, "fused_path": "train_step",
+                     "fused_compute_dtype": "bfloat16", "scan_chunk": 50},
                     {"use_fused": False, "matmul_precision": "bfloat16"},
                     {"use_fused": True, "fused_path": "two_stage",
                      "fused_compute_dtype": "bfloat16"},
@@ -326,12 +342,13 @@ def main() -> None:
                                 "acts_per_sec": round(float(rate), 1),
                                 "best_window": round(rate.best, 1),
                                 "windows": rate.windows})
-                best_rate = max(best_rate, rate, key=float)
+                if float(rate) > float(best_rate):
+                    best_rate, best_variant = rate, kwargs
             except Exception as e:
                 print(f"bench variant {kwargs} failed: {e!r}", file=sys.stderr)
         _write_variants_artifact(records)
     _emit(float(best_rate) / n_chips, backend=jax.default_backend(), fpa=fpa,
-          best_window=best_rate.best / n_chips)
+          best_window=best_rate.best / n_chips, variant=best_variant)
 
 
 def _write_variants_artifact(records: list[dict]) -> None:

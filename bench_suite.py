@@ -62,7 +62,10 @@ def bench_ensemble(quick: bool) -> None:
                                    matmul_precision="bfloat16")),
             ("fused_bf16", dict(use_fused=True,
                                 fused_compute_dtype="bfloat16")),
-            ("untied_fused", dict(use_fused=True, sig="sae")),
+            ("untied_fused_two_stage", dict(use_fused=True, sig="sae",
+                                            fused_path="two_stage")),
+            ("untied_fused_train_step", dict(use_fused=True, sig="sae",
+                                             fused_path="train_step")),
             ("untied_fused_bf16", dict(use_fused=True, sig="sae",
                                        fused_compute_dtype="bfloat16")),
         ]

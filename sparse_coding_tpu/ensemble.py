@@ -269,6 +269,76 @@ def make_fullfused_tied_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
+def make_fullfused_untied_step(
+    adam_hypers: tuple[float, float, float],
+    donate: bool = True,
+    interpret: bool = False,
+    batch_tile: Optional[int] = None,
+    compute_dtype: str = "float32",
+) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
+    """Single-device untied-SAE whole-step path: TWO Pallas passes and no XLA
+    prologue/epilogue on the big matrices. Pass 1 (fused_untied_sae_grads)
+    normalizes the decoder in-kernel and produces losses + exact grads; pass
+    2 (fused_adam_vjp_update, feature-tiled) chains dWn through the
+    normalization VJP and applies the exact optax-Adam update to encoder and
+    decoder — one HBM read+write per tensor. A single-kernel variant (as the
+    tied family has) would keep 12 double-buffered [n, d] blocks resident
+    and exceeds VMEM at canonical shapes, hence the two-pass design. Bias
+    (+ its decay term) updates stay in XLA: [N, n] traffic is negligible and
+    the safe-norm reduction spans the full feature axis, which pass 2 tiles.
+    Numerically identical to the two-stage path (same kernels' grad math,
+    same optax formulas)."""
+    from sparse_coding_tpu.ops.fused_sae import (
+        fused_adam_vjp_update,
+        fused_untied_sae_grads,
+        pick_epilogue_tile,
+        prepare_kernel_batch,
+        untied_bias_decay_terms,
+    )
+
+    b1, b2, eps = adam_hypers
+
+    def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
+        e = state.params["encoder"]
+        dec = state.params["decoder"]
+        bias = state.params["encoder_bias"]
+        n_feats, d = e.shape[1], e.shape[2]
+        batch, tile = prepare_kernel_batch(batch, n_feats, d, batch_tile,
+                                           compute_dtype, n_mats=2)
+        ftile = pick_epilogue_tile(n_feats, d)
+        opt = state.opt_state
+        count_inc = optax.safe_increment(opt.count)
+        bc1 = 1.0 - b1 ** count_inc
+        bc2 = 1.0 - b2 ** count_inc
+        losses, de, dwn, db, activity = fused_untied_sae_grads(
+            e, dec, bias, state.buffers["l1_alpha"], batch,
+            batch_tile=tile, interpret=interpret,
+            compute_dtype=compute_dtype)
+        decay_loss, db = untied_bias_decay_terms(
+            bias, state.buffers["bias_decay"], db)
+        losses = dict(losses, bias_decay=decay_loss)
+        e2, mu_e, nu_e, d2, mu_d, nu_d = fused_adam_vjp_update(
+            e, de, opt.mu["encoder"], opt.nu["encoder"],
+            dec, dwn, opt.mu["decoder"], opt.nu["decoder"],
+            state.lrs, bc1, bc2, ftile=ftile, interpret=interpret,
+            b1=b1, b2=b2, eps=eps)
+        mu_b = b1 * opt.mu["encoder_bias"] + (1.0 - b1) * db
+        nu_b = b2 * opt.nu["encoder_bias"] + (1.0 - b2) * db * db
+        bias2 = bias - state.lrs[:, None] * (mu_b / bc1[:, None]) / (
+            jnp.sqrt(nu_b / bc2[:, None]) + eps)
+        params = {"encoder": e2, "encoder_bias": bias2, "decoder": d2}
+        opt_state = opt._replace(
+            count=count_inc,
+            mu={"encoder": mu_e, "encoder_bias": mu_b, "decoder": mu_d},
+            nu={"encoder": nu_e, "encoder_bias": nu_b, "decoder": nu_d})
+        aux = _fused_aux(losses, activity)
+        new_state = state.replace(params=params, opt_state=opt_state,
+                                  step=state.step + 1)
+        return new_state, aux
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
 def make_fused_tied_step(optimizer, donate=True, interpret=False,
                          batch_tile=None, compute_dtype="float32"):
     return make_fused_step(
@@ -490,6 +560,15 @@ class Ensemble:
                     self._adam_hypers, donate=donate,
                     interpret=fused_interpret, batch_tile=fused_batch_tile,
                     compute_dtype=fused_compute_dtype)
+            if mesh is None and make_single is make_fused_untied_step:
+                # untied family, single device: whole-step = grads kernel +
+                # feature-tiled Adam/VJP epilogue kernel (two Pallas passes;
+                # a single kernel would exceed VMEM — see
+                # make_fullfused_untied_step)
+                self._fullfused_step = make_fullfused_untied_step(
+                    self._adam_hypers, donate=donate,
+                    interpret=fused_interpret, batch_tile=fused_batch_tile,
+                    compute_dtype=fused_compute_dtype)
         # the fused kernel additionally needs a VMEM-fitting batch tile — only
         # known once the real batch arrives, so the final choice happens on
         # the first step_batch call (and is re-checked per batch size).
@@ -500,10 +579,11 @@ class Ensemble:
         self._forced_fused_path = fused_path
         if fused_path == "train_step" and self._fullfused_step is None:
             raise ValueError(
-                "fused_path='train_step' requires a single-device "
-                "identity-centered tied_sae bucket with the fused path "
-                "enabled (the whole-step kernel has no sharded or untied "
-                "variant)")
+                "fused_path='train_step' requires a single-device bucket "
+                "with the fused path enabled: identity-centered tied_sae "
+                "(one-kernel whole step) or plain sae (grads + fused "
+                "Adam/VJP epilogue); the whole-step path has no sharded "
+                "variant")
         if fused_path == "two_stage" and self._fused_step is None:
             raise ValueError(
                 "fused_path='two_stage' but no fused kernel is eligible for "
@@ -538,7 +618,8 @@ class Ensemble:
                 or (batch_size, batch_itemsize) == self._resolved_batch):
             return
         from sparse_coding_tpu.ops.fused_sae import (
-            pick_batch_tile, pick_train_step_tile, tile_fits, train_tile_fits)
+            pick_batch_tile, pick_epilogue_tile, pick_train_step_tile,
+            tile_fits, train_tile_fits)
 
         n_feats = self.state.params["encoder"].shape[1]
         d = self.state.params["encoder"].shape[2]
@@ -562,13 +643,23 @@ class Ensemble:
         # (BENCH_VARIANTS.json) measured it ~9% faster than two_stage at
         # bench scale, consistently across dtype variants.
         force = self._forced_fused_path
-        workable_full = self._fullfused_step is not None and (
-            train_tile_fits(local, self._fused_batch_tile, n_feats, d,
-                            batch_itemsize, compute_itemsize=ci, n_mats=nm)
-            if self._fused_batch_tile is not None else
-            pick_train_step_tile(local, n_feats, d,
-                                 batch_itemsize=batch_itemsize,
-                                 compute_itemsize=ci, n_mats=nm) is not None)
+        if nm == 2:
+            # untied whole-step = the SAME grads kernel as two_stage plus the
+            # feature-tiled Adam/VJP epilogue kernel, so its batch-tile
+            # admission equals `workable`; the epilogue only needs a feature
+            # tile dividing n_feats
+            workable_full = (self._fullfused_step is not None and workable
+                             and pick_epilogue_tile(n_feats, d) is not None)
+        else:
+            workable_full = self._fullfused_step is not None and (
+                train_tile_fits(local, self._fused_batch_tile, n_feats, d,
+                                batch_itemsize, compute_itemsize=ci,
+                                n_mats=nm)
+                if self._fused_batch_tile is not None else
+                pick_train_step_tile(local, n_feats, d,
+                                     batch_itemsize=batch_itemsize,
+                                     compute_itemsize=ci, n_mats=nm)
+                is not None)
         if force == "train_step" and not workable_full:
             raise ValueError(
                 f"fused_path='train_step' but no VMEM-fitting train-step "

@@ -73,7 +73,7 @@ def _working_set(batch_tile: int, n_feats: int, d: int,
                  + (0 if batch_itemsize == compute_itemsize
                     else batch_tile * d * compute_itemsize))    # xc
     # in/out BLOCKS are double-buffered by Mosaic's pipeline (×_DB);
-    # in-kernel intermediates are single copies
+    # in-kernel intermediates and scratch are single copies
     blocks = (
         n_feats * d * f32 * 2 * n_mats  # weights in + grad accumulators out
         + batch_tile * d * batch_itemsize  # x tile (stream width)
@@ -83,6 +83,7 @@ def _working_set(batch_tile: int, n_feats: int, d: int,
         batch_tile * n_feats * f32 * 2  # c and r@Wᵀ/dpre
         + batch_tile * d * (cast_copy + 2 * f32)  # x upcast, x̂, r
         + extra
+        + n_feats * d * f32             # wn scratch (in-kernel normalization)
     )
     return _DB * blocks + interm
 
@@ -177,18 +178,28 @@ def _tied_tile_grads(x_in, w, b, alpha, coef_mask=None, *, total_batch: int,
     return dw, db, activity, part
 
 
-def _kernel(alpha_ref, x_ref, w_ref, b_ref, *rest,
+def _kernel(alpha_ref, x_ref, e_ref, b_ref, *rest,
             total_batch: int, d_act: int, compute_dtype, masked: bool = False):
     import jax.experimental.pallas as pl
 
     if masked:
-        mask_ref, dw_ref, db_ref, act_ref, loss_ref = rest
+        mask_ref, dw_ref, db_ref, act_ref, loss_ref, wn_s = rest
     else:
-        mask_ref, (dw_ref, db_ref, act_ref, loss_ref) = None, rest
+        mask_ref, (dw_ref, db_ref, act_ref, loss_ref, wn_s) = None, rest
     m = pl.program_id(0)
     i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _norm():
+        # row-normalize the RAW dictionary into VMEM scratch once per member
+        # — the XLA prologue that used to produce w_normed read+wrote the
+        # whole [N, n, d] stack in HBM every step
+        e = e_ref[0]
+        norms = jnp.sqrt(jnp.sum(e * e, axis=-1, keepdims=True))
+        wn_s[...] = e / jnp.clip(norms, 1e-8)
+
     dw, db, activity, part = _tied_tile_grads(
-        x_ref[...], w_ref[0].astype(compute_dtype), b_ref[0, 0],
+        x_ref[...], wn_s[...].astype(compute_dtype), b_ref[0, 0],
         alpha_ref[m], None if mask_ref is None else mask_ref[0, 0],
         total_batch=total_batch, d_act=d_act, compute_dtype=compute_dtype)
 
@@ -210,16 +221,19 @@ def _kernel(alpha_ref, x_ref, w_ref, b_ref, *rest,
 @functools.partial(jax.jit,
                    static_argnames=("batch_tile", "interpret", "total_batch",
                                     "compute_dtype"))
-def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
+def fused_tied_sae_grads(encoder: Array, bias: Array, alphas: Array,
                          batch: Array, batch_tile: int = 256,
                          interpret: bool = False,
                          total_batch: Optional[int] = None,
                          compute_dtype: str = "float32",
                          coef_mask: Optional[Array] = None):
-    """All-member losses and gradients wrt (normalized W, bias).
+    """All-member losses and gradients wrt (normalized W, bias). The row
+    normalization W = E/‖E‖ happens IN-KERNEL (VMEM scratch, once per
+    member) — no XLA prologue materializes w_normed in HBM; the returned dW
+    is still wrt the normalized W (chain through normalize_with_vjp for dE).
 
     Args:
-      w_normed: [N, n, d] row-normalized dictionaries.
+      encoder: [N, n, d] RAW (unnormalized) dictionaries.
       bias: [N, n]; alphas: [N] l1 coefficients; batch: [B, d] shared
         (f32 or bf16 — bf16 is read half-width and cast up in VMEM).
       total_batch: loss-normalization denominator; defaults to the batch
@@ -238,7 +252,7 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    n_members, n_feats, d = w_normed.shape
+    n_members, n_feats, d = encoder.shape
     if total_batch is None:
         total_batch = batch.shape[0]
     local_batch = batch.shape[0]  # == total_batch except under shard_map
@@ -261,7 +275,7 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
         grid=(n_members, n_tiles),
         in_specs=[
             pl.BlockSpec((batch_tile, d), lambda m, i, *_: (i, 0)),  # x
-            pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0)),  # W
+            pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0)),  # E
             vec,  # b
         ] + ([vec] if masked else []),
         out_specs=[
@@ -269,6 +283,7 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
             vec, vec,
             pl.BlockSpec((1, 1, 3), lambda m, i, *_: (m, 0, 0)),
         ],
+        scratch_shapes=[pltpu.VMEM((n_feats, d), jnp.float32)],  # wn
     )
 
     # member axis is embarrassingly parallel (each m owns disjoint output
@@ -279,7 +294,7 @@ def fused_tied_sae_grads(w_normed: Array, bias: Array, alphas: Array,
         dimension_semantics=("parallel", "arbitrary"),
         vmem_limit_bytes=VMEM_LIMIT_BYTES))
 
-    operands = [alphas.astype(jnp.float32), batch, w_normed,
+    operands = [alphas.astype(jnp.float32), batch, encoder,
                 bias.reshape(n_members, 1, n_feats)]
     if masked:
         operands.append(coef_mask.astype(jnp.float32)
@@ -355,10 +370,8 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
     e = params_stacked["encoder"]
     batch, batch_tile = prepare_kernel_batch(
         batch, e.shape[1], e.shape[2], batch_tile, compute_dtype)
-    norms = jnp.clip(jnp.linalg.norm(e, axis=-1, keepdims=True), 1e-8)
-    w_normed = e / norms
     losses, dw, db, activity = fused_tied_sae_grads(
-        w_normed, params_stacked["encoder_bias"], alphas, batch,
+        e, params_stacked["encoder_bias"], alphas, batch,
         batch_tile=batch_tile, interpret=interpret, total_batch=total_batch,
         compute_dtype=compute_dtype, coef_mask=coef_mask)
     if psum_axis is not None:
@@ -373,9 +386,9 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
 
 # --- fully-fused train-step kernel (tied family) -----------------------------
 #
-# The two-stage fused path still leaves ~1/3 of the step to XLA: normalizing E
-# (read 134 MB + write 134 MB at bench scale), the dW HBM round trip, and the
-# Adam + normalization-VJP epilogue (~940 MB of f32 state traffic). This
+# The two-stage fused path still leaves part of the step to XLA: the dW HBM
+# round trip and the Adam + normalization-VJP epilogue (~940 MB of f32 state
+# traffic at bench scale; normalization itself moved in-kernel above). This
 # kernel runs the ENTIRE training step per member in one Pallas pass:
 #   i == 0:       normalize the resident E block into VMEM scratch
 #   every tile:   loss + grads, dW accumulated in scratch (never HBM)
@@ -599,8 +612,8 @@ def fused_tied_sae_train_step(encoder: Array, bias: Array,
 
 # --- untied kernel -----------------------------------------------------------
 
-def _untied_kernel(alpha_ref, x_ref, e_ref, w_ref, b_ref,
-                   de_ref, dw_ref, db_ref, act_ref, loss_ref,
+def _untied_kernel(alpha_ref, x_ref, e_ref, d_ref, b_ref,
+                   de_ref, dw_ref, db_ref, act_ref, loss_ref, wn_s,
                    *, total_batch: int, d_act: int, compute_dtype):
     """Per-(member, batch-tile) fused loss+grads for the UNTIED SAE
     (models/sae.py FunctionalSAE.loss; reference: sae_ensemble.py:41-56):
@@ -609,14 +622,23 @@ def _untied_kernel(alpha_ref, x_ref, e_ref, w_ref, b_ref,
         ∂L/∂pre = (2/(B·d) · r Wnᵀ + α/B) ⊙ [pre > 0]
         ∂L/∂E   = ∂L/∂preᵀ x     ∂L/∂Wn = 2/(B·d) · cᵀ r
         ∂L/∂b   = Σ_batch ∂L/∂pre
-    Same dtype contract as the tied kernel: bf16 x streams cast up per-tile,
-    compute_dtype=bf16 runs the dots on the MXU bf16 path, f32 accumulation."""
+    The decoder arrives RAW and is row-normalized into VMEM scratch once per
+    member (no XLA prologue in HBM). Same dtype contract as the tied kernel:
+    bf16 x streams cast up per-tile, compute_dtype=bf16 runs the dots on the
+    MXU bf16 path, f32 accumulation."""
     import jax.experimental.pallas as pl
 
     m = pl.program_id(0)
     i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _norm():
+        dec = d_ref[0]
+        norms = jnp.sqrt(jnp.sum(dec * dec, axis=-1, keepdims=True))
+        wn_s[...] = dec / jnp.clip(norms, 1e-8)
+
     e = e_ref[0].astype(compute_dtype)   # [n, d] raw encoder
-    w = w_ref[0].astype(compute_dtype)   # [n, d] normalized decoder
+    w = wn_s[...].astype(compute_dtype)  # [n, d] normalized decoder
     x_in = x_ref[...]
     xb = x_in.astype(jnp.float32)
     xc = x_in if x_in.dtype == compute_dtype else xb.astype(compute_dtype)
@@ -665,15 +687,18 @@ def _untied_kernel(alpha_ref, x_ref, e_ref, w_ref, b_ref,
 @functools.partial(jax.jit,
                    static_argnames=("batch_tile", "interpret", "total_batch",
                                     "compute_dtype"))
-def fused_untied_sae_grads(encoder: Array, w_normed: Array, bias: Array,
+def fused_untied_sae_grads(encoder: Array, decoder: Array, bias: Array,
                            alphas: Array, batch: Array, batch_tile: int = 256,
                            interpret: bool = False,
                            total_batch: Optional[int] = None,
                            compute_dtype: str = "float32"):
     """All-member losses and gradients wrt (raw encoder E, normalized decoder
-    Wn, bias) for the untied SAE. Same grid/blocking/accumulation scheme as
-    fused_tied_sae_grads with a second weight matrix resident (VMEM admission
-    uses n_mats=2). Returns (losses {mse, l1, l0}, dE, dWn, db, activity)."""
+    Wn, bias) for the untied SAE. The decoder arrives RAW — row normalization
+    happens in-kernel (VMEM scratch), dWn is wrt the normalized matrix (chain
+    through normalize_with_vjp for the raw-decoder grad). Same
+    grid/blocking/accumulation scheme as fused_tied_sae_grads with a second
+    weight matrix resident (VMEM admission uses n_mats=2).
+    Returns (losses {mse, l1, l0}, dE, dWn, db, activity)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -692,7 +717,7 @@ def fused_untied_sae_grads(encoder: Array, w_normed: Array, bias: Array,
         in_specs=[
             pl.BlockSpec((batch_tile, d), lambda m, i, *_: (i, 0)),      # x
             pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0)),   # E
-            pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0)),   # Wn
+            pl.BlockSpec((1, n_feats, d), lambda m, i, *_: (m, 0, 0)),   # D raw
             pl.BlockSpec((1, 1, n_feats), lambda m, i, *_: (m, 0, 0)),   # b
         ],
         out_specs=[
@@ -702,6 +727,7 @@ def fused_untied_sae_grads(encoder: Array, w_normed: Array, bias: Array,
             pl.BlockSpec((1, 1, n_feats), lambda m, i, *_: (m, 0, 0)),   # act
             pl.BlockSpec((1, 1, 3), lambda m, i, *_: (m, 0, 0)),         # loss
         ],
+        scratch_shapes=[pltpu.VMEM((n_feats, d), jnp.float32)],  # wn
     )
     compiler_params = (None if interpret else pltpu.CompilerParams(
         dimension_semantics=("parallel", "arbitrary"),
@@ -718,7 +744,7 @@ def fused_untied_sae_grads(encoder: Array, w_normed: Array, bias: Array,
         ],
         interpret=interpret,
         compiler_params=compiler_params,
-    )(alphas.astype(jnp.float32), batch, encoder, w_normed,
+    )(alphas.astype(jnp.float32), batch, encoder, decoder,
       bias.reshape(n_members, 1, n_feats))
 
     db = db.reshape(n_members, n_feats)
@@ -751,20 +777,139 @@ def fused_untied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
     dec = params_stacked["decoder"]
     batch, batch_tile = prepare_kernel_batch(
         batch, e.shape[1], e.shape[2], batch_tile, compute_dtype, n_mats=2)
-    norms = jnp.clip(jnp.linalg.norm(dec, axis=-1, keepdims=True), 1e-8)
-    w_normed = dec / norms
     losses, de, dw, db, activity = fused_untied_sae_grads(
-        e, w_normed, params_stacked["encoder_bias"], alphas, batch,
+        e, dec, params_stacked["encoder_bias"], alphas, batch,
         batch_tile=batch_tile, interpret=interpret, total_batch=total_batch,
         compute_dtype=compute_dtype)
     if psum_axis is not None:
         losses, de, dw, db, activity = jax.lax.psum(
             (losses, de, dw, db, activity), psum_axis)
     bias = params_stacked["encoder_bias"]
-    # _safe_norm: sqrt(Σb² + eps²) — finite gradient at b = 0
-    safe = jnp.sqrt(jnp.sum(bias * bias, axis=-1) + 1e-8 ** 2)  # [N]
-    losses["bias_decay"] = bias_decays * safe
+    decay_loss, db = untied_bias_decay_terms(bias, bias_decays, db)
+    losses["bias_decay"] = decay_loss
     grads = {"encoder": de,
-             "encoder_bias": db + (bias_decays / safe)[:, None] * bias,
+             "encoder_bias": db,
              "decoder": normalize_with_vjp(dec, dw)}
     return losses, grads, activity
+
+
+def untied_bias_decay_terms(bias: Array, bias_decays: Array,
+                            db: Array) -> tuple[Array, Array]:
+    """The untied family's bias-decay loss term and its gradient folded into
+    db — SINGLE-SOURCED for the two-stage wrapper above and the whole-step
+    builder (ensemble.make_fullfused_untied_step). Uses the documented
+    safe-norm deviation sqrt(Σb² + eps²) (models/sae.py::_safe_norm,
+    PARITY.md) so the gradient at b = 0 is finite; parity locked by
+    tests/test_torch_loss_parity.py."""
+    safe = jnp.sqrt(jnp.sum(bias * bias, axis=-1) + 1e-8 ** 2)  # [N]
+    return bias_decays * safe, db + (bias_decays / safe)[:, None] * bias
+
+
+# --- fused Adam(+normalization-VJP) epilogue (untied whole-step path) --------
+#
+# The tied family fuses its whole step into ONE kernel because a single
+# [n, d] matrix (+ its two moments) fits VMEM alongside the batch tiles. The
+# untied family carries TWO matrices × (param + grad + 2 moments) = 12 big
+# blocks — double-buffered that exceeds VMEM at canonical shapes, so its
+# whole-step path is two Pallas passes instead: the grads kernel above
+# (normalization already in-kernel), then THIS feature-tiled kernel applying
+# the normalization VJP and the exact optax-Adam update to both matrices —
+# one HBM read and one write per tensor, replacing the XLA epilogue's
+# multi-pass traffic. Feature tiles keep VMEM tiny; the d-axis row reductions
+# the VJP needs are local to a [ftile, d] block.
+
+EPILOGUE_TILES: tuple = (1024, 512, 256, 128, 64, 32, 16, 8)
+
+
+def pick_epilogue_tile(n_feats: int, d: int) -> Optional[int]:
+    """Largest feature tile that divides n_feats AND fits the epilogue
+    kernel's VMEM: 14 grid-varying [ftile, d] f32 blocks (8 in + 6 out),
+    double-buffered — ~59 MiB at ftile=1024, d=512, so large-d shapes must
+    shrink the tile. None when n_feats has no dividing tile that fits
+    (admission falls back to the two-stage path)."""
+    f32 = 4
+    for t in EPILOGUE_TILES:
+        if n_feats % t == 0 and (
+                _DB * 14 * t * d * f32 <= VMEM_BUDGET_BYTES):
+            return t
+    return None
+
+
+def _adam_vjp_kernel(lr_ref, bc1_ref, bc2_ref,
+                     e_ref, de_ref, mue_ref, nue_ref,
+                     d_ref, dwn_ref, mud_ref, nud_ref,
+                     e_out, mue_out, nue_out, d_out, mud_out, nud_out,
+                     *, b1: float, b2: float, eps: float):
+    import jax.experimental.pallas as pl
+
+    m = pl.program_id(0)
+    lr = lr_ref[m]
+    bc1 = bc1_ref[m]
+    bc2 = bc2_ref[m]
+
+    def adam(p, g, mu_in, nu_in):
+        # exact optax scale_by_adam (eps_root=0) + the engine's lr scaling
+        mu = b1 * mu_in + (1.0 - b1) * g
+        nu = b2 * nu_in + (1.0 - b2) * g * g
+        return p - lr * (mu / bc1) / (jnp.sqrt(nu / bc2) + eps), mu, nu
+
+    e2, mue, nue = adam(e_ref[0], de_ref[0], mue_ref[0], nue_ref[0])
+    e_out[0] = e2
+    mue_out[0] = mue
+    nue_out[0] = nue
+
+    # decoder: dL/dWn → dL/dD through the row-normalization VJP, then Adam
+    dmat = d_ref[0]
+    norms = jnp.clip(jnp.sqrt(jnp.sum(dmat * dmat, axis=-1, keepdims=True)),
+                     1e-8)
+    w_hat = dmat / norms
+    dwn = dwn_ref[0]
+    radial = jnp.sum(dwn * w_hat, axis=-1, keepdims=True)
+    dd = (dwn - w_hat * radial) / norms
+    d2, mud, nud = adam(dmat, dd, mud_ref[0], nud_ref[0])
+    d_out[0] = d2
+    mud_out[0] = mud
+    nud_out[0] = nud
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("ftile", "interpret", "b1", "b2", "eps"))
+def fused_adam_vjp_update(encoder: Array, de: Array, mu_e: Array, nu_e: Array,
+                          decoder: Array, dwn: Array, mu_d: Array,
+                          nu_d: Array, lrs: Array, bc1: Array, bc2: Array,
+                          ftile: int, interpret: bool = False,
+                          b1: float = 0.9, b2: float = 0.999,
+                          eps: float = 1e-8):
+    """Fused optimizer epilogue for the untied whole-step path: applies plain
+    Adam to the encoder and normalization-VJP + Adam to the raw decoder, all
+    matrices feature-tiled ([1, ftile, d] blocks). bc1/bc2: [N] bias
+    corrections 1−β^count_inc precomputed by the caller (exactly optax's).
+    Returns (new_encoder, new_mu_e, new_nu_e, new_decoder, new_mu_d,
+    new_nu_d). Bias updates stay outside — [N, n] is negligible traffic."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_members, n_feats, d = encoder.shape
+    assert n_feats % ftile == 0
+
+    kernel = functools.partial(_adam_vjp_kernel, b1=b1, b2=b2, eps=eps)
+    blk = pl.BlockSpec((1, ftile, d), lambda m, f, *_: (m, f, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(n_members, n_feats // ftile),
+        in_specs=[blk] * 8,
+        out_specs=[blk] * 6,
+    )
+    compiler_params = (None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel"),
+        vmem_limit_bytes=VMEM_LIMIT_BYTES))
+    big = jax.ShapeDtypeStruct((n_members, n_feats, d), jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[big] * 6,
+        interpret=interpret,
+        compiler_params=compiler_params,
+    )(lrs.astype(jnp.float32), bc1.astype(jnp.float32),
+      bc2.astype(jnp.float32),
+      encoder, de, mu_e, nu_e, decoder, dwn, mu_d, nu_d)

@@ -527,6 +527,85 @@ def test_untied_kernel_lowers_for_tpu():
                 ).trace(e, w, b, a, x).lower(lowering_platforms=("tpu",))
 
 
+def test_untied_train_step_matches_two_stage_and_autodiff(rng):
+    """The untied whole-step path (grads kernel + fused Adam/VJP epilogue
+    kernel) is numerically the two-stage fused path and the autodiff path,
+    step for step, including optimizer moments and the bias-decay term."""
+    from sparse_coding_tpu.models.sae import FunctionalSAE
+
+    k_init, k_data = jax.random.split(rng)
+    keys = jax.random.split(k_init, 2)
+    members = [FunctionalSAE.init(k, D, N_FEATS, l1_alpha=l1,
+                                  bias_decay=0.01)
+               for k, l1 in zip(keys, [1e-4, 3e-3])]
+    batch = jax.random.normal(k_data, (512, D))
+
+    full = Ensemble(members, FunctionalSAE, lr=1e-3, use_fused=True,
+                    fused_interpret=True, donate=False,
+                    fused_path="train_step")
+    two_stage = Ensemble(members, FunctionalSAE, lr=1e-3, use_fused=True,
+                         fused_interpret=True, donate=False,
+                         fused_path="two_stage")
+    standard = Ensemble(members, FunctionalSAE, lr=1e-3, use_fused=False,
+                        donate=False)
+
+    for _ in range(5):
+        aux_full = full.step_batch(batch)
+        aux_two = two_stage.step_batch(batch)
+        aux_std = standard.step_batch(batch)
+    assert full.fused_path == "train_step"
+    assert full._step_fn is full._fullfused_step
+    assert two_stage.fused_path == "two_stage"
+
+    for aux in (aux_two, aux_std):
+        np.testing.assert_allclose(np.asarray(aux_full.losses["loss"]),
+                                   np.asarray(aux.losses["loss"]), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(aux_full.losses["l_bias_decay"]),
+        np.asarray(aux_std.losses["l_bias_decay"]), rtol=1e-5)
+    p_full = jax.device_get(full.state.params)
+    for other in (two_stage, standard):
+        p_o = jax.device_get(other.state.params)
+        for name in p_full:
+            np.testing.assert_allclose(p_full[name], p_o[name],
+                                       rtol=1e-4, atol=1e-6,
+                                       err_msg=f"param drift: {name}")
+    mu_full = jax.device_get(full.state.opt_state.mu)
+    mu_std = jax.device_get(standard.state.opt_state.mu)
+    for name in mu_full:
+        np.testing.assert_allclose(mu_full[name], mu_std[name],
+                                   rtol=1e-4, atol=1e-7,
+                                   err_msg=f"moment drift: {name}")
+
+    # auto mode prefers the whole-step path for untied buckets too
+    auto = Ensemble(members, FunctionalSAE, lr=1e-3, use_fused=True,
+                    fused_interpret=True, donate=False)
+    auto.step_batch(batch)
+    assert auto.fused_path == "train_step"
+
+
+def test_adam_vjp_epilogue_lowers_for_tpu():
+    """AOT Mosaic lowering of the fused Adam/VJP epilogue kernel at small
+    and bench scale."""
+    from sparse_coding_tpu.ops.fused_sae import (
+        fused_adam_vjp_update,
+        pick_epilogue_tile,
+    )
+
+    for n_members, n_feats, d in ((2, 64, 32), (32, 2048, 512)):
+        big = jnp.zeros((n_members, n_feats, d))
+        vecn = jnp.zeros((n_members,))
+        ftile = pick_epilogue_tile(n_feats, d)
+        assert ftile is not None
+        jax.jit(
+            lambda e, de, mue, nue, dec, dwn, mud, nud, lrs, bc1, bc2,
+                   ft=ftile: fused_adam_vjp_update(
+                e, de, mue, nue, dec, dwn, mud, nud, lrs, bc1, bc2,
+                ftile=ft)
+        ).trace(big, big, big, big, big, big, big, big, vecn, vecn, vecn
+                ).lower(lowering_platforms=("tpu",))
+
+
 def test_fused_path_override_knob(rng):
     """The fused_path constructor knob (the bench/tune A/B): forces each
     tied kernel, auto prefers two_stage, and invalid combinations fail

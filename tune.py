@@ -38,7 +38,11 @@ from bench import _time_ensemble, chip_peak_flops, flops_per_activation
 TUNE_PATH = Path(__file__).parent / "TUNE.json"
 QUICK_TUNE_PATH = Path(__file__).parent / "TUNE.quick.json"
 
-SCAN_CHUNKS = (5, 10, 25, 50)
+# 100/200 chase the tunnel's ~54ms/dispatch overhead further down (~4% left
+# at 200). Cost is bounded: _time_ensemble floors at 3 windows, so the big
+# chunks run 3×scan_chunk timed steps (~5s at bench step time) and stage a
+# [scan, B, d] f32 batch stack (~800 MB at 200 on a 16 GB chip) — deliberate.
+SCAN_CHUNKS = (5, 10, 25, 50, 100, 200)
 
 
 def stage1_grid(on_tpu: bool, quick: bool) -> list[dict]:
