@@ -1,0 +1,232 @@
+"""Cross-layer dictionary connections: do upstream features map onto few or
+many downstream features?
+
+TPU-native counterpart of the reference's notebook analysis
+`/root/reference/inter_dict_connections.ipynb`: pick the closest-l1 dict
+from an upstream and a downstream sweep, then measure
+
+1. **Direction overlap** — |cos| similarity between the two dictionaries'
+   feature directions, vs a random-dictionary baseline (notebook cells
+   "cosine_sim"/"baseline_cosine_sim"), summarized per upstream feature by
+   the Gini coefficient of its similarity row (high Gini = the feature
+   points at FEW downstream directions; the notebook's `gini`).
+2. **Code co-activation** — streaming cross-covariance and Pearson
+   correlation between upstream and downstream CODES on paired activation
+   streams (the notebook's iterative covariance loop), again vs the random
+   baseline, with per-feature Gini of |cov| rows.
+
+    python examples/inter_dict_connections.py \
+        --up_dicts out/l2/learned_dicts.pkl --down_dicts out/l3/learned_dicts.pkl \
+        --up_acts data/layer_2 --down_acts data/layer_3 [--target_l1 8e-4]
+
+--tiny runs the identical chain hermetically (random tiny dicts, synthetic
+paired activations where downstream = rotation(upstream) + noise, so
+correlations are nontrivial) — it smokes the analysis, not dict quality.
+Outputs one JSON summary (+ optional histogram PNGs via --plots DIR).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+# runnable as `python examples/inter_dict_connections.py` without PYTHONPATH
+# (a PYTHONPATH entry breaks the axon plugin registration in this image)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def select_dict(dicts, hparam_name: str, hparam_value: float):
+    """Closest-hyperparameter member of a sweep's learned-dict list
+    (reference: inter_dict_connections.ipynb `select_dict`)."""
+    best = min(dicts, key=lambda dh: abs(float(dh[1].get(hparam_name, np.inf))
+                                         - hparam_value))
+    return best[0], float(best[1].get(hparam_name, np.nan))
+
+
+def gini_rows(mat: Array) -> Array:
+    """Gini coefficient of each (nonnegative) row — vectorized form of the
+    notebook's per-row loop: for ascending-sorted x,
+    G = 2·Σᵢ i·xᵢ / (n·Σx) − (n+1)/n."""
+    x = jnp.sort(jnp.abs(mat), axis=-1)
+    n = x.shape[-1]
+    idx = jnp.arange(1, n + 1, dtype=x.dtype)
+    total = jnp.clip(jnp.sum(x, axis=-1), 1e-12)
+    return 2.0 * (x @ idx) / (n * total) - (n + 1) / n
+
+
+def direction_overlap(up_ld, down_ld, base_ld) -> dict:
+    """|cos| similarity of feature directions + per-upstream-feature Gini,
+    dict vs random baseline (notebook: cosine_sim / baseline_cosine_sim /
+    up_gini / rand_gini)."""
+    up = up_ld.get_learned_dict()
+    down = down_ld.get_learned_dict()
+    base = base_ld.get_learned_dict()
+    sim = jnp.abs(up @ down.T)
+    base_sim = jnp.abs(base @ down.T)
+    return {
+        "cos_mean": float(jnp.mean(sim)),
+        "cos_p99": float(jnp.percentile(sim, 99)),
+        "baseline_cos_mean": float(jnp.mean(base_sim)),
+        "baseline_cos_p99": float(jnp.percentile(base_sim, 99)),
+        "gini_mean": float(jnp.mean(gini_rows(sim))),
+        "baseline_gini_mean": float(jnp.mean(gini_rows(base_sim))),
+        "_sim": sim, "_base_sim": base_sim,
+    }
+
+
+@jax.jit
+def _cov_accumulate(carry, up_codes, down_codes):
+    su, sd, suu, sdd, sud, n = carry
+    return (su + jnp.sum(up_codes, 0), sd + jnp.sum(down_codes, 0),
+            suu + jnp.sum(up_codes * up_codes, 0),
+            sdd + jnp.sum(down_codes * down_codes, 0),
+            sud + up_codes.T @ down_codes,
+            n + up_codes.shape[0])
+
+
+def _batches(acts, batch_size: int):
+    """Exact-size, in-order [batch_size, d] batches from an array or
+    ChunkStore: _iter_slabs yields whole multiples of batch_size with
+    remainders carried across chunk boundaries, so slicing each slab gives
+    row i of the stream in batch i//bs regardless of the store's chunking —
+    two streams batched this way pair row-for-row under zip."""
+    from sparse_coding_tpu.metrics.core import _iter_slabs
+
+    for slab in _iter_slabs(acts, batch_size):
+        for i in range(0, slab.shape[0], batch_size):
+            yield slab[i:i + batch_size]
+
+
+def code_covariances(up_lds, down_ld, up_acts, down_acts,
+                     batch_size: int = 8192) -> list[tuple[Array, Array]]:
+    """Streaming cross-covariance and Pearson correlation between each
+    upstream dict's codes and the downstream dict's codes on PAIRED
+    activation rows (notebook: the iterative covariance build). All
+    upstream dicts accumulate in ONE pass over the data — each slab is
+    read, decoded, and down-encoded once. Trailing rows present in only
+    one stream are dropped (equal-length streams drop nothing)."""
+    n_down = down_ld.n_dict_components()
+    carries = [(jnp.zeros(ld.n_dict_components()), jnp.zeros(n_down),
+                jnp.zeros(ld.n_dict_components()), jnp.zeros(n_down),
+                jnp.zeros((ld.n_dict_components(), n_down)),
+                jnp.zeros((), jnp.int32)) for ld in up_lds]
+    for up_b, down_b in zip(_batches(up_acts, batch_size),
+                            _batches(down_acts, batch_size)):
+        down_codes = down_ld.encode(down_b)
+        carries = [_cov_accumulate(c, ld.encode(up_b), down_codes)
+                   for c, ld in zip(carries, up_lds)]
+    out = []
+    for su, sd, suu, sdd, sud, n in carries:
+        n = jnp.maximum(n, 1).astype(jnp.float32)
+        cov = sud / n - jnp.outer(su / n, sd / n)
+        var_u = jnp.clip(suu / n - (su / n) ** 2, 1e-12)
+        var_d = jnp.clip(sdd / n - (sd / n) ** 2, 1e-12)
+        out.append((cov, cov / jnp.sqrt(jnp.outer(var_u, var_d))))
+    return out
+
+
+def analyze(up_ld, down_ld, base_ld, up_acts, down_acts,
+            batch_size: int = 8192) -> tuple[dict, dict]:
+    out = direction_overlap(up_ld, down_ld, base_ld)
+    sim, base_sim = out.pop("_sim"), out.pop("_base_sim")
+    (cov, corr), (bcov, bcorr) = code_covariances(
+        [up_ld, base_ld], down_ld, up_acts, down_acts, batch_size)
+    out.update({
+        "cov_gini_mean": float(jnp.mean(gini_rows(cov))),
+        "baseline_cov_gini_mean": float(jnp.mean(gini_rows(bcov))),
+        "corr_abs_mean": float(jnp.mean(jnp.abs(corr))),
+        "corr_p99": float(jnp.percentile(jnp.abs(corr), 99)),
+        "baseline_corr_abs_mean": float(jnp.mean(jnp.abs(bcorr))),
+    })
+    return out, {"sim": sim, "base_sim": base_sim, "corr": corr,
+                 "base_corr": bcorr}
+
+
+def _tiny_inputs(key):
+    """Hermetic stand-ins: random tiny dicts and synthetic PAIRED streams
+    where downstream = rotation(upstream) + noise, so code correlations are
+    structured, not zero. Every draw uses its own split key — in particular
+    the noise must be independent of the baseline dict created in main()."""
+    from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+
+    d, n_feats, rows = 32, 64, 4096
+    k1, k2, k4, k5, k6 = jax.random.split(key, 5)
+    up_dicts = [(FunctionalTiedSAE.to_learned_dict(
+        *FunctionalTiedSAE.init(k, d, n_feats, l1_alpha=l1)),
+        {"l1_alpha": l1}) for k, l1 in zip(jax.random.split(k1, 3),
+                                           (1e-4, 8e-4, 3e-3))]
+    down_dicts = [(FunctionalTiedSAE.to_learned_dict(
+        *FunctionalTiedSAE.init(k, d, n_feats, l1_alpha=l1)),
+        {"l1_alpha": l1}) for k, l1 in zip(jax.random.split(k2, 3),
+                                           (1e-4, 8e-4, 3e-3))]
+    up_acts = jax.random.normal(k4, (rows, d))
+    rot = jnp.linalg.qr(jax.random.normal(k5, (d, d)))[0]
+    down_acts = up_acts @ rot + 0.1 * jax.random.normal(k6, (rows, d))
+    return up_dicts, down_dicts, up_acts, down_acts
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--up_dicts")
+    parser.add_argument("--down_dicts")
+    parser.add_argument("--up_acts", help="ChunkStore dir (paired rows)")
+    parser.add_argument("--down_acts", help="ChunkStore dir (paired rows)")
+    parser.add_argument("--target_l1", type=float, default=8e-4)
+    parser.add_argument("--batch_size", type=int, default=8192)
+    parser.add_argument("--out", default="inter_dict_connections.json")
+    parser.add_argument("--plots", default=None, help="dir for hist PNGs")
+    parser.add_argument("--tiny", action="store_true")
+    args = parser.parse_args()
+
+    if args.tiny:
+        up_dicts, down_dicts, up_acts, down_acts = _tiny_inputs(
+            jax.random.PRNGKey(0))
+    else:
+        from sparse_coding_tpu.data.chunk_store import ChunkStore
+        from sparse_coding_tpu.utils.artifacts import load_learned_dicts
+
+        up_dicts = load_learned_dicts(args.up_dicts)
+        down_dicts = load_learned_dicts(args.down_dicts)
+        up_acts = ChunkStore(args.up_acts)
+        down_acts = ChunkStore(args.down_acts)
+
+    up_ld, l1_up = select_dict(up_dicts, "l1_alpha", args.target_l1)
+    down_ld, l1_down = select_dict(down_dicts, "l1_alpha", args.target_l1)
+    print(f"upstream l1={l1_up}  downstream l1={l1_down}")
+    # baseline matches the SELECTED upstream dict's shape (a ratio-sweeping
+    # pkl can hold different-width members; Gini depends on row length)
+    from sparse_coding_tpu.models.learned_dict import RandomDict
+
+    base = RandomDict.create(jax.random.PRNGKey(1),
+                             up_ld.get_learned_dict().shape[1],
+                             up_ld.n_dict_components())
+
+    summary, mats = analyze(up_ld, down_ld, base, up_acts, down_acts,
+                            args.batch_size)
+    summary["l1_up"], summary["l1_down"] = l1_up, l1_down
+    Path(args.out).write_text(json.dumps(summary, indent=2))
+    print(json.dumps(summary, indent=2))
+
+    if args.plots:
+        from sparse_coding_tpu.plotting.helpers import plot_hist
+
+        pdir = Path(args.plots)
+        pdir.mkdir(parents=True, exist_ok=True)
+        for name, mat in mats.items():
+            plot_hist(np.abs(np.asarray(mat)).ravel(),
+                      x_label=f"|{name}|", y_label="count",
+                      save_path=pdir / f"{name}.png")
+        print(f"plots -> {pdir}")
+
+
+if __name__ == "__main__":
+    main()

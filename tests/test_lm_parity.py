@@ -234,6 +234,24 @@ def test_frontier_chain_tiny(tmp_path):
     assert (tmp_path / "frontier.png").exists()
 
 
+def test_inter_dict_connections_tiny(tmp_path):
+    """The cross-layer dictionary-connections analysis (reference:
+    inter_dict_connections.ipynb — cosine overlap, code cross-covariance,
+    per-feature Gini, random baseline) runs hermetically at tiny scale."""
+    import json
+
+    out = tmp_path / "idc.json"
+    _run_example("inter_dict_connections.py", "--tiny", "--out", str(out),
+                 "--plots", str(tmp_path / "plots"))
+    s = json.loads(out.read_text())
+    for k in ("cos_mean", "baseline_cos_mean", "gini_mean",
+              "cov_gini_mean", "corr_abs_mean"):
+        assert np.isfinite(s[k]), k
+    assert 0.0 <= s["gini_mean"] <= 1.0
+    assert 0.0 <= s["corr_abs_mean"] <= 1.0
+    assert (tmp_path / "plots" / "corr.png").exists()
+
+
 def test_embedding_direction_check_tiny(tmp_path):
     """The embedding-direction analysis (reference:
     experiments/check_l0_tokens.py) runs hermetically at tiny scale."""
