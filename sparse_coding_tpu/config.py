@@ -154,6 +154,14 @@ class EnsembleArgs(BaseArgs):
     # (after compile/warmup) into <output_folder>/trace — TensorBoard/XProf
     # readable, the on-hardware tuning loop's first artifact
     profile_steps: int = 0
+    # steps fused into one device program via lax.scan (Ensemble.run_steps).
+    # Per-dispatch overhead through the axon tunnel measured ~54 ms (r4), so
+    # scan_steps=50 turns a dispatch-bound sweep into a compute-bound one —
+    # same update sequence, numerically equivalent training (XLA may fuse
+    # the scanned program differently at ULP level); logging/profiling
+    # granularity becomes per-window and host RAM briefly holds a
+    # [scan_steps, batch, d] stack (~200 MB at 50x2048x512 f32)
+    scan_steps: int = 1
 
 
 @dataclass

@@ -904,5 +904,11 @@ class EnsembleGroup:
     def step_batch(self, batch: Array) -> dict[str, AuxData]:
         return {name: ens.step_batch(batch) for name, ens in self.ensembles.items()}
 
+    def run_steps(self, batches: Array) -> dict[str, AuxData]:
+        """K scanned steps per bucket on one [K, B, d] batch stack (see
+        Ensemble.run_steps); buckets still pipeline on device."""
+        return {name: ens.run_steps(batches)
+                for name, ens in self.ensembles.items()}
+
     def to_learned_dicts(self) -> dict[str, list]:
         return {name: ens.to_learned_dicts() for name, ens in self.ensembles.items()}

@@ -57,9 +57,10 @@ def single_device_mesh() -> Mesh:
     return make_mesh(1, 1)
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Activations [batch, d] sharded over the data axis."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+def batch_sharding(mesh: Mesh, stacked: bool = False) -> NamedSharding:
+    """Activations [batch, d] — or a [K, batch, d] scan-window stack when
+    stacked=True — sharded over the data axis."""
+    return NamedSharding(mesh, P(None, DATA_AXIS) if stacked else P(DATA_AXIS))
 
 
 def ensemble_sharding(mesh: Mesh) -> NamedSharding:

@@ -50,6 +50,20 @@ EnsembleLike = Union[Ensemble, EnsembleGroup]
 EnsembleInitFn = Callable[..., list[tuple[EnsembleLike, list[dict], str]]]
 
 
+def _window_stacks(batches, k: int):
+    """Group [B, d] host batches into [K, B, d] stacks for run_steps. The
+    final short window flushes with however many batches remain, so every
+    batch trains (it compiles its own scan length at most once per sweep)."""
+    buf = []
+    for b in batches:
+        buf.append(b)
+        if len(buf) == k:
+            yield np.stack(buf)
+            buf = []
+    if buf:
+        yield np.stack(buf)
+
+
 def init_synthetic_dataset(cfg: SyntheticEnsembleArgs) -> ChunkStore:
     """Materialize a synthetic dataset to chunk files
     (reference: big_sweep.py:269-295 init_synthetic_dataset)."""
@@ -219,15 +233,29 @@ def sweep(
     else:
         save_points = {2**k - 1 for k in range(3, 10)}
     step = 0
-    timer = StepTimer(warmup=3)  # activations/sec — the north-star metric
+    last_log = 0
+    # scan_steps > 1: fuse K steps into one device program (lax.scan via
+    # run_steps) — same update sequence, one dispatch per window. Through
+    # the axon tunnel (~54 ms/dispatch measured r4) this is the difference
+    # between a dispatch-bound and a compute-bound sweep.
+    scan_k = max(1, int(getattr(cfg, "scan_steps", 1)))
+    # the timer ticks once per window, so warmup is denominated in windows;
+    # one window of K steps is already past compile+dispatch warmth (a chunk
+    # with a single window still logs 0 — raise batches/chunk or lower
+    # scan_steps if the throughput stream matters at debug scale)
+    timer = StepTimer(warmup=3 if scan_k == 1 else 1)
     # orbax: a fully-issued async checkpoint set whose swap is deferred so
     # its disk writes overlap the next chunk's training
     pending_staging: Optional[Path] = None
     # cfg.profile_steps > 0: one jax.profiler trace window opens once the
-    # first step has compiled (step 2) and closes profile_steps later —
-    # early enough that even a tiny debugging sweep produces its trace
-    profile_start = 2
+    # first program has compiled — step 2 per-step, the SECOND window under
+    # scan (the first window compiles the scanned program; starting there
+    # would trace minutes of XLA compile instead of steady-state steps) —
+    # and closes profile_steps later, on a window boundary, so it covers AT
+    # LEAST profile_steps steps.
+    profile_start = 2 if scan_k == 1 else scan_k + 1
     profiling = False
+    profile_done = False
 
     # remaining chunks stream through chunk_reader: the next chunk's disk
     # read overlaps the current chunk's training (native/chunkio.cpp
@@ -247,22 +275,44 @@ def sweep(
                 # out-of-place would briefly hold two full chunks in RAM
                 chunk -= center.astype(train_np_dtype)
             batches = store.batches(chunk, cfg.batch_size, rng)
-            for batch in device_prefetch(batches, sharding):
-                step += 1
-                if cfg.profile_steps > 0 and step == profile_start:
+            if scan_k > 1:
+                batches = _window_stacks(batches, scan_k)
+                window_sharding = (batch_sharding(mesh, stacked=True)
+                                   if mesh is not None else None)
+            else:
+                window_sharding = sharding
+            for batch in device_prefetch(batches, window_sharding):
+                k_steps = batch.shape[0] if scan_k > 1 else 1
+                step += k_steps
+                if (cfg.profile_steps > 0 and not profiling
+                        and not profile_done and step >= profile_start):
                     jax.profiler.start_trace(str(out_dir / "trace"))
                     profiling = True
-                elif profiling and step == profile_start + cfg.profile_steps:
+                elif profiling and step >= profile_start + cfg.profile_steps:
                     jax.profiler.stop_trace()
                     profiling = False
+                    profile_done = True
+                do_log = step - last_log >= log_every
+                if do_log:
+                    last_log = step
                 for ens_idx, (ensemble, hypers, name) in enumerate(ensembles):
                     is_group = isinstance(ensemble, EnsembleGroup)
-                    if is_group:
-                        auxes = ensemble.step_batch(batch)
-                        aux_items = list(auxes.items())
+                    if scan_k > 1:
+                        # aux comes back stacked [K, ...]; the window's last
+                        # step is sliced out ONLY when logging (the slice is
+                        # its own device dispatch — paying it per window
+                        # would re-import the overhead scan_steps removes)
+                        stepper = ensemble.run_steps
+                        last = lambda aux: jax.tree.map(lambda a: a[-1], aux)
                     else:
-                        aux_items = [(name, ensemble.step_batch(batch))]
-                    if step % log_every == 0:
+                        stepper = ensemble.step_batch
+                        last = lambda aux: aux
+                    if is_group:
+                        raw_items = list(stepper(batch).items())
+                    else:
+                        raw_items = [(name, stepper(batch))]
+                    if do_log:
+                        aux_items = [(n, last(a)) for n, a in raw_items]
                         for sub_name, aux in aux_items:
                             losses = jax.device_get(aux.losses["loss"])
                             l0 = jax.device_get(aux.l0)
@@ -284,8 +334,9 @@ def sweep(
                                 rec[f"{sub_name}/{member}/loss"] = float(loss_i)
                                 rec[f"{sub_name}/{member}/l0"] = float(l0_i)
                             logger.log(rec, step=step)
-                timer.tick(batch.shape[0])
-                if step % log_every == 0:
+                timer.tick(batch.shape[0] * (batch.shape[1]
+                                             if scan_k > 1 else 1))
+                if do_log:
                     logger.log({"activations_per_sec": timer.items_per_sec},
                                step=step)
             # checkpoint + periodic artifact saves; the RNG state makes the

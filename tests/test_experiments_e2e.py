@@ -86,6 +86,27 @@ def test_zero_l1_baseline_sweep(base_cfg):
     assert fvus[0.0] < fvus[max(fvus)], fvus
 
 
+def test_scan_steps_trains_identically(base_cfg):
+    """cfg.scan_steps fuses K steps per device program (run_steps windows)
+    without changing the training outcome: same seed, same batch stream,
+    same update sequence — final dictionaries match the per-step driver.
+    K=4 over this config's 15 batches/chunk gives 3 full windows + a
+    3-batch tail, so the short-tail-window path is exercised too."""
+    cfg1 = base_cfg("scan1")
+    r1 = sweep(lambda c, m: tied_vs_not_experiment(
+        c, m, l1_range=[1e-3], activation_dim=24), cfg1, log_every=10)
+    cfg3 = base_cfg("scan3", scan_steps=4)
+    r3 = sweep(lambda c, m: tied_vs_not_experiment(
+        c, m, l1_range=[1e-3], activation_dim=24), cfg3, log_every=10)
+    for fam in ("tied", "untied"):
+        (ld1, h1), (ld3, h3) = r1[fam][0], r3[fam][0]
+        assert h1 == h3
+        np.testing.assert_allclose(
+            np.asarray(ld1.get_learned_dict()),
+            np.asarray(ld3.get_learned_dict()), rtol=1e-5, atol=1e-6,
+            err_msg=f"{fam}: scan_steps changed the training outcome")
+
+
 def test_residual_denoising_experiment_sweep(base_cfg):
     cfg = base_cfg("lista")
     result = sweep(lambda c, m: residual_denoising_experiment(

@@ -102,3 +102,14 @@ def test_sweep_on_mesh(rng, devices8, tmp_path):
         np.testing.assert_allclose(np.asarray(ld_m.dictionary),
                                    np.asarray(ld_p.dictionary),
                                    rtol=1e-4, atol=1e-5)
+
+    # scan windows compose with the mesh: [K, B, d] stacks sharded
+    # P(None, "data"), same training outcome
+    cfg_scan = SyntheticEnsembleArgs(output_folder=str(tmp_path / "scan_out"),
+                                     mesh_model=2, mesh_data=4, scan_steps=4,
+                                     **base)
+    scanned = sweep(init_fn, cfg_scan, log_every=10)["dense_l1_range"]
+    for (ld_s, _), (ld_p, _) in zip(scanned, plain):
+        np.testing.assert_allclose(np.asarray(ld_s.dictionary),
+                                   np.asarray(ld_p.dictionary),
+                                   rtol=1e-4, atol=1e-5)
