@@ -98,7 +98,8 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
                    n_members=None, batch=None, bench_steps=None,
                    scan_chunk=None, batch_dtype=None,
                    batch_tile=None, fused_compute_dtype=None,
-                   sig="tied_sae", fused_path=None) -> WindowedRate:
+                   sig="tied_sae", fused_path=None,
+                   fused_moments_dtype=None) -> WindowedRate:
     """Shared ensemble-throughput measurement (bench_suite.py and tune.py
     reuse it with their own scales; batch_tile forces the fused kernel's
     batch tile, None = auto-pick; fused_compute_dtype="bfloat16" runs the
@@ -128,7 +129,8 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
         ens = Ensemble(members, sig_cls, lr=1e-3, use_fused=use_fused,
                        fused_batch_tile=batch_tile,
                        fused_compute_dtype=fused_compute_dtype or "float32",
-                       fused_path=fused_path)
+                       fused_path=fused_path,
+                       fused_moments_dtype=fused_moments_dtype or "float32")
 
         batches = jax.random.normal(jax.random.PRNGKey(1),
                                     (scan_chunk, batch, d_act))
@@ -265,7 +267,8 @@ def _load_tuned_variant(path: str | None = None) -> dict | None:
         return None
     best = data.get("best") or {}
     keys = ("use_fused", "matmul_precision", "batch_dtype", "scan_chunk",
-            "batch_tile", "fused_compute_dtype", "fused_path")
+            "batch_tile", "fused_compute_dtype", "fused_path",
+            "fused_moments_dtype")
     variant = {k: v for k, v in best.items() if k in keys and v is not None}
     if variant.get("scan_chunk") == SCAN_CHUNK:
         del variant["scan_chunk"]  # default — keep the variant dedupable
@@ -325,7 +328,15 @@ def main() -> None:
                      "batch_dtype": "bfloat16"},
                     {"use_fused": True, "fused_path": "train_step",
                      "fused_compute_dtype": "bfloat16",
-                     "batch_dtype": "bfloat16"}]
+                     "batch_dtype": "bfloat16"},
+                    # opt-in half-width Adam-moment storage (documented
+                    # deviation from exact optax parity; math stays f32) —
+                    # differs from the previous variant in ONLY this knob,
+                    # so the artifact isolates the moment-storage effect
+                    {"use_fused": True, "fused_path": "train_step",
+                     "fused_compute_dtype": "bfloat16",
+                     "batch_dtype": "bfloat16",
+                     "fused_moments_dtype": "bfloat16"}]
         tuned = _load_tuned_variant()
         if tuned is not None and tuned not in variants:
             print(f"bench: adding tuned variant from TUNE.json: {tuned}",

@@ -240,11 +240,13 @@ def make_fullfused_tied_step(
     b1, b2, eps = adam_hypers
 
     def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
+        opt = state.opt_state
         batch, tile = prepare_kernel_batch(
             batch, state.params["encoder"].shape[1],
             state.params["encoder"].shape[2], batch_tile, compute_dtype,
-            picker=pick_train_step_tile)
-        opt = state.opt_state
+            picker=functools.partial(
+                pick_train_step_tile,
+                moments_itemsize=opt.mu["encoder"].dtype.itemsize))
         count_inc = optax.safe_increment(opt.count)
         bc1 = 1.0 - b1 ** count_inc
         bc2 = 1.0 - b2 ** count_inc
@@ -472,11 +474,25 @@ class Ensemble:
         fused_batch_tile: Optional[int] = None,
         fused_compute_dtype: str = "float32",
         fused_path: Optional[str] = None,
+        fused_moments_dtype: str = "float32",
     ):
         if fused_path not in (None, "two_stage", "train_step"):
             raise ValueError(
                 f"fused_path must be None, 'two_stage' or 'train_step', got "
                 f"{fused_path!r}")
+        if fused_moments_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"fused_moments_dtype must be 'float32' or 'bfloat16', got "
+                f"{fused_moments_dtype!r}")
+        if fused_moments_dtype != "float32" and fused_path != "train_step":
+            raise ValueError(
+                "fused_moments_dtype='bfloat16' requires "
+                "fused_path='train_step': only the whole-step kernels carry "
+                "moments through VMEM (the win is their halved HBM traffic),"
+                " and an auto-mode path flip would silently change the "
+                "optimizer-state dtype mid-run. It is an opt-in DEVIATION "
+                "from exact optax/torchopt parity (~8-bit moment mantissas; "
+                "update math stays f32).")
         if fused_path is not None and use_fused is False:
             raise ValueError("fused_path requires use_fused=True or 'auto'")
         if not members:
@@ -503,6 +519,14 @@ class Ensemble:
         if lrs.shape != (n,):
             raise ValueError(f"lr must be scalar or length-{n}, got shape {lrs.shape}")
         opt_state = jax.vmap(self.optimizer.init)(params)
+        if fused_moments_dtype == "bfloat16":
+            # half-width storage for the BIG ([N, n, d]) moment leaves only;
+            # bias moments stay f32 (negligible traffic, less deviation)
+            cast = lambda tree: jax.tree.map(
+                lambda a: a.astype(jnp.bfloat16) if a.ndim == 3 else a, tree)
+            opt_state = opt_state._replace(mu=cast(opt_state.mu),
+                                           nu=cast(opt_state.nu))
+        self._moments_itemsize = 2 if fused_moments_dtype == "bfloat16" else 4
 
         self.state = EnsembleState(
             params=params, buffers=buffers, opt_state=opt_state, lrs=lrs,
@@ -651,14 +675,16 @@ class Ensemble:
             workable_full = (self._fullfused_step is not None and workable
                              and pick_epilogue_tile(n_feats, d) is not None)
         else:
+            mi = self._moments_itemsize
             workable_full = self._fullfused_step is not None and (
                 train_tile_fits(local, self._fused_batch_tile, n_feats, d,
                                 batch_itemsize, compute_itemsize=ci,
-                                n_mats=nm)
+                                n_mats=nm, moments_itemsize=mi)
                 if self._fused_batch_tile is not None else
                 pick_train_step_tile(local, n_feats, d,
                                      batch_itemsize=batch_itemsize,
-                                     compute_itemsize=ci, n_mats=nm)
+                                     compute_itemsize=ci, n_mats=nm,
+                                     moments_itemsize=mi)
                 is not None)
         if force == "train_step" and not workable_full:
             raise ValueError(

@@ -405,9 +405,12 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
 
 def _train_working_set(batch_tile: int, n_feats: int, d: int,
                        batch_itemsize: int = 4, compute_itemsize: int = 4,
-                       n_mats: int = 1) -> int:
+                       n_mats: int = 1, moments_itemsize: int = 4) -> int:
     """VMEM model for the train-step kernel: the two-stage model plus the
-    moment in/out blocks and the wn/dW scratch, minus the dW output block."""
+    moment in/out blocks and the wn/dW scratch, minus the dW output block.
+    moments_itemsize=2 models bf16 Adam-moment storage (the blocks ride
+    half-width; the in-kernel f32 upcasts are transient VPU registers, not
+    resident copies, matching how Mosaic materializes elementwise chains)."""
     f32 = 4
     cast_copy = f32 if batch_itemsize < f32 else 0
     extra = 0
@@ -418,10 +421,11 @@ def _train_working_set(batch_tile: int, n_feats: int, d: int,
                  + (0 if batch_itemsize == compute_itemsize
                     else batch_tile * d * compute_itemsize))
     big = n_feats * d * f32
-    in_blocks = (3 * n_mats * big              # params + 2 moments per matrix
+    big_m = n_feats * d * moments_itemsize
+    in_blocks = (n_mats * (big + 2 * big_m)    # params + 2 moments per matrix
                  + batch_tile * d * batch_itemsize
                  + n_feats * f32 * 3)          # b, mu_b, nu_b
-    out_blocks = (3 * n_mats * big             # updated params + moments
+    out_blocks = (n_mats * (big + 2 * big_m)   # updated params + moments
                   + n_feats * f32 * 5)         # b', mu_b', nu_b', act, losses
     scratch = (1 + n_mats) * big + n_feats * f32  # wn + grad accum(s) + db
     interm = (batch_tile * n_feats * f32 * 2
@@ -432,21 +436,23 @@ def _train_working_set(batch_tile: int, n_feats: int, d: int,
 
 def pick_train_step_tile(batch: int, n_feats: int, d: int,
                          batch_itemsize: int = 4, compute_itemsize: int = 4,
-                         n_mats: int = 1) -> Optional[int]:
+                         n_mats: int = 1,
+                         moments_itemsize: int = 4) -> Optional[int]:
     for tile in PREFERRED_TILES:
         if batch % tile == 0 and _train_working_set(
-                tile, n_feats, d, batch_itemsize,
-                compute_itemsize, n_mats) <= VMEM_BUDGET_BYTES:
+                tile, n_feats, d, batch_itemsize, compute_itemsize,
+                n_mats, moments_itemsize) <= VMEM_BUDGET_BYTES:
             return tile
     return None
 
 
 def train_tile_fits(batch: int, tile: int, n_feats: int, d: int,
                     batch_itemsize: int = 4, compute_itemsize: int = 4,
-                    n_mats: int = 1) -> bool:
+                    n_mats: int = 1, moments_itemsize: int = 4) -> bool:
     return (batch % tile == 0
             and _train_working_set(tile, n_feats, d, batch_itemsize,
-                                   compute_itemsize, n_mats)
+                                   compute_itemsize, n_mats,
+                                   moments_itemsize)
             <= VMEM_BUDGET_BYTES)
 
 
@@ -505,10 +511,12 @@ def _tied_train_kernel(alpha_ref, lr_ref, bc1_ref, bc2_ref,
         lr = lr_ref[m]
         bc1 = bc1_ref[m]
         bc2 = bc2_ref[m]
-        mu = b1 * mu_ref[0] + (1.0 - b1) * de
-        nu = b2 * nu_ref[0] + (1.0 - b2) * de * de
-        mu_out[0] = mu
-        nu_out[0] = nu
+        # moments may be stored sub-f32 (bf16 halves their HBM traffic —
+        # opt-in, Ensemble fused_moments_dtype); math always runs f32
+        mu = b1 * mu_ref[0].astype(jnp.float32) + (1.0 - b1) * de
+        nu = b2 * nu_ref[0].astype(jnp.float32) + (1.0 - b2) * de * de
+        mu_out[0] = mu.astype(mu_out.dtype)
+        nu_out[0] = nu.astype(nu_out.dtype)
         e_out[0] = e - lr * (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
         db_acc = db_s[...][0]
         mub = b1 * mub_ref[0, 0] + (1.0 - b1) * db_acc
@@ -590,8 +598,10 @@ def fused_tied_sae_train_step(encoder: Array, bias: Array,
         out_shape=[
             jax.ShapeDtypeStruct((n_members, n_feats, d), jnp.float32),
             jax.ShapeDtypeStruct((n_members, 1, n_feats), jnp.float32),
-            jax.ShapeDtypeStruct((n_members, n_feats, d), jnp.float32),
-            jax.ShapeDtypeStruct((n_members, n_feats, d), jnp.float32),
+            # moment outputs keep their STORAGE dtype (bf16 when the engine
+            # opted into half-width moments; math inside the kernel is f32)
+            jax.ShapeDtypeStruct((n_members, n_feats, d), mu_e.dtype),
+            jax.ShapeDtypeStruct((n_members, n_feats, d), nu_e.dtype),
             jax.ShapeDtypeStruct((n_members, 1, n_feats), jnp.float32),
             jax.ShapeDtypeStruct((n_members, 1, n_feats), jnp.float32),
             jax.ShapeDtypeStruct((n_members, 1, n_feats), jnp.float32),
@@ -848,15 +858,17 @@ def _adam_vjp_kernel(lr_ref, bc1_ref, bc2_ref,
     bc2 = bc2_ref[m]
 
     def adam(p, g, mu_in, nu_in):
-        # exact optax scale_by_adam (eps_root=0) + the engine's lr scaling
-        mu = b1 * mu_in + (1.0 - b1) * g
-        nu = b2 * nu_in + (1.0 - b2) * g * g
+        # exact optax scale_by_adam (eps_root=0) + the engine's lr scaling;
+        # moments may be STORED sub-f32 (bf16 halves their HBM traffic) —
+        # the math always runs f32
+        mu = b1 * mu_in.astype(jnp.float32) + (1.0 - b1) * g
+        nu = b2 * nu_in.astype(jnp.float32) + (1.0 - b2) * g * g
         return p - lr * (mu / bc1) / (jnp.sqrt(nu / bc2) + eps), mu, nu
 
     e2, mue, nue = adam(e_ref[0], de_ref[0], mue_ref[0], nue_ref[0])
     e_out[0] = e2
-    mue_out[0] = mue
-    nue_out[0] = nue
+    mue_out[0] = mue.astype(mue_out.dtype)
+    nue_out[0] = nue.astype(nue_out.dtype)
 
     # decoder: dL/dWn → dL/dD through the row-normalization VJP, then Adam
     dmat = d_ref[0]
@@ -868,8 +880,8 @@ def _adam_vjp_kernel(lr_ref, bc1_ref, bc2_ref,
     dd = (dwn - w_hat * radial) / norms
     d2, mud, nud = adam(dmat, dd, mud_ref[0], nud_ref[0])
     d_out[0] = d2
-    mud_out[0] = mud
-    nud_out[0] = nud
+    mud_out[0] = mud.astype(mud_out.dtype)
+    nud_out[0] = nud.astype(nud_out.dtype)
 
 
 @functools.partial(jax.jit,
@@ -903,11 +915,17 @@ def fused_adam_vjp_update(encoder: Array, de: Array, mu_e: Array, nu_e: Array,
     compiler_params = (None if interpret else pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel"),
         vmem_limit_bytes=VMEM_LIMIT_BYTES))
-    big = jax.ShapeDtypeStruct((n_members, n_feats, d), jnp.float32)
+
+    def big(dtype=jnp.float32):
+        return jax.ShapeDtypeStruct((n_members, n_feats, d), dtype)
+
+    # moment outputs keep their STORAGE dtype (bf16 when the engine opted
+    # into half-width moments); params always f32
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[big] * 6,
+        out_shape=[big(), big(mu_e.dtype), big(nu_e.dtype),
+                   big(), big(mu_d.dtype), big(nu_d.dtype)],
         interpret=interpret,
         compiler_params=compiler_params,
     )(lrs.astype(jnp.float32), bc1.astype(jnp.float32),

@@ -380,6 +380,16 @@ def test_train_step_kernel_lowers_for_tpu():
                         jnp.ones_like(a), jnp.ones_like(a), x,
                         batch_tile=64, compute_dtype=cd)
                 ).trace(e, b, a, lrs, x).lower(lowering_platforms=("tpu",))
+    # bf16 moment STORAGE lowers too (bench scale)
+    e = jnp.zeros((32, 2048, 512))
+    b, a = jnp.zeros((32, 2048)), jnp.zeros((32,))
+    m = jnp.zeros(e.shape, jnp.bfloat16)
+    jax.jit(
+        lambda e, b, a, m, x: fused_tied_sae_train_step(
+            e, b, m, m, jnp.zeros_like(b), jnp.zeros_like(b), a, a,
+            jnp.ones_like(a), jnp.ones_like(a), x, batch_tile=64)
+    ).trace(e, b, a, m, jnp.zeros((2048, 512))
+            ).lower(lowering_platforms=("tpu",))
 
 
 # --- untied kernel -----------------------------------------------------------
@@ -597,13 +607,68 @@ def test_adam_vjp_epilogue_lowers_for_tpu():
         vecn = jnp.zeros((n_members,))
         ftile = pick_epilogue_tile(n_feats, d)
         assert ftile is not None
-        jax.jit(
-            lambda e, de, mue, nue, dec, dwn, mud, nud, lrs, bc1, bc2,
-                   ft=ftile: fused_adam_vjp_update(
-                e, de, mue, nue, dec, dwn, mud, nud, lrs, bc1, bc2,
-                ftile=ft)
-        ).trace(big, big, big, big, big, big, big, big, vecn, vecn, vecn
-                ).lower(lowering_platforms=("tpu",))
+        for m_dtype in (jnp.float32, jnp.bfloat16):
+            # bf16 = the fused_moments_dtype storage path: half-width
+            # moment blocks must clear Mosaic's bf16 tiling rules too
+            m = jnp.zeros((n_members, n_feats, d), m_dtype)
+            jax.jit(
+                lambda e, de, mue, nue, dec, dwn, mud, nud, lrs, bc1, bc2,
+                       ft=ftile: fused_adam_vjp_update(
+                    e, de, mue, nue, dec, dwn, mud, nud, lrs, bc1, bc2,
+                    ftile=ft)
+            ).trace(big, big, m, m, big, big, m, m, vecn, vecn, vecn
+                    ).lower(lowering_platforms=("tpu",))
+
+
+def test_bf16_moments_opt_in(rng):
+    """fused_moments_dtype='bfloat16' (opt-in, train_step only): big moment
+    leaves are stored half-width and keep that dtype across steps; update
+    math stays f32 so the trajectory tracks the f32-moments path closely;
+    requesting it without the whole-step path fails fast."""
+    from sparse_coding_tpu.models.sae import FunctionalSAE
+
+    k_init, k_data = jax.random.split(rng)
+    members = [FunctionalTiedSAE.init(k, D, N_FEATS, l1_alpha=1e-3)
+               for k in jax.random.split(k_init, 2)]
+    batch = jax.random.normal(k_data, (BATCH, D))
+
+    bf = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=True,
+                  fused_interpret=True, donate=False,
+                  fused_path="train_step", fused_moments_dtype="bfloat16")
+    f32 = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=True,
+                   fused_interpret=True, donate=False,
+                   fused_path="train_step")
+    for _ in range(5):
+        aux_bf = bf.step_batch(batch)
+        aux_f = f32.step_batch(batch)
+    assert bf.state.opt_state.mu["encoder"].dtype == jnp.bfloat16
+    assert bf.state.opt_state.nu["encoder"].dtype == jnp.bfloat16
+    assert bf.state.opt_state.mu["encoder_bias"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(aux_bf.losses["loss"]),
+                               np.asarray(aux_f.losses["loss"]), rtol=5e-3)
+    for name in f32.state.params:
+        np.testing.assert_allclose(
+            np.asarray(bf.state.params[name]),
+            np.asarray(f32.state.params[name]), atol=5e-4,
+            err_msg=f"bf16-moments trajectory diverged: {name}")
+
+    # the untied whole-step path (epilogue kernel) honors the knob too
+    u_members = [FunctionalSAE.init(k, D, N_FEATS, l1_alpha=1e-3)
+                 for k in jax.random.split(k_init, 2)]
+    ubf = Ensemble(u_members, FunctionalSAE, lr=1e-3, use_fused=True,
+                   fused_interpret=True, donate=False,
+                   fused_path="train_step", fused_moments_dtype="bfloat16")
+    ubf.step_batch(batch)
+    assert ubf.state.opt_state.nu["decoder"].dtype == jnp.bfloat16
+    assert ubf.state.opt_state.mu["encoder"].dtype == jnp.bfloat16
+
+    with pytest.raises(ValueError, match="requires"):
+        Ensemble(members, FunctionalTiedSAE, use_fused=True,
+                 fused_interpret=True, fused_moments_dtype="bfloat16")
+    with pytest.raises(ValueError, match="fused_moments_dtype must be"):
+        Ensemble(members, FunctionalTiedSAE, use_fused=True,
+                 fused_interpret=True, fused_path="train_step",
+                 fused_moments_dtype="float16")
 
 
 def test_fused_path_override_knob(rng):

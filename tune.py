@@ -87,6 +87,15 @@ def dtype_grid(best: dict) -> list[dict]:
             continue  # == the tile winner itself
         configs.append({**base, "fused_compute_dtype": compute,
                         "batch_dtype": batch_dtype})
+    if base.get("fused_path") == "train_step":
+        # opt-in bf16 moment storage (halves the whole-step kernel's
+        # optimizer-state HBM traffic; documented optax-parity deviation) —
+        # measured with BOTH batch streams so the moments effect is
+        # isolated against each dtype-grid comparator
+        for batch_dtype in (None, "bfloat16"):
+            configs.append({**base, "fused_compute_dtype": "bfloat16",
+                            "batch_dtype": batch_dtype,
+                            "fused_moments_dtype": "bfloat16"})
     return configs
 
 
