@@ -282,3 +282,7 @@ class BigSAEArgs(BaseArgs):
     resurrect_every: int = 500
     mesh_data: int = 1
     seed: int = 0
+    # steps fused per device program (lax.scan) — see EnsembleArgs.scan_steps.
+    # Resurrection checks run on window boundaries, so the effective interval
+    # rounds up to a multiple of scan_steps.
+    scan_steps: int = 1

@@ -39,9 +39,11 @@ def basic_l1_sweep(
     seed: int = 0,
     mesh=None,
     use_wandb: bool = False,
+    scan_steps: int = 1,
 ) -> list:
     """Train one ensemble member per l1 value; save per-epoch artifacts.
-    Returns the final list of (LearnedDict, hyperparams)."""
+    Returns the final list of (LearnedDict, hyperparams). scan_steps > 1
+    fuses K steps per device program (see EnsembleArgs.scan_steps)."""
     store = ChunkStore(dataset_dir)
     d = store.activation_dim  # inferred from chunk 0, as basic_l1_sweep.py:59-62
     n_dict = int(d * dict_ratio)
@@ -57,12 +59,28 @@ def basic_l1_sweep(
     sharding = batch_sharding(mesh) if mesh is not None else None
 
     step = 0
+    last_log = 0
+    scan_k = max(1, int(scan_steps))
+    if scan_k > 1:
+        from sparse_coding_tpu.train.sweep import _window_stacks
+
+        if mesh is not None:
+            sharding = batch_sharding(mesh, stacked=True)
     for epoch in range(n_epochs):
         batches = store.epoch(batch_size, rng)
+        if scan_k > 1:
+            batches = _window_stacks(batches, scan_k)
         for batch in device_prefetch(batches, sharding):
-            aux = ens.step_batch(batch)
-            step += 1
-            if step % 100 == 0:
+            if scan_k > 1:
+                aux = ens.run_steps(batch)
+                step += batch.shape[0]
+            else:
+                aux = ens.step_batch(batch)
+                step += 1
+            if step - last_log >= 100:
+                last_log = step
+                if scan_k > 1:
+                    aux = jax.tree.map(lambda a: a[-1], aux)
                 losses = jax.device_get(aux.losses)
                 l0 = jax.device_get(aux.l0)
                 for i, l1 in enumerate(l1_values):
@@ -105,7 +123,8 @@ def main(argv=None) -> None:
     basic_l1_sweep(cfg.dataset_folder, cfg.output_folder, l1_values,
                    dict_ratio=cfg.learned_dict_ratio, batch_size=cfg.batch_size,
                    lr=cfg.lr, tied=cfg.tied_ae, adam_epsilon=cfg.adam_epsilon,
-                   seed=cfg.seed, mesh=mesh, use_wandb=cfg.use_wandb)
+                   seed=cfg.seed, mesh=mesh, use_wandb=cfg.use_wandb,
+                   scan_steps=cfg.scan_steps)
 
 
 if __name__ == "__main__":

@@ -422,16 +422,45 @@ def train_big_sae(cfg, store=None, mesh: Optional[Mesh] = None,
     step_fn = make_big_sae_step(optimizer, l1, mesh)
 
     rng = np.random.default_rng(cfg.seed)
-    sharding = NamedSharding(mesh, P("data")) if mesh is not None else None
+    scan_k = max(1, int(getattr(cfg, "scan_steps", 1)))
+    if scan_k > 1:
+        # K steps per device program; [K, B, d] windows sharded P(None,
+        # "data"). Same update sequence — resurrection and logging move to
+        # window boundaries (see BigSAEArgs.scan_steps).
+        from sparse_coding_tpu.train.sweep import _window_stacks
+
+        window_fn = jax.jit(
+            lambda s, stack: jax.lax.scan(step_fn, s, stack),
+            donate_argnums=(0,))
+        sharding = (NamedSharding(mesh, P(None, "data"))
+                    if mesh is not None else None)
+    else:
+        window_fn = None
+        sharding = NamedSharding(mesh, P("data")) if mesh is not None else None
     steps = 0
+    last_log = 0
+    last_resurrect = 0
     for epoch in range(cfg.n_epochs):
         batches = store.epoch(cfg.batch_size, rng)
+        if scan_k > 1:
+            batches = _window_stacks(batches, scan_k)
         for batch in device_prefetch(batches, sharding):
-            state, metrics = step_fn(state, batch)
-            steps += 1
-            if logger is not None and steps % 100 == 0:
+            if scan_k > 1:
+                state, metrics = window_fn(state, batch)
+                steps += batch.shape[0]
+            else:
+                state, metrics = step_fn(state, batch)
+                steps += 1
+            if logger is not None and steps - last_log >= 100:
+                last_log = steps
+                if scan_k > 1:
+                    # slice the window's last step only when logging — the
+                    # slice is its own device dispatch
+                    metrics = {k: v[-1] for k, v in metrics.items()}
                 logger.log({k: float(v) for k, v in metrics.items()}, step=steps)
-            if cfg.resurrect_every and steps % cfg.resurrect_every == 0:
+            if (cfg.resurrect_every
+                    and steps - last_resurrect >= cfg.resurrect_every):
+                last_resurrect = steps
                 state, n_dead = resurrect_dead_features(state)
                 if logger is not None:
                     logger.log({"n_dead_feats": int(n_dead)}, step=steps)

@@ -103,6 +103,31 @@ def test_big_sae_trains(rng):
     assert export_fvu < 1.0, f"export FVU {export_fvu} inconsistent with training"
 
 
+def test_big_sae_scan_steps_equivalent(tmp_path, rng):
+    """train_big_sae with scan_steps windows reproduces the per-step loop's
+    final params (same seed, same batch stream; 15 batches over K=4
+    windows exercises the short tail too)."""
+    from sparse_coding_tpu.config import BigSAEArgs
+    from sparse_coding_tpu.data.chunk_store import ChunkWriter
+    from sparse_coding_tpu.train.big_sae import train_big_sae
+
+    d = 16
+    w = ChunkWriter(tmp_path / "chunks", d,
+                    chunk_size_gb=2000 * d * 2 / 2**30, dtype="float16")
+    w.add(np.asarray(jax.random.normal(rng, (4000, d)), np.float16))
+    w.finalize()
+    base = dict(activation_dim=d, n_feats=32, l1_alpha=1e-3, lr=1e-3,
+                batch_size=256, dataset_folder=str(tmp_path / "chunks"),
+                n_epochs=1, resurrect_every=0, seed=3)
+    s1 = train_big_sae(BigSAEArgs(output_folder=str(tmp_path / "o1"), **base))
+    s2 = train_big_sae(BigSAEArgs(output_folder=str(tmp_path / "o2"),
+                                  scan_steps=4, **base))
+    for k in s1.params:
+        np.testing.assert_allclose(np.asarray(s1.params[k]),
+                                   np.asarray(s2.params[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
 def test_dead_feature_resurrection(rng):
     state, optimizer, l1 = init_big_sae(rng, D, 64, l1_alpha=1e-4, n_worst=32)
     step = make_big_sae_step(optimizer, l1)

@@ -106,3 +106,14 @@ def test_pipeline_gpt2_arch(tmp_path):
     fvu = min(float(fraction_variance_unexplained(ld, eval_batch))
               for ld, _ in dicts)
     assert fvu < 0.6, fvu
+
+    # scan_steps fuses K steps per dispatch without changing the outcome
+    # (same seed -> same batch stream -> same update sequence)
+    scanned = basic_l1_sweep(tmp_path / "acts" / "attn_concat.1",
+                             tmp_path / "out_scan", [1e-4, 1e-3],
+                             dict_ratio=2.0, batch_size=128, lr=3e-3,
+                             n_epochs=2, scan_steps=3)
+    for (ld1, _), (ld2, _) in zip(dicts, scanned):
+        np.testing.assert_allclose(np.asarray(ld1.get_learned_dict()),
+                                   np.asarray(ld2.get_learned_dict()),
+                                   rtol=1e-5, atol=1e-6)
