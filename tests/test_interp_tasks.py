@@ -360,3 +360,85 @@ def test_run_ioi_feature_ident(tiny_lm):
                                    tokenizer=_CharTokenizer(), n_prompts=6,
                                    forward=gptneox.forward, top_m=3)
     assert len(result["ranking"]) == 3
+
+
+def test_openai_explainer_protocol_hermetic():
+    """OpenAIExplainer replicates the neuron-explainer protocol with NO
+    network (fake injected client): the explainer prompt carries
+    0-10-discretized token<TAB>activation records in the library's few-shot
+    role structure, and simulation reads back EXPECTED VALUES over each
+    digit position's logprob distribution (the calibration trick), not the
+    argmax digit."""
+    import math
+    import types
+
+    from sparse_coding_tpu.interp.client import (
+        ActivationRecord,
+        OpenAIExplainer,
+        expected_values_from_logprobs,
+        normalize_activations,
+    )
+
+    assert normalize_activations([0.0, 2.5, 5.0], 5.0) == [0, 5, 10]
+    assert normalize_activations([1.0, -3.0], 0.0) == [0, 0]
+
+    captured = {}
+
+    class FakeChatCompletions:
+        def create(self, **kw):
+            captured["chat"] = kw
+            msg = types.SimpleNamespace(content=" nouns about food")
+            return types.SimpleNamespace(
+                choices=[types.SimpleNamespace(message=msg)])
+
+    class FakeCompletions:
+        def create(self, **kw):
+            captured["comp"] = kw
+            # realistic shape: a top_logprobs dict at EVERY position (the
+            # real API never returns None mid-stream), a numeric DOCUMENT
+            # token ("2024") that must not be read as an activation, and a
+            # fused "\t0" tab+digit token
+            lp = types.SimpleNamespace(
+                tokens=["2024", "\t", "7", "\n", "cat", "\t0", "\n"],
+                top_logprobs=[{"2024": 0.0}, {"\t": 0.0},
+                              {"7": math.log(0.5), "5": math.log(0.5),
+                               "x": math.log(0.1)},
+                              {"\n": 0.0}, {"cat": 0.0},
+                              {"\t0": 0.0}, {"\n": 0.0}])
+            return types.SimpleNamespace(
+                choices=[types.SimpleNamespace(logprobs=lp)])
+
+    fake = types.SimpleNamespace(
+        chat=types.SimpleNamespace(completions=FakeChatCompletions()),
+        completions=FakeCompletions())
+    ex = OpenAIExplainer(_client=fake)
+
+    records = [ActivationRecord(tokens=["the", "cat"],
+                                activations=[0.0, 4.0])]
+    explanation = ex.explain(records)
+    assert explanation == "nouns about food"
+    msgs = captured["chat"]["messages"]
+    assert [m["role"] for m in msgs] == ["system", "user", "assistant",
+                                         "user"]
+    assert "0 to 10" in msgs[0]["content"]
+    # the real records, discretized: max act 4.0 -> "cat\t10", "the\t0"
+    assert "the\t0" in msgs[3]["content"]
+    assert "cat\t10" in msgs[3]["content"]
+    assert "<start>" in msgs[3]["content"]
+
+    preds = ex.simulate("nouns about food", ["2024", "cat"])
+    # line 1 ("2024\t7"): EV over {7: .5, 5: .5} = 6.0 (NOT the argmax 7,
+    # and NOT the document token "2024"); line 2 (fused "\t0"): certain 0
+    assert preds == [6.0, 0.0]
+    assert "unknown" in captured["comp"]["prompt"]
+    assert captured["comp"]["logprobs"] == 5
+    assert captured["comp"]["stop"] == ["<end>"]
+
+    # direct EV helper edge cases: digit-looking DOCUMENT tokens are never
+    # activation slots; a line with no parseable digit contributes 0 at its
+    # slot; missing tails pad 0
+    evs = expected_values_from_logprobs(
+        ["7", "\t", "3", "\n", "tok", "\t", "oops\n", "x"],
+        [{"7": 0.0}, {"\t": 0.0}, {"3": 0.0}, {"\n": 0.0},
+         {"tok": 0.0}, {"\t": 0.0}, {"oops\n": 0.0}, {"x": 0.0}], 3)
+    assert evs == [3.0, 0.0, 0.0]
