@@ -149,6 +149,49 @@ def test_interp_run_offline(tmp_path, tiny_lm):
     assert results2 == results
 
 
+@pytest.mark.parametrize("layer_loc", ["residual", "mlp"])
+def test_fragment_activations_match_direct_encode(tiny_lm, layer_loc):
+    """The per-token activations the interpretation pipeline records equal a
+    DIRECT forward-tap + encode at sampled positions {0, mid, last}, for
+    both residual and MLP hook points with randomly initialized tied and
+    untied SAEs — the reference's recorded-vs-recomputed consistency gate
+    (reference: test/test_interpret.py:25-104)."""
+    from sparse_coding_tpu.interp.fragments import build_fragment_activations
+    from sparse_coding_tpu.lm.hooks import get_activation_size, tap_name
+    from sparse_coding_tpu.models import UntiedSAE
+
+    params, lm_cfg = tiny_lm
+    frag_len = 12
+    fragments = np.random.default_rng(0).integers(
+        0, lm_cfg.vocab_size, size=(10, frag_len))
+    d = get_activation_size(layer_loc, lm_cfg)  # mlp taps are d_mlp wide
+    tied = TiedSAE(dictionary=jax.random.normal(jax.random.PRNGKey(2),
+                                                (16, d)),
+                   encoder_bias=jnp.zeros(16))
+    untied = UntiedSAE(
+        encoder=jax.random.normal(jax.random.PRNGKey(3), (16, d)),
+        encoder_bias=jnp.zeros(16),
+        dictionary=jax.random.normal(jax.random.PRNGKey(4), (16, d)))
+    for ld in (tied, untied):
+        _, lookup = build_fragment_activations(
+            params, lm_cfg, ld, fragments, layer=1, layer_loc=layer_loc,
+            batch_size=4, forward=gptneox.forward)
+        # direct recompute, independent of the pipeline's jitted path
+        tap = tap_name(1, layer_loc)
+        _, tapped = gptneox.forward(params, jnp.asarray(fragments), lm_cfg,
+                                    taps=(tap,), stop_at_layer=2)
+        acts = tapped[tap]
+        for frag_idx in (0, 7):
+            direct = ld.encode(ld.center(acts[frag_idx]))
+            recorded = lookup.tokens_activations(frag_idx, feature=5)
+            for pos in (0, frag_len // 2, frag_len - 1):
+                np.testing.assert_allclose(
+                    float(recorded[pos]), float(direct[pos, 5]),
+                    rtol=1e-5, atol=1e-6,
+                    err_msg=f"{layer_loc} {type(ld).__name__} "
+                            f"frag{frag_idx} pos{pos}")
+
+
 def test_read_transform_scores(tmp_path):
     for name, score in [("sae", 0.5), ("pca", 0.2)]:
         d = tmp_path / name / "feature_0"
