@@ -62,6 +62,26 @@ def test_bench_ignores_non_tpu_tune_file(tmp_path):
     assert _load_tuned_variant(str(bad)) is None
 
 
+def test_windowed_rate_estimators():
+    """WindowedRate: the float value is the MEDIAN-window rate (the
+    headline estimator, robust to pool-state episodes), .best is the
+    fastest window, .windows records each — the contract BENCH_VARIANTS
+    and the headline JSON are built on."""
+    from bench import WindowedRate
+
+    # 3 windows of 100 acts each: 1s, 2s, 4s -> rates 100, 50, 25
+    r = WindowedRate([1.0, 2.0, 4.0], 100.0)
+    assert float(r) == 50.0          # median window
+    assert r.best == 100.0           # min-time window
+    assert r.windows == (100.0, 50.0, 25.0)
+    # even count: statistics.median interpolates
+    r2 = WindowedRate([1.0, 2.0], 100.0)
+    assert float(r2) == 100.0 / 1.5
+    # max by float picks the faster MEDIAN, not the best window
+    slow_median = WindowedRate([1.0, 10.0, 10.0], 100.0)  # best 100, med 10
+    assert max(r, slow_median, key=float) is r
+
+
 def test_explicit_fused_batch_tile(rng):
     """fused_batch_tile forces the kernel tile, scoped to that Ensemble;
     a tile that can't divide the batch falls back in auto mode (same
