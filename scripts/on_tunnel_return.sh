@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Measurement sequence for when the axon tunnel recovers from a wedge.
+# Runs the on-chip loop strictly serially (ONE jax process at a time —
+# CLAUDE.md), each stage with its own timeout so a re-wedge can't strand
+# the whole sequence; artifacts land in the repo as usual
+# (BENCH_VARIANTS.json, TUNE.json) plus logs under /tmp.
+#
+#   bash scripts/on_tunnel_return.sh
+set -u
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 90 python - <<'EOF'
+import faulthandler
+faulthandler.dump_traceback_later(60, exit=True)
+import jax
+print("devices:", jax.devices())
+EOF
+}
+
+echo "== probe =="
+if ! probe; then
+  echo "tunnel still wedged; aborting (re-run later)"; exit 1
+fi
+
+echo "== bench (pre-tune) =="
+timeout 2400 python bench.py 2>/tmp/bench_pre.log; echo "rc=$?"
+tail -5 /tmp/bench_pre.log
+
+echo "== tune =="
+timeout 3600 python tune.py 2>/tmp/tune.log; echo "rc=$?"
+tail -3 /tmp/tune.log
+
+echo "== bench (post-tune, the round's number) =="
+timeout 2400 python bench.py 2>/tmp/bench_post.log; echo "rc=$?"
+tail -5 /tmp/bench_post.log
+
+echo "== bench_suite (full) =="
+timeout 5400 python bench_suite.py 2>/tmp/bench_suite.log; echo "rc=$?"
+tail -5 /tmp/bench_suite.log
+
+echo "done — check BENCH_VARIANTS.json / TUNE.json and commit"
