@@ -254,6 +254,21 @@ def shuffled_batches(chunk: np.ndarray, batch_size: int,
         yield chunk[perm[lo:lo + batch_size]]
 
 
+def window_stacks(batches: Iterable[np.ndarray], k: int) -> Iterator[np.ndarray]:
+    """Group [B, d] host batches into [K, B, d] stacks for scanned training
+    windows (Ensemble.run_steps / cfg.scan_steps). The final short window
+    flushes with however many batches remain, so every batch trains (it
+    compiles its own scan length at most once per run)."""
+    buf: list[np.ndarray] = []
+    for b in batches:
+        buf.append(b)
+        if len(buf) == k:
+            yield np.stack(buf)
+            buf = []
+    if buf:
+        yield np.stack(buf)
+
+
 def device_prefetch(batches: Iterable[np.ndarray], sharding=None,
                     buffer_size: int = 2) -> Iterator[Array]:
     """Double-buffered host→device pipeline: batch i+1 transfers while batch i

@@ -572,24 +572,23 @@ class Ensemble:
                             interpret=fused_interpret,
                             batch_tile=fused_batch_tile,
                             compute_dtype=fused_compute_dtype))
-            if (mesh is None and make_single is make_fused_tied_step
-                    and self.sig_name == "tied_sae"):
-                # plain tied family, single device: the whole-step kernel
-                # (grads + normalization VJP + Adam in one Pallas pass) —
-                # per-batch resolution in _resolve_step, preferred in auto
-                # mode when its tile admits (r4 on-chip A/B: ~9% faster);
-                # the masked family has no train-step kernel (its
-                # coef_mask operand is two-stage only)
-                self._fullfused_step = make_fullfused_tied_step(
-                    self._adam_hypers, donate=donate,
-                    interpret=fused_interpret, batch_tile=fused_batch_tile,
-                    compute_dtype=fused_compute_dtype)
-            if mesh is None and make_single is make_fused_untied_step:
-                # untied family, single device: whole-step = grads kernel +
-                # feature-tiled Adam/VJP epilogue kernel (two Pallas passes;
-                # a single kernel would exceed VMEM — see
-                # make_fullfused_untied_step)
-                self._fullfused_step = make_fullfused_untied_step(
+            # single-device whole-step paths, resolved per batch in
+            # _resolve_step and preferred in auto mode when their working
+            # sets admit (r4 on-chip A/B: ~9% faster than two_stage):
+            # tied = one kernel (grads + VJP + Adam in one Pallas pass;
+            # the masked family has no train-step kernel — its coef_mask
+            # operand is two-stage only); untied = grads kernel + the
+            # feature-tiled Adam/VJP epilogue kernel (a single kernel would
+            # exceed VMEM — see make_fullfused_untied_step)
+            make_fullfused = None
+            if mesh is None:
+                if (make_single is make_fused_tied_step
+                        and self.sig_name == "tied_sae"):
+                    make_fullfused = make_fullfused_tied_step
+                elif make_single is make_fused_untied_step:
+                    make_fullfused = make_fullfused_untied_step
+            if make_fullfused is not None:
+                self._fullfused_step = make_fullfused(
                     self._adam_hypers, donate=donate,
                     interpret=fused_interpret, batch_tile=fused_batch_tile,
                     compute_dtype=fused_compute_dtype)

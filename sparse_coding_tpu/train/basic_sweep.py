@@ -62,14 +62,14 @@ def basic_l1_sweep(
     last_log = 0
     scan_k = max(1, int(scan_steps))
     if scan_k > 1:
-        from sparse_coding_tpu.train.sweep import _window_stacks
+        from sparse_coding_tpu.data.chunk_store import window_stacks
 
         if mesh is not None:
             sharding = batch_sharding(mesh, stacked=True)
     for epoch in range(n_epochs):
         batches = store.epoch(batch_size, rng)
         if scan_k > 1:
-            batches = _window_stacks(batches, scan_k)
+            batches = window_stacks(batches, scan_k)
         for batch in device_prefetch(batches, sharding):
             if scan_k > 1:
                 aux = ens.run_steps(batch)

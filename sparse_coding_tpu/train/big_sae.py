@@ -427,7 +427,7 @@ def train_big_sae(cfg, store=None, mesh: Optional[Mesh] = None,
         # K steps per device program; [K, B, d] windows sharded P(None,
         # "data"). Same update sequence — resurrection and logging move to
         # window boundaries (see BigSAEArgs.scan_steps).
-        from sparse_coding_tpu.train.sweep import _window_stacks
+        from sparse_coding_tpu.data.chunk_store import window_stacks
 
         window_fn = jax.jit(
             lambda s, stack: jax.lax.scan(step_fn, s, stack),
@@ -443,7 +443,7 @@ def train_big_sae(cfg, store=None, mesh: Optional[Mesh] = None,
     for epoch in range(cfg.n_epochs):
         batches = store.epoch(cfg.batch_size, rng)
         if scan_k > 1:
-            batches = _window_stacks(batches, scan_k)
+            batches = window_stacks(batches, scan_k)
         for batch in device_prefetch(batches, sharding):
             if scan_k > 1:
                 state, metrics = window_fn(state, batch)

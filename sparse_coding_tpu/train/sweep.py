@@ -30,7 +30,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from sparse_coding_tpu.config import EnsembleArgs, SyntheticEnsembleArgs
-from sparse_coding_tpu.data.chunk_store import ChunkStore, ChunkWriter, device_prefetch
+from sparse_coding_tpu.data.chunk_store import (
+    ChunkStore,
+    ChunkWriter,
+    device_prefetch,
+    window_stacks,
+)
 from sparse_coding_tpu.ensemble import Ensemble, EnsembleGroup
 from sparse_coding_tpu.metrics.core import (
     fraction_variance_unexplained,
@@ -48,20 +53,6 @@ from sparse_coding_tpu.utils.profiling import StepTimer
 EnsembleLike = Union[Ensemble, EnsembleGroup]
 # ensemble_init_fn(cfg, mesh) -> list of (ensemble, per-member hyperparams, name)
 EnsembleInitFn = Callable[..., list[tuple[EnsembleLike, list[dict], str]]]
-
-
-def _window_stacks(batches, k: int):
-    """Group [B, d] host batches into [K, B, d] stacks for run_steps. The
-    final short window flushes with however many batches remain, so every
-    batch trains (it compiles its own scan length at most once per sweep)."""
-    buf = []
-    for b in batches:
-        buf.append(b)
-        if len(buf) == k:
-            yield np.stack(buf)
-            buf = []
-    if buf:
-        yield np.stack(buf)
 
 
 def init_synthetic_dataset(cfg: SyntheticEnsembleArgs) -> ChunkStore:
@@ -276,7 +267,7 @@ def sweep(
                 chunk -= center.astype(train_np_dtype)
             batches = store.batches(chunk, cfg.batch_size, rng)
             if scan_k > 1:
-                batches = _window_stacks(batches, scan_k)
+                batches = window_stacks(batches, scan_k)
                 window_sharding = (batch_sharding(mesh, stacked=True)
                                    if mesh is not None else None)
             else:

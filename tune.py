@@ -164,7 +164,7 @@ def main() -> None:
 
     # stage 2: scan-chunk sweep for the winner (roughly independent of the
     # stage-1 knobs, so sweeping it only here keeps the grid tractable)
-    base = {k: v for k, v in best.items() if k not in ("acts_per_sec", "mfu")}
+    base = strip(best)
     scan_chunks = (5,) if args.quick else SCAN_CHUNKS
     for scan_chunk in scan_chunks:
         rec = measure({**base, "scan_chunk": scan_chunk})
