@@ -32,7 +32,18 @@ timeout 3600 python tune.py 2>/tmp/tune.log; echo "rc=$?"
 tail -3 /tmp/tune.log
 
 echo "== bench (post-tune, the round's number) =="
-timeout 2400 python bench.py 2>/tmp/bench_post.log; echo "rc=$?"
+# stdout JSON line is saved as a committed artifact so a later re-wedge
+# cannot erase the on-chip evidence before the driver's end-of-round run.
+# Only promote a REAL on-chip line: a cpu-fallback (or truncated) run must
+# never clobber earlier on-chip evidence.
+timeout 2400 python bench.py >/tmp/bench_onchip.json 2>/tmp/bench_post.log
+rc=$?; echo "rc=$rc"
+cat /tmp/bench_onchip.json
+if [ "$rc" -eq 0 ] && grep -q '"backend": "tpu"' /tmp/bench_onchip.json; then
+  mv /tmp/bench_onchip.json BENCH_ONCHIP.json
+else
+  echo "not promoting to BENCH_ONCHIP.json (rc=$rc or non-tpu backend)"
+fi
 tail -5 /tmp/bench_post.log
 
 echo "== bench_suite (full) =="

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Unattended tunnel-recovery capture (VERDICT r4 next #1): poll the axon
+# tunnel; the moment it admits a client, run the full serial measurement
+# loop (scripts/on_tunnel_return.sh) and COMMIT the refreshed artifacts so
+# a later re-wedge cannot erase the on-chip evidence.
+#
+#   nohup bash scripts/tunnel_watch.sh &   # or run under the session driver
+#
+# Safe to run alongside plugin-stripped CPU work (env -u
+# PALLAS_AXON_POOL_IPS ...): only the probe/measurement processes here touch
+# the tunnel, strictly one at a time.
+set -u
+cd "$(dirname "$0")/.."
+LOG=${TUNNEL_WATCH_LOG:-/tmp/tunnel_watch.log}
+POLL_S=${TUNNEL_WATCH_POLL_S:-600}
+
+probe() {
+  timeout 120 python - <<'EOF'
+import faulthandler
+faulthandler.dump_traceback_later(90, exit=True)
+import jax
+ds = jax.devices()
+assert ds and ds[0].platform.lower() not in ("cpu",), ds
+print("tunnel OK:", ds)
+EOF
+}
+
+commit_artifacts() {
+  # the watcher may race a foreground commit for the index lock; retry a few
+  # times and never fail the capture over it. Pathspec commit so nothing a
+  # concurrent foreground session staged gets swept into this commit.
+  for _ in 1 2 3 4 5; do
+    if git commit -m "On-chip bench recapture after tunnel recovery" \
+        -- BENCH_ONCHIP.json BENCH_VARIANTS.json TUNE.json \
+           BENCH_SUITE_TPU.json >>"$LOG" 2>&1; then
+      return 0
+    fi
+    sleep 20
+  done
+  echo "$(date -u) WARNING: artifact commit failed (see above)" >>"$LOG"
+}
+
+echo "$(date -u) tunnel watch started (poll every ${POLL_S}s)" >>"$LOG"
+while true; do
+  if probe >>"$LOG" 2>&1; then
+    echo "$(date -u) tunnel recovered; running measurement loop" >>"$LOG"
+    bash scripts/on_tunnel_return.sh >>"$LOG" 2>&1
+    commit_artifacts
+    echo "$(date -u) capture complete" >>"$LOG"
+    exit 0
+  fi
+  echo "$(date -u) tunnel still wedged" >>"$LOG"
+  sleep "$POLL_S"
+done

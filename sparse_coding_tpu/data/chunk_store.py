@@ -125,25 +125,54 @@ class ChunkWriter:
 
 class ChunkStore:
     """Reader over a chunk folder (reference counterpart: the torch.load
-    loops at big_sweep.py:357-364 and basic_l1_sweep.py:86-105)."""
+    loops at big_sweep.py:357-364 and basic_l1_sweep.py:86-105).
+
+    Reads native `.npy` stores, and — for reference-artifact interop — raw
+    reference chunk folders of torch-saved `<i>.pt` tensors
+    (activation_dataset.py:499-503) directly, without conversion. The .pt
+    path has no native readahead (torch deserialization is not a raw file
+    read); convert via utils.ref_interop.import_reference_chunks when
+    streaming throughput matters."""
 
     def __init__(self, folder: str | Path):
         self.folder = Path(folder)
         self.chunk_paths = sorted(
             (p for p in self.folder.glob("*.npy") if p.stem.isdigit()),
             key=lambda p: int(p.stem))
+        self.format = "npy"
         if not self.chunk_paths:
-            raise FileNotFoundError(f"no .npy chunks in {self.folder}")
+            self.chunk_paths = sorted(
+                (p for p in self.folder.glob("*.pt") if p.stem.isdigit()),
+                key=lambda p: int(p.stem))
+            self.format = "pt"
+        if not self.chunk_paths:
+            raise FileNotFoundError(f"no .npy or .pt chunks in {self.folder}")
         meta_path = self.folder / "meta.json"
         self.meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
-        first = np.load(self.chunk_paths[0], mmap_mode="r")
-        self.activation_dim = int(first.shape[-1])
+        if self.format == "pt":
+            if "activation_dim" in self.meta:
+                self.activation_dim = int(self.meta["activation_dim"])
+            else:
+                from sparse_coding_tpu.utils.ref_interop import read_pt_chunk
+
+                # on-disk dtype (no float32 blow-up) just to read the width;
+                # reference chunks can be ~2 GB fp16
+                self.activation_dim = int(
+                    read_pt_chunk(self.chunk_paths[0],
+                                  dtype=np.float16).shape[-1])
+        else:
+            first = np.load(self.chunk_paths[0], mmap_mode="r")
+            self.activation_dim = int(first.shape[-1])
 
     @property
     def n_chunks(self) -> int:
         return len(self.chunk_paths)
 
     def load_chunk(self, i: int, dtype=np.float32) -> np.ndarray:
+        if self.format == "pt":
+            from sparse_coding_tpu.utils.ref_interop import read_pt_chunk
+
+            return read_pt_chunk(self.chunk_paths[i], dtype=dtype)
         from sparse_coding_tpu.data.native_io import (
             DEFAULT_THREADS,
             read_npy_native,
@@ -209,6 +238,11 @@ class ChunkStore:
         background threads while the caller trains on the current one
         (native/chunkio.cpp; silently sequential without it). Holds at most
         two chunks in host RAM (current + in-flight)."""
+        if self.format == "pt":
+            # torch deserialization isn't a raw pread — no native readahead
+            for ci in indices:
+                yield self.load_chunk(int(ci), dtype)
+            return
         from sparse_coding_tpu.data.native_io import NativePrefetcher
 
         indices = [int(i) for i in indices]

@@ -1,0 +1,256 @@
+"""Reference-artifact interop: ingest HoagyC/sparse_coding outputs.
+
+The reference persists two artifact families this framework must be able to
+read so reference-trained results can be evaluated/compared in place:
+
+- ``learned_dicts.pt``: a torch pickle of ``[(LearnedDict, hyperparams), …]``
+  tuples (reference: big_sweep.py:378-384, basic_l1_sweep.py:108-115). The
+  pickle references live classes from the reference's ``autoencoders.*``
+  modules, which are not installed here — ``load_reference_learned_dicts``
+  unpickles them into attribute-only shim objects and converts each to the
+  equivalent registered flax-struct :class:`LearnedDict` pytree.
+- ``<i>.pt`` activation chunks: one torch-saved ``[n, d]`` fp16 tensor per
+  file (reference: activation_dataset.py:499-503 ``save_activation_chunk``).
+  :class:`~sparse_coding_tpu.data.chunk_store.ChunkStore` reads these folders
+  directly (format="pt"); ``import_reference_chunks`` converts one to the
+  native ``.npy`` store when readahead throughput matters.
+
+Known parity deviations (all from framework-wide row normalization of
+exported dictionaries, models/learned_dict.py::normalize_rows):
+
+- reference ``RandomDict`` decodes with its RAW gaussian rows
+  (learned_dict.py:114-118); the converted dict normalizes. Feature
+  *directions* (MMCS, cosine geometry) are identical.
+- reference ``TiedSAE(norm_encoder=False)`` encodes with raw rows; that case
+  converts to :class:`UntiedSAE` (raw encoder, normalized decoder), which
+  reproduces it exactly.
+- reference ``ReverseSAE`` defaults to ``norm_encoder=False`` and its decode
+  in-place-mutates the code tensor (learned_dict.py:253-255); the converted
+  :class:`ReverseSAE` is the pure normalized-row variant.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+_REF_MODULE_PREFIXES = ("autoencoders", "torchtyping", "test_datasets")
+
+
+class _RefShim:
+    """Stand-in for a reference class during unpickling: instances only
+    carry the pickled ``__dict__`` (reference classes are plain Python
+    objects, so default pickling is class + attribute dict)."""
+
+    def __init__(self, *args, **kwargs):  # tolerate NEWOBJ with args
+        pass
+
+
+_shim_cache: dict[tuple[str, str], type] = {}
+
+
+def _shim_class(module: str, name: str) -> type:
+    key = (module, name)
+    if key not in _shim_cache:
+        _shim_cache[key] = type(name, (_RefShim,), {"__module__": module})
+    return _shim_cache[key]
+
+
+class _RefUnpickler(pickle.Unpickler):
+    """Resolves reference-package globals to shims; everything else (torch
+    tensor rebuilds, builtins) resolves normally."""
+
+    def find_class(self, module: str, name: str):
+        if module.split(".")[0] in _REF_MODULE_PREFIXES:
+            return _shim_class(module, name)
+        return super().find_class(module, name)
+
+
+class _RefPickleModule:
+    """Duck-typed ``pickle_module`` for torch.load."""
+
+    Unpickler = _RefUnpickler
+    load = staticmethod(pickle.load)
+    # torch.load consults these when re-serializing errors / legacy formats
+    dump = staticmethod(pickle.dump)
+    dumps = staticmethod(pickle.dumps)
+    loads = staticmethod(pickle.loads)
+    HIGHEST_PROTOCOL = pickle.HIGHEST_PROTOCOL
+
+
+def _np(v) -> np.ndarray:
+    import torch
+
+    if isinstance(v, torch.Tensor):
+        return v.detach().cpu().float().numpy()
+    return np.asarray(v, dtype=np.float32)
+
+
+def _nontrivial(v, identity: np.ndarray) -> np.ndarray | None:
+    """None when a centering buffer is (missing or) its do-nothing value —
+    keeps converted pytrees as small as the information they carry."""
+    if v is None:
+        return None
+    arr = _np(v)
+    if arr.shape == identity.shape and np.allclose(arr, identity):
+        return None
+    return arr
+
+
+def _convert_one(obj: Any):
+    """Shim object (reference class name + attrs) → native LearnedDict."""
+    import jax.numpy as jnp
+
+    from sparse_coding_tpu.models.learned_dict import (
+        AddedNoise,
+        Identity,
+        IdentityPositive,
+        IdentityReLU,
+        RandomDict,
+        ReverseSAE,
+        Rotation,
+        TiedSAE,
+        TopKLearnedDict,
+        UntiedSAE,
+    )
+
+    name = type(obj).__name__
+    d = obj.__dict__
+
+    if name == "Identity":
+        return Identity.create(int(d["activation_size"]))
+    if name == "IdentityReLU":
+        bias = d.get("bias")
+        if bias is not None and np.any(_np(bias)):
+            raise NotImplementedError(
+                "reference IdentityReLU with a non-zero bias has no native "
+                "counterpart (the reference constructor cannot actually set "
+                "one either — `if bias:` on a tensor raises)")
+        return IdentityReLU.create(int(d["activation_size"]))
+    if name == "IdentityPositive":
+        return IdentityPositive.create(int(d["activation_size"]))
+    if name == "RandomDict":
+        return RandomDict(dictionary=jnp.asarray(_np(d["encoder"])))
+    if name == "Rotation":
+        return Rotation(rotation=jnp.asarray(_np(d["matrix"])))
+    if name == "AddedNoise":
+        import jax
+
+        return AddedNoise.create(jax.random.PRNGKey(0),
+                                 int(d["activation_size"]),
+                                 float(_np(d["noise_mag"])))
+    if name == "UntiedSAE":
+        return UntiedSAE(encoder=jnp.asarray(_np(d["encoder"])),
+                         encoder_bias=jnp.asarray(_np(d["encoder_bias"])),
+                         dictionary=jnp.asarray(_np(d["decoder"])))
+    if name in ("TiedSAE", "TiedCenteredSAE"):
+        enc = jnp.asarray(_np(d["encoder"]))
+        bias = jnp.asarray(_np(d["encoder_bias"]))
+        dim = enc.shape[-1]
+        rot = _nontrivial(d.get("center_rot"), np.eye(dim, dtype=np.float32))
+        trans = _nontrivial(d.get("center_trans"),
+                            np.zeros(dim, dtype=np.float32))
+        scale = _nontrivial(d.get("center_scale"),
+                            np.ones(dim, dtype=np.float32))
+        if not d.get("norm_encoder", True):
+            if rot is not None or trans is not None or scale is not None:
+                raise NotImplementedError(
+                    "reference TiedSAE with norm_encoder=False AND a "
+                    "non-trivial centering transform is not representable")
+            # raw-row encode + normalized decode ≡ native UntiedSAE
+            return UntiedSAE(encoder=enc, encoder_bias=bias, dictionary=enc)
+        return TiedSAE(
+            dictionary=enc, encoder_bias=bias,
+            centering_rot=None if rot is None else jnp.asarray(rot),
+            centering_trans=None if trans is None else jnp.asarray(trans),
+            centering_scale=None if scale is None else jnp.asarray(scale))
+    if name == "ReverseSAE":
+        return ReverseSAE(dictionary=jnp.asarray(_np(d["encoder"])),
+                          encoder_bias=jnp.asarray(_np(d["encoder_bias"])))
+    if name == "TopKLearnedDict":
+        return TopKLearnedDict(dictionary=jnp.asarray(_np(d["dict"])),
+                               k=int(d["sparsity"]))
+
+    raise NotImplementedError(
+        f"no conversion for reference class {name!r} "
+        f"(attrs: {sorted(d)}); supported: Identity, IdentityReLU, "
+        "IdentityPositive, RandomDict, Rotation, AddedNoise, UntiedSAE, "
+        "TiedSAE, TiedCenteredSAE, ReverseSAE, TopKLearnedDict")
+
+
+def _clean_hyperparams(h: Any) -> dict:
+    if not isinstance(h, dict):
+        return {"hyperparams": h}
+    out = {}
+    for k, v in h.items():
+        if isinstance(v, (bool, int, float, str, type(None))):
+            out[k] = v  # plain scalars pass through untouched (bool/int
+            # must not round-trip via float32)
+            continue
+        try:
+            arr = _np(v)
+            out[k] = arr.item() if arr.size == 1 else arr
+        except (TypeError, ValueError):
+            out[k] = v
+    return out
+
+
+def load_reference_learned_dicts(path: str | Path) -> list[tuple[Any, dict]]:
+    """Load a reference ``learned_dicts.pt`` into native
+    ``[(LearnedDict pytree, hyperparams dict), …]`` — the same tuple contract
+    :func:`sparse_coding_tpu.utils.artifacts.load_learned_dicts` returns, so
+    loaded reference dicts drop straight into every eval/metric driver
+    (MMCS/FVU cross-framework comparison, intervention evals, interp)."""
+    import torch
+
+    raw = torch.load(str(path), map_location="cpu",
+                     pickle_module=_RefPickleModule, weights_only=False)
+    if not isinstance(raw, (list, tuple)):
+        raise ValueError(f"{path}: expected a list of (dict, hyperparams) "
+                         f"tuples, got {type(raw).__name__}")
+    out = []
+    for item in raw:
+        obj, hyper = item if isinstance(item, (list, tuple)) else (item, {})
+        out.append((_convert_one(obj), _clean_hyperparams(hyper)))
+    return out
+
+
+def read_pt_chunk(path: str | Path, dtype=np.float32) -> np.ndarray:
+    """One reference activation chunk (torch-saved [n, d] tensor,
+    activation_dataset.py:499-503) as a numpy array."""
+    import torch
+
+    t = torch.load(str(path), map_location="cpu", weights_only=True)
+    if not isinstance(t, torch.Tensor):
+        raise ValueError(f"{path}: expected a tensor, got {type(t).__name__}")
+    return t.numpy().astype(dtype, copy=False).reshape(t.shape[0], -1)
+
+
+def import_reference_chunks(src: str | Path, dst: str | Path,
+                            dtype: str = "float16") -> int:
+    """Convert a reference chunk folder (``0.pt, 1.pt, …``) into a native
+    ``.npy`` ChunkStore at ``dst`` (native readahead works on raw .npy
+    files; ChunkStore reads .pt folders directly but without readahead).
+    Chunk boundaries are preserved 1:1, so skip_chunks-style cursors keep
+    meaning. Returns the number of chunks written."""
+    src, dst = Path(src), Path(dst)
+    paths = sorted((p for p in src.glob("*.pt") if p.stem.isdigit()),
+                   key=lambda p: int(p.stem))
+    if not paths:
+        raise FileNotFoundError(f"no <i>.pt chunks in {src}")
+    dst.mkdir(parents=True, exist_ok=True)
+    np_dtype = np.dtype(dtype)
+    dim = None
+    for i, p in enumerate(paths):
+        arr = read_pt_chunk(p, dtype=np_dtype)
+        dim = arr.shape[-1] if dim is None else dim
+        np.save(dst / f"{i}.npy", arr)
+    meta = {"activation_dim": int(dim), "dtype": str(np_dtype),
+            "n_chunks": len(paths), "centered": False,
+            "source": str(src), "format": "pt-import"}
+    (dst / "meta.json").write_text(json.dumps(meta, indent=2))
+    return len(paths)

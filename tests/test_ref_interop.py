@@ -1,0 +1,307 @@
+"""Reference-artifact interop (VERDICT r4 next #2).
+
+Writes artifacts in the REFERENCE's on-disk formats — `learned_dicts.pt`
+torch pickles of live `autoencoders.*` class instances (big_sweep.py:378-384)
+and `<i>.pt` torch-saved activation chunks (activation_dataset.py:499-503) —
+using throwaway fixture classes that emulate the reference's attribute
+layout, then checks the framework ingests them with the reference package
+absent: `load_reference_learned_dicts` must reproduce the reference math,
+and `ChunkStore` must read .pt chunk folders directly.
+"""
+
+import sys
+import types
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from sparse_coding_tpu.data.chunk_store import ChunkStore
+from sparse_coding_tpu.metrics.core import (
+    fraction_variance_unexplained,
+    mmcs,
+)
+from sparse_coding_tpu.models.learned_dict import (
+    Identity,
+    IdentityReLU,
+    RandomDict,
+    ReverseSAE,
+    Rotation,
+    TiedSAE,
+    TopKLearnedDict,
+    UntiedSAE,
+)
+from sparse_coding_tpu.utils.ref_interop import (
+    import_reference_chunks,
+    load_reference_learned_dicts,
+    read_pt_chunk,
+)
+
+REF_MODULE = "autoencoders.learned_dict"
+
+
+def _ref_instance(cls_name: str, **attrs):
+    """An object that pickles exactly like a reference LearnedDict: plain
+    class from the `autoencoders.learned_dict` module, state = __dict__."""
+    cls = type(cls_name, (), {"__module__": REF_MODULE})
+    obj = cls.__new__(cls)
+    obj.__dict__.update(attrs)
+    return obj
+
+
+@contextmanager
+def _ref_modules_visible(*objs):
+    """Register fake autoencoders modules so torch.save can pickle the
+    fixture instances by qualified name; always removed afterwards so the
+    LOAD path is proven to work without the reference package."""
+    pkg = types.ModuleType("autoencoders")
+    mod = types.ModuleType(REF_MODULE)
+    for o in objs:
+        setattr(mod, type(o).__name__, type(o))
+    pkg.learned_dict = mod
+    sys.modules["autoencoders"] = pkg
+    sys.modules[REF_MODULE] = mod
+    try:
+        yield
+    finally:
+        sys.modules.pop("autoencoders", None)
+        sys.modules.pop(REF_MODULE, None)
+
+
+def _save_ref_artifact(tmp_path, pairs):
+    path = tmp_path / "learned_dicts.pt"
+    with _ref_modules_visible(*(d for d, _ in pairs)):
+        torch.save(list(pairs), path)
+    assert "autoencoders" not in sys.modules
+    return path
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _norm_rows(d):
+    return d / np.clip(np.linalg.norm(d, axis=-1, keepdims=True), 1e-8, None)
+
+
+def test_untied_sae_roundtrip(tmp_path):
+    r = _rng(1)
+    enc = r.normal(size=(24, 16)).astype(np.float32)
+    dec = r.normal(size=(24, 16)).astype(np.float32)
+    bias = r.normal(size=(24,)).astype(np.float32)
+    ref = _ref_instance("UntiedSAE", encoder=torch.tensor(enc),
+                        decoder=torch.tensor(dec),
+                        encoder_bias=torch.tensor(bias),
+                        n_feats=24, activation_size=16)
+    path = _save_ref_artifact(tmp_path, [(ref, {"l1_alpha": torch.tensor(3e-4),
+                                                "dict_size": 24})])
+
+    loaded = load_reference_learned_dicts(path)
+    assert len(loaded) == 1
+    d, hyper = loaded[0]
+    assert isinstance(d, UntiedSAE)
+    # hyperparams: tensors squeezed to python scalars
+    assert hyper["l1_alpha"] == pytest.approx(3e-4)
+    assert hyper["dict_size"] == 24
+
+    x = r.normal(size=(7, 16)).astype(np.float32)
+    # reference UntiedSAE.encode: relu(enc @ x + bias), RAW encoder rows
+    want_c = np.maximum(x @ enc.T + bias, 0.0)
+    np.testing.assert_allclose(np.asarray(d.encode(jnp.asarray(x))), want_c,
+                               rtol=1e-5, atol=1e-5)
+    # reference decode: code @ row-normalized decoder (learned_dict.py:32-43)
+    want_x = want_c @ _norm_rows(dec)
+    np.testing.assert_allclose(np.asarray(d.predict(jnp.asarray(x))), want_x,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_tied_sae_trivial_centering_drops_buffers(tmp_path):
+    r = _rng(2)
+    enc = r.normal(size=(12, 8)).astype(np.float32)
+    bias = r.normal(size=(12,)).astype(np.float32)
+    ref = _ref_instance(
+        "TiedSAE", encoder=torch.tensor(enc), encoder_bias=torch.tensor(bias),
+        norm_encoder=True, n_feats=12, activation_size=8,
+        center_trans=torch.zeros(8), center_rot=torch.eye(8),
+        center_scale=torch.ones(8))
+    d, _ = load_reference_learned_dicts(
+        _save_ref_artifact(tmp_path, [(ref, {})]))[0]
+    assert isinstance(d, TiedSAE)
+    assert d.centering_rot is None and d.centering_trans is None
+    assert d.centering_scale is None
+
+    x = r.normal(size=(5, 8)).astype(np.float32)
+    want = np.maximum(x @ _norm_rows(enc).T + bias, 0.0)
+    np.testing.assert_allclose(np.asarray(d.encode(jnp.asarray(x))), want,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_tied_sae_real_centering_preserved(tmp_path):
+    r = _rng(3)
+    enc = r.normal(size=(12, 8)).astype(np.float32)
+    bias = np.zeros(12, dtype=np.float32)
+    trans = r.normal(size=(8,)).astype(np.float32)
+    scale = (1.0 + r.random(8)).astype(np.float32)
+    q, _ = np.linalg.qr(r.normal(size=(8, 8)))
+    rot = q.astype(np.float32)
+    ref = _ref_instance(
+        "TiedSAE", encoder=torch.tensor(enc), encoder_bias=torch.tensor(bias),
+        norm_encoder=True, n_feats=12, activation_size=8,
+        center_trans=torch.tensor(trans), center_rot=torch.tensor(rot),
+        center_scale=torch.tensor(scale))
+    d, _ = load_reference_learned_dicts(
+        _save_ref_artifact(tmp_path, [(ref, {})]))[0]
+    assert d.centering_rot is not None
+
+    x = r.normal(size=(5, 8)).astype(np.float32)
+    # reference center: einsum("cu,bu->bc", rot, x - trans) * scale
+    centered = ((x - trans) @ rot.T) * scale
+    want = np.maximum(centered @ _norm_rows(enc).T + bias, 0.0)
+    np.testing.assert_allclose(
+        np.asarray(d.encode(d.center(jnp.asarray(x)))), want,
+        rtol=1e-4, atol=1e-5)
+
+
+def test_tied_sae_unnormalized_encoder_maps_to_untied(tmp_path):
+    r = _rng(4)
+    enc = (3.0 * r.normal(size=(12, 8))).astype(np.float32)
+    bias = r.normal(size=(12,)).astype(np.float32)
+    ref = _ref_instance(
+        "TiedSAE", encoder=torch.tensor(enc), encoder_bias=torch.tensor(bias),
+        norm_encoder=False, n_feats=12, activation_size=8)
+    d, _ = load_reference_learned_dicts(
+        _save_ref_artifact(tmp_path, [(ref, {})]))[0]
+    # raw-row encode + normalized-row decode is exactly native UntiedSAE
+    assert isinstance(d, UntiedSAE)
+    x = r.normal(size=(5, 8)).astype(np.float32)
+    want_c = np.maximum(x @ enc.T + bias, 0.0)
+    np.testing.assert_allclose(np.asarray(d.encode(jnp.asarray(x))), want_c,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(d.predict(jnp.asarray(x))),
+                               want_c @ _norm_rows(enc), rtol=1e-5, atol=1e-5)
+
+
+def test_baseline_and_topk_conversions(tmp_path):
+    r = _rng(5)
+    rnd_enc = r.normal(size=(10, 6)).astype(np.float32)
+    q, _ = np.linalg.qr(r.normal(size=(6, 6)))
+    topk_dict = _norm_rows(r.normal(size=(10, 6)).astype(np.float32))
+    pairs = [
+        (_ref_instance("Identity", activation_size=6, n_feats=6,
+                       device="cpu"), {"name": "identity"}),
+        (_ref_instance("IdentityReLU", activation_size=6, n_feats=6,
+                       bias=torch.zeros(6)), {}),
+        (_ref_instance("RandomDict", activation_size=6, n_feats=10,
+                       encoder=torch.tensor(rnd_enc),
+                       encoder_bias=torch.zeros(10)), {}),
+        (_ref_instance("Rotation", matrix=torch.tensor(q.astype(np.float32)),
+                       activation_size=6, device="cpu"), {}),
+        (_ref_instance("TopKLearnedDict", dict=torch.tensor(topk_dict),
+                       sparsity=3, n_feats=10, activation_size=6), {}),
+        (_ref_instance("ReverseSAE", encoder=torch.tensor(rnd_enc),
+                       encoder_bias=torch.zeros(10), norm_encoder=True,
+                       n_feats=10, activation_size=6), {}),
+    ]
+    loaded = load_reference_learned_dicts(_save_ref_artifact(tmp_path, pairs))
+    types_got = [type(d) for d, _ in loaded]
+    assert types_got == [Identity, IdentityReLU, RandomDict, Rotation,
+                         TopKLearnedDict, ReverseSAE]
+    assert loaded[0][1] == {"name": "identity"}
+    rd = loaded[2][0]
+    # directions (geometry/MMCS) match the reference's raw rows exactly
+    np.testing.assert_allclose(np.asarray(rd.get_learned_dict()),
+                               _norm_rows(rnd_enc), rtol=1e-5, atol=1e-6)
+    tk = loaded[4][0]
+    assert tk.k == 3
+    x = r.normal(size=(4, 6)).astype(np.float32)
+    codes = np.asarray(tk.encode(jnp.asarray(x)))
+    assert (np.count_nonzero(codes, axis=1) <= 3).all()
+
+
+def test_unknown_reference_class_fails_loudly(tmp_path):
+    ref = _ref_instance("FrobnicatorDict", weights=torch.zeros(3, 3))
+    path = _save_ref_artifact(tmp_path, [(ref, {})])
+    with pytest.raises(NotImplementedError, match="FrobnicatorDict"):
+        load_reference_learned_dicts(path)
+
+
+def test_cross_framework_eval(tmp_path):
+    """The loaded reference dict drops into the native metric drivers: MMCS
+    against a native dict of the same rows is exactly 1, and FVU evaluates
+    finite — the cross-framework parity check VERDICT r4 asked for."""
+    r = _rng(6)
+    enc = r.normal(size=(32, 16)).astype(np.float32)
+    bias = r.normal(size=(32,)).astype(np.float32)
+    ref = _ref_instance("TiedSAE", encoder=torch.tensor(enc),
+                        encoder_bias=torch.tensor(bias), norm_encoder=True,
+                        n_feats=32, activation_size=16)
+    loaded, _ = load_reference_learned_dicts(
+        _save_ref_artifact(tmp_path, [(ref, {})]))[0]
+    native = TiedSAE(dictionary=jnp.asarray(enc),
+                     encoder_bias=jnp.asarray(bias))
+    assert float(mmcs(loaded, native)) == pytest.approx(1.0, abs=1e-6)
+
+    x = jnp.asarray(r.normal(size=(256, 16)).astype(np.float32))
+    fvu_loaded = float(fraction_variance_unexplained(loaded, x))
+    fvu_native = float(fraction_variance_unexplained(native, x))
+    assert np.isfinite(fvu_loaded)
+    assert fvu_loaded == pytest.approx(fvu_native, rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# .pt activation chunks
+
+
+def _write_pt_chunks(folder, arrays):
+    folder.mkdir(parents=True, exist_ok=True)
+    for i, a in enumerate(arrays):
+        torch.save(torch.tensor(a), folder / f"{i}.pt")
+
+
+def test_chunkstore_reads_pt_folder(tmp_path):
+    r = _rng(7)
+    chunks = [r.normal(size=(40, 12)).astype(np.float16) for _ in range(3)]
+    src = tmp_path / "ref_chunks"
+    _write_pt_chunks(src, chunks)
+
+    store = ChunkStore(src)
+    assert store.format == "pt"
+    assert store.n_chunks == 3
+    assert store.activation_dim == 12
+    np.testing.assert_allclose(store.load_chunk(1),
+                               chunks[1].astype(np.float32))
+    # chunk_reader + epoch drive the same path the sweep drivers use
+    got = list(store.chunk_reader([2, 0]))
+    np.testing.assert_allclose(got[0], chunks[2].astype(np.float32))
+    np.testing.assert_allclose(got[1], chunks[0].astype(np.float32))
+    batches = list(store.epoch(batch_size=16, rng=_rng(0)))
+    assert all(b.shape == (16, 12) for b in batches)
+    assert len(batches) == 3 * (40 // 16)
+
+
+def test_import_reference_chunks(tmp_path):
+    r = _rng(8)
+    chunks = [r.normal(size=(30, 8)).astype(np.float16) for _ in range(2)]
+    src = tmp_path / "ref_chunks"
+    _write_pt_chunks(src, chunks)
+
+    n = import_reference_chunks(src, tmp_path / "native")
+    assert n == 2
+    store = ChunkStore(tmp_path / "native")
+    assert store.format == "npy"
+    assert store.meta["format"] == "pt-import"
+    for i in range(2):
+        np.testing.assert_allclose(store.load_chunk(i),
+                                   chunks[i].astype(np.float32))
+
+
+def test_read_pt_chunk_flattens_sequence_dims(tmp_path):
+    # harvest shapes are already [b*s, n] but guard the reshape contract
+    t = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    p = tmp_path / "0.pt"
+    torch.save(torch.tensor(t), p)
+    out = read_pt_chunk(p)
+    assert out.shape == (2, 12)
