@@ -13,9 +13,16 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=${TUNNEL_WATCH_LOG:-/tmp/tunnel_watch.log}
 POLL_S=${TUNNEL_WATCH_POLL_S:-600}
+# Tunnel mutual exclusion: every tunnel-touching process (here AND any
+# foreground on-chip run: `flock /tmp/axon_tunnel.lock python bench.py`)
+# serializes on this lock, enforcing the repo's one-JAX-process rule
+# instead of merely documenting it.
+LOCK=/tmp/axon_tunnel.lock
 
 probe() {
-  timeout 120 python - <<'EOF'
+  # -w 5: if a foreground run holds the tunnel, skip this poll instead of
+  # queueing a probe behind it (a queued probe could fire mid-measurement)
+  flock -w 5 "$LOCK" timeout 120 python - <<'EOF'
 import faulthandler
 faulthandler.dump_traceback_later(90, exit=True)
 import jax
@@ -28,11 +35,21 @@ EOF
 commit_artifacts() {
   # the watcher may race a foreground commit for the index lock; retry a few
   # times and never fail the capture over it. Pathspec commit so nothing a
-  # concurrent foreground session staged gets swept into this commit.
+  # concurrent foreground session staged gets swept into this commit; the
+  # add stages only artifacts that exist (BENCH_ONCHIP.json may be new or,
+  # after a cpu-fallback, absent — an unmatched pathspec would abort the
+  # whole commit).
+  arts=""
+  for f in BENCH_ONCHIP.json BENCH_VARIANTS.json TUNE.json \
+           BENCH_SUITE_TPU.json; do
+    [ -e "$f" ] && arts="$arts $f"
+  done
   for _ in 1 2 3 4 5; do
+    # shellcheck disable=SC2086
+    git add -- $arts >>"$LOG" 2>&1
+    # shellcheck disable=SC2086
     if git commit -m "On-chip bench recapture after tunnel recovery" \
-        -- BENCH_ONCHIP.json BENCH_VARIANTS.json TUNE.json \
-           BENCH_SUITE_TPU.json >>"$LOG" 2>&1; then
+        -- $arts >>"$LOG" 2>&1; then
       return 0
     fi
     sleep 20
@@ -44,7 +61,7 @@ echo "$(date -u) tunnel watch started (poll every ${POLL_S}s)" >>"$LOG"
 while true; do
   if probe >>"$LOG" 2>&1; then
     echo "$(date -u) tunnel recovered; running measurement loop" >>"$LOG"
-    bash scripts/on_tunnel_return.sh >>"$LOG" 2>&1
+    flock "$LOCK" bash scripts/on_tunnel_return.sh >>"$LOG" 2>&1
     commit_artifacts
     echo "$(date -u) capture complete" >>"$LOG"
     exit 0
