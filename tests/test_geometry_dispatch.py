@@ -144,3 +144,42 @@ def test_launchers_registry():
         exp_fn, cfg = launcher()
         assert exp_fn in EXPERIMENTS.values(), name
         assert cfg.output_folder and cfg.dataset_folder, name
+
+
+def test_sweeps_single_store_pass_match_array(tmp_path, rng):
+    """activity_sweep / kurtosis_sweep stream the store ONCE for all dicts
+    (chunk-outer loop); results must equal the in-RAM-array path for
+    multiple dicts of different widths."""
+    from sparse_coding_tpu.data.chunk_store import ChunkStore, ChunkWriter
+    from sparse_coding_tpu.metrics.geometry import activity_sweep, kurtosis_sweep
+
+    d = 16
+    x = np.asarray(jax.random.normal(rng, (4000, d)), np.float32)
+    w = ChunkWriter(tmp_path / "store", d, chunk_size_gb=1500 * d * 4 / 2**30,
+                    dtype="float32")
+    w.add(x)
+    w.finalize()
+    store = ChunkStore(tmp_path / "store")
+    assert store.n_chunks > 1
+
+    files = []
+    for i, n in enumerate((24, 40)):
+        p, b = FunctionalTiedSAE.init(jax.random.PRNGKey(i), d, n,
+                                      l1_alpha=1e-3)
+        f = tmp_path / f"d{i}.pkl"
+        save_learned_dicts([(FunctionalTiedSAE.to_learned_dict(p, b),
+                             {"l1_alpha": 1e-3, "i": i})], f)
+        files.append(f)
+
+    a_store = activity_sweep(files, store, threshold=5, batch_size=500)
+    a_array = activity_sweep(files, x, threshold=5, batch_size=500)
+    assert [r["n_ever_active"] for r in a_store] == \
+        [r["n_ever_active"] for r in a_array]
+    assert [r["n_feats"] for r in a_store] == [24, 40]
+
+    k_store = kurtosis_sweep(files, store, batch_size=500)
+    k_array = kurtosis_sweep(files, x, batch_size=500)
+    for rs, ra in zip(k_store, k_array):
+        assert rs["mean_kurtosis"] == pytest.approx(ra["mean_kurtosis"],
+                                                    rel=1e-5)
+        assert rs["mean_skew"] == pytest.approx(ra["mean_skew"], rel=1e-5)
