@@ -520,10 +520,21 @@ class Ensemble:
             raise ValueError(f"lr must be scalar or length-{n}, got shape {lrs.shape}")
         opt_state = jax.vmap(self.optimizer.init)(params)
         if fused_moments_dtype == "bfloat16":
-            # half-width storage for the BIG ([N, n, d]) moment leaves only;
+            # half-width storage for the dictionary-weight moment leaves
+            # only — selected BY NAME (encoder/decoder, mirroring the
+            # name-based row_params contract) rather than by ndim, so a
+            # future 3-d non-dictionary leaf can't be swept in silently;
             # bias moments stay f32 (negligible traffic, less deviation)
-            cast = lambda tree: jax.tree.map(
-                lambda a: a.astype(jnp.bfloat16) if a.ndim == 3 else a, tree)
+            from jax.tree_util import DictKey, tree_map_with_path
+
+            def _is_weight_leaf(path) -> bool:
+                return any(isinstance(k, DictKey)
+                           and k.key in ("encoder", "decoder")
+                           for k in path)
+
+            cast = lambda tree: tree_map_with_path(
+                lambda p, a: a.astype(jnp.bfloat16) if _is_weight_leaf(p)
+                else a, tree)
             opt_state = opt_state._replace(mu=cast(opt_state.mu),
                                            nu=cast(opt_state.nu))
         self._moments_itemsize = 2 if fused_moments_dtype == "bfloat16" else 4

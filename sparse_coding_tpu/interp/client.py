@@ -153,27 +153,62 @@ def expected_values_from_logprobs(out_tokens: Sequence[str],
                 den += p
         return num / den if den > 0 else float(fallback)
 
+    def continuation(i: int, raw_digits: str):
+        """A two-token number split like '1'+'0' (or a fused '\\t1' followed
+        by '0'): if the NEXT token is a digit string whose concatenation
+        still parses as a 0-10 activation, the number extends across the
+        split. Returns the combined value, else None. Without this,
+        '...\\t1','0' recorded 1 and dropped the 0 — understating exactly
+        the max-activation (10) positions that drive the correlation score
+        (ADVICE r4 #1). Newline boundaries end the number: a current token
+        already carrying '\\n' ('1\\n'), or a next token whose digit sits
+        AFTER a newline ('\\n0' — the next LINE's document token), must not
+        merge."""
+        if "\n" in raw_digits or i + 1 >= len(out_tokens):
+            return None
+        nxt = out_tokens[i + 1].rstrip("\n")  # '0\n' is digit + line end
+        if nxt and nxt.isdigit():
+            return as_int(raw_digits.strip() + nxt)
+        return None
+
     evs: list[float] = []
     expect_digit = False
-    for tok, dist in zip(out_tokens, top_logprobs):
-        if len(evs) == n_tokens:
-            break
+    i = 0
+    while i < len(out_tokens) and len(evs) < n_tokens:
+        tok = out_tokens[i]
+        # a truncated logprobs array (e.g. around a stop sequence) degrades
+        # to fallback values, it must not crash the scoring call
+        dist = top_logprobs[i] if i < len(top_logprobs) else {}
         if expect_digit:
             v = as_int(tok)
             if v is not None:  # the digit token right after the tab
-                evs.append(ev(dist, v))
+                combined = continuation(i, tok)
+                if combined is not None:
+                    # multi-token number: no single logprob position holds
+                    # the value, so record it literally
+                    evs.append(float(combined))
+                    i += 1  # consume the continuation token
+                else:
+                    evs.append(ev(dist, v))
                 expect_digit = False
             elif "\n" in tok:  # line ended without a parseable activation
                 evs.append(0.0)
                 expect_digit = False
+            i += 1
             continue
         if "\t" in tok:
             tail = tok.rsplit("\t", 1)[1]
             v = as_int(tail)
             if tail and v is not None:  # tab+digit fused into one token
-                evs.append(ev(dist, v))
+                combined = continuation(i, tail)
+                if combined is not None:
+                    evs.append(float(combined))
+                    i += 1
+                else:
+                    evs.append(ev(dist, v))
             else:
                 expect_digit = True
+        i += 1
     evs += [0.0] * (n_tokens - len(evs))
     return evs
 

@@ -485,3 +485,55 @@ def test_openai_explainer_protocol_hermetic():
         [{"7": 0.0}, {"\t": 0.0}, {"3": 0.0}, {"\n": 0.0},
          {"tok": 0.0}, {"\t": 0.0}, {"oops\n": 0.0}, {"x": 0.0}], 3)
     assert evs == [3.0, 0.0, 0.0]
+
+
+def test_logprob_ev_digit_continuation():
+    """A 10 split across tokens ('\\t1'+'0' fused-tab, or '\\t','1','0')
+    must parse as 10, not 1 with the 0 dropped (understating exactly the
+    max-activation positions the correlation score leans on)."""
+    from sparse_coding_tpu.interp.client import expected_values_from_logprobs
+
+    # fused tab+digit then bare-digit continuation
+    evs = expected_values_from_logprobs(
+        ["cat\t1", "0", "\n", "dog\t2", "\n"],
+        [{"cat\t1": 0.0}, {"0": 0.0}, {"\n": 0.0}, {"dog\t2": 0.0},
+         {"\n": 0.0}], 2)
+    assert evs == [10.0, 2.0]
+
+    # separate tab token, then '1' + '0'
+    evs = expected_values_from_logprobs(
+        ["cat", "\t", "1", "0", "\n", "dog", "\t", "4", "\n"],
+        [{}] * 9, 2)
+    assert evs == [10.0, 4.0]
+
+    # a '1' followed by a NON-digit token is still a plain 1 (EV path)
+    evs = expected_values_from_logprobs(
+        ["cat\t1", "\n", "dog\t0", "\n"],
+        [{"1": 0.0, "0": -100.0}, {}, {"0": 0.0}, {}], 2)
+    assert evs == [pytest.approx(1.0, abs=1e-6), 0.0]
+
+    # '1'+'1' would read 11 > 10: not a valid activation, no extension
+    evs = expected_values_from_logprobs(
+        ["cat\t1", "1", "\n"], [{"1": 0.0}, {"1": 0.0}, {}], 1)
+    assert evs == [1.0]
+
+    # newline boundaries end the number: a fused '\n0' is the NEXT line's
+    # document token, and a '1\n' already closed its line — neither merges
+    evs = expected_values_from_logprobs(
+        ["cat\t1", "\n0", "war", "\t", "3", "\n"],
+        [{"1": 0.0}, {}, {}, {}, {"3": 0.0}, {}], 2)
+    assert evs == [1.0, 3.0]
+    evs = expected_values_from_logprobs(
+        ["cat\t", "1\n", "0", "res", "\t", "2", "\n"],
+        [{}] * 7, 2)
+    assert evs == [1.0, 2.0]
+    # ... but a '0\n' continuation (digit then line end) does merge
+    evs = expected_values_from_logprobs(
+        ["cat\t", "1", "0\n", "dog\t2", "\n"], [{}] * 5, 2)
+    assert evs == [10.0, 2.0]
+
+    # a logprobs array shorter than the token array degrades to fallback
+    # values instead of crashing
+    evs = expected_values_from_logprobs(
+        ["cat\t1", "\n", "dog\t2"], [{"1": 0.0}, {}], 2)
+    assert evs == [1.0, 2.0]

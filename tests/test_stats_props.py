@@ -152,8 +152,10 @@ def test_streaming_scan_compiles_bounded(rng, tmp_path):
 def test_streaming_eval_sweep_matches_separate_passes(rng, tmp_path):
     """The single-pass combined sweep (VERDICT r4 next #3) returns exactly
     what n_ever_active + calc_moments_streaming return separately — for an
-    array AND a multi-chunk store, including a dict whose `center` is NOT
-    the identity (activity counts encode centered input, moments do not)."""
+    array AND a multi-chunk store. The fixture dict has a non-identity
+    `center` to pin that BOTH families encode the RAW batch (neither scan
+    applies center; a fused scan that centered one of them would diverge
+    here across the threshold sweep)."""
     from sparse_coding_tpu.data.chunk_store import ChunkStore, ChunkWriter
     from sparse_coding_tpu.metrics.core import (
         n_ever_active,
