@@ -213,7 +213,10 @@ def _cpu_fallback_main() -> None:
     cfg = CPU_FALLBACK
     rate = _time_ensemble(use_fused=False, **cfg)
     fpa = flops_per_activation(n_members=cfg["n_members"])
+    # variant present on EVERY emit path — CLAUDE.md documents it as part of
+    # the stdout contract, so the fallback line must carry it too
     _emit(rate, backend="cpu-fallback", fpa=fpa,
+          variant={"use_fused": False},
           note="TPU tunnel down; reduced scale "
                f"(members={cfg['n_members']}, batch={cfg['batch']}) on CPU")
 

@@ -92,17 +92,20 @@ def identify_task_features(
 def run_ioi_feature_ident(params, lm_cfg, model: LearnedDict, layer: int,
                           tokenizer, n_prompts: int = 32,
                           layer_loc: str = "residual", forward=None,
+                          family: str = "mixed", seed: int = 0,
                           **kwargs) -> dict:
     """End-to-end IOI feature identification (the missing
-    ioi_feature_ident.py workflow): build the counterfactual IOI dataset and
-    rank this dictionary's features by their causal effect on the IOI
-    logit-diff."""
+    ioi_feature_ident.py workflow): build the counterfactual IOI dataset
+    (`family` selects any ioi_counterfact.TEMPLATE_FAMILIES bank; "mixed"
+    = ABBA+BABA, the reference gen_ioi_dataset's population) and rank this
+    dictionary's features by their causal effect on the IOI logit-diff."""
     from sparse_coding_tpu.tasks.ioi_counterfact import (
         gen_ioi_dataset_with_distractors,
     )
 
     tokens, _, lengths, target_ids, distractor_ids = (
-        gen_ioi_dataset_with_distractors(tokenizer, n_prompts))
+        gen_ioi_dataset_with_distractors(tokenizer, n_prompts,
+                                         family=family, seed=seed))
     return identify_task_features(
         params, lm_cfg, model, layer, tokens, lengths, target_ids,
         distractor_ids, layer_loc=layer_loc, forward=forward, **kwargs)

@@ -223,6 +223,69 @@ def test_ioi_counterfact_dataset():
     assert np.all(lengths <= tokens.shape[1])
 
 
+def test_ioi_counterfact_template_bank_breadth():
+    """The bank matches the reference's distributional breadth
+    (ioi_counterfact.py:133-236: 15 short + 15 long + 8 late + 8 early BABA
+    templates, ABBA/BAC derivations, 8 places, 8 objects, verb slot), every
+    family generates well-formed counterfact pairs, and each template ends
+    with the indirect-object slot (the completion token)."""
+    from sparse_coding_tpu.tasks import ioi_counterfact as icf
+
+    assert len(icf.BABA_TEMPLATES) >= 15
+    assert len(icf.BABA_LONG_TEMPLATES) >= 15
+    assert len(icf.BABA_LATE_IOS) >= 8
+    assert len(icf.BABA_EARLY_IOS) >= 8
+    assert len(icf.ABC_TEMPLATES) >= 4
+    assert len(icf.PLACES) >= 8 and len(icf.OBJECTS) >= 8
+    assert len(icf.TEMPLATE_FAMILIES) >= 10
+
+    # ABBA derivation really swaps: every template differs from its source
+    # (a comma-cut swap silently no-ops on 'Later, ...' style openers)
+    for baba, abba in [(icf.BABA_TEMPLATES, icf.ABBA_TEMPLATES),
+                       (icf.BABA_LONG_TEMPLATES, icf.ABBA_LONG_TEMPLATES),
+                       (icf.BABA_LATE_IOS, icf.ABBA_LATE_IOS),
+                       (icf.BABA_EARLY_IOS, icf.ABBA_EARLY_IOS),
+                       (icf.ABC_TEMPLATES, icf.BAC_TEMPLATES)]:
+        assert len(baba) == len(abba)
+        assert all(a != b for a, b in zip(baba, abba))
+
+    for bank in (icf.BABA_TEMPLATES, icf.BABA_LONG_TEMPLATES,
+                 icf.BABA_LATE_IOS, icf.BABA_EARLY_IOS, icf.ABC_TEMPLATES):
+        for t in bank:
+            assert t.endswith("[A]"), t
+            assert "[B]" in t and "[PLACE]" in t and "[OBJECT]" in t
+
+    tok = _CharTokenizer()
+    for family in icf.TEMPLATE_FAMILIES:
+        prompts = icf.gen_prompt_counterfact(tok, 5, family=family, seed=1)
+        for p in prompts:
+            # completion is the indirect object; counterfact swaps ONLY it
+            assert p.text.endswith(p.indirect_object)
+            assert not p.counterfact.endswith(p.indirect_object)
+            assert p.subject in p.text and p.subject in p.counterfact
+            assert "[" not in p.text and "[" not in p.counterfact
+
+    with pytest.raises(ValueError, match="unknown family"):
+        icf.gen_prompt_counterfact(tok, 1, family="nope")
+
+
+def test_ioi_counterfact_families_feed_feature_ident(tiny_lm):
+    """Probe test (VERDICT r4 next #6): the broadened families flow through
+    the causal feature-identification driver end-to-end."""
+    from sparse_coding_tpu.tasks.feature_ident import run_ioi_feature_ident
+
+    params, lm_cfg = tiny_lm
+    sae = TiedSAE(dictionary=jax.random.normal(jax.random.PRNGKey(5),
+                                               (8, lm_cfg.d_model)),
+                  encoder_bias=jnp.zeros(8))
+    for family in ("mixed", "abc", "baba_long"):
+        result = run_ioi_feature_ident(params, lm_cfg, sae, layer=1,
+                                       tokenizer=_CharTokenizer(),
+                                       n_prompts=4, family=family,
+                                       forward=gptneox.forward, top_m=2)
+        assert len(result["ranking"]) == 2
+
+
 def test_gender_probe_arrays():
     from sparse_coding_tpu.tasks.gender import gender_probe_arrays
 
