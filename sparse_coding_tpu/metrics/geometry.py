@@ -71,8 +71,8 @@ def activity_sweep(dict_files: Sequence[str | Path], activations,
     memory; re-reads ride the OS page cache across dicts)."""
     acts = (activations if _is_store(activations)
             else jnp.asarray(activations))
-    dicts = [(ld, hyper) for path in dict_files
-             for ld, hyper in load_learned_dicts(path)]
+    dicts = [(ld, hyper, str(path), j) for path in dict_files
+             for j, (ld, hyper) in enumerate(load_learned_dicts(path))]
     if not dicts:
         return []
     # chunk-outer / dict-inner: the store streams ONCE for the whole census
@@ -83,16 +83,20 @@ def activity_sweep(dict_files: Sequence[str | Path], activations,
 
     counts: list = [None] * len(dicts)
     for slab in _iter_slabs(acts, batch_size):
-        for i, (ld, _) in enumerate(dicts):
+        for i, (ld, _, _, _) in enumerate(dicts):
             c = _count_active_scan(ld, slab, batch_size)
             counts[i] = c if counts[i] is None else counts[i] + c
     out = []
-    for (ld, hyper), c in zip(dicts, counts):
+    for (ld, hyper, path, member), c in zip(dicts, counts):
         out.append({
             **{k: v for k, v in hyper.items()
                if isinstance(v, (int, float, str, bool))},
             "n_ever_active": int(jnp.sum(c > threshold)),
             "n_feats": int(ld.n_feats),
+            # provenance so multi-file censuses can be partitioned back
+            # (plotting/timeseries.py runs ONE census over all snapshots)
+            "artifact": path,
+            "member": member,
         })
     return out
 

@@ -174,3 +174,24 @@ def bottleneck_plot(series: Dict[str, Sequence[Tuple[float, float]]],
     img = _fig_to_array(fig)
     plt.close(fig)
     return img
+
+
+def get_pyplot():
+    """Headless-safe pyplot (Agg backend): the single home for the
+    matplotlib-setup dance the file-figure plotters share
+    (plotting/sweeps.py, plotting/timeseries.py)."""
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def save_figure(fig, save_path) -> None:
+    """mkdir-parents + savefig(dpi=150) + close, shared by the file-figure
+    plotters."""
+    plt = get_pyplot()
+    Path(save_path).parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(save_path, dpi=150)
+    plt.close(fig)

@@ -17,12 +17,9 @@ from sparse_coding_tpu.utils.artifacts import load_learned_dicts
 
 
 def _plt():
-    import matplotlib
+    from sparse_coding_tpu.plotting.helpers import get_pyplot
 
-    matplotlib.use("Agg", force=False)
-    import matplotlib.pyplot as plt
-
-    return plt
+    return get_pyplot()
 
 
 def sweep_grid(scores: Sequence[dict], x_key: str = "l1_alpha",

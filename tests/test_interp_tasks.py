@@ -427,6 +427,21 @@ def test_interpret_across_chunks(tmp_path, tiny_lm):
     assert ([r["feature"] for r in series["_0"][member]] ==
             [r["feature"] for r in series["_1"][member]])
 
+    # one-call time-series figure over the tree this driver just wrote
+    # (reference: plot_autointerp_across_chunks.py)
+    from sparse_coding_tpu.plotting.timeseries import (
+        plot_autointerp_across_chunks,
+    )
+
+    fig_path = tmp_path / "plots" / "autointerp_over_time.png"
+    plotted = plot_autointerp_across_chunks(tmp_path / "interp",
+                                            save_path=fig_path)
+    assert fig_path.exists()
+    (name, s), = [(k, v) for k, v in plotted.items()]
+    assert name == "e_learned_dicts_0"
+    assert s["snapshots"] == [0, 1]
+    assert len(s["mean"]) == 2 and all(np.isfinite(s["mean"]))
+
 
 def test_identify_task_features(tiny_lm):
     """A feature whose dictionary atom is planted in the unembedding

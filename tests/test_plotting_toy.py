@@ -130,3 +130,34 @@ def test_s3_transfer_gated_without_boto3(tmp_path, monkeypatch):
     monkeypatch.delitem(sys.modules, "boto3", raising=False)
     with pytest.raises(ImportError, match="boto3"):
         ops.upload_to_aws(tmp_path / "x", "bucket")
+
+
+def test_plot_n_active_over_time(tmp_path, rng):
+    """One-call active-features-over-training figure from a sweep snapshot
+    tree (reference: plot_n_active_over_time.py)."""
+    from sparse_coding_tpu.plotting.timeseries import plot_n_active_over_time
+
+    d = 12
+    for i, scale in enumerate((0.0, 1.0, 2.0)):
+        snap = tmp_path / "sweep" / f"_{i}"
+        snap.mkdir(parents=True)
+        dicts = []
+        for j, n in enumerate((16, 24)):
+            p, b = FunctionalTiedSAE.init(jax.random.PRNGKey(10 * i + j), d,
+                                          n, l1_alpha=1e-3)
+            # later snapshots get increasingly negative bias -> fewer
+            # active features, so the series must be non-increasing
+            p = dict(p, encoder_bias=p["encoder_bias"] - scale)
+            dicts.append((FunctionalTiedSAE.to_learned_dict(p, b),
+                          {"l1_alpha": 1e-3, "dict_size": n}))
+        save_learned_dicts(dicts, snap / "e_learned_dicts.pkl")
+
+    acts = np.asarray(jax.random.normal(rng, (3000, d)), np.float32)
+    fig = tmp_path / "plots" / "n_active.png"
+    series = plot_n_active_over_time(tmp_path / "sweep", acts, threshold=5,
+                                     batch_size=500, save_path=fig)
+    assert fig.exists()
+    assert len(series) == 2  # one line per (l1, size) member
+    for s in series.values():
+        assert s["snapshots"] == [0, 1, 2]
+        assert s["n_active"][0] >= s["n_active"][-1]
