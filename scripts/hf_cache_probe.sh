@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Session-start probe: does the HF cache already hold the real-weights
+# frontier's inputs? Prints READY (with the next command) or EMPTY.
+# Run this at the top of every session — the frontier should fire the
+# FIRST session it can (VERDICT r4 next #8).
+set -u
+CACHE="${HF_HOME:-$HOME/.cache/huggingface}"
+model_ok=false
+data_ok=false
+# require config AND weights: an interrupted populate run downloads the
+# small JSONs first and would otherwise leave a persistent false READY
+m="$CACHE/hub/models--EleutherAI--pythia-70m-deduped/snapshots"
+if compgen -G "$m/*/*.json" >/dev/null 2>&1 \
+    && { compgen -G "$m/*/*.safetensors" >/dev/null 2>&1 \
+         || compgen -G "$m/*/*.bin" >/dev/null 2>&1; }; then
+  model_ok=true
+fi
+# at least one actual data file inside the dataset snapshot, not just the
+# (possibly empty) revision directory
+if compgen -G "$CACHE/hub/datasets--NeelNanda--pile-10k/snapshots/*/*" >/dev/null 2>&1; then
+  data_ok=true
+fi
+echo "hf-cache: model(pythia-70m-deduped)=$model_ok dataset(pile-10k)=$data_ok"
+if $model_ok && $data_ok; then
+  echo "READY -> flock /tmp/axon_tunnel.lock python examples/pythia70m_frontier.py"
+  exit 0
+fi
+echo "EMPTY -> bash scripts/populate_hf_cache.sh (needs network egress)"
+exit 1

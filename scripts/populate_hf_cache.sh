@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Populate the HuggingFace cache with everything the real-weights frontier
+# needs (examples/pythia70m_frontier.py + tests/test_lm_parity.py), so the
+# moment this container ever has network egress, ONE command takes us from
+# empty cache to the canonical FVU-vs-L0 frontier artifact:
+#
+#   bash scripts/populate_hf_cache.sh && \
+#     flock /tmp/axon_tunnel.lock python examples/pythia70m_frontier.py
+#
+# Also un-skips the real-weights LM parity gate:
+#   python -m pytest tests/test_lm_parity.py -q
+#
+# Idempotent: HF hub downloads resume/skip what's cached. Zero-egress
+# containers fail fast on the first download with a clear network error.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python - <<'EOF'
+from huggingface_hub import snapshot_download
+
+# model weights + tokenizer (the frontier's canonical model; BASELINE.md)
+snapshot_download("EleutherAI/pythia-70m-deduped",
+                  allow_patterns=["*.json", "*.bin", "*.safetensors",
+                                  "tokenizer*", "*.txt"])
+print("pythia-70m-deduped cached")
+
+# the reference's eval corpus (test_end_to_end.py uses pile-10k)
+snapshot_download("NeelNanda/pile-10k", repo_type="dataset")
+print("pile-10k cached")
+EOF
+
+echo "HF cache ready; next:"
+echo "  flock /tmp/axon_tunnel.lock python examples/pythia70m_frontier.py"
