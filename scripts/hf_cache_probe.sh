@@ -15,11 +15,16 @@ if compgen -G "$m/*/*.json" >/dev/null 2>&1 \
          || compgen -G "$m/*/*.bin" >/dev/null 2>&1; }; then
   model_ok=true
 fi
-# at least one actual data file inside the dataset snapshot, not just the
-# (possibly empty) revision directory
-if compgen -G "$CACHE/hub/datasets--NeelNanda--pile-10k/snapshots/*/*" >/dev/null 2>&1; then
-  data_ok=true
-fi
+# at least one actual DATA file inside the dataset snapshot — an
+# interrupted populate that only fetched README.md must not read as ready
+ds="$CACHE/hub/datasets--NeelNanda--pile-10k/snapshots"
+for ext in parquet arrow json jsonl "json.zst" "jsonl.zst" csv; do
+  if compgen -G "$ds/*/*.$ext" >/dev/null 2>&1 \
+      || compgen -G "$ds/*/*/*.$ext" >/dev/null 2>&1; then
+    data_ok=true
+    break
+  fi
+done
 echo "hf-cache: model(pythia-70m-deduped)=$model_ok dataset(pile-10k)=$data_ok"
 if $model_ok && $data_ok; then
   echo "READY -> flock /tmp/axon_tunnel.lock python examples/pythia70m_frontier.py"
