@@ -22,9 +22,9 @@ import pytest
 
 _WORKER = textwrap.dedent("""
     import os, sys
-    pid, port, nprocs, local_dev, out_path = (
+    pid, port, nprocs, local_dev, mesh_model, mesh_data, out_path = (
         int(sys.argv[1]), sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
-        sys.argv[5])
+        int(sys.argv[5]), int(sys.argv[6]), sys.argv[7])
     # NOTE: the axon plugin must be stripped by the PARENT's env (sitecustomize
     # runs before this script body); these env vars are honored because they
     # are read lazily by jax itself
@@ -40,10 +40,10 @@ _WORKER = textwrap.dedent("""
     from sparse_coding_tpu.models.sae import FunctionalTiedSAE
     from sparse_coding_tpu.parallel.mesh import make_mesh
 
-    assert len(jax.devices()) == 8, jax.devices()          # global view
+    assert len(jax.devices()) == nprocs * local_dev, jax.devices()  # global
     assert len(jax.local_devices()) == local_dev
 
-    mesh = make_mesh(2, 4)  # 2-way ensemble parallel x 4-way data parallel
+    mesh = make_mesh(mesh_model, mesh_data)  # ensemble x data parallel
     members = [FunctionalTiedSAE.init(k, 16, 32, l1_alpha=1e-3)
                for k in jax.random.split(jax.random.PRNGKey(0), 4)]
     ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, mesh=mesh)
@@ -82,10 +82,14 @@ def _stripped_env() -> dict:
     return env
 
 
-def _run_world(tmp_path, n_procs: int, local_dev: int) -> list[float]:
-    """Launch an n_procs-process world (local_dev virtual CPU devices each,
-    8 global), train the sharded ensemble, and return the global losses
-    after asserting every process observed the identical result."""
+def _run_world(tmp_path, n_procs: int, local_dev: int,
+               mesh_shape: tuple[int, int] = (2, 4)) -> list[float]:
+    """Launch an n_procs-process world (local_dev virtual CPU devices each),
+    train the sharded ensemble on a mesh_shape=(model, data) mesh, and
+    return the global losses after asserting every process observed the
+    identical result."""
+    assert n_procs * local_dev == mesh_shape[0] * mesh_shape[1], \
+        "world size must equal the mesh device count"
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)
     port = _free_port()
@@ -94,6 +98,7 @@ def _run_world(tmp_path, n_procs: int, local_dev: int) -> list[float]:
     out_files = [tmp_path / f"losses_{pid}.txt" for pid in range(n_procs)]
     procs = [subprocess.Popen([sys.executable, str(worker), str(pid),
                                str(port), str(n_procs), str(local_dev),
+                               str(mesh_shape[0]), str(mesh_shape[1]),
                                str(out_files[pid])],
                               env=env, stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True)
