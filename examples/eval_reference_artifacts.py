@@ -1,0 +1,149 @@
+"""Evaluate dictionaries trained by the REFERENCE framework, in place.
+
+The most direct cross-framework check available: point this at a reference
+output directory (torch `learned_dicts.pt` pickles, `<i>.pt` activation
+chunks — big_sweep.py:378-384 / activation_dataset.py:499-503 formats) and
+get the same FVU / L0 / dead-features / MMCS table the native eval drivers
+produce, with no conversion step.
+
+    python examples/eval_reference_artifacts.py \
+        --dicts old_run/_31/learned_dicts.pt \
+        --chunks old_run/activations/l2_residual \
+        [--out scores.json]
+
+    python examples/eval_reference_artifacts.py --selftest   # hermetic demo
+
+`--selftest` needs no reference checkout or artifacts: it writes a
+reference-format artifact + chunk folder with throwaway fixtures, then
+runs the identical evaluation path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def evaluate(dicts_path: str, chunks_path: str, eval_rows: int = 8192,
+             batch_size: int = 1000) -> list[dict]:
+    import jax.numpy as jnp
+
+    from sparse_coding_tpu.data.chunk_store import ChunkStore
+    from sparse_coding_tpu.metrics.core import (
+        _count_active_scan,
+        _iter_slabs,
+        fraction_variance_unexplained,
+        mean_l0,
+        mmcs_from_list,
+    )
+    from sparse_coding_tpu.utils.ref_interop import (
+        load_reference_learned_dicts,
+    )
+
+    pairs = load_reference_learned_dicts(dicts_path)
+    store = ChunkStore(chunks_path)
+    x = jnp.asarray(store.load_chunk(0)[:eval_rows])
+    print(f"loaded {len(pairs)} reference dict(s); eval batch {x.shape} "
+          f"from a {store.format}-format store of {store.n_chunks} chunk(s)",
+          file=sys.stderr)
+
+    # chunk-outer / dict-inner (the activity_sweep pattern): the store —
+    # possibly 40x2 GB of torch-deserialized .pt files with no native
+    # readahead — streams ONCE for all dicts
+    counts = [None] * len(pairs)
+    for slab in _iter_slabs(store, batch_size):
+        for i, (ld, _) in enumerate(pairs):
+            c = _count_active_scan(ld, slab, batch_size)
+            counts[i] = c if counts[i] is None else counts[i] + c
+    n_alive_per_dict = [int(jnp.sum(c > 10)) for c in counts]
+
+    records = []
+    for (ld, hyper), n_alive in zip(pairs, n_alive_per_dict):
+        records.append({
+            **{k: v for k, v in hyper.items()
+               if isinstance(v, (int, float, str, bool))},
+            "class": type(ld).__name__,
+            "n_feats": int(ld.n_feats),
+            "fvu": float(fraction_variance_unexplained(ld, x)),
+            "mean_l0": float(mean_l0(ld, x)),
+            "n_ever_active": int(n_alive),
+        })
+    sims = mmcs_from_list([ld for ld, _ in pairs])
+    for i, rec in enumerate(records):
+        others = [float(sims[i, j]) for j in range(len(records)) if j != i]
+        rec["max_mmcs_to_others"] = max(others) if others else None
+    return records
+
+
+def _selftest(tmp: Path) -> tuple[str, str]:
+    """Reference-format fixtures (format emulation, same as
+    tests/test_ref_interop.py) so the example runs hermetically."""
+    import sys as _sys
+    import types
+
+    import numpy as np
+    import torch
+
+    rng = np.random.default_rng(0)
+    d, n = 32, 64
+    chunks = tmp / "chunks"
+    chunks.mkdir(parents=True)
+    for i in range(2):
+        torch.save(torch.tensor(rng.normal(size=(20_000, d))
+                                .astype(np.float16)), chunks / f"{i}.pt")
+
+    cls = type("TiedSAE", (), {"__module__": "autoencoders.learned_dict"})
+    pairs = []
+    for l1 in (3e-4, 1e-3):
+        obj = cls.__new__(cls)
+        obj.__dict__.update(
+            encoder=torch.tensor(rng.normal(size=(n, d)).astype(np.float32)),
+            encoder_bias=torch.zeros(n), norm_encoder=True,
+            n_feats=n, activation_size=d)
+        pairs.append((obj, {"l1_alpha": l1, "dict_size": n}))
+    pkg = types.ModuleType("autoencoders")
+    mod = types.ModuleType("autoencoders.learned_dict")
+    mod.TiedSAE = cls
+    pkg.learned_dict = mod
+    _sys.modules["autoencoders"] = pkg
+    _sys.modules["autoencoders.learned_dict"] = mod
+    try:
+        torch.save(pairs, tmp / "learned_dicts.pt")
+    finally:
+        _sys.modules.pop("autoencoders", None)
+        _sys.modules.pop("autoencoders.learned_dict", None)
+    return str(tmp / "learned_dicts.pt"), str(chunks)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dicts", help="reference learned_dicts.pt")
+    ap.add_argument("--chunks", help="reference chunk folder (<i>.pt)")
+    ap.add_argument("--out", default=None, help="write scores JSON here")
+    ap.add_argument("--eval-rows", type=int, default=8192)
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            dicts, chunks = _selftest(Path(td))
+            records = evaluate(dicts, chunks, eval_rows=4096)
+    elif args.dicts and args.chunks:
+        records = evaluate(args.dicts, args.chunks, eval_rows=args.eval_rows)
+    else:
+        ap.error("--dicts and --chunks are required (or --selftest)")
+
+    for rec in records:
+        print(json.dumps(rec))
+    if args.out:
+        Path(args.out).write_text(json.dumps(records, indent=2))
+
+
+if __name__ == "__main__":
+    main()

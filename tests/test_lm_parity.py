@@ -264,3 +264,20 @@ def test_embedding_direction_check_tiny(tmp_path):
     for r in rows:
         assert 0.0 <= r["embed_mcs"] <= 1.0
         assert 0.0 <= r["unembed_mcs"] <= 1.0
+
+
+def test_eval_reference_artifacts_selftest(capsys):
+    """examples/eval_reference_artifacts.py --selftest: the cross-framework
+    eval CLI runs hermetically over reference-format fixtures (learned
+    dicts pickle + .pt chunk folder) and emits one JSON record per dict."""
+    import json
+
+    _run_example("eval_reference_artifacts.py", "--selftest")
+    out = capsys.readouterr().out.strip().splitlines()
+    recs = [json.loads(line) for line in out if line.startswith("{")]
+    assert len(recs) == 2
+    for rec in recs:
+        assert rec["class"] == "TiedSAE"
+        assert 0.0 <= rec["fvu"] <= 2.0
+        assert rec["n_ever_active"] <= rec["n_feats"]
+    assert recs[0]["l1_alpha"] == 3e-4
