@@ -174,12 +174,64 @@ def _convert_one(obj: Any):
     if name == "TopKLearnedDict":
         return TopKLearnedDict(dictionary=jnp.asarray(_np(d["dict"])),
                                k=int(d["sparsity"]))
+    if name in ("TiedPositiveSAE", "UntiedPositiveSAE"):
+        # reference mlp_tests.py:8-66: encode uses the RAW |encoder| rows
+        # (UntiedPositiveSAE computes a normalized copy but its einsum uses
+        # self.encoder, and TiedPositiveSAE defaults norm_encoder=False);
+        # decode/get_learned_dict is the row-NORMALIZED encoder in both
+        # (the decoder attr is never used at inference). That behavior is
+        # exactly native UntiedSAE(enc, bias, enc); the constructor already
+        # stored |encoder|, so no abs here. The norm_encoder=True tied case
+        # is a plain TiedSAE.
+        enc = jnp.asarray(_np(d["encoder"]))
+        bias = jnp.asarray(_np(d["encoder_bias"]))
+        if name == "TiedPositiveSAE" and d.get("norm_encoder", False):
+            return TiedSAE(dictionary=enc, encoder_bias=bias)
+        return UntiedSAE(encoder=enc, encoder_bias=bias, dictionary=enc)
+    if name == "LISTADenoisingSAE":
+        from sparse_coding_tpu.models.lista import LISTADenoisingSAE
+
+        p = d["params"]
+        return LISTADenoisingSAE(
+            decoder=jnp.asarray(_np(p["decoder"])),
+            encoder_layers=_stack_layer_list(p["encoder_layers"]))
+    if name == "ResidualDenoisingSAE":
+        from sparse_coding_tpu.models.lista import ResidualDenoisingSAE
+
+        p = d["params"]
+        # the reference constructor reads params["dict"] though its init
+        # writes "decoder" (residual_denoising_autoencoder.py:188,142) —
+        # accept either key
+        dec = p.get("decoder", p.get("dict"))
+        return ResidualDenoisingSAE(
+            decoder=jnp.asarray(_np(dec)),
+            encoder_layers=_stack_layer_list(p["encoder_layers"]),
+            encoder_bias=jnp.asarray(_np(p["encoder_bias"])))
 
     raise NotImplementedError(
         f"no conversion for reference class {name!r} "
         f"(attrs: {sorted(d)}); supported: Identity, IdentityReLU, "
         "IdentityPositive, RandomDict, Rotation, AddedNoise, UntiedSAE, "
-        "TiedSAE, TiedCenteredSAE, ReverseSAE, TopKLearnedDict")
+        "TiedSAE, TiedCenteredSAE, ReverseSAE, TopKLearnedDict, "
+        "TiedPositiveSAE, UntiedPositiveSAE, LISTADenoisingSAE, "
+        "ResidualDenoisingSAE")
+
+
+def _stack_layer_list(layers) -> dict:
+    """Reference per-layer param-dict LISTS → this framework's stacked
+    [L, ...] trees (models/lista.py stacks for lax.scan)."""
+    import jax
+
+    if not layers:
+        # n_hidden_layers=0 is constructible in the reference but the
+        # stacked-scan format cannot infer leaf shapes from zero layers
+        raise NotImplementedError(
+            "reference artifact has an empty encoder_layers list "
+            "(n_hidden_layers=0); the stacked-scan LISTA format needs at "
+            "least one layer")
+    converted = [{k: _np(v) for k, v in layer.items()} for layer in layers]
+    return jax.tree.map(lambda *xs: jax.numpy.stack(
+        [jax.numpy.asarray(x) for x in xs]), *converted)
 
 
 def _clean_hyperparams(h: Any) -> dict:

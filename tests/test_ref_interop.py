@@ -221,6 +221,43 @@ def test_baseline_and_topk_conversions(tmp_path):
     assert (np.count_nonzero(codes, axis=1) <= 3).all()
 
 
+def test_positive_sae_conversions(tmp_path):
+    """mlp_tests positive classes: raw-|row| encode + normalized decode →
+    native UntiedSAE; the norm_encoder=True tied case is a plain TiedSAE
+    (normalized encode)."""
+    r = _rng(9)
+    enc = np.abs(r.normal(size=(10, 6))).astype(np.float32)
+    bias = r.normal(size=(10,)).astype(np.float32)
+    first = _ref_instance("TiedPositiveSAE", encoder=torch.tensor(enc),
+                          encoder_bias=torch.tensor(bias),
+                          norm_encoder=False, n_feats=10, activation_size=6)
+    second = type(first).__new__(type(first))  # same shim class: two
+    # same-named classes would break pickling-by-qualified-name
+    second.__dict__.update(first.__dict__, norm_encoder=True)
+    loaded = load_reference_learned_dicts(_save_ref_artifact(
+        tmp_path, [(first, {}), (second, {})]))
+    raw_d, normed_d = loaded[0][0], loaded[1][0]
+    assert isinstance(raw_d, UntiedSAE) and isinstance(normed_d, TiedSAE)
+
+    x = r.normal(size=(5, 6)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(raw_d.encode(jnp.asarray(x))),
+                               np.maximum(x @ enc.T + bias, 0.0),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(normed_d.encode(jnp.asarray(x))),
+                               np.maximum(x @ _norm_rows(enc).T + bias, 0.0),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_empty_lista_layer_list_fails_loudly(tmp_path):
+    ref = _ref_instance("LISTADenoisingSAE",
+                        params={"decoder": torch.randn(8, 4),
+                                "encoder_layers": []},
+                        n_feats=8, activation_size=4)
+    path = _save_ref_artifact(tmp_path, [(ref, {})])
+    with pytest.raises(NotImplementedError, match="encoder_layers"):
+        load_reference_learned_dicts(path)
+
+
 def test_unknown_reference_class_fails_loudly(tmp_path):
     ref = _ref_instance("FrobnicatorDict", weights=torch.zeros(3, 3))
     path = _save_ref_artifact(tmp_path, [(ref, {})])

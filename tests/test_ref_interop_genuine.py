@@ -49,12 +49,26 @@ _WRITER = textwrap.dedent("""
     import torch
 
     from autoencoders.learned_dict import TiedSAE, UntiedSAE
+    from autoencoders.mlp_tests import TiedPositiveSAE, UntiedPositiveSAE
+    from autoencoders.residual_denoising_autoencoder import (
+        FunctionalLISTADenoisingSAE,
+        FunctionalResidualDenoisingSAE,
+        LISTADenoisingSAE,
+        ResidualDenoisingSAE,
+    )
     from autoencoders.topk_encoder import TopKLearnedDict
 
     out_dir = sys.argv[1]
     torch.manual_seed(0)
     d, n = 16, 24
     x = torch.randn(8, d)
+
+    lista_params, _ = FunctionalLISTADenoisingSAE.init(d, n, 2, 1e-3)
+    resid_params, _ = FunctionalResidualDenoisingSAE.init(d, n, 2, 1e-3)
+    # the reference ResidualDenoisingSAE constructor reads params["dict"]
+    # though its init writes "decoder" (a known reference bug) — any user
+    # who actually constructed one aliased the key, as here
+    resid_params["dict"] = resid_params["decoder"]
 
     q, _ = torch.linalg.qr(torch.randn(d, d))
     dicts = [
@@ -70,6 +84,12 @@ _WRITER = textwrap.dedent("""
         (TopKLearnedDict(torch.nn.functional.normalize(torch.randn(n, d),
                                                        dim=-1), 4),
          {"name": "topk"}),
+        (LISTADenoisingSAE(lista_params), {"name": "lista"}),
+        (ResidualDenoisingSAE(resid_params), {"name": "resid_denoise"}),
+        (TiedPositiveSAE(torch.rand(n, d), 0.1 * torch.randn(n)),
+         {"name": "tied_positive"}),
+        (UntiedPositiveSAE(torch.rand(n, d), 0.1 * torch.randn(n),
+                           torch.randn(n, d)), {"name": "untied_positive"}),
     ]
     torch.save(dicts, out_dir + "/learned_dicts.pt")
 
@@ -86,6 +106,8 @@ _WRITER = textwrap.dedent("""
     print("WROTE", len(dicts))
 """)
 
+N_DICTS = 9
+
 
 @pytest.fixture(scope="module")
 def genuine_artifact(tmp_path_factory):
@@ -97,7 +119,7 @@ def genuine_artifact(tmp_path_factory):
     r = subprocess.run([sys.executable, str(script), str(out)], env=env,
                        capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "WROTE 5" in r.stdout
+    assert f"WROTE {N_DICTS}" in r.stdout
     return out
 
 
@@ -113,6 +135,10 @@ def test_genuine_artifact_roundtrip(genuine_artifact):
         TopKLearnedDict,
         UntiedSAE,
     )
+    from sparse_coding_tpu.models.lista import (
+        LISTADenoisingSAE,
+        ResidualDenoisingSAE,
+    )
     from sparse_coding_tpu.utils.ref_interop import (
         load_reference_learned_dicts,
     )
@@ -121,14 +147,20 @@ def test_genuine_artifact_roundtrip(genuine_artifact):
     x = jnp.asarray(np.asarray(payload["x"], np.float32))
     loaded = load_reference_learned_dicts(genuine_artifact /
                                           "learned_dicts.pt")
-    assert len(loaded) == 5
+    assert len(loaded) == N_DICTS
     by_name = {hyper["name"]: (ld, hyper) for ld, hyper in loaded}
     assert by_name["tied"][1]["l1_alpha"] == pytest.approx(8.6e-4)
 
     want_types = {"untied": UntiedSAE, "tied": TiedSAE,
                   "tied_centered": TiedSAE,
                   "tied_unnormed": UntiedSAE,  # raw-row encode mapping
-                  "topk": TopKLearnedDict}
+                  "topk": TopKLearnedDict,
+                  "lista": LISTADenoisingSAE,
+                  "resid_denoise": ResidualDenoisingSAE,
+                  # positive classes encode with raw |rows|, decode with
+                  # normalized rows — exactly native UntiedSAE
+                  "tied_positive": UntiedSAE,
+                  "untied_positive": UntiedSAE}
     for name, cls in want_types.items():
         assert isinstance(by_name[name][0], cls), name
 
