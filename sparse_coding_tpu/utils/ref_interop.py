@@ -271,6 +271,120 @@ def load_reference_learned_dicts(path: str | Path) -> list[tuple[Any, dict]]:
     return out
 
 
+def export_reference_learned_dicts(pairs, path: str | Path) -> None:
+    """The write side of the interop: save native dicts as a reference
+    ``learned_dicts.pt`` that the REFERENCE's own tooling (its plotting /
+    interp / eval scripts, which torch.load these pickles) can consume.
+
+    The pickle references ``autoencoders.learned_dict`` classes by
+    qualified name — resolved at LOAD time in the reference's environment;
+    writing here needs no reference package (shim classes are registered
+    for the duration of the save). Exportable natives: UntiedSAE, TiedSAE
+    (with optional centering), ReverseSAE, TopKLearnedDict. State layouts
+    mirror the reference constructors (learned_dict.py:129-257,
+    topk_encoder.py:49-63)."""
+    import sys
+    import types
+
+    import torch
+
+    from sparse_coding_tpu.models.learned_dict import (
+        ReverseSAE,
+        TiedSAE,
+        TopKLearnedDict,
+        UntiedSAE,
+    )
+
+    def t(v) -> "torch.Tensor":
+        return torch.tensor(np.asarray(jax.device_get(v), np.float32))
+
+    import jax
+
+    def convert(ld):
+        if isinstance(ld, UntiedSAE):
+            obj = _shim_class("autoencoders.learned_dict", "UntiedSAE")()
+            obj.__dict__.update(
+                encoder=t(ld.encoder), decoder=t(ld.dictionary),
+                encoder_bias=t(ld.encoder_bias))
+        elif isinstance(ld, ReverseSAE):
+            obj = _shim_class("autoencoders.learned_dict", "ReverseSAE")()
+            obj.__dict__.update(encoder=t(ld.dictionary),
+                                encoder_bias=t(ld.encoder_bias),
+                                norm_encoder=True)
+        elif isinstance(ld, TiedSAE):  # after ReverseSAE: not a subclass
+            dim = ld.dictionary.shape[-1]
+            obj = _shim_class("autoencoders.learned_dict", "TiedSAE")()
+            obj.__dict__.update(
+                encoder=t(ld.dictionary), encoder_bias=t(ld.encoder_bias),
+                norm_encoder=True,
+                center_trans=(t(ld.centering_trans)
+                              if ld.centering_trans is not None
+                              else torch.zeros(dim)),
+                center_rot=(t(ld.centering_rot)
+                            if ld.centering_rot is not None
+                            else torch.eye(dim)),
+                center_scale=(t(ld.centering_scale)
+                              if ld.centering_scale is not None
+                              else torch.ones(dim)))
+        elif isinstance(ld, TopKLearnedDict):
+            obj = _shim_class("autoencoders.topk_encoder",
+                              "TopKLearnedDict")()
+            obj.__dict__.update(dict=t(ld.get_learned_dict()),
+                                sparsity=int(ld.k))
+        else:
+            raise NotImplementedError(
+                f"no reference-format export for {type(ld).__name__}; "
+                "exportable: UntiedSAE, TiedSAE, ReverseSAE, "
+                "TopKLearnedDict")
+        obj.__dict__.update(
+            n_feats=int(ld.n_feats), activation_size=int(ld.activation_size))
+        return obj
+
+    # hyperparams must unpickle in the reference env (no jax there):
+    # coerce array-likes to plain scalars, the mirror of the load side
+    records = [(convert(ld), _clean_hyperparams(dict(hyper)))
+               for ld, hyper in pairs]
+    # the shim classes must be importable by qualified name while pickle
+    # WRITES class references (loading in the reference env resolves the
+    # real classes instead). Register ONLY the shims these records use,
+    # snapshot any attribute they would shadow (the process may have the
+    # real reference package imported — its classes must survive), and
+    # restore everything afterwards.
+    used = {type(obj) for obj, _ in records}
+    sentinel = object()
+    created_modules: list[str] = []
+    shadowed: list[tuple] = []  # (module_obj, attr_name, prior_value)
+    try:
+        pkg = sys.modules.get("autoencoders")
+        if pkg is None:
+            pkg = types.ModuleType("autoencoders")
+            sys.modules["autoencoders"] = pkg
+            created_modules.append("autoencoders")
+        for cls in used:
+            module = cls.__module__  # always "autoencoders.<sub>" here
+            mod = sys.modules.get(module)
+            if mod is None:
+                mod = types.ModuleType(module)
+                sys.modules[module] = mod
+                created_modules.append(module)
+            shadowed.append((mod, cls.__name__,
+                             getattr(mod, cls.__name__, sentinel)))
+            setattr(mod, cls.__name__, cls)
+            sub = module.split(".", 1)[1]
+            shadowed.append((pkg, sub, getattr(pkg, sub, sentinel)))
+            setattr(pkg, sub, mod)
+        torch.save(records, str(path))
+    finally:
+        for mod, attr, prior in reversed(shadowed):
+            if prior is sentinel:
+                if hasattr(mod, attr):
+                    delattr(mod, attr)
+            else:
+                setattr(mod, attr, prior)
+        for module in created_modules:
+            sys.modules.pop(module, None)
+
+
 def read_pt_chunk(path: str | Path, dtype=np.float32) -> np.ndarray:
     """One reference activation chunk (torch-saved [n, d] tensor,
     activation_dataset.py:499-503) as a numpy array."""

@@ -342,3 +342,50 @@ def test_read_pt_chunk_flattens_sequence_dims(tmp_path):
     torch.save(torch.tensor(t), p)
     out = read_pt_chunk(p)
     assert out.shape == (2, 12)
+
+
+def test_export_sanitizes_hyperparams_and_restores_shadowed_classes(tmp_path):
+    """Export must (a) coerce jax-array hyperparams to plain scalars (the
+    reference env has no jax to unpickle them) and (b) restore any real
+    `autoencoders` classes it temporarily shadows while pickling."""
+    import types
+
+    from sparse_coding_tpu.models.learned_dict import TiedSAE as NativeTied
+    from sparse_coding_tpu.utils.ref_interop import (
+        export_reference_learned_dicts,
+    )
+
+    # simulate a process that already imported the real reference package
+    real_cls = type("TiedSAE", (), {"marker": "real"})
+    pkg = types.ModuleType("autoencoders")
+    mod = types.ModuleType("autoencoders.learned_dict")
+    mod.TiedSAE = real_cls
+    pkg.learned_dict = mod
+    sys.modules["autoencoders"] = pkg
+    sys.modules["autoencoders.learned_dict"] = mod
+    try:
+        native = NativeTied(dictionary=jnp.ones((4, 3)),
+                            encoder_bias=jnp.zeros(4))
+        export_reference_learned_dicts(
+            [(native, {"l1_alpha": jnp.float32(1e-3), "dict_size": 4})],
+            tmp_path / "exp.pt")
+        # the pre-existing class survived the export
+        assert sys.modules["autoencoders.learned_dict"].TiedSAE is real_cls
+        assert sys.modules["autoencoders"].learned_dict is mod
+    finally:
+        sys.modules.pop("autoencoders", None)
+        sys.modules.pop("autoencoders.learned_dict", None)
+
+    back = load_reference_learned_dicts(tmp_path / "exp.pt")
+    (ld, hyper), = back
+    assert isinstance(hyper["l1_alpha"], float)
+    assert hyper["l1_alpha"] == pytest.approx(1e-3)
+    assert hyper["dict_size"] == 4
+    # and the raw pickle holds no jax types at all: loadable with torch
+    # alone (what the reference env does)
+    raw = torch.load(tmp_path / "exp.pt", map_location="cpu",
+                     weights_only=False,
+                     pickle_module=__import__(
+                         "sparse_coding_tpu.utils.ref_interop",
+                         fromlist=["_RefPickleModule"])._RefPickleModule)
+    assert isinstance(raw[0][1]["l1_alpha"], float)

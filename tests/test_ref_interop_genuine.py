@@ -108,6 +108,43 @@ _WRITER = textwrap.dedent("""
 
 N_DICTS = 9
 
+_READER = textwrap.dedent("""
+    import json, sys, types
+
+    stubs = {"torchtyping": {"TensorType": type("TensorType", (), {
+                 "__class_getitem__": classmethod(lambda c, i: c)})},
+             "torchopt": {}, "optree": {}}
+    for name, attrs in stubs.items():
+        m = types.ModuleType(name)
+        for k, v in attrs.items():
+            setattr(m, k, v)
+        sys.modules[name] = m
+    sys.path.insert(0, "/root/reference")
+
+    import numpy as np
+    import torch
+
+    out_dir = sys.argv[1]
+    # resolving by qualified name exercises the real reference classes
+    pairs = torch.load(out_dir + "/exported.pt", map_location="cpu",
+                       weights_only=False)
+    with open(out_dir + "/x.json") as fh:
+        x = torch.tensor(np.asarray(json.load(fh), dtype=np.float32))
+    out = {}
+    for ld, hyper in pairs:
+        assert type(ld).__module__.startswith("autoencoders."), type(ld)
+        with torch.no_grad():
+            rec = {"encode": ld.encode(ld.center(x)).numpy().tolist()}
+            if hyper["name"] != "reverse":
+                # reference ReverseSAE.decode requires n_feats == d (its
+                # einsum mislabels the encoder axes) — encode-only there
+                rec["predict"] = ld.predict(x).numpy().tolist()
+        out[hyper["name"]] = rec
+    with open(out_dir + "/ref_out.json", "w") as fh:
+        json.dump(out, fh)
+    print("READ", len(pairs))
+""")
+
 
 @pytest.fixture(scope="module")
 def genuine_artifact(tmp_path_factory):
@@ -175,3 +212,71 @@ def test_genuine_artifact_roundtrip(genuine_artifact):
         np.testing.assert_allclose(
             got_pred, np.asarray(exp["predict"], np.float32),
             rtol=1e-4, atol=1e-5, err_msg=f"{name}: predict mismatch")
+
+
+def test_export_read_back_by_reference_code(tmp_path):
+    """Write side of the interop: native dicts exported with
+    export_reference_learned_dicts must load in the REFERENCE's environment
+    (real autoencoders classes resolved by qualified name) and reproduce
+    the native encode/predict outputs through the reference's own methods."""
+    import jax
+
+    from sparse_coding_tpu.models.learned_dict import (
+        ReverseSAE,
+        TiedSAE,
+        TopKLearnedDict,
+        UntiedSAE,
+        normalize_rows,
+    )
+    from sparse_coding_tpu.utils.ref_interop import (
+        export_reference_learned_dicts,
+    )
+
+    d, n = 12, 20
+    keys = jax.random.split(jax.random.PRNGKey(0), 8)
+    natives = [
+        (UntiedSAE(encoder=jax.random.normal(keys[0], (n, d)),
+                   encoder_bias=0.1 * jax.random.normal(keys[1], (n,)),
+                   dictionary=jax.random.normal(keys[2], (n, d))),
+         {"name": "untied"}),
+        (TiedSAE(dictionary=jax.random.normal(keys[3], (n, d)),
+                 encoder_bias=0.1 * jax.random.normal(keys[4], (n,)),
+                 centering_trans=jax.random.normal(keys[5], (d,))),
+         {"name": "tied_centered", "l1_alpha": 1e-3}),
+        (ReverseSAE(dictionary=jax.random.normal(keys[6], (n, d)),
+                    encoder_bias=jnp.full((n,), 0.05)),
+         {"name": "reverse"}),
+        (TopKLearnedDict(dictionary=normalize_rows(
+            jax.random.normal(keys[7], (n, d))), k=3),
+         {"name": "topk"}),
+    ]
+    export_reference_learned_dicts(natives, tmp_path / "exported.pt")
+    assert "autoencoders" not in sys.modules  # shim modules cleaned up
+
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(9), (6, d)),
+                   np.float32)
+    (tmp_path / "x.json").write_text(json.dumps(x.tolist()))
+    script = tmp_path / "reader.py"
+    script.write_text(_READER)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([sys.executable, str(script), str(tmp_path)], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "READ 4" in r.stdout
+
+    ref_out = json.loads((tmp_path / "ref_out.json").read_text())
+    xj = jnp.asarray(x)
+    for ld, hyper in natives:
+        name = hyper["name"]
+        np.testing.assert_allclose(
+            np.asarray(ld.encode(ld.center(xj))),
+            np.asarray(ref_out[name]["encode"], np.float32),
+            rtol=1e-4, atol=1e-5,
+            err_msg=f"{name}: reference-side encode of the exported dict "
+            "diverges from the native encode")
+        if "predict" in ref_out[name]:
+            np.testing.assert_allclose(
+                np.asarray(ld.predict(xj)),
+                np.asarray(ref_out[name]["predict"], np.float32),
+                rtol=1e-4, atol=1e-5, err_msg=f"{name}: predict diverges")
