@@ -37,8 +37,12 @@ def _timed(fn, n_iters: int, payload: float, warmup: int = 2) -> float:
 
 
 def _emit(suite: str, value: float, unit: str, **extra) -> None:
+    # backend on every record so unattended captures can tell a real TPU
+    # profile from a CPU run (scripts/on_tunnel_return.sh only assembles
+    # BENCH_SUITE_TPU.json from backend:"tpu" records)
     print(json.dumps({"suite": suite, "value": round(value, 1), "unit": unit,
-                      **extra}), flush=True)
+                      "backend": jax.default_backend(), **extra}),
+          flush=True)
 
 
 def bench_ensemble(quick: bool) -> None:

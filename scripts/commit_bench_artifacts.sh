@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Shared helper: commit the on-chip measurement artifacts (whichever exist)
+# by pathspec, retrying around a concurrent foreground session's index
+# lock. Single home for the artifact list — scripts/on_tunnel_return.sh
+# (per-stage evidence commits) and scripts/tunnel_watch.sh (final sweep)
+# both call this so the two can't drift.
+#
+#   bash scripts/commit_bench_artifacts.sh "commit message"
+set -u
+cd "$(dirname "$0")/.."
+msg="${1:?usage: commit_bench_artifacts.sh MESSAGE}"
+
+arts=""
+for f in BENCH_ONCHIP.json BENCH_VARIANTS.json TUNE.json \
+         BENCH_SUITE_TPU.json; do
+  [ -e "$f" ] && arts="$arts $f"
+done
+[ -n "$arts" ] || exit 0
+# shellcheck disable=SC2086
+if [ -z "$(git status --porcelain -- $arts)" ]; then
+  echo "bench artifacts already committed"
+  exit 0
+fi
+for _ in 1 2 3 4 5; do
+  # shellcheck disable=SC2086
+  git add -- $arts 2>/dev/null
+  # shellcheck disable=SC2086
+  if git commit -m "$msg" -- $arts >/dev/null 2>&1; then
+    echo "committed: $msg"
+    exit 0
+  fi
+  sleep 15
+done
+echo "WARNING: bench-artifact commit failed ($msg)"
+exit 1

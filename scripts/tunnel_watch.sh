@@ -33,28 +33,11 @@ EOF
 }
 
 commit_artifacts() {
-  # the watcher may race a foreground commit for the index lock; retry a few
-  # times and never fail the capture over it. Pathspec commit so nothing a
-  # concurrent foreground session staged gets swept into this commit; the
-  # add stages only artifacts that exist (BENCH_ONCHIP.json may be new or,
-  # after a cpu-fallback, absent — an unmatched pathspec would abort the
-  # whole commit).
-  arts=""
-  for f in BENCH_ONCHIP.json BENCH_VARIANTS.json TUNE.json \
-           BENCH_SUITE_TPU.json; do
-    [ -e "$f" ] && arts="$arts $f"
-  done
-  for _ in 1 2 3 4 5; do
-    # shellcheck disable=SC2086
-    git add -- $arts >>"$LOG" 2>&1
-    # shellcheck disable=SC2086
-    if git commit -m "On-chip bench recapture after tunnel recovery" \
-        -- $arts >>"$LOG" 2>&1; then
-      return 0
-    fi
-    sleep 20
-  done
-  echo "$(date -u) WARNING: artifact commit failed (see above)" >>"$LOG"
+  # on_tunnel_return.sh commits evidence per stage; this is the
+  # belt-and-braces final sweep (shared helper: single artifact list,
+  # skips cleanly when everything is already committed)
+  bash scripts/commit_bench_artifacts.sh \
+    "On-chip bench recapture after tunnel recovery" >>"$LOG" 2>&1
 }
 
 echo "$(date -u) tunnel watch started (poll every ${POLL_S}s)" >>"$LOG"

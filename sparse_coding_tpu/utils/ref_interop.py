@@ -234,21 +234,30 @@ def _stack_layer_list(layers) -> dict:
         [jax.numpy.asarray(x) for x in xs]), *converted)
 
 
+def _clean_value(v):
+    """Hyperparam leaf → plain python/numpy (recursing into containers):
+    the export side pickles these for an environment with NO jax, so no
+    jax.Array may survive at any nesting depth; the load side uses the
+    same coercion for symmetry."""
+    if isinstance(v, (bool, int, float, str, type(None))):
+        return v  # plain scalars untouched (bool/int must not round-trip
+        # via float32)
+    if isinstance(v, dict):
+        return {k: _clean_value(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        out = [_clean_value(x) for x in v]
+        return tuple(out) if isinstance(v, tuple) else out
+    try:
+        arr = _np(v)
+        return arr.item() if arr.size == 1 else arr
+    except (TypeError, ValueError):
+        return v
+
+
 def _clean_hyperparams(h: Any) -> dict:
     if not isinstance(h, dict):
-        return {"hyperparams": h}
-    out = {}
-    for k, v in h.items():
-        if isinstance(v, (bool, int, float, str, type(None))):
-            out[k] = v  # plain scalars pass through untouched (bool/int
-            # must not round-trip via float32)
-            continue
-        try:
-            arr = _np(v)
-            out[k] = arr.item() if arr.size == 1 else arr
-        except (TypeError, ValueError):
-            out[k] = v
-    return out
+        return {"hyperparams": _clean_value(h)}
+    return {k: _clean_value(v) for k, v in h.items()}
 
 
 def load_reference_learned_dicts(path: str | Path) -> list[tuple[Any, dict]]:

@@ -367,7 +367,12 @@ def test_export_sanitizes_hyperparams_and_restores_shadowed_classes(tmp_path):
         native = NativeTied(dictionary=jnp.ones((4, 3)),
                             encoder_bias=jnp.zeros(4))
         export_reference_learned_dicts(
-            [(native, {"l1_alpha": jnp.float32(1e-3), "dict_size": 4})],
+            [(native, {"l1_alpha": jnp.float32(1e-3), "dict_size": 4,
+                       # nested containers must be sanitized too — a jax
+                       # array at ANY depth makes the pickle unloadable in
+                       # a jax-less reference environment
+                       "schedule": {"lr": jnp.float32(3e-4)},
+                       "tags": [jnp.float32(2.0), "a"]})],
             tmp_path / "exp.pt")
         # the pre-existing class survived the export
         assert sys.modules["autoencoders.learned_dict"].TiedSAE is real_cls
@@ -381,6 +386,8 @@ def test_export_sanitizes_hyperparams_and_restores_shadowed_classes(tmp_path):
     assert isinstance(hyper["l1_alpha"], float)
     assert hyper["l1_alpha"] == pytest.approx(1e-3)
     assert hyper["dict_size"] == 4
+    assert isinstance(hyper["schedule"]["lr"], float)
+    assert isinstance(hyper["tags"][0], float) and hyper["tags"][1] == "a"
     # and the raw pickle holds no jax types at all: loadable with torch
     # alone (what the reference env does)
     raw = torch.load(tmp_path / "exp.pt", map_location="cpu",
