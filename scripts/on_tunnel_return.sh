@@ -11,6 +11,8 @@
 #   flock /tmp/axon_tunnel.lock bash scripts/on_tunnel_return.sh
 set -u
 cd "$(dirname "$0")/.."
+# children (bench.py) must not re-acquire the lock our invoker holds
+export AXON_LOCK_HELD=1
 
 probe() {
   timeout 90 python - <<'EOF'

@@ -1,0 +1,47 @@
+"""bench.py's tunnel-lock coordination (the repo-wide
+/tmp/axon_tunnel.lock convention): waits while a measurement holds the
+lock, acquires when free, and times out gracefully."""
+
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_acquire_when_free(tmp_path, monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "TUNNEL_LOCK", str(tmp_path / "lock"))
+    fh = bench._acquire_tunnel_lock(wait_s=5)
+    assert fh is not None
+    fh.close()
+
+
+def test_times_out_while_held_then_acquires(tmp_path, monkeypatch):
+    import bench
+
+    lock_path = tmp_path / "lock"
+    monkeypatch.setattr(bench, "TUNNEL_LOCK", str(lock_path))
+    # a subprocess holds the lock (flock is per-open-file, so holding it
+    # from this process would not block a re-acquire here)
+    holder = subprocess.Popen(
+        [sys.executable, "-c",
+         "import fcntl, sys, time\n"
+         f"fh = open({str(lock_path)!r}, 'w')\n"
+         "fcntl.flock(fh, fcntl.LOCK_EX)\n"
+         "print('HELD', flush=True)\n"
+         "time.sleep(60)\n"],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        assert holder.stdout.readline().strip() == "HELD"
+        t0 = time.monotonic()
+        assert bench._acquire_tunnel_lock(wait_s=0.5) is None
+        assert time.monotonic() - t0 < 15  # timed out, did not hang
+    finally:
+        holder.kill()
+        holder.wait()
+    fh = bench._acquire_tunnel_lock(wait_s=5)  # freed -> acquires
+    assert fh is not None
+    fh.close()
