@@ -153,12 +153,23 @@ def bench_harvest(quick: bool) -> None:
     b, s = (8, 64) if quick else (8, 256)
     toks = jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (b, s)))
-    fn = make_harvest_fn(params, cfg, ("residual.2",) if not quick
-                         else ("residual.1",), forward=gptneox.forward)
+    taps = ("residual.2",) if not quick else ("residual.1",)
+    fn = make_harvest_fn(params, cfg, taps, forward=gptneox.forward)
     rate = _timed(lambda: next(iter(fn(toks).values())), 3 if quick else 15,
                   b * s)
     _emit("harvest", rate, "tokens/s", d_model=cfg.d_model,
           n_layers=cfg.n_layers, context=s)
+
+    # scan_batches A/B: K forwards per device program amortize the
+    # ~54 ms/dispatch tunnel overhead exactly like training's scan_steps
+    k = 4 if quick else 8
+    fn_scan = make_harvest_fn(params, cfg, taps, forward=gptneox.forward,
+                              scan_batches=k)
+    stack = jnp.asarray(np.tile(np.asarray(toks)[None], (k, 1, 1)))
+    rate = _timed(lambda: next(iter(fn_scan(stack).values())),
+                  3 if quick else 15, k * b * s)
+    _emit("harvest", rate, "tokens/s", variant=f"scan{k}",
+          d_model=cfg.d_model, n_layers=cfg.n_layers, context=s)
 
 
 def bench_chunk_io(quick: bool) -> None:

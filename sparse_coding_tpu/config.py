@@ -109,6 +109,11 @@ class DataArgs(BaseArgs):
     activation_dtype: str = "bfloat16"
     max_docs: Optional[int] = None
     seed: int = 0
+    # LM forwards fused per device program during harvesting (lax.scan) —
+    # the harvesting twin of EnsembleArgs.scan_steps: at model_batch_size=4
+    # through the axon tunnel, per-dispatch overhead (~54 ms) dominates the
+    # forward itself; K=8 amortizes it 8x. Results are bit-identical to 1.
+    scan_batches: int = 1
 
 
 @dataclass

@@ -24,10 +24,18 @@ from sparse_coding_tpu.lm.model_config import LMConfig
 
 
 def make_harvest_fn(params, cfg: LMConfig, taps: Sequence[str], forward=None,
-                    mesh=None):
+                    mesh=None, scan_batches: int = 1):
     """Jitted tokens[b,s] -> {tap: [b*s, width]} harvesting step
     (the reference's run_with_cache + rearrange "b s n -> (b s) n",
     activation_dataset.py:361-368).
+
+    `scan_batches=K > 1` returns a fn taking a [K, b, s] token STACK and
+    running K forwards inside one device program (lax.scan) — the same
+    dispatch-amortization lever as training's scan_steps: through the axon
+    tunnel each dispatch costs ~54 ms (TUNE.json r4), which at the
+    reference's model_batch_size=4 dwarfs the forward itself; fusing K
+    batches also turns K small device→host activation pulls into one large
+    one (small transfers ride the tunnel ~6x slower than bulk).
 
     With a mesh, contexts run SEQUENCE-PARALLEL (lm/long_context.py): the
     sequence axis shards over the mesh's data axis with ring attention, so
@@ -39,6 +47,11 @@ def make_harvest_fn(params, cfg: LMConfig, taps: Sequence[str], forward=None,
                 "forward= and mesh= are mutually exclusive: the mesh path "
                 "always uses the sequence-parallel GPT-NeoX forward "
                 "(lm/long_context.py)")
+        if scan_batches > 1:
+            raise ValueError(
+                "scan_batches > 1 is a single-chip dispatch-amortization "
+                "lever; the mesh (sequence-parallel) path runs one large "
+                "sharded forward per dispatch instead")
         from sparse_coding_tpu.lm.long_context import sequence_parallel_forward
 
         stop = hooks.max_tap_layer(taps) + 1
@@ -61,6 +74,15 @@ def make_harvest_fn(params, cfg: LMConfig, taps: Sequence[str], forward=None,
         return {name: acts.reshape(-1, acts.shape[-1])
                 for name, acts in tapped.items()}
 
+    if scan_batches > 1:
+        def harvest_scan(token_stack):  # [K, b, s]
+            _, tapped = jax.lax.scan(
+                lambda _, toks: (None, harvest(toks)), None, token_stack)
+            # {tap: [K, b*s, w]} -> [K*b*s, w], scan order = batch order
+            return {name: a.reshape(-1, a.shape[-1])
+                    for name, a in tapped.items()}
+
+        return jax.jit(harvest_scan)
     return jax.jit(harvest)
 
 
@@ -79,6 +101,7 @@ def harvest_activations(
     dtype: str = "bfloat16",
     forward=None,
     mesh=None,
+    scan_batches: int = 1,
 ) -> dict[str, int]:
     """Run the LM over packed token rows, streaming each tap's activations to
     its own chunk folder `{output_folder}/{tap}/`. Multi-layer in one pass
@@ -86,9 +109,19 @@ def harvest_activations(
 
     Returns {tap_name: n_chunks_written}. `skip_chunks` resumes mid-dataset
     by skipping already-harvested leading chunks (reference:
-    activation_dataset.py:348,433)."""
+    activation_dataset.py:348,433). `scan_batches=K` fuses K model batches
+    into one device program (dispatch amortization through the tunnel; see
+    make_harvest_fn) — results are bit-identical to K=1, only the dispatch
+    granularity changes; the tail falls back to single-batch dispatches so
+    every full model batch is harvested either way."""
+    if scan_batches > 1 and mesh is not None:
+        raise ValueError("scan_batches > 1 is not supported on the mesh "
+                         "(sequence-parallel) harvesting path")
     taps = hooks.taps_for(layers, layer_loc)
     harvest = make_harvest_fn(params, cfg, taps, forward=forward, mesh=mesh)
+    harvest_window = (make_harvest_fn(params, cfg, taps, forward=forward,
+                                      scan_batches=scan_batches)
+                      if scan_batches > 1 else None)
     width = hooks.get_activation_size(layer_loc, cfg)
 
     seq_len = token_rows.shape[1]
@@ -104,9 +137,16 @@ def harvest_activations(
     }
 
     n_rows = token_rows.shape[0]
-    rows_done = 0
     target_rows_per_chunk = next(iter(writers.values())).rows_per_chunk
     skip_rows = skip_chunks * (target_rows_per_chunk // seq_len)
+    if n_chunks is not None:
+        # never feed rows past the chunk cap: a scan window crossing the
+        # final chunk boundary would leave buffered rows that finalize()
+        # flushes as an overshooting extra chunk (rows_per_chunk is rounded
+        # to whole model batches, so this bound is batch-aligned and the
+        # K=1 / K>1 paths consume identical rows)
+        n_rows = min(n_rows,
+                     skip_rows + n_chunks * (target_rows_per_chunk // seq_len))
 
     # device→host double buffering: batch i's activations stream back while
     # batch i+1 computes, so the host-side chunk writer never stalls the LM
@@ -122,19 +162,27 @@ def harvest_activations(
             w.chunk_index - skip_chunks >= n_chunks for w in writers.values()))
 
     done = False
-    for lo in range(skip_rows, n_rows, model_batch_size):
-        batch = jnp.asarray(token_rows[lo:lo + model_batch_size])
-        if batch.shape[0] < model_batch_size:
-            break  # keep shapes static for jit
-        tapped = harvest(batch)
+    lo = skip_rows
+    while lo < n_rows and not done:
+        n_avail = (n_rows - lo) // model_batch_size  # full batches left
+        if n_avail == 0:
+            break  # keep shapes static for jit (partial batch dropped)
+        if harvest_window is not None and n_avail >= scan_batches:
+            step_rows = model_batch_size * scan_batches
+            stack = jnp.asarray(token_rows[lo:lo + step_rows].reshape(
+                scan_batches, model_batch_size, seq_len))
+            tapped = harvest_window(stack)
+        else:
+            # the tail (< scan_batches full batches) reuses the compiled
+            # single-batch program — at most two compilations total
+            step_rows = model_batch_size
+            tapped = harvest(jnp.asarray(token_rows[lo:lo + step_rows]))
         for acts in tapped.values():
             acts.copy_to_host_async()
         pending.append(tapped)
-        rows_done += batch.shape[0]
+        lo += step_rows
         if len(pending) > 1:
-            if drain_one():
-                done = True
-                break
+            done = drain_one()
     while pending and not done:
         done = drain_one()
 
@@ -172,4 +220,5 @@ def setup_data(cfg: DataArgs, params, lm_cfg: LMConfig, texts, tokenizer,
         params, lm_cfg, rows, cfg.layers, cfg.layer_loc, cfg.dataset_folder,
         model_batch_size=cfg.model_batch_size, chunk_size_gb=cfg.chunk_size_gb,
         n_chunks=cfg.n_chunks, skip_chunks=cfg.skip_chunks,
-        center=cfg.center_dataset, dtype=cfg.activation_dtype, forward=forward)
+        center=cfg.center_dataset, dtype=cfg.activation_dtype, forward=forward,
+        scan_batches=cfg.scan_batches)

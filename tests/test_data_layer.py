@@ -247,3 +247,50 @@ def test_fast_astype_matches_numpy():
     # non-f32 targets fall through to plain astype semantics
     out16 = fast_astype(base, np.float16)
     np.testing.assert_array_equal(out16, base.astype(np.float16))
+
+
+def test_harvest_scan_batches_equivalence(tmp_path, tiny_lm):
+    """scan_batches=K (K forwards fused into one device program — the
+    dispatch-amortization lever) produces bit-identical chunk folders to
+    the per-batch path, including a tail shorter than a full window."""
+    params, cfg = tiny_lm
+    rng = np.random.default_rng(3)
+    # 7 full model batches: one 4-batch window, then 3 tail batches
+    token_rows = rng.integers(0, cfg.vocab_size, size=(28, 16))
+    kwargs = dict(layers=[1], layer_loc="residual", model_batch_size=4,
+                  chunk_size_gb=48 * 128 * 2 / 2**30, dtype="float16",
+                  forward=gptneox.forward)
+    harvest_activations(params, cfg, token_rows,
+                        output_folder=tmp_path / "plain", **kwargs)
+    harvest_activations(params, cfg, token_rows,
+                        output_folder=tmp_path / "scanned", scan_batches=4,
+                        **kwargs)
+
+    a = ChunkStore(tmp_path / "plain" / "residual.1")
+    b = ChunkStore(tmp_path / "scanned" / "residual.1")
+    assert a.n_chunks == b.n_chunks
+    for i in range(a.n_chunks):
+        np.testing.assert_array_equal(a.load_chunk(i), b.load_chunk(i))
+
+    # n_chunks cap with a scan window that would CROSS the final chunk
+    # boundary (rows_per_chunk = 3 model batches, window = 4): the cap must
+    # hold exactly — no overshooting extra chunk from buffered rows
+    capped = dict(kwargs, chunk_size_gb=3 * 4 * 16 * 32 * 2 / 2**30)
+    for folder, k in (("cap1", 1), ("cap4", 4)):
+        out = harvest_activations(params, cfg, token_rows, n_chunks=1,
+                                  output_folder=tmp_path / folder,
+                                  scan_batches=k, **capped)
+        assert out == {"residual.1": 1}, (folder, out)
+    c1 = ChunkStore(tmp_path / "cap1" / "residual.1")
+    c4 = ChunkStore(tmp_path / "cap4" / "residual.1")
+    assert c1.n_chunks == c4.n_chunks == 1
+    np.testing.assert_array_equal(c1.load_chunk(0), c4.load_chunk(0))
+
+    # mesh + scan_batches is an explicit error, not a silent degrade
+    from sparse_coding_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="scan_batches"):
+        harvest_activations(params, cfg, token_rows, layers=[1],
+                            layer_loc="residual",
+                            output_folder=tmp_path / "m",
+                            mesh=make_mesh(1, 2), scan_batches=4)
