@@ -81,10 +81,12 @@ def main(argv=None) -> None:
     acts_dir = out_root / "activations"
     tap = f"residual.{layer}"
     if not (acts_dir / tap / "meta.json").exists():
+        # scan_batches=8 fuses 8 forwards per device program (tunnel
+        # dispatch amortization; bit-identical results to 1)
         harvest_activations(params, lm_cfg, token_rows, layers=[layer],
                             layer_loc="residual", output_folder=acts_dir,
                             model_batch_size=4, chunk_size_gb=chunk_gb,
-                            forward=forward)
+                            forward=forward, scan_batches=8)
     store = ChunkStore(acts_dir / tap)
     print(f"harvested {store.n_chunks} chunk(s) at {tap}", file=sys.stderr)
 

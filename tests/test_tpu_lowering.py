@@ -139,3 +139,9 @@ def test_harvest_fn_lowers(rng):
     fn = make_harvest_fn(params, cfg, ("residual.1", "mlp.1"),
                          forward=gptneox.forward)
     fn.trace(jnp.zeros((4, 16), jnp.int32)).lower(lowering_platforms=("tpu",))
+    # the scan_batches>1 window program (the variant the frontier example
+    # dispatches on TPU): lax.scan over K fused forwards
+    fn_scan = make_harvest_fn(params, cfg, ("residual.1", "mlp.1"),
+                              forward=gptneox.forward, scan_batches=8)
+    fn_scan.trace(jnp.zeros((8, 4, 16), jnp.int32)).lower(
+        lowering_platforms=("tpu",))
