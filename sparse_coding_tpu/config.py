@@ -220,6 +220,11 @@ class InterpArgs(BaseArgs):
     explainer_model: str = "gpt-4"
     simulator_model: str = "text-davinci-003"
     seed: int = 0
+    # fragment batches fused per device program during activation recording
+    # (lax.scan; see DataArgs.scan_batches — the same tunnel dispatch-
+    # amortization lever, applied to the reference's ~2500-dispatch
+    # fragment pass)
+    scan_batches: int = 1
 
 
 @dataclass

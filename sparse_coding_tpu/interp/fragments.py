@@ -88,15 +88,14 @@ class TokenActivationLookup:
         return self._codes_for(int(fragment_idx))[:, feature]
 
 
-def build_fragment_activations(
-    params, lm_cfg: LMConfig, model: LearnedDict, fragments: np.ndarray,
-    layer: int, layer_loc: str = "residual", batch_size: int = 64,
-    forward=None,
-) -> tuple[FragmentActivations, TokenActivationLookup]:
-    """Run the LM over ALL fragments (tail batch included), keeping only the
-    per-fragment maxes on device; returns the maxes plus a lazy lookup."""
-    if fragments.shape[0] == 0:
-        raise ValueError("no fragments to process")
+def make_fragment_encode_fns(params, lm_cfg: LMConfig, model: LearnedDict,
+                             layer: int, layer_loc: str = "residual",
+                             forward=None):
+    """The two jitted fragment programs: `encode_batch` (tokens[b,s] →
+    per-token codes [b,s,n]) and `window_maxes` (a [K,b,s] token stack →
+    per-fragment maxes [K*b,n], K forwards fused into one device program
+    with the max reduced in-scan). Factored out so the TPU AOT-lowering
+    gate traces exactly what build_fragment_activations dispatches."""
     if forward is None:
         from sparse_coding_tpu.lm.convert import forward_fn
         forward = forward_fn(lm_cfg)
@@ -110,10 +109,51 @@ def build_fragment_activations(
         b, s, d = acts.shape
         return model.encode(model.center(acts.reshape(b * s, d))).reshape(b, s, -1)
 
+    @jax.jit
+    def window_maxes(tok_stack):  # [K, b, s] -> [K*b, n_feats]
+        _, m = jax.lax.scan(
+            lambda _, toks: (None, jnp.max(encode_batch(toks), axis=1)),
+            None, tok_stack)
+        return m.reshape(-1, m.shape[-1])
+
+    return encode_batch, window_maxes
+
+
+def build_fragment_activations(
+    params, lm_cfg: LMConfig, model: LearnedDict, fragments: np.ndarray,
+    layer: int, layer_loc: str = "residual", batch_size: int = 64,
+    forward=None, scan_batches: int = 1,
+) -> tuple[FragmentActivations, TokenActivationLookup]:
+    """Run the LM over ALL fragments (tail batch included), keeping only the
+    per-fragment maxes on device; returns the maxes plus a lazy lookup.
+
+    `scan_batches=K` fuses K fragment batches into one device program with
+    the per-fragment max reduced INSIDE the scan (the reference's 50k
+    fragments at batch 20 are ~2500 separate dispatches, interpret.py:169;
+    through the axon tunnel each costs ~54 ms — data/harvest.py has the
+    same lever; InterpArgs.scan_batches plumbs it). Results are identical
+    to K=1; the sub-window tail runs on the single-batch program (its own
+    compilations: one for a full batch, one more if a partial final batch
+    exists)."""
+    if fragments.shape[0] == 0:
+        raise ValueError("no fragments to process")
+    encode_batch, window_maxes = make_fragment_encode_fns(
+        params, lm_cfg, model, layer, layer_loc, forward)
+
     maxes = []
-    for lo in range(0, fragments.shape[0], batch_size):
-        c = encode_batch(jnp.asarray(fragments[lo:lo + batch_size]))
-        maxes.append(jnp.max(c, axis=1))
+    n = fragments.shape[0]
+    window_rows = batch_size * max(1, scan_batches)
+    lo = 0
+    while lo < n:
+        if scan_batches > 1 and n - lo >= window_rows:
+            stack = jnp.asarray(fragments[lo:lo + window_rows].reshape(
+                scan_batches, batch_size, -1))
+            maxes.append(window_maxes(stack))
+            lo += window_rows
+        else:
+            c = encode_batch(jnp.asarray(fragments[lo:lo + batch_size]))
+            maxes.append(jnp.max(c, axis=1))
+            lo += batch_size
     max_per_fragment = jnp.concatenate(maxes, axis=0)
     fragments_dev = jnp.asarray(fragments)
     fa = FragmentActivations(fragments=fragments_dev,

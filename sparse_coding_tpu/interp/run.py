@@ -94,7 +94,8 @@ def run(learned_dict, cfg: InterpArgs, params, lm_cfg, token_rows: np.ndarray,
                                  seed=cfg.seed)
     fa, lookup = build_fragment_activations(
         params, lm_cfg, learned_dict, fragments, cfg.layer, cfg.layer_loc,
-        batch_size=cfg.batch_size, forward=forward)
+        batch_size=cfg.batch_size, forward=forward,
+        scan_batches=cfg.scan_batches)
 
     if feature_indices is None:
         # features with the highest activation mass, as a sensible default

@@ -615,3 +615,29 @@ def test_logprob_ev_digit_continuation():
     evs = expected_values_from_logprobs(
         ["cat\t1", "\n", "dog\t2"], [{"1": 0.0}, {}], 2)
     assert evs == [1.0, 2.0]
+
+
+def test_fragment_maxes_scan_window_equivalence(tiny_lm):
+    """build_fragment_activations with scan_batches=K (fused-dispatch
+    windows, max reduced in-scan) returns identical per-fragment maxes to
+    the per-batch path, including a tail shorter than a full window."""
+    from sparse_coding_tpu.interp.fragments import build_fragment_activations
+
+    params, lm_cfg = tiny_lm
+    # 10 fragments, batch 2: one 4-batch window (8) + tail (2)
+    fragments = np.random.default_rng(7).integers(
+        0, lm_cfg.vocab_size, size=(10, 12))
+    ld = TiedSAE(dictionary=jax.random.normal(jax.random.PRNGKey(8),
+                                              (16, lm_cfg.d_model)),
+                 encoder_bias=jnp.zeros(16))
+    fa1, _ = build_fragment_activations(params, lm_cfg, ld, fragments,
+                                        layer=1, batch_size=2,
+                                        scan_batches=1,
+                                        forward=gptneox.forward)
+    fa4, _ = build_fragment_activations(params, lm_cfg, ld, fragments,
+                                        layer=1, batch_size=2,
+                                        scan_batches=4,
+                                        forward=gptneox.forward)
+    np.testing.assert_allclose(np.asarray(fa1.max_per_fragment),
+                               np.asarray(fa4.max_per_fragment),
+                               rtol=1e-6, atol=1e-7)

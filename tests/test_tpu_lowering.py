@@ -145,3 +145,23 @@ def test_harvest_fn_lowers(rng):
                               forward=gptneox.forward, scan_batches=8)
     fn_scan.trace(jnp.zeros((8, 4, 16), jnp.int32)).lower(
         lowering_platforms=("tpu",))
+
+
+def test_fragment_window_program_lowers(rng):
+    """The interp fragment window program (lax.scan over forward+encode
+    with in-scan max — what InterpArgs.scan_batches>1 dispatches on TPU)."""
+    from sparse_coding_tpu.interp.fragments import make_fragment_encode_fns
+    from sparse_coding_tpu.lm import gptneox
+    from sparse_coding_tpu.lm.model_config import tiny_test_config
+    from sparse_coding_tpu.models import TiedSAE
+
+    cfg = tiny_test_config("gptneox")
+    params = gptneox.init_params(rng, cfg)
+    ld = TiedSAE(dictionary=jnp.ones((16, cfg.d_model)),
+                 encoder_bias=jnp.zeros(16))
+    encode_batch, window_maxes = make_fragment_encode_fns(
+        params, cfg, ld, layer=1, forward=gptneox.forward)
+    encode_batch.trace(jnp.zeros((4, 12), jnp.int32)).lower(
+        lowering_platforms=("tpu",))
+    window_maxes.trace(jnp.zeros((8, 4, 12), jnp.int32)).lower(
+        lowering_platforms=("tpu",))
