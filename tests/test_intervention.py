@@ -124,3 +124,25 @@ def test_ablation_graph_transfers_scale_with_features_not_edges(
     # n_ablate source rows × 255 targets each, but only n_ablate pulls
     assert len(graph) == n_ablate * 255
     assert calls["n"] <= n_ablate + 2  # +slack for the base cache
+
+
+def test_calculate_perplexity_scan_matches_per_batch(tiny_lm):
+    """The scanned whole-eval program (one dispatch for all full batches)
+    must reproduce the per-batch mean EXACTLY, including drop_last=False
+    tail weighting with a non-divisible row count."""
+    params, cfg = tiny_lm
+    token_rows = _tokens(cfg, n=10, seed=3)  # 2 full batches of 4 + tail 2
+    ld = Identity.create(cfg.d_model)
+
+    orig, _ = calculate_perplexity(params, cfg, [(ld, {})], layer=1,
+                                   setting="residual", token_rows=token_rows,
+                                   model_batch_size=4,
+                                   forward=gptneox.forward)
+    # reference computation: independent per-batch means (the semantics the
+    # scan must preserve)
+    base_fn = jax.jit(lambda t: lm_loss(
+        gptneox.forward(params, t, cfg)[0], t))
+    losses = [float(base_fn(jnp.asarray(token_rows[i:i + 4])))
+              for i in range(0, 10, 4)]
+    np.testing.assert_allclose(orig, float(np.exp(np.mean(losses))),
+                               rtol=1e-6)

@@ -165,3 +165,27 @@ def test_fragment_window_program_lowers(rng):
         lowering_platforms=("tpu",))
     window_maxes.trace(jnp.zeros((8, 4, 12), jnp.int32)).lower(
         lowering_platforms=("tpu",))
+
+
+def test_perplexity_scan_program_lowers(rng):
+    """The scanned perplexity program (lax.scan over the edit-intervened
+    forward — what calculate_perplexity dispatches for all full batches)."""
+    from sparse_coding_tpu.lm import gptneox
+    from sparse_coding_tpu.lm.model_config import tiny_test_config
+    from sparse_coding_tpu.metrics.intervention import (
+        make_perplexity_loss_fns,
+        reconstruction_edit,
+    )
+    from sparse_coding_tpu.models import TiedSAE
+
+    cfg = tiny_test_config("gptneox")
+    params = gptneox.init_params(rng, cfg)
+    ld = TiedSAE(dictionary=jnp.ones((16, cfg.d_model)),
+                 encoder_bias=jnp.zeros(16))
+    for edit in (None, ("residual.1", reconstruction_edit(ld))):
+        core, scanned = make_perplexity_loss_fns(params, cfg, edit,
+                                                 gptneox.forward)
+        core.trace(jnp.zeros((4, 12), jnp.int32)).lower(
+            lowering_platforms=("tpu",))
+        scanned.trace(jnp.zeros((6, 4, 12), jnp.int32)).lower(
+            lowering_platforms=("tpu",))
