@@ -48,3 +48,18 @@ def devices8():
     if len(devs) < 8:
         pytest.skip("needs 8 virtual devices")
     return devs[:8]
+
+
+def stripped_cpu_subprocess_env(repo_on_pythonpath: bool = True) -> dict:
+    """Env for CPU jax SUBPROCESSES spawned by tests: axon plugin stripped
+    (the child must never touch the tunnel), JAX on CPU, repo root on
+    PYTHONPATH (safe BECAUSE the plugin is stripped — see the verify
+    skill's PYTHONPATH gotcha). Single home for the strip recipe;
+    test_multihost.py and the hermetic-example smokes share it."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if repo_on_pythonpath:
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    return env

@@ -2,6 +2,8 @@
 (SURVEY.md §4/§7: verify activation equivalence against reference hooks
 without network access — transformers builds models from config offline)."""
 
+import sys
+
 import jax
 from pathlib import Path
 import jax.numpy as jnp
@@ -281,3 +283,26 @@ def test_eval_reference_artifacts_selftest(capsys):
         assert 0.0 <= rec["fvu"] <= 2.0
         assert rec["n_ever_active"] <= rec["n_feats"]
     assert recs[0]["l1_alpha"] == 3e-4
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("example,expect", [
+    ("interpret_offline.py", "activates on tokens"),
+    ("erasure_gender.py", "LEACE"),
+    ("feature_case_study.py", "nearest atoms"),
+    ("quickstart_synthetic.py", "l1_alpha"),
+])
+def test_hermetic_examples_run(tmp_path, example, expect):
+    """Every shipped example runs end-to-end with no network/TPU (tiny
+    random-weight models / synthetic data), in a scratch cwd, as a real
+    subprocess — the user's first-contact surfaces must never rot."""
+    import subprocess
+
+    from conftest import stripped_cpu_subprocess_env
+
+    env = stripped_cpu_subprocess_env()
+    script = Path(__file__).resolve().parent.parent / "examples" / example
+    r = subprocess.run([sys.executable, str(script)], cwd=tmp_path, env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert expect in r.stdout, r.stdout[-2000:]

@@ -73,13 +73,9 @@ def _free_port() -> int:
 
 
 def _stripped_env() -> dict:
-    """Subprocess env for plugin-stripped CPU jax workers (single home for
-    the axon-strip recipe; PYTHONPATH is safe here BECAUSE the plugin is
-    stripped — see the verify skill's PYTHONPATH gotcha)."""
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["PYTHONPATH"] = str(Path(__file__).resolve().parent.parent)
-    return env
+    from conftest import stripped_cpu_subprocess_env
+
+    return stripped_cpu_subprocess_env()
 
 
 def _run_world(tmp_path, n_procs: int, local_dev: int,
