@@ -99,6 +99,30 @@ def perplexity_under_reconstruction(params, lm_cfg: LMConfig,
     return lm_loss(logits, tokens)
 
 
+def ablate_feature_set_edit(model: LearnedDict, feature_mask) -> Callable[[Array], Array]:
+    """Subtract a SET of features' contributions from the tapped activation
+    (feature_mask: [n_feats], 1 = ablate). The mask may be traced, so a
+    jitted lax.map over many masks (e.g. cumulative top-m ablation curves)
+    compiles once. Generalizes ablate_feature_edit (reference:
+    ablate_feature_intervention, standard_metrics.py:69-84) from one
+    feature to a subset."""
+
+    def edit(tensor: Array) -> Array:
+        b, s, d = tensor.shape
+        flat = tensor.reshape(b * s, d)
+        codes = model.encode(flat)
+        # [b*s, n] masked codes against [n, d] dictionary: the summed
+        # contribution of every ablated feature in one matmul. Mask cast to
+        # the codes dtype so an f32 mask cannot silently upcast a bf16
+        # residual stream (which would recompile and diverge downstream)
+        mask = jnp.asarray(feature_mask).astype(codes.dtype)
+        contribution = ((codes * mask) @
+                        model.get_learned_dict()).reshape(b, s, d)
+        return tensor - contribution.astype(tensor.dtype)
+
+    return edit
+
+
 def make_perplexity_loss_fns(params, lm_cfg: LMConfig, edit, forward):
     """The two jitted perplexity programs: `core` (tokens[b,s] → mean LM
     loss, optionally edit-intervened) and `scanned` (a [K,b,s] batch stack

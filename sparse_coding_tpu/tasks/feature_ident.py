@@ -19,7 +19,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from sparse_coding_tpu.lm.hooks import tap_name
-from sparse_coding_tpu.metrics.intervention import ablate_feature_edit
+from sparse_coding_tpu.metrics.intervention import (
+    ablate_feature_edit,
+    ablate_feature_set_edit,
+)
 from sparse_coding_tpu.models.learned_dict import LearnedDict
 
 Array = jax.Array
@@ -89,11 +92,68 @@ def identify_task_features(
     return {"base_metric": base, "effects": effects, "ranking": ranking}
 
 
+def cumulative_ablation_curve(
+    params, lm_cfg, model: LearnedDict, layer: int, tokens: np.ndarray,
+    lengths: np.ndarray, target_ids: np.ndarray, distractor_ids: np.ndarray,
+    ranking: Sequence[int], layer_loc: str = "residual", forward=None,
+    base_metric: Optional[float] = None,
+) -> dict:
+    """Task-erasure curve: jointly ablate the top-m ranked features for
+    m = 1..len(ranking) and measure the task metric at each prefix — does
+    removing the identified circuit actually destroy the behavior, and how
+    concentrated is it? (The task-probe analogue of the concept-erasure
+    curve, metrics/erasure.py::feature_erasure_curve; composes
+    identify_task_features' ranking with the set-ablation edit.) One
+    compiled program: lax.map over the M cumulative masks.
+
+    Returns {"base_metric", "metrics" [M] (metric with top-m ablated),
+    "drops" [M] (base − metric)}. Pass `base_metric` when the caller
+    already computed it (identify_task_features does) to skip the
+    un-edited forward."""
+    if forward is None:
+        from sparse_coding_tpu.lm.convert import forward_fn
+        forward = forward_fn(lm_cfg)
+    tap = tap_name(layer, layer_loc)
+    tokens = jnp.asarray(tokens)
+    lengths = jnp.asarray(lengths)
+    target_ids = jnp.asarray(target_ids)
+    distractor_ids = jnp.asarray(distractor_ids)
+    ranking = np.asarray(list(ranking), np.int32)
+    n_feats = int(model.n_feats)
+    # cumulative one-hot prefixes: masks[m] ablates ranking[:m+1]
+    masks = np.zeros((len(ranking), n_feats), np.float32)
+    for m, feat in enumerate(ranking):
+        masks[m:, feat] = 1.0
+
+    @jax.jit
+    def curve(mask_stack):
+        def one(mask):
+            logits, _ = forward(params, tokens, lm_cfg,
+                                edit=(tap, ablate_feature_set_edit(model,
+                                                                  mask)))
+            return logit_diff_metric(logits, lengths, target_ids,
+                                     distractor_ids)
+
+        return jax.lax.map(one, mask_stack)
+
+    if base_metric is None:
+        @jax.jit
+        def base_fn():
+            logits, _ = forward(params, tokens, lm_cfg)
+            return logit_diff_metric(logits, lengths, target_ids,
+                                     distractor_ids)
+
+        base_metric = float(base_fn())
+    metrics = np.asarray(curve(jnp.asarray(masks)))
+    return {"base_metric": base_metric, "metrics": metrics,
+            "drops": base_metric - metrics}
+
+
 def run_ioi_feature_ident(params, lm_cfg, model: LearnedDict, layer: int,
                           tokenizer, n_prompts: int = 32,
                           layer_loc: str = "residual", forward=None,
                           family: str = "mixed", seed: int = 0,
-                          **kwargs) -> dict:
+                          curve: bool = False, **kwargs) -> dict:
     """End-to-end IOI feature identification (the missing
     ioi_feature_ident.py workflow): build the counterfactual IOI dataset
     (`family` selects any ioi_counterfact.TEMPLATE_FAMILIES bank; "mixed"
@@ -106,6 +166,15 @@ def run_ioi_feature_ident(params, lm_cfg, model: LearnedDict, layer: int,
     tokens, _, lengths, target_ids, distractor_ids = (
         gen_ioi_dataset_with_distractors(tokenizer, n_prompts,
                                          family=family, seed=seed))
-    return identify_task_features(
+    result = identify_task_features(
         params, lm_cfg, model, layer, tokens, lengths, target_ids,
         distractor_ids, layer_loc=layer_loc, forward=forward, **kwargs)
+    if curve:
+        # opt-in task-erasure curve over the identified ranking: how much
+        # of the behavior the top-m features jointly carry (costs top_m
+        # extra intervened forwards)
+        result["ablation_curve"] = cumulative_ablation_curve(
+            params, lm_cfg, model, layer, tokens, lengths, target_ids,
+            distractor_ids, result["ranking"], layer_loc=layer_loc,
+            forward=forward, base_metric=result["base_metric"])
+    return result

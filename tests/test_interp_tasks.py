@@ -641,3 +641,86 @@ def test_fragment_maxes_scan_window_equivalence(tiny_lm):
     np.testing.assert_allclose(np.asarray(fa1.max_per_fragment),
                                np.asarray(fa4.max_per_fragment),
                                rtol=1e-6, atol=1e-7)
+
+
+def test_ablate_feature_set_edit_matches_single(tiny_lm):
+    """A one-hot feature_mask must reproduce ablate_feature_edit exactly;
+    a two-feature mask equals composing the two single ablations when the
+    features' contributions are independent (linear decode)."""
+    from sparse_coding_tpu.metrics.intervention import (
+        ablate_feature_edit,
+        ablate_feature_set_edit,
+    )
+
+    _, lm_cfg = tiny_lm
+    d = lm_cfg.d_model
+    ld = TiedSAE(dictionary=jax.random.normal(jax.random.PRNGKey(11),
+                                              (12, d)),
+                 encoder_bias=jnp.zeros(12))
+    x = jax.random.normal(jax.random.PRNGKey(12), (2, 6, d))
+
+    one_hot = jnp.zeros(12).at[3].set(1.0)
+    np.testing.assert_allclose(
+        np.asarray(ablate_feature_set_edit(ld, one_hot)(x)),
+        np.asarray(ablate_feature_edit(ld, 3)(x)), rtol=1e-5, atol=1e-6)
+
+    pair = jnp.zeros(12).at[3].set(1.0).at[7].set(1.0)
+    # decode is linear in the codes, so the joint subtraction equals the
+    # sum of individual contributions
+    single3 = x - ablate_feature_edit(ld, 3)(x)
+    single7 = x - ablate_feature_edit(ld, 7)(x)
+    np.testing.assert_allclose(
+        np.asarray(ablate_feature_set_edit(ld, pair)(x)),
+        np.asarray(x - single3 - single7), rtol=1e-5, atol=1e-6)
+
+
+def test_cumulative_ablation_curve_consistency(tiny_lm):
+    """The curve's internal consistency gates: one entry per ranked
+    feature, drops[0] equals the single-feature effect of the top-ranked
+    feature, and the final entry equals a direct joint ablation of the
+    WHOLE ranked set (catching a disjoint-one-hot-masks regression, which
+    would break the cumulative-prefix semantics)."""
+    from sparse_coding_tpu.metrics.intervention import ablate_feature_set_edit
+    from sparse_coding_tpu.tasks.feature_ident import (
+        cumulative_ablation_curve,
+        identify_task_features,
+        logit_diff_metric,
+    )
+    from sparse_coding_tpu.lm.hooks import tap_name
+
+    params, lm_cfg = tiny_lm
+    rng_np = np.random.default_rng(0)
+    n = 8
+    tokens = rng_np.integers(0, lm_cfg.vocab_size, (n, 10))
+    lengths = np.full(n, 10, np.int32)
+    target_ids = rng_np.integers(0, lm_cfg.vocab_size, n)
+    distractor_ids = rng_np.integers(0, lm_cfg.vocab_size, n)
+    dictionary = jax.random.normal(jax.random.PRNGKey(1),
+                                   (12, lm_cfg.d_model))
+    sae = TiedSAE(dictionary=dictionary, encoder_bias=jnp.zeros(12))
+
+    ident = identify_task_features(
+        params, lm_cfg, sae, layer=2, tokens=tokens, lengths=lengths,
+        target_ids=target_ids, distractor_ids=distractor_ids,
+        forward=gptneox.forward, top_m=4)
+    curve = cumulative_ablation_curve(
+        params, lm_cfg, sae, layer=2, tokens=tokens, lengths=lengths,
+        target_ids=target_ids, distractor_ids=distractor_ids,
+        ranking=ident["ranking"], forward=gptneox.forward)
+    assert curve["base_metric"] == pytest.approx(ident["base_metric"])
+    assert curve["metrics"].shape == (4,)
+    assert np.all(np.isfinite(curve["metrics"]))
+    # ablating the top-1 feature reproduces its single-feature effect
+    assert curve["drops"][0] == pytest.approx(
+        ident["effects"][ident["ranking"][0]], abs=1e-5)
+    # the last curve point equals ablating the WHOLE ranked set at once
+    full_mask = jnp.zeros(12).at[jnp.asarray(ident["ranking"])].set(1.0)
+    logits, _ = gptneox.forward(
+        params, jnp.asarray(tokens), lm_cfg,
+        edit=(tap_name(2, "residual"), ablate_feature_set_edit(sae,
+                                                               full_mask)))
+    joint = float(logit_diff_metric(jnp.asarray(logits),
+                                    jnp.asarray(lengths),
+                                    jnp.asarray(target_ids),
+                                    jnp.asarray(distractor_ids)))
+    assert curve["metrics"][-1] == pytest.approx(joint, abs=1e-5)
