@@ -38,3 +38,40 @@ def plot_erasure_tradeoff(curve: Sequence[dict], leace: Optional[dict] = None,
         Path(save_path).parent.mkdir(parents=True, exist_ok=True)
         fig.savefig(save_path, dpi=150)
     plt.close(fig)
+
+
+def plot_task_ablation_curve(curve: dict, ranking=None,
+                             save_path: Optional[str | Path] = None,
+                             title: str = "task metric vs features ablated",
+                             ylabel: str = "task metric (IOI logit diff)"):
+    """Task-erasure figure over a
+    tasks/feature_ident.py::cumulative_ablation_curve result: the task
+    metric as the top-m ranked features are jointly ablated, with the
+    unablated base as a reference line. Completes the task-probe analogue
+    of the concept-erasure tradeoff family above."""
+    from sparse_coding_tpu.plotting.helpers import get_pyplot, save_figure
+
+    fig, ax = get_pyplot().subplots(figsize=(7, 4.5))
+    m = len(curve["metrics"])
+    xs = range(1, m + 1)
+    ax.plot(xs, curve["metrics"], marker="o", label="top-m ablated")
+    ax.axhline(curve["base_metric"], color="gray", ls="--",
+               label="base (no ablation)")
+    if ranking is not None:
+        for x, feat in zip(xs, ranking):
+            ax.annotate(str(int(feat)), (x, float(curve["metrics"][x - 1])),
+                        fontsize=7, xytext=(3, 3),
+                        textcoords="offset points")
+    ax.set_xlabel("features ablated (ranked by causal effect)")
+    ax.set_ylabel(ylabel)
+    if m <= 30:  # per-point ticks unreadable beyond that
+        ax.set_xticks(list(xs))
+    ax.set_title(title)
+    ax.legend()
+    fig.tight_layout()
+    # always closed (like every sibling plotter here): no pyplot-registry
+    # leak across sweep loops, and no ambiguous returned-but-closed figure
+    if save_path is not None:
+        save_figure(fig, save_path)
+    else:
+        get_pyplot().close(fig)

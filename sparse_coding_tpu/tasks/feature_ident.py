@@ -40,6 +40,19 @@ def logit_diff_metric(logits: Array, lengths: Array, target_ids: Array,
     return jnp.mean(pred[idx, target_ids] - pred[idx, distractor_ids])
 
 
+def _make_base_metric_fn(params, lm_cfg, forward, tokens, lengths,
+                         target_ids, distractor_ids):
+    """Jitted un-edited task metric — single home for the base-metric
+    program shared by identify_task_features and
+    cumulative_ablation_curve (their drops/effects must agree exactly)."""
+    @jax.jit
+    def base_fn():
+        logits, _ = forward(params, tokens, lm_cfg)
+        return logit_diff_metric(logits, lengths, target_ids, distractor_ids)
+
+    return base_fn
+
+
 def identify_task_features(
     params, lm_cfg, model: LearnedDict, layer: int, tokens: np.ndarray,
     lengths: np.ndarray, target_ids: np.ndarray, distractor_ids: np.ndarray,
@@ -60,11 +73,8 @@ def identify_task_features(
     lengths = jnp.asarray(lengths)
     target_ids = jnp.asarray(target_ids)
     distractor_ids = jnp.asarray(distractor_ids)
-
-    @jax.jit
-    def base_fn():
-        logits, _ = forward(params, tokens, lm_cfg)
-        return logit_diff_metric(logits, lengths, target_ids, distractor_ids)
+    base_fn = _make_base_metric_fn(params, lm_cfg, forward, tokens, lengths,
+                                   target_ids, distractor_ids)
 
     @jax.jit
     def effects_fn(feat_array):
@@ -137,13 +147,9 @@ def cumulative_ablation_curve(
         return jax.lax.map(one, mask_stack)
 
     if base_metric is None:
-        @jax.jit
-        def base_fn():
-            logits, _ = forward(params, tokens, lm_cfg)
-            return logit_diff_metric(logits, lengths, target_ids,
-                                     distractor_ids)
-
-        base_metric = float(base_fn())
+        base_metric = float(_make_base_metric_fn(
+            params, lm_cfg, forward, tokens, lengths, target_ids,
+            distractor_ids)())
     metrics = np.asarray(curve(jnp.asarray(masks)))
     return {"base_metric": base_metric, "metrics": metrics,
             "drops": base_metric - metrics}

@@ -161,3 +161,18 @@ def test_plot_n_active_over_time(tmp_path, rng):
     for s in series.values():
         assert s["snapshots"] == [0, 1, 2]
         assert s["n_active"][0] >= s["n_active"][-1]
+
+
+def test_plot_task_ablation_curve(tmp_path):
+    from sparse_coding_tpu.plotting.erasure import plot_task_ablation_curve
+
+    curve = {"base_metric": 1.5,
+             "metrics": np.asarray([1.1, 0.6, 0.55]),
+             "drops": np.asarray([0.4, 0.9, 0.95])}
+    plot_task_ablation_curve(curve, ranking=[7, 3, 1],
+                             save_path=tmp_path / "curve.png")
+    assert (tmp_path / "curve.png").exists()
+    plot_task_ablation_curve(curve)  # no-save path must not leak a figure
+    import matplotlib.pyplot as plt
+
+    assert not plt.get_fignums()
