@@ -45,3 +45,35 @@ def test_times_out_while_held_then_acquires(tmp_path, monkeypatch):
     fh = bench._acquire_tunnel_lock(wait_s=5)  # freed -> acquires
     assert fh is not None
     fh.close()
+
+
+def test_ancestor_lock_detection(tmp_path, monkeypatch):
+    """When an ancestor of the process holds the flock (the
+    `flock <lock> python bench.py` wrap), bench must detect it and skip
+    acquisition instead of self-waiting; an UNRELATED holder is not an
+    ancestor."""
+    import bench
+
+    lock_path = tmp_path / "lock"
+    lock_path.touch()
+    monkeypatch.setattr(bench, "TUNNEL_LOCK", str(lock_path))
+
+    # child under `flock`: the flock utility (our child's ancestor) holds it
+    r = subprocess.run(
+        ["flock", str(lock_path), sys.executable, "-c",
+         "import sys; sys.path.insert(0, '/root/repo')\n"
+         "import bench\n"
+         f"bench.TUNNEL_LOCK = {str(lock_path)!r}\n"
+         "print('ANCESTOR', bench._lock_held_by_ancestor())"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "ANCESTOR True" in r.stdout
+
+    # unrelated (sibling) holder: not an ancestor
+    holder = subprocess.Popen(["flock", str(lock_path), "sleep", "30"])
+    try:
+        time.sleep(0.5)
+        assert bench._lock_held_by_ancestor() is False
+    finally:
+        holder.kill()
+        holder.wait()
