@@ -297,7 +297,8 @@ def _lock_held_by_ancestor(lock_path: str | None = None) -> bool:
     if lock_path is None:
         lock_path = TUNNEL_LOCK  # resolved at CALL time (tests patch it)
     try:
-        ino = os.stat(lock_path).st_ino
+        st = os.stat(lock_path)
+        want = (os.major(st.st_dev), os.minor(st.st_dev), st.st_ino)
         with open("/proc/locks") as fh:
             holders = set()
             for line in fh:
@@ -306,10 +307,13 @@ def _lock_held_by_ancestor(lock_path: str | None = None) -> bool:
                 if "FLOCK" in parts:
                     try:
                         pid = int(parts[-4])
-                        inode = int(parts[-3].rsplit(":", 1)[1])
+                        maj_s, min_s, ino_s = parts[-3].split(":")
+                        # full (device, inode) identity: an equal inode on
+                        # a DIFFERENT filesystem must not match
+                        key = (int(maj_s, 16), int(min_s, 16), int(ino_s))
                     except (ValueError, IndexError):
                         continue
-                    if inode == ino:
+                    if key == want:
                         holders.add(pid)
         if not holders:
             return False

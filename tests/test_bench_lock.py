@@ -59,9 +59,10 @@ def test_ancestor_lock_detection(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "TUNNEL_LOCK", str(lock_path))
 
     # child under `flock`: the flock utility (our child's ancestor) holds it
+    repo_root = str(Path(__file__).resolve().parent.parent)
     r = subprocess.run(
         ["flock", str(lock_path), sys.executable, "-c",
-         "import sys; sys.path.insert(0, '/root/repo')\n"
+         f"import sys; sys.path.insert(0, {repo_root!r})\n"
          "import bench\n"
          f"bench.TUNNEL_LOCK = {str(lock_path)!r}\n"
          "print('ANCESTOR', bench._lock_held_by_ancestor())"],
