@@ -281,6 +281,31 @@ def _load_tuned_variant(path: str | None = None) -> dict | None:
 TUNNEL_LOCK = "/tmp/axon_tunnel.lock"
 
 
+def _parse_flock_holders(lines, want: tuple) -> set:
+    """PIDs HOLDING the flock on the (major, minor, inode) identity `want`,
+    from /proc/locks content. Blocked waiters are listed too, as
+    "<id>: -> FLOCK ..." continuation lines — a waiter is NOT a holder
+    (treating it as one made bench skip acquisition whenever an ancestor
+    was merely queued, ADVICE r5 #3), so '->' lines are skipped."""
+    holders = set()
+    for line in lines:
+        parts = line.split()
+        if "->" in parts or "FLOCK" not in parts:
+            continue
+        # "<id>: FLOCK ADVISORY WRITE <pid> <maj>:<min>:<inode> ..."
+        try:
+            pid = int(parts[-4])
+            maj_s, min_s, ino_s = parts[-3].split(":")
+            # full (device, inode) identity: an equal inode on
+            # a DIFFERENT filesystem must not match
+            key = (int(maj_s, 16), int(min_s, 16), int(ino_s))
+        except (ValueError, IndexError):
+            continue
+        if key == want:
+            holders.add(pid)
+    return holders
+
+
 def _lock_held_by_ancestor(lock_path: str | None = None) -> bool:
     """True when an ANCESTOR process holds the tunnel flock — i.e. this
     bench was launched as `flock /tmp/axon_tunnel.lock ... python bench.py`
@@ -300,21 +325,7 @@ def _lock_held_by_ancestor(lock_path: str | None = None) -> bool:
         st = os.stat(lock_path)
         want = (os.major(st.st_dev), os.minor(st.st_dev), st.st_ino)
         with open("/proc/locks") as fh:
-            holders = set()
-            for line in fh:
-                parts = line.split()
-                # "<id>: FLOCK ADVISORY WRITE <pid> <maj>:<min>:<inode> ..."
-                if "FLOCK" in parts:
-                    try:
-                        pid = int(parts[-4])
-                        maj_s, min_s, ino_s = parts[-3].split(":")
-                        # full (device, inode) identity: an equal inode on
-                        # a DIFFERENT filesystem must not match
-                        key = (int(maj_s, 16), int(min_s, 16), int(ino_s))
-                    except (ValueError, IndexError):
-                        continue
-                    if key == want:
-                        holders.add(pid)
+            holders = _parse_flock_holders(fh, want)
         if not holders:
             return False
         pid = os.getpid()

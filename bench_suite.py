@@ -250,6 +250,59 @@ def bench_streaming_eval(quick: bool) -> None:
               d=d, n_feats=d * ratio, single_pass=True)
 
 
+def bench_serving(quick: bool) -> None:
+    """Online feature-extraction serving: concurrent mixed-size requests
+    through the micro-batching engine's AOT bucket programs. Reports
+    end-to-end throughput plus per-request p50/p99 latency and the
+    steady-state recompile count (must be 0 — every recompile is a trace
+    in the latency path)."""
+    import threading
+
+    from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+    from sparse_coding_tpu.serve import ModelRegistry, ServingEngine
+
+    d, ratio = (256, 2) if quick else (512, 4)
+    n_threads, per_thread = (4, 50) if quick else (8, 250)
+    ld = FunctionalTiedSAE.to_learned_dict(
+        *FunctionalTiedSAE.init(jax.random.PRNGKey(0), d, d * ratio,
+                                l1_alpha=1e-3))
+    registry = ModelRegistry()
+    registry.register("sae", ld)
+    rng = np.random.default_rng(0)
+    sizes = rng.integers(1, 65, n_threads * per_thread)
+    payloads = [np.asarray(rng.standard_normal((int(s), d)), np.float32)
+                for s in sizes]
+    with ServingEngine(registry, max_wait_ms=1.0,
+                       max_queue_rows=1 << 20) as engine:
+        engine.warmup()
+
+        def submitter(tid: int) -> None:
+            futures = [engine.submit("sae", payloads[tid * per_thread + i])
+                       for i in range(per_thread)]
+            for f in futures:
+                f.result(timeout=120)
+
+        threads = [threading.Thread(target=submitter, args=(t,))
+                   for t in range(n_threads)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        dt = time.perf_counter() - t0
+        snap = engine.stats()
+    total_rows = int(sizes.sum())
+    fill = (sum(b["rows"] for b in snap["buckets"].values())
+            / max(1, sum(b["batches"] * size
+                         for size, b in snap["buckets"].items())))
+    _emit("serving", total_rows / dt, "activations/s",
+          n_requests=len(payloads), n_threads=n_threads, d=d,
+          n_feats=d * ratio,
+          p50_ms=round(snap["p50_ms"], 3) if snap["p50_ms"] else None,
+          p99_ms=round(snap["p99_ms"], 3) if snap["p99_ms"] else None,
+          fill_ratio=round(fill, 3), recompiles=snap["recompiles"])
+
+
 def bench_seq_parallel(quick: bool) -> None:
     # The pre-r4 version of this suite hung indefinitely behind the axon
     # tunnel (eager shard_map); the jitted _sp_program fixed it, but a

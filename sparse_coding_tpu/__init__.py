@@ -12,7 +12,10 @@ A ground-up re-design of the capabilities of HoagyC/sparse_coding (see
   `run_with_cache` (activation_dataset.py), incl. a sequence-parallel
   ring-attention path for long contexts,
 - metrics, interpretation, and plotting layers mirroring standard_metrics.py,
-  interpret.py and plotting/.
+  interpret.py and plotting/,
+- a request-driven serving engine (serve/) — micro-batched, AOT-compiled
+  shape-bucket feature extraction over a multi-dict registry — a workload
+  the reference has no counterpart for.
 """
 
 __version__ = "0.1.0"
@@ -20,5 +23,6 @@ __version__ = "0.1.0"
 from sparse_coding_tpu import config as config
 from sparse_coding_tpu import ensemble as ensemble
 from sparse_coding_tpu import models as models
+from sparse_coding_tpu import serve as serve
 from sparse_coding_tpu.ensemble import Ensemble, EnsembleGroup
 from sparse_coding_tpu.parallel.mesh import make_mesh

@@ -190,6 +190,11 @@ def _finalize_moments(carry, k: int):
     (standard_metrics.py:482-511). Single home for the clipped-variance
     normalization shared by calc_moments_streaming, streaming_eval_sweep and
     geometry.kurtosis_sweep."""
+    if k == 0:
+        raise ValueError(
+            "no full batch was consumed (dataset smaller than batch_size); "
+            "moment statistics would be NaN — use a batch_size <= the row "
+            "count (ADVICE r5 #4)")
     times_active, m1, m2, m3, m4 = carry
     mean, m2, m3, m4 = m1 / k, m2 / k, m3 / k, m4 / k
     var = m2 - mean**2

@@ -16,7 +16,7 @@ Conventions (matching the reference):
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import ClassVar, Optional
 
 import flax.struct as struct
 import jax
@@ -42,7 +42,19 @@ LEARNED_DICT_REGISTRY: dict[str, type] = {}
 
 
 class LearnedDict(struct.PyTreeNode):
-    """Base class: subclasses provide `encode` and `get_learned_dict`."""
+    """Base class: subclasses provide `encode` and `get_learned_dict`.
+
+    Uniform inference signature (audited at the serving-registry boundary,
+    serve/registry.py::audit_signature): ``encode(x: [b, d]) -> [b, n]``,
+    ``decode(c: [b, n]) -> [b, d]``, ``predict(x: [b, d]) -> [b, d]`` —
+    all pure, all row-independent unless ``batch_coupled`` says otherwise.
+    """
+
+    # True when encode/predict depend on the WHOLE batch (not row-wise) —
+    # e.g. AddedNoise salts its RNG with the batch sum. Such dicts cannot
+    # be served through the coalescing micro-batcher: mixing rows from
+    # different requests would change each request's answer.
+    batch_coupled: ClassVar[bool] = False
 
     def __init_subclass__(cls, **kwargs):
         super().__init_subclass__(**kwargs)
@@ -165,6 +177,8 @@ class AddedNoise(LearnedDict):
     noise_mag: Array
     eye: Array
     key: Array
+
+    batch_coupled: ClassVar[bool] = True  # RNG salt = f(whole batch)
 
     @classmethod
     def create(cls, key: Array, activation_size: int, noise_mag: float,

@@ -1,0 +1,42 @@
+"""Feature-extraction serving engine.
+
+Turns trained LearnedDict artifacts into a low-latency online service plus
+a high-throughput offline scorer, built from four pieces:
+
+- :mod:`registry`  — named model store; loads native ``learned_dicts.pkl``
+  and reference ``learned_dicts.pt`` artifacts, audits signatures, stacks
+  homogeneous dicts for the vmapped multi-dict path.
+- :mod:`engine`    — AOT-compiled padded shape-bucket programs
+  (``jit(...).lower(...).compile()`` at warmup; steady state never traces).
+- :mod:`batching`  — dynamic micro-batching queue: coalesce, deadline
+  flush, backpressure; the Python hot loop is ``lax``-free.
+- :mod:`metrics`   — per-bucket counters, fill ratios, latency quantiles,
+  recompile counter (must stay 0 after warmup).
+- :mod:`offline`   — batch scorer reusing the same compiled buckets.
+
+See docs/ARCHITECTURE.md §8 for design rationale.
+"""
+
+from sparse_coding_tpu.serve.batching import (
+    QueueFullError,
+    RequestTooLargeError,
+    ServeError,
+    ServeFuture,
+)
+from sparse_coding_tpu.serve.engine import ServingEngine, bucket_op_fn
+from sparse_coding_tpu.serve.metrics import ServingMetrics
+from sparse_coding_tpu.serve.offline import score_offline
+from sparse_coding_tpu.serve.registry import ModelRegistry, RegistryEntry
+
+__all__ = [
+    "ModelRegistry",
+    "RegistryEntry",
+    "ServingEngine",
+    "ServingMetrics",
+    "ServeError",
+    "ServeFuture",
+    "QueueFullError",
+    "RequestTooLargeError",
+    "bucket_op_fn",
+    "score_offline",
+]

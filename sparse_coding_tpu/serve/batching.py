@@ -1,0 +1,227 @@
+"""Dynamic micro-batching queue for the serving engine.
+
+Requests (a few activation rows each) are coalesced per (model, op) stream
+into one padded device program per batch — the serving-side instance of the
+repo's dispatch-amortization doctrine (docs/ARCHITECTURE.md §7): through
+the axon tunnel a dispatch costs ~54 ms, so per-request dispatch would cap
+throughput at ~18 req/s regardless of batch math. The whole hot loop here
+is host Python over numpy buffers and threading primitives — ``lax``-free
+by construction; the only jax entry point is the engine's dispatch callback
+invoking an AOT-compiled executable.
+
+Flush policy (per (model, op) stream, oldest stream first):
+
+- **capacity flush**: pending rows reach the largest bucket → dispatch now;
+- **deadline flush**: the oldest request has waited ``max_wait_s`` →
+  dispatch whatever is pending into the smallest covering bucket;
+- **backpressure**: queued rows would exceed ``max_queue_rows`` → the
+  submit call fails fast with :class:`QueueFullError` (typed, carries the
+  depth) instead of adding unbounded latency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from sparse_coding_tpu.serve.metrics import ServingMetrics
+
+
+class ServeError(RuntimeError):
+    """Base class for typed serving failures."""
+
+
+class QueueFullError(ServeError):
+    """Backpressure rejection: admitting the request would push the queue
+    past ``max_queue_rows``. Callers should shed load or retry with
+    backoff; the request was NOT enqueued."""
+
+    def __init__(self, queued_rows: int, max_queue_rows: int):
+        super().__init__(
+            f"serving queue full: {queued_rows} rows queued "
+            f"(max {max_queue_rows}); request rejected")
+        self.queued_rows = queued_rows
+        self.max_queue_rows = max_queue_rows
+
+
+class RequestTooLargeError(ServeError):
+    """The request exceeds the largest shape bucket; route it through
+    :func:`sparse_coding_tpu.serve.offline.score_offline` instead."""
+
+    def __init__(self, rows: int, max_rows: int):
+        super().__init__(
+            f"request of {rows} rows exceeds the largest bucket "
+            f"({max_rows}); use serve.offline.score_offline for bulk "
+            f"scoring")
+        self.rows = rows
+        self.max_rows = max_rows
+
+
+class ServeFuture:
+    """Synchronization handle for one in-flight request."""
+
+    __slots__ = ("_event", "_result", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: BaseException | None = None
+
+    def _set_result(self, result: Any) -> None:
+        self._result = result
+        self._event.set()
+
+    def _set_error(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request did not complete in time")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+@dataclass
+class Request:
+    """One submitted unit of work: ``x`` is always [rows, width] float;
+    ``squeeze`` remembers a 1-D submission so the result matches."""
+
+    key: tuple  # (model_name, op)
+    x: np.ndarray
+    rows: int
+    squeeze: bool
+    t_submit: float
+    future: ServeFuture = field(default_factory=ServeFuture)
+
+
+class MicroBatcher:
+    """Single worker thread draining per-(model, op) request streams into
+    the dispatch callback. ``dispatch(key, requests, deadline_flush)`` owns
+    bucket selection, padding, the compiled call, and result fan-out."""
+
+    def __init__(self, dispatch: Callable[[tuple, list[Request], bool], None],
+                 max_rows_per_batch: int, max_wait_s: float,
+                 max_queue_rows: int, metrics: ServingMetrics):
+        self._dispatch = dispatch
+        self._max_rows = max_rows_per_batch
+        self._max_wait_s = max_wait_s
+        self._max_queue_rows = max_queue_rows
+        self._metrics = metrics
+        self._queues: dict[tuple, deque[Request]] = {}
+        self._queued_rows = 0
+        self._cond = threading.Condition()
+        self._stop = False
+        self._paused = False
+        self._worker = threading.Thread(target=self._loop,
+                                        name="serve-batcher", daemon=True)
+        self._worker.start()
+
+    # -- producer side -------------------------------------------------------
+
+    def submit(self, request: Request) -> ServeFuture:
+        with self._cond:
+            if self._stop:
+                raise ServeError("serving engine is shut down")
+            if self._queued_rows + request.rows > self._max_queue_rows:
+                self._metrics.record_reject()
+                raise QueueFullError(self._queued_rows, self._max_queue_rows)
+            self._queues.setdefault(request.key, deque()).append(request)
+            self._queued_rows += request.rows
+            self._metrics.record_enqueue(request.rows)
+            self._cond.notify_all()
+        return request.future
+
+    def pause(self) -> None:
+        """Hold dispatch (drain-style maintenance and deterministic tests);
+        submissions still enqueue — and still backpressure."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def shutdown(self, wait: bool = True) -> None:
+        with self._cond:
+            self._stop = True
+            self._paused = False
+            self._cond.notify_all()
+        if wait:
+            self._worker.join(timeout=30)
+
+    # -- worker side ---------------------------------------------------------
+
+    def _pick_stream(self, now: float) -> tuple[tuple | None, float | None]:
+        """(key of the stream to flush NOW, or None; earliest deadline among
+        pending streams when nothing is flushable). A stream is flushable
+        when it reaches bucket capacity or its oldest request's deadline —
+        choosing the oldest FLUSHABLE stream (not the globally oldest one)
+        avoids head-of-line blocking: a capacity-full stream must not wait
+        behind an older sparse stream that is still accumulating."""
+        flush_key, flush_t = None, None
+        next_deadline = None
+        for key, q in self._queues.items():
+            if not q:
+                continue
+            deadline = q[0].t_submit + self._max_wait_s
+            if (sum(r.rows for r in q) >= self._max_rows
+                    or now >= deadline or self._stop):
+                if flush_t is None or q[0].t_submit < flush_t:
+                    flush_key, flush_t = key, q[0].t_submit
+            elif next_deadline is None or deadline < next_deadline:
+                next_deadline = deadline
+        return flush_key, next_deadline
+
+    def _pop_batch(self) -> tuple[tuple, list[Request], bool] | None:
+        """Block until a stream is flushable (capacity or deadline), then
+        pop greedily up to the largest bucket. Returns None on shutdown."""
+        with self._cond:
+            while True:
+                if self._stop and (self._paused
+                                   or not any(self._queues.values())):
+                    return None
+                if self._paused:
+                    self._cond.wait(timeout=0.1)
+                    continue
+                now = time.perf_counter()
+                key, next_deadline = self._pick_stream(now)
+                if key is None:
+                    self._cond.wait(
+                        timeout=0.1 if next_deadline is None
+                        else max(1e-4, next_deadline - now))
+                    continue
+                q = self._queues[key]
+                deadline_hit = now >= q[0].t_submit + self._max_wait_s
+                reqs: list[Request] = [q.popleft()]
+                rows = reqs[0].rows
+                while q and rows + q[0].rows <= self._max_rows:
+                    r = q.popleft()
+                    reqs.append(r)
+                    rows += r.rows
+                self._queued_rows -= rows
+                self._metrics.record_dequeue(rows)
+                return key, reqs, deadline_hit and rows < self._max_rows
+
+    def _loop(self) -> None:
+        while True:
+            popped = self._pop_batch()
+            if popped is None:
+                return
+            key, reqs, deadline_flush = popped
+            try:
+                self._dispatch(key, reqs, deadline_flush)
+            except BaseException as e:  # noqa: BLE001 — fan the error out
+                for r in reqs:
+                    if not r.future.done():
+                        r.future._set_error(e)

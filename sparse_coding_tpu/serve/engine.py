@@ -1,0 +1,289 @@
+"""AOT shape-bucket serving engine.
+
+Online inference is request-driven: shapes arrive one ragged handful of
+rows at a time, and jit's trace-on-first-shape model would turn every new
+row count into a compile in the latency path. The engine removes tracing
+from steady state entirely:
+
+- requests coalesce (serve/batching.py) into a small ladder of padded row
+  buckets (default 8/64/512 — geometric, so padding waste is bounded at
+  ~8x worst case on the smallest bucket and amortizes with load);
+- each (model, op, bucket) program is AOT-compiled at startup via
+  ``jit(f).lower(model, spec).compile()`` — ``warmup()`` walks the full
+  product so the first real request already hits a compiled executable;
+- the model pytree is an ARGUMENT of the compiled program (not a closed-
+  over constant), so weights live in ordinary device buffers shared across
+  buckets rather than being baked into N executables;
+- the padded input buffer is donated on TPU (it is fresh per batch, so
+  XLA may write outputs in place; donation is skipped on CPU where it is
+  unimplemented and only warns);
+- a registry stack entry compiles the vmapped multi-dict program
+  ``vmap(op, in_axes=(0, None))`` — one activation batch scored against N
+  dictionaries in a single dispatch;
+- every compiled-cache miss after warmup increments the recompile counter
+  (serve/metrics.py) — the invariant a healthy deployment asserts on.
+
+The dispatch path (host loop → numpy concat/pad → one device call → numpy
+fan-out) is ``lax``-free Python per docs/ARCHITECTURE.md §7: exactly one
+device program and one bulk transfer each way per coalesced batch.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding_tpu.serve.batching import (
+    MicroBatcher,
+    Request,
+    RequestTooLargeError,
+    ServeError,
+    ServeFuture,
+)
+from sparse_coding_tpu.serve.metrics import ServingMetrics
+from sparse_coding_tpu.serve.registry import ModelRegistry, RegistryEntry
+
+DEFAULT_BUCKETS = (8, 64, 512)
+DEFAULT_OPS = ("encode", "decode", "topk")
+
+
+def bucket_op_fn(op: str, k: int | None = None):
+    """The pure per-bucket program for one op. Module-level (not an engine
+    closure) so tests/test_tpu_lowering.py can AOT-lower the exact
+    functions the engine compiles. ``x`` is [bucket_rows, d] for
+    encode/predict/topk and [bucket_rows, n_feats] for decode."""
+    if op == "encode":
+        return lambda ld, x: ld.encode(x)
+    if op == "decode":
+        return lambda ld, x: ld.decode(x)
+    if op == "predict":
+        return lambda ld, x: ld.predict(x)
+    if op == "topk":
+        if k is None or k < 1:
+            raise ValueError("topk op needs k >= 1")
+
+        def topk(ld, x):
+            vals, idx = jax.lax.top_k(ld.encode(x), k)
+            return vals, idx
+
+        return topk
+    raise ValueError(f"unknown serving op {op!r} "
+                     f"(supported: encode, decode, predict, topk)")
+
+
+class ServingEngine:
+    """Request-driven feature extraction over a :class:`ModelRegistry`.
+
+    ``submit`` enqueues and returns a :class:`ServeFuture`; ``query`` is
+    the blocking convenience. ``warmup()`` AOT-compiles every
+    (model, op, bucket) program; after it returns, ``stats()["recompiles"]``
+    staying 0 proves steady-state serving never traces.
+    """
+
+    def __init__(self, registry: ModelRegistry,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 ops: Sequence[str] = DEFAULT_OPS,
+                 topk_k: int = 16,
+                 max_wait_ms: float = 2.0,
+                 max_queue_rows: int = 8192,
+                 donate: bool | None = None,
+                 dtype=jnp.float32,
+                 latency_window: int = 4096):
+        if not buckets or list(buckets) != sorted(set(buckets)):
+            raise ValueError(f"buckets must be unique ascending: {buckets}")
+        self._registry = registry
+        self._buckets = tuple(int(b) for b in buckets)
+        self._ops = tuple(ops)
+        self._topk_k = int(topk_k)
+        self._dtype = jnp.dtype(dtype)
+        self._np_dtype = np.dtype(dtype)
+        # donation lets XLA alias the padded input for outputs; CPU's
+        # runtime doesn't implement it and would warn every compile
+        self._donate = (jax.default_backend() == "tpu"
+                        if donate is None else bool(donate))
+        self.metrics = ServingMetrics(latency_window=latency_window)
+        self._compiled: dict[tuple, Any] = {}
+        self._compile_lock = threading.Lock()
+        self._warmed = False
+        self._batcher = MicroBatcher(
+            dispatch=self._dispatch,
+            max_rows_per_batch=self._buckets[-1],
+            max_wait_s=max_wait_ms / 1e3,
+            max_queue_rows=max_queue_rows,
+            metrics=self.metrics)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def warmup(self) -> int:
+        """AOT-compile every (model, op, bucket) program for the CURRENT
+        registry contents. Returns the number of executables compiled.
+        Idempotent; re-run after registering more models."""
+        n = 0
+        for name in self._registry.names():
+            for op in self._ops:
+                for bucket in self._buckets:
+                    if (name, op, bucket) not in self._compiled:
+                        self._get_compiled(name, op, bucket,
+                                           count_miss=False)
+                        n += 1
+        self._warmed = True
+        return n
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._batcher.shutdown(wait=wait)
+
+    def pause(self) -> None:
+        self._batcher.pause()
+
+    def resume(self) -> None:
+        self._batcher.resume()
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- request path --------------------------------------------------------
+
+    def submit(self, model: str, x, op: str = "encode") -> ServeFuture:
+        """Enqueue one request. ``x`` is [rows, width] (or a single [width]
+        row, returned un-batched); width is d_activation for
+        encode/predict/topk and n_feats for decode. Raises
+        :class:`QueueFullError` under backpressure and
+        :class:`RequestTooLargeError` past the largest bucket."""
+        entry = self._registry.get(model)
+        if op not in self._ops:
+            raise ValueError(f"op {op!r} not served (engine ops: "
+                             f"{self._ops})")
+        arr = np.asarray(x, dtype=self._np_dtype)
+        squeeze = arr.ndim == 1
+        if squeeze:
+            arr = arr[None, :]
+        if arr.ndim != 2:
+            raise ValueError(f"request must be 1-D or 2-D, got shape "
+                             f"{arr.shape}")
+        width = self._op_width(entry, op)
+        if arr.shape[1] != width:
+            raise ValueError(
+                f"{model!r}/{op}: expected width {width}, got "
+                f"{arr.shape[1]}")
+        rows = arr.shape[0]
+        if rows == 0:
+            raise ValueError("empty request")
+        if rows > self._buckets[-1]:
+            raise RequestTooLargeError(rows, self._buckets[-1])
+        req = Request(key=(model, op), x=arr, rows=rows, squeeze=squeeze,
+                      t_submit=time.perf_counter())
+        return self._batcher.submit(req)
+
+    def query(self, model: str, x, op: str = "encode",
+              timeout: float | None = 60.0):
+        """Blocking submit+result."""
+        return self.submit(model, x, op=op).result(timeout=timeout)
+
+    def topk(self, model: str, x, timeout: float | None = 60.0):
+        """Top-k feature query: (values, indices) of the k strongest
+        features per row (k fixed per engine at construction — it is a
+        static shape in the compiled programs)."""
+        return self.query(model, x, op="topk", timeout=timeout)
+
+    def stats(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["warmed"] = self._warmed
+        snap["compiled_programs"] = len(self._compiled)
+        return snap
+
+    # -- compiled-program cache ----------------------------------------------
+
+    def _op_width(self, entry: RegistryEntry, op: str) -> int:
+        return entry.n_feats if op == "decode" else entry.d_activation
+
+    def _bucket_for(self, rows: int) -> int:
+        i = bisect.bisect_left(self._buckets, rows)
+        if i == len(self._buckets):
+            raise RequestTooLargeError(rows, self._buckets[-1])
+        return self._buckets[i]
+
+    def _compile(self, entry: RegistryEntry, op: str, bucket: int):
+        fn = bucket_op_fn(op, k=min(self._topk_k, entry.n_feats))
+        if entry.is_stack:
+            fn = jax.vmap(fn, in_axes=(0, None))
+        spec = jax.ShapeDtypeStruct((bucket, self._op_width(entry, op)),
+                                    self._dtype)
+        donate = (1,) if self._donate else ()
+        return (jax.jit(fn, donate_argnums=donate)
+                .lower(entry.tree, spec).compile())
+
+    def _get_compiled(self, model: str, op: str, bucket: int,
+                      count_miss: bool = True):
+        key = (model, op, bucket)
+        compiled = self._compiled.get(key)
+        if compiled is None:
+            with self._compile_lock:
+                compiled = self._compiled.get(key)
+                if compiled is None:
+                    if self._warmed and count_miss:
+                        self.metrics.record_recompile(key)
+                    compiled = self._compile(self._registry.get(model), op,
+                                             bucket)
+                    self._compiled[key] = compiled
+        return compiled
+
+    # -- dispatch (runs on the batcher worker thread) ------------------------
+
+    def run_padded(self, model: str, op: str, x: np.ndarray):
+        """One coalesced batch through one compiled program: pad [rows, w]
+        up to its bucket, single device call, results sliced back to
+        ``rows`` on host. Shared by the online dispatch and the offline
+        scorer; returns (bucket, numpy result tree)."""
+        rows = x.shape[0]
+        bucket = self._bucket_for(rows)
+        if rows < bucket:
+            pad = np.zeros((bucket, x.shape[1]), self._np_dtype)
+            pad[:rows] = x
+            x = pad
+        compiled = self._get_compiled(model, op, bucket)
+        out = compiled(self._registry.get(model).tree, jnp.asarray(x))
+        rows_axis = 1 if self._registry.get(model).is_stack else 0
+        sl = (slice(None),) * rows_axis + (slice(0, rows),)
+        host = jax.tree.map(lambda a: np.asarray(a)[sl], out)
+        return bucket, host
+
+    def _dispatch(self, key: tuple, requests: list[Request],
+                  deadline_flush: bool) -> None:
+        model, op = key
+        rows = sum(r.rows for r in requests)
+        if len(requests) == 1:
+            x = requests[0].x
+        else:
+            x = np.concatenate([r.x for r in requests], axis=0)
+        try:
+            bucket, host = self.run_padded(model, op, x)
+        except BaseException as e:  # noqa: BLE001 — typed fan-out
+            err = e if isinstance(e, ServeError) else ServeError(
+                f"dispatch failed for {model!r}/{op}: {e!r}")
+            for r in requests:
+                r.future._set_error(err)
+            return
+        self.metrics.record_batch(bucket, len(requests), rows,
+                                  deadline_flush)
+        rows_axis = 1 if self._registry.get(model).is_stack else 0
+        now = time.perf_counter()
+        ofs = 0
+        for r in requests:
+            sl = ((slice(None),) * rows_axis
+                  + (slice(ofs, ofs + r.rows),))
+            res = jax.tree.map(lambda a: a[sl], host)
+            if r.squeeze:
+                sq = (slice(None),) * rows_axis + (0,)
+                res = jax.tree.map(lambda a: a[sq], res)
+            ofs += r.rows
+            self.metrics.record_latency(bucket, now - r.t_submit)
+            r.future._set_result(res)

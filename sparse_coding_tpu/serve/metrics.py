@@ -1,0 +1,140 @@
+"""Serving observability: per-bucket counters and latency quantiles.
+
+Everything here is plain host-side Python (a lock, dicts, deques) — the
+metrics path must never touch jax, or instrumentation itself would add
+device dispatches to the hot loop. The one invariant the snapshot exists to
+prove is ``recompiles == 0`` after warmup: every compiled-program cache miss
+in steady state means a shape escaped the bucket ladder and the engine
+silently paid a trace+compile in a latency-sensitive path.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+
+
+def _quantile_ms(samples: list[float], q: float) -> float | None:
+    """Nearest-rank quantile of a list of second-valued latencies, in ms."""
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    idx = min(len(ordered) - 1, max(0, math.ceil(q * len(ordered)) - 1))
+    return ordered[idx] * 1e3
+
+
+class _BucketStats:
+    __slots__ = ("batches", "requests", "rows", "deadline_flushes",
+                 "latencies")
+
+    def __init__(self, latency_window: int):
+        self.batches = 0
+        self.requests = 0
+        self.rows = 0
+        self.deadline_flushes = 0
+        self.latencies: deque[float] = deque(maxlen=latency_window)
+
+
+class ServingMetrics:
+    """Thread-safe counters shared by the engine, the batcher, and the
+    offline driver. ``snapshot()`` is the only read surface."""
+
+    def __init__(self, latency_window: int = 4096):
+        self._lock = threading.Lock()
+        self._latency_window = latency_window
+        self._buckets: dict[int, _BucketStats] = {}
+        self._recompiles = 0
+        self._recompile_keys: list[tuple] = []
+        self._rejected = 0
+        self._queued_rows = 0
+        self._max_queued_rows = 0
+        self._submitted = 0
+
+    # -- write side (engine / batcher) --------------------------------------
+
+    def _bucket(self, bucket: int) -> _BucketStats:
+        b = self._buckets.get(bucket)
+        if b is None:
+            b = self._buckets[bucket] = _BucketStats(self._latency_window)
+        return b
+
+    def record_enqueue(self, rows: int) -> None:
+        with self._lock:
+            self._submitted += 1
+            self._queued_rows += rows
+            self._max_queued_rows = max(self._max_queued_rows,
+                                        self._queued_rows)
+
+    def record_dequeue(self, rows: int) -> None:
+        with self._lock:
+            self._queued_rows = max(0, self._queued_rows - rows)
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self._rejected += 1
+
+    def record_batch(self, bucket: int, n_requests: int, rows: int,
+                     deadline_flush: bool) -> None:
+        with self._lock:
+            b = self._bucket(bucket)
+            b.batches += 1
+            b.requests += n_requests
+            b.rows += rows
+            if deadline_flush:
+                b.deadline_flushes += 1
+
+    def record_latency(self, bucket: int, seconds: float) -> None:
+        with self._lock:
+            self._bucket(bucket).latencies.append(seconds)
+
+    def record_recompile(self, key: tuple) -> None:
+        with self._lock:
+            self._recompiles += 1
+            self._recompile_keys.append(key)
+
+    # -- read side -----------------------------------------------------------
+
+    @property
+    def recompiles(self) -> int:
+        with self._lock:
+            return self._recompiles
+
+    @property
+    def queued_rows(self) -> int:
+        with self._lock:
+            return self._queued_rows
+
+    def snapshot(self) -> dict:
+        """One coherent dict of everything: per-bucket request counts, fill
+        ratios (rows served / bucket capacity dispatched), latency p50/p99,
+        queue-depth high-water mark, rejections, and the recompile counter
+        (with the offending (model, op, bucket) keys when nonzero)."""
+        with self._lock:
+            buckets = {}
+            all_lat: list[float] = []
+            for size in sorted(self._buckets):
+                b = self._buckets[size]
+                lat = list(b.latencies)
+                all_lat.extend(lat)
+                capacity = b.batches * size
+                buckets[size] = {
+                    "batches": b.batches,
+                    "requests": b.requests,
+                    "rows": b.rows,
+                    "fill_ratio": (b.rows / capacity) if capacity else 0.0,
+                    "deadline_flushes": b.deadline_flushes,
+                    "p50_ms": _quantile_ms(lat, 0.50),
+                    "p99_ms": _quantile_ms(lat, 0.99),
+                }
+            return {
+                "buckets": buckets,
+                "p50_ms": _quantile_ms(all_lat, 0.50),
+                "p99_ms": _quantile_ms(all_lat, 0.99),
+                "requests": self._submitted,
+                "rejected": self._rejected,
+                "queue_depth_rows": self._queued_rows,
+                "max_queue_depth_rows": self._max_queued_rows,
+                "recompiles": self._recompiles,
+                "recompile_keys": list(self._recompile_keys),
+            }

@@ -13,7 +13,7 @@ from __future__ import annotations
 import dataclasses
 import pickle
 from pathlib import Path
-from typing import Any, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import numpy as np
@@ -64,12 +64,19 @@ def save_learned_dicts(dicts: Sequence[tuple[Any, dict]], path: str | Path) -> N
         pickle.dump(records, fh)
 
 
-def load_learned_dicts(path: str | Path) -> list[tuple[Any, dict]]:
+def load_learned_dicts(path: str | Path,
+                       select: Optional[Callable[[dict], bool]] = None
+                       ) -> list[tuple[Any, dict]]:
+    """``select(hyperparams) -> bool`` filters records BEFORE their arrays
+    are reconstructed as jax trees — a serving registry loading two dicts
+    out of a 64-member sweep artifact skips 62 host→device transfers."""
     with Path(path).open("rb") as fh:
         records = pickle.load(fh)
     reg = _dict_registry()
     out = []
     for rec in records:
+        if select is not None and not select(rec["hyperparams"]):
+            continue
         cls = reg[rec["cls"]]
         kwargs = {k: _to_jax_tree(v) for k, v in rec["fields"].items()}
         kwargs.update(rec["static"])

@@ -27,6 +27,15 @@ exported dictionaries, models/learned_dict.py::normalize_rows):
 - reference ``ReverseSAE`` defaults to ``norm_encoder=False`` and its decode
   in-place-mutates the code tensor (learned_dict.py:253-255); the converted
   :class:`ReverseSAE` is the pure normalized-row variant.
+- the EXPORT side has the mirror-image deviation (ADVICE r5 #5): a native
+  ReverseSAE exports as a reference ``ReverseSAE(norm_encoder=True)``, but
+  the reference's own decode (learned_dict.py:246-257) einsums the dict
+  TRANSPOSED — correct only for square dictionaries — and mutates its input
+  codes in place, so reference-side decode/predict of an exported non-square
+  ReverseSAE will not reproduce native decode. Encode-side behavior (the
+  part every reference eval driver uses) matches. When reference-side decode
+  fidelity matters, export the dict as a plain TiedSAE instead (identical
+  encode; standard decode).
 """
 
 from __future__ import annotations
@@ -60,25 +69,87 @@ def _shim_class(module: str, name: str) -> type:
     return _shim_cache[key]
 
 
+# The ONLY non-shim globals a reference learned_dicts.pt may reference:
+# torch tensor-rebuild machinery, container/scalar plumbing, and numpy
+# array reconstruction (hyperparams dicts may carry numpy values). A
+# pickle is attacker-controlled code by default (any __reduce__ global
+# runs at load), and the serving registry makes untrusted artifacts a live
+# ingestion path — so find_class is deny-by-default (ADVICE r5 #1).
+_ALLOWED_GLOBALS: dict[str, frozenset[str]] = {
+    "collections": frozenset({"OrderedDict", "defaultdict"}),
+    "builtins": frozenset({
+        "list", "tuple", "dict", "set", "frozenset", "bytearray",
+        "int", "float", "bool", "complex", "str", "bytes", "slice",
+        "range", "NoneType",
+    }),
+    "copyreg": frozenset({"_reconstructor"}),
+    "numpy": frozenset({
+        "ndarray", "dtype", "bool_", "int8", "int16", "int32", "int64",
+        "uint8", "uint16", "uint32", "uint64", "float16", "float32",
+        "float64", "complex64", "complex128", "longlong", "ulonglong",
+    }),
+    "numpy.core.multiarray": frozenset({"_reconstruct", "scalar"}),
+    "numpy._core.multiarray": frozenset({"_reconstruct", "scalar"}),
+    "torch": frozenset({
+        "Size", "device", "dtype", "ByteStorage", "DoubleStorage",
+        "FloatStorage", "HalfStorage", "LongStorage", "IntStorage",
+        "ShortStorage", "CharStorage", "BoolStorage", "BFloat16Storage",
+    }),
+    "torch.storage": frozenset({"TypedStorage", "UntypedStorage",
+                                "_load_from_bytes"}),
+    "torch.serialization": frozenset({"_get_layout"}),
+}
+
+# Name-prefix rules for modules whose helper set churns across versions:
+# torch._utils' tensor-rebuild family (_rebuild_tensor_v2, _rebuild_meta_…)
+# all share the _rebuild_ prefix.
+_ALLOWED_PREFIXES: dict[str, str] = {"torch._utils": "_rebuild_"}
+
+
 class _RefUnpickler(pickle.Unpickler):
-    """Resolves reference-package globals to shims; everything else (torch
-    tensor rebuilds, builtins) resolves normally."""
+    """Resolves reference-package globals to shims; torch/numpy/container
+    rebuild helpers resolve from the allowlist; EVERYTHING else is
+    rejected — loading a learned_dicts.pt must never execute arbitrary
+    globals from a crafted pickle."""
 
     def find_class(self, module: str, name: str):
         if module.split(".")[0] in _REF_MODULE_PREFIXES:
             return _shim_class(module, name)
+        prefix = _ALLOWED_PREFIXES.get(module)
+        allowed_here = (name in _ALLOWED_GLOBALS.get(module, frozenset())
+                        or (prefix is not None
+                            and name.startswith(prefix)))
+        if not allowed_here:
+            raise pickle.UnpicklingError(
+                f"refusing to unpickle global {module}.{name}: not in the "
+                "reference-artifact allowlist (utils/ref_interop.py "
+                "_ALLOWED_GLOBALS). If this is a legitimate reference "
+                "artifact, extend the allowlist deliberately.")
         return super().find_class(module, name)
 
 
+def _restricted_load(fh, **kwargs):
+    return _RefUnpickler(fh, **kwargs).load()
+
+
+def _restricted_loads(data, **kwargs):
+    import io
+
+    return _RefUnpickler(io.BytesIO(data), **kwargs).load()
+
+
 class _RefPickleModule:
-    """Duck-typed ``pickle_module`` for torch.load."""
+    """Duck-typed ``pickle_module`` for torch.load. ALL load surfaces route
+    through the allowlisted unpickler — torch's legacy format feeds header
+    pickles through ``load``/``loads``, which are attacker-controlled bytes
+    too."""
 
     Unpickler = _RefUnpickler
-    load = staticmethod(pickle.load)
+    load = staticmethod(_restricted_load)
+    loads = staticmethod(_restricted_loads)
     # torch.load consults these when re-serializing errors / legacy formats
     dump = staticmethod(pickle.dump)
     dumps = staticmethod(pickle.dumps)
-    loads = staticmethod(pickle.loads)
     HIGHEST_PROTOCOL = pickle.HIGHEST_PROTOCOL
 
 
@@ -291,7 +362,12 @@ def export_reference_learned_dicts(pairs, path: str | Path) -> None:
     for the duration of the save). Exportable natives: UntiedSAE, TiedSAE
     (with optional centering), ReverseSAE, TopKLearnedDict. State layouts
     mirror the reference constructors (learned_dict.py:129-257,
-    topk_encoder.py:49-63)."""
+    topk_encoder.py:49-63).
+
+    ReverseSAE caveat: the reference's ReverseSAE.decode is transposed (only
+    square dicts) and mutates codes in place, so an exported ReverseSAE
+    matches the reference on ENCODE only — see the module docstring; export
+    as TiedSAE when reference-side decode must agree."""
     import sys
     import types
 

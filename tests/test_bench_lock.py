@@ -78,3 +78,20 @@ def test_ancestor_lock_detection(tmp_path, monkeypatch):
     finally:
         holder.kill()
         holder.wait()
+
+
+def test_parse_flock_holders_skips_blocked_waiters():
+    """/proc/locks lists blocked waiters as '-> FLOCK' continuation lines;
+    a PID merely QUEUED on the flock must not be reported as a holder
+    (ADVICE r5: a queued ancestor made bench skip acquisition)."""
+    import bench
+
+    want = (253, 0, 4242)
+    lines = [
+        "1: FLOCK  ADVISORY  WRITE 100 fd:00:4242 0 EOF\n",
+        "1: -> FLOCK  ADVISORY  WRITE 200 fd:00:4242 0 EOF\n",
+        "2: FLOCK  ADVISORY  WRITE 300 fd:00:9999 0 EOF\n",  # other inode
+        "3: POSIX  ADVISORY  WRITE 400 fd:00:4242 0 EOF\n",  # not flock
+        "garbage line\n",
+    ]
+    assert bench._parse_flock_holders(lines, want) == {100}

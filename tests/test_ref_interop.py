@@ -396,3 +396,51 @@ def test_export_sanitizes_hyperparams_and_restores_shadowed_classes(tmp_path):
                          "sparse_coding_tpu.utils.ref_interop",
                          fromlist=["_RefPickleModule"])._RefPickleModule)
     assert isinstance(raw[0][1]["l1_alpha"], float)
+
+
+def test_malicious_pickle_rejected(tmp_path):
+    """The unpickler is deny-by-default (ADVICE r5 #1): a learned_dicts.pt
+    whose reduce chain references a global outside the allowlist (here
+    os.system) must fail with UnpicklingError BEFORE anything executes —
+    the serving registry makes untrusted-artifact loading a live path."""
+    import pickle
+
+    class Evil:
+        def __reduce__(self):
+            import os
+
+            return (os.system, ("echo pwned",))
+
+    path = tmp_path / "learned_dicts.pt"
+    with path.open("wb") as fh:
+        pickle.dump([(Evil(), {})], fh)
+    with pytest.raises(Exception) as exc:
+        load_reference_learned_dicts(path)
+    assert "allowlist" in str(exc.value) or isinstance(
+        exc.value, pickle.UnpicklingError)
+
+
+def test_registry_loads_reference_artifact(tmp_path):
+    """The serving registry's reference-format path end to end: a
+    reference-layout artifact loads through the allowlisted unpickler and
+    registers servable entries."""
+    from sparse_coding_tpu.serve import ModelRegistry
+
+    rng = _rng(11)
+    n, d = 12, 8
+    obj = _ref_instance(
+        "UntiedSAE",
+        encoder=torch.tensor(
+            rng.standard_normal((n, d)).astype(np.float32)),
+        encoder_bias=torch.tensor(
+            rng.standard_normal(n).astype(np.float32)),
+        decoder=torch.tensor(
+            rng.standard_normal((n, d)).astype(np.float32)))
+    path = _save_ref_artifact(tmp_path, [(obj, {"l1_alpha": 1e-3})])
+    reg = ModelRegistry()
+    names = reg.load_reference(path, prefix="ref")
+    assert names == ["ref/0"]
+    entry = reg.get("ref/0")
+    assert entry.cls_name == "UntiedSAE"
+    assert (entry.d_activation, entry.n_feats) == (d, n)
+    assert entry.hyperparams == {"l1_alpha": 1e-3}

@@ -1,0 +1,302 @@
+"""Serving-engine tests: CPU-hermetic, per the tier-1 contract.
+
+Covers the acceptance invariants from the serving design
+(docs/ARCHITECTURE.md §8): concurrent mixed-size requests coalesce into
+bucket programs with results BIT-equal to direct per-request encode(),
+the recompile counter stays 0 after warmup, backpressure rejects with a
+typed error, deadline flushes dispatch partial buckets, the vmapped
+multi-dict path matches per-dict answers, and the offline driver reuses
+the same compiled buckets.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.models import (
+    AddedNoise,
+    TiedSAE,
+    TopKLearnedDict,
+    UntiedSAE,
+)
+from sparse_coding_tpu.serve import (
+    ModelRegistry,
+    QueueFullError,
+    RequestTooLargeError,
+    ServingEngine,
+    score_offline,
+)
+from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+
+D, N = 16, 32
+
+
+def _mk_tied(key, d=D, n=N) -> TiedSAE:
+    k1, k2 = jax.random.split(key)
+    return TiedSAE(dictionary=jax.random.normal(k1, (n, d)),
+                   encoder_bias=0.1 * jax.random.normal(k2, (n,)))
+
+
+@pytest.fixture
+def registry(rng):
+    reg = ModelRegistry()
+    reg.register("tied", _mk_tied(rng))
+    reg.register("topk", TopKLearnedDict(
+        dictionary=jax.random.normal(jax.random.fold_in(rng, 7), (N, D)),
+        k=4))
+    return reg
+
+
+def test_registry_rejects_batch_coupled(rng):
+    reg = ModelRegistry()
+    with pytest.raises(TypeError, match="batch_coupled"):
+        reg.register("noise", AddedNoise.create(rng, D, 0.1))
+
+
+def test_registry_audit_and_lookup(registry):
+    e = registry.get("tied")
+    assert (e.d_activation, e.n_feats) == (D, N)
+    assert not e.is_stack
+    assert "tied" in registry and len(registry) == 2
+    with pytest.raises(KeyError, match="not registered"):
+        registry.get("nope")
+
+
+def test_registry_loads_native_artifact(rng, tmp_path):
+    path = tmp_path / "learned_dicts.pkl"
+    save_learned_dicts([(_mk_tied(rng), {"l1_alpha": 1e-3}),
+                        (_mk_tied(jax.random.fold_in(rng, 1)),
+                         {"l1_alpha": 1e-2})], path)
+    reg = ModelRegistry()
+    names = reg.load_native(path, prefix="sweep")
+    assert names == ["sweep/0", "sweep/1"]
+    assert reg.get("sweep/0").hyperparams == {"l1_alpha": 1e-3}
+    # select= loads a subset without reconstructing the rest
+    reg2 = ModelRegistry()
+    names2 = reg2.load_native(path, prefix="one",
+                              select=lambda h: h["l1_alpha"] > 5e-3)
+    assert names2 == ["one/0"] and len(reg2) == 1
+
+
+def test_concurrent_mixed_requests_bit_equal_zero_recompiles(rng):
+    """The acceptance-criteria test: ~1000 mixed-size concurrent requests
+    after warmup — coalesced into buckets, every result bit-equal to the
+    direct per-request encode, recompile counter 0.
+
+    Weights and inputs are integer-valued: every dot product is then exact
+    in f32, so the direct [r, d] program and the padded bucket program
+    agree to the BIT regardless of XLA's shape-dependent reduction order —
+    isolating what the engine controls (routing, coalescing, padding,
+    slicing) from backend matmul scheduling, which reorders real-valued
+    reductions per compiled shape at the ~1-ulp level even at `highest`
+    precision. (On the TPU MXU the systolic accumulation order is fixed
+    per row, so real-valued results are shape-independent there.)"""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    int_dict = UntiedSAE(
+        encoder=jax.random.randint(k1, (N, D), -4, 5).astype(jnp.float32),
+        encoder_bias=jax.random.randint(k2, (N,), -4, 5).astype(
+            jnp.float32),
+        dictionary=jax.random.randint(k3, (N, D), -4, 5).astype(
+            jnp.float32))
+    registry = ModelRegistry()
+    registry.register("int", int_dict)
+    n_threads, per_thread = 16, 63  # 1008 requests
+    nrng = np.random.default_rng(0)
+    payloads = [np.asarray(nrng.integers(-4, 5, (r, D)), np.float32)
+                for r in nrng.integers(1, 21, n_threads * per_thread)]
+    expected = {}  # direct per-request encode, computed OUTSIDE the engine
+    enc = jax.jit(lambda ld, x: ld.encode(x))
+    for i, p in enumerate(payloads):
+        expected[i] = np.asarray(enc(int_dict, jnp.asarray(p)))
+
+    with ServingEngine(registry, max_wait_ms=5.0,
+                       max_queue_rows=1 << 20) as engine:
+        n_compiled = engine.warmup()
+        assert n_compiled == 1 * 3 * 3  # 1 model x 3 ops x 3 buckets
+        results: dict[int, np.ndarray] = {}
+        errors: list[BaseException] = []
+
+        def submitter(tid):
+            try:
+                idx = range(tid * per_thread, (tid + 1) * per_thread)
+                futs = [(i, engine.submit("int", payloads[i]))
+                        for i in idx]
+                for i, f in futs:
+                    results[i] = f.result(timeout=60)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=submitter, args=(t,))
+                   for t in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors
+        snap = engine.stats()
+
+    assert len(results) == len(payloads)
+    for i, p in enumerate(payloads):
+        np.testing.assert_array_equal(results[i], expected[i])
+    assert snap["recompiles"] == 0, snap["recompile_keys"]
+    assert snap["rejected"] == 0
+    assert sum(b["requests"] for b in snap["buckets"].values()) == len(
+        payloads)
+    # coalescing happened: strictly fewer device dispatches than requests
+    assert sum(b["batches"] for b in snap["buckets"].values()) < len(
+        payloads)
+    for b in snap["buckets"].values():
+        assert 0.0 < b["fill_ratio"] <= 1.0
+    assert snap["p50_ms"] is not None and snap["p99_ms"] >= snap["p50_ms"]
+
+
+def test_single_row_and_topk_queries(registry):
+    with ServingEngine(registry, max_wait_ms=0.0, topk_k=4) as engine:
+        engine.warmup()
+        x = np.asarray(np.random.default_rng(1).standard_normal(D),
+                       np.float32)
+        code = engine.query("tied", x)
+        assert code.shape == (N,)  # 1-D in, 1-D out
+        direct = np.asarray(registry.get("tied").tree.encode(
+            jnp.asarray(x[None]))[0])
+        np.testing.assert_array_equal(code, direct)
+
+        vals, idx = engine.topk("topk", x[None])
+        assert vals.shape == (1, 4) and idx.shape == (1, 4)
+        full = np.asarray(registry.get("topk").tree.encode(
+            jnp.asarray(x[None])))
+        np.testing.assert_array_equal(vals[0], np.sort(full[0])[::-1][:4])
+
+
+def test_decode_roundtrip(registry):
+    with ServingEngine(registry, max_wait_ms=0.0) as engine:
+        engine.warmup()
+        x = np.asarray(np.random.default_rng(2).standard_normal((3, D)),
+                       np.float32)
+        code = engine.query("tied", x)
+        out = engine.query("tied", code, op="decode")
+        tied = registry.get("tied").tree
+        np.testing.assert_array_equal(
+            out, np.asarray(tied.decode(jnp.asarray(code))))
+
+
+def test_backpressure_typed_rejection(registry):
+    with ServingEngine(registry, max_wait_ms=200.0,
+                       max_queue_rows=4) as engine:
+        engine.warmup()
+        engine.pause()  # hold dispatch so the queue genuinely fills
+        f1 = engine.submit("tied", np.zeros((2, D), np.float32))
+        f2 = engine.submit("tied", np.zeros((2, D), np.float32))
+        with pytest.raises(QueueFullError) as exc:
+            engine.submit("tied", np.zeros((1, D), np.float32))
+        assert exc.value.queued_rows == 4
+        assert exc.value.max_queue_rows == 4
+        engine.resume()
+        assert f1.result(timeout=30).shape == (2, N)
+        assert f2.result(timeout=30).shape == (2, N)
+        snap = engine.stats()
+        assert snap["rejected"] == 1
+        assert snap["max_queue_depth_rows"] == 4
+
+
+def test_request_too_large_routes_to_offline(registry):
+    with ServingEngine(registry, buckets=(8, 64),
+                       max_wait_ms=0.0) as engine:
+        engine.warmup()
+        with pytest.raises(RequestTooLargeError):
+            engine.submit("tied", np.zeros((65, D), np.float32))
+
+
+def test_deadline_flush_partial_bucket(registry):
+    """A lone 3-row request cannot fill any bucket; the max-wait deadline
+    must flush it into the smallest bucket anyway."""
+    with ServingEngine(registry, max_wait_ms=10.0) as engine:
+        engine.warmup()
+        out = engine.query("tied", np.zeros((3, D), np.float32),
+                           timeout=30)
+        assert out.shape == (3, N)
+        snap = engine.stats()
+        b8 = snap["buckets"][8]
+        assert b8["deadline_flushes"] >= 1
+        assert b8["fill_ratio"] == pytest.approx(3 / 8)
+
+
+def test_multi_dict_stack_matches_per_dict(rng):
+    dicts = [_mk_tied(jax.random.fold_in(rng, i)) for i in range(3)]
+    reg = ModelRegistry()
+    reg.register_stack("stack", dicts)
+    assert reg.get("stack").n_stack == 3
+    with ServingEngine(reg, max_wait_ms=0.0) as engine:
+        engine.warmup()
+        x = np.asarray(np.random.default_rng(3).standard_normal((5, D)),
+                       np.float32)
+        out = engine.query("stack", x)
+        assert out.shape == (3, 5, N)
+        for i, ld in enumerate(dicts):
+            np.testing.assert_array_equal(
+                out[i], np.asarray(ld.encode(jnp.asarray(x))))
+
+
+def test_register_stack_rejects_heterogeneous(rng):
+    reg = ModelRegistry()
+    tied = _mk_tied(rng)
+    untied = UntiedSAE(encoder=tied.dictionary,
+                       encoder_bias=tied.encoder_bias,
+                       dictionary=tied.dictionary)
+    with pytest.raises(TypeError, match="mixed classes"):
+        reg.register_stack("bad", [tied, untied])
+    with pytest.raises(TypeError, match="structure or leaf shapes"):
+        reg.register_stack("bad2", [tied, _mk_tied(rng, d=D, n=N * 2)])
+
+
+def test_offline_scoring_reuses_buckets(registry):
+    rows = 1000  # not a bucket multiple: exercises the padded tail slab
+    x = np.asarray(np.random.default_rng(4).standard_normal((rows, D)),
+                   np.float32)
+    with ServingEngine(registry, max_wait_ms=0.0, topk_k=4) as engine:
+        engine.warmup()
+        codes = score_offline(engine, "tied", x)
+        vals, idx = score_offline(engine, "topk", x, op="topk")
+        snap = engine.stats()
+    assert codes.shape == (rows, N)
+    assert vals.shape == (rows, 4) and idx.shape == (rows, 4)
+    tied = registry.get("tied").tree
+    direct = np.concatenate(
+        [np.asarray(tied.encode(jnp.asarray(x[i:i + 512])))
+         for i in range(0, rows, 512)])
+    np.testing.assert_array_equal(codes, direct)
+    assert snap["recompiles"] == 0, snap["recompile_keys"]
+
+
+def test_recompile_counter_counts_post_warmup_models(registry, rng):
+    with ServingEngine(registry, max_wait_ms=0.0) as engine:
+        engine.warmup()
+        registry.register("late", _mk_tied(jax.random.fold_in(rng, 99)))
+        out = engine.query("late", np.zeros((2, D), np.float32),
+                           timeout=30)
+        assert out.shape == (2, N)
+        assert engine.stats()["recompiles"] == 1  # visible, by design
+        engine.warmup()  # re-warm covers the new model...
+        engine.query("late", np.zeros((2, D), np.float32), timeout=30)
+        assert engine.stats()["recompiles"] == 1  # ...no further misses
+
+
+def test_capacity_flush_not_blocked_by_older_sparse_stream(registry):
+    """A capacity-full stream must dispatch immediately even when an older,
+    still-accumulating sparse stream exists (no head-of-line blocking): the
+    1-row 'tied' request has a 10 s deadline, yet the 512-row 'topk'
+    capacity flush behind it must complete far sooner."""
+    import time
+
+    with ServingEngine(registry, max_wait_ms=10_000.0,
+                       max_queue_rows=1 << 20) as engine:
+        engine.warmup()
+        slow = engine.submit("tied", np.zeros((1, D), np.float32))
+        t0 = time.perf_counter()
+        full = engine.submit("topk", np.zeros((512, D), np.float32))
+        full.result(timeout=30)
+        assert time.perf_counter() - t0 < 5.0  # not the 10 s deadline
+        assert not slow.done()  # the sparse stream is still waiting

@@ -190,3 +190,16 @@ def test_streaming_eval_sweep_matches_separate_passes(rng, tmp_path):
                      (kurt, kurt2), (m4, m42)]:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-7)
+
+
+def test_moments_undersized_input_fails_loudly(rng):
+    """A dataset smaller than batch_size consumes zero full batches; the
+    moment sweep must raise instead of silently returning NaN statistics
+    (ADVICE r5 #4)."""
+    from sparse_coding_tpu.models.learned_dict import Identity
+
+    ident = Identity.create(8)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((10, 8)),
+                    jnp.float32)
+    with pytest.raises(ValueError, match="no full batch"):
+        calc_moments_streaming(ident, x, batch_size=100)

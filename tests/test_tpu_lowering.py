@@ -167,6 +167,35 @@ def test_fragment_window_program_lowers(rng):
         lowering_platforms=("tpu",))
 
 
+def test_serving_bucket_programs_lower(rng):
+    """Every program the serving engine AOT-compiles at warmup
+    (serve/engine.py::bucket_op_fn, single-dict and vmapped multi-dict,
+    across the default bucket ladder) passes the TPU lowering pipeline —
+    so a CI-green engine cannot hit a Mosaic/XLA constraint at deploy
+    warmup."""
+    from sparse_coding_tpu.models import TiedSAE, TopKLearnedDict
+    from sparse_coding_tpu.serve.engine import DEFAULT_BUCKETS, bucket_op_fn
+    from sparse_coding_tpu.utils.trees import stack_trees
+
+    d, n = 32, 64
+    k1, k2 = jax.random.split(rng)
+    tied = TiedSAE(dictionary=jax.random.normal(k1, (n, d)),
+                   encoder_bias=jnp.zeros(n))
+    topk = TopKLearnedDict(dictionary=jax.random.normal(k2, (n, d)), k=8)
+    stacked = stack_trees([tied, tied, tied])
+    for rows in DEFAULT_BUCKETS:
+        for ld in (tied, topk):
+            _lower_tpu(bucket_op_fn("encode"), ld, jnp.zeros((rows, d)))
+            _lower_tpu(bucket_op_fn("decode"), ld, jnp.zeros((rows, n)))
+            _lower_tpu(bucket_op_fn("topk", k=16), ld,
+                       jnp.zeros((rows, d)))
+        # the vmapped multi-dict program: one batch vs N dictionaries
+        _lower_tpu(jax.vmap(bucket_op_fn("encode"), in_axes=(0, None)),
+                   stacked, jnp.zeros((rows, d)))
+        _lower_tpu(jax.vmap(bucket_op_fn("topk", k=16), in_axes=(0, None)),
+                   stacked, jnp.zeros((rows, d)))
+
+
 def test_perplexity_scan_program_lowers(rng):
     """The scanned perplexity program (lax.scan over the edit-intervened
     forward — what calculate_perplexity dispatches for all full batches)."""
