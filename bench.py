@@ -345,21 +345,30 @@ def _lock_held_by_ancestor(lock_path: str | None = None) -> bool:
     return False
 
 
-def _acquire_tunnel_lock(wait_s: float):
+def _acquire_tunnel_lock(wait_s: float, poll_s: float = 10.0):
     """Serialize on the repo-wide tunnel lock (CLAUDE.md): the unattended
     recovery watcher (scripts/tunnel_watch.sh) holds it through its
     measurement loop, and a second tunnel client would otherwise block in
     backend init until the driver-side watchdog gives up and emits a
     cpu-fallback line DESPITE a healthy tunnel. Returns the held lock file
     (kept open for the process lifetime) or None if the wait timed out —
-    the caller proceeds either way; the lock is advisory."""
+    the caller proceeds either way; the lock is advisory.
+
+    The `lock.acquire` fault site covers each acquisition attempt, so the
+    fault-matrix suite drives both outcomes (wait-then-acquire and clean
+    timeout) without a real contending process."""
     import fcntl
 
+    from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
+
+    register_fault_site("lock.acquire",
+                        "tunnel flock acquisition attempt (bench.py)")
     fh = open(TUNNEL_LOCK, "w")
     deadline = time.monotonic() + wait_s
     notified = False
     while True:
         try:
+            fault_point("lock.acquire")
             fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
             return fh
         except OSError:
@@ -373,7 +382,7 @@ def _acquire_tunnel_lock(wait_s: float):
             if remaining <= 0:
                 fh.close()
                 return None
-            time.sleep(min(10.0, remaining))
+            time.sleep(min(poll_s, remaining))
 
 
 def main() -> None:

@@ -17,6 +17,7 @@ chunks per :25-27) and its shared-memory DataLoader trick
 from __future__ import annotations
 
 import json
+import logging
 from pathlib import Path
 from typing import Iterable, Iterator, Optional
 
@@ -24,7 +25,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparse_coding_tpu.resilience.atomic import atomic_save_npy, atomic_write_text
+from sparse_coding_tpu.resilience.errors import ChunkCorruptionError
+from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
+from sparse_coding_tpu.resilience.manifest import array_sha256
+from sparse_coding_tpu.resilience.retry import retry_io
+
 Array = jax.Array
+
+logger = logging.getLogger(__name__)
+
+register_fault_site("chunk.read",
+                    "ChunkStore._finish_raw — every chunk load, both the "
+                    "numpy and native-prefetch paths")
+register_fault_site("chunk.write",
+                    "ChunkWriter._write — every chunk flush (inside the "
+                    "bounded-retry scope)")
 
 _DTYPES = {"float16": np.float16, "float32": np.float32,
            "bfloat16": jnp.bfloat16}  # ml_dtypes-backed numpy dtype
@@ -38,9 +54,21 @@ class ChunkWriter:
     def __init__(self, folder: str | Path, activation_dim: int,
                  chunk_size_gb: float = 2.0, dtype: str = "bfloat16",
                  start_index: int = 0, round_rows_to: int = 1,
-                 center: bool = False):
+                 center: bool = False, io_retries: int = 3):
         self.folder = Path(folder)
         self.folder.mkdir(parents=True, exist_ok=True)
+        self.io_retries = int(io_retries)
+        # per-chunk content digests, recorded at write and stamped into
+        # meta.json at finalize so ChunkStore can detect silent corruption.
+        # A skip_chunks-style resume inherits the original run's digests
+        # for the chunks it keeps.
+        self._digests: dict[str, str] = {}
+        if start_index > 0:
+            prior_meta = self.folder / "meta.json"
+            if prior_meta.exists():
+                self._digests = dict(
+                    json.loads(prior_meta.read_text()).get(
+                        "chunk_digests", {}))
         self.activation_dim = activation_dim
         self.dtype = np.dtype(_DTYPES[dtype])
         bytes_per_row = activation_dim * self.dtype.itemsize
@@ -89,7 +117,16 @@ class ChunkWriter:
         # pattern as uint16; ChunkStore views it back via meta["dtype"]
         if self.dtype == jnp.bfloat16:
             arr = arr.view(np.uint16)
-        np.save(self.folder / f"{self.chunk_index}.npy", arr)
+        path = self.folder / f"{self.chunk_index}.npy"
+
+        def _write_once():
+            fault_point("chunk.write")
+            atomic_save_npy(path, arr)
+
+        # tmp+fsync+rename: a crash mid-write can never leave a truncated
+        # chunk at the final name; transient I/O errors get a bounded retry
+        retry_io(_write_once, attempts=self.io_retries)
+        self._digests[str(self.chunk_index)] = array_sha256(arr)
         self.chunk_index += 1
 
     def _flush_chunk(self) -> None:
@@ -108,19 +145,30 @@ class ChunkWriter:
             self._write(flat)
             self._buffer, self._buffered_rows = [], 0
         if self._center_mean is not None:
-            np.save(self.folder / "center.npy", self._center_mean)
+            atomic_save_npy(self.folder / "center.npy", self._center_mean)
         centered = self.center and self._center_mean is not None
         meta = {"activation_dim": self.activation_dim,
                 "dtype": str(np.dtype(self.dtype)),
                 "n_chunks": self.chunk_index,
                 "centered": centered,
+                "chunk_digests": dict(self._digests),
                 # format marker: distinguishes stores whose chunks are
                 # ACTUALLY mean-subtracted on disk from any older artifact
                 # that stamped centered=true without subtracting
                 **({"center_format": "subtracted-v2"} if centered else {})}
         meta.update(metadata or {})
-        (self.folder / "meta.json").write_text(json.dumps(meta, indent=2))
+        # meta.json is written LAST and atomically: its presence certifies
+        # a complete store (every chunk + center.npy already durable)
+        atomic_write_text(self.folder / "meta.json", json.dumps(meta, indent=2))
         return self.chunk_index
+
+    def abort(self) -> None:
+        """Drop buffered rows and sweep up any orphaned tmp files so an
+        aborted harvest leaves only whole chunks and NO meta.json (the
+        absence of which marks the store incomplete)."""
+        self._buffer, self._buffered_rows = [], 0
+        for tmp in self.folder.glob(".*.tmp.*"):
+            tmp.unlink(missing_ok=True)
 
 
 class ChunkStore:
@@ -134,7 +182,25 @@ class ChunkStore:
     read); convert via utils.ref_interop.import_reference_chunks when
     streaming throughput matters."""
 
-    def __init__(self, folder: str | Path):
+    def __init__(self, folder: str | Path, quarantine_corrupt: bool = False,
+                 verify_digests: bool = True, io_retries: int = 3,
+                 retry_base_delay_s: float = 0.01):
+        # quarantine_corrupt=True: streaming readers (chunk_reader/epoch)
+        # skip a corrupt chunk with one logged warning instead of raising —
+        # the opt-in mode for long unattended sweeps where losing one chunk
+        # beats losing the run. load_chunk always raises (a direct caller
+        # asked for THAT chunk).
+        self.quarantine_corrupt = bool(quarantine_corrupt)
+        self.verify_digests = bool(verify_digests)
+        self.io_retries = int(io_retries)
+        self.retry_base_delay_s = float(retry_base_delay_s)
+        self.quarantined: set[int] = set()
+        # chunks whose digest already verified this process: a sha256 over
+        # a multi-GB chunk costs ~1s serial with training, so epoch
+        # repetitions must not re-pay it — first read still catches
+        # on-disk corruption, which is the threat model (a chunk damaged
+        # AFTER a clean in-process read implies failing RAM, not disk)
+        self._digest_verified: set[str] = set()
         self.folder = Path(folder)
         self.chunk_paths = sorted(
             (p for p in self.folder.glob("*.npy") if p.stem.isdigit()),
@@ -178,13 +244,28 @@ class ChunkStore:
             read_npy_native,
         )
 
-        # foreground reads: threaded pread only beats np.load with real
-        # cores to spread over — the native layer's 1-CPU value is the
-        # BACKGROUND overlap in chunk_reader, not raw read speed
-        raw = read_npy_native(self.chunk_paths[i]) if DEFAULT_THREADS > 1 else None
-        if raw is None:  # no compiler / native lib / single-CPU host
-            raw = np.load(self.chunk_paths[i])
-        return self._finish_raw(raw, dtype, self.chunk_paths[i])
+        path = self.chunk_paths[i]
+
+        def _load_once() -> np.ndarray:
+            try:
+                # foreground reads: threaded pread only beats np.load with
+                # real cores to spread over — the native layer's 1-CPU value
+                # is the BACKGROUND overlap in chunk_reader, not raw speed
+                raw = (read_npy_native(path) if DEFAULT_THREADS > 1
+                       else None)
+                if raw is None:  # no compiler / native lib / 1-CPU host
+                    raw = np.load(path)
+            except (ValueError, EOFError) as e:
+                # truncated header/payload: structural damage, not a
+                # transient hiccup — typed, named, never retried
+                raise ChunkCorruptionError(
+                    int(path.stem), path, f"unreadable npy: {e}") from e
+            return self._finish_raw(raw, dtype, path)
+
+        # transient I/O errors (OSError family) get a bounded backoff
+        # retry; ChunkCorruptionError is not transient and passes through
+        return retry_io(_load_once, attempts=self.io_retries,
+                        base_delay_s=self.retry_base_delay_s)
 
     def chunk_mean(self, i: int = 0) -> np.ndarray:
         """Mean of one chunk — the reference's first-chunk centering
@@ -218,9 +299,26 @@ class ChunkStore:
         return shuffled_batches(chunk, batch_size, rng, drop_last)
 
     def _finish_raw(self, raw: np.ndarray, dtype, path) -> np.ndarray:
-        """Single dtype gate for BOTH the numpy and native-prefetch paths:
-        uint16 data is bfloat16 bit patterns only if meta.json says so —
-        otherwise fail loudly (likely an interrupted harvest)."""
+        """Single dtype + integrity gate for BOTH the numpy and
+        native-prefetch paths: the chunk's content digest (recorded in
+        meta.json at finalize) is verified here, so a bit flip anywhere
+        between the writer's buffer and this read raises a typed
+        ChunkCorruptionError naming the chunk; uint16 data is bfloat16 bit
+        patterns only if meta.json says so — otherwise fail loudly
+        (likely an interrupted harvest)."""
+        raw = fault_point("chunk.read", raw)
+        stem = str(path.stem)
+        expected = ((self.meta.get("chunk_digests") or {}).get(stem)
+                    if self.verify_digests and stem not in self._digest_verified
+                    else None)
+        if expected is not None:
+            got = array_sha256(raw)
+            if got != expected:
+                raise ChunkCorruptionError(
+                    int(path.stem), path,
+                    f"content digest mismatch ({got[:12]}… != "
+                    f"{expected[:12]}…)")
+            self._digest_verified.add(stem)
         if raw.dtype == np.uint16:
             if self.meta.get("dtype") != "bfloat16":
                 raise ValueError(
@@ -232,38 +330,82 @@ class ChunkStore:
 
         return fast_astype(raw, dtype)
 
-    def chunk_reader(self, indices, dtype=np.float32) -> Iterator[np.ndarray]:
+    def chunk_reader(self, indices,
+                     dtype=np.float32) -> Iterator[Optional[np.ndarray]]:
         """Yield in-RAM chunks for the given index sequence with disk
         readahead: the NEXT chunk's file streams from disk on native
         background threads while the caller trains on the current one
         (native/chunkio.cpp; silently sequential without it). Holds at most
-        two chunks in host RAM (current + in-flight)."""
+        two chunks in host RAM (current + in-flight). With
+        ``quarantine_corrupt=True`` a corrupt chunk yields ``None`` in its
+        position (one warning logged, see ``_quarantine``) so positional
+        consumers stay aligned with ``indices``."""
         if self.format == "pt":
             # torch deserialization isn't a raw pread — no native readahead
             for ci in indices:
-                yield self.load_chunk(int(ci), dtype)
+                try:
+                    yield self.load_chunk(int(ci), dtype)
+                except ChunkCorruptionError as e:
+                    if not self.quarantine_corrupt:
+                        raise
+                    self._quarantine(e)
+                    yield None
             return
         from sparse_coding_tpu.data.native_io import NativePrefetcher
 
         indices = [int(i) for i in indices]
         prefetcher = NativePrefetcher()
+
+        def _start(path) -> bool:
+            # a truncated/corrupt header must not crash the reader from
+            # the prefetch side: degrade to the foreground path, which
+            # types the failure (ChunkCorruptionError) properly
+            try:
+                return prefetcher.start(path)
+            except (ValueError, EOFError, OSError):
+                return False
+
         try:
-            prefetching = (prefetcher.start(self.chunk_paths[indices[0]])
+            prefetching = (_start(self.chunk_paths[indices[0]])
                            if indices else False)
             for pos, ci in enumerate(indices):
                 raw = prefetcher.wait() if prefetching else None
-                chunk = (self._finish_raw(raw, dtype, self.chunk_paths[ci])
-                         if raw is not None else self.load_chunk(ci, dtype))
+                try:
+                    try:
+                        chunk = (self._finish_raw(raw, dtype,
+                                                  self.chunk_paths[ci])
+                                 if raw is not None
+                                 else self.load_chunk(ci, dtype))
+                    except OSError:
+                        # transient failure on the prefetched buffer:
+                        # re-read through load_chunk's bounded-retry path
+                        chunk = self.load_chunk(ci, dtype)
+                except ChunkCorruptionError as e:
+                    if not self.quarantine_corrupt:
+                        raise
+                    self._quarantine(e)
+                    chunk = None
                 # _finish_raw copied: drop the on-disk dtype buffer before
                 # the yield (keeps the documented two-chunk RAM bound)
                 raw = None
                 if pos + 1 < len(indices):
-                    prefetching = prefetcher.start(
-                        self.chunk_paths[indices[pos + 1]])
+                    prefetching = _start(self.chunk_paths[indices[pos + 1]])
+                # a quarantined chunk yields None (never silently dropped):
+                # positional consumers — the sweep zips chunk indices with
+                # this stream — must stay aligned with the index sequence
                 yield chunk
         finally:
             # early generator exit must not leak the in-flight native read
             prefetcher.cancel()
+
+    def _quarantine(self, err: ChunkCorruptionError) -> None:
+        """Record + warn about a corrupt chunk exactly once; later visits
+        (n_repetitions > 1) skip silently."""
+        if err.chunk_index not in self.quarantined:
+            logger.warning(
+                "quarantining corrupt chunk %d (%s): %s — skipping it for "
+                "the rest of this run", err.chunk_index, err.path, err.reason)
+            self.quarantined.add(err.chunk_index)
 
     def epoch(self, batch_size: int, rng: np.random.Generator,
               n_repetitions: int = 1, dtype=np.float32) -> Iterator[np.ndarray]:
@@ -273,6 +415,8 @@ class ChunkStore:
         order = np.concatenate([rng.permutation(self.n_chunks)
                                 for _ in range(n_repetitions)])
         for chunk in self.chunk_reader(order, dtype):
+            if chunk is None:  # quarantined (quarantine_corrupt=True)
+                continue
             yield from self.batches(chunk, batch_size, rng)
 
 

@@ -163,28 +163,37 @@ def harvest_activations(
 
     done = False
     lo = skip_rows
-    while lo < n_rows and not done:
-        n_avail = (n_rows - lo) // model_batch_size  # full batches left
-        if n_avail == 0:
-            break  # keep shapes static for jit (partial batch dropped)
-        if harvest_window is not None and n_avail >= scan_batches:
-            step_rows = model_batch_size * scan_batches
-            stack = jnp.asarray(token_rows[lo:lo + step_rows].reshape(
-                scan_batches, model_batch_size, seq_len))
-            tapped = harvest_window(stack)
-        else:
-            # the tail (< scan_batches full batches) reuses the compiled
-            # single-batch program — at most two compilations total
-            step_rows = model_batch_size
-            tapped = harvest(jnp.asarray(token_rows[lo:lo + step_rows]))
-        for acts in tapped.values():
-            acts.copy_to_host_async()
-        pending.append(tapped)
-        lo += step_rows
-        if len(pending) > 1:
+    try:
+        while lo < n_rows and not done:
+            n_avail = (n_rows - lo) // model_batch_size  # full batches left
+            if n_avail == 0:
+                break  # keep shapes static for jit (partial batch dropped)
+            if harvest_window is not None and n_avail >= scan_batches:
+                step_rows = model_batch_size * scan_batches
+                stack = jnp.asarray(token_rows[lo:lo + step_rows].reshape(
+                    scan_batches, model_batch_size, seq_len))
+                tapped = harvest_window(stack)
+            else:
+                # the tail (< scan_batches full batches) reuses the compiled
+                # single-batch program — at most two compilations total
+                step_rows = model_batch_size
+                tapped = harvest(jnp.asarray(token_rows[lo:lo + step_rows]))
+            for acts in tapped.values():
+                acts.copy_to_host_async()
+            pending.append(tapped)
+            lo += step_rows
+            if len(pending) > 1:
+                done = drain_one()
+        while pending and not done:
             done = drain_one()
-    while pending and not done:
-        done = drain_one()
+    except BaseException:
+        # a crashed harvest must leave only whole chunk files and NO
+        # meta.json — its absence marks the store incomplete, and abort()
+        # sweeps up any in-flight tmp file (chunk writes are tmp+rename,
+        # so a torn final chunk is impossible either way)
+        for w in writers.values():
+            w.abort()
+        raise
 
     # centering happens INSIDE the writers (first flushed chunk's mean
     # subtracted from every chunk, reference: activation_dataset.py:379-381);

@@ -1,0 +1,61 @@
+"""Deterministic fault injection + the hardening it exercises.
+
+The production north star (ROADMAP.md) is a system that runs unattended —
+long multi-config sweeps surviving preemption and serving heavy traffic
+through partial failure. This package is the failure-handling substrate,
+built so that every handler is *driven by injected faults in CI* rather
+than assumed:
+
+- :mod:`faults`   — named fault sites + Nth-hit :class:`FaultPlan`s
+  (in code via :func:`inject`, or ``SPARSE_CODING_FAULT_PLAN`` env);
+- :mod:`errors`   — the typed failure taxonomy (corruption vs transient);
+- :mod:`retry`    — bounded retry-with-backoff for transient I/O;
+- :mod:`atomic`   — tmp+fsync+rename write discipline;
+- :mod:`manifest` — content digests + checkpoint digest manifests;
+- :mod:`breaker`  — the serving circuit breaker;
+- :mod:`preempt`  — SIGTERM → checkpoint-and-exit for sweeps.
+
+See docs/ARCHITECTURE.md §10 for the design and the fault-site naming
+scheme; tests/test_resilience.py is the fault-matrix suite.
+"""
+
+from sparse_coding_tpu.resilience.breaker import CircuitBreaker
+from sparse_coding_tpu.resilience.errors import (
+    CheckpointCorruptionError,
+    ChunkCorruptionError,
+    ResilienceError,
+)
+from sparse_coding_tpu.resilience.faults import (
+    FAULT_SITES,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    fault_point,
+    inject,
+    install_plan,
+    parse_fault_plan,
+    register_fault_site,
+    reload_from_env,
+)
+from sparse_coding_tpu.resilience.preempt import PreemptionGuard, SweepPreempted
+from sparse_coding_tpu.resilience.retry import retry_io
+
+__all__ = [
+    "CircuitBreaker",
+    "CheckpointCorruptionError",
+    "ChunkCorruptionError",
+    "FAULT_SITES",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "PreemptionGuard",
+    "ResilienceError",
+    "SweepPreempted",
+    "fault_point",
+    "inject",
+    "install_plan",
+    "parse_fault_plan",
+    "register_fault_site",
+    "reload_from_env",
+    "retry_io",
+]

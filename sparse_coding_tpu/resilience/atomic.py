@@ -1,0 +1,63 @@
+"""Atomic durable file writes: tmp + fsync + rename.
+
+``os.replace`` on the same filesystem is atomic, so a reader (or a crash)
+can only ever observe the old complete file or the new complete file —
+never a truncated hybrid. The fsync before the rename makes the CONTENT
+durable before the name flips; the directory fsync after makes the rename
+itself durable (a power cut between the two otherwise resurrects the old
+file, which is still a complete file — the invariant holds either way).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def fsync_dir(path: str | Path) -> None:
+    """Best-effort directory fsync (some filesystems refuse O_RDONLY dir
+    fsync; the rename is already atomic, so failure here only weakens
+    durability, not consistency)."""
+    try:
+        fd = os.open(str(path), os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str | Path, data: bytes) -> None:
+    """Write ``data`` to ``path`` such that ``path`` always holds either
+    its previous complete content or ``data`` in full."""
+    path = Path(path)
+    tmp = path.parent / f".{path.name}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        tmp.unlink(missing_ok=True)
+        raise
+    fsync_dir(path.parent)
+
+
+def atomic_write_text(path: str | Path, text: str) -> None:
+    atomic_write_bytes(path, text.encode())
+
+
+def atomic_save_npy(path: str | Path, arr) -> None:
+    """np.save with the tmp+fsync+rename discipline (np.save to the final
+    path directly can leave a truncated .npy on crash/ENOSPC)."""
+    import io
+
+    import numpy as np
+
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    atomic_write_bytes(path, buf.getvalue())

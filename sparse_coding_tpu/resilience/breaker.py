@@ -1,0 +1,137 @@
+"""Circuit breaker for the serving dispatch path.
+
+Classic three-state machine, host-side Python only (the serving metrics
+doctrine: instrumentation must never touch jax):
+
+- **closed**: all traffic flows; ``failure_threshold`` CONSECUTIVE
+  dispatch failures open the circuit.
+- **open**: every dispatch (and, via the engine's admission check, every
+  submit) fails fast with a typed error instead of queueing work a sick
+  backend cannot serve — bounded load shedding, no wedged queue.
+- **half_open**: after ``reset_timeout_s`` one probe dispatch is let
+  through; success closes the circuit, failure re-opens it (and restarts
+  the cooldown). Only one probe is ever in flight.
+
+The clock is injectable so tests drive the cooldown deterministically;
+``on_transition`` lets the engine mirror every state change into
+``serve/metrics.py`` snapshots.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+TRANSITION_HISTORY = 256  # bounded: a flapping breaker must not grow RAM
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Optional[Callable[[str, str], None]] = None):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self._threshold = int(failure_threshold)
+        self._reset_s = float(reset_timeout_s)
+        self._clock = clock
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._probe_in_flight = False
+        self._transitions: deque[str] = deque(maxlen=TRANSITION_HISTORY)
+        self._n_transitions = 0
+
+    def set_on_transition(self,
+                          cb: Optional[Callable[[str, str], None]]) -> None:
+        """Attach/replace the transition mirror (the serving engine wires
+        this to ServingMetrics.record_breaker_transition)."""
+        with self._lock:
+            self._on_transition = cb
+
+    # -- state machine --------------------------------------------------------
+
+    def _move(self, new: str) -> None:
+        # lock held by caller
+        old, self._state = self._state, new
+        self._transitions.append(f"{old}->{new}")
+        self._n_transitions += 1
+        if self._on_transition is not None:
+            self._on_transition(old, new)
+
+    def allow(self) -> bool:
+        """May a dispatch proceed right now? In OPEN past the cooldown
+        this admits exactly one probe and moves to HALF_OPEN."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self._clock() - self._opened_at >= self._reset_s:
+                    self._move(HALF_OPEN)
+                    self._probe_in_flight = True
+                    return True
+                return False
+            # HALF_OPEN: only the single in-flight probe
+            if not self._probe_in_flight:
+                self._probe_in_flight = True
+                return True
+            return False
+
+    def admission_allowed(self) -> bool:
+        """Non-mutating submit-time check: shed new work only while the
+        circuit is OPEN and the cooldown has not elapsed (a probe-eligible
+        or half-open circuit still admits, so recovery traffic exists)."""
+        with self._lock:
+            return not (self._state == OPEN
+                        and self._clock() - self._opened_at < self._reset_s)
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probe_in_flight = False
+            if self._state != CLOSED:
+                self._move(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            self._probe_in_flight = False
+            if self._state == HALF_OPEN or (
+                    self._state == CLOSED
+                    and self._consecutive_failures >= self._threshold):
+                self._opened_at = self._clock()
+                self._move(OPEN)
+            elif self._state == OPEN:
+                # failures while open (e.g. a raced dispatch) restart the
+                # cooldown — a sick backend gets its full quiet period
+                self._opened_at = self._clock()
+
+    # -- read side ------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def seconds_until_probe(self) -> float:
+        with self._lock:
+            if self._state != OPEN:
+                return 0.0
+            return max(0.0, self._reset_s - (self._clock() - self._opened_at))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self._state,
+                    "consecutive_failures": self._consecutive_failures,
+                    "failure_threshold": self._threshold,
+                    "reset_timeout_s": self._reset_s,
+                    "n_transitions": self._n_transitions,
+                    "transitions": list(self._transitions)}

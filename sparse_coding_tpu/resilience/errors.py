@@ -1,0 +1,42 @@
+"""Typed failure taxonomy for the resilience layer.
+
+Every hardened subsystem converts low-level failures into one of these
+types at its boundary, so callers can distinguish "data is damaged"
+(corruption — do NOT retry, fall back or quarantine) from "the operation
+hiccupped" (transient I/O — bounded retry, resilience/retry.py) from "we
+were asked to stop" (preemption — checkpoint and exit cleanly,
+resilience/preempt.py).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+
+class ResilienceError(RuntimeError):
+    """Base class for typed resilience-layer failures."""
+
+
+class ChunkCorruptionError(ResilienceError):
+    """A chunk file's content does not match the digest recorded in
+    meta.json at finalize (or the file is structurally unreadable).
+    Names the chunk index so operators can delete/re-harvest exactly one
+    chunk; ``ChunkStore(quarantine_corrupt=True)`` readers skip it."""
+
+    def __init__(self, chunk_index: int, path: str | Path, reason: str):
+        super().__init__(
+            f"chunk {chunk_index} corrupt at {path}: {reason}")
+        self.chunk_index = int(chunk_index)
+        self.path = Path(path)
+        self.reason = reason
+
+
+class CheckpointCorruptionError(ResilienceError):
+    """A checkpoint payload fails its digest manifest (or cannot be
+    deserialized). ``train/sweep.py::resume_sweep_state`` reacts by
+    falling back to the ``ckpt_prev/`` last-good set."""
+
+    def __init__(self, path: str | Path, reason: str):
+        super().__init__(f"checkpoint corrupt at {path}: {reason}")
+        self.path = Path(path)
+        self.reason = reason

@@ -1,0 +1,289 @@
+"""Deterministic fault injection: named sites, Nth-hit trigger plans.
+
+Production failures (torn writes, bit flips, flaky I/O, dispatch errors)
+are rare and non-reproducible; the handlers that survive them rot unless
+they are exercised on every CI run. This module gives every failure-prone
+operation a **named fault site** — a single `fault_point(site, payload)`
+call on its hot path — and lets a test (or an operator, via the
+``SPARSE_CODING_FAULT_PLAN`` env var) install a :class:`FaultPlan` that
+fires a chosen fault on exactly the Nth hit of a site. Counting is
+per-plan and lock-protected, so a plan replays identically across runs
+and across the threaded serving path.
+
+Canonical sites (hosts register theirs at import; the canonical set is
+pre-registered here so env plans validate before any host module loads):
+
+====================  =====================================================
+``chunk.read``        ChunkStore._finish_raw — every chunk load, both the
+                      numpy and native-prefetch paths
+``chunk.write``       ChunkWriter._write — every chunk flush (inside the
+                      bounded-retry scope)
+``ckpt.save``         save_ensemble / save_pytree / orbax save
+``ckpt.restore``      restore_ensemble / restore_pytree / orbax restore
+``serve.dispatch``    ServingEngine.run_padded — immediately before the
+                      compiled device call
+``lock.acquire``      bench.py tunnel-flock acquisition attempt
+====================  =====================================================
+
+Plan syntax (``SPARSE_CODING_FAULT_PLAN`` or :func:`parse_fault_plan`):
+
+- compact: ``site:key=val,key=val`` entries joined by ``;`` —
+  ``"chunk.read:nth=3,mode=error,error=OSError;serve.dispatch:nth=1,count=4"``
+- JSON: a list of spec objects with the same keys.
+
+Spec keys: ``nth`` (1-based hit that first fires, default 1), ``count``
+(how many consecutive hits fire, default 1; 0 = every hit from nth on),
+``mode`` (``error`` raises a typed injected exception; ``corrupt``
+bit-flips the payload an array/bytes site passes through), ``error``
+(exception class name for mode=error), ``message``, ``seed`` (byte offset
+selector for mode=corrupt).
+
+Injected exceptions subclass BOTH the requested builtin (so real handlers
+— retry loops, breakers — treat them exactly like the genuine failure)
+and :class:`InjectedFault` (so tests can assert the failure was ours).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+ENV_VAR = "SPARSE_CODING_FAULT_PLAN"
+
+# site name -> one-line description; hosts add theirs via register_fault_site
+FAULT_SITES: dict[str, str] = {
+    "chunk.read": "chunk store read (numpy and native-prefetch paths)",
+    "chunk.write": "chunk store write/flush",
+    "ckpt.save": "checkpoint save (msgpack and orbax backends)",
+    "ckpt.restore": "checkpoint restore (msgpack and orbax backends)",
+    "serve.dispatch": "serving engine compiled-program dispatch",
+    "lock.acquire": "tunnel flock acquisition attempt",
+}
+
+
+def register_fault_site(name: str, description: str) -> str:
+    """Idempotently register a fault site (host modules call this at
+    import so the registry documents every live site)."""
+    FAULT_SITES[name] = description
+    return name
+
+
+class InjectedFault(Exception):
+    """Marker mixin: every exception raised by fault injection carries
+    this base, so tests can tell injected failures from genuine ones."""
+
+
+_ERROR_BASES: dict[str, type] = {
+    "OSError": OSError,
+    "IOError": OSError,
+    "RuntimeError": RuntimeError,
+    "ValueError": ValueError,
+    "TimeoutError": TimeoutError,
+    "ConnectionError": ConnectionError,
+    "MemoryError": MemoryError,
+}
+_injected_types: dict[type, type] = {}
+
+
+def _injected_type(base: type) -> type:
+    t = _injected_types.get(base)
+    if t is None:
+        t = type(f"Injected{base.__name__}", (InjectedFault, base), {})
+        _injected_types[base] = t
+    return t
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One fault: fires on hits ``nth .. nth+count-1`` of ``site``."""
+
+    site: str
+    nth: int = 1
+    count: int = 1
+    mode: str = "error"  # "error" | "corrupt"
+    error: str = "OSError"
+    message: str = "injected fault"
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.site not in FAULT_SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r} "
+                f"(registered: {sorted(FAULT_SITES)})")
+        if self.mode not in ("error", "corrupt"):
+            raise ValueError(f"unknown fault mode {self.mode!r}")
+        if self.mode == "error" and self.error not in _ERROR_BASES:
+            raise ValueError(
+                f"unknown error type {self.error!r} "
+                f"(supported: {sorted(_ERROR_BASES)})")
+        if self.nth < 1:
+            raise ValueError("nth is 1-based and must be >= 1")
+        if self.count < 0:
+            raise ValueError("count must be >= 0 (0 = every hit from nth)")
+
+    def fires_on(self, hit: int) -> bool:
+        if hit < self.nth:
+            return False
+        return self.count == 0 or hit < self.nth + self.count
+
+    def build_error(self) -> BaseException:
+        return _injected_type(_ERROR_BASES[self.error])(
+            f"{self.message} [site={self.site}]")
+
+
+@dataclass
+class FaultPlan:
+    """An installed set of :class:`FaultSpec`s with per-site hit counters.
+
+    Deterministic: hit k of a site fires iff some spec covers k,
+    independent of wall clock, interleaving, or prior runs. ``fired``
+    records every (site, hit_index) that triggered, for assertions."""
+
+    specs: list[FaultSpec] = field(default_factory=list)
+    hits: dict[str, int] = field(default_factory=dict)
+    fired: list[tuple[str, int]] = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def hit(self, site: str) -> Optional[FaultSpec]:
+        with self._lock:
+            n = self.hits.get(site, 0) + 1
+            self.hits[site] = n
+            for spec in self.specs:
+                if spec.site == site and spec.fires_on(n):
+                    self.fired.append((site, n))
+                    return spec
+        return None
+
+    def fired_count(self, site: str) -> int:
+        with self._lock:
+            return sum(1 for s, _ in self.fired if s == site)
+
+
+_active: Optional[FaultPlan] = None
+_env_checked = False
+_install_lock = threading.Lock()
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The installed plan; lazily loads ``SPARSE_CODING_FAULT_PLAN`` from
+    the environment exactly once if nothing was installed in code."""
+    global _active, _env_checked
+    if _active is None and not _env_checked:
+        with _install_lock:
+            if _active is None and not _env_checked:
+                text = os.environ.get(ENV_VAR, "").strip()
+                if text:
+                    _active = parse_fault_plan(text)
+                _env_checked = True
+    return _active
+
+
+def install_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install (or with None, clear) the active plan; returns the previous
+    one. Also re-arms the env lookup so clearing in tests is hermetic."""
+    global _active, _env_checked
+    with _install_lock:
+        prev, _active = _active, plan
+        _env_checked = True  # explicit install wins over the env var
+    return prev
+
+
+def reload_from_env() -> Optional[FaultPlan]:
+    """Force a re-parse of ``SPARSE_CODING_FAULT_PLAN`` and return the
+    newly-installed plan (tests; operators changing the plan between runs
+    never need this — a fresh process parses lazily)."""
+    text = os.environ.get(ENV_VAR, "").strip()
+    plan = parse_fault_plan(text) if text else None
+    install_plan(plan)
+    return plan
+
+
+class inject:
+    """Context manager: install a plan for the block, restore the previous
+    plan after. ``inject(FaultSpec(...), ...)`` or keyword shorthand
+    ``inject(site="chunk.read", nth=2)`` for a single spec. The plan
+    object is available as the ``as`` target for fired-count asserts."""
+
+    def __init__(self, *specs: FaultSpec, **one_spec):
+        if one_spec:
+            specs = specs + (FaultSpec(**one_spec),)
+        self.plan = FaultPlan(specs=list(specs))
+        self._prev: Optional[FaultPlan] = None
+
+    def __enter__(self) -> FaultPlan:
+        self._prev = install_plan(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc) -> None:
+        install_plan(self._prev)
+
+
+def _corrupt_payload(payload, spec: FaultSpec):
+    """Deterministically flip one bit of an array/bytes payload (the
+    ``seed`` selects the byte). Sites that pass no payload cannot host a
+    corrupt-mode fault — that is a plan bug, so fail loudly."""
+    import numpy as np
+
+    if payload is None:
+        raise ValueError(
+            f"fault site {spec.site!r} carries no payload; mode=corrupt "
+            "is only valid at data-bearing sites (use mode=error)")
+    if isinstance(payload, (bytes, bytearray)):
+        buf = bytearray(payload)
+        buf[spec.seed % len(buf)] ^= 0x01
+        return bytes(buf)
+    arr = np.array(payload, copy=True)
+    flat = arr.view(np.uint8).reshape(-1)
+    flat[spec.seed % flat.size] ^= 0x01
+    return arr
+
+
+def fault_point(site: str, payload=None):
+    """The single injection hook every hardened path calls. Returns the
+    payload (possibly corrupted by an active corrupt-mode fault); raises
+    the injected exception for error-mode faults. Near-zero cost when no
+    plan is active."""
+    plan = active_plan()
+    if plan is None:
+        return payload
+    spec = plan.hit(site)
+    if spec is None:
+        return payload
+    if spec.mode == "error":
+        raise spec.build_error()
+    return _corrupt_payload(payload, spec)
+
+
+def parse_fault_plan(text: str) -> FaultPlan:
+    """Parse the env-var / CLI plan syntax (JSON list or compact
+    ``site:key=val,...;site2:...`` string) into a validated plan."""
+    text = text.strip()
+    specs: list[FaultSpec] = []
+    if text.startswith("[") or text.startswith("{"):
+        raw = json.loads(text)
+        if isinstance(raw, dict):
+            raw = [raw]
+        for entry in raw:
+            specs.append(FaultSpec(**entry))
+        return FaultPlan(specs=specs)
+    for entry in text.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, _, rest = entry.partition(":")
+        kwargs: dict = {"site": site.strip()}
+        for pair in filter(None, (p.strip() for p in rest.split(","))):
+            key, _, val = pair.partition("=")
+            if not _ or key not in ("nth", "count", "mode", "error",
+                                    "message", "seed"):
+                raise ValueError(
+                    f"bad fault-plan pair {pair!r} in entry {entry!r} "
+                    "(expected key=value with key in nth/count/mode/"
+                    "error/message/seed)")
+            kwargs[key] = (int(val) if key in ("nth", "count", "seed")
+                           else val)
+        specs.append(FaultSpec(**kwargs))
+    return FaultPlan(specs=specs)

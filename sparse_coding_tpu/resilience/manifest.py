@@ -1,0 +1,99 @@
+"""Content digests and checkpoint digest manifests.
+
+- :func:`array_sha256` / :func:`bytes_sha256`: the digest primitive the
+  chunk store and msgpack checkpoint backend record at write time and
+  verify at read time.
+- :func:`write_dir_manifest` / :func:`verify_dir_manifest`: a sidecar
+  JSON mapping every file under a directory tree (the orbax checkpoint
+  dir) to its sha256 + size, written AFTER the backend's own commit is
+  durable. Restore verifies the manifest before handing the directory to
+  orbax, turning silent shard corruption into a typed
+  :class:`~sparse_coding_tpu.resilience.errors.CheckpointCorruptionError`
+  that the sweep's resume path can fall back from.
+
+The manifest lives NEXT TO the checkpoint directory (``<dir>.manifest
+.json``), never inside it — orbax owns its directory contents.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+import numpy as np
+
+from sparse_coding_tpu.resilience.atomic import atomic_write_text
+from sparse_coding_tpu.resilience.errors import CheckpointCorruptionError
+
+MANIFEST_SUFFIX = ".manifest.json"
+
+
+def bytes_sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def array_sha256(arr) -> str:
+    """Digest of an array's raw C-order bytes — identical whether the
+    array came from np.load, the native pread path, or the writer's
+    pre-save buffer, so one recorded digest covers every read path."""
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+def file_sha256(path: str | Path, block: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(block)
+            if not chunk:
+                return h.hexdigest()
+            h.update(chunk)
+
+
+def manifest_path(target: str | Path) -> Path:
+    target = Path(target)
+    return target.parent / (target.name + MANIFEST_SUFFIX)
+
+
+def write_dir_manifest(target: str | Path) -> Path:
+    """Record sha256+size of every file under ``target`` (recursive) into
+    the ``<target>.manifest.json`` sidecar. Call only once the backend's
+    own write is durable (e.g. after orbax wait_until_finished)."""
+    target = Path(target)
+    files = sorted(p for p in target.rglob("*") if p.is_file())
+    entries = {
+        str(p.relative_to(target)): {"sha256": file_sha256(p),
+                                     "size": p.stat().st_size}
+        for p in files}
+    out = manifest_path(target)
+    atomic_write_text(out, json.dumps({"files": entries}, indent=2))
+    return out
+
+
+def verify_dir_manifest(target: str | Path) -> bool:
+    """Verify ``target`` against its sidecar manifest. Returns False when
+    no manifest exists (pre-manifest checkpoint — nothing to verify);
+    raises :class:`CheckpointCorruptionError` naming the first damaged or
+    missing file otherwise."""
+    target = Path(target)
+    side = manifest_path(target)
+    if not side.exists():
+        return False
+    try:
+        entries = json.loads(side.read_text())["files"]
+    except (ValueError, KeyError) as e:
+        raise CheckpointCorruptionError(target,
+                                        f"unreadable manifest: {e}") from e
+    for rel, want in entries.items():
+        p = target / rel
+        if not p.exists():
+            raise CheckpointCorruptionError(target,
+                                            f"manifest file missing: {rel}")
+        if p.stat().st_size != want["size"]:
+            raise CheckpointCorruptionError(
+                target, f"size mismatch for {rel}: "
+                f"{p.stat().st_size} != {want['size']}")
+        if file_sha256(p) != want["sha256"]:
+            raise CheckpointCorruptionError(target,
+                                            f"digest mismatch for {rel}")
+    return True

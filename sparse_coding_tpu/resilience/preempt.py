@@ -1,0 +1,73 @@
+"""Cooperative SIGTERM preemption for long unattended sweeps.
+
+Preemptible capacity (and the unattended tunnel-recovery loop) delivers
+SIGTERM, not SIGKILL — a window to save and exit. The guard converts the
+signal into a flag the sweep polls at chunk boundaries: the chunk is the
+unit of resumable work (the data-order RNG is checkpointed per chunk), so
+finishing the in-flight chunk, checkpointing, and raising
+:class:`SweepPreempted` continues BITWISE-identically on resume — the
+same guarantee as the crash-resume path (docs/ARCHITECTURE.md §4), now
+exercised on the graceful-shutdown path too.
+
+Signal handlers are process-global and main-thread-only; the guard
+restores the previous handler on exit and degrades to a purely
+cooperative flag (``request()``) off the main thread.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional
+
+
+class SweepPreempted(RuntimeError):
+    """Raised by ``train/sweep.py`` after a preemption-triggered
+    checkpoint completed: state through ``chunks_done`` chunks is durable
+    and ``sweep(..., resume=True)`` continues exactly. The CLI treats
+    this as a clean (exit-0) shutdown."""
+
+    def __init__(self, chunks_done: int):
+        super().__init__(
+            f"sweep preempted: checkpointed after chunk {chunks_done}; "
+            f"resume with resume=True")
+        self.chunks_done = int(chunks_done)
+
+
+class PreemptionGuard:
+    """Context manager installing a SIGTERM (by default) flag handler."""
+
+    def __init__(self, signals: tuple = (signal.SIGTERM,)):
+        self._signals = signals
+        self._event = threading.Event()
+        self._previous: dict[int, object] = {}
+        self._installed = False
+
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is threading.main_thread():
+            for sig in self._signals:
+                self._previous[sig] = signal.signal(sig, self._handle)
+            self._installed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._installed:
+            for sig, prev in self._previous.items():
+                signal.signal(sig, prev)
+            self._previous.clear()
+            self._installed = False
+
+    def _handle(self, signum, frame) -> None:
+        self._event.set()
+
+    def request(self) -> None:
+        """Cooperative trigger (tests, embedding frameworks with their own
+        signal plumbing)."""
+        self._event.set()
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def signal_received(self) -> Optional[bool]:
+        return self._event.is_set()

@@ -1,0 +1,37 @@
+"""Bounded retry-with-backoff for transient I/O.
+
+One policy, used by every hardened host path (chunk reads/writes, the
+serving dispatch retry loop supplies its own budget on top). Deliberately
+tiny: retries are for *transient* failures only — corruption
+(:class:`~sparse_coding_tpu.resilience.errors.ChunkCorruptionError`,
+``CheckpointCorruptionError``) must never be retried, so those types are
+excluded by construction via ``retry_on``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+TRANSIENT_IO_ERRORS: tuple[type, ...] = (OSError,)  # incl. Timeout/Connection
+
+
+def retry_io(fn: Callable, *, attempts: int = 3, base_delay_s: float = 0.01,
+             retry_on: Sequence[type] = TRANSIENT_IO_ERRORS,
+             sleep: Callable[[float], None] = time.sleep,
+             on_retry: Optional[Callable[[int, BaseException], None]] = None):
+    """Call ``fn()`` with up to ``attempts`` tries and exponential backoff
+    (``base_delay_s * 2**i`` between tries). The last failure propagates
+    unchanged — bounded means bounded, no infinite-retry hangs."""
+    if attempts < 1:
+        raise ValueError("attempts must be >= 1")
+    retry_on = tuple(retry_on)
+    for i in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — the loop IS the policy
+            if i == attempts - 1:
+                raise
+            if on_retry is not None:
+                on_retry(i, e)
+            sleep(base_delay_s * (2 ** i))

@@ -14,21 +14,37 @@ a high-throughput offline scorer, built from four pieces:
   recompile counter (must stay 0 after warmup).
 - :mod:`offline`   — batch scorer reusing the same compiled buckets.
 
+Dispatch is hardened (docs/ARCHITECTURE.md §10): typed per-request
+errors, a per-stream retry budget for transient failures, and a circuit
+breaker (``resilience.CircuitBreaker``) that sheds load while the backend
+is sick — all driven deterministically in CI via the ``serve.dispatch``
+fault site.
+
 See docs/ARCHITECTURE.md §8 for design rationale.
 """
 
+from sparse_coding_tpu.resilience.breaker import CircuitBreaker
 from sparse_coding_tpu.serve.batching import (
+    CircuitOpenError,
+    DispatchError,
     QueueFullError,
     RequestTooLargeError,
     ServeError,
     ServeFuture,
 )
-from sparse_coding_tpu.serve.engine import ServingEngine, bucket_op_fn
+from sparse_coding_tpu.serve.engine import (
+    ServingEngine,
+    bucket_op_fn,
+    build_bucket_program,
+)
 from sparse_coding_tpu.serve.metrics import ServingMetrics
 from sparse_coding_tpu.serve.offline import score_offline
 from sparse_coding_tpu.serve.registry import ModelRegistry, RegistryEntry
 
 __all__ = [
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "DispatchError",
     "ModelRegistry",
     "RegistryEntry",
     "ServingEngine",
@@ -38,5 +54,6 @@ __all__ = [
     "QueueFullError",
     "RequestTooLargeError",
     "bucket_op_fn",
+    "build_bucket_program",
     "score_offline",
 ]

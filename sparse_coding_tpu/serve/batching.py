@@ -62,6 +62,34 @@ class RequestTooLargeError(ServeError):
         self.max_rows = max_rows
 
 
+class DispatchError(ServeError):
+    """One flush's dispatch failed after exhausting its retry budget; only
+    THAT flush's requests carry this error — the worker thread and every
+    other queued request are unaffected. ``cause`` is the underlying
+    exception; ``key`` names the (model, op) stream."""
+
+    def __init__(self, key: tuple, cause: BaseException):
+        model, op = key
+        super().__init__(
+            f"dispatch failed for {model!r}/{op}: {cause!r}")
+        self.key = key
+        self.cause = cause
+
+
+class CircuitOpenError(ServeError):
+    """The dispatch circuit breaker is open: the backend failed repeatedly
+    and new work is being shed instead of queued behind a sick device.
+    Retry after ``retry_after_s`` (the breaker's remaining cooldown)."""
+
+    def __init__(self, key: tuple, retry_after_s: float):
+        model, op = key
+        super().__init__(
+            f"circuit open for {model!r}/{op}: backend failing; retry in "
+            f"~{retry_after_s:.2f}s")
+        self.key = key
+        self.retry_after_s = retry_after_s
+
+
 class ServeFuture:
     """Synchronization handle for one in-flight request."""
 
@@ -214,6 +242,11 @@ class MicroBatcher:
                 return key, reqs, deadline_hit and rows < self._max_rows
 
     def _loop(self) -> None:
+        # worker-survival contract: NO exception from the dispatch callback
+        # may escape this loop — it would kill the only drain thread and
+        # strand every queued result() waiter until timeout. A failed flush
+        # marks exactly its own requests failed (typed) and the worker
+        # moves on to the next batch.
         while True:
             popped = self._pop_batch()
             if popped is None:
@@ -222,6 +255,11 @@ class MicroBatcher:
             try:
                 self._dispatch(key, reqs, deadline_flush)
             except BaseException as e:  # noqa: BLE001 — fan the error out
+                err = e if isinstance(e, ServeError) else DispatchError(key, e)
+                n = 0
                 for r in reqs:
                     if not r.future.done():
-                        r.future._set_error(e)
+                        r.future._set_error(err)
+                        n += 1
+                if n:
+                    self._metrics.record_request_errors(n, type(err).__name__)

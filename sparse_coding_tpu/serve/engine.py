@@ -39,7 +39,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparse_coding_tpu.resilience.breaker import CircuitBreaker
+from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
 from sparse_coding_tpu.serve.batching import (
+    CircuitOpenError,
+    DispatchError,
     MicroBatcher,
     Request,
     RequestTooLargeError,
@@ -51,6 +55,15 @@ from sparse_coding_tpu.serve.registry import ModelRegistry, RegistryEntry
 
 DEFAULT_BUCKETS = (8, 64, 512)
 DEFAULT_OPS = ("encode", "decode", "topk")
+
+register_fault_site("serve.dispatch",
+                    "ServingEngine.run_padded — immediately before the "
+                    "compiled device call")
+
+# transient dispatch failures (worth a retry / distinct from a poisoned
+# request): the I/O family — the tunnel path surfaces flaky transport as
+# OSError subclasses. Everything else fails the flush immediately.
+TRANSIENT_DISPATCH_ERRORS = (OSError, TimeoutError, ConnectionError)
 
 
 def bucket_op_fn(op: str, k: int | None = None):
@@ -77,6 +90,27 @@ def bucket_op_fn(op: str, k: int | None = None):
                      f"(supported: encode, decode, predict, topk)")
 
 
+def op_width(entry: RegistryEntry, op: str) -> int:
+    """Input width of one op's program: the SINGLE home of the width rule,
+    shared by submit-time validation and program compilation so the two
+    can never drift."""
+    return entry.n_feats if op == "decode" else entry.d_activation
+
+
+def build_bucket_program(entry: RegistryEntry, op: str, bucket: int,
+                         dtype, topk_k: int):
+    """(fn, input spec) for one (entry, op, bucket) program — the exact
+    function+shape the engine AOT-compiles. Module-level so
+    tests/test_tpu_lowering.py lowers the hardened dispatch path's real
+    programs rather than a reconstruction."""
+    fn = bucket_op_fn(op, k=min(topk_k, entry.n_feats))
+    if entry.is_stack:
+        fn = jax.vmap(fn, in_axes=(0, None))
+    spec = jax.ShapeDtypeStruct((bucket, op_width(entry, op)),
+                                jnp.dtype(dtype))
+    return fn, spec
+
+
 class ServingEngine:
     """Request-driven feature extraction over a :class:`ModelRegistry`.
 
@@ -94,7 +128,13 @@ class ServingEngine:
                  max_queue_rows: int = 8192,
                  donate: bool | None = None,
                  dtype=jnp.float32,
-                 latency_window: int = 4096):
+                 latency_window: int = 4096,
+                 breaker: CircuitBreaker | None = None,
+                 breaker_threshold: int = 5,
+                 breaker_reset_s: float = 5.0,
+                 dispatch_retries: int = 2,
+                 stream_retry_budget: int = 16,
+                 retry_backoff_s: float = 0.002):
         if not buckets or list(buckets) != sorted(set(buckets)):
             raise ValueError(f"buckets must be unique ascending: {buckets}")
         self._registry = registry
@@ -108,6 +148,21 @@ class ServingEngine:
         self._donate = (jax.default_backend() == "tpu"
                         if donate is None else bool(donate))
         self.metrics = ServingMetrics(latency_window=latency_window)
+        # dispatch resilience (docs/ARCHITECTURE.md §10): transient
+        # failures retry against a per-stream budget (refilled on
+        # success); consecutive failures trip the breaker, which sheds
+        # load at BOTH ends — submit refuses new work, the worker fails
+        # queued flushes fast — until a half-open probe heals it
+        self._dispatch_retries = int(dispatch_retries)
+        self._stream_retry_budget = int(stream_retry_budget)
+        self._retry_backoff_s = float(retry_backoff_s)
+        self._retry_tokens: dict[tuple, int] = {}
+        self._retry_lock = threading.Lock()
+        self._breaker = breaker if breaker is not None else CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            reset_timeout_s=breaker_reset_s)
+        # mirror every breaker transition into the metrics snapshot
+        self._breaker.set_on_transition(self.metrics.record_breaker_transition)
         self._compiled: dict[tuple, Any] = {}
         self._compile_lock = threading.Lock()
         self._warmed = False
@@ -162,6 +217,13 @@ class ServingEngine:
         if op not in self._ops:
             raise ValueError(f"op {op!r} not served (engine ops: "
                              f"{self._ops})")
+        if not self._breaker.admission_allowed():
+            # graceful load shedding: while the circuit is open there is
+            # no point queueing work behind a sick backend — refuse at
+            # admission with the cooldown as a retry hint
+            self.metrics.record_shed()
+            raise CircuitOpenError((model, op),
+                                   self._breaker.seconds_until_probe())
         arr = np.asarray(x, dtype=self._np_dtype)
         squeeze = arr.ndim == 1
         if squeeze:
@@ -198,12 +260,13 @@ class ServingEngine:
         snap = self.metrics.snapshot()
         snap["warmed"] = self._warmed
         snap["compiled_programs"] = len(self._compiled)
+        snap["breaker"] = self._breaker.snapshot()
         return snap
 
     # -- compiled-program cache ----------------------------------------------
 
     def _op_width(self, entry: RegistryEntry, op: str) -> int:
-        return entry.n_feats if op == "decode" else entry.d_activation
+        return op_width(entry, op)
 
     def _bucket_for(self, rows: int) -> int:
         i = bisect.bisect_left(self._buckets, rows)
@@ -212,11 +275,8 @@ class ServingEngine:
         return self._buckets[i]
 
     def _compile(self, entry: RegistryEntry, op: str, bucket: int):
-        fn = bucket_op_fn(op, k=min(self._topk_k, entry.n_feats))
-        if entry.is_stack:
-            fn = jax.vmap(fn, in_axes=(0, None))
-        spec = jax.ShapeDtypeStruct((bucket, self._op_width(entry, op)),
-                                    self._dtype)
+        fn, spec = build_bucket_program(entry, op, bucket, self._dtype,
+                                        self._topk_k)
         donate = (1,) if self._donate else ()
         return (jax.jit(fn, donate_argnums=donate)
                 .lower(entry.tree, spec).compile())
@@ -250,28 +310,68 @@ class ServingEngine:
             pad[:rows] = x
             x = pad
         compiled = self._get_compiled(model, op, bucket)
+        fault_point("serve.dispatch")
         out = compiled(self._registry.get(model).tree, jnp.asarray(x))
         rows_axis = 1 if self._registry.get(model).is_stack else 0
         sl = (slice(None),) * rows_axis + (slice(0, rows),)
         host = jax.tree.map(lambda a: np.asarray(a)[sl], out)
         return bucket, host
 
+    def _take_retry_token(self, key: tuple) -> bool:
+        with self._retry_lock:
+            left = self._retry_tokens.get(key, self._stream_retry_budget)
+            if left <= 0:
+                return False
+            self._retry_tokens[key] = left - 1
+            return True
+
+    def _refill_retry_budget(self, key: tuple) -> None:
+        with self._retry_lock:
+            self._retry_tokens[key] = self._stream_retry_budget
+
+    def _fail_requests(self, requests: list[Request],
+                       err: ServeError) -> None:
+        self.metrics.record_request_errors(len(requests), type(err).__name__)
+        for r in requests:
+            if not r.future.done():
+                r.future._set_error(err)
+
     def _dispatch(self, key: tuple, requests: list[Request],
                   deadline_flush: bool) -> None:
         model, op = key
+        if not self._breaker.allow():
+            # fail-fast drain while the circuit is open: the queue keeps
+            # moving (no wedge) and nothing touches the sick backend
+            self.metrics.record_shed(len(requests))
+            self._fail_requests(requests, CircuitOpenError(
+                key, self._breaker.seconds_until_probe()))
+            return
         rows = sum(r.rows for r in requests)
         if len(requests) == 1:
             x = requests[0].x
         else:
             x = np.concatenate([r.x for r in requests], axis=0)
-        try:
-            bucket, host = self.run_padded(model, op, x)
-        except BaseException as e:  # noqa: BLE001 — typed fan-out
-            err = e if isinstance(e, ServeError) else ServeError(
-                f"dispatch failed for {model!r}/{op}: {e!r}")
-            for r in requests:
-                r.future._set_error(err)
-            return
+        attempt = 0
+        while True:
+            try:
+                bucket, host = self.run_padded(model, op, x)
+                break
+            except BaseException as e:  # noqa: BLE001 — typed fan-out
+                transient = (isinstance(e, TRANSIENT_DISPATCH_ERRORS)
+                             and not isinstance(e, ServeError))
+                if (transient and attempt < self._dispatch_retries
+                        and self._take_retry_token(key)):
+                    attempt += 1
+                    self.metrics.record_dispatch_retry()
+                    time.sleep(self._retry_backoff_s * attempt)
+                    continue
+                self._breaker.record_failure()
+                self.metrics.record_dispatch_failure()
+                err = e if isinstance(e, ServeError) else DispatchError(key, e)
+                self._fail_requests(requests, err)
+                return
+        self._breaker.record_success()
+        self._refill_retry_budget(key)
         self.metrics.record_batch(bucket, len(requests), rows,
                                   deadline_flush)
         rows_axis = 1 if self._registry.get(model).is_stack else 0

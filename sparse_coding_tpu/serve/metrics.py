@@ -50,6 +50,19 @@ class ServingMetrics:
         self._queued_rows = 0
         self._max_queued_rows = 0
         self._submitted = 0
+        # resilience counters (docs/ARCHITECTURE.md §10): per-request error
+        # counts by type, dispatch retries/failures, shed requests, and the
+        # circuit breaker's current state + transition history — the
+        # snapshot is how an operator sees the breaker at all
+        self._request_errors: dict[str, int] = {}
+        self._dispatch_retries = 0
+        self._dispatch_failures = 0
+        self._shed_requests = 0
+        self._breaker_state = "closed"
+        # bounded mirror of the breaker's history: a flapping backend
+        # cycling open/half_open for days must not grow the snapshot
+        self._breaker_transitions: deque[str] = deque(maxlen=256)
+        self._breaker_n_transitions = 0
 
     # -- write side (engine / batcher) --------------------------------------
 
@@ -92,6 +105,31 @@ class ServingMetrics:
         with self._lock:
             self._recompiles += 1
             self._recompile_keys.append(key)
+
+    def record_request_errors(self, n: int, error_type: str) -> None:
+        """n requests in one flush failed with the given error type."""
+        with self._lock:
+            self._request_errors[error_type] = (
+                self._request_errors.get(error_type, 0) + n)
+
+    def record_dispatch_retry(self) -> None:
+        with self._lock:
+            self._dispatch_retries += 1
+
+    def record_dispatch_failure(self) -> None:
+        with self._lock:
+            self._dispatch_failures += 1
+
+    def record_shed(self, n: int = 1) -> None:
+        """n requests refused without device work (open breaker)."""
+        with self._lock:
+            self._shed_requests += n
+
+    def record_breaker_transition(self, old: str, new: str) -> None:
+        with self._lock:
+            self._breaker_state = new
+            self._breaker_transitions.append(f"{old}->{new}")
+            self._breaker_n_transitions += 1
 
     # -- read side -----------------------------------------------------------
 
@@ -137,4 +175,11 @@ class ServingMetrics:
                 "max_queue_depth_rows": self._max_queued_rows,
                 "recompiles": self._recompiles,
                 "recompile_keys": list(self._recompile_keys),
+                "request_errors": dict(self._request_errors),
+                "dispatch_retries": self._dispatch_retries,
+                "dispatch_failures": self._dispatch_failures,
+                "shed_requests": self._shed_requests,
+                "breaker_state": self._breaker_state,
+                "breaker_transitions": list(self._breaker_transitions),
+                "breaker_n_transitions": self._breaker_n_transitions,
             }

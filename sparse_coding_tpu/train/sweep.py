@@ -21,6 +21,7 @@ Flow (mirroring big_sweep.py:298-386):
 from __future__ import annotations
 
 import json
+import logging
 import shutil
 from pathlib import Path
 from typing import Callable, Optional, Sequence, Union
@@ -44,11 +45,15 @@ from sparse_coding_tpu.metrics.core import (
     mmcs_from_list,
 )
 from sparse_coding_tpu.parallel.mesh import batch_sharding, make_mesh
+from sparse_coding_tpu.resilience.errors import CheckpointCorruptionError
+from sparse_coding_tpu.resilience.preempt import PreemptionGuard, SweepPreempted
 from sparse_coding_tpu.utils.artifacts import save_learned_dicts
 from sparse_coding_tpu.utils.checkpoint import restore_ensemble, save_ensemble
 from sparse_coding_tpu.utils.orbax_ckpt import checkpoint_path
 from sparse_coding_tpu.utils.logging import MetricsLogger
 from sparse_coding_tpu.utils.profiling import StepTimer
+
+logger_mod = logging.getLogger(__name__)
 
 EnsembleLike = Union[Ensemble, EnsembleGroup]
 # ensemble_init_fn(cfg, mesh) -> list of (ensemble, per-member hyperparams, name)
@@ -112,6 +117,20 @@ def _ensembles_of(e: EnsembleLike) -> list[Ensemble]:
     return list(e.ensembles.values()) if isinstance(e, EnsembleGroup) else [e]
 
 
+def _agree_preempted(local_flag: bool) -> bool:
+    """Cross-host consensus on the preemption flag (identity single-host).
+    SIGTERM may reach only ONE process of a multi-host sweep; the
+    checkpoint branch below contains collective barriers, so every host
+    must take it (or not) together — any host preempted preempts all."""
+    if jax.process_count() == 1:
+        return local_flag
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray(local_flag, dtype=np.bool_))
+    return bool(np.any(flags))
+
+
 def _sync_hosts(tag: str) -> None:
     """Cross-host barrier (no-op single-host): checkpoint-set directory
     mutations are process-0-only, so every host must agree the set is
@@ -125,16 +144,18 @@ def _sync_hosts(tag: str) -> None:
 
 def _swap_in_checkpoint_set(out_dir: Path, staging: Path) -> None:
     """Rename-swap a COMPLETE staged checkpoint set into ckpt/. The old set
-    survives as ckpt_prev until the new one is in place, so a crash at any
-    instant leaves at least one complete consistent set (ADVICE r1 #5).
-    Multi-host callers gate this on process 0 + barriers."""
+    is RETAINED as ckpt_prev/: it covers both a crash at any instant during
+    the swap (at least one complete consistent set always exists, ADVICE r1
+    #5) and post-hoc corruption of ckpt/ — resume_sweep_state falls back to
+    it when the newest set fails its digest manifest (docs/ARCHITECTURE.md
+    §10), at the cost of one extra set on disk. Multi-host callers gate
+    this on process 0 + barriers."""
     ckpt_dir = out_dir / "ckpt"
     prev = out_dir / "ckpt_prev"
     if ckpt_dir.exists():
         shutil.rmtree(prev, ignore_errors=True)
         ckpt_dir.rename(prev)
     staging.rename(ckpt_dir)
-    shutil.rmtree(prev, ignore_errors=True)
 
 
 def _flat_dicts(e: EnsembleLike) -> list:
@@ -254,18 +275,30 @@ def sweep(
     todo = list(range(chunks_done, len(chunk_order)))
     reader = store.chunk_reader([int(chunk_order[ci]) for ci in todo],
                                 dtype=train_np_dtype)
+    # SIGTERM (preemptible capacity, the unattended recovery loop) sets a
+    # flag polled at chunk boundaries: the in-flight chunk finishes, a
+    # checkpoint set is forced regardless of cadence, and SweepPreempted
+    # propagates — resume=True then continues bitwise-identically
+    # (resilience/preempt.py; the graceful twin of the crash-resume path).
+    preempt = PreemptionGuard()
+    preempt.__enter__()  # paired in the finally (keeps the loop unindented)
     try:
         for ci, chunk in zip(todo, reader):
             # fresh throughput window per chunk: checkpoint/artifact wall
             # time between chunks must not dilute the training-rate signal
             timer.reset()
-            if center is not None:
+            if chunk is not None and center is not None:
                 # cast the mean down rather than the chunk up: keeps the
                 # bf16 path bf16 end to end (host RAM + host→device traffic
                 # halved). In place: load_chunk returns a fresh array, and
                 # out-of-place would briefly hold two full chunks in RAM
                 chunk -= center.astype(train_np_dtype)
-            batches = store.batches(chunk, cfg.batch_size, rng)
+            # chunk is None when the store quarantined it
+            # (quarantine_corrupt=True): no batches to train, but the
+            # boundary bookkeeping below (checkpoint cadence, preemption
+            # consensus) still runs at this ci so indices stay aligned
+            batches = (iter(()) if chunk is None
+                       else store.batches(chunk, cfg.batch_size, rng))
             if scan_k > 1:
                 batches = window_stacks(batches, scan_k)
                 window_sharding = (batch_sharding(mesh, stacked=True)
@@ -341,7 +374,13 @@ def sweep(
             # training; msgpack sets swap immediately.
             last_chunk = ci == len(chunk_order) - 1
             cadence = cfg.checkpoint_every_chunks
-            if (cadence > 0 and (ci + 1) % cadence == 0) or last_chunk:
+            # sample the preemption flag ONCE per boundary (a signal landing
+            # mid-checkpoint is honored at the next chunk's boundary) and
+            # agree on it cross-host BEFORE gating the barrier-containing
+            # branch — a host-local flag would desync the collectives
+            preempted = _agree_preempted(preempt.requested)
+            if ((cadence > 0 and (ci + 1) % cadence == 0) or last_chunk
+                    or preempted):
                 rng_state = rng.bit_generator.state
                 staging = out_dir / "ckpt_staging"
                 if pending_staging is not None:
@@ -374,16 +413,27 @@ def sweep(
                     pending_staging = staging
                 elif jax.process_index() == 0:
                     _swap_in_checkpoint_set(out_dir, staging)
-            if ci in save_points or ci == len(chunk_order) - 1:
+            if (ci in save_points or ci == len(chunk_order) - 1) \
+                    and chunk is not None:
                 _save_artifacts(ensembles, out_dir / f"_{ci}", chunk, cfg,
                                 logger,
                                 image_metrics=image_metrics_every is not None
                                 and (ci + 1) % image_metrics_every == 0)
+            if preempted and not last_chunk:
+                # checkpoint for chunks 0..ci is issued (and for msgpack
+                # already swapped in); exit cleanly so resume continues
+                raise SweepPreempted(ci + 1)
         clean_exit = True
+    except SweepPreempted:
+        # a preemption exit IS clean: the staged orbax set (if any) is
+        # fully issued and must be swapped in below like a normal finish
+        clean_exit = True
+        raise
     except BaseException:
         clean_exit = False
         raise
     finally:
+        preempt.__exit__(None, None, None)
         reader.close()  # release any in-flight native chunk read
         if profiling:
             # short sweeps / crashes inside the window: the trace is still
@@ -477,39 +527,20 @@ def main(argv=None) -> None:
 
     synthetic = _parse_value(ns.synthetic, bool)
     cfg = (SyntheticEnsembleArgs if synthetic else EnsembleArgs).from_cli(rest)
-    result = sweep(EXPERIMENTS[ns.experiment], cfg,
-                   resume=_parse_value(ns.resume, bool))
+    try:
+        result = sweep(EXPERIMENTS[ns.experiment], cfg,
+                       resume=_parse_value(ns.resume, bool))
+    except SweepPreempted as e:
+        # SIGTERM shutdown is a SUCCESS for the driver: state is durable,
+        # `--resume true` continues bitwise-identically
+        print(f"sweep: {e}")
+        return
     for name, dicts in result.items():
         print(f"{name}: {len(dicts)} dicts -> {cfg.output_folder}")
 
 
-def resume_sweep_state(ensembles: Sequence[tuple[EnsembleLike, list, str]],
-                       out_dir: str | Path) -> tuple[int, Optional[dict]]:
-    """Restore all ensembles from the newest COMPLETE checkpoint set; returns
-    (chunks_done, batch-rng bit-generator state) — (0, None) without
-    checkpoints. `ckpt/` only ever holds a consistent set (staged rename
-    swap); `ckpt_prev/` covers a crash inside the swap itself. Resuming uses
-    min(chunks_done) across the set as a final guard so no ensemble ever
-    skips a chunk it never trained on (ADVICE r1 #5)."""
-    out_dir = Path(out_dir)
-    ckpt_dir = out_dir / "ckpt"
-    if not ckpt_dir.exists():
-        ckpt_dir = out_dir / "ckpt_prev"
-
-    def find(name: str, j: int) -> Optional[Path]:
-        # either backend's file may be present (a sweep resumed after a
-        # checkpoint_backend change still restores the old set)
-        for p in (ckpt_dir / f"{name}_{j}.msgpack",
-                  checkpoint_path(ckpt_dir, f"{name}_{j}")):
-            if p.exists():
-                return p
-        return None
-
-    targets = [(sub, find(name, j))
-               for ensemble, hypers, name in ensembles
-               for j, sub in enumerate(_ensembles_of(ensemble))]
-    if not all(path is not None for _, path in targets):
-        return 0, None  # no/incomplete set: restart from scratch, untouched
+def _restore_checkpoint_set(
+        targets: Sequence[tuple[Ensemble, Path]]) -> tuple[int, Optional[dict]]:
     chunks_done: Optional[int] = None
     rng_state = None
     for sub, path in targets:
@@ -524,6 +555,52 @@ def resume_sweep_state(ensembles: Sequence[tuple[EnsembleLike, list, str]],
             chunks_done = done
             rng_state = meta.get("rng_state", rng_state)
     return (chunks_done or 0), rng_state
+
+
+def resume_sweep_state(ensembles: Sequence[tuple[EnsembleLike, list, str]],
+                       out_dir: str | Path) -> tuple[int, Optional[dict]]:
+    """Restore all ensembles from the newest COMPLETE checkpoint set; returns
+    (chunks_done, batch-rng bit-generator state) — (0, None) without
+    checkpoints. `ckpt/` only ever holds a consistent set (staged rename
+    swap); `ckpt_prev/` covers a crash inside the swap itself. Resuming uses
+    min(chunks_done) across the set as a final guard so no ensemble ever
+    skips a chunk it never trained on (ADVICE r1 #5).
+
+    Corruption fallback (docs/ARCHITECTURE.md §10): a set whose digest
+    manifest fails raises a typed CheckpointCorruptionError from the
+    backend; this walks back to the `ckpt_prev/` last-good set instead of
+    resuming from damaged state. Only when EVERY present set is corrupt
+    does the error propagate — never a silent restart-from-scratch."""
+    out_dir = Path(out_dir)
+    last_err: Optional[CheckpointCorruptionError] = None
+    for ckpt_dir in (out_dir / "ckpt", out_dir / "ckpt_prev"):
+        if not ckpt_dir.exists():
+            continue
+
+        def find(name: str, j: int) -> Optional[Path]:
+            # either backend's file may be present (a sweep resumed after a
+            # checkpoint_backend change still restores the old set)
+            for p in (ckpt_dir / f"{name}_{j}.msgpack",
+                      checkpoint_path(ckpt_dir, f"{name}_{j}")):
+                if p.exists():
+                    return p
+            return None
+
+        targets = [(sub, find(name, j))
+                   for ensemble, hypers, name in ensembles
+                   for j, sub in enumerate(_ensembles_of(ensemble))]
+        if not all(path is not None for _, path in targets):
+            continue  # incomplete set: fall through to the older set
+        try:
+            return _restore_checkpoint_set(targets)
+        except CheckpointCorruptionError as e:
+            last_err = e
+            logger_mod.warning(
+                "checkpoint set %s is corrupt (%s); falling back to the "
+                "previous set", ckpt_dir.name, e)
+    if last_err is not None:
+        raise last_err
+    return 0, None  # no/incomplete set: restart from scratch, untouched
 
 
 if __name__ == "__main__":

@@ -7,6 +7,15 @@ the FULL training state (params, buffers, optimizer state, lrs, step, data
 cursor, RNG) so sweeps resume exactly (SURVEY.md §5 'Checkpoint / resume').
 
 Format: flax msgpack for the pytree + a JSON sidecar for static metadata.
+
+Hardening (docs/ARCHITECTURE.md §10): every write is tmp+fsync+rename, so
+an interrupted save can never leave a truncated file at the target path;
+the sidecar records the payload's sha256, and restore verifies it before
+deserializing — silent corruption becomes a typed
+:class:`~sparse_coding_tpu.resilience.errors.CheckpointCorruptionError`
+that `train/sweep.py::resume_sweep_state` falls back from (to the
+``ckpt_prev/`` last-good set). Fault sites ``ckpt.save``/``ckpt.restore``
+let tests drive both failure paths deterministically.
 """
 
 from __future__ import annotations
@@ -20,6 +29,15 @@ import numpy as np
 from flax import serialization
 
 from sparse_coding_tpu.ensemble import Ensemble, EnsembleState
+from sparse_coding_tpu.resilience.atomic import atomic_write_bytes, atomic_write_text
+from sparse_coding_tpu.resilience.errors import CheckpointCorruptionError
+from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
+from sparse_coding_tpu.resilience.manifest import bytes_sha256
+
+register_fault_site("ckpt.save",
+                    "checkpoint save (msgpack and orbax backends)")
+register_fault_site("ckpt.restore",
+                    "checkpoint restore (msgpack and orbax backends)")
 
 
 def save_ensemble(ens: Ensemble, path: str | Path,
@@ -29,23 +47,43 @@ def save_ensemble(ens: Ensemble, path: str | Path,
     state = jax.device_get(ens.state)
     tree = {"params": state.params, "buffers": state.buffers,
             "opt_state": state.opt_state, "lrs": state.lrs, "step": state.step}
-    path.write_bytes(serialization.to_bytes(tree))
+    payload = serialization.to_bytes(tree)
+    fault_point("ckpt.save")
+    atomic_write_bytes(path, payload)
     meta = {"sig_name": state.sig_name,
             "static_buffers": list(state.static_buffers),
+            "payload_sha256": bytes_sha256(payload),
+            "payload_bytes": len(payload),
             **(extra or {})}
-    path.with_suffix(path.suffix + ".meta.json").write_text(
-        json.dumps(meta, indent=2, default=str))
+    # sidecar written last: its digest certifies the payload beside it
+    atomic_write_text(path.with_suffix(path.suffix + ".meta.json"),
+                      json.dumps(meta, indent=2, default=str))
 
 
 def restore_ensemble(ens: Ensemble, path: str | Path) -> dict:
     """Restore state in-place into a freshly-constructed, same-shape Ensemble.
-    Returns the metadata sidecar (incl. any data-cursor extras)."""
+    Returns the metadata sidecar (incl. any data-cursor extras). Verifies
+    the payload digest when the sidecar carries one; raises
+    :class:`CheckpointCorruptionError` on mismatch or a payload that no
+    longer deserializes."""
     path = Path(path)
+    fault_point("ckpt.restore")
+    meta_path = path.with_suffix(path.suffix + ".meta.json")
+    meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
+    payload = path.read_bytes()
+    want = meta.get("payload_sha256")
+    if want is not None and bytes_sha256(payload) != want:
+        raise CheckpointCorruptionError(
+            path, "payload sha256 does not match the sidecar manifest")
     state = jax.device_get(ens.state)
     template = {"params": state.params, "buffers": state.buffers,
                 "opt_state": state.opt_state, "lrs": state.lrs,
                 "step": state.step}
-    tree = serialization.from_bytes(template, path.read_bytes())
+    try:
+        tree = serialization.from_bytes(template, payload)
+    except Exception as e:  # msgpack unpack errors are library-specific
+        raise CheckpointCorruptionError(
+            path, f"payload does not deserialize: {e}") from e
     new_state = EnsembleState(
         params=tree["params"], buffers=tree["buffers"],
         opt_state=tree["opt_state"], lrs=tree["lrs"], step=tree["step"],
@@ -56,15 +94,27 @@ def restore_ensemble(ens: Ensemble, path: str | Path) -> dict:
     else:
         new_state = jax.tree.map(jax.numpy.asarray, new_state)
     ens.state = new_state
-    meta_path = path.with_suffix(path.suffix + ".meta.json")
-    return json.loads(meta_path.read_text()) if meta_path.exists() else {}
+    return meta
 
 
 def save_pytree(tree: Any, path: str | Path) -> None:
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    path.write_bytes(serialization.to_bytes(jax.device_get(tree)))
+    payload = serialization.to_bytes(jax.device_get(tree))
+    fault_point("ckpt.save")
+    atomic_write_bytes(path, payload)
+    atomic_write_text(path.with_suffix(path.suffix + ".sha256"),
+                      bytes_sha256(payload))
 
 
 def restore_pytree(template: Any, path: str | Path) -> Any:
-    return serialization.from_bytes(template, Path(path).read_bytes())
+    path = Path(path)
+    fault_point("ckpt.restore")
+    payload = path.read_bytes()
+    digest_path = path.with_suffix(path.suffix + ".sha256")
+    if digest_path.exists():
+        want = digest_path.read_text().strip()
+        if bytes_sha256(payload) != want:
+            raise CheckpointCorruptionError(
+                path, "payload sha256 does not match the .sha256 sidecar")
+    return serialization.from_bytes(template, payload)

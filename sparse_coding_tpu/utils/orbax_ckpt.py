@@ -43,8 +43,18 @@ from typing import Optional
 import jax
 
 from sparse_coding_tpu.ensemble import Ensemble, EnsembleState
+from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
+from sparse_coding_tpu.resilience.manifest import (
+    verify_dir_manifest,
+    write_dir_manifest,
+)
 
 _SUFFIX = ".orbax"
+
+register_fault_site("ckpt.save",
+                    "checkpoint save (msgpack and orbax backends)")
+register_fault_site("ckpt.restore",
+                    "checkpoint restore (msgpack and orbax backends)")
 
 
 def _state_tree(state: EnsembleState) -> dict:
@@ -77,6 +87,9 @@ class AsyncEnsembleCheckpointer:
     def __init__(self, use_async: bool = True):
         self._use_async = use_async
         self._ckptrs: dict[str, object] = {}
+        # saves whose digest manifest is still owed: manifests can only be
+        # written once the async write is durable, so wait() writes them
+        self._manifest_pending: set[Path] = set()
 
     def _ckptr_for(self, path: Path):
         import orbax.checkpoint as ocp
@@ -91,6 +104,7 @@ class AsyncEnsembleCheckpointer:
     def save(self, ens: Ensemble, path: str | Path,
              extra: Optional[dict] = None) -> None:
         path = Path(path)
+        fault_point("ckpt.save")
         if jax.process_index() == 0:
             path.parent.mkdir(parents=True, exist_ok=True)
         state = ens.state
@@ -98,6 +112,7 @@ class AsyncEnsembleCheckpointer:
         # same-path re-save (e.g. re-running a crashed chunk) replaces it
         self._ckptr_for(path).save(path.absolute(), _state_tree(state),
                                    force=True)
+        self._manifest_pending.add(path)
         if jax.process_index() == 0:
             meta = {"sig_name": state.sig_name,
                     "static_buffers": list(state.static_buffers),
@@ -113,7 +128,12 @@ class AsyncEnsembleCheckpointer:
         import orbax.checkpoint as ocp
 
         path = Path(path)
+        fault_point("ckpt.restore")
         self.wait()
+        # digest-manifest gate (written by wait() after the save was
+        # durable): shard corruption raises CheckpointCorruptionError here
+        # instead of surfacing as garbage params mid-training
+        verify_dir_manifest(path)
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
                                 _state_tree(ens.state))
         tree = self._ckptr_for(path).restore(path.absolute(), abstract)
@@ -126,11 +146,18 @@ class AsyncEnsembleCheckpointer:
         return json.loads(meta.read_text()) if meta.exists() else {}
 
     def wait(self) -> None:
-        """Block until every pending write (across all paths) is durable."""
+        """Block until every pending write (across all paths) is durable,
+        then stamp each newly-durable checkpoint's digest manifest (the
+        ``<path>.manifest.json`` sidecar restore verifies)."""
         for ckptr in self._ckptrs.values():
             wait = getattr(ckptr, "wait_until_finished", None)
             if wait is not None:
                 wait()
+        if jax.process_index() == 0:
+            for path in sorted(self._manifest_pending):
+                if path.exists():
+                    write_dir_manifest(path)
+        self._manifest_pending.clear()
 
     def close(self) -> None:
         self.wait()

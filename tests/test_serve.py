@@ -284,6 +284,92 @@ def test_recompile_counter_counts_post_warmup_models(registry, rng):
         assert engine.stats()["recompiles"] == 1  # ...no further misses
 
 
+def test_dispatch_fault_typed_error_and_worker_survives(registry):
+    """A dispatch-callback exception marks ONLY that flush's requests
+    failed (typed DispatchError carrying the injected cause) and the
+    worker thread survives to serve the next request — the queue never
+    wedges (resilience satellite; fault site serve.dispatch)."""
+    from sparse_coding_tpu.resilience import InjectedFault, inject
+    from sparse_coding_tpu.serve import DispatchError
+
+    with ServingEngine(registry, max_wait_ms=5.0) as engine:
+        engine.warmup()
+        engine.pause()  # coalesce three requests into ONE flush
+        futs = [engine.submit("tied", np.zeros((1, D), np.float32))
+                for _ in range(3)]
+        with inject(site="serve.dispatch", nth=1, error="ValueError"):
+            engine.resume()
+            for f in futs:
+                with pytest.raises(DispatchError) as exc:
+                    f.result(timeout=30)
+                assert isinstance(exc.value.cause, InjectedFault)
+        # the worker survived: a fresh request on the same engine succeeds
+        out = engine.query("tied", np.zeros((2, D), np.float32), timeout=30)
+        assert out.shape == (2, N)
+        snap = engine.stats()
+        assert snap["request_errors"] == {"DispatchError": 3}
+        assert snap["dispatch_failures"] == 1
+        assert snap["breaker_state"] == "closed"  # one failure < threshold
+
+
+def test_dispatch_transient_fault_retried_within_budget(registry):
+    """A transient (OSError-family) dispatch failure is retried against
+    the per-stream budget and the request still SUCCEEDS — visible only
+    as a dispatch_retries tick, never a request error."""
+    from sparse_coding_tpu.resilience import inject
+
+    with ServingEngine(registry, max_wait_ms=0.0,
+                       retry_backoff_s=0.0) as engine:
+        engine.warmup()
+        with inject(site="serve.dispatch", nth=1, error="OSError") as plan:
+            out = engine.query("tied", np.zeros((3, D), np.float32),
+                               timeout=30)
+        assert out.shape == (3, N)
+        assert plan.fired_count("serve.dispatch") == 1
+        snap = engine.stats()
+        assert snap["dispatch_retries"] == 1
+        assert snap["request_errors"] == {}
+        assert snap["dispatch_failures"] == 0
+
+
+def test_breaker_opens_sheds_and_recovers(registry):
+    """Sustained dispatch failure trips the circuit breaker: queued work
+    fails fast (typed), NEW submissions are shed at admission, and after
+    the cooldown a half-open probe closes the circuit again — the full
+    open -> half_open -> closed recovery, all visible in metrics
+    snapshots."""
+    import time
+
+    from sparse_coding_tpu.resilience import inject
+    from sparse_coding_tpu.serve import CircuitOpenError, DispatchError
+
+    with ServingEngine(registry, max_wait_ms=0.0, dispatch_retries=0,
+                       breaker_threshold=2, breaker_reset_s=0.2) as engine:
+        engine.warmup()
+        x = np.zeros((2, D), np.float32)
+        with inject(site="serve.dispatch", nth=1, count=2):
+            for _ in range(2):  # two consecutive failures: threshold
+                with pytest.raises(DispatchError):
+                    engine.query("tied", x, timeout=30)
+        snap = engine.stats()
+        assert snap["breaker_state"] == "open"
+        # open circuit: shed at ADMISSION — no queueing behind a sick
+        # backend, and the error carries the cooldown as a retry hint
+        with pytest.raises(CircuitOpenError) as exc:
+            engine.submit("tied", x)
+        assert exc.value.retry_after_s > 0
+        time.sleep(0.3)  # past the cooldown: next dispatch is the probe
+        out = engine.query("tied", x, timeout=30)  # no fault plan: heals
+        assert out.shape == (2, N)
+        snap = engine.stats()
+        assert snap["breaker_state"] == "closed"
+        assert snap["shed_requests"] >= 1
+        assert snap["breaker_transitions"] == [
+            "closed->open", "open->half_open", "half_open->closed"]
+        assert snap["request_errors"].get("DispatchError") == 2
+        assert snap["breaker"]["state"] == "closed"
+
+
 def test_capacity_flush_not_blocked_by_older_sparse_stream(registry):
     """A capacity-full stream must dispatch immediately even when an older,
     still-accumulating sparse stream exists (no head-of-line blocking): the

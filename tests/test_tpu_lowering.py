@@ -196,6 +196,40 @@ def test_serving_bucket_programs_lower(rng):
                    stacked, jnp.zeros((rows, d)))
 
 
+def test_hardened_serve_dispatch_programs_lower(rng):
+    """The resilience-hardened dispatch path (breaker + retry wrapping in
+    engine._dispatch) is host-side Python by construction — the DEVICE
+    program it retries/probes with must be exactly the pre-hardening
+    bucket program. This lowers the engine's REAL compiled functions (via
+    serve.engine.build_bucket_program, the same builder engine._compile
+    uses) for TPU, so the hardening can never have smuggled host logic
+    into the compiled path."""
+    import jax.numpy as jnp
+
+    from sparse_coding_tpu.models import TiedSAE
+    from sparse_coding_tpu.serve.engine import DEFAULT_BUCKETS
+    from sparse_coding_tpu.serve.registry import ModelRegistry
+
+    d, n = 32, 64
+    reg = ModelRegistry()
+    reg.register("tied", TiedSAE(dictionary=jax.random.normal(rng, (n, d)),
+                                 encoder_bias=jnp.zeros(n)))
+    reg.register_stack("stack", [
+        TiedSAE(dictionary=jax.random.normal(jax.random.fold_in(rng, i),
+                                             (n, d)),
+                encoder_bias=jnp.zeros(n)) for i in range(3)])
+    from sparse_coding_tpu.serve.engine import build_bucket_program
+
+    for name in ("tied", "stack"):
+        entry = reg.get(name)
+        for op in ("encode", "decode", "topk"):
+            for bucket in DEFAULT_BUCKETS:
+                fn, spec = build_bucket_program(entry, op, bucket,
+                                                jnp.float32, topk_k=16)
+                jax.jit(fn).trace(entry.tree, spec).lower(
+                    lowering_platforms=("tpu",))
+
+
 def test_perplexity_scan_program_lowers(rng):
     """The scanned perplexity program (lax.scan over the edit-intervened
     forward — what calculate_perplexity dispatches for all full batches)."""
