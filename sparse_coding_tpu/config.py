@@ -57,8 +57,11 @@ class BaseArgs:
         return out
 
     def save(self, path: str | Path) -> None:
+        from sparse_coding_tpu.resilience.atomic import atomic_write_text
+
         Path(path).parent.mkdir(parents=True, exist_ok=True)
-        Path(path).write_text(json.dumps(self.to_dict(), indent=2, default=str))
+        atomic_write_text(path, json.dumps(self.to_dict(), indent=2,
+                                           default=str))
 
     @classmethod
     def load(cls: Type[T], path: str | Path) -> T:

@@ -25,7 +25,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparse_coding_tpu.resilience import lease
 from sparse_coding_tpu.resilience.atomic import atomic_save_npy, atomic_write_text
+from sparse_coding_tpu.resilience.crash import crash_barrier, register_crash_site
 from sparse_coding_tpu.resilience.errors import ChunkCorruptionError
 from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
 from sparse_coding_tpu.resilience.manifest import array_sha256
@@ -41,6 +43,12 @@ register_fault_site("chunk.read",
 register_fault_site("chunk.write",
                     "ChunkWriter._write — every chunk flush (inside the "
                     "bounded-retry scope)")
+register_crash_site("chunk.flushed",
+                    "ChunkWriter._write — a chunk file + digest just became "
+                    "durable; the next instruction never runs")
+register_crash_site("store.finalize",
+                    "ChunkWriter.finalize — all chunks durable, meta.json "
+                    "(the completeness marker) not yet written")
 
 _DTYPES = {"float16": np.float16, "float32": np.float32,
            "bfloat16": jnp.bfloat16}  # ml_dtypes-backed numpy dtype
@@ -69,6 +77,16 @@ class ChunkWriter:
                 self._digests = dict(
                     json.loads(prior_meta.read_text()).get(
                         "chunk_digests", {}))
+            else:
+                # crash-resume: the previous harvest died before finalize
+                # (no meta.json), so the kept chunks' digests were never
+                # recorded — recompute them from the durable files so the
+                # finished store's meta is byte-identical to an
+                # uninterrupted harvest's (the chaos-matrix contract).
+                for i in range(start_index):
+                    p = self.folder / f"{i}.npy"
+                    if p.exists():
+                        self._digests[str(i)] = array_sha256(np.load(p))
         self.activation_dim = activation_dim
         self.dtype = np.dtype(_DTYPES[dtype])
         bytes_per_row = activation_dim * self.dtype.itemsize
@@ -128,6 +146,8 @@ class ChunkWriter:
         retry_io(_write_once, attempts=self.io_retries)
         self._digests[str(self.chunk_index)] = array_sha256(arr)
         self.chunk_index += 1
+        lease.beat()  # a durable chunk is the harvest's unit of progress
+        crash_barrier("chunk.flushed")
 
     def _flush_chunk(self) -> None:
         flat = np.concatenate(self._buffer, axis=0)
@@ -158,7 +178,9 @@ class ChunkWriter:
                 **({"center_format": "subtracted-v2"} if centered else {})}
         meta.update(metadata or {})
         # meta.json is written LAST and atomically: its presence certifies
-        # a complete store (every chunk + center.npy already durable)
+        # a complete store (every chunk + center.npy already durable) — a
+        # kill at this barrier leaves a resumable, visibly-incomplete store
+        crash_barrier("store.finalize")
         atomic_write_text(self.folder / "meta.json", json.dumps(meta, indent=2))
         return self.chunk_index
 
@@ -418,6 +440,32 @@ class ChunkStore:
             if chunk is None:  # quarantined (quarantine_corrupt=True)
                 continue
             yield from self.batches(chunk, batch_size, rng)
+
+
+def complete_chunk_count(folder: str | Path) -> int:
+    """Number of leading complete chunks (``0.npy .. k-1.npy``) in a
+    possibly-unfinalized store. Chunk writes are sequential and atomic, so
+    after a crash the durable prefix is exactly the resumable work:
+    ``ChunkWriter(..., start_index=complete_chunk_count(folder))`` plus
+    skipping the producer rows those chunks cover continues the harvest
+    bitwise-identically (tmp debris never matches ``<i>.npy``)."""
+    folder = Path(folder)
+    k = 0
+    while (folder / f"{k}.npy").exists():
+        k += 1
+    return k
+
+
+def clean_write_debris(folder: str | Path) -> int:
+    """Remove orphaned atomic-write tmp files (``.<name>.tmp.<pid>``) a
+    killed writer left behind; returns how many were removed. Safe by
+    construction: no complete chunk ever has a dotted tmp name."""
+    folder = Path(folder)
+    n = 0
+    for tmp in folder.glob(".*.tmp.*"):
+        tmp.unlink(missing_ok=True)
+        n += 1
+    return n
 
 
 def shuffled_batches(chunk: np.ndarray, batch_size: int,

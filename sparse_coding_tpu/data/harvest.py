@@ -21,6 +21,7 @@ from sparse_coding_tpu.config import DataArgs
 from sparse_coding_tpu.data.chunk_store import ChunkStore, ChunkWriter
 from sparse_coding_tpu.lm import hooks
 from sparse_coding_tpu.lm.model_config import LMConfig
+from sparse_coding_tpu.resilience import lease
 
 
 def make_harvest_fn(params, cfg: LMConfig, taps: Sequence[str], forward=None,
@@ -158,6 +159,10 @@ def harvest_activations(
         tapped = pending.popleft()
         for name, acts in tapped.items():
             writers[name].add(np.asarray(acts))
+        # progress heartbeat per drained forward (supervised runs): a
+        # drained batch proves the LM, the device→host pull, and the
+        # writer all advanced — a wedged tunnel stops these beats cold
+        lease.beat()
         return (n_chunks is not None and all(
             w.chunk_index - skip_chunks >= n_chunks for w in writers.values()))
 

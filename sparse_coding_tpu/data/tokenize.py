@@ -64,11 +64,17 @@ def save_token_dataset(rows: np.ndarray, path: str | Path,
     import json
     from pathlib import Path
 
+    from sparse_coding_tpu.resilience.atomic import (
+        atomic_save_npy,
+        atomic_write_text,
+    )
+
     path = Path(path).with_suffix(".npy")  # np.save appends it anyway
     path.parent.mkdir(parents=True, exist_ok=True)
-    np.save(path, rows)
+    atomic_save_npy(path, rows)
     if metadata:
-        path.with_suffix(".meta.json").write_text(json.dumps(metadata, indent=2))
+        atomic_write_text(path.with_suffix(".meta.json"),
+                          json.dumps(metadata, indent=2))
 
 
 def load_token_dataset(path: str | Path) -> np.ndarray:

@@ -17,6 +17,7 @@ import numpy as np
 
 from sparse_coding_tpu.config import InterpGraphArgs, InvestigateArgs
 from sparse_coding_tpu.interp.fragments import sample_fragments
+from sparse_coding_tpu.resilience.atomic import atomic_write_text
 from sparse_coding_tpu.metrics.intervention import (
     build_ablation_graph,
     build_ablation_graph_non_positional,
@@ -50,7 +51,8 @@ def run_interp_graph(cfg: InterpGraphArgs, params, lm_cfg,
     out = Path(cfg.output_folder)
     out.mkdir(parents=True, exist_ok=True)
     serializable = {repr(k): v for k, v in graph.items()}
-    (out / "ablation_graph.json").write_text(json.dumps(serializable, indent=2))
+    atomic_write_text(out / "ablation_graph.json",
+                      json.dumps(serializable, indent=2))
     return graph
 
 

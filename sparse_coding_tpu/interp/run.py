@@ -17,6 +17,7 @@ import jax
 import numpy as np
 
 from sparse_coding_tpu.config import InterpArgs
+from sparse_coding_tpu.resilience.atomic import atomic_write_text
 from sparse_coding_tpu.interp.client import ActivationRecord, Explainer, get_explainer
 from sparse_coding_tpu.utils.artifacts import load_learned_dicts
 from sparse_coding_tpu.interp.fragments import (
@@ -112,10 +113,12 @@ def run(learned_dict, cfg: InterpArgs, params, lm_cfg, token_rows: np.ndarray,
                                 top_k=cfg.top_k_fragments,
                                 n_random=cfg.n_random_fragments, seed=cfg.seed)
         feat_dir.mkdir(parents=True, exist_ok=True)
-        (feat_dir / "explanation.txt").write_text(rec["explanation"])
-        (feat_dir / "scores.json").write_text(json.dumps(rec, indent=2))
+        atomic_write_text(feat_dir / "explanation.txt", rec["explanation"])
+        # scores.json is the per-feature completeness marker (idempotent
+        # re-runs key off it above) — written last, atomically
+        atomic_write_text(feat_dir / "scores.json", json.dumps(rec, indent=2))
         results.append(rec)
-    (out / "summary.json").write_text(json.dumps(results, indent=2))
+    atomic_write_text(out / "summary.json", json.dumps(results, indent=2))
     return results
 
 

@@ -23,6 +23,7 @@ import numpy as np
 from sparse_coding_tpu.config import ErasureArgs
 from sparse_coding_tpu.lm.hooks import tap_name
 from sparse_coding_tpu.metrics.erasure import feature_erasure_curve, leace_baseline
+from sparse_coding_tpu.resilience.atomic import atomic_write_text
 from sparse_coding_tpu.utils.artifacts import load_learned_dicts
 
 
@@ -91,7 +92,7 @@ def run_erasure(cfg: ErasureArgs, params, lm_cfg, probe_tokens: np.ndarray,
                 "curve": curve,
             })
         path = out / f"erasure_scores_layer_{layer}.json"
-        path.write_text(json.dumps(layer_rec, indent=2, default=float))
+        atomic_write_text(path, json.dumps(layer_rec, indent=2, default=float))
         plot_erasure_tradeoff(layer_rec["dicts"][0]["curve"],
                               leace=layer_rec["leace"],
                               save_path=out / f"erasure_layer_{layer}.png",

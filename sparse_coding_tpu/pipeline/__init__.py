@@ -1,0 +1,38 @@
+"""Crash-only pipeline supervision: journaled harvest→sweep→eval.
+
+- :mod:`journal`    — append-only run journal (the supervisor's only
+  memory; atomic appends, artifact-beats-journal recovery);
+- :mod:`supervisor` — the step DAG runner: child processes, lease
+  takeover, SIGKILL recovery, hang watchdog with tunnel diagnosis,
+  degrade-to-CPU, plus ``supervise_bench`` (bench.py ``--supervised``);
+- :mod:`steps`      — the built-in resumable step children.
+
+Design + formats: docs/ARCHITECTURE.md §11; wedged-tunnel operations:
+docs/RUNBOOK_TUNNEL.md; kill coverage: tests/test_pipeline_chaos.py.
+"""
+
+from sparse_coding_tpu.pipeline.journal import RunJournal
+from sparse_coding_tpu.pipeline.supervisor import (
+    ConcurrentSupervisorError,
+    PipelineError,
+    Step,
+    StepFailed,
+    StepHung,
+    Supervisor,
+    build_pipeline,
+    step_argv,
+    supervise_bench,
+)
+
+__all__ = [
+    "ConcurrentSupervisorError",
+    "PipelineError",
+    "RunJournal",
+    "Step",
+    "StepFailed",
+    "StepHung",
+    "Supervisor",
+    "build_pipeline",
+    "step_argv",
+    "supervise_bench",
+]

@@ -1,0 +1,86 @@
+"""Append-only run journal: the supervisor's single source of truth.
+
+Crash-only design rule: the supervisor keeps NO state in memory that it
+cannot rebuild from disk, because the supervisor itself may be SIGKILLed
+between any two instructions. Every observable step transition (spawned,
+done, killed, failed, hung, lease takeover) is appended here *before* the
+supervisor acts on it, so a restarted supervisor replays the journal and
+continues exactly where the dead one stopped.
+
+Appends are atomic (read + append + tmp/fsync/rename via
+:mod:`resilience.atomic`): a reader — including a concurrently restarted
+supervisor — only ever sees a complete journal, never a torn tail line.
+Journals are small (a handful of records per step), so the rewrite-append
+costs nothing measurable; in exchange there is no partial-line recovery
+code to test.
+
+Truth hierarchy on restart: *artifacts beat the journal*. A "done" record
+whose completion artifact is missing means the artifact's durability
+raced the record — the step re-runs (it is resumable by contract); the
+journal is how the supervisor explains itself, the filesystem is what it
+trusts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Optional
+
+from sparse_coding_tpu.resilience.atomic import atomic_write_bytes
+
+
+class RunJournal:
+    """One journal file (``journal.jsonl``) for one pipeline run dir."""
+
+    def __init__(self, path: str | Path, clock=time.time):
+        self.path = Path(path)
+        self._clock = clock
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+
+    def append(self, event: str, step: str = "", **detail) -> dict:
+        rec = {"seq": self._next_seq(), "ts": self._clock(),
+               "pid": os.getpid(), "event": event, "step": step}
+        if detail:
+            rec["detail"] = detail
+        existing = self.path.read_bytes() if self.path.exists() else b""
+        if existing and not existing.endswith(b"\n"):
+            # an operator-edited journal may lack the trailing newline; a
+            # new record must never merge into (and thus corrupt) that line
+            existing += b"\n"
+        atomic_write_bytes(self.path,
+                           existing + json.dumps(rec).encode() + b"\n")
+        return rec
+
+    def records(self) -> list[dict]:
+        """All records, oldest first. Tolerant of a malformed line (cannot
+        happen under the atomic append, but a journal is also an operator-
+        edited artifact during incident response — never die over it)."""
+        if not self.path.exists():
+            return []
+        out = []
+        for line in self.path.read_bytes().splitlines():
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+        return out
+
+    def _next_seq(self) -> int:
+        recs = self.records()
+        return recs[-1]["seq"] + 1 if recs else 1
+
+    def last_event(self, step: str) -> Optional[dict]:
+        for rec in reversed(self.records()):
+            if rec.get("step") == step:
+                return rec
+        return None
+
+    def step_events(self, step: str) -> list[dict]:
+        return [r for r in self.records() if r.get("step") == step]
+
+    def done_steps(self) -> set[str]:
+        return {r["step"] for r in self.records()
+                if r.get("event") == "step.done"}

@@ -1,0 +1,234 @@
+"""Built-in pipeline step children: harvest / sweep / eval.
+
+Each step is a subprocess entrypoint (``python -m
+sparse_coding_tpu.pipeline.steps <step> --config pipeline.json``) obeying
+the crash-only contract the supervisor depends on:
+
+- **re-runnable from scratch at any instant**: harvest resumes from the
+  durable chunk prefix (``complete_chunk_count`` + producer-row skip, or
+  ``skip_chunks`` on the LM path), the sweep resumes from §4/§10's
+  checkpoint sets (``resume=True``), eval is idempotent behind its output
+  marker — so a SIGKILL anywhere costs only the in-flight unit of work
+  and the completed run is bitwise-identical to an uninterrupted one;
+- **heartbeats from the work loop** (:mod:`resilience.lease`): the lease
+  configured from ``SPARSE_CODING_LEASE_PATH`` is beaten at chunk/window
+  granularity by the host modules, so a wedged process goes visibly
+  stale;
+- **every durable transition sits behind a named crash barrier**
+  (:mod:`resilience.crash`), which is how the chaos matrix kills real
+  children at exactly the worst instants.
+
+Config file: one JSON object with ``harvest`` / ``sweep`` / ``eval``
+sections (see each step function). All seeds are explicit — two runs of
+the same config must produce byte-identical artifacts.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+from sparse_coding_tpu.resilience import lease
+from sparse_coding_tpu.resilience.atomic import atomic_write_text
+from sparse_coding_tpu.resilience.crash import crash_barrier, register_crash_site
+
+register_crash_site("eval.write",
+                    "pipeline eval step — results computed, output file "
+                    "not yet written")
+
+
+def run_harvest(config: dict) -> None:
+    """``config["harvest"]`` keys — common: ``mode`` ("synthetic" | "lm"),
+    ``dataset_folder`` (the chunk store the sweep reads; completion marker
+    is its ``meta.json``), ``seed``. Synthetic: ``activation_dim``,
+    ``n_ground_truth_features``, ``feature_num_nonzero``,
+    ``feature_prob_decay``, ``dataset_size``, ``n_chunks``,
+    ``batch_rows``. LM: ``arch``, ``layer``, ``layer_loc``, ``n_rows``,
+    ``context_len``, ``model_batch_size``, ``chunk_size_gb`` — the
+    dataset_folder must be the TAP subfolder the harvester writes."""
+    from sparse_coding_tpu.data.chunk_store import clean_write_debris
+
+    cfg = config["harvest"]
+    folder = Path(cfg["dataset_folder"])
+    if (folder / "meta.json").exists():
+        return  # complete store: nothing to do (idempotent)
+    folder.mkdir(parents=True, exist_ok=True)
+    clean_write_debris(folder)  # tmp debris from a killed writer
+    if cfg.get("mode", "synthetic") == "synthetic":
+        _synthetic_harvest(cfg)
+    else:
+        _lm_harvest(cfg)
+
+
+def _synthetic_harvest(cfg: dict) -> None:
+    """Deterministic synthetic activation store with crash-resume: the
+    generator stream is replayed from its seed and the rows already
+    covered by durable chunks are skipped, so the finished store —
+    chunks, digests, meta — is byte-identical however many times the
+    process died along the way."""
+    import jax
+
+    from sparse_coding_tpu.data.chunk_store import (
+        ChunkWriter,
+        complete_chunk_count,
+    )
+    from sparse_coding_tpu.data.synthetic import RandomDatasetGenerator
+
+    folder = Path(cfg["dataset_folder"])
+    dim = int(cfg["activation_dim"])
+    total = int(cfg["dataset_size"])
+    n_chunks = int(cfg.get("n_chunks", 4))
+    seed = int(cfg.get("seed", 0))
+    dtype = cfg.get("dtype", "float16")
+    rows_per_chunk = total // n_chunks
+    bytes_per_row = dim * np.dtype(np.float16 if dtype == "float16"
+                                   else np.float32).itemsize
+    k = complete_chunk_count(folder)
+    gen = RandomDatasetGenerator.create(
+        jax.random.PRNGKey(seed), dim, int(cfg["n_ground_truth_features"]),
+        int(cfg.get("feature_num_nonzero", 5)),
+        float(cfg.get("feature_prob_decay", 0.99)),
+        correlated=bool(cfg.get("correlated_components", False)))
+    writer = ChunkWriter(folder, dim,
+                         chunk_size_gb=rows_per_chunk * bytes_per_row / 2**30,
+                         dtype=dtype, start_index=k)
+    skip_rows = k * writer.rows_per_chunk
+    key = jax.random.PRNGKey(seed + 1)
+    batch_rows = int(cfg.get("batch_rows", 8192))
+    produced = 0
+    while produced < total:
+        key, sub = jax.random.split(key)
+        n = min(total - produced, batch_rows)
+        if produced + n > skip_rows:
+            batch = np.asarray(jax.device_get(gen.batch(sub, n)))
+            lo = max(0, skip_rows - produced)
+            writer.add(batch[lo:])
+        produced += n
+        lease.beat()
+    writer.finalize({"synthetic": True, "seed": seed})
+
+
+def _lm_harvest(cfg: dict) -> None:
+    """Tiny-LM harvest through the REAL ``harvest_activations`` path
+    (random-init weights, seeded token rows — no network), resuming via
+    ``skip_chunks`` from the durable chunk prefix."""
+    import jax
+
+    from sparse_coding_tpu.data.chunk_store import complete_chunk_count
+    from sparse_coding_tpu.data.harvest import harvest_activations
+    from sparse_coding_tpu.lm.model_config import tiny_test_config
+
+    folder = Path(cfg["dataset_folder"])  # the tap subfolder
+    arch = cfg.get("arch", "gptneox")
+    lm_cfg = tiny_test_config(arch)
+    if arch == "gptneox":
+        from sparse_coding_tpu.lm.gptneox import init_params
+    else:
+        from sparse_coding_tpu.lm.gpt2 import init_params
+    seed = int(cfg.get("seed", 0))
+    params = init_params(jax.random.PRNGKey(seed), lm_cfg)
+    rng = np.random.default_rng(seed)
+    token_rows = rng.integers(
+        0, lm_cfg.vocab_size,
+        (int(cfg["n_rows"]), int(cfg.get("context_len", 16))))
+    harvest_activations(
+        params, lm_cfg, token_rows, [int(cfg.get("layer", 1))],
+        cfg.get("layer_loc", "residual"), folder.parent,
+        model_batch_size=int(cfg.get("model_batch_size", 2)),
+        chunk_size_gb=float(cfg["chunk_size_gb"]),
+        skip_chunks=complete_chunk_count(folder),
+        dtype=cfg.get("dtype", "float16"))
+
+
+def run_sweep(config: dict) -> None:
+    """``config["sweep"]`` keys: ``experiment`` (EXPERIMENTS registry
+    name), ``ensemble`` (EnsembleArgs fields), ``log_every``. Always runs
+    ``resume=True`` — a fresh run resumes from nothing, a killed run from
+    its newest complete checkpoint set (§10 fallback chain included).
+
+    The completion marker is written HERE, not by ``sweep()``'s periodic
+    artifact saves: ``<output>/final/<name>_learned_dicts.pkl`` is
+    derived from the (restored or live) end state, so it exists even when
+    the resume had zero chunks left to train — the property that makes
+    "retry after any kill" converge instead of looping."""
+    import sparse_coding_tpu.train.sweep as sweep_mod
+    from sparse_coding_tpu.config import EnsembleArgs
+    from sparse_coding_tpu.train.experiments import EXPERIMENTS
+    from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+
+    cfg = config["sweep"]
+    ens_cfg = EnsembleArgs(**cfg["ensemble"])
+    result = sweep_mod.sweep(EXPERIMENTS[cfg.get("experiment",
+                                                 "dense_l1_range")],
+                             ens_cfg, resume=True,
+                             log_every=int(cfg.get("log_every", 100)),
+                             image_metrics_every=None)
+    final = Path(ens_cfg.output_folder) / "final"
+    for name, tagged in result.items():
+        save_learned_dicts(tagged, final / f"{name}_learned_dicts.pkl")
+
+
+def run_eval(config: dict) -> None:
+    """``config["eval"]`` keys: ``output_folder``, ``n_eval_rows``,
+    ``seed``. Scores every dictionary in the sweep's final artifact (FVU +
+    mean L0 on a seeded slice of chunk 0) and writes ``eval.json``
+    atomically behind the ``eval.write`` crash barrier."""
+    import jax.numpy as jnp
+
+    from sparse_coding_tpu.data.chunk_store import ChunkStore
+    from sparse_coding_tpu.metrics.core import (
+        fraction_variance_unexplained,
+        mean_l0,
+    )
+    from sparse_coding_tpu.utils.artifacts import load_learned_dicts
+
+    cfg = config["eval"]
+    out = Path(cfg["output_folder"])
+    marker = out / "eval.json"
+    if marker.exists():
+        return
+    out.mkdir(parents=True, exist_ok=True)
+    name = config["sweep"].get("experiment", "dense_l1_range")
+    pkl = (Path(config["sweep"]["ensemble"]["output_folder"]) / "final"
+           / f"{name}_learned_dicts.pkl")
+    tagged = load_learned_dicts(pkl)
+    store = ChunkStore(config["harvest"]["dataset_folder"])
+    chunk = store.load_chunk(0)
+    rng = np.random.default_rng(int(cfg.get("seed", 0)))
+    rows = rng.permutation(chunk.shape[0])[:int(cfg.get("n_eval_rows", 2048))]
+    eval_batch = jnp.asarray(chunk[rows], jnp.float32)
+    records = []
+    for ld, hyper in tagged:
+        records.append({
+            **{k: v for k, v in hyper.items()
+               if isinstance(v, (int, float, str, bool))},
+            "fvu": float(fraction_variance_unexplained(ld, eval_batch)),
+            "l0": float(mean_l0(ld, eval_batch))})
+        lease.beat()
+    crash_barrier("eval.write")
+    atomic_write_text(marker, json.dumps(
+        {"experiment": name, "n_eval_rows": int(len(rows)),
+         "dicts": records}, indent=2))
+
+
+STEPS = {"harvest": run_harvest, "sweep": run_sweep, "eval": run_eval}
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) != 3 or argv[1] != "--config" or argv[0] not in STEPS:
+        raise SystemExit(
+            f"usage: python -m sparse_coding_tpu.pipeline.steps "
+            f"{{{'|'.join(STEPS)}}} --config pipeline.json")
+    step, config_path = argv[0], argv[2]
+    # claim the lease before any real work: from here on, silence = hang
+    lease.configure_from_env(step=step)
+    config = json.loads(Path(config_path).read_text())
+    STEPS[step](config)
+
+
+if __name__ == "__main__":
+    main()

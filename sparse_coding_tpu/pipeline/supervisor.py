@@ -1,0 +1,443 @@
+"""Crash-only pipeline supervisor: journaled harvest→sweep→eval DAG.
+
+The paper's workflow is a long unattended chain — harvest activations,
+train vmapped SAE ensembles, evaluate dictionaries — and at production
+scale (ROADMAP north star; the ensembling papers in PAPERS.md multiply
+sweep count) that chain must survive whole-process death and wedged
+hardware, not only the in-process I/O faults §10 injects. The design is
+**crash-only**: there is no graceful-shutdown path that recovery depends
+on — recovery IS the normal start path.
+
+- every step runs as a **child process** (the unit that dies); the
+  supervisor itself holds no unrecoverable state (journal +
+  artifacts rebuild everything, so the supervisor may also die);
+- each step owns a **lease file** with progress heartbeats
+  (:mod:`resilience.lease`): a restarted supervisor distinguishes
+  "crashed" (owner pid dead → take over) from "hung" (owner alive,
+  heartbeat stale → kill, diagnose) from "still running" (leave alone);
+- a **watchdog** polls the live child's lease; when the heartbeat goes
+  stale it runs the tunnel-wedge diagnosis (socket probe of ports
+  2024/8082/8083, :mod:`resilience.watchdog`) before deciding
+  retry / degrade-to-CPU / halt;
+- steps are **resumable by contract**: harvest resumes from the durable
+  chunk prefix, the sweep from §4/§10's checkpoints — so "retry" is
+  always "respawn the same command", and a completed run's artifacts are
+  bitwise-identical to an uninterrupted one (the chaos matrix,
+  tests/test_pipeline_chaos.py, SIGKILLs a child at every named crash
+  barrier and asserts exactly that).
+
+Execution is deliberately SERIAL (topological order): this container
+admits one jax process at a time (CLAUDE.md), and the DAG's edges here
+are all data dependencies anyway.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Optional, Sequence
+
+from sparse_coding_tpu.pipeline.journal import RunJournal
+from sparse_coding_tpu.resilience import lease as lease_mod
+from sparse_coding_tpu.resilience import watchdog as watchdog_mod
+from sparse_coding_tpu.resilience.errors import ResilienceError
+from sparse_coding_tpu.resilience.lease import lease_state, read_lease, seed_lease
+from sparse_coding_tpu.resilience.watchdog import (
+    DEGRADE_CPU,
+    HALT,
+    RETRY,
+    classify_hang,
+    format_diagnosis,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+class PipelineError(ResilienceError):
+    """Base for typed supervisor failures."""
+
+
+class StepFailed(PipelineError):
+    """A step exhausted its attempt budget (crash, kill, or nonzero exit).
+    The run journal holds the per-attempt record; re-running the
+    supervisor resumes from the durable prefix."""
+
+    def __init__(self, step: str, attempts: int, reason: str):
+        super().__init__(f"step {step!r} failed after {attempts} "
+                         f"attempt(s): {reason}")
+        self.step = step
+        self.attempts = attempts
+        self.reason = reason
+
+
+class StepHung(PipelineError):
+    """The watchdog declared a step hung and the diagnosis said halting is
+    the only safe move (tunnel endpoint reachable but our client wedged —
+    the server-side lease only time clears; see docs/RUNBOOK_TUNNEL.md)."""
+
+    def __init__(self, step: str, diagnosis: dict):
+        super().__init__(f"step {step!r} hung; {format_diagnosis(diagnosis)}")
+        self.step = step
+        self.diagnosis = diagnosis
+
+
+class ConcurrentSupervisorError(PipelineError):
+    """A live, heartbeating lease for a step this supervisor wants to run:
+    another supervisor (or a still-running orphan) owns the run. Refusing
+    is the safe default — two writers on one run dir is undefined."""
+
+
+@dataclass
+class Step:
+    """One journaled pipeline step.
+
+    ``argv`` must be re-runnable from scratch at any instant (the crash-
+    only contract); ``done()`` checks the completion artifact on disk —
+    it, not the journal, is the truth a restarted supervisor trusts.
+    ``degrade_argv`` (optional) is the command used after the watchdog
+    decides degrade-to-CPU (e.g. bench's reduced-scale CPU fallback)."""
+
+    name: str
+    argv: list[str]
+    done: Callable[[], bool]
+    deps: tuple[str, ...] = ()
+    degrade_argv: Optional[list[str]] = None
+    env: dict = field(default_factory=dict)
+
+
+def _toposort(steps: Sequence[Step]) -> list[Step]:
+    by_name = {s.name: s for s in steps}
+    if len(by_name) != len(steps):
+        raise ValueError("duplicate step names")
+    for s in steps:
+        for d in s.deps:
+            if d not in by_name:
+                raise ValueError(f"step {s.name!r} depends on unknown "
+                                 f"step {d!r}")
+    order: list[Step] = []
+    state: dict[str, int] = {}  # 0 visiting, 1 done
+
+    def visit(s: Step):
+        if state.get(s.name) == 1:
+            return
+        if state.get(s.name) == 0:
+            raise ValueError(f"dependency cycle through {s.name!r}")
+        state[s.name] = 0
+        for d in s.deps:
+            visit(by_name[d])
+        state[s.name] = 1
+        order.append(s)
+
+    for s in steps:
+        visit(s)
+    return order
+
+
+def stripped_cpu_env(env: dict) -> dict:
+    """The degrade-to-CPU child environment: axon plugin stripped so the
+    child can never touch the (diagnosed-dead) tunnel, jax pinned to CPU."""
+    env = dict(env)
+    env.pop(watchdog_mod.TUNNEL_ENV, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+class Supervisor:
+    """Run a step DAG with journaling, leases, kill-recovery and a hang
+    watchdog. Construction is cheap and stateless on disk; ``run()`` may
+    be called on a fresh instance over an old run dir — that IS the
+    restart path."""
+
+    def __init__(self, run_dir: str | Path, steps: Sequence[Step], *,
+                 max_attempts: int = 2, heartbeat_stale_s: float = 120.0,
+                 poll_s: float = 0.25, cpu_only: bool = False,
+                 prober=None, clock=time.time):
+        self.run_dir = Path(run_dir)
+        self.steps = _toposort(steps)
+        self.max_attempts = int(max_attempts)
+        self.heartbeat_stale_s = float(heartbeat_stale_s)
+        self.poll_s = float(poll_s)
+        self.cpu_only = bool(cpu_only)
+        self._prober = prober or watchdog_mod.probe_tunnel
+        self._clock = clock
+        self.journal = RunJournal(self.run_dir / "journal.jsonl", clock=clock)
+        (self.run_dir / "logs").mkdir(parents=True, exist_ok=True)
+        (self.run_dir / "leases").mkdir(parents=True, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------------
+
+    def lease_path(self, step: Step) -> Path:
+        return self.run_dir / "leases" / f"{step.name}.json"
+
+    def _log_path(self, step: Step, attempt: int) -> Path:
+        return self.run_dir / "logs" / f"{step.name}.{attempt}.log"
+
+    # -- run -----------------------------------------------------------------
+
+    def run(self) -> dict[str, str]:
+        """Execute every step not already complete; returns
+        ``{step: "done" | "skipped"}``. Raises typed errors on failure —
+        after which calling ``run()`` again (same or new process) resumes."""
+        self.journal.append("run.start",
+                            detail_steps=[s.name for s in self.steps])
+        summary: dict[str, str] = {}
+        for step in self.steps:
+            if step.done():
+                # artifact present: complete, whether or not a journal
+                # record survived (artifacts beat the journal)
+                if step.name not in self.journal.done_steps():
+                    self.journal.append("step.done", step.name,
+                                        note="artifact present at startup")
+                summary[step.name] = "skipped"
+                continue
+            self._takeover_lease(step)
+            self._run_step(step)
+            summary[step.name] = "done"
+        self.journal.append("run.done")
+        return summary
+
+    # -- lease takeover ------------------------------------------------------
+
+    def _takeover_lease(self, step: Step) -> None:
+        path = self.lease_path(step)
+        state = lease_state(path, self.heartbeat_stale_s, clock=self._clock)
+        if state == "missing":
+            return
+        info = read_lease(path)
+        if state == "live":
+            raise ConcurrentSupervisorError(
+                f"step {step.name!r} has a live heartbeating lease "
+                f"(pid {info.pid}); refusing to double-run the pipeline")
+        if state == "stale":
+            # owner alive but not progressing: a hung orphan from a dead
+            # supervisor. SIGKILL it (crash-only: it is resumable) so two
+            # processes never write one step's artifacts.
+            self.journal.append("lease.stale_kill", step.name, pid=info.pid,
+                                beat_age_s=round(self._clock() - info.beat_at,
+                                                 3))
+            _kill_pid(info.pid)
+        else:  # dead
+            self.journal.append("lease.takeover", step.name, pid=info.pid)
+        path.unlink(missing_ok=True)
+
+    # -- one step ------------------------------------------------------------
+
+    def _child_env(self, step: Step, degraded: bool) -> dict:
+        env = dict(os.environ)
+        for key, val in step.env.items():
+            if val is None:  # None = delete (e.g. un-pin JAX_PLATFORMS)
+                env.pop(key, None)
+            else:
+                env[key] = val
+        env[lease_mod.ENV_PATH] = str(self.lease_path(step))
+        if self.cpu_only or degraded:
+            env = stripped_cpu_env(env)
+        return env
+
+    def _run_step(self, step: Step) -> None:
+        degraded = False
+        last_reason = "never spawned"
+        for attempt in range(1, self.max_attempts + 1):
+            argv = (step.degrade_argv
+                    if degraded and step.degrade_argv else step.argv)
+            log_path = self._log_path(step, attempt)
+            env = self._child_env(step, degraded)
+            spawn_argv = list(argv)
+            if env.get(watchdog_mod.TUNNEL_ENV):
+                # tunnel-touching child: serialize on the repo-wide flock
+                # (CLAUDE.md; util-linux flock execs the command in place,
+                # so signal/exit semantics pass through). AXON_LOCK_HELD=1
+                # tells bench.py-style children their lock is already held
+                # (re-acquiring on a second fd of the same file would
+                # self-deadlock). If another holder (e.g. tunnel_watch.sh
+                # mid-measurement) blocks us past heartbeat_stale_s, the
+                # watchdog treats it as a hang and the probe decides —
+                # which is the correct posture toward a busy tunnel.
+                import shutil as _shutil
+
+                if _shutil.which("flock"):
+                    env["AXON_LOCK_HELD"] = "1"
+                    spawn_argv = ["flock", watchdog_mod.TUNNEL_LOCK] \
+                        + spawn_argv
+            self.journal.append("step.spawn", step.name, attempt=attempt,
+                                argv=shlex.join(spawn_argv),
+                                degraded=degraded)
+            with open(log_path, "ab") as log_fh:
+                proc = subprocess.Popen(spawn_argv, cwd=str(REPO_ROOT),
+                                        env=env, stdout=log_fh,
+                                        stderr=subprocess.STDOUT)
+            seed_lease(self.lease_path(step), proc.pid, step=step.name,
+                       clock=self._clock)
+            verdict = self._watch(step, proc)
+            if verdict is None:  # exited on its own
+                rc = proc.returncode
+                if rc == 0 and step.done():
+                    self.journal.append("step.done", step.name,
+                                        attempt=attempt)
+                    self.lease_path(step).unlink(missing_ok=True)
+                    return
+                if rc == 0:
+                    last_reason = ("exit 0 but completion artifact missing "
+                                   "(crash between artifact and marker?)")
+                    self.journal.append("step.failed", step.name,
+                                        attempt=attempt, rc=0,
+                                        reason=last_reason)
+                elif rc < 0:
+                    last_reason = f"killed by signal {-rc}"
+                    self.journal.append("step.killed", step.name,
+                                        attempt=attempt, signal=-rc,
+                                        log=str(log_path))
+                else:
+                    last_reason = f"exit code {rc}"
+                    self.journal.append("step.failed", step.name,
+                                        attempt=attempt, rc=rc,
+                                        log=str(log_path))
+            else:  # watchdog declared it hung and killed it
+                action = verdict["action"]
+                last_reason = f"hung ({action})"
+                if action == HALT:
+                    raise StepHung(step.name, verdict)
+                if action == DEGRADE_CPU:
+                    degraded = True
+        raise StepFailed(step.name, self.max_attempts, last_reason)
+
+    def _watch(self, step: Step, proc: subprocess.Popen) -> Optional[dict]:
+        """Poll child + lease. Returns None when the child exited by
+        itself, or the hang diagnosis dict after killing a hung child.
+        The lease the CHILD rewrites is the progress signal; the seed
+        lease stamped at spawn opens the staleness window immediately, so
+        a child wedged before its first beat (backend init — the known
+        tunnel failure mode) is caught too."""
+        path = self.lease_path(step)
+        while True:
+            if proc.poll() is not None:
+                return None
+            state = lease_state(path, self.heartbeat_stale_s,
+                                clock=self._clock)
+            if state == "stale" or state == "missing":
+                probe = self._prober()
+                diag = {"probe": probe, "action": classify_hang(probe),
+                        "runbook": watchdog_mod.RUNBOOK}
+                self.journal.append("step.hung", step.name, **diag)
+                _kill_pid(proc.pid)
+                proc.wait()
+                path.unlink(missing_ok=True)
+                return diag
+            time.sleep(self.poll_s)
+
+
+def _kill_pid(pid: int) -> None:
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    except PermissionError:
+        pass
+
+
+# -- canonical pipelines -----------------------------------------------------
+
+
+def step_argv(step_name: str, config_path: str | Path) -> list[str]:
+    """Child command for a built-in step (pipeline/steps.py entrypoint)."""
+    return [sys.executable, "-m", "sparse_coding_tpu.pipeline.steps",
+            step_name, "--config", str(config_path)]
+
+
+def build_pipeline(run_dir: str | Path, config: dict,
+                   only: Optional[Sequence[str]] = None) -> list[Step]:
+    """The harvest → sweep → eval DAG over a single config dict (see
+    pipeline/steps.py for the per-step config keys). The config is
+    persisted into the run dir so a restarted supervisor — or an operator
+    — can rebuild the exact same pipeline from disk.
+
+    ``only`` prunes the DAG to a subset (deps on pruned steps are
+    dropped): an operator re-running just the eval over finished sweep
+    artifacts — or the chaos matrix seeding a case from golden copies —
+    names the steps it wants."""
+    import json
+
+    from sparse_coding_tpu.resilience.atomic import atomic_write_text
+
+    run_dir = Path(run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    cfg_path = run_dir / "pipeline.json"
+    atomic_write_text(cfg_path, json.dumps(config, indent=2))
+
+    def anchor(p) -> Path:
+        # children run with cwd=REPO_ROOT, so the supervisor-side done()
+        # probes must resolve relative config paths against the same root
+        # — not against wherever the operator launched the supervisor
+        p = Path(p)
+        return p if p.is_absolute() else REPO_ROOT / p
+
+    dataset = anchor(config["harvest"]["dataset_folder"])
+    sweep_out = anchor(config["sweep"]["ensemble"]["output_folder"])
+    eval_out = anchor(config["eval"]["output_folder"])
+    name = config["sweep"].get("experiment", "dense_l1_range")
+    steps = [
+        Step("harvest", step_argv("harvest", cfg_path),
+             done=lambda: (dataset / "meta.json").exists()),
+        Step("sweep", step_argv("sweep", cfg_path), deps=("harvest",),
+             done=lambda: (sweep_out / "final"
+                           / f"{name}_learned_dicts.pkl").exists()),
+        Step("eval", step_argv("eval", cfg_path), deps=("sweep",),
+             done=lambda: (eval_out / "eval.json").exists()),
+    ]
+    if only is None:
+        return steps
+    keep = set(only)
+    unknown = keep - {s.name for s in steps}
+    if unknown:
+        raise ValueError(f"unknown pipeline steps in only=: {sorted(unknown)}")
+    pruned = []
+    for s in steps:
+        if s.name in keep:
+            s.deps = tuple(d for d in s.deps if d in keep)
+            pruned.append(s)
+    return pruned
+
+
+def supervise_bench(run_dir: str | Path, *, max_attempts: int = 2,
+                    heartbeat_stale_s: Optional[float] = None) -> Path:
+    """bench.py's ``--supervised`` mode: run the bench as a journaled,
+    leased, watchdogged child. The child writes its one-line JSON record
+    to ``<run_dir>/bench.json`` (``BENCH_RESULT_PATH``); a hang — the
+    classic tunnel wedge during backend init — is diagnosed by socket
+    probe, and when the tunnel endpoint is down the retry degrades to the
+    bench's own reduced-scale ``--cpu-fallback`` with the plugin stripped.
+    Returns the result path; the caller prints its content (the stdout
+    contract stays one JSON line)."""
+    run_dir = Path(run_dir)
+    result_path = run_dir / "bench.json"
+    # a benchmark result is per-INVOCATION: the marker is crash-resume
+    # state within one supervised run, never a cache across runs — a
+    # stale bench.json must not masquerade as a fresh measurement
+    result_path.unlink(missing_ok=True)
+    bench_py = str(REPO_ROOT / "bench.py")
+    if heartbeat_stale_s is None:
+        heartbeat_stale_s = float(os.environ.get("BENCH_HANG_S", "420"))
+    env: dict = {"BENCH_RESULT_PATH": str(result_path)}
+    axon = os.environ.get("BENCH_SUPERVISED_AXON", "").strip()
+    if axon:
+        # the parent re-exec'd itself plugin-stripped + cpu-pinned
+        # (bench.py _supervised_main); the CHILD is the one tunnel client,
+        # so it gets the pool IPs back and the cpu pin removed
+        env["PALLAS_AXON_POOL_IPS"] = axon
+        env["JAX_PLATFORMS"] = None
+        env["BENCH_SUPERVISED_REEXEC"] = None
+    step = Step(
+        "bench", [sys.executable, bench_py],
+        done=result_path.exists,
+        degrade_argv=[sys.executable, bench_py, "--cpu-fallback"],
+        env=env)
+    sup = Supervisor(run_dir, [step], max_attempts=max_attempts,
+                     heartbeat_stale_s=heartbeat_stale_s)
+    sup.run()
+    return result_path

@@ -37,8 +37,10 @@ def generate_scores(dict_files: Sequence[str | Path], eval_batch,
                 "l0": float(mean_l0(ld, eval_batch)),
             })
     if out_path is not None:
+        from sparse_coding_tpu.resilience.atomic import atomic_write_text
+
         Path(out_path).parent.mkdir(parents=True, exist_ok=True)
-        Path(out_path).write_text(json.dumps(scores, indent=2))
+        atomic_write_text(out_path, json.dumps(scores, indent=2))
     return scores
 
 

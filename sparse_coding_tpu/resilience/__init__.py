@@ -13,17 +13,35 @@ than assumed:
 - :mod:`atomic`   — tmp+fsync+rename write discipline;
 - :mod:`manifest` — content digests + checkpoint digest manifests;
 - :mod:`breaker`  — the serving circuit breaker;
-- :mod:`preempt`  — SIGTERM → checkpoint-and-exit for sweeps.
+- :mod:`preempt`  — SIGTERM → checkpoint-and-exit for sweeps;
+- :mod:`crash`    — named crash barriers: deterministic whole-process
+  SIGKILL at the Nth hit (``SPARSE_CODING_CRASH_PLAN``);
+- :mod:`lease`    — lease files + progress heartbeats (crashed vs hung
+  vs still-running, for the pipeline supervisor);
+- :mod:`watchdog` — tunnel socket probe + hang classification
+  (retry / degrade-to-CPU / halt).
 
 See docs/ARCHITECTURE.md §10 for the design and the fault-site naming
-scheme; tests/test_resilience.py is the fault-matrix suite.
+scheme (§11 for the crash/lease/watchdog layer);
+tests/test_resilience.py is the fault-matrix suite and
+tests/test_pipeline_chaos.py the process-kill chaos matrix.
 """
 
 from sparse_coding_tpu.resilience.breaker import CircuitBreaker
+from sparse_coding_tpu.resilience.crash import (
+    CRASH_SITES,
+    CrashPlan,
+    CrashSpec,
+    crash_barrier,
+    install_crash_plan,
+    parse_crash_plan,
+    register_crash_site,
+)
 from sparse_coding_tpu.resilience.errors import (
     CheckpointCorruptionError,
     ChunkCorruptionError,
     ResilienceError,
+    UnknownFaultSiteError,
 )
 from sparse_coding_tpu.resilience.faults import (
     FAULT_SITES,
@@ -37,24 +55,50 @@ from sparse_coding_tpu.resilience.faults import (
     register_fault_site,
     reload_from_env,
 )
+from sparse_coding_tpu.resilience.lease import (
+    Lease,
+    LeaseInfo,
+    lease_state,
+    read_lease,
+)
 from sparse_coding_tpu.resilience.preempt import PreemptionGuard, SweepPreempted
 from sparse_coding_tpu.resilience.retry import retry_io
+from sparse_coding_tpu.resilience.watchdog import (
+    classify_hang,
+    diagnose_hang,
+    probe_tunnel,
+)
 
 __all__ = [
+    "CRASH_SITES",
     "CircuitBreaker",
     "CheckpointCorruptionError",
     "ChunkCorruptionError",
+    "CrashPlan",
+    "CrashSpec",
     "FAULT_SITES",
     "FaultPlan",
     "FaultSpec",
     "InjectedFault",
+    "Lease",
+    "LeaseInfo",
     "PreemptionGuard",
     "ResilienceError",
     "SweepPreempted",
+    "UnknownFaultSiteError",
+    "classify_hang",
+    "crash_barrier",
+    "diagnose_hang",
     "fault_point",
     "inject",
+    "install_crash_plan",
     "install_plan",
+    "lease_state",
+    "parse_crash_plan",
     "parse_fault_plan",
+    "probe_tunnel",
+    "read_lease",
+    "register_crash_site",
     "register_fault_site",
     "reload_from_env",
     "retry_io",

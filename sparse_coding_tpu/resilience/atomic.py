@@ -61,3 +61,12 @@ def atomic_save_npy(path: str | Path, arr) -> None:
     buf = io.BytesIO()
     np.save(buf, arr)
     atomic_write_bytes(path, buf.getvalue())
+
+
+def atomic_pickle_dump(path: str | Path, obj) -> None:
+    """pickle.dump with the tmp+fsync+rename discipline (a crash mid-dump
+    to the final path leaves a truncated pickle another process would
+    choke on)."""
+    import pickle
+
+    atomic_write_bytes(path, pickle.dumps(obj))

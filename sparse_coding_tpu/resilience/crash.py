@@ -1,0 +1,172 @@
+"""Named crash barriers: deterministic whole-process SIGKILL injection.
+
+PR 2's fault sites exercise *in-process* failure handlers; this module
+exercises the crash-only contract itself — "any process may die at any
+instruction". A **crash barrier** is a single ``crash_barrier(site)`` call
+placed immediately after (or between) the durable effects whose ordering
+the recovery story depends on. A :class:`CrashPlan` — installed in code or
+via ``SPARSE_CODING_CRASH_PLAN`` (same Nth-hit grammar as
+``SPARSE_CODING_FAULT_PLAN``, keys ``nth``/``count`` only) — SIGKILLs the
+process at exactly the Nth hit of a site. SIGKILL is uncatchable: no
+``atexit``, no buffers flushed, no finally blocks — the honest model of a
+kill -9, an OOM kill, or a power cut.
+
+Canonical sites (hosts register theirs at import, like fault sites):
+
+====================  =====================================================
+``chunk.flushed``     ChunkWriter._write — a chunk file + digest just
+                      became durable; the next instruction never runs
+``store.finalize``    ChunkWriter.finalize — all chunks durable, meta.json
+                      (the completeness marker) NOT yet written
+``sweep.chunk``       train/sweep.py — end of one chunk's training +
+                      checkpoint + artifact block
+``ckpt.swap``         _swap_in_checkpoint_set — after ckpt/ was renamed to
+                      ckpt_prev/, before staging/ was renamed to ckpt/
+                      (the worst instant of the checkpoint-set swap)
+``eval.write``        pipeline eval step — results computed, output file
+                      NOT yet written
+====================  =====================================================
+
+The chaos matrix (tests/test_pipeline_chaos.py, marker ``chaos``) kills a
+real subprocess at every barrier, restarts the supervisor, and asserts the
+completed run's artifacts are bitwise-identical to an uninterrupted run.
+
+Hit counting is per-process: a resumed child starts fresh counters, so a
+plan that kills at ``nth=2`` kills every attempt at its own 2nd hit —
+useful for proving forward progress under repeated kills.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from sparse_coding_tpu.resilience.errors import UnknownFaultSiteError
+from sparse_coding_tpu.resilience.faults import parse_plan_entries
+
+ENV_VAR = "SPARSE_CODING_CRASH_PLAN"
+
+# site name -> one-line description; hosts add theirs via register_crash_site
+CRASH_SITES: dict[str, str] = {
+    "chunk.flushed": "a chunk file + digest just became durable "
+                     "(ChunkWriter._write)",
+    "store.finalize": "all chunks durable, meta.json not yet written "
+                      "(ChunkWriter.finalize)",
+    "sweep.chunk": "end of one sweep chunk's train+checkpoint+artifact block",
+    "ckpt.swap": "mid checkpoint-set swap: old set renamed to ckpt_prev/, "
+                 "new set not yet renamed in",
+    "eval.write": "eval results computed, output not yet written",
+}
+
+
+def register_crash_site(name: str, description: str) -> str:
+    """Idempotently register a crash site (host modules call this at
+    import, mirroring ``register_fault_site``)."""
+    CRASH_SITES[name] = description
+    return name
+
+
+@dataclass(frozen=True)
+class CrashSpec:
+    """SIGKILL the process on hits ``nth .. nth+count-1`` of ``site``."""
+
+    site: str
+    nth: int = 1
+    count: int = 1
+
+    def __post_init__(self):
+        if self.site not in CRASH_SITES:
+            raise UnknownFaultSiteError(self.site, CRASH_SITES, kind="crash")
+        if self.nth < 1:
+            raise ValueError("nth is 1-based and must be >= 1")
+        if self.count < 0:
+            raise ValueError("count must be >= 0 (0 = every hit from nth)")
+
+    def fires_on(self, hit: int) -> bool:
+        if hit < self.nth:
+            return False
+        return self.count == 0 or hit < self.nth + self.count
+
+
+@dataclass
+class CrashPlan:
+    """Installed set of :class:`CrashSpec`s with per-site hit counters
+    (lock-protected, so counting is deterministic across threads)."""
+
+    specs: list[CrashSpec] = field(default_factory=list)
+    hits: dict[str, int] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def hit(self, site: str) -> Optional[CrashSpec]:
+        with self._lock:
+            n = self.hits.get(site, 0) + 1
+            self.hits[site] = n
+            for spec in self.specs:
+                if spec.site == site and spec.fires_on(n):
+                    return spec
+        return None
+
+
+def parse_crash_plan(text: str) -> CrashPlan:
+    """Same grammar as ``SPARSE_CODING_FAULT_PLAN`` (compact or JSON), keys
+    ``nth``/``count`` only. Unknown sites raise the typed
+    :class:`UnknownFaultSiteError` eagerly."""
+    entries = parse_plan_entries(text, keys=("nth", "count"),
+                                 int_keys=("nth", "count"),
+                                 label="crash-plan")
+    return CrashPlan(specs=[CrashSpec(**e) for e in entries])
+
+
+_active: Optional[CrashPlan] = None
+_env_checked = False
+_install_lock = threading.Lock()
+
+
+def active_crash_plan() -> Optional[CrashPlan]:
+    """The installed plan; lazily loads ``SPARSE_CODING_CRASH_PLAN`` once
+    if nothing was installed in code (same lifecycle as fault plans)."""
+    global _active, _env_checked
+    if _active is None and not _env_checked:
+        with _install_lock:
+            if _active is None and not _env_checked:
+                text = os.environ.get(ENV_VAR, "").strip()
+                if text:
+                    _active = parse_crash_plan(text)
+                _env_checked = True
+    return _active
+
+
+def install_crash_plan(plan: Optional[CrashPlan]) -> Optional[CrashPlan]:
+    """Install (or with None, clear) the active plan; returns the previous
+    one. Re-arms the env lookup so clearing in tests is hermetic."""
+    global _active, _env_checked
+    with _install_lock:
+        prev, _active = _active, plan
+        _env_checked = True
+    return prev
+
+
+def _kill_self(site: str) -> None:  # monkeypatchable in unit tests
+    # stderr is unbuffered-ish and the write is best-effort: SIGKILL gives
+    # no other chance to leave a breadcrumb for the supervisor's step log
+    try:
+        sys.stderr.write(f"crash_barrier: SIGKILL at site {site!r}\n")
+        sys.stderr.flush()
+    except Exception:
+        pass
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def crash_barrier(site: str) -> None:
+    """The single hook every crash-tested path calls. No-op without an
+    active plan; SIGKILLs the process (uncatchable, nothing flushed) when
+    the plan covers this hit."""
+    plan = active_crash_plan()
+    if plan is None:
+        return
+    if plan.hit(site) is not None:
+        _kill_self(site)

@@ -17,6 +17,21 @@ class ResilienceError(RuntimeError):
     """Base class for typed resilience-layer failures."""
 
 
+class UnknownFaultSiteError(ResilienceError, ValueError):
+    """A fault/crash plan named a site no module registered. Raised eagerly
+    at plan parse (env or code) — a typo in ``SPARSE_CODING_FAULT_PLAN`` /
+    ``SPARSE_CODING_CRASH_PLAN`` would otherwise disable the injection
+    without warning, and an untested fault plan is worse than none.
+    Subclasses ValueError so pre-existing ``except ValueError`` callers and
+    tests keep working."""
+
+    def __init__(self, site: str, registered, kind: str = "fault"):
+        super().__init__(
+            f"unknown {kind} site {site!r} (registered: {sorted(registered)})")
+        self.site = site
+        self.kind = kind
+
+
 class ChunkCorruptionError(ResilienceError):
     """A chunk file's content does not match the digest recorded in
     meta.json at finalize (or the file is structurally unreadable).

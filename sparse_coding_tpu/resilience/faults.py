@@ -49,7 +49,9 @@ import json
 import os
 import threading
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Optional, Sequence
+
+from sparse_coding_tpu.resilience.errors import UnknownFaultSiteError
 
 ENV_VAR = "SPARSE_CODING_FAULT_PLAN"
 
@@ -110,9 +112,9 @@ class FaultSpec:
 
     def __post_init__(self):
         if self.site not in FAULT_SITES:
-            raise ValueError(
-                f"unknown fault site {self.site!r} "
-                f"(registered: {sorted(FAULT_SITES)})")
+            # typed + eager: a typo'd site in SPARSE_CODING_FAULT_PLAN must
+            # fail the plan parse loudly, never silently disable the fault
+            raise UnknownFaultSiteError(self.site, FAULT_SITES, kind="fault")
         if self.mode not in ("error", "corrupt"):
             raise ValueError(f"unknown fault mode {self.mode!r}")
         if self.mode == "error" and self.error not in _ERROR_BASES:
@@ -257,18 +259,20 @@ def fault_point(site: str, payload=None):
     return _corrupt_payload(payload, spec)
 
 
-def parse_fault_plan(text: str) -> FaultPlan:
-    """Parse the env-var / CLI plan syntax (JSON list or compact
-    ``site:key=val,...;site2:...`` string) into a validated plan."""
+def parse_plan_entries(text: str, keys: Sequence[str],
+                       int_keys: Sequence[str],
+                       label: str = "fault-plan") -> list[dict]:
+    """Shared plan grammar (JSON list or compact ``site:key=val,...;...``)
+    -> a list of spec-kwargs dicts. `SPARSE_CODING_FAULT_PLAN` and
+    `SPARSE_CODING_CRASH_PLAN` use the same Nth-hit grammar; `keys` names
+    the spec fields each accepts."""
     text = text.strip()
-    specs: list[FaultSpec] = []
     if text.startswith("[") or text.startswith("{"):
         raw = json.loads(text)
         if isinstance(raw, dict):
             raw = [raw]
-        for entry in raw:
-            specs.append(FaultSpec(**entry))
-        return FaultPlan(specs=specs)
+        return [dict(entry) for entry in raw]
+    entries: list[dict] = []
     for entry in text.split(";"):
         entry = entry.strip()
         if not entry:
@@ -276,14 +280,21 @@ def parse_fault_plan(text: str) -> FaultPlan:
         site, _, rest = entry.partition(":")
         kwargs: dict = {"site": site.strip()}
         for pair in filter(None, (p.strip() for p in rest.split(","))):
-            key, _, val = pair.partition("=")
-            if not _ or key not in ("nth", "count", "mode", "error",
-                                    "message", "seed"):
+            key, sep, val = pair.partition("=")
+            if not sep or key not in keys:
                 raise ValueError(
-                    f"bad fault-plan pair {pair!r} in entry {entry!r} "
-                    "(expected key=value with key in nth/count/mode/"
-                    "error/message/seed)")
-            kwargs[key] = (int(val) if key in ("nth", "count", "seed")
-                           else val)
-        specs.append(FaultSpec(**kwargs))
-    return FaultPlan(specs=specs)
+                    f"bad {label} pair {pair!r} in entry {entry!r} "
+                    f"(expected key=value with key in {'/'.join(keys)})")
+            kwargs[key] = int(val) if key in int_keys else val
+        entries.append(kwargs)
+    return entries
+
+
+def parse_fault_plan(text: str) -> FaultPlan:
+    """Parse the env-var / CLI plan syntax (JSON list or compact
+    ``site:key=val,...;site2:...`` string) into a validated plan. Unknown
+    site names raise a typed :class:`UnknownFaultSiteError` eagerly."""
+    entries = parse_plan_entries(
+        text, keys=("nth", "count", "mode", "error", "message", "seed"),
+        int_keys=("nth", "count", "seed"), label="fault-plan")
+    return FaultPlan(specs=[FaultSpec(**e) for e in entries])

@@ -1,0 +1,189 @@
+"""Lease files with progress heartbeats: crashed vs hung vs still-running.
+
+A supervisor that restarts after its own death (or watches a live child)
+needs to answer one question about a step it did not just spawn: is the
+process that owns this step **still making progress**? The lease file is
+that answer on disk:
+
+- the step's process atomically rewrites ``<lease>.json`` (tmp+fsync+
+  rename, :mod:`resilience.atomic`) at every **real progress point** —
+  a chunk flushed, a training chunk finished, a bench window timed;
+- a reader classifies the lease: ``missing`` (no claim), ``dead`` (owner
+  pid gone — it crashed; take over), ``stale`` (owner alive but the
+  heartbeat is old — it is hung; kill + diagnose), ``live`` (leave it
+  alone).
+
+Heartbeats are deliberately emitted from the WORK LOOP on the main thread,
+never from a side thread: the canonical hang here is the axon TPU tunnel
+wedging a process inside ``make_c_api_client`` (CLAUDE.md) — a side-thread
+heartbeat would keep beating through exactly the hang the watchdog exists
+to catch. Hosts call the module-level :func:`beat` (a no-op unless
+``SPARSE_CODING_LEASE_PATH`` is set, so library code stays supervisor-
+agnostic); rewrites are throttled to one per ``interval_s``.
+
+pid liveness is same-host only (``os.kill(pid, 0)``); the supervisor and
+its steps share a machine by construction (one TPU tunnel per host).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+from sparse_coding_tpu.resilience.atomic import atomic_write_text
+
+ENV_PATH = "SPARSE_CODING_LEASE_PATH"
+ENV_INTERVAL = "SPARSE_CODING_LEASE_INTERVAL_S"
+
+
+@dataclass
+class LeaseInfo:
+    """One parsed lease file."""
+
+    pid: int
+    host: str
+    step: str
+    started_at: float
+    beat_at: float
+    seq: int
+
+
+class Lease:
+    """Writer side: the step process's claim on its unit of work."""
+
+    def __init__(self, path: str | Path, step: str = "",
+                 interval_s: float = 1.0, clock=time.time):
+        self.path = Path(path)
+        self.step = step
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._started = clock()
+        self._last_write = 0.0
+        self._seq = 0
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.beat(force=True)  # claim immediately: a spawned-but-not-yet-
+        # progressing step must look "live", not "missing"
+
+    def beat(self, force: bool = False) -> None:
+        """Record progress. Throttled to one atomic rewrite per
+        ``interval_s`` so per-batch call sites stay cheap."""
+        now = self._clock()
+        if not force and now - self._last_write < self.interval_s:
+            return
+        self._seq += 1
+        atomic_write_text(self.path, json.dumps({
+            "pid": os.getpid(), "host": socket.gethostname(),
+            "step": self.step, "started_at": self._started,
+            "beat_at": now, "seq": self._seq}))
+        self._last_write = now
+
+    def release(self) -> None:
+        self.path.unlink(missing_ok=True)
+
+
+def read_lease(path: str | Path) -> Optional[LeaseInfo]:
+    """Parse a lease file; None when missing or unreadable (an unreadable
+    lease means no valid claim — atomic writes make torn files impossible,
+    so garbage is pre-takeover debris)."""
+    try:
+        raw = json.loads(Path(path).read_text())
+        return LeaseInfo(pid=int(raw["pid"]), host=str(raw.get("host", "")),
+                         step=str(raw.get("step", "")),
+                         started_at=float(raw.get("started_at", 0.0)),
+                         beat_at=float(raw["beat_at"]),
+                         seq=int(raw.get("seq", 0)))
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, other uid
+    return True
+
+
+def lease_state(path: str | Path, stale_after_s: float,
+                clock=time.time) -> str:
+    """Classify a lease: ``missing`` | ``dead`` | ``stale`` | ``live``.
+
+    ``dead`` = owner pid gone (crashed — safe takeover). ``stale`` = owner
+    alive but no heartbeat for ``stale_after_s`` (hung — kill before
+    takeover). Wall-clock staleness is same-host comparable; a beat_at in
+    the future (clock step) counts as fresh rather than poisoning the
+    window."""
+    info = read_lease(path)
+    if info is None:
+        return "missing"
+    if not pid_alive(info.pid):
+        return "dead"
+    if clock() - info.beat_at > stale_after_s:
+        return "stale"
+    return "live"
+
+
+def seed_lease(path: str | Path, pid: int, step: str = "",
+               clock=time.time) -> None:
+    """Supervisor-side: stamp a just-spawned child's claim so the hang
+    window opens at spawn time — the child overwrites with its own beats
+    once its interpreter is up (jax import time counts against the stale
+    budget by design: a child wedged in backend init never beats)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    now = clock()
+    atomic_write_text(path, json.dumps({
+        "pid": int(pid), "host": socket.gethostname(), "step": step,
+        "started_at": now, "beat_at": now, "seq": 0}))
+
+
+# -- module-global heartbeat hook (host work loops call beat()) --------------
+
+_active: Optional[Lease] = None
+_env_checked = False
+
+
+def configure(lease: Optional[Lease]) -> Optional[Lease]:
+    """Install (or clear) the process's active lease; returns the previous
+    one. Explicit configuration wins over the env lookup."""
+    global _active, _env_checked
+    prev, _active = _active, lease
+    _env_checked = True
+    return prev
+
+
+def configure_from_env(step: str = "") -> Optional[Lease]:
+    """Create the process lease from ``SPARSE_CODING_LEASE_PATH`` (no-op
+    returning None when unset)."""
+    path = os.environ.get(ENV_PATH, "").strip()
+    if not path:
+        configure(None)
+        return None
+    interval = float(os.environ.get(ENV_INTERVAL, "1.0"))
+    lease = Lease(path, step=step, interval_s=interval)
+    configure(lease)
+    return lease
+
+
+def beat() -> None:
+    """Progress heartbeat for hosted work loops (harvest drain, sweep chunk
+    loop, bench timing windows). Lazily self-configures from the env on
+    first call so hosts need no supervisor plumbing; near-zero cost when no
+    lease is configured."""
+    global _env_checked
+    if _active is None:
+        if _env_checked:
+            return
+        _env_checked = True
+        if configure_from_env() is None:
+            return
+    _active.beat()

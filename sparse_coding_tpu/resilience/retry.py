@@ -19,12 +19,24 @@ TRANSIENT_IO_ERRORS: tuple[type, ...] = (OSError,)  # incl. Timeout/Connection
 def retry_io(fn: Callable, *, attempts: int = 3, base_delay_s: float = 0.01,
              retry_on: Sequence[type] = TRANSIENT_IO_ERRORS,
              sleep: Callable[[float], None] = time.sleep,
-             on_retry: Optional[Callable[[int, BaseException], None]] = None):
+             on_retry: Optional[Callable[[int, BaseException], None]] = None,
+             jitter: float = 0.0, rng=None):
     """Call ``fn()`` with up to ``attempts`` tries and exponential backoff
     (``base_delay_s * 2**i`` between tries). The last failure propagates
-    unchanged — bounded means bounded, no infinite-retry hangs."""
+    unchanged — bounded means bounded, no infinite-retry hangs.
+
+    ``jitter`` > 0 scales each delay by ``1 + U[0, jitter)`` drawn from
+    ``rng`` (a ``numpy.random.Generator``; required when jitter is set) —
+    decorrelates a herd of clients retrying the same shared resource. The
+    backoff stays DETERMINISTIC under a seeded rng: same seed, same delay
+    sequence (tests/test_resilience.py pins this)."""
     if attempts < 1:
         raise ValueError("attempts must be >= 1")
+    if jitter < 0:
+        raise ValueError("jitter must be >= 0")
+    if jitter > 0 and rng is None:
+        raise ValueError("jitter needs an explicit seeded rng — an implicit "
+                         "global RNG would make retry timing irreproducible")
     retry_on = tuple(retry_on)
     for i in range(attempts):
         try:
@@ -34,4 +46,7 @@ def retry_io(fn: Callable, *, attempts: int = 3, base_delay_s: float = 0.01,
                 raise
             if on_retry is not None:
                 on_retry(i, e)
-            sleep(base_delay_s * (2 ** i))
+            delay = base_delay_s * (2 ** i)
+            if jitter > 0:
+                delay *= 1.0 + jitter * float(rng.random())
+            sleep(delay)

@@ -1,0 +1,106 @@
+"""Hang diagnosis: socket-probe the TPU tunnel, then decide what a hung
+step means.
+
+The canonical hang on this container is the axon TPU tunnel: its plugin
+initializes inside every jax process and a wedged tunnel blocks the
+process forever in ``make_c_api_client`` at ~0% CPU (CLAUDE.md). The
+repo's diagnosis recipe — probe ports 2024/8082/8083 with a socket
+connect *before theorizing* — is implemented here as data, so the
+pipeline supervisor (and ``__graft_entry__``'s dryrun watchdog) can act
+on it mechanically:
+
+- tunnel **not configured** (no ``PALLAS_AXON_POOL_IPS``): the hang is
+  not tunnel-related → **retry** the step;
+- tunnel configured but **unreachable**: the endpoint is down → a retry
+  would wedge again; **degrade to CPU** (respawn with the plugin
+  stripped) so the run completes with a labeled CPU artifact;
+- tunnel configured and **reachable**: the endpoint answers but our
+  client is stuck — the known server-side session-lease wedge, which
+  nothing local clears → **halt** and point the operator at the runbook
+  (a retrying client would become the second tunnel process that wedges
+  it harder).
+
+This module must stay import-light (no jax): it runs inside watchdog
+threads while the main thread may be stuck in native code.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional, Sequence
+
+TUNNEL_ENV = "PALLAS_AXON_POOL_IPS"
+TUNNEL_PORTS = (2024, 8082, 8083)
+# repo-wide tunnel mutual exclusion (CLAUDE.md): every tunnel-touching
+# process serializes on this flock
+TUNNEL_LOCK = "/tmp/axon_tunnel.lock"
+RUNBOOK = "docs/RUNBOOK_TUNNEL.md"
+
+# classify_hang verdicts
+RETRY = "retry"
+DEGRADE_CPU = "degrade-cpu"
+HALT = "halt"
+
+
+def tunnel_hosts(env: Optional[dict] = None) -> list[str]:
+    """Hosts from ``PALLAS_AXON_POOL_IPS`` (comma/space separated)."""
+    raw = (env if env is not None else os.environ).get(TUNNEL_ENV, "")
+    return [h for h in raw.replace(",", " ").split() if h]
+
+
+def probe_tunnel(hosts: Optional[Sequence[str]] = None,
+                 ports: Sequence[int] = TUNNEL_PORTS,
+                 timeout_s: float = 2.0, connect=None) -> dict:
+    """Socket-connect every host:port; returns a JSON-able report:
+    ``{"configured", "endpoints": {"h:p": bool}, "reachable"}``.
+    ``connect`` is injectable for deterministic tests."""
+    if hosts is None:
+        hosts = tunnel_hosts()
+    if connect is None:
+        connect = socket.create_connection
+    endpoints: dict[str, bool] = {}
+    for host in hosts:
+        for port in ports:
+            key = f"{host}:{port}"
+            try:
+                conn = connect((host, int(port)), timeout_s)
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                endpoints[key] = True
+            except OSError:
+                endpoints[key] = False
+    return {"configured": bool(hosts), "endpoints": endpoints,
+            "reachable": any(endpoints.values())}
+
+
+def classify_hang(probe: dict) -> str:
+    """Map a :func:`probe_tunnel` report to a supervisor action (see the
+    module docstring for the reasoning): RETRY | DEGRADE_CPU | HALT."""
+    if not probe.get("configured"):
+        return RETRY
+    if not probe.get("reachable"):
+        return DEGRADE_CPU
+    return HALT
+
+
+def diagnose_hang(prober=probe_tunnel) -> dict:
+    """One-call hang diagnosis: probe + verdict + the operator pointer,
+    shaped for journaling."""
+    probe = prober()
+    action = classify_hang(probe)
+    return {"probe": probe, "action": action, "runbook": RUNBOOK}
+
+
+def format_diagnosis(diag: dict) -> str:
+    probe = diag.get("probe", {})
+    up = [k for k, v in probe.get("endpoints", {}).items() if v]
+    down = [k for k, v in probe.get("endpoints", {}).items() if not v]
+    if not probe.get("configured"):
+        detail = "tunnel not configured (no PALLAS_AXON_POOL_IPS)"
+    else:
+        detail = (f"tunnel endpoints up={up or 'none'} down={down or 'none'}")
+    return (f"hang diagnosis: {detail}; action={diag.get('action')}; "
+            f"see {diag.get('runbook', RUNBOOK)}")

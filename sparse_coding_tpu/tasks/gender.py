@@ -36,8 +36,9 @@ def preprocess_gender_dataset(csv_path: str | Path, tokenizer,
                 entries.append(entry)
     result = (max_tok_len, entries)
     if out_path is not None:
-        with open(out_path, "wb") as f:
-            pickle.dump(result, f)
+        from sparse_coding_tpu.resilience.atomic import atomic_pickle_dump
+
+        atomic_pickle_dump(out_path, result)
     return result
 
 

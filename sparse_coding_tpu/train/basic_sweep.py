@@ -111,7 +111,9 @@ def _save_epoch(ens: Ensemble, l1_values, dict_ratio, store: ChunkStore,
                       "l0": float(mean_l0(ld, eval_batch))})
     import json
 
-    (out / "eval.json").write_text(json.dumps(stats, indent=2))
+    from sparse_coding_tpu.resilience.atomic import atomic_write_text
+
+    atomic_write_text(out / "eval.json", json.dumps(stats, indent=2))
 
 
 def main(argv=None) -> None:

@@ -45,6 +45,9 @@ from sparse_coding_tpu.metrics.core import (
     mmcs_from_list,
 )
 from sparse_coding_tpu.parallel.mesh import batch_sharding, make_mesh
+from sparse_coding_tpu.resilience import lease
+from sparse_coding_tpu.resilience.atomic import atomic_save_npy, atomic_write_text
+from sparse_coding_tpu.resilience.crash import crash_barrier, register_crash_site
 from sparse_coding_tpu.resilience.errors import CheckpointCorruptionError
 from sparse_coding_tpu.resilience.preempt import PreemptionGuard, SweepPreempted
 from sparse_coding_tpu.utils.artifacts import save_learned_dicts
@@ -54,6 +57,14 @@ from sparse_coding_tpu.utils.logging import MetricsLogger
 from sparse_coding_tpu.utils.profiling import StepTimer
 
 logger_mod = logging.getLogger(__name__)
+
+register_crash_site("sweep.chunk",
+                    "end of one sweep chunk's train+checkpoint+artifact "
+                    "block (train/sweep.py)")
+register_crash_site("ckpt.swap",
+                    "mid checkpoint-set swap: old set renamed to "
+                    "ckpt_prev/, new set not yet renamed in "
+                    "(_swap_in_checkpoint_set)")
 
 EnsembleLike = Union[Ensemble, EnsembleGroup]
 # ensemble_init_fn(cfg, mesh) -> list of (ensemble, per-member hyperparams, name)
@@ -84,7 +95,8 @@ def init_synthetic_dataset(cfg: SyntheticEnsembleArgs) -> ChunkStore:
         writer.add(jax.device_get(gen.batch(sub, n)))
         remaining -= n
     writer.finalize({"synthetic": True})
-    np.save(folder / "ground_truth_feats.npy", jax.device_get(gen.feats))
+    atomic_save_npy(folder / "ground_truth_feats.npy",
+                    jax.device_get(gen.feats))
     return ChunkStore(folder)
 
 
@@ -155,6 +167,10 @@ def _swap_in_checkpoint_set(out_dir: Path, staging: Path) -> None:
     if ckpt_dir.exists():
         shutil.rmtree(prev, ignore_errors=True)
         ckpt_dir.rename(prev)
+    # the swap's worst instant: ckpt/ is gone, the new set not yet named in
+    # — a kill here must leave resume falling back to ckpt_prev/ (chaos
+    # matrix site; tests/test_pipeline_chaos.py)
+    crash_barrier("ckpt.swap")
     staging.rename(ckpt_dir)
 
 
@@ -360,6 +376,10 @@ def sweep(
                             logger.log(rec, step=step)
                 timer.tick(batch.shape[0] * (batch.shape[1]
                                              if scan_k > 1 else 1))
+                # supervised runs: each completed training window is
+                # progress (throttled inside; a hang anywhere in the
+                # dispatch→sync path stops these beats)
+                lease.beat()
                 if do_log:
                     logger.log({"activations_per_sec": timer.items_per_sec},
                                step=step)
@@ -419,6 +439,9 @@ def sweep(
                                 logger,
                                 image_metrics=image_metrics_every is not None
                                 and (ci + 1) % image_metrics_every == 0)
+            # one chunk's full train+checkpoint+artifact block is durable —
+            # the crash-resume unit the chaos matrix kills at
+            crash_barrier("sweep.chunk")
             if preempted and not last_chunk:
                 # checkpoint for chunks 0..ci is issued (and for msgpack
                 # already swapped in); exit cleanly so resume continues
@@ -487,7 +510,8 @@ def _save_artifacts(ensembles, folder: Path, chunk: np.ndarray,
                              if isinstance(v, (int, float, str))},
                           "fvu": float(fraction_variance_unexplained(ld, eval_batch)),
                           "l0": float(mean_l0(ld, eval_batch))})
-        (folder / f"{name}_eval.json").write_text(json.dumps(evals, indent=2))
+        atomic_write_text(folder / f"{name}_eval.json",
+                          json.dumps(evals, indent=2))
         if image_metrics:
             # MMCS grid + per-dict sparsity histograms (reference's wandb
             # image panels, big_sweep.py:86-156, as files)
@@ -495,7 +519,7 @@ def _save_artifacts(ensembles, folder: Path, chunk: np.ndarray,
 
             if len(dicts) > 1:
                 grid = np.asarray(mmcs_from_list(dicts[: min(len(dicts), 8)]))
-                np.save(folder / f"{name}_mmcs_grid.npy", grid)
+                atomic_save_npy(folder / f"{name}_mmcs_grid.npy", grid)
             for di, ld in enumerate(dicts):
                 freqs = mean_nonzero_activations(ld, eval_batch)
                 plot_hist(jnp.log10(jnp.clip(freqs, 1e-6)),

@@ -67,9 +67,12 @@ def run_toy_replication(cfg: ToyArgs, l1_values=None,
     if output_folder is not None:
         import json
 
+        from sparse_coding_tpu.resilience.atomic import atomic_write_text
+
         out = Path(output_folder)
         out.mkdir(parents=True, exist_ok=True)
-        (out / "toy_recovery.json").write_text(json.dumps(results, indent=2))
+        atomic_write_text(out / "toy_recovery.json",
+                          json.dumps(results, indent=2))
         _plot_recovery(results, out / "toy_recovery.png")
     return results
 

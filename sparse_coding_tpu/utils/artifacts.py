@@ -18,6 +18,8 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 import numpy as np
 
+from sparse_coding_tpu.resilience.atomic import atomic_pickle_dump
+
 ARTIFACT_NAME = "learned_dicts.pkl"
 
 
@@ -60,8 +62,9 @@ def save_learned_dicts(dicts: Sequence[tuple[Any, dict]], path: str | Path) -> N
                         "static": static, "hyperparams": dict(hyper)})
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    with path.open("wb") as fh:
-        pickle.dump(records, fh)
+    # atomic: sweeps re-save this artifact at every save point while other
+    # processes (serving registry, eval steps) may be loading it
+    atomic_pickle_dump(path, records)
 
 
 def load_learned_dicts(path: str | Path,

@@ -43,6 +43,7 @@ from typing import Optional
 import jax
 
 from sparse_coding_tpu.ensemble import Ensemble, EnsembleState
+from sparse_coding_tpu.resilience.atomic import atomic_write_text
 from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
 from sparse_coding_tpu.resilience.manifest import (
     verify_dir_manifest,
@@ -117,8 +118,8 @@ class AsyncEnsembleCheckpointer:
             meta = {"sig_name": state.sig_name,
                     "static_buffers": list(state.static_buffers),
                     **(extra or {})}
-            _meta_path(path).write_text(
-                json.dumps(meta, indent=2, default=str))
+            atomic_write_text(_meta_path(path),
+                              json.dumps(meta, indent=2, default=str))
 
     def restore(self, ens: Ensemble, path: str | Path) -> dict:
         """Restore in-place into a freshly-constructed, same-shape Ensemble

@@ -47,6 +47,12 @@ from typing import Any
 
 import numpy as np
 
+from sparse_coding_tpu.resilience.atomic import (
+    atomic_pickle_dump,
+    atomic_save_npy,
+    atomic_write_text,
+)
+
 _REF_MODULE_PREFIXES = ("autoencoders", "torchtyping", "test_datasets")
 
 
@@ -499,9 +505,10 @@ def import_reference_chunks(src: str | Path, dst: str | Path,
     for i, p in enumerate(paths):
         arr = read_pt_chunk(p, dtype=np_dtype)
         dim = arr.shape[-1] if dim is None else dim
-        np.save(dst / f"{i}.npy", arr)
+        atomic_save_npy(dst / f"{i}.npy", arr)
     meta = {"activation_dim": int(dim), "dtype": str(np_dtype),
             "n_chunks": len(paths), "centered": False,
             "source": str(src), "format": "pt-import"}
-    (dst / "meta.json").write_text(json.dumps(meta, indent=2))
+    # meta.json last: its presence certifies a complete imported store
+    atomic_write_text(dst / "meta.json", json.dumps(meta, indent=2))
     return len(paths)

@@ -1,0 +1,246 @@
+"""Process-kill chaos matrix (marker ``chaos``): for EVERY named crash
+barrier, SIGKILL a real subprocess exactly there (``SPARSE_CODING_CRASH_
+PLAN``), restart the supervisor, and assert the completed run's artifacts
+— chunks, checkpoints, final dicts, eval outputs — are **bitwise
+identical** to an uninterrupted run's. This is the acceptance gate of the
+crash-only pipeline tentpole: "any process may die at any instruction"
+reduced to deterministic, CI-runnable cases.
+
+Children run with the test process's (axon-stripped, CPU) environment and
+strictly serially — the repo's one-jax-process rule. The golden
+(uninterrupted) artifacts are produced in-process through the same step
+functions the children run, which keeps the suite ~2 subprocesses per
+barrier.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+from pathlib import Path
+
+import pytest
+
+from sparse_coding_tpu.pipeline import (
+    StepFailed,
+    Supervisor,
+    build_pipeline,
+)
+from sparse_coding_tpu.pipeline.steps import run_eval, run_harvest, run_sweep
+from sparse_coding_tpu.resilience import crash as crash_mod
+from sparse_coding_tpu.resilience import lease as lease_mod
+
+pytestmark = [pytest.mark.chaos, pytest.mark.faults]
+
+STALE_S = 300.0  # watchdog off: these cases test kill-recovery, not hangs
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_plans(monkeypatch):
+    monkeypatch.delenv(crash_mod.ENV_VAR, raising=False)
+    monkeypatch.delenv(lease_mod.ENV_PATH, raising=False)
+    yield
+    crash_mod.install_crash_plan(None)
+    lease_mod.configure(None)
+
+
+def _config(base: Path) -> dict:
+    return {
+        "harvest": {"mode": "synthetic",
+                    "dataset_folder": str(base / "chunks"),
+                    "activation_dim": 16, "n_ground_truth_features": 24,
+                    "feature_num_nonzero": 5, "feature_prob_decay": 0.99,
+                    "dataset_size": 2048, "n_chunks": 4, "batch_rows": 512,
+                    "seed": 0},
+        "sweep": {"experiment": "dense_l1_range",
+                  "ensemble": {"output_folder": str(base / "sweep"),
+                               "dataset_folder": str(base / "chunks"),
+                               "batch_size": 128, "n_chunks": 4,
+                               "learned_dict_ratio": 2.0, "tied_ae": True,
+                               "checkpoint_every_chunks": 1, "seed": 0},
+                  "log_every": 1000},
+        "eval": {"output_folder": str(base / "eval"), "n_eval_rows": 512,
+                 "seed": 0},
+    }
+
+
+# artifact families compared bitwise; config.json/metrics.jsonl are
+# excluded (absolute paths / timestamps — not data artifacts)
+_FAMILIES = {
+    "chunks": ["*.npy", "meta.json"],
+    "sweep": ["final/*.pkl", "ckpt/*", "ckpt_prev/*", "_*/*.json",
+              "_*/*.pkl"],
+    "eval": ["eval.json"],
+}
+
+
+def _digests(base: Path, families) -> dict[str, str]:
+    out = {}
+    for fam in families:
+        root = base / fam
+        for pat in _FAMILIES[fam]:
+            for p in sorted(root.glob(pat)):
+                if p.is_file():
+                    key = f"{fam}/{p.relative_to(root)}"
+                    out[key] = hashlib.sha256(p.read_bytes()).hexdigest()
+    return out
+
+
+@pytest.fixture(scope="module")
+def golden(tmp_path_factory):
+    """The uninterrupted run, produced in-process through the same step
+    functions the chaos children execute."""
+    base = tmp_path_factory.mktemp("golden")
+    config = _config(base)
+    run_harvest(config)
+    run_sweep(config)
+    run_eval(config)
+    digests = _digests(base, _FAMILIES)
+    assert any(k.startswith("chunks/") for k in digests)
+    assert any(k.startswith("sweep/final") for k in digests)
+    assert "eval/eval.json" in digests
+    return {"base": base, "digests": digests}
+
+
+def _seed_from_golden(golden, base: Path, families) -> None:
+    for fam in families:
+        shutil.copytree(golden["base"] / fam, base / fam)
+
+
+def _assert_bitwise(golden, base: Path, families) -> None:
+    got = _digests(base, families)
+    want = {k: v for k, v in golden["digests"].items()
+            if k.split("/", 1)[0] in families}
+    assert set(got) == set(want), set(got) ^ set(want)
+    diff = [k for k in want if got[k] != want[k]]
+    assert not diff, f"artifacts differ after kill+resume: {diff}"
+
+
+# (site, plan, steps to run, families seeded from golden, families compared)
+MATRIX = [
+    ("chunk.flushed", "chunk.flushed:nth=2", ["harvest"], [], ["chunks"]),
+    ("store.finalize", "store.finalize:nth=1", ["harvest"], [], ["chunks"]),
+    ("sweep.chunk", "sweep.chunk:nth=2", None, [], None),  # full pipeline
+    ("ckpt.swap", "ckpt.swap:nth=2", ["sweep"], ["chunks"], ["sweep"]),
+    ("eval.write", "eval.write:nth=1", ["eval"], ["chunks", "sweep"],
+     ["eval"]),
+]
+
+
+@pytest.mark.parametrize("site,plan,only,seed,compare",
+                         MATRIX, ids=[m[0] for m in MATRIX])
+def test_kill_at_barrier_restart_bitwise(tmp_path, monkeypatch, golden,
+                                         site, plan, only, seed, compare):
+    base = tmp_path
+    _seed_from_golden(golden, base, seed)
+    config = _config(base)
+    run_dir = base / "run"
+
+    # run 1: the crash plan reaches the child through the environment and
+    # SIGKILLs it at the barrier — a kill -9 at the worst instant
+    monkeypatch.setenv(crash_mod.ENV_VAR, plan)
+    sup = Supervisor(run_dir, build_pipeline(run_dir, config, only=only),
+                     max_attempts=1, heartbeat_stale_s=STALE_S)
+    with pytest.raises(StepFailed, match="killed by signal 9"):
+        sup.run()
+    killed = [r for r in sup.journal.records() if r["event"] == "step.killed"]
+    assert killed and killed[-1]["detail"]["signal"] == 9
+
+    # run 2: a fresh supervisor over the same run dir (the restart path —
+    # journal + artifacts are its only memory), no crash plan
+    monkeypatch.delenv(crash_mod.ENV_VAR)
+    sup2 = Supervisor(run_dir, build_pipeline(run_dir, config, only=only),
+                      max_attempts=2, heartbeat_stale_s=STALE_S)
+    summary = sup2.run()
+    assert all(v in ("done", "skipped") for v in summary.values())
+    _assert_bitwise(golden, base,
+                    compare if compare is not None else list(_FAMILIES))
+
+
+def test_repeated_kills_self_heal_in_one_supervisor(tmp_path, monkeypatch,
+                                                    golden):
+    """Forward progress under RECURRING kills: hit counting is
+    per-process, so a plan killing every attempt at its 2nd chunk flush
+    still converges — each attempt persists one more chunk (4 chunks →
+    attempt 3 finds nothing left to write and finalizes). One
+    supervisor.run() with an attempt budget self-heals to a bitwise-
+    identical store, no operator restart needed."""
+    config = _config(tmp_path)
+    run_dir = tmp_path / "run"
+    monkeypatch.setenv(crash_mod.ENV_VAR, "chunk.flushed:nth=2")
+    sup = Supervisor(run_dir,
+                     build_pipeline(run_dir, config, only=["harvest"]),
+                     max_attempts=3, heartbeat_stale_s=STALE_S)
+    assert sup.run() == {"harvest": "done"}
+    kills = [r for r in sup.journal.records() if r["event"] == "step.killed"]
+    assert len(kills) == 2  # attempts 1 and 2 died, attempt 3 finished
+    _assert_bitwise(golden, tmp_path, ["chunks"])
+
+
+def test_lm_harvest_kill_resume_bitwise(tmp_path, monkeypatch):
+    """The REAL LM harvest path (tiny random-weight model through
+    ``harvest_activations``): killed after two durable chunks, the
+    restarted child resumes via ``skip_chunks`` + digest backfill and the
+    finished tap store — chunks AND meta — is byte-identical to an
+    uninterrupted harvest."""
+    lm_cfg = {"mode": "lm", "arch": "gptneox", "layer": 1,
+              "layer_loc": "residual", "n_rows": 16, "context_len": 16,
+              "model_batch_size": 2, "seed": 0, "dtype": "float16",
+              # d_model=32, f16: 64 rows/chunk -> 4 chunks of 256 rows
+              "chunk_size_gb": 64 * 32 * 2 / 2**30}
+
+    # golden, in-process
+    golden_dir = tmp_path / "golden" / "residual.1"
+    run_harvest({"harvest": {**lm_cfg, "dataset_folder": str(golden_dir)}})
+    want = {p.name: hashlib.sha256(p.read_bytes()).hexdigest()
+            for p in sorted(golden_dir.iterdir())}
+    assert len([n for n in want if n.endswith(".npy")]) == 4
+
+    case_dir = tmp_path / "case" / "residual.1"
+    config = {"harvest": {**lm_cfg, "dataset_folder": str(case_dir)},
+              "sweep": {"ensemble": {"output_folder": str(tmp_path / "s")}},
+              "eval": {"output_folder": str(tmp_path / "e")}}
+    run_dir = tmp_path / "run"
+    monkeypatch.setenv(crash_mod.ENV_VAR, "chunk.flushed:nth=2")
+    sup = Supervisor(run_dir,
+                     build_pipeline(run_dir, config, only=["harvest"]),
+                     max_attempts=1, heartbeat_stale_s=STALE_S)
+    with pytest.raises(StepFailed, match="killed by signal 9"):
+        sup.run()
+    assert (case_dir / "1.npy").exists() and not (case_dir
+                                                  / "meta.json").exists()
+    monkeypatch.delenv(crash_mod.ENV_VAR)
+    sup2 = Supervisor(run_dir,
+                      build_pipeline(run_dir, config, only=["harvest"]),
+                      max_attempts=2, heartbeat_stale_s=STALE_S)
+    assert sup2.run() == {"harvest": "done"}
+    got = {p.name: hashlib.sha256(p.read_bytes()).hexdigest()
+           for p in sorted(case_dir.iterdir())}
+    assert got == want
+
+
+def test_journal_records_full_kill_story(tmp_path, monkeypatch, golden):
+    """The journal is the operator's incident record: spawn → killed →
+    (restart) takeover of the dead child's lease → spawn → done, replayed
+    from disk by a supervisor that shares no memory with the dead one."""
+    config = _config(tmp_path)
+    run_dir = tmp_path / "run"
+    monkeypatch.setenv(crash_mod.ENV_VAR, "store.finalize:nth=1")
+    sup = Supervisor(run_dir,
+                     build_pipeline(run_dir, config, only=["harvest"]),
+                     max_attempts=1, heartbeat_stale_s=STALE_S)
+    with pytest.raises(StepFailed):
+        sup.run()
+    monkeypatch.delenv(crash_mod.ENV_VAR)
+    sup2 = Supervisor(run_dir,
+                      build_pipeline(run_dir, config, only=["harvest"]),
+                      heartbeat_stale_s=STALE_S)
+    sup2.run()
+    events = [(r["event"], r.get("step")) for r in sup2.journal.records()]
+    for expected in [("step.spawn", "harvest"), ("step.killed", "harvest"),
+                     ("lease.takeover", "harvest"),
+                     ("step.done", "harvest"), ("run.done", "")]:
+        assert expected in events, (expected, events)
+    assert events.index(("step.killed", "harvest")) < events.index(
+        ("lease.takeover", "harvest")) < events.index(
+        ("step.done", "harvest"))

@@ -1,0 +1,358 @@
+"""Supervisor mechanics driven deterministically with cheap (non-jax)
+child processes: journal replay, lease takeover (dead and stale owners),
+the hang watchdog's three verdicts (retry / degrade-to-CPU / halt), DAG
+validation, and the crash/lease/watchdog primitives themselves.
+
+The real harvest→sweep→eval children and the SIGKILL matrix live in
+tests/test_pipeline_chaos.py (marker ``chaos``)."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from sparse_coding_tpu.pipeline import (
+    ConcurrentSupervisorError,
+    RunJournal,
+    Step,
+    StepFailed,
+    StepHung,
+    Supervisor,
+    build_pipeline,
+)
+from sparse_coding_tpu.resilience import crash as crash_mod
+from sparse_coding_tpu.resilience import lease as lease_mod
+from sparse_coding_tpu.resilience.errors import UnknownFaultSiteError
+from sparse_coding_tpu.resilience.lease import (
+    Lease,
+    lease_state,
+    read_lease,
+    seed_lease,
+)
+from sparse_coding_tpu.resilience.watchdog import (
+    DEGRADE_CPU,
+    HALT,
+    RETRY,
+    classify_hang,
+    probe_tunnel,
+)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_crash_plan():
+    yield
+    crash_mod.install_crash_plan(None)
+    lease_mod.configure(None)
+
+
+def _touch_step(tmp_path, name="work", content="done"):
+    out = tmp_path / f"{name}.out"
+    return out, Step(name, [sys.executable, "-c",
+                            f"open({str(out)!r}, 'w').write({content!r})"],
+                     done=out.exists)
+
+
+def _hang_argv():
+    # a child that claims nothing and never beats: stale by construction
+    return [sys.executable, "-c", "import time; time.sleep(60)"]
+
+
+# -- journal ------------------------------------------------------------------
+
+
+def test_journal_append_replay_and_torn_line_tolerance(tmp_path):
+    j = RunJournal(tmp_path / "journal.jsonl")
+    j.append("run.start")
+    j.append("step.spawn", "a", attempt=1)
+    j.append("step.done", "a")
+    assert j.done_steps() == {"a"}
+    assert j.last_event("a")["event"] == "step.done"
+    assert [r["seq"] for r in j.records()] == [1, 2, 3]
+    # operator-mangled tail line is skipped, not fatal
+    with open(j.path, "ab") as fh:
+        fh.write(b'{"truncated": ')
+    assert len(j.records()) == 3
+    j2 = RunJournal(tmp_path / "journal.jsonl")
+    j2.append("run.start")
+    assert j2.records()[-1]["seq"] == 4
+
+
+# -- lease primitives ---------------------------------------------------------
+
+
+def test_lease_beat_throttling_and_states(tmp_path):
+    t = {"now": 1000.0}
+    lease = Lease(tmp_path / "l.json", step="s", interval_s=1.0,
+                  clock=lambda: t["now"])
+    first = read_lease(lease.path)
+    assert first.pid == os.getpid() and first.seq == 1
+    lease.beat()  # throttled: same second
+    assert read_lease(lease.path).seq == 1
+    t["now"] += 1.5
+    lease.beat()
+    assert read_lease(lease.path).seq == 2
+    assert lease_state(lease.path, 10.0, clock=lambda: t["now"]) == "live"
+    t["now"] += 60.0
+    assert lease_state(lease.path, 10.0, clock=lambda: t["now"]) == "stale"
+    assert lease_state(tmp_path / "none.json", 10.0) == "missing"
+    seed_lease(tmp_path / "dead.json", pid=2**22 + 12345)
+    assert lease_state(tmp_path / "dead.json", 10.0) == "dead"
+
+
+def test_lease_beat_global_hook_noop_and_env(tmp_path, monkeypatch):
+    lease_mod.configure(None)
+    lease_mod.beat()  # unconfigured: no-op, no file, no error
+    monkeypatch.setenv(lease_mod.ENV_PATH, str(tmp_path / "hook.json"))
+    lease_mod.configure_from_env(step="host")
+    lease_mod.beat()
+    info = read_lease(tmp_path / "hook.json")
+    assert info is not None and info.step == "host"
+
+
+# -- crash plan primitives ----------------------------------------------------
+
+
+def test_crash_plan_parse_counting_and_typed_unknown_site():
+    plan = crash_mod.parse_crash_plan("sweep.chunk:nth=2,count=2")
+    spec = plan.specs[0]
+    assert [spec.fires_on(h) for h in (1, 2, 3, 4)] == [False, True, True,
+                                                        False]
+    assert plan.hit("sweep.chunk") is None  # hit 1
+    assert plan.hit("sweep.chunk") is spec  # hit 2 fires
+    json_plan = crash_mod.parse_crash_plan(
+        json.dumps([{"site": "eval.write", "nth": 1}]))
+    assert json_plan.specs[0].site == "eval.write"
+    with pytest.raises(UnknownFaultSiteError, match="unknown crash site"):
+        crash_mod.parse_crash_plan("no.such.site:nth=1")
+    with pytest.raises(ValueError, match="bad crash-plan pair"):
+        crash_mod.parse_crash_plan("sweep.chunk:mode=error")  # fault-only key
+
+
+def test_crash_barrier_fires_via_env_and_kill_hook(monkeypatch):
+    monkeypatch.setenv(crash_mod.ENV_VAR, "eval.write:nth=2")
+    crash_mod.install_crash_plan(None)  # clear explicit install
+    monkeypatch.setattr(crash_mod, "_env_checked", False)
+    killed = []
+    monkeypatch.setattr(crash_mod, "_kill_self", killed.append)
+    crash_mod.crash_barrier("eval.write")  # hit 1: survives
+    assert killed == []
+    crash_mod.crash_barrier("eval.write")  # hit 2: SIGKILL (stubbed)
+    assert killed == ["eval.write"]
+
+
+# -- watchdog probe/classification -------------------------------------------
+
+
+def test_probe_and_classify_all_verdicts(monkeypatch):
+    refused = lambda addr, timeout: (_ for _ in ()).throw(OSError("refused"))
+    up = lambda addr, timeout: type("C", (), {"close": lambda s: None})()
+    none = probe_tunnel(hosts=[])
+    assert not none["configured"] and classify_hang(none) == RETRY
+    down = probe_tunnel(hosts=["10.0.0.1"], connect=refused)
+    assert down["configured"] and not down["reachable"]
+    assert classify_hang(down) == DEGRADE_CPU
+    alive = probe_tunnel(hosts=["10.0.0.1"], connect=up)
+    assert alive["reachable"] and classify_hang(alive) == HALT
+    assert set(alive["endpoints"]) == {"10.0.0.1:2024", "10.0.0.1:8082",
+                                       "10.0.0.1:8083"}
+
+
+# -- supervisor: happy path, resume, DAG --------------------------------------
+
+
+def test_supervisor_runs_dag_in_order_and_resumes(tmp_path):
+    a_out, a = _touch_step(tmp_path, "a")
+    b_out = tmp_path / "b.out"
+    b = Step("b", [sys.executable, "-c",
+                   # b proves its dep ran first by copying a's artifact
+                   f"import shutil; shutil.copy({str(a_out)!r}, {str(b_out)!r})"],
+             done=b_out.exists, deps=("a",))
+    sup = Supervisor(tmp_path / "run", [b, a], heartbeat_stale_s=60.0)
+    assert sup.run() == {"a": "done", "b": "done"}
+    assert b_out.read_text() == "done"
+    # restart: everything skipped, journal records the completed set
+    sup2 = Supervisor(tmp_path / "run", [b, a], heartbeat_stale_s=60.0)
+    assert sup2.run() == {"a": "skipped", "b": "skipped"}
+    events = [r["event"] for r in sup2.journal.records()]
+    assert events.count("run.done") == 2
+
+
+def test_supervisor_rejects_bad_dags(tmp_path):
+    _, a = _touch_step(tmp_path, "a")
+    with pytest.raises(ValueError, match="unknown step"):
+        Supervisor(tmp_path / "r1",
+                   [Step("x", ["true"], done=lambda: False, deps=("ghost",))])
+    loop_a = Step("a", ["true"], done=lambda: False, deps=("b",))
+    loop_b = Step("b", ["true"], done=lambda: False, deps=("a",))
+    with pytest.raises(ValueError, match="cycle"):
+        Supervisor(tmp_path / "r2", [loop_a, loop_b])
+    with pytest.raises(ValueError, match="duplicate"):
+        Supervisor(tmp_path / "r3", [a, a])
+
+
+def test_step_failure_is_typed_and_journaled(tmp_path):
+    bad = Step("bad", [sys.executable, "-c", "raise SystemExit(7)"],
+               done=lambda: False)
+    sup = Supervisor(tmp_path / "run", [bad], max_attempts=2,
+                     heartbeat_stale_s=60.0)
+    with pytest.raises(StepFailed, match="exit code 7"):
+        sup.run()
+    fails = [r for r in sup.journal.records() if r["event"] == "step.failed"]
+    assert [f["detail"]["attempt"] for f in fails] == [1, 2]
+
+
+# -- supervisor: lease takeover ----------------------------------------------
+
+
+def test_dead_owner_lease_taken_over(tmp_path):
+    out, step = _touch_step(tmp_path)
+    sup = Supervisor(tmp_path / "run", [step], heartbeat_stale_s=60.0)
+    seed_lease(sup.lease_path(step), pid=2**22 + 4242, step=step.name)
+    assert sup.run() == {"work": "done"}
+    assert any(r["event"] == "lease.takeover" for r in sup.journal.records())
+
+
+def test_live_owner_lease_refused(tmp_path):
+    out, step = _touch_step(tmp_path)
+    sup = Supervisor(tmp_path / "run", [step], heartbeat_stale_s=60.0)
+    seed_lease(sup.lease_path(step), pid=os.getpid(), step=step.name)
+    with pytest.raises(ConcurrentSupervisorError):
+        sup.run()
+    assert not out.exists()  # refused before spawning anything
+
+
+def test_stale_owner_killed_then_taken_over(tmp_path):
+    """A hung orphan (alive pid, old heartbeat — e.g. left by a SIGKILLed
+    supervisor) is SIGKILLed before the step re-runs, so two processes
+    never write one step's artifacts."""
+    orphan = subprocess.Popen(_hang_argv())
+    try:
+        out, step = _touch_step(tmp_path)
+        sup = Supervisor(tmp_path / "run", [step], heartbeat_stale_s=5.0)
+        seed_lease(sup.lease_path(step), pid=orphan.pid, step=step.name,
+                   clock=lambda: time.time() - 60.0)  # old heartbeat
+        assert sup.run() == {"work": "done"}
+        assert any(r["event"] == "lease.stale_kill"
+                   for r in sup.journal.records())
+        assert orphan.wait(timeout=10) == -9
+    finally:
+        if orphan.poll() is None:
+            orphan.kill()
+
+
+# -- supervisor: hang watchdog ------------------------------------------------
+
+
+def _fake_prober(configured, reachable):
+    return lambda: {"configured": configured, "reachable": reachable,
+                    "endpoints": {"fake:2024": reachable} if configured
+                    else {}}
+
+
+def test_hung_step_retry_verdict_consumes_attempts(tmp_path):
+    hang = Step("hang", _hang_argv(), done=lambda: False)
+    sup = Supervisor(tmp_path / "run", [hang], max_attempts=2,
+                     heartbeat_stale_s=0.5, poll_s=0.05,
+                     prober=_fake_prober(configured=False, reachable=False))
+    t0 = time.monotonic()
+    with pytest.raises(StepFailed, match="hung"):
+        sup.run()
+    assert time.monotonic() - t0 < 30  # killed at staleness, not sleep(60)
+    hangs = [r for r in sup.journal.records() if r["event"] == "step.hung"]
+    assert len(hangs) == 2
+    assert all(h["detail"]["action"] == RETRY for h in hangs)
+
+
+def test_hung_step_degrades_to_cpu_when_tunnel_down(tmp_path):
+    """Tunnel configured but unreachable → the retry respawns the step's
+    degrade command with the axon plugin stripped and jax pinned to CPU —
+    the supervisor-level analogue of bench.py's cpu fallback."""
+    out = tmp_path / "deg.out"
+    step = Step(
+        "bench-like", _hang_argv(), done=out.exists,
+        degrade_argv=[sys.executable, "-c",
+                      "import os; open(" + repr(str(out)) + ", 'w').write("
+                      "os.environ.get('JAX_PLATFORMS','') + '|' + "
+                      "os.environ.get('PALLAS_AXON_POOL_IPS','<unset>'))"],
+        env={"PALLAS_AXON_POOL_IPS": "203.0.113.7"})
+    sup = Supervisor(tmp_path / "run", [step], max_attempts=2,
+                     heartbeat_stale_s=0.5, poll_s=0.05,
+                     prober=_fake_prober(configured=True, reachable=False))
+    assert sup.run() == {"bench-like": "done"}
+    assert out.read_text() == "cpu|<unset>"
+    hung = [r for r in sup.journal.records() if r["event"] == "step.hung"]
+    assert hung and hung[0]["detail"]["action"] == DEGRADE_CPU
+    spawns = [r["detail"]["argv"] for r in sup.journal.records()
+              if r["event"] == "step.spawn"]
+    if shutil.which("flock"):
+        # tunnel-touching attempt serialized on the repo-wide flock
+        # (CLAUDE.md convention); the degraded CPU respawn must NOT be
+        assert spawns[0].startswith("flock /tmp/axon_tunnel.lock ")
+    assert not spawns[1].startswith("flock")
+
+
+def test_hung_step_halts_on_wedged_tunnel(tmp_path):
+    """Tunnel endpoint reachable but our client hung: the known
+    server-side lease wedge — retrying would double-book the tunnel, so
+    the supervisor halts with the runbook pointer."""
+    hang = Step("hang", _hang_argv(), done=lambda: False)
+    sup = Supervisor(tmp_path / "run", [hang], max_attempts=3,
+                     heartbeat_stale_s=0.5, poll_s=0.05,
+                     prober=_fake_prober(configured=True, reachable=True))
+    with pytest.raises(StepHung, match="RUNBOOK_TUNNEL"):
+        sup.run()
+    spawns = [r for r in sup.journal.records() if r["event"] == "step.spawn"]
+    assert len(spawns) == 1  # halted immediately, no blind retries
+
+
+# -- build_pipeline subsetting ------------------------------------------------
+
+
+def test_build_pipeline_only_prunes_deps(tmp_path):
+    config = {
+        "harvest": {"dataset_folder": str(tmp_path / "chunks")},
+        "sweep": {"ensemble": {"output_folder": str(tmp_path / "sweep")}},
+        "eval": {"output_folder": str(tmp_path / "eval")},
+    }
+    steps = build_pipeline(tmp_path / "run", config)
+    assert [s.name for s in steps] == ["harvest", "sweep", "eval"]
+    sub = build_pipeline(tmp_path / "run", config, only=["sweep", "eval"])
+    assert [s.name for s in sub] == ["sweep", "eval"]
+    assert sub[0].deps == ()  # harvest dep dropped with the step
+    assert sub[1].deps == ("sweep",)
+    with pytest.raises(ValueError, match="unknown pipeline steps"):
+        build_pipeline(tmp_path / "run", config, only=["ghost"])
+
+
+def test_build_pipeline_anchors_relative_paths_to_repo_root(tmp_path):
+    """Children run with cwd=REPO_ROOT; the supervisor-side done() probes
+    must resolve relative config paths against that SAME root, whatever
+    directory the supervisor was launched from."""
+    from sparse_coding_tpu.pipeline.supervisor import REPO_ROOT
+
+    config = {
+        "harvest": {"dataset_folder": "rel_chunks_dir_that_never_exists"},
+        "sweep": {"ensemble": {"output_folder": "rel_sweep"}},
+        "eval": {"output_folder": "rel_eval"},
+    }
+    harvest = build_pipeline(tmp_path / "run", config)[0]
+    cwd = os.getcwd()
+    os.chdir(tmp_path)  # supervisor launched from elsewhere
+    try:
+        assert harvest.done() is False
+        marker = REPO_ROOT / "rel_chunks_dir_that_never_exists" / "meta.json"
+        try:
+            marker.parent.mkdir()
+            marker.write_text("{}")
+            assert harvest.done() is True  # probes REPO_ROOT, not cwd
+        finally:
+            shutil.rmtree(marker.parent, ignore_errors=True)
+    finally:
+        os.chdir(cwd)

@@ -76,6 +76,26 @@ def test_fault_plan_compact_and_json_parsing():
         parse_fault_plan("chunk.read:bogus")
 
 
+def test_unknown_site_raises_typed_error_on_every_parse_path(monkeypatch):
+    """A typo'd site name must fail the plan LOUDLY and TYPED on every
+    ingestion path — compact, JSON, env reload, and in-code inject — never
+    silently disable the planned fault."""
+    from sparse_coding_tpu.resilience.errors import UnknownFaultSiteError
+
+    with pytest.raises(UnknownFaultSiteError, match="chunk.raed"):
+        parse_fault_plan("chunk.raed:nth=3")  # compact, typo'd
+    with pytest.raises(UnknownFaultSiteError):
+        parse_fault_plan(json.dumps([{"site": "srve.dispatch"}]))  # JSON
+    with pytest.raises(UnknownFaultSiteError):
+        inject(site="ckpt.sav", nth=1)  # in-code shorthand
+    monkeypatch.setenv(faults.ENV_VAR, "lock.aquire:nth=1")
+    with pytest.raises(UnknownFaultSiteError) as exc:
+        faults.reload_from_env()
+    # the error is also a ValueError (back-compat) and names the registry
+    assert isinstance(exc.value, ValueError)
+    assert "lock.acquire" in str(exc.value)  # suggests the real sites
+
+
 def test_fault_plan_env_var_roundtrip(monkeypatch):
     monkeypatch.setenv(faults.ENV_VAR,
                        "chunk.read:nth=2,mode=error,error=OSError")
@@ -115,6 +135,32 @@ def test_retry_io_bounded_and_backoff():
     calls["n"] = -10  # now always failing within the budget
     with pytest.raises(OSError):
         retry_io(flaky, attempts=2, base_delay_s=0.0, sleep=lambda s: None)
+
+
+def test_retry_backoff_jitter_is_deterministic_under_seeded_rng():
+    """Jittered backoff must replay exactly under a seeded rng: retry
+    timing is part of a run's reproducibility story (the same fault plan
+    must produce the same wall-clock schedule)."""
+    def always_fail():
+        raise OSError("transient")
+
+    def sleeps_for(seed):
+        sleeps = []
+        with pytest.raises(OSError):
+            retry_io(always_fail, attempts=4, base_delay_s=0.01,
+                     sleep=sleeps.append, jitter=0.5,
+                     rng=np.random.default_rng(seed))
+        return sleeps
+
+    a, b = sleeps_for(123), sleeps_for(123)
+    assert a == b and len(a) == 3  # same seed -> identical schedule
+    assert sleeps_for(7) != a  # the jitter is real
+    base = [0.01, 0.02, 0.04]
+    for got, want in zip(a, base):
+        assert want <= got <= want * 1.5  # bounded by the jitter factor
+    # jitter without an explicit rng is refused (irreproducible timing)
+    with pytest.raises(ValueError, match="seeded rng"):
+        retry_io(always_fail, attempts=2, jitter=0.5)
 
 
 def test_circuit_breaker_state_machine():
@@ -218,6 +264,37 @@ def test_bitflip_detected_and_quarantine_skips_once(tmp_path, caplog):
     # epoch() (the training path) transparently skips the quarantined slot
     batches = list(lenient.epoch(8, np.random.default_rng(0)))
     assert len(batches) == 6  # 3 surviving chunks x 16 rows / 8
+
+
+def test_quarantine_alignment_with_multiple_corrupt_chunks(tmp_path, caplog):
+    """Positional alignment when SEVERAL chunks are quarantined: every
+    corrupt chunk yields None at exactly its position in the index
+    sequence (so a consumer zipping indices with the stream never
+    misattributes a chunk), each is warned about exactly once, and
+    epoch() trains on precisely the surviving rows."""
+    data = _mk_store(tmp_path)  # 4 chunks x 16 rows
+    for victim_idx in (1, 3):
+        victim = tmp_path / f"{victim_idx}.npy"
+        blob = bytearray(victim.read_bytes())
+        blob[-1] ^= 0x01
+        victim.write_bytes(bytes(blob))
+
+    lenient = ChunkStore(tmp_path, quarantine_corrupt=True)
+    order = [3, 0, 1, 2, 1, 3, 0]
+    with caplog.at_level("WARNING", "sparse_coding_tpu.data.chunk_store"):
+        out = list(lenient.chunk_reader(order))
+    assert [c is None for c in out] == [True, False, True, False, True,
+                                        True, False]
+    assert lenient.quarantined == {1, 3}
+    warnings = [r for r in caplog.records if "quarantining" in r.message]
+    assert len(warnings) == 2  # one per bad chunk, repeats silent
+    # surviving positions carry the RIGHT chunk for their index
+    np.testing.assert_allclose(out[1], data[:16], atol=2e-3)
+    np.testing.assert_allclose(out[3], data[32:48], atol=2e-3)
+    np.testing.assert_allclose(out[6], data[:16], atol=2e-3)
+    # the training path sees only the two surviving chunks' batches
+    batches = list(lenient.epoch(8, np.random.default_rng(0)))
+    assert len(batches) == 4  # 2 good chunks x 16 rows / 8
 
 
 def test_chunk_read_transient_fault_retried_and_bounded(tmp_path):
