@@ -108,8 +108,14 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
     fused_path forces the tied kernel choice: "two_stage" | "train_step")."""
     import contextlib
 
+    from sparse_coding_tpu import obs
     from sparse_coding_tpu.ensemble import Ensemble
     from sparse_coding_tpu.models.sae import FunctionalSAE, FunctionalTiedSAE
+
+    # XLA probes (idempotent): every bench path — main, cpu-fallback,
+    # bench_suite, tune — counts retraces/compiles; diagnostics are
+    # stderr/obs-file only, never the stdout JSON line
+    obs.install_jax_probes()
 
     d_act = d_act or D_ACT
     n_dict = n_dict or N_DICT
@@ -150,23 +156,30 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
         # the headline (robust to the shared pool behind the tunnel, which
         # alternates two perf states ~40% apart in minutes-long episodes,
         # and comparable to the r1/r2 whole-run averages) and the best
-        # window is kept as a separately-labeled peak figure.
+        # window is kept as a separately-labeled peak figure. The window
+        # walls come from StepTimer — the sweep's throughput meter — so
+        # bench and sweep report through ONE code path, and publish()
+        # mirrors the numbers into the obs registry (stderr + obs.report;
+        # stdout stays the single driver-contract JSON line).
         from sparse_coding_tpu.resilience import lease
+        from sparse_coding_tpu.utils.profiling import StepTimer
 
-        window_times = []
+        acts_per_window = scan_chunk * batch
+        timer = StepTimer(warmup=0)
+        timer.tick()  # anchor: the warmup window above already synced
         # at least 3 windows so the median is meaningful even when one scan
         # chunk covers the whole nominal step budget (scan_chunk >= 50)
         for _ in range(max(3, bench_steps // scan_chunk)):
-            t0 = time.perf_counter()
             aux = ens.run_steps(batches)
             np.asarray(aux.losses["loss"])
-            window_times.append(time.perf_counter() - t0)
+            timer.tick(acts_per_window)
             # supervised mode: each timed window that SYNCED is progress —
             # a tunnel wedge stops these beats and the watchdog catches it
             lease.beat()
         if ens.fused_path is not None:
             print(f"  (fused kernel path: {ens.fused_path})", file=sys.stderr)
-        return WindowedRate(window_times, scan_chunk * batch)
+        snap = timer.publish(prefix="bench")
+        return WindowedRate(list(snap["window_s"]), acts_per_window)
 
 
 def _emit(acts_per_sec_per_chip: float, *, backend: str,
@@ -210,6 +223,20 @@ def _emit(acts_per_sec_per_chip: float, *, backend: str,
         record["note"] = note
     line = json.dumps(record)
     import os
+
+    from sparse_coding_tpu import obs
+
+    reg = obs.get_registry()
+    compile_s = reg.histogram("jax.compile_dur_s").snapshot()["sum"]
+    print(f"bench: obs retraces={reg.counter('jax.retraces').value} "
+          f"compiles={reg.counter('jax.compiles').value} "
+          f"compile_wall={compile_s:.1f}s", file=sys.stderr)
+    obs.update_memory_gauges()
+    # under the supervisor the obs env points at the run dir: the metrics
+    # snapshot (throughput gauges, retrace counters) joins the run's event
+    # stream for obs.report — a no-op on bare invocations
+    obs.flush_metrics()
+    obs.close_sink()
 
     result_path = os.environ.get("BENCH_RESULT_PATH", "").strip()
     if result_path:

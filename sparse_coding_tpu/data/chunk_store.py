@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparse_coding_tpu import obs
 from sparse_coding_tpu.resilience import lease
 from sparse_coding_tpu.resilience.atomic import atomic_save_npy, atomic_write_text
 from sparse_coding_tpu.resilience.crash import crash_barrier, register_crash_site
@@ -126,6 +127,7 @@ class ChunkWriter:
             self._flush_chunk()
 
     def _write(self, arr: np.ndarray) -> None:
+        t0 = obs.monotime()
         if self.center:
             f32 = arr.astype(np.float32)
             if self._center_mean is None:
@@ -147,6 +149,12 @@ class ChunkWriter:
         self._digests[str(self.chunk_index)] = array_sha256(arr)
         self.chunk_index += 1
         lease.beat()  # a durable chunk is the harvest's unit of progress
+        # chunk granularity matches the lease beat: one span event + the
+        # row counter per durable chunk, never per batch
+        obs.counter("chunk.rows_written").inc(int(arr.shape[0]))
+        obs.record_span("chunk.write", obs.monotime() - t0,
+                        index=self.chunk_index - 1,
+                        rows=int(arr.shape[0]))
         crash_barrier("chunk.flushed")
 
     def _flush_chunk(self) -> None:

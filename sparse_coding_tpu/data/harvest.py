@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparse_coding_tpu import obs
 from sparse_coding_tpu.config import DataArgs
 from sparse_coding_tpu.data.chunk_store import ChunkStore, ChunkWriter
 from sparse_coding_tpu.lm import hooks
@@ -155,10 +156,14 @@ def harvest_activations(
 
     pending: deque = deque()
 
+    drained_rows = obs.counter("harvest.rows_drained")
+
     def drain_one() -> bool:
         tapped = pending.popleft()
         for name, acts in tapped.items():
-            writers[name].add(np.asarray(acts))
+            host = np.asarray(acts)
+            writers[name].add(host)
+            drained_rows.inc(int(host.shape[0]))
         # progress heartbeat per drained forward (supervised runs): a
         # drained batch proves the LM, the device→host pull, and the
         # writer all advanced — a wedged tunnel stops these beats cold
@@ -168,6 +173,7 @@ def harvest_activations(
 
     done = False
     lo = skip_rows
+    t_harvest = obs.monotime()
     try:
         while lo < n_rows and not done:
             n_avail = (n_rows - lo) // model_batch_size  # full batches left
@@ -198,13 +204,19 @@ def harvest_activations(
         # so a torn final chunk is impossible either way)
         for w in writers.values():
             w.abort()
+        obs.record_span("harvest.run", obs.monotime() - t_harvest, ok=False,
+                        error="aborted", taps=list(taps))
         raise
 
     # centering happens INSIDE the writers (first flushed chunk's mean
     # subtracted from every chunk, reference: activation_dataset.py:379-381);
     # the writer stamps the truthful "centered" flag and saves center.npy
-    return {name: w.finalize({"model": cfg.arch, "layer_loc": layer_loc})
-            for name, w in writers.items()}
+    result = {name: w.finalize({"model": cfg.arch, "layer_loc": layer_loc})
+              for name, w in writers.items()}
+    obs.record_span("harvest.run", obs.monotime() - t_harvest,
+                    taps=list(taps), rows=int(n_rows - skip_rows),
+                    chunks={k: int(v) for k, v in result.items()})
+    return result
 
 
 def make_one_chunk_per_layer(params, lm_cfg: LMConfig, token_rows: np.ndarray,

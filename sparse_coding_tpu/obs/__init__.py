@@ -1,0 +1,134 @@
+"""Unified observability: instruments, spans, crash-safe events, probes.
+
+One subsystem replaces the three disjoint telemetry fragments (StepTimer
+walls, MetricsLogger JSONL, serving counters) with correlated,
+crash-surviving evidence — because with the TPU tunnel wedging for whole
+sessions (docs/RUNBOOK_TUNNEL.md), every on-chip minute must yield a
+complete profile on the first try:
+
+- :mod:`registry`  — typed instruments (counters, gauges, mergeable
+  fixed-bucket histograms) behind a process-wide default registry;
+- :mod:`spans`     — ``span("sweep.chunk", **attrs)`` context managers
+  emitting start/end/error events with monotonic durations and the
+  run/step/span correlation IDs the pipeline supervisor propagates via
+  env (``SPARSE_CODING_RUN_ID`` / ``SPARSE_CODING_OBS_DIR`` /
+  ``SPARSE_CODING_OBS_STEP``);
+- :mod:`sink`      — append-only line-atomic JSONL event files, one per
+  process, SIGKILL-truncation-tolerant reader, named fault/crash site
+  ``obs.sink.write``;
+- :mod:`jaxprobes` — XLA retrace/compile counters, compile-time
+  histograms, device memory gauges via ``jax.monitoring`` hooks
+  (host-side only: the lowered HLO is bitwise identical with probes
+  installed — tests/test_tpu_lowering.py);
+- :mod:`report`    — ``python -m sparse_coding_tpu.obs.report <run_dir>``
+  merges a run's event files into per-step p50/p95/p99 durations,
+  throughput, retrace and error counts.
+
+Import discipline: this package (minus :mod:`jaxprobes`) never imports
+jax, so the serving metrics path and the report CLI stay device-free;
+``install_jax_probes`` defers the jax import to call time.
+
+Design: docs/ARCHITECTURE.md §12. Raw-clock reads in hot paths
+(data/train/serve/pipeline) go through :func:`monotime` — enforced
+mechanically by tests/test_obs_lint.py (escape hatch:
+``# lint: allow-raw-timer <why>``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from sparse_coding_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    get_registry,
+    set_registry,
+)
+from sparse_coding_tpu.obs.sink import (
+    ENV_OBS_DIR,
+    EventSink,
+    active_sink,
+    configure as configure_sink,
+    configure_from_env as configure_sink_from_env,
+    close as close_sink,
+    read_events,
+    scan_events,
+)
+from sparse_coding_tpu.obs.spans import (
+    ENV_RUN_ID,
+    ENV_STEP,
+    emit_event,
+    flush_metrics,
+    monotime,
+    record_span,
+    run_id,
+    span,
+    step_name,
+)
+
+
+def counter(name: str, **labels) -> Counter:
+    return get_registry().counter(name, **labels)
+
+
+def gauge(name: str, **labels) -> Gauge:
+    return get_registry().gauge(name, **labels)
+
+
+def histogram(name: str, bounds=None, **labels) -> Histogram:
+    return get_registry().histogram(name, bounds=bounds, **labels)
+
+
+def install_jax_probes() -> bool:
+    """Install the XLA retrace/compile/memory probes (idempotent; defers
+    the jax import so obs stays importable device-free)."""
+    from sparse_coding_tpu.obs import jaxprobes
+
+    return jaxprobes.install()
+
+
+def uninstall_jax_probes() -> None:
+    from sparse_coding_tpu.obs import jaxprobes
+
+    jaxprobes.uninstall()
+
+
+def update_memory_gauges(registry: Optional[Registry] = None) -> int:
+    from sparse_coding_tpu.obs import jaxprobes
+
+    return jaxprobes.update_memory_gauges(registry)
+
+
+__all__ = [
+    "Counter",
+    "ENV_OBS_DIR",
+    "ENV_RUN_ID",
+    "ENV_STEP",
+    "EventSink",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "active_sink",
+    "close_sink",
+    "configure_sink",
+    "configure_sink_from_env",
+    "counter",
+    "emit_event",
+    "flush_metrics",
+    "gauge",
+    "get_registry",
+    "histogram",
+    "install_jax_probes",
+    "monotime",
+    "read_events",
+    "record_span",
+    "run_id",
+    "scan_events",
+    "set_registry",
+    "span",
+    "step_name",
+    "uninstall_jax_probes",
+    "update_memory_gauges",
+]

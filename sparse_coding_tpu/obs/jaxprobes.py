@@ -1,0 +1,120 @@
+"""JAX runtime probes: retraces, compiles, compile time, device memory.
+
+XLA's costs are invisible to host-side timers — a retrace (a jitted
+function seeing a new shape/dtype) silently inserts seconds of trace +
+compile into what looks like a steady-state loop, and through the axon
+tunnel a single unplanned compile dwarfs whole measurement windows. These
+probes surface that behavior as ordinary registry instruments, with the
+serving engine's steady-state invariant (0 retraces after warmup —
+docs/ARCHITECTURE.md §8) now assertable for EVERY hot path:
+
+- ``jax.retraces``       counter — one per jaxpr trace
+  (``/jax/core/compile/jaxpr_trace_duration`` events);
+- ``jax.compiles``       counter — one per backend (XLA) compile;
+- ``jax.compile_dur_s`` / ``jax.trace_dur_s`` histograms — where compile
+  wall time went;
+- ``jax.cache_hits`` / ``jax.cache_misses`` counters — the persistent
+  compilation cache, when enabled;
+- ``jax.mem.<stat>{device=i}`` gauges — ``device.memory_stats()``
+  (``bytes_in_use``, peaks; absent on CPU, where the gauge family is
+  simply not created).
+
+Installation uses ``jax.monitoring``'s public listener hooks and is
+idempotent; the listener is a few dict ops per *compile* (never per
+step), so the zero-overhead guarantee of the compiled path is untouched
+— ``tests/test_tpu_lowering.py`` asserts the lowered HLO is bitwise
+identical with probes installed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from sparse_coding_tpu.obs.registry import Registry, get_registry
+
+# duration-event suffixes -> (counter, histogram) names
+_DURATION_EVENTS = {
+    "/jax/core/compile/jaxpr_trace_duration": ("jax.retraces",
+                                               "jax.trace_dur_s"),
+    "/jax/core/compile/backend_compile_duration": ("jax.compiles",
+                                                   "jax.compile_dur_s"),
+}
+_COUNT_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "jax.cache_hits",
+    "/jax/compilation_cache/cache_misses": "jax.cache_misses",
+}
+
+_installed = False
+_listeners: list = []
+
+
+def _on_event(event: str, **kwargs) -> None:
+    name = _COUNT_EVENTS.get(event)
+    if name is not None:
+        get_registry().counter(name).inc()
+
+
+def _on_duration(event: str, duration_secs: float, **kwargs) -> None:
+    names = _DURATION_EVENTS.get(event)
+    if names is None:
+        return
+    counter, hist = names
+    reg = get_registry()
+    reg.counter(counter).inc()
+    reg.histogram(hist).observe(duration_secs)
+
+
+def install() -> bool:
+    """Register the monitoring listeners once per process. Returns True
+    when (already) installed, False when this jax build lacks the hooks
+    (the probes then degrade to absent instruments, never an error)."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        import jax.monitoring as monitoring
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _listeners.extend([_on_event, _on_duration])
+    except (ImportError, AttributeError):
+        return False
+    _installed = True
+    return True
+
+
+def uninstall() -> None:
+    """Best-effort removal (tests — the public API has no unregister, so
+    this reaches for the private helpers and tolerates their absence)."""
+    global _installed
+    if not _installed:
+        return
+    try:
+        from jax._src import monitoring as _m
+
+        _m._unregister_event_listener_by_callback(_on_event)
+        _m._unregister_event_duration_listener_by_callback(_on_duration)
+    except Exception:
+        pass
+    _listeners.clear()
+    _installed = False
+
+
+def update_memory_gauges(registry: Optional[Registry] = None) -> int:
+    """Sample ``memory_stats()`` of every local device into gauges;
+    returns how many devices reported (0 on CPU, whose runtime returns
+    None). Call at span boundaries — it is a device-runtime query, not
+    free, so it does not belong inside per-batch loops."""
+    import jax
+
+    reg = registry if registry is not None else get_registry()
+    n = 0
+    for i, dev in enumerate(jax.local_devices()):
+        stats = getattr(dev, "memory_stats", lambda: None)()
+        if not stats:
+            continue
+        n += 1
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                reg.gauge(f"jax.mem.{key}", device=i).set(stats[key])
+    return n

@@ -1,0 +1,238 @@
+"""Process-wide registry of typed instruments: counters, gauges, histograms.
+
+Everything here is plain host-side Python (one lock, dicts) — instruments
+must be touchable from any hot loop without adding device dispatches, and
+`snapshot()` must be cheap enough to emit at chunk/window granularity.
+Instrument identity is ``(name, sorted labels)``: the same call site asked
+twice returns the same object, so hosts write
+``registry.counter("serve.rows", bucket=8).inc(n)`` with no setup phase.
+
+Histograms are **fixed-bucket and mergeable** by construction: two
+snapshots with the same bucket bounds add bin-for-bin, which is what lets
+``obs.report`` fuse event files from several processes of one run into a
+single latency distribution without ever shipping raw samples.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Iterable, Optional, Sequence
+
+# default duration buckets: 100 µs .. ~100 s, geometric (x√10 per step) —
+# wide enough for a tunnel dispatch (~54 ms) and a whole sweep chunk
+DEFAULT_BUCKETS = tuple(10.0 ** (e / 2.0) for e in range(-8, 5))
+
+
+def _label_key(labels: dict) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={labels[k]}" for k in sorted(labels)) + "}"
+
+
+class Counter:
+    """Monotonic count. ``inc`` only; resets only with the registry."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-written value (plus a high-water mark, for queue depths)."""
+
+    __slots__ = ("_lock", "_value", "_max")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self._value = 0.0
+        self._max = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+            if v > self._max:
+                self._max = float(v)
+
+    def add(self, dv: float) -> float:
+        with self._lock:
+            self._value += float(dv)
+            if self._value > self._max:
+                self._max = self._value
+            return self._value
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    @property
+    def max(self) -> float:
+        with self._lock:
+            return self._max
+
+
+class Histogram:
+    """Fixed-bound bucket histogram with sum/count/min/max.
+
+    ``bounds`` are the upper edges of the first ``len(bounds)`` bins; one
+    overflow bin catches everything larger. Quantiles are estimated by
+    linear interpolation inside the covering bin — exact enough for
+    p50/p95/p99 reporting, and (unlike a sample reservoir) mergeable
+    across processes.
+    """
+
+    __slots__ = ("_lock", "bounds", "counts", "sum", "count", "min", "max")
+
+    def __init__(self, lock: threading.Lock,
+                 bounds: Optional[Sequence[float]] = None):
+        self._lock = lock
+        self.bounds = tuple(float(b) for b in (bounds or DEFAULT_BUCKETS))
+        if list(self.bounds) != sorted(self.bounds):
+            raise ValueError("histogram bounds must be ascending")
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            i = 0
+            for i, b in enumerate(self.bounds):  # noqa: B007
+                if v <= b:
+                    break
+            else:
+                i = len(self.bounds)
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    def merge_snapshot(self, snap: dict) -> None:
+        """Fold another histogram's ``snapshot()`` dict into this one
+        (bin-for-bin; bounds must match — the fixed-bucket contract)."""
+        with self._lock:
+            if tuple(snap["bounds"]) != self.bounds:
+                raise ValueError(
+                    f"cannot merge histograms with different bounds: "
+                    f"{snap['bounds']} vs {list(self.bounds)}")
+            for i, c in enumerate(snap["counts"]):
+                self.counts[i] += int(c)
+            self.sum += float(snap["sum"])
+            self.count += int(snap["count"])
+            if snap["count"]:
+                self.min = min(self.min, float(snap["min"]))
+                self.max = max(self.max, float(snap["max"]))
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if self.count == 0:
+                return None
+            target = q * self.count
+            seen = 0
+            for i, c in enumerate(self.counts):
+                if seen + c >= target and c > 0:
+                    lo = 0.0 if i == 0 else self.bounds[i - 1]
+                    hi = (self.bounds[i] if i < len(self.bounds)
+                          else max(self.max, lo))
+                    lo = max(lo, self.min)
+                    hi = min(hi, self.max) if self.max >= lo else hi
+                    frac = (target - seen) / c
+                    return lo + (hi - lo) * min(1.0, max(0.0, frac))
+                seen += c
+            return self.max
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"bounds": list(self.bounds), "counts": list(self.counts),
+                    "sum": self.sum, "count": self.count,
+                    "min": self.min if self.count else None,
+                    "max": self.max if self.count else None}
+
+
+class Registry:
+    """One process's instrument table. ``snapshot()`` is the only bulk
+    read surface and returns plain JSON-serializable data."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def counter(self, name: str, **labels) -> Counter:
+        key = name + _label_key(labels)
+        with self._lock:
+            c = self._counters.get(key)
+            if c is None:
+                c = self._counters[key] = Counter(threading.Lock())
+            return c
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        key = name + _label_key(labels)
+        with self._lock:
+            g = self._gauges.get(key)
+            if g is None:
+                g = self._gauges[key] = Gauge(threading.Lock())
+            return g
+
+    def histogram(self, name: str, bounds: Optional[Iterable[float]] = None,
+                  **labels) -> Histogram:
+        key = name + _label_key(labels)
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = self._histograms[key] = Histogram(
+                    threading.Lock(), bounds=tuple(bounds) if bounds else None)
+            return h
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {k: c.value for k, c in counters.items()},
+            "gauges": {k: {"value": g.value, "max": g.max}
+                       for k, g in gauges.items()},
+            "histograms": {k: h.snapshot() for k, h in histograms.items()},
+        }
+
+    def clear(self) -> None:
+        """Drop every instrument (tests; a fresh process never needs it)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+# -- process-wide default ----------------------------------------------------
+
+_default = Registry()
+
+
+def get_registry() -> Registry:
+    return _default
+
+
+def set_registry(registry: Registry) -> Registry:
+    """Swap the process default (tests); returns the previous one."""
+    global _default
+    prev, _default = _default, registry
+    return prev

@@ -1,0 +1,192 @@
+"""Merge a run's event files into one human-readable summary.
+
+``python -m sparse_coding_tpu.obs.report <run_dir>`` scans
+``<run_dir>/obs/*.jsonl`` — one file per process that took part in the
+run (supervisor + every child-step attempt) — and joins them on the run
+ID the supervisor propagated (obs/spans.py correlation contract):
+
+- per-span duration stats (count, errors, p50/p95/p99, total wall) from
+  ``span.end`` events, exact — the raw durations are in the events;
+- merged registry counters (summed across processes: retraces, compiles,
+  rows harvested, sink drops, …), gauges (latest by wall clock:
+  throughput, memory), histograms (bin-for-bin fixed-bucket merge) from
+  each file's LAST ``metrics`` event — the crash-safe snapshot the hosts
+  flush at durable boundaries;
+- hygiene: files scanned, torn/corrupt lines skipped (a SIGKILLed
+  writer's tail is skipped by the reader contract, so it can never
+  corrupt this report), run IDs seen (one, unless files from different
+  runs were mixed into the directory).
+
+Diagnostics go to the returned dict / stdout only — this module never
+touches jax, so the CLI runs on a host with a wedged tunnel.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import Optional
+
+import threading
+
+from sparse_coding_tpu.obs.registry import Histogram
+from sparse_coding_tpu.obs.sink import scan_events
+
+
+def _quantile(values: list[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
+    """The merged summary dict for one run directory."""
+    run_dir = Path(run_dir)
+    obs_dir = run_dir / obs_subdir
+    files = sorted(obs_dir.glob("*.jsonl")) if obs_dir.exists() else []
+    spans: dict[str, dict] = {}
+    counters: dict[str, int] = {}
+    gauges: dict[str, dict] = {}  # name -> {"value", "max", "ts"}
+    merged: dict[str, Histogram] = {}
+    run_ids: set[str] = set()
+    steps: set[str] = set()
+    skipped_total = 0
+    n_events = 0
+    errors: dict[str, int] = {}
+
+    for path in files:
+        events, skipped = scan_events(path)
+        skipped_total += skipped
+        n_events += len(events)
+        last_metrics: Optional[dict] = None
+        for ev in events:
+            if ev.get("run"):
+                run_ids.add(ev["run"])
+            if ev.get("step"):
+                steps.add(ev["step"])
+            kind = ev.get("kind")
+            if kind == "span.end":
+                s = spans.setdefault(ev.get("span", "?"), {
+                    "count": 0, "errors": 0, "dur_s": []})
+                s["count"] += 1
+                if not ev.get("ok", True):
+                    s["errors"] += 1
+                    err = ev.get("error", "Error")
+                    errors[err] = errors.get(err, 0) + 1
+                if isinstance(ev.get("dur_s"), (int, float)):
+                    s["dur_s"].append(float(ev["dur_s"]))
+            elif kind == "metrics":
+                last_metrics = ev
+        if last_metrics is not None:
+            snap = last_metrics.get("registry", {})
+            for name, v in snap.get("counters", {}).items():
+                counters[name] = counters.get(name, 0) + int(v)
+            ts = float(last_metrics.get("ts", 0.0))
+            for name, g in snap.get("gauges", {}).items():
+                if name not in gauges or ts >= gauges[name]["ts"]:
+                    gauges[name] = {"value": g.get("value"),
+                                    "max": g.get("max"), "ts": ts}
+            for name, h in snap.get("histograms", {}).items():
+                hist = merged.get(name)
+                if hist is None:
+                    hist = merged[name] = Histogram(threading.Lock(),
+                                                    bounds=h.get("bounds"))
+                try:
+                    hist.merge_snapshot(h)
+                except ValueError:
+                    pass  # bounds drifted between processes: skip, not die
+
+    span_stats = {}
+    for name, s in sorted(spans.items()):
+        durs = s["dur_s"]
+        span_stats[name] = {
+            "count": s["count"], "errors": s["errors"],
+            "total_s": round(sum(durs), 6),
+            "p50_s": _quantile(durs, 0.50), "p95_s": _quantile(durs, 0.95),
+            "p99_s": _quantile(durs, 0.99),
+        }
+    histograms = {name: {**h.snapshot(),
+                         "p50": h.quantile(0.50), "p95": h.quantile(0.95),
+                         "p99": h.quantile(0.99)}
+                  for name, h in merged.items()}
+    return {
+        "run_dir": str(run_dir),
+        "run_ids": sorted(run_ids),
+        "steps": sorted(steps),
+        "files": [p.name for p in files],
+        "events": n_events,
+        "skipped_lines": skipped_total,
+        "spans": span_stats,
+        "counters": dict(sorted(counters.items())),
+        "gauges": {k: {"value": v["value"], "max": v["max"]}
+                   for k, v in sorted(gauges.items())},
+        "histograms": histograms,
+        "span_errors": errors,
+        "retraces": counters.get("jax.retraces", 0),
+        "compiles": counters.get("jax.compiles", 0),
+        "dropped_events": counters.get("obs.sink.dropped", 0),
+    }
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    return f"{v * 1e3:.1f}ms" if v < 1.0 else f"{v:.2f}s"
+
+
+def format_report(report: dict) -> str:
+    lines = [f"run {', '.join(report['run_ids']) or '(no run id)'} — "
+             f"{len(report['files'])} event file(s), {report['events']} "
+             f"events, {report['skipped_lines']} torn/corrupt line(s) "
+             f"skipped",
+             f"steps: {', '.join(report['steps']) or '-'}"]
+    if report["spans"]:
+        lines.append("spans (count/err  p50  p95  p99  total):")
+        for name, s in report["spans"].items():
+            lines.append(
+                f"  {name:<28} {s['count']}/{s['errors']}  "
+                f"{_fmt_s(s['p50_s'])}  {_fmt_s(s['p95_s'])}  "
+                f"{_fmt_s(s['p99_s'])}  {_fmt_s(s['total_s'])}")
+    throughput = {k: v for k, v in report["gauges"].items()
+                  if k.endswith("per_sec")}
+    if throughput:
+        lines.append("throughput:")
+        for name, g in throughput.items():
+            lines.append(f"  {name:<28} {g['value']:.1f} (max {g['max']:.1f})")
+    lines.append(f"xla: {report['retraces']} retrace(s), "
+                 f"{report['compiles']} compile(s)")
+    interesting = {k: v for k, v in report["counters"].items()
+                   if not k.startswith(("jax.retraces", "jax.compiles"))}
+    if interesting:
+        lines.append("counters:")
+        for name, v in interesting.items():
+            lines.append(f"  {name:<28} {v}")
+    if report["span_errors"]:
+        lines.append(f"errors: {report['span_errors']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if len(argv) != 1:
+        raise SystemExit(
+            "usage: python -m sparse_coding_tpu.obs.report <run_dir> "
+            "[--json]")
+    report = build_report(argv[0])
+    try:
+        print(json.dumps(report, indent=2, default=float) if as_json
+              else format_report(report))
+    except BrokenPipeError:
+        # `... | head` closed the pipe: normal CLI usage, not an error
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+
+
+if __name__ == "__main__":
+    main()

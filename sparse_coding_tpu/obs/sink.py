@@ -1,0 +1,204 @@
+"""Crash-safe JSONL event sink: append-only, line-atomic, torn-tail-tolerant.
+
+The observability write path must obey two rules the journal's
+read+rewrite-atomic append cannot afford at event rates:
+
+1. **The host workload is never collateral.** A failing event write
+   (disk full, injected fault) drops THAT event, counts the drop, and
+   returns — it must not kill a sweep. The write sits behind the named
+   fault site ``obs.sink.write`` (docs/ARCHITECTURE.md §10) so the
+   fault-matrix suite drives both the error-drop and the corrupt-line
+   paths deterministically.
+2. **A torn tail is data loss, never corruption.** Events append to a
+   per-process file (no writer ever shares a file, so O_APPEND ordering
+   is trivial) in two writes: the JSON payload, then the ``\\n`` commit
+   byte. A SIGKILL or power cut between the two — the instant the
+   ``obs.sink.write`` crash barrier pins for the chaos matrix — leaves an
+   unterminated (or, after an OS-level partial flush, truncated) last
+   line that :func:`scan_events` skips by contract: a reader only
+   accepts newline-terminated lines that parse as JSON.
+
+fsync policy: every ``fsync_every`` events (default 1 — each committed
+line is durable; raise it on hot paths where losing the last few events
+to a power cut is acceptable). ``close()`` always syncs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from pathlib import Path
+from typing import Optional
+
+from sparse_coding_tpu.resilience.crash import crash_barrier
+from sparse_coding_tpu.resilience.faults import fault_point
+
+from sparse_coding_tpu.obs.registry import get_registry
+
+ENV_OBS_DIR = "SPARSE_CODING_OBS_DIR"
+FAULT_SITE = "obs.sink.write"  # pre-registered in resilience.faults/crash
+
+
+class EventSink:
+    """One process's append-only event file. ``emit(dict)`` writes exactly
+    one JSON line; returns False (and counts ``obs.sink.dropped``) when
+    the write failed — never raises into the host workload."""
+
+    def __init__(self, path: str | Path, fsync_every: int = 1):
+        self.path = Path(path)
+        self.fsync_every = max(0, int(fsync_every))
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fd: Optional[int] = os.open(
+            str(self.path), os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+        self._lock = threading.Lock()
+        self._since_sync = 0
+
+    def emit(self, record: dict) -> bool:
+        try:
+            data = json.dumps(record, default=_json_default).encode()
+        except (TypeError, ValueError):
+            get_registry().counter("obs.sink.dropped").inc()
+            return False
+        with self._lock:
+            if self._fd is None:
+                get_registry().counter("obs.sink.dropped").inc()
+                return False
+            try:
+                # the fault site covers the whole line write; corrupt-mode
+                # flips a payload byte (the reader must then skip the line)
+                data = fault_point(FAULT_SITE, data)
+                os.write(self._fd, data)
+                # the worst instant: payload written, commit byte not — a
+                # kill here leaves the torn tail scan_events() must skip
+                crash_barrier(FAULT_SITE)
+                os.write(self._fd, b"\n")
+                self._since_sync += 1
+                if self.fsync_every and self._since_sync >= self.fsync_every:
+                    os.fsync(self._fd)
+                    self._since_sync = 0
+            except OSError:
+                get_registry().counter("obs.sink.dropped").inc()
+                return False
+        return True
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fd is not None and self._since_sync:
+                try:
+                    os.fsync(self._fd)
+                    self._since_sync = 0
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd is None:
+                return
+            try:
+                os.fsync(self._fd)
+            except OSError:
+                pass
+            os.close(self._fd)
+            self._fd = None
+
+    def __enter__(self) -> "EventSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _json_default(obj):
+    try:
+        return float(obj)
+    except (TypeError, ValueError):
+        return repr(obj)
+
+
+def scan_events(path: str | Path) -> tuple[list[dict], int]:
+    """Read one event file: ``(events, skipped_lines)``. Only newline-
+    terminated, JSON-parsing lines are events; an unterminated tail (the
+    SIGKILL case) and corrupt lines are counted, skipped, and can never
+    poison a report."""
+    path = Path(path)
+    if not path.exists():
+        return [], 0
+    raw = path.read_bytes()
+    events: list[dict] = []
+    skipped = 0
+    if not raw:
+        return events, skipped
+    lines = raw.split(b"\n")
+    torn_tail = lines.pop()  # b"" when the file ends with the commit byte
+    if torn_tail:
+        skipped += 1
+    for line in lines:
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            skipped += 1
+            continue
+        if isinstance(rec, dict):
+            events.append(rec)
+        else:
+            skipped += 1
+    return events, skipped
+
+
+def read_events(path: str | Path) -> list[dict]:
+    """Events only (scan_events without the skip count)."""
+    return scan_events(path)[0]
+
+
+# -- module-global sink (the per-process default spans/metrics write to) ------
+
+_active: Optional[EventSink] = None
+_env_checked = False
+_lock = threading.Lock()
+
+
+def configure(sink: Optional[EventSink]) -> Optional[EventSink]:
+    """Install (or with None, clear) the process sink; returns the
+    previous one. Explicit configuration wins over the env lookup."""
+    global _active, _env_checked
+    with _lock:
+        prev, _active = _active, sink
+        _env_checked = True
+    return prev
+
+
+def configure_from_env(name: str = "") -> Optional[EventSink]:
+    """Create the process sink inside ``SPARSE_CODING_OBS_DIR`` (no-op
+    returning None when unset). The file name is ``<name>-<pid>.jsonl`` so
+    every process of a run owns its file — no cross-process interleaving,
+    and a restarted attempt (new pid) never appends to a dead process's
+    possibly-torn file."""
+    folder = os.environ.get(ENV_OBS_DIR, "").strip()
+    if not folder:
+        configure(None)
+        return None
+    label = name or os.environ.get("SPARSE_CODING_OBS_STEP", "") or "proc"
+    sink = EventSink(Path(folder) / f"{label}-{os.getpid()}.jsonl")
+    configure(sink)
+    return sink
+
+
+def active_sink() -> Optional[EventSink]:
+    """The configured sink; lazily self-configures from the env once so
+    library code needs no supervisor plumbing (mirrors ``lease.beat``)."""
+    global _env_checked
+    with _lock:
+        if _active is not None or _env_checked:
+            return _active
+    return configure_from_env()
+
+
+def close() -> None:
+    sink = configure(None)
+    global _env_checked
+    _env_checked = False
+    if sink is not None:
+        sink.close()

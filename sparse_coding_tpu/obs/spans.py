@@ -1,0 +1,151 @@
+"""Spans: structured start/end/error events with run/step/span correlation.
+
+A **span** is one timed region of host work (``span("sweep.chunk",
+index=ci)``). Entering emits a ``span.start`` event, leaving emits
+``span.end`` with a monotonic duration and ok/error status, and the
+duration lands in the registry histogram ``span.<name>.dur_s`` (errors in
+the counter ``span.<name>.errors``) — so one call site feeds both the
+event stream the report merges and the cheap in-process snapshot.
+
+Correlation contract (docs/ARCHITECTURE.md §12): every event carries
+
+- ``run``  — the run ID, minted once per run dir by the pipeline
+  supervisor (persisted to ``<run_dir>/obs/run_id`` so a restarted
+  supervisor joins, not forks, the run) and propagated to child steps via
+  ``SPARSE_CODING_RUN_ID``;
+- ``step`` — the supervisor step name (``SPARSE_CODING_OBS_STEP``);
+- ``pid`` / ``seq`` — process identity and per-process event order;
+- ``span_id`` / ``parent`` — this span and its enclosing span (a
+  thread-local stack), so nested regions reconstruct.
+
+Events from the supervisor, its child steps, journal records, and lease
+beats of one run all join on ``run`` (plus the run dir itself — the
+coarse correlation scope).
+
+Timing uses :func:`monotime` — the repo's single raw-clock read for hot
+paths (``tests/test_obs_lint.py`` enforces that data/train/serve/pipeline
+code reads clocks through here).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from sparse_coding_tpu.obs import sink as sink_mod
+from sparse_coding_tpu.obs.registry import Registry, get_registry
+
+ENV_RUN_ID = "SPARSE_CODING_RUN_ID"
+ENV_STEP = "SPARSE_CODING_OBS_STEP"
+
+monotime = time.perf_counter  # the sanctioned monotonic clock read
+
+
+def run_id() -> str:
+    return os.environ.get(ENV_RUN_ID, "")
+
+
+def step_name() -> str:
+    return os.environ.get(ENV_STEP, "")
+
+
+_seq_lock = threading.Lock()
+_seq = 0
+_stack = threading.local()  # per-thread open-span id stack
+
+
+def _next_seq() -> int:
+    global _seq
+    with _seq_lock:
+        _seq += 1
+        return _seq
+
+
+def _current_parent() -> Optional[str]:
+    stack = getattr(_stack, "ids", None)
+    return stack[-1] if stack else None
+
+
+def emit_event(kind: str, *, sink: Optional[sink_mod.EventSink] = None,
+               **fields) -> bool:
+    """One correlated event to the given (or process-default) sink.
+    No-op returning False when no sink is configured — library code calls
+    this unconditionally, supervisor-agnostic (mirrors ``lease.beat``)."""
+    target = sink if sink is not None else sink_mod.active_sink()
+    if target is None:
+        return False
+    rec = {"ts": time.time(), "kind": kind, "run": run_id(),
+           "step": step_name(), "pid": os.getpid(), "seq": _next_seq()}
+    rec.update(fields)
+    return target.emit(rec)
+
+
+def record_span(name: str, dur_s: float, ok: bool = True,
+                error: str = "", sink: Optional[sink_mod.EventSink] = None,
+                registry: Optional[Registry] = None, **attrs) -> None:
+    """Record a completed span from an externally-measured duration (loop
+    bodies that cannot wrap themselves in a context manager). Feeds the
+    registry histogram AND emits the ``span.end`` event."""
+    reg = registry if registry is not None else get_registry()
+    reg.histogram(f"span.{name}.dur_s").observe(dur_s)
+    if not ok:
+        reg.counter(f"span.{name}.errors").inc()
+    emit_event("span.end", sink=sink, span=name, dur_s=round(dur_s, 6),
+               ok=ok, **({"error": error} if error else {}), **attrs)
+
+
+class span:
+    """Context manager form: emits paired start/end events with nesting.
+
+    >>> with span("sweep.chunk", index=ci):
+    ...     train_one_chunk()
+    """
+
+    def __init__(self, name: str, sink: Optional[sink_mod.EventSink] = None,
+                 registry: Optional[Registry] = None, **attrs):
+        self.name = name
+        self.attrs = attrs
+        self._sink = sink
+        self._registry = registry
+        self._t0 = 0.0
+        self.span_id = ""
+
+    def __enter__(self) -> "span":
+        self.span_id = f"{os.getpid()}-{_next_seq()}"
+        parent = _current_parent()
+        stack = getattr(_stack, "ids", None)
+        if stack is None:
+            stack = _stack.ids = []
+        stack.append(self.span_id)
+        emit_event("span.start", sink=self._sink, span=self.name,
+                   span_id=self.span_id,
+                   **({"parent": parent} if parent else {}), **self.attrs)
+        self._t0 = monotime()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        dur = monotime() - self._t0
+        stack = getattr(_stack, "ids", None)
+        if stack and stack[-1] == self.span_id:
+            stack.pop()
+        reg = self._registry if self._registry is not None else get_registry()
+        reg.histogram(f"span.{self.name}.dur_s").observe(dur)
+        if exc_type is not None:
+            reg.counter(f"span.{self.name}.errors").inc()
+        emit_event("span.end", sink=self._sink, span=self.name,
+                   span_id=self.span_id, dur_s=round(dur, 6),
+                   ok=exc_type is None,
+                   **({"error": exc_type.__name__} if exc_type else {}),
+                   **self.attrs)
+
+
+def flush_metrics(sink: Optional[sink_mod.EventSink] = None,
+                  registry: Optional[Registry] = None) -> bool:
+    """Emit the registry snapshot as one ``metrics`` event. Called at
+    durable boundaries (chunk trained, step finished) so a crashed
+    process still leaves its last counters in the event stream — the
+    crash-only twin of an exit handler, which SIGKILL never runs."""
+    reg = registry if registry is not None else get_registry()
+    return emit_event("metrics", sink=sink, registry=reg.snapshot())

@@ -20,6 +20,7 @@ from sparse_coding_tpu.pipeline.supervisor import (
     StepHung,
     Supervisor,
     build_pipeline,
+    load_or_create_run_id,
     step_argv,
     supervise_bench,
 )
@@ -33,6 +34,7 @@ __all__ = [
     "StepHung",
     "Supervisor",
     "build_pipeline",
+    "load_or_create_run_id",
     "step_argv",
     "supervise_bench",
 ]

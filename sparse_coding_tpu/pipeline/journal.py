@@ -35,14 +35,20 @@ from sparse_coding_tpu.resilience.atomic import atomic_write_bytes
 class RunJournal:
     """One journal file (``journal.jsonl``) for one pipeline run dir."""
 
-    def __init__(self, path: str | Path, clock=time.time):
+    def __init__(self, path: str | Path, clock=time.time, run_id: str = ""):
         self.path = Path(path)
         self._clock = clock
+        # correlation (docs/ARCHITECTURE.md §12): journal records carry
+        # the run ID the supervisor minted, joining them with the obs
+        # event stream and the child steps' lease beats
+        self.run_id = run_id
         self.path.parent.mkdir(parents=True, exist_ok=True)
 
     def append(self, event: str, step: str = "", **detail) -> dict:
         rec = {"seq": self._next_seq(), "ts": self._clock(),
                "pid": os.getpid(), "event": event, "step": step}
+        if self.run_id:
+            rec["run"] = self.run_id
         if detail:
             rec["detail"] = detail
         existing = self.path.read_bytes() if self.path.exists() else b""

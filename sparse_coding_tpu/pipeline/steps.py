@@ -31,6 +31,7 @@ from pathlib import Path
 
 import numpy as np
 
+from sparse_coding_tpu import obs
 from sparse_coding_tpu.resilience import lease
 from sparse_coding_tpu.resilience.atomic import atomic_write_text
 from sparse_coding_tpu.resilience.crash import crash_barrier, register_crash_site
@@ -226,8 +227,20 @@ def main(argv=None) -> None:
     step, config_path = argv[0], argv[2]
     # claim the lease before any real work: from here on, silence = hang
     lease.configure_from_env(step=step)
+    # join the run's observability stream (no-op outside a supervisor):
+    # the env carries SPARSE_CODING_RUN_ID / _OBS_DIR / _OBS_STEP, so this
+    # child's spans, XLA probe counters, and metrics snapshots land in the
+    # same obs dir as the supervisor's and merge in obs.report (§12)
+    obs.configure_sink_from_env(step)
+    obs.install_jax_probes()
     config = json.loads(Path(config_path).read_text())
-    STEPS[step](config)
+    try:
+        with obs.span(f"step.{step}"):
+            STEPS[step](config)
+    finally:
+        obs.update_memory_gauges()
+        obs.flush_metrics()
+        obs.close_sink()
 
 
 if __name__ == "__main__":

@@ -43,6 +43,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Optional, Sequence
 
+from sparse_coding_tpu import obs
 from sparse_coding_tpu.pipeline.journal import RunJournal
 from sparse_coding_tpu.resilience import lease as lease_mod
 from sparse_coding_tpu.resilience import watchdog as watchdog_mod
@@ -57,6 +58,30 @@ from sparse_coding_tpu.resilience.watchdog import (
 )
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def load_or_create_run_id(run_dir: str | Path) -> str:
+    """The run's correlation ID (docs/ARCHITECTURE.md §12): minted once
+    per run dir and persisted to ``<run_dir>/obs/run_id``, so a restarted
+    supervisor — crash-only: restart IS the normal path — joins the same
+    run instead of forking a new identity. Every event, journal record,
+    and child-step env carries it."""
+    import binascii
+
+    run_dir = Path(run_dir)
+    marker = run_dir / "obs" / "run_id"
+    try:
+        existing = marker.read_text().strip()
+        if existing:
+            return existing
+    except OSError:
+        pass
+    from sparse_coding_tpu.resilience.atomic import atomic_write_text
+
+    rid = f"{run_dir.name}-{binascii.hexlify(os.urandom(4)).decode()}"
+    marker.parent.mkdir(parents=True, exist_ok=True)
+    atomic_write_text(marker, rid + "\n")
+    return rid
 
 
 class PipelineError(ResilienceError):
@@ -166,9 +191,27 @@ class Supervisor:
         self.cpu_only = bool(cpu_only)
         self._prober = prober or watchdog_mod.probe_tunnel
         self._clock = clock
-        self.journal = RunJournal(self.run_dir / "journal.jsonl", clock=clock)
+        # the run's correlation identity: journal records carry it, child
+        # steps inherit it (with the shared event dir) through the env, so
+        # every process's events join up in obs.report (§12)
+        self.run_id = load_or_create_run_id(self.run_dir)
+        self.obs_dir = self.run_dir / "obs"
+        # a PER-INSTANCE sink (not the module-global one, which tests and
+        # a hosting process may own): opened for the duration of run() and
+        # closed in its finally, so idle/dead supervisors hold no fd
+        self._sink: Optional[obs.EventSink] = None
+        self.journal = RunJournal(self.run_dir / "journal.jsonl", clock=clock,
+                                  run_id=self.run_id)
         (self.run_dir / "logs").mkdir(parents=True, exist_ok=True)
         (self.run_dir / "leases").mkdir(parents=True, exist_ok=True)
+
+    def _record_span(self, name: str, dur_s: float, ok: bool = True,
+                     error: str = "", **attrs) -> None:
+        """The single home of the supervisor-side emit plumbing: every
+        span goes to this instance's sink stamped with this run's ID —
+        never to the module-global sink, which would lose both."""
+        obs.record_span(name, dur_s, ok=ok, error=error, sink=self._sink,
+                        run=self.run_id, **attrs)
 
     # -- paths ---------------------------------------------------------------
 
@@ -186,21 +229,36 @@ class Supervisor:
         after which calling ``run()`` again (same or new process) resumes."""
         self.journal.append("run.start",
                             detail_steps=[s.name for s in self.steps])
+        self._sink = obs.EventSink(
+            self.obs_dir / f"supervisor-{os.getpid()}.jsonl")
+        t_run = obs.monotime()
         summary: dict[str, str] = {}
-        for step in self.steps:
-            if step.done():
-                # artifact present: complete, whether or not a journal
-                # record survived (artifacts beat the journal)
-                if step.name not in self.journal.done_steps():
-                    self.journal.append("step.done", step.name,
-                                        note="artifact present at startup")
-                summary[step.name] = "skipped"
-                continue
-            self._takeover_lease(step)
-            self._run_step(step)
-            summary[step.name] = "done"
-        self.journal.append("run.done")
-        return summary
+        try:
+            for step in self.steps:
+                if step.done():
+                    # artifact present: complete, whether or not a journal
+                    # record survived (artifacts beat the journal)
+                    if step.name not in self.journal.done_steps():
+                        self.journal.append("step.done", step.name,
+                                            note="artifact present at startup")
+                    summary[step.name] = "skipped"
+                    continue
+                self._takeover_lease(step)
+                self._run_step(step)
+                summary[step.name] = "done"
+        except BaseException as e:
+            self._record_span("pipeline.run", obs.monotime() - t_run,
+                              ok=False, error=type(e).__name__)
+            raise
+        else:
+            self.journal.append("run.done")
+            self._record_span("pipeline.run", obs.monotime() - t_run,
+                              summary=dict(summary))
+            return summary
+        finally:
+            obs.flush_metrics(sink=self._sink)
+            self._sink.close()
+            self._sink = None
 
     # -- lease takeover ------------------------------------------------------
 
@@ -236,6 +294,12 @@ class Supervisor:
             else:
                 env[key] = val
         env[lease_mod.ENV_PATH] = str(self.lease_path(step))
+        # correlation propagation (§12): the child's spans/events/metrics
+        # land in the run's shared obs dir, stamped with this run's ID and
+        # its step name — obs.report joins them with the supervisor's own
+        env[obs.ENV_RUN_ID] = self.run_id
+        env[obs.ENV_OBS_DIR] = str(self.obs_dir)
+        env[obs.ENV_STEP] = step.name
         if self.cpu_only or degraded:
             env = stripped_cpu_env(env)
         return env
@@ -268,19 +332,31 @@ class Supervisor:
             self.journal.append("step.spawn", step.name, attempt=attempt,
                                 argv=shlex.join(spawn_argv),
                                 degraded=degraded)
+            t_attempt = obs.monotime()
             with open(log_path, "ab") as log_fh:
                 proc = subprocess.Popen(spawn_argv, cwd=str(REPO_ROOT),
                                         env=env, stdout=log_fh,
                                         stderr=subprocess.STDOUT)
             seed_lease(self.lease_path(step), proc.pid, step=step.name,
-                       clock=self._clock)
+                       clock=self._clock, run=self.run_id)
             verdict = self._watch(step, proc)
+
+            def _span(outcome: str, ok: bool) -> None:
+                # one span per attempt: the supervisor-side wall clock of
+                # the child, labeled with how the attempt ended
+                self._record_span("pipeline.step",
+                                  obs.monotime() - t_attempt, ok=ok,
+                                  error="" if ok else outcome,
+                                  step=step.name, attempt=attempt,
+                                  outcome=outcome, degraded=degraded)
+
             if verdict is None:  # exited on its own
                 rc = proc.returncode
                 if rc == 0 and step.done():
                     self.journal.append("step.done", step.name,
                                         attempt=attempt)
                     self.lease_path(step).unlink(missing_ok=True)
+                    _span("done", ok=True)
                     return
                 if rc == 0:
                     last_reason = ("exit 0 but completion artifact missing "
@@ -288,19 +364,23 @@ class Supervisor:
                     self.journal.append("step.failed", step.name,
                                         attempt=attempt, rc=0,
                                         reason=last_reason)
+                    _span("failed", ok=False)
                 elif rc < 0:
                     last_reason = f"killed by signal {-rc}"
                     self.journal.append("step.killed", step.name,
                                         attempt=attempt, signal=-rc,
                                         log=str(log_path))
+                    _span("killed", ok=False)
                 else:
                     last_reason = f"exit code {rc}"
                     self.journal.append("step.failed", step.name,
                                         attempt=attempt, rc=rc,
                                         log=str(log_path))
+                    _span("failed", ok=False)
             else:  # watchdog declared it hung and killed it
                 action = verdict["action"]
                 last_reason = f"hung ({action})"
+                _span("hung", ok=False)
                 if action == HALT:
                     raise StepHung(step.name, verdict)
                 if action == DEGRADE_CPU:

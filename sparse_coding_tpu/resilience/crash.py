@@ -25,6 +25,8 @@ Canonical sites (hosts register theirs at import, like fault sites):
                       (the worst instant of the checkpoint-set swap)
 ``eval.write``        pipeline eval step — results computed, output file
                       NOT yet written
+``obs.sink.write``    obs/sink.py — event payload appended, commit newline
+                      not yet written (the torn-tail instant)
 ====================  =====================================================
 
 The chaos matrix (tests/test_pipeline_chaos.py, marker ``chaos``) kills a
@@ -60,6 +62,8 @@ CRASH_SITES: dict[str, str] = {
     "ckpt.swap": "mid checkpoint-set swap: old set renamed to ckpt_prev/, "
                  "new set not yet renamed in",
     "eval.write": "eval results computed, output not yet written",
+    "obs.sink.write": "event payload appended, commit newline not yet "
+                      "written (obs/sink.py — the torn-tail instant)",
 }
 
 

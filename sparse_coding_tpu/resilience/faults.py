@@ -23,6 +23,8 @@ pre-registered here so env plans validate before any host module loads):
 ``serve.dispatch``    ServingEngine.run_padded — immediately before the
                       compiled device call
 ``lock.acquire``      bench.py tunnel-flock acquisition attempt
+``obs.sink.write``    obs/sink.py EventSink.emit — every observability
+                      event line append (drops, never raises)
 ====================  =====================================================
 
 Plan syntax (``SPARSE_CODING_FAULT_PLAN`` or :func:`parse_fault_plan`):
@@ -63,6 +65,7 @@ FAULT_SITES: dict[str, str] = {
     "ckpt.restore": "checkpoint restore (msgpack and orbax backends)",
     "serve.dispatch": "serving engine compiled-program dispatch",
     "lock.acquire": "tunnel flock acquisition attempt",
+    "obs.sink.write": "observability event-sink line append (obs/sink.py)",
 }
 
 

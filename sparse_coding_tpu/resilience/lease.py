@@ -39,6 +39,11 @@ from sparse_coding_tpu.resilience.atomic import atomic_write_text
 
 ENV_PATH = "SPARSE_CODING_LEASE_PATH"
 ENV_INTERVAL = "SPARSE_CODING_LEASE_INTERVAL_S"
+# the supervisor's run correlation ID (obs/spans.py contract, docs/
+# ARCHITECTURE.md §12): stamped into every lease write so beats join the
+# run's journal records and events. Read directly (not via obs) to keep
+# this module dependency-free.
+ENV_RUN_ID = "SPARSE_CODING_RUN_ID"
 
 
 @dataclass
@@ -79,7 +84,8 @@ class Lease:
         atomic_write_text(self.path, json.dumps({
             "pid": os.getpid(), "host": socket.gethostname(),
             "step": self.step, "started_at": self._started,
-            "beat_at": now, "seq": self._seq}))
+            "beat_at": now, "seq": self._seq,
+            "run": os.environ.get(ENV_RUN_ID, "")}))
         self._last_write = now
 
     def release(self) -> None:
@@ -133,7 +139,7 @@ def lease_state(path: str | Path, stale_after_s: float,
 
 
 def seed_lease(path: str | Path, pid: int, step: str = "",
-               clock=time.time) -> None:
+               clock=time.time, run: str = "") -> None:
     """Supervisor-side: stamp a just-spawned child's claim so the hang
     window opens at spawn time — the child overwrites with its own beats
     once its interpreter is up (jax import time counts against the stale
@@ -143,7 +149,8 @@ def seed_lease(path: str | Path, pid: int, step: str = "",
     now = clock()
     atomic_write_text(path, json.dumps({
         "pid": int(pid), "host": socket.gethostname(), "step": step,
-        "started_at": now, "beat_at": now, "seq": 0}))
+        "started_at": now, "beat_at": now, "seq": 0,
+        "run": run or os.environ.get(ENV_RUN_ID, "")}))
 
 
 # -- module-global heartbeat hook (host work loops call beat()) --------------

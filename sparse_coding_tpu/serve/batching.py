@@ -22,13 +22,13 @@ Flush policy (per (model, op) stream, oldest stream first):
 from __future__ import annotations
 
 import threading
-import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
 import numpy as np
 
+from sparse_coding_tpu.obs import monotime
 from sparse_coding_tpu.serve.metrics import ServingMetrics
 
 
@@ -222,7 +222,7 @@ class MicroBatcher:
                 if self._paused:
                     self._cond.wait(timeout=0.1)
                     continue
-                now = time.perf_counter()
+                now = monotime()
                 key, next_deadline = self._pick_stream(now)
                 if key is None:
                     self._cond.wait(

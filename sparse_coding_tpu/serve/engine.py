@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparse_coding_tpu.obs import monotime
 from sparse_coding_tpu.resilience.breaker import CircuitBreaker
 from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
 from sparse_coding_tpu.serve.batching import (
@@ -242,7 +243,7 @@ class ServingEngine:
         if rows > self._buckets[-1]:
             raise RequestTooLargeError(rows, self._buckets[-1])
         req = Request(key=(model, op), x=arr, rows=rows, squeeze=squeeze,
-                      t_submit=time.perf_counter())
+                      t_submit=monotime())
         return self._batcher.submit(req)
 
     def query(self, model: str, x, op: str = "encode",
@@ -375,7 +376,7 @@ class ServingEngine:
         self.metrics.record_batch(bucket, len(requests), rows,
                                   deadline_flush)
         rows_axis = 1 if self._registry.get(model).is_stack else 0
-        now = time.perf_counter()
+        now = monotime()
         ofs = 0
         for r in requests:
             sl = ((slice(None),) * rows_axis

@@ -1,11 +1,27 @@
-"""Serving observability: per-bucket counters and latency quantiles.
+"""Serving observability: the obs registry behind the serving snapshot.
 
-Everything here is plain host-side Python (a lock, dicts, deques) — the
-metrics path must never touch jax, or instrumentation itself would add
-device dispatches to the hot loop. The one invariant the snapshot exists to
-prove is ``recompiles == 0`` after warmup: every compiled-program cache miss
-in steady state means a shape escaped the bucket ladder and the engine
-silently paid a trace+compile in a latency-sensitive path.
+Everything here is plain host-side Python — the metrics path must never
+touch jax, or instrumentation itself would add device dispatches to the
+hot loop (the obs core keeps the same discipline). Since the obs
+subsystem (docs/ARCHITECTURE.md §12) the counters/gauges/histograms live
+in a :class:`sparse_coding_tpu.obs.Registry` — so `obs.report` and
+`flush_metrics` see serving traffic through the same instrument taxonomy
+as every other subsystem — while ``snapshot()`` keeps its original schema
+(tests and the bench suite read it) and its exact ring-buffer latency
+quantiles.
+
+The one invariant the snapshot exists to prove is ``recompiles == 0``
+after warmup: every compiled-program cache miss in steady state means a
+shape escaped the bucket ladder and the engine silently paid a
+trace+compile in a latency-sensitive path.
+
+Instrument names (labels carry the bucket): ``serve.requests``,
+``serve.rejected``, ``serve.shed``, ``serve.dispatch_retries``,
+``serve.dispatch_failures``, ``serve.recompiles``,
+``serve.request_errors{type=..}``, ``serve.breaker_transitions``,
+``serve.queue_rows`` (gauge; its high-water mark is the max),
+``serve.batches{bucket=..}`` / ``serve.batch_requests`` / ``serve.rows``
+/ ``serve.deadline_flushes``, ``serve.latency_s{bucket=..}`` (histogram).
 """
 
 from __future__ import annotations
@@ -13,6 +29,9 @@ from __future__ import annotations
 import math
 import threading
 from collections import deque
+from typing import Optional
+
+from sparse_coding_tpu.obs.registry import Registry
 
 
 def _quantile_ms(samples: list[float], q: float) -> float | None:
@@ -24,119 +43,109 @@ def _quantile_ms(samples: list[float], q: float) -> float | None:
     return ordered[idx] * 1e3
 
 
-class _BucketStats:
-    __slots__ = ("batches", "requests", "rows", "deadline_flushes",
-                 "latencies")
-
-    def __init__(self, latency_window: int):
-        self.batches = 0
-        self.requests = 0
-        self.rows = 0
-        self.deadline_flushes = 0
-        self.latencies: deque[float] = deque(maxlen=latency_window)
-
-
 class ServingMetrics:
     """Thread-safe counters shared by the engine, the batcher, and the
-    offline driver. ``snapshot()`` is the only read surface."""
+    offline driver. ``snapshot()`` is the only read surface; ``registry``
+    exposes the same numbers as obs instruments.
 
-    def __init__(self, latency_window: int = 4096):
+    Each engine owns a PRIVATE registry by default (two engines in one
+    process must not sum their queues); pass ``registry=`` — e.g.
+    ``obs.get_registry()`` — to publish into a shared one."""
+
+    def __init__(self, latency_window: int = 4096,
+                 registry: Optional[Registry] = None):
+        self.registry = registry if registry is not None else Registry()
         self._lock = threading.Lock()
         self._latency_window = latency_window
-        self._buckets: dict[int, _BucketStats] = {}
-        self._recompiles = 0
+        self._buckets: set[int] = set()
+        self._latencies: dict[int, deque[float]] = {}
         self._recompile_keys: list[tuple] = []
-        self._rejected = 0
         self._queued_rows = 0
-        self._max_queued_rows = 0
-        self._submitted = 0
-        # resilience counters (docs/ARCHITECTURE.md §10): per-request error
-        # counts by type, dispatch retries/failures, shed requests, and the
-        # circuit breaker's current state + transition history — the
-        # snapshot is how an operator sees the breaker at all
-        self._request_errors: dict[str, int] = {}
-        self._dispatch_retries = 0
-        self._dispatch_failures = 0
-        self._shed_requests = 0
+        self._error_types: set[str] = set()
         self._breaker_state = "closed"
         # bounded mirror of the breaker's history: a flapping backend
         # cycling open/half_open for days must not grow the snapshot
         self._breaker_transitions: deque[str] = deque(maxlen=256)
-        self._breaker_n_transitions = 0
+        r = self.registry
+        self._submitted = r.counter("serve.requests")
+        self._rejected = r.counter("serve.rejected")
+        self._shed = r.counter("serve.shed")
+        self._retries = r.counter("serve.dispatch_retries")
+        self._failures = r.counter("serve.dispatch_failures")
+        self._recompiles = r.counter("serve.recompiles")
+        self._n_transitions = r.counter("serve.breaker_transitions")
+        self._queue_gauge = r.gauge("serve.queue_rows")
 
     # -- write side (engine / batcher) --------------------------------------
 
-    def _bucket(self, bucket: int) -> _BucketStats:
-        b = self._buckets.get(bucket)
-        if b is None:
-            b = self._buckets[bucket] = _BucketStats(self._latency_window)
-        return b
-
     def record_enqueue(self, rows: int) -> None:
+        self._submitted.inc()
         with self._lock:
-            self._submitted += 1
             self._queued_rows += rows
-            self._max_queued_rows = max(self._max_queued_rows,
-                                        self._queued_rows)
+            self._queue_gauge.set(self._queued_rows)
 
     def record_dequeue(self, rows: int) -> None:
         with self._lock:
             self._queued_rows = max(0, self._queued_rows - rows)
+            self._queue_gauge.set(self._queued_rows)
 
     def record_reject(self) -> None:
-        with self._lock:
-            self._rejected += 1
+        self._rejected.inc()
 
     def record_batch(self, bucket: int, n_requests: int, rows: int,
                      deadline_flush: bool) -> None:
         with self._lock:
-            b = self._bucket(bucket)
-            b.batches += 1
-            b.requests += n_requests
-            b.rows += rows
-            if deadline_flush:
-                b.deadline_flushes += 1
+            self._buckets.add(bucket)
+        r = self.registry
+        r.counter("serve.batches", bucket=bucket).inc()
+        r.counter("serve.batch_requests", bucket=bucket).inc(n_requests)
+        r.counter("serve.rows", bucket=bucket).inc(rows)
+        if deadline_flush:
+            r.counter("serve.deadline_flushes", bucket=bucket).inc()
 
     def record_latency(self, bucket: int, seconds: float) -> None:
         with self._lock:
-            self._bucket(bucket).latencies.append(seconds)
+            self._buckets.add(bucket)
+            q = self._latencies.get(bucket)
+            if q is None:
+                q = self._latencies[bucket] = deque(
+                    maxlen=self._latency_window)
+            q.append(seconds)
+        self.registry.histogram("serve.latency_s", bucket=bucket).observe(
+            seconds)
 
     def record_recompile(self, key: tuple) -> None:
+        self._recompiles.inc()
         with self._lock:
-            self._recompiles += 1
             self._recompile_keys.append(key)
 
     def record_request_errors(self, n: int, error_type: str) -> None:
         """n requests in one flush failed with the given error type."""
         with self._lock:
-            self._request_errors[error_type] = (
-                self._request_errors.get(error_type, 0) + n)
+            self._error_types.add(error_type)
+        self.registry.counter("serve.request_errors", type=error_type).inc(n)
 
     def record_dispatch_retry(self) -> None:
-        with self._lock:
-            self._dispatch_retries += 1
+        self._retries.inc()
 
     def record_dispatch_failure(self) -> None:
-        with self._lock:
-            self._dispatch_failures += 1
+        self._failures.inc()
 
     def record_shed(self, n: int = 1) -> None:
         """n requests refused without device work (open breaker)."""
-        with self._lock:
-            self._shed_requests += n
+        self._shed.inc(n)
 
     def record_breaker_transition(self, old: str, new: str) -> None:
+        self._n_transitions.inc()
         with self._lock:
             self._breaker_state = new
             self._breaker_transitions.append(f"{old}->{new}")
-            self._breaker_n_transitions += 1
 
     # -- read side -----------------------------------------------------------
 
     @property
     def recompiles(self) -> int:
-        with self._lock:
-            return self._recompiles
+        return self._recompiles.value
 
     @property
     def queued_rows(self) -> int:
@@ -148,38 +157,51 @@ class ServingMetrics:
         ratios (rows served / bucket capacity dispatched), latency p50/p99,
         queue-depth high-water mark, rejections, and the recompile counter
         (with the offending (model, op, bucket) keys when nonzero)."""
+        r = self.registry
         with self._lock:
-            buckets = {}
-            all_lat: list[float] = []
-            for size in sorted(self._buckets):
-                b = self._buckets[size]
-                lat = list(b.latencies)
-                all_lat.extend(lat)
-                capacity = b.batches * size
-                buckets[size] = {
-                    "batches": b.batches,
-                    "requests": b.requests,
-                    "rows": b.rows,
-                    "fill_ratio": (b.rows / capacity) if capacity else 0.0,
-                    "deadline_flushes": b.deadline_flushes,
-                    "p50_ms": _quantile_ms(lat, 0.50),
-                    "p99_ms": _quantile_ms(lat, 0.99),
-                }
-            return {
-                "buckets": buckets,
-                "p50_ms": _quantile_ms(all_lat, 0.50),
-                "p99_ms": _quantile_ms(all_lat, 0.99),
-                "requests": self._submitted,
-                "rejected": self._rejected,
-                "queue_depth_rows": self._queued_rows,
-                "max_queue_depth_rows": self._max_queued_rows,
-                "recompiles": self._recompiles,
-                "recompile_keys": list(self._recompile_keys),
-                "request_errors": dict(self._request_errors),
-                "dispatch_retries": self._dispatch_retries,
-                "dispatch_failures": self._dispatch_failures,
-                "shed_requests": self._shed_requests,
-                "breaker_state": self._breaker_state,
-                "breaker_transitions": list(self._breaker_transitions),
-                "breaker_n_transitions": self._breaker_n_transitions,
+            bucket_sizes = sorted(self._buckets)
+            latencies = {b: list(q) for b, q in self._latencies.items()}
+            recompile_keys = list(self._recompile_keys)
+            error_types = set(self._error_types)
+            breaker_state = self._breaker_state
+            breaker_transitions = list(self._breaker_transitions)
+            queued = self._queued_rows
+        buckets = {}
+        all_lat: list[float] = []
+        for size in bucket_sizes:
+            lat = latencies.get(size, [])
+            all_lat.extend(lat)
+            batches = r.counter("serve.batches", bucket=size).value
+            rows = r.counter("serve.rows", bucket=size).value
+            capacity = batches * size
+            buckets[size] = {
+                "batches": batches,
+                "requests": r.counter("serve.batch_requests",
+                                      bucket=size).value,
+                "rows": rows,
+                "fill_ratio": (rows / capacity) if capacity else 0.0,
+                "deadline_flushes": r.counter("serve.deadline_flushes",
+                                              bucket=size).value,
+                "p50_ms": _quantile_ms(lat, 0.50),
+                "p99_ms": _quantile_ms(lat, 0.99),
             }
+        return {
+            "buckets": buckets,
+            "p50_ms": _quantile_ms(all_lat, 0.50),
+            "p99_ms": _quantile_ms(all_lat, 0.99),
+            "requests": self._submitted.value,
+            "rejected": self._rejected.value,
+            "queue_depth_rows": queued,
+            "max_queue_depth_rows": int(self._queue_gauge.max),
+            "recompiles": self._recompiles.value,
+            "recompile_keys": recompile_keys,
+            "request_errors": {
+                t: r.counter("serve.request_errors", type=t).value
+                for t in sorted(error_types)},
+            "dispatch_retries": self._retries.value,
+            "dispatch_failures": self._failures.value,
+            "shed_requests": self._shed.value,
+            "breaker_state": breaker_state,
+            "breaker_transitions": breaker_transitions,
+            "breaker_n_transitions": self._n_transitions.value,
+        }

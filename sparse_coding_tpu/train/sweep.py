@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparse_coding_tpu import obs
 from sparse_coding_tpu.config import EnsembleArgs, SyntheticEnsembleArgs
 from sparse_coding_tpu.data.chunk_store import (
     ChunkStore,
@@ -164,14 +165,15 @@ def _swap_in_checkpoint_set(out_dir: Path, staging: Path) -> None:
     this on process 0 + barriers."""
     ckpt_dir = out_dir / "ckpt"
     prev = out_dir / "ckpt_prev"
-    if ckpt_dir.exists():
-        shutil.rmtree(prev, ignore_errors=True)
-        ckpt_dir.rename(prev)
-    # the swap's worst instant: ckpt/ is gone, the new set not yet named in
-    # — a kill here must leave resume falling back to ckpt_prev/ (chaos
-    # matrix site; tests/test_pipeline_chaos.py)
-    crash_barrier("ckpt.swap")
-    staging.rename(ckpt_dir)
+    with obs.span("sweep.ckpt_swap"):
+        if ckpt_dir.exists():
+            shutil.rmtree(prev, ignore_errors=True)
+            ckpt_dir.rename(prev)
+        # the swap's worst instant: ckpt/ is gone, the new set not yet named
+        # in — a kill here must leave resume falling back to ckpt_prev/
+        # (chaos matrix site; tests/test_pipeline_chaos.py)
+        crash_barrier("ckpt.swap")
+        staging.rename(ckpt_dir)
 
 
 def _flat_dicts(e: EnsembleLike) -> list:
@@ -303,6 +305,7 @@ def sweep(
             # fresh throughput window per chunk: checkpoint/artifact wall
             # time between chunks must not dilute the training-rate signal
             timer.reset()
+            t_chunk = obs.monotime()
             if chunk is not None and center is not None:
                 # cast the mean down rather than the chunk up: keeps the
                 # bf16 path bf16 end to end (host RAM + host→device traffic
@@ -439,6 +442,17 @@ def sweep(
                                 logger,
                                 image_metrics=image_metrics_every is not None
                                 and (ci + 1) % image_metrics_every == 0)
+            # chunk telemetry BEFORE the barrier: a kill at the barrier
+            # leaves the span + metrics snapshot as durable as the chunk's
+            # artifacts. StepTimer.snapshot() is the single throughput
+            # surface (bench shares it), published as the sweep gauge.
+            snap = timer.snapshot()
+            timer.publish(prefix="sweep")
+            obs.record_span("sweep.chunk", obs.monotime() - t_chunk,
+                            index=ci, chunk=int(chunk_order[ci]),
+                            steps=snap["steps"],
+                            acts_per_sec=round(snap["items_per_sec"], 1))
+            obs.flush_metrics()
             # one chunk's full train+checkpoint+artifact block is durable —
             # the crash-resume unit the chaos matrix kills at
             crash_barrier("sweep.chunk")

@@ -4,24 +4,36 @@ The reference hard-wires wandb with secrets read from secrets.json
 (reference: big_sweep.py:310-319). Here the default sink is a local JSONL
 file (always works in a zero-egress container); wandb attaches on top when
 available and requested.
+
+Since the obs subsystem (docs/ARCHITECTURE.md §12) the file is written
+through :class:`sparse_coding_tpu.obs.EventSink` — line-atomic appends on
+an owned fd (the old buffered ``open("a")`` handle leaked when callers
+forgot ``close()``, and a crash could tear a buffered line in half),
+fsync every ``flush_every`` records bounding crash loss, and a
+torn-tail-tolerant read contract (``obs.read_events``). Records carry the
+run correlation ID when the process runs under the pipeline supervisor.
+``MetricsLogger`` is a context manager; ``close()`` stays idempotent.
 """
 
 from __future__ import annotations
 
-import json
 import time
 from pathlib import Path
 from typing import Any, Optional
 
+from sparse_coding_tpu import obs
+
 
 class MetricsLogger:
     def __init__(self, output_folder: str | Path, use_wandb: bool = False,
-                 run_name: str = "run", config: Optional[dict] = None):
+                 run_name: str = "run", config: Optional[dict] = None,
+                 flush_every: int = 50):
         self.folder = Path(output_folder)
         self.folder.mkdir(parents=True, exist_ok=True)
         self.path = self.folder / "metrics.jsonl"
-        self._fh = self.path.open("a")
-        self._writes = 0
+        # fsync every Nth record: bounds crash-loss of metrics lines while
+        # keeping per-log cost off the training loop's critical path
+        self._sink = obs.EventSink(self.path, fsync_every=flush_every)
         self.wandb = None
         if use_wandb:
             try:
@@ -32,26 +44,30 @@ class MetricsLogger:
             except Exception:
                 self.wandb = None  # offline image: silently fall back to JSONL
 
-    _FLUSH_EVERY = 50  # bound crash-loss of buffered JSONL records
-
     def log(self, metrics: dict[str, Any], step: Optional[int] = None) -> None:
-        rec = {"ts": time.time(), **({"step": step} if step is not None else {}),
-               **metrics}
-        self._fh.write(json.dumps(rec, default=float) + "\n")
-        self._writes += 1
-        if self._writes % self._FLUSH_EVERY == 0:
-            self._fh.flush()
+        rec = {"ts": time.time(),
+               **({"step": step} if step is not None else {}), **metrics}
+        run = obs.run_id()
+        if run:  # supervised: join the run's correlation scope (§12)
+            rec.setdefault("run", run)
+        self._sink.emit(rec)
         if self.wandb is not None:
             self.wandb.log(metrics, step=step)
 
     def flush(self) -> None:
-        self._fh.flush()
+        self._sink.flush()
 
     def close(self) -> None:
-        self._fh.flush()
-        self._fh.close()
+        self._sink.close()
         if self.wandb is not None:
             self.wandb.finish()
+            self.wandb = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def make_hyperparam_name(hyperparams: dict[str, Any]) -> str:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import time
+from collections import deque
 from pathlib import Path
 from typing import Iterator, Optional
 
@@ -38,7 +39,16 @@ class StepTimer:
     """Throughput meter: call `tick(n_items)` once per step; read
     `items_per_sec`. Skips `warmup` steps so compile time doesn't pollute the
     rate; `block_on` forces device sync before timestamps when exact per-step
-    walls are needed."""
+    walls are needed.
+
+    This is the repo's ONE throughput code path: `snapshot()` returns the
+    measured window (steps, items, items/sec, total wall, the bounded
+    per-step wall list — bench.py builds its median-window estimator from
+    it), and `publish()` lands the same numbers in the obs registry so
+    sweep logs, bench stderr diagnostics, and `obs.report` all read one
+    meter (docs/ARCHITECTURE.md §12)."""
+
+    WINDOW_KEEP = 4096  # bound per-step wall retention on multi-hour sweeps
 
     def __init__(self, warmup: int = 3):
         self.warmup = warmup
@@ -50,6 +60,7 @@ class StepTimer:
         self._t0: Optional[float] = None
         self.last_dt: Optional[float] = None
         self._last_tick: Optional[float] = None
+        self._window_s: deque[float] = deque(maxlen=self.WINDOW_KEEP)
 
     def tick(self, n_items: int = 1, block_on=None) -> None:
         if block_on is not None:
@@ -61,6 +72,7 @@ class StepTimer:
         elif self._steps > self.warmup + 1:
             self._items += n_items
             self.last_dt = now - (self._last_tick or now)
+            self._window_s.append(self.last_dt)
         self._last_tick = now
 
     @property
@@ -73,3 +85,27 @@ class StepTimer:
     @property
     def measured_steps(self) -> int:
         return max(0, self._steps - self.warmup - 1)
+
+    def snapshot(self) -> dict:
+        """The measured window as plain data: ``steps`` / ``items`` /
+        ``items_per_sec`` / ``total_wall_s`` plus ``window_s`` (per-step
+        walls after warmup, newest-last, bounded at WINDOW_KEEP)."""
+        total = (0.0 if self._t0 is None or self._last_tick is None
+                 else self._last_tick - self._t0)
+        return {"steps": self.measured_steps, "items": self._items,
+                "items_per_sec": self.items_per_sec,
+                "total_wall_s": total, "window_s": tuple(self._window_s)}
+
+    def publish(self, registry=None, prefix: str = "train") -> dict:
+        """Feed the snapshot into the obs registry (gauges
+        ``<prefix>.items_per_sec`` / ``.measured_steps`` / ``.wall_s``);
+        returns the snapshot so callers log the same numbers they
+        published."""
+        from sparse_coding_tpu import obs
+
+        reg = registry if registry is not None else obs.get_registry()
+        snap = self.snapshot()
+        reg.gauge(f"{prefix}.items_per_sec").set(snap["items_per_sec"])
+        reg.gauge(f"{prefix}.measured_steps").set(snap["steps"])
+        reg.gauge(f"{prefix}.wall_s").set(snap["total_wall_s"])
+        return snap

@@ -1,0 +1,336 @@
+"""Observability subsystem tests (docs/ARCHITECTURE.md §12).
+
+Covers the acceptance invariants of the obs tentpole: typed instruments
+with mergeable histograms, spans emitting correlated start/end/error
+events, the crash-safe sink's torn-tail reader contract, the report
+merger, MetricsLogger/StepTimer riding the same machinery, and — the
+end-to-end gate — one sweep-under-supervisor run yielding a single
+merged report with per-step durations, throughput, retrace counts, and
+error counters correlated by run ID across the supervisor and its
+child-step processes.
+"""
+
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from sparse_coding_tpu import obs
+from sparse_coding_tpu.obs.report import build_report, format_report
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_obs(monkeypatch):
+    """No sink/registry state may leak across tests."""
+    monkeypatch.delenv(obs.ENV_OBS_DIR, raising=False)
+    monkeypatch.delenv(obs.ENV_RUN_ID, raising=False)
+    monkeypatch.delenv(obs.ENV_STEP, raising=False)
+    prev = obs.set_registry(obs.Registry())
+    obs.configure_sink(None)
+    yield
+    obs.close_sink()
+    obs.set_registry(prev)
+
+
+# -- instruments --------------------------------------------------------------
+
+
+def test_counter_gauge_labels_and_snapshot():
+    reg = obs.Registry()
+    reg.counter("rows", bucket=8).inc(3)
+    reg.counter("rows", bucket=8).inc(2)  # same identity
+    reg.counter("rows", bucket=64).inc()
+    g = reg.gauge("queue")
+    g.set(7), g.set(3)
+    snap = reg.snapshot()
+    assert snap["counters"] == {"rows{bucket=8}": 5, "rows{bucket=64}": 1}
+    assert snap["gauges"]["queue"] == {"value": 3.0, "max": 7.0}
+    json.dumps(snap)  # must be JSON-serializable as-is
+
+
+def test_histogram_quantiles_and_merge():
+    reg = obs.Registry()
+    h = reg.histogram("lat")
+    for v in (0.001, 0.002, 0.01, 0.02, 0.5):
+        h.observe(v)
+    assert h.count == 5 and 0.0 < h.quantile(0.5) < 0.05
+    assert h.quantile(0.99) <= 0.5 + 1e-9
+    # merge is bin-for-bin: two copies double every count
+    other = obs.Registry().histogram("lat")
+    snap = h.snapshot()
+    other.merge_snapshot(snap)
+    other.merge_snapshot(snap)
+    assert other.count == 10 and other.sum == pytest.approx(2 * h.sum)
+    with pytest.raises(ValueError, match="different bounds"):
+        obs.Registry().histogram("x", bounds=(1.0, 2.0)).merge_snapshot(snap)
+
+
+def test_default_registry_helpers():
+    obs.counter("c").inc()
+    obs.gauge("g").set(1.5)
+    obs.histogram("h").observe(0.1)
+    snap = obs.get_registry().snapshot()
+    assert snap["counters"]["c"] == 1
+    assert snap["histograms"]["h"]["count"] == 1
+
+
+# -- sink ---------------------------------------------------------------------
+
+
+def test_sink_emits_lines_and_reader_roundtrips(tmp_path):
+    path = tmp_path / "e.jsonl"
+    with obs.EventSink(path) as sink:
+        assert sink.emit({"a": 1})
+        assert sink.emit({"a": 2})
+    events, skipped = obs.scan_events(path)
+    assert [e["a"] for e in events] == [1, 2] and skipped == 0
+
+
+def test_sink_reader_skips_torn_tail_and_corrupt_lines(tmp_path):
+    path = tmp_path / "e.jsonl"
+    with obs.EventSink(path) as sink:
+        sink.emit({"a": 1})
+    with open(path, "ab") as fh:
+        fh.write(b'not json at all\n')      # corrupt but committed line
+        fh.write(b'{"a": 2, "torn": tru')   # torn tail: no commit newline
+    events, skipped = obs.scan_events(path)
+    assert [e["a"] for e in events] == [1]
+    assert skipped == 2
+
+
+def test_sink_emit_after_close_drops_and_counts():
+    import tempfile
+
+    path = Path(tempfile.mkdtemp()) / "e.jsonl"
+    sink = obs.EventSink(path)
+    sink.close()
+    before = obs.counter("obs.sink.dropped").value
+    assert sink.emit({"a": 1}) is False
+    assert obs.counter("obs.sink.dropped").value == before + 1
+
+
+def test_sink_env_configuration_is_lazy_and_per_process(tmp_path,
+                                                        monkeypatch):
+    monkeypatch.setenv(obs.ENV_OBS_DIR, str(tmp_path))
+    monkeypatch.setenv(obs.ENV_STEP, "mystep")
+    obs.configure_sink(None)
+    # reset the env-checked latch the configure above set
+    from sparse_coding_tpu.obs import sink as sink_mod
+
+    sink_mod._env_checked = False
+    assert obs.emit_event("ping", n=1)  # lazily self-configures
+    obs.close_sink()
+    files = list(tmp_path.glob("*.jsonl"))
+    assert files == [tmp_path / f"mystep-{os.getpid()}.jsonl"]
+    (ev,), _ = obs.scan_events(files[0])
+    assert ev["kind"] == "ping" and ev["step"] == "mystep"
+
+
+# -- spans --------------------------------------------------------------------
+
+
+def test_span_events_carry_correlation_and_nesting(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.ENV_RUN_ID, "run-abc")
+    monkeypatch.setenv(obs.ENV_STEP, "sweep")
+    path = tmp_path / "e.jsonl"
+    sink = obs.EventSink(path)
+    obs.configure_sink(sink)
+    with obs.span("outer"):
+        with obs.span("inner", index=3):
+            pass
+    with pytest.raises(ValueError):
+        with obs.span("failing"):
+            raise ValueError("boom")
+    obs.close_sink()
+    events = obs.read_events(path)
+    by_kind = {}
+    for e in events:
+        assert e["run"] == "run-abc" and e["step"] == "sweep"
+        assert e["pid"] == os.getpid()
+        by_kind.setdefault((e["kind"], e.get("span")), []).append(e)
+    inner_start = by_kind[("span.start", "inner")][0]
+    outer_start = by_kind[("span.start", "outer")][0]
+    assert inner_start["parent"] == outer_start["span_id"]
+    assert by_kind[("span.end", "inner")][0]["index"] == 3
+    fail_end = by_kind[("span.end", "failing")][0]
+    assert fail_end["ok"] is False and fail_end["error"] == "ValueError"
+    # registry side: durations + error counter
+    snap = obs.get_registry().snapshot()
+    assert snap["histograms"]["span.outer.dur_s"]["count"] == 1
+    assert snap["counters"]["span.failing.errors"] == 1
+    # seq is strictly increasing within the process
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+def test_record_span_and_flush_metrics(tmp_path):
+    path = tmp_path / "e.jsonl"
+    obs.configure_sink(obs.EventSink(path))
+    obs.counter("work.done").inc(5)
+    obs.record_span("manual", 0.125, index=1)
+    assert obs.flush_metrics()
+    obs.close_sink()
+    events = obs.read_events(path)
+    kinds = [e["kind"] for e in events]
+    assert kinds == ["span.end", "metrics"]
+    assert events[1]["registry"]["counters"]["work.done"] == 5
+
+
+# -- report -------------------------------------------------------------------
+
+
+def test_report_merges_files_sums_counters_takes_latest_gauges(tmp_path):
+    obs_dir = tmp_path / "obs"
+    for i, (rows, rate, ts) in enumerate([(10, 100.0, 1.0), (20, 250.0, 2.0)]):
+        reg = obs.Registry()
+        reg.counter("chunk.rows_written").inc(rows)
+        reg.gauge("sweep.items_per_sec").set(rate)
+        reg.histogram("span.x.dur_s").observe(0.01 * (i + 1))
+        with obs.EventSink(obs_dir / f"step-{i}.jsonl") as sink:
+            sink.emit({"kind": "span.end", "run": "r1", "span": "x",
+                       "dur_s": 0.01 * (i + 1), "ok": True, "ts": ts})
+            sink.emit({"kind": "metrics", "run": "r1", "ts": ts,
+                       "registry": reg.snapshot()})
+            # stale metrics earlier in the file must lose to the last one
+            sink.emit({"kind": "metrics", "run": "r1", "ts": ts,
+                       "registry": reg.snapshot()})
+    report = build_report(tmp_path)
+    assert report["run_ids"] == ["r1"]
+    assert report["counters"]["chunk.rows_written"] == 30
+    assert report["gauges"]["sweep.items_per_sec"]["value"] == 250.0
+    assert report["spans"]["x"]["count"] == 2
+    assert report["spans"]["x"]["p50_s"] in (0.01, 0.02)
+    assert report["histograms"]["span.x.dur_s"]["count"] == 2
+    assert report["skipped_lines"] == 0
+    text = format_report(report)
+    assert "r1" in text and "retrace" in text
+
+
+def test_report_cli_prints_json(tmp_path, capsys):
+    (tmp_path / "obs").mkdir()
+    from sparse_coding_tpu.obs import report as report_mod
+
+    report_mod.main([str(tmp_path), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["events"] == 0 and out["run_ids"] == []
+
+
+# -- MetricsLogger / StepTimer on the same machinery --------------------------
+
+
+def test_metrics_logger_is_sink_backed_and_context_managed(tmp_path,
+                                                           monkeypatch):
+    from sparse_coding_tpu.utils.logging import MetricsLogger
+
+    monkeypatch.setenv(obs.ENV_RUN_ID, "run-77")
+    with MetricsLogger(tmp_path, use_wandb=False) as logger:
+        logger.log({"loss": 0.5}, step=1)
+    events, skipped = obs.scan_events(tmp_path / "metrics.jsonl")
+    assert skipped == 0
+    assert events[0]["loss"] == 0.5 and events[0]["step"] == 1
+    assert events[0]["run"] == "run-77"  # joins the run's correlation scope
+    # a torn tail (SIGKILL mid-write) never breaks later reads
+    with open(tmp_path / "metrics.jsonl", "ab") as fh:
+        fh.write(b'{"loss": 0.')
+    events2, skipped2 = obs.scan_events(tmp_path / "metrics.jsonl")
+    assert len(events2) == 1 and skipped2 == 1
+
+
+def test_step_timer_snapshot_and_publish():
+    from sparse_coding_tpu.utils.profiling import StepTimer
+
+    t = StepTimer(warmup=1)
+    for _ in range(4):
+        t.tick(100)
+    snap = t.snapshot()
+    assert snap["steps"] == 2 and snap["items"] == 200
+    assert len(snap["window_s"]) == 2
+    assert snap["items_per_sec"] == t.items_per_sec
+    reg = obs.Registry()
+    published = t.publish(registry=reg, prefix="bench")
+    assert published["steps"] == 2
+    assert reg.gauge("bench.items_per_sec").value == t.items_per_sec
+    assert reg.gauge("bench.measured_steps").value == 2
+
+
+# -- the end-to-end acceptance gate ------------------------------------------
+
+
+def _pipeline_config(base: Path) -> dict:
+    return {
+        "harvest": {"mode": "synthetic",
+                    "dataset_folder": str(base / "chunks"),
+                    "activation_dim": 16, "n_ground_truth_features": 24,
+                    "feature_num_nonzero": 5, "feature_prob_decay": 0.99,
+                    "dataset_size": 2048, "n_chunks": 4, "batch_rows": 512,
+                    "seed": 0},
+        "sweep": {"experiment": "dense_l1_range",
+                  # batch 64 → 8 steps/chunk: enough past StepTimer's
+                  # warmup for a nonzero per-chunk throughput reading
+                  "ensemble": {"output_folder": str(base / "sweep"),
+                               "dataset_folder": str(base / "chunks"),
+                               "batch_size": 64, "n_chunks": 4,
+                               "learned_dict_ratio": 2.0, "tied_ae": True,
+                               "checkpoint_every_chunks": 1, "seed": 0},
+                  "log_every": 1000},
+        "eval": {"output_folder": str(base / "eval"), "n_eval_rows": 512,
+                 "seed": 0},
+    }
+
+
+def test_supervised_run_yields_single_correlated_report(tmp_path):
+    """ISSUE 4 acceptance: one harvest→sweep→eval run under the
+    supervisor produces ONE merged report with per-step p50/p95
+    durations, throughput, retrace count, and error counters, correlated
+    by run ID across the supervisor and all child-step processes."""
+    from sparse_coding_tpu.pipeline import Supervisor, build_pipeline
+
+    config = _pipeline_config(tmp_path)
+    run_dir = tmp_path / "run"
+    sup = Supervisor(run_dir, build_pipeline(run_dir, config),
+                     max_attempts=1, heartbeat_stale_s=300.0)
+    summary = sup.run()
+    assert summary == {"harvest": "done", "sweep": "done", "eval": "done"}
+
+    # one event file per process: the supervisor + three children
+    files = sorted(p.name for p in (run_dir / "obs").glob("*.jsonl"))
+    assert any(f.startswith("supervisor-") for f in files)
+    for step in ("harvest", "sweep", "eval"):
+        assert any(f.startswith(f"{step}-") for f in files), files
+
+    report = build_report(run_dir)
+    # correlation: ONE run id across every process's events, and the
+    # journal carries the same id
+    assert report["run_ids"] == [sup.run_id]
+    journal_runs = {r.get("run") for r in sup.journal.records()}
+    assert journal_runs == {sup.run_id}
+    # restarted supervisor over the same dir JOINS the run, never forks it
+    sup2 = Supervisor(run_dir, build_pipeline(run_dir, config),
+                      heartbeat_stale_s=300.0)
+    assert sup2.run_id == sup.run_id
+
+    # per-step spans with duration percentiles, from both sides
+    spans = report["spans"]
+    for name in ("pipeline.run", "pipeline.step", "step.harvest",
+                 "step.sweep", "step.eval", "sweep.chunk", "chunk.write"):
+        assert name in spans, (name, sorted(spans))
+        assert spans[name]["count"] >= 1
+        assert spans[name]["p50_s"] is not None
+        assert spans[name]["p95_s"] is not None
+    assert spans["pipeline.step"]["count"] == 3  # one attempt per step
+    assert spans["sweep.chunk"]["count"] == 4    # one per chunk
+    assert spans["pipeline.step"]["errors"] == 0
+
+    # throughput (StepTimer → gauge), XLA probes, and work counters made
+    # it from the children into the merged view
+    assert report["gauges"]["sweep.items_per_sec"]["value"] > 0
+    assert report["retraces"] > 0 and report["compiles"] > 0
+    assert report["counters"]["chunk.rows_written"] == 2048
+    assert report["dropped_events"] == 0
+    assert report["skipped_lines"] == 0
+
+    # the human rendering holds the headline evidence
+    text = format_report(report)
+    assert "step.sweep" in text and "retrace" in text
+    assert "sweep.items_per_sec" in text

@@ -1,0 +1,76 @@
+"""Mechanical enforcement of the CLAUDE.md observability convention:
+hot-path subsystems (`data/`, `train/`, `serve/`, `pipeline/` under
+`sparse_coding_tpu/`) must not read raw clocks with ad-hoc
+`time.time()` / `time.monotonic()` / `time.perf_counter()` — timing goes
+through `obs` (`obs.monotime`, `obs.span`/`record_span`, `StepTimer`) so
+every duration lands in the same registry/event stream `obs.report`
+merges, instead of rotting in print statements and private variables.
+
+A grep, not a dataflow analysis, by design (the atomic-write lint's
+pattern): the convention is cheap to follow and the false-positive escape
+hatch is explicit — append `# lint: allow-raw-timer <why>` to a line
+whose raw clock read provably should not feed observability (e.g. a
+backoff deadline). Default args like ``clock=time.time`` are references,
+not reads, and do not match. New unexplained hits fail the build.
+"""
+
+import re
+from pathlib import Path
+
+PACKAGE = Path(__file__).resolve().parent.parent / "sparse_coding_tpu"
+
+# the hot-path subsystems the convention covers; obs/ itself and utils/
+# (where the sanctioned primitives live) are exempt by scope
+LINTED_DIRS = ("data", "train", "serve", "pipeline")
+
+RAW_TIMER = re.compile(r"\btime\.(time|monotonic|perf_counter)\s*\(")
+OPT_OUT = "# lint: allow-raw-timer"
+
+
+def _violations(package: Path = None):
+    root = package if package is not None else PACKAGE
+    hits = []
+    for sub in LINTED_DIRS:
+        folder = root / sub
+        if not folder.exists():
+            continue
+        for path in sorted(folder.rglob("*.py")):
+            rel = path.relative_to(root).as_posix()
+            for lineno, line in enumerate(path.read_text().splitlines(), 1):
+                # match only the code portion: a mention inside a comment
+                # is not a clock read
+                code = line.split("#", 1)[0]
+                if RAW_TIMER.search(code) and OPT_OUT not in line:
+                    hits.append(f"sparse_coding_tpu/{rel}:{lineno}: "
+                                f"{line.strip()}")
+    return hits
+
+
+def test_no_raw_timers_in_hot_paths():
+    hits = _violations()
+    assert not hits, (
+        "ad-hoc raw clock read in a hot-path subsystem — route timing "
+        "through obs (obs.monotime, obs.span/record_span, StepTimer; "
+        "docs/ARCHITECTURE.md §12), or append "
+        "'# lint: allow-raw-timer <why>' with a reason:\n" + "\n".join(hits))
+
+
+def test_lint_catches_a_planted_violation(tmp_path):
+    """The lint must actually bite: plant raw timer reads in a scratch
+    tree and watch exactly the unexcused ones get flagged (guards against
+    the regex rotting)."""
+    pkg = tmp_path / "sparse_coding_tpu"
+    (pkg / "serve").mkdir(parents=True)
+    (pkg / "utils").mkdir()
+    (pkg / "serve" / "bad.py").write_text(
+        "import time\n"
+        "t0 = time.perf_counter()\n"
+        "t1 = time.time()  # lint: allow-raw-timer backoff deadline only\n"
+        "ok = 1  # time.monotonic( in a comment does not count\n"
+        "clock = time.time  # a reference, not a read\n"
+        "t2 = time.monotonic()\n")
+    # outside the linted dirs: never flagged, whatever it does
+    (pkg / "utils" / "free.py").write_text("import time\nt = time.time()\n")
+    hits = _violations(pkg)
+    assert len(hits) == 2, hits
+    assert "bad.py:2" in hits[0] and "bad.py:6" in hits[1]

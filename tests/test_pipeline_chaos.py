@@ -244,3 +244,57 @@ def test_journal_records_full_kill_story(tmp_path, monkeypatch, golden):
     assert events.index(("step.killed", "harvest")) < events.index(
         ("lease.takeover", "harvest")) < events.index(
         ("step.done", "harvest"))
+
+
+def test_obs_sink_kill_mid_event_write_report_survives(tmp_path, golden):
+    """SIGKILL the harvest child exactly between an event's payload write
+    and its commit newline (``obs.sink.write`` crash barrier): the dead
+    attempt's event file ends in a torn tail. Restart completes the step,
+    the store is still bitwise-identical to golden, and ``obs.report``
+    merges both attempts' files — the torn line is skipped and counted,
+    never corrupting the summary (docs/ARCHITECTURE.md §12).
+
+    The plan rides ``Step.env`` (not the test environment): the
+    supervisor runs in-process here and writes its OWN events through the
+    same barrier-instrumented sink — a process-wide plan would SIGKILL
+    the test itself at the supervisor's Nth event.
+    """
+    from sparse_coding_tpu.obs import scan_events
+    from sparse_coding_tpu.obs.report import build_report
+
+    config = _config(tmp_path)
+    run_dir = tmp_path / "run"
+
+    # run 1: the child's 3rd event write (step span.start, then one
+    # chunk.write span.end per durable chunk) dies mid-line
+    steps = build_pipeline(run_dir, config, only=["harvest"])
+    for s in steps:
+        s.env["SPARSE_CODING_CRASH_PLAN"] = "obs.sink.write:nth=3"
+    sup = Supervisor(run_dir, steps, max_attempts=1,
+                     heartbeat_stale_s=STALE_S)
+    with pytest.raises(StepFailed, match="killed by signal 9"):
+        sup.run()
+    torn_files = sorted((run_dir / "obs").glob("harvest-*.jsonl"))
+    assert len(torn_files) == 1
+    events, skipped = scan_events(torn_files[0])
+    assert skipped == 1, "the kill must leave an uncommitted torn tail"
+    assert len(events) == 2  # the committed prefix survives intact
+
+    # run 2: fresh supervisor, no plan — the restarted child (new pid,
+    # new file) resumes from the durable chunk prefix and completes
+    sup2 = Supervisor(run_dir, build_pipeline(run_dir, config,
+                                              only=["harvest"]),
+                      max_attempts=2, heartbeat_stale_s=STALE_S)
+    assert sup2.run() == {"harvest": "done"}
+    _assert_bitwise(golden, tmp_path, ["chunks"])
+
+    report = build_report(run_dir)
+    assert report["skipped_lines"] == 1
+    assert report["run_ids"] == [sup.run_id]  # both attempts, one run
+    assert report["spans"]["step.harvest"]["count"] == 1  # completed once
+    # chunk.write spans from BOTH attempts merged: 4 chunks written, and
+    # exactly ONE span event (the kill's victim) is the torn tail — event
+    # loss is bounded to the in-flight line, and is never data loss (the
+    # chunk itself was already durable; bitwise assert above)
+    assert report["spans"]["chunk.write"]["count"] == 3
+    assert report["spans"]["pipeline.step"]["count"] == 2  # kill + done

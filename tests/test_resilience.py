@@ -14,6 +14,9 @@ Sites × handlers covered here:
 - ``serve.dispatch``→ covered in tests/test_serve.py (retry, breaker,
                       recovery) — the engine-side matrix entries
 - ``lock.acquire``  → bench.py waits through contention / times out clean
+- ``obs.sink.write``→ a failing event write drops THAT event (counted),
+                      never the workload; a corrupt line is skipped by
+                      the torn-tail-tolerant reader
 - SIGTERM           → sweep checkpoints at the chunk boundary and resume
                       continues BITWISE-identically
 """
@@ -498,3 +501,48 @@ def test_lock_acquire_fault_waits_then_acquires(tmp_path, monkeypatch):
     # permanently contended: times out CLEANLY (None), never hangs
     with inject(site="lock.acquire", nth=1, count=0):
         assert bench._acquire_tunnel_lock(wait_s=0.05, poll_s=0.01) is None
+
+
+# -- obs.sink.write (observability event sink) -------------------------------
+
+
+def test_obs_sink_write_fault_drops_event_never_the_workload(tmp_path):
+    """An injected I/O failure on the event append drops exactly that
+    event — counted in ``obs.sink.dropped`` — and emit() returns False
+    instead of raising: observability must never take down a sweep."""
+    from sparse_coding_tpu import obs
+
+    path = tmp_path / "events.jsonl"
+    sink = obs.EventSink(path)
+    before = obs.counter("obs.sink.dropped").value
+    with inject(site="obs.sink.write", nth=2, error="OSError") as plan:
+        assert sink.emit({"n": 1}) is True
+        assert sink.emit({"n": 2}) is False  # injected: dropped, no raise
+        assert sink.emit({"n": 3}) is True
+    sink.close()
+    assert plan.fired_count("obs.sink.write") == 1
+    assert obs.counter("obs.sink.dropped").value == before + 1
+    events, skipped = obs.scan_events(path)
+    assert [e["n"] for e in events] == [1, 3] and skipped == 0
+
+
+def test_obs_sink_write_corrupt_line_skipped_by_reader(tmp_path):
+    """A bit-flipped event line (corrupt-mode fault on the payload) is
+    committed but unparseable: the reader skips and counts it, and the
+    neighbors survive — no corrupt line can poison a report."""
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.obs.report import build_report
+
+    obs_dir = tmp_path / "obs"
+    sink = obs.EventSink(obs_dir / "step-1.jsonl")
+    with inject(site="obs.sink.write", nth=2, mode="corrupt") as plan:
+        for n in range(1, 4):
+            assert sink.emit({"kind": "span.end", "span": "s", "run": "r",
+                              "dur_s": 0.1, "ok": True, "n": n})
+    sink.close()
+    assert plan.fired_count("obs.sink.write") == 1
+    events, skipped = obs.scan_events(obs_dir / "step-1.jsonl")
+    assert [e["n"] for e in events] == [1, 3] and skipped == 1
+    report = build_report(tmp_path)
+    assert report["spans"]["s"]["count"] == 2
+    assert report["skipped_lines"] == 1

@@ -386,3 +386,54 @@ def test_capacity_flush_not_blocked_by_older_sparse_stream(registry):
         full.result(timeout=30)
         assert time.perf_counter() - t0 < 5.0  # not the 10 s deadline
         assert not slow.done()  # the sparse stream is still waiting
+
+
+def test_instrumented_serve_path_zero_steady_state_recompiles(registry,
+                                                              tmp_path):
+    """ISSUE 4 acceptance: with the obs instrumentation fully live — XLA
+    probes installed, an event sink configured, registry-backed serving
+    metrics — steady-state traffic across the 8/64/512 bucket ladder adds
+    ZERO recompiles: neither the engine's own cache-miss counter nor the
+    process-wide ``jax.retraces``/``jax.compiles`` probe counters move
+    after the priming round. The snapshot schema is unchanged."""
+    from sparse_coding_tpu import obs
+
+    assert obs.install_jax_probes()
+    prev_sink = obs.configure_sink(obs.EventSink(tmp_path / "serve.jsonl"))
+    nrng = np.random.default_rng(3)
+    try:
+        with ServingEngine(registry, max_wait_ms=1.0) as engine:
+            engine.warmup()
+            # priming round: one pass of mixed sizes through every bucket
+            # (any first-touch host-side conversion happens here)
+            for rows in (1, 8, 9, 64, 65, 512):
+                engine.query("tied", nrng.normal(size=(rows, D)))
+            retraces = obs.counter("jax.retraces").value
+            compiles = obs.counter("jax.compiles").value
+            # steady state: 60 mixed-size requests over all three buckets
+            for rows in nrng.integers(1, 513, 60):
+                engine.query("tied", nrng.normal(size=(int(rows), D)))
+            snap = engine.stats()
+            assert snap["recompiles"] == 0, snap["recompile_keys"]
+            assert obs.counter("jax.retraces").value == retraces
+            assert obs.counter("jax.compiles").value == compiles
+            # the migrated metrics keep their schema AND expose the
+            # registry: obs instruments and snapshot agree
+            for key in ("buckets", "p50_ms", "p99_ms", "requests",
+                        "rejected", "queue_depth_rows", "recompiles",
+                        "breaker_state", "request_errors"):
+                assert key in snap
+            assert set(snap["buckets"]) <= {8, 64, 512}
+            reg_snap = engine.metrics.registry.snapshot()
+            assert reg_snap["counters"]["serve.requests"] == snap["requests"]
+            assert sum(v for k, v in reg_snap["counters"].items()
+                       if k.startswith("serve.rows{")) == sum(
+                b["rows"] for b in snap["buckets"].values())
+            assert obs.flush_metrics(registry=engine.metrics.registry)
+    finally:
+        obs.configure_sink(prev_sink)
+        obs.uninstall_jax_probes()
+    events = obs.read_events(tmp_path / "serve.jsonl")
+    snapshots = [e for e in events if e["kind"] == "metrics"]
+    assert snapshots and "serve.latency_s{bucket=8}" in \
+        snapshots[-1]["registry"]["histograms"]

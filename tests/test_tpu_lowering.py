@@ -252,3 +252,50 @@ def test_perplexity_scan_program_lowers(rng):
             lowering_platforms=("tpu",))
         scanned.trace(jnp.zeros((6, 4, 12), jnp.int32)).lower(
             lowering_platforms=("tpu",))
+
+
+def test_obs_instrumentation_is_zero_overhead_in_hlo(rng, tmp_path):
+    """The observability layer is host-side by construction: with the XLA
+    probes installed, an event sink live, and the lowering performed
+    INSIDE an active span, the TPU-lowered HLO of the serving bucket
+    program and the ensemble train step is BITWISE identical to the
+    uninstrumented lowering — instrumentation adds zero device ops — and
+    the probes demonstrably observed the retraces it took to prove it."""
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.models import TiedSAE
+    from sparse_coding_tpu.serve.engine import build_bucket_program
+    from sparse_coding_tpu.serve.registry import ModelRegistry
+
+    reg = ModelRegistry()
+    reg.register("tied", TiedSAE(dictionary=jax.random.normal(rng, (64, 32)),
+                                 encoder_bias=jnp.zeros(64)))
+    entry = reg.get("tied")
+    members = [FunctionalTiedSAE.init(k, 32, 64, l1_alpha=1e-3)
+               for k in jax.random.split(rng, 2)]
+    ens = Ensemble(members, FunctionalTiedSAE, donate=False)
+    batch = jnp.zeros((128, 32))
+
+    def lower_both():
+        fn, spec = build_bucket_program(entry, "encode", 64, jnp.float32,
+                                        topk_k=16)
+        serve_txt = jax.jit(fn).trace(entry.tree, spec).lower(
+            lowering_platforms=("tpu",)).as_text()
+        train_txt = jax.jit(
+            lambda s, b: ens._standard_step(s, b)).trace(
+            ens.state, batch).lower(lowering_platforms=("tpu",)).as_text()
+        return serve_txt, train_txt
+
+    baseline = lower_both()
+    assert obs.install_jax_probes()
+    prev_sink = obs.configure_sink(obs.EventSink(tmp_path / "e.jsonl"))
+    retraces_before = obs.counter("jax.retraces").value
+    try:
+        with obs.span("lowering.instrumented"):
+            instrumented = lower_both()
+    finally:
+        obs.configure_sink(prev_sink)
+        obs.uninstall_jax_probes()
+    assert instrumented[0] == baseline[0]  # serving bucket program
+    assert instrumented[1] == baseline[1]  # ensemble train step
+    # the probes were live while the identical HLO was produced
+    assert obs.counter("jax.retraces").value > retraces_before
