@@ -763,15 +763,63 @@ class Ensemble:
             batches = jax.device_put(
                 batches, NamedSharding(self.mesh, P(None, "data")))
         if self._scan_fn is None:
-            step_fn = self._step_fn  # jitted; inlines under the outer jit
-
-            def run(state, batches):
-                return jax.lax.scan(step_fn, state, batches)
-
-            self._scan_fn = jax.jit(
-                run, donate_argnums=(0,) if self._donate else ())
+            self._scan_fn = self._build_scan_fn()
         self.state, aux = self._scan_fn(self.state, batches)
         return aux
+
+    def _build_scan_fn(self):
+        """The jitted K-step scan program over the CURRENTLY-resolved
+        step (single home — run_steps and precompile must build the
+        exact same program or the warm-start would warm a stranger)."""
+        step_fn = self._step_fn  # jitted; inlines under the outer jit
+
+        def run(state, batches):
+            return jax.lax.scan(step_fn, state, batches)
+
+        return jax.jit(run, donate_argnums=(0,) if self._donate else ())
+
+    def precompile(self, batch_shape: Sequence[int], dtype=jnp.float32,
+                   label: str = "ensemble"):
+        """Compile-or-load the exact step program ``step_batch`` (2-d
+        shape) or ``run_steps`` (3-d ``[K, B, d]`` shape) will dispatch
+        for batches of ``batch_shape``/``dtype``, WITHOUT executing a
+        step — training state is untouched. Through
+        ``xcache.cached_compile`` (docs/ARCHITECTURE.md §13): with the
+        executable cache enabled the program is serialized to disk, the
+        sweep's warm-start loads it before the first chunk is read, and
+        the jax persistent compilation cache makes the subsequent real
+        dispatch's backend compile a disk hit instead of an XLA compile.
+        Returns the compiled executable (callers want the side effect)."""
+        from sparse_coding_tpu import xcache
+        from sparse_coding_tpu.ops.fused_sae import kernel_batch_itemsize
+
+        shape = tuple(int(s) for s in batch_shape)
+        if len(shape) not in (2, 3):
+            raise ValueError(f"batch_shape must be [B, d] or [K, B, d], "
+                             f"got {shape}")
+        scan = len(shape) == 3
+        dt = jnp.dtype(dtype)
+        self._resolve_step(shape[1] if scan else shape[0],
+                           kernel_batch_itemsize(dt))
+        if scan:
+            if self._scan_fn is None:
+                self._scan_fn = self._build_scan_fn()
+            fn = self._scan_fn
+        else:
+            fn = self._step_fn
+        if self.mesh is not None:
+            part = P(None, "data") if scan else P("data")
+            spec = jax.ShapeDtypeStruct(
+                shape, dt, sharding=NamedSharding(self.mesh, part))
+        else:
+            spec = jax.ShapeDtypeStruct(shape, dt)
+        return xcache.cached_compile(
+            fn, (self.state, spec), label=label,
+            manifest_desc={"kind": "sweep", "label": label,
+                           "sig": self.sig_name,
+                           "n_members": int(self.n_members),
+                           "shape": list(shape), "dtype": str(dt),
+                           "fused_path": self.fused_path})
 
     def unstack(self) -> list[tuple[Pytree, dict]]:
         """Per-member (params, buffers incl. statics), host-side

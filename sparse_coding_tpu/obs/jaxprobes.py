@@ -14,7 +14,11 @@ docs/ARCHITECTURE.md §8) now assertable for EVERY hot path:
 - ``jax.compile_dur_s`` / ``jax.trace_dur_s`` histograms — where compile
   wall time went;
 - ``jax.cache_hits`` / ``jax.cache_misses`` counters — the persistent
-  compilation cache, when enabled;
+  compilation cache. Dormant until something enables that cache:
+  ``xcache.enable()`` (docs/ARCHITECTURE.md §13) is what turns it on —
+  tests/test_xcache.py holds the regression test that a second identical
+  jit in a fresh process increments ``jax.cache_hits`` in the merged
+  report;
 - ``jax.mem.<stat>{device=i}`` gauges — ``device.memory_stats()``
   (``bytes_in_use``, peaks; absent on CPU, where the gauge family is
   simply not created).

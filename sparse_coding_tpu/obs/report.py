@@ -12,6 +12,10 @@ ID the supervisor propagated (obs/spans.py correlation contract):
   throughput, memory), histograms (bin-for-bin fixed-bucket merge) from
   each file's LAST ``metrics`` event — the crash-safe snapshot the hosts
   flush at durable boundaries;
+- compile/cache evidence (``compile_cache`` — docs/ARCHITECTURE.md §13):
+  persistent-compilation-cache and executable-store hit/miss counts,
+  total compile seconds, and the estimated compile seconds a warm start
+  saved (summed from each loaded entry's recorded compile time);
 - hygiene: files scanned, torn/corrupt lines skipped (a SIGKILLed
   writer's tail is skipped by the reader contract, so it can never
   corrupt this report), run IDs seen (one, unless files from different
@@ -112,6 +116,27 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
                          "p50": h.quantile(0.50), "p95": h.quantile(0.95),
                          "p99": h.quantile(0.99)}
                   for name, h in merged.items()}
+
+    def _hist_sum(name: str) -> float:
+        h = histograms.get(name)
+        return round(float(h["sum"]), 3) if h else 0.0
+
+    # compile/cache evidence (docs/ARCHITECTURE.md §13): the two cache
+    # layers xcache.enable() turns on. "persistent" = jax's compilation
+    # cache (hits are disk loads inside a compile); "store" = the
+    # serialized-executable store (hits skip the backend compile
+    # entirely); saved_s sums the compile seconds each loaded entry
+    # replaced — the headline number of a warm restart.
+    compile_cache = {
+        "persistent_hits": counters.get("jax.cache_hits", 0),
+        "persistent_misses": counters.get("jax.cache_misses", 0),
+        "store_hits": counters.get("xcache.hits", 0),
+        "store_misses": counters.get("xcache.misses", 0),
+        "store_errors": counters.get("xcache.errors", 0),
+        "store_evictions": counters.get("xcache.evictions", 0),
+        "compile_time_s": _hist_sum("jax.compile_dur_s"),
+        "saved_s": _hist_sum("xcache.saved_s"),
+    }
     return {
         "run_dir": str(run_dir),
         "run_ids": sorted(run_ids),
@@ -127,6 +152,7 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "span_errors": errors,
         "retraces": counters.get("jax.retraces", 0),
         "compiles": counters.get("jax.compiles", 0),
+        "compile_cache": compile_cache,
         "dropped_events": counters.get("obs.sink.dropped", 0),
     }
 
@@ -158,6 +184,16 @@ def format_report(report: dict) -> str:
             lines.append(f"  {name:<28} {g['value']:.1f} (max {g['max']:.1f})")
     lines.append(f"xla: {report['retraces']} retrace(s), "
                  f"{report['compiles']} compile(s)")
+    cc = report.get("compile_cache", {})
+    if any(cc.get(k) for k in ("persistent_hits", "persistent_misses",
+                               "store_hits", "store_misses",
+                               "store_errors")):
+        lines.append(
+            f"compile cache: persistent {cc['persistent_hits']}h/"
+            f"{cc['persistent_misses']}m, store {cc['store_hits']}h/"
+            f"{cc['store_misses']}m ({cc['store_errors']} bad), "
+            f"{cc['compile_time_s']:.1f}s compiling, "
+            f"~{cc['saved_s']:.1f}s saved")
     interesting = {k: v for k, v in report["counters"].items()
                    if not k.startswith(("jax.retraces", "jax.compiles"))}
     if interesting:

@@ -233,6 +233,13 @@ def main(argv=None) -> None:
     # same obs dir as the supervisor's and merge in obs.report (§12)
     obs.configure_sink_from_env(step)
     obs.install_jax_probes()
+    # persistent executable cache (§13): the supervisor propagates one
+    # shared SPARSE_CODING_XCACHE_DIR per run, so a respawned attempt of
+    # this step loads executables instead of recompiling (no-op when the
+    # env is unset — bare step invocations stay cache-free)
+    from sparse_coding_tpu import xcache
+
+    xcache.enable_from_env()
     config = json.loads(Path(config_path).read_text())
     try:
         with obs.span(f"step.{step}"):

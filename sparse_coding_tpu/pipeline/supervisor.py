@@ -300,6 +300,15 @@ class Supervisor:
         env[obs.ENV_RUN_ID] = self.run_id
         env[obs.ENV_OBS_DIR] = str(self.obs_dir)
         env[obs.ENV_STEP] = step.name
+        # executable-cache propagation (§13): every step child of this run
+        # — including each RESPAWN of the same step, the crash-only normal
+        # case — shares one cache dir, so attempt 2 loads what attempt 1
+        # compiled instead of recompiling. setdefault: an operator- or
+        # step-level dir wins. Degrade-to-CPU retries share the dir safely
+        # because every cache key carries the backend (per-backend keying).
+        from sparse_coding_tpu.xcache import ENV_DIR as _XCACHE_ENV_DIR
+
+        env.setdefault(_XCACHE_ENV_DIR, str(self.run_dir / "xcache"))
         if self.cpu_only or degraded:
             env = stripped_cpu_env(env)
         return env

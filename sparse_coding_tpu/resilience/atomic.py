@@ -30,25 +30,35 @@ def fsync_dir(path: str | Path) -> None:
         os.close(fd)
 
 
-def atomic_write_bytes(path: str | Path, data: bytes) -> None:
+def atomic_write_bytes(path: str | Path, data: bytes,
+                       fsync: bool = True) -> None:
     """Write ``data`` to ``path`` such that ``path`` always holds either
-    its previous complete content or ``data`` in full."""
+    its previous complete content or ``data`` in full.
+
+    ``fsync=False`` keeps the rename atomicity (readers still never see
+    a torn file) but skips both fsyncs — for high-frequency BOOKKEEPING
+    files whose loss to a power cut is self-healing (e.g. the xcache LRU
+    manifest, which reconciles against its directory); data artifacts
+    must keep the default."""
     path = Path(path)
     tmp = path.parent / f".{path.name}.tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
             f.write(data)
             f.flush()
-            os.fsync(f.fileno())
+            if fsync:
+                os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:
         tmp.unlink(missing_ok=True)
         raise
-    fsync_dir(path.parent)
+    if fsync:
+        fsync_dir(path.parent)
 
 
-def atomic_write_text(path: str | Path, text: str) -> None:
-    atomic_write_bytes(path, text.encode())
+def atomic_write_text(path: str | Path, text: str,
+                      fsync: bool = True) -> None:
+    atomic_write_bytes(path, text.encode(), fsync=fsync)
 
 
 def atomic_save_npy(path: str | Path, arr) -> None:

@@ -27,6 +27,8 @@ Canonical sites (hosts register theirs at import, like fault sites):
                       NOT yet written
 ``obs.sink.write``    obs/sink.py — event payload appended, commit newline
                       not yet written (the torn-tail instant)
+``xcache.store``      xcache/store.py — executable-cache entry durable,
+                      LRU manifest not yet updated
 ====================  =====================================================
 
 The chaos matrix (tests/test_pipeline_chaos.py, marker ``chaos``) kills a
@@ -64,6 +66,8 @@ CRASH_SITES: dict[str, str] = {
     "eval.write": "eval results computed, output not yet written",
     "obs.sink.write": "event payload appended, commit newline not yet "
                       "written (obs/sink.py — the torn-tail instant)",
+    "xcache.store": "executable-cache entry durable, LRU manifest not yet "
+                    "updated (xcache/store.py)",
 }
 
 

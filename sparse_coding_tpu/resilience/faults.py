@@ -25,6 +25,9 @@ pre-registered here so env plans validate before any host module loads):
 ``lock.acquire``      bench.py tunnel-flock acquisition attempt
 ``obs.sink.write``    obs/sink.py EventSink.emit — every observability
                       event line append (drops, never raises)
+``xcache.load``       xcache/store.py ExecutableStore.load — every
+                      executable-cache entry read (corrupt/stale entries
+                      fall back to a fresh compile)
 ====================  =====================================================
 
 Plan syntax (``SPARSE_CODING_FAULT_PLAN`` or :func:`parse_fault_plan`):
@@ -66,6 +69,7 @@ FAULT_SITES: dict[str, str] = {
     "serve.dispatch": "serving engine compiled-program dispatch",
     "lock.acquire": "tunnel flock acquisition attempt",
     "obs.sink.write": "observability event-sink line append (obs/sink.py)",
+    "xcache.load": "executable-cache entry load (xcache/store.py)",
 }
 
 

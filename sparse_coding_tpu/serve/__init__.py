@@ -7,7 +7,9 @@ a high-throughput offline scorer, built from four pieces:
   and reference ``learned_dicts.pt`` artifacts, audits signatures, stacks
   homogeneous dicts for the vmapped multi-dict path.
 - :mod:`engine`    — AOT-compiled padded shape-bucket programs
-  (``jit(...).lower(...).compile()`` at warmup; steady state never traces).
+  (compile-or-load through ``xcache.cached_compile`` at warmup — a
+  restarted engine deserializes instead of recompiling, docs/
+  ARCHITECTURE.md §13; steady state never traces).
 - :mod:`batching`  — dynamic micro-batching queue: coalesce, deadline
   flush, backpressure; the Python hot loop is ``lax``-free.
 - :mod:`metrics`   — per-bucket counters, fill ratios, latency quantiles,

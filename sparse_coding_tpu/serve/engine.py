@@ -8,9 +8,15 @@ from steady state entirely:
 - requests coalesce (serve/batching.py) into a small ladder of padded row
   buckets (default 8/64/512 — geometric, so padding waste is bounded at
   ~8x worst case on the smallest bucket and amortizes with load);
-- each (model, op, bucket) program is AOT-compiled at startup via
-  ``jit(f).lower(model, spec).compile()`` — ``warmup()`` walks the full
-  product so the first real request already hits a compiled executable;
+- each (model, op, bucket) program is AOT-compiled at startup through
+  ``xcache.cached_compile`` — ``warmup()`` walks the full product (on a
+  bounded thread pool: XLA compiles release the GIL) so the first real
+  request already hits a compiled executable, and with the executable
+  cache enabled (``xcache.enable``) a RESTARTED engine loads serialized
+  executables instead of recompiling: the second cold start performs
+  zero backend compiles (docs/ARCHITECTURE.md §13). Every program is
+  recorded in the warmup manifest, the durable statement of what must be
+  warm before the engine admits traffic;
 - the model pytree is an ARGUMENT of the compiled program (not a closed-
   over constant), so weights live in ordinary device buffers shared across
   buckets rather than being baked into N executables;
@@ -31,6 +37,7 @@ device program and one bulk transfer each way per coalesced batch.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 import time
 from typing import Any, Sequence
@@ -39,6 +46,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparse_coding_tpu import obs, xcache
 from sparse_coding_tpu.obs import monotime
 from sparse_coding_tpu.resilience.breaker import CircuitBreaker
 from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
@@ -135,7 +143,8 @@ class ServingEngine:
                  breaker_reset_s: float = 5.0,
                  dispatch_retries: int = 2,
                  stream_retry_budget: int = 16,
-                 retry_backoff_s: float = 0.002):
+                 retry_backoff_s: float = 0.002,
+                 warmup_workers: int | None = None):
         if not buckets or list(buckets) != sorted(set(buckets)):
             raise ValueError(f"buckets must be unique ascending: {buckets}")
         self._registry = registry
@@ -165,7 +174,15 @@ class ServingEngine:
         # mirror every breaker transition into the metrics snapshot
         self._breaker.set_on_transition(self.metrics.record_breaker_transition)
         self._compiled: dict[tuple, Any] = {}
+        # per-key locks (allocated under _compile_lock) rather than one
+        # global compile lock: warmup fans compiles out over a thread
+        # pool, and XLA releases the GIL while compiling — serializing on
+        # one lock would quietly undo the parallelism
         self._compile_lock = threading.Lock()
+        self._key_locks: dict[tuple, threading.Lock] = {}
+        self._warmup_workers = (max(1, int(warmup_workers))
+                                if warmup_workers is not None
+                                else min(8, os.cpu_count() or 2))
         self._warmed = False
         self._batcher = MicroBatcher(
             dispatch=self._dispatch,
@@ -176,20 +193,42 @@ class ServingEngine:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def warmup(self) -> int:
-        """AOT-compile every (model, op, bucket) program for the CURRENT
-        registry contents. Returns the number of executables compiled.
-        Idempotent; re-run after registering more models."""
-        n = 0
-        for name in self._registry.names():
-            for op in self._ops:
-                for bucket in self._buckets:
-                    if (name, op, bucket) not in self._compiled:
-                        self._get_compiled(name, op, bucket,
+    def warmup(self, max_workers: int | None = None) -> int:
+        """AOT compile-or-load every (model, op, bucket) program for the
+        CURRENT registry contents — the full set is warm BEFORE the
+        engine admits traffic. Returns the number of executables
+        prepared. Idempotent; re-run after registering more models.
+
+        Compilation fans out over a bounded thread pool (XLA compiles
+        release the GIL; ``max_workers`` overrides the engine default,
+        1 forces the serial order) and is timed under the
+        ``serve.warmup`` span. With the executable cache enabled
+        (``xcache.enable``), programs stored by a previous process load
+        instead of compiling, and every program is recorded in the
+        warmup manifest (docs/ARCHITECTURE.md §13)."""
+        todo = [(name, op, bucket)
+                for name in self._registry.names()
+                for op in self._ops
+                for bucket in self._buckets
+                if (name, op, bucket) not in self._compiled]
+        workers = (max(1, int(max_workers)) if max_workers is not None
+                   else self._warmup_workers)
+        workers = min(workers, len(todo)) if todo else 1
+        with obs.span("serve.warmup", programs=len(todo), workers=workers):
+            if workers <= 1:
+                for key in todo:
+                    self._get_compiled(*key, count_miss=False)
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    futures = [pool.submit(self._get_compiled, *key,
                                            count_miss=False)
-                        n += 1
+                               for key in todo]
+                    for f in futures:
+                        f.result()  # propagate the first compile failure
         self._warmed = True
-        return n
+        return len(todo)
 
     def shutdown(self, wait: bool = True) -> None:
         self._batcher.shutdown(wait=wait)
@@ -275,12 +314,23 @@ class ServingEngine:
             raise RequestTooLargeError(rows, self._buckets[-1])
         return self._buckets[i]
 
-    def _compile(self, entry: RegistryEntry, op: str, bucket: int):
+    def _compile(self, entry: RegistryEntry, op: str, bucket: int,
+                 model: str):
         fn, spec = build_bucket_program(entry, op, bucket, self._dtype,
                                         self._topk_k)
         donate = (1,) if self._donate else ()
-        return (jax.jit(fn, donate_argnums=donate)
-                .lower(entry.tree, spec).compile())
+        # compile-or-load through the executable store (§13): the model
+        # pytree is an ARGUMENT, so the lowered text — and therefore the
+        # cache key — depends only on shapes, and same-shape models share
+        # one stored executable per (op, bucket). The manifest descriptor
+        # records the program so a restarted process knows the warm set.
+        return xcache.cached_compile(
+            jax.jit(fn, donate_argnums=donate), (entry.tree, spec),
+            label=f"serve/{model}/{op}/{bucket}",
+            manifest_desc={"kind": "serve", "model": model, "op": op,
+                           "bucket": int(bucket),
+                           "dtype": str(self._dtype),
+                           "stack": bool(entry.is_stack)})
 
     def _get_compiled(self, model: str, op: str, bucket: int,
                       count_miss: bool = True):
@@ -289,11 +339,16 @@ class ServingEngine:
         if compiled is None:
             with self._compile_lock:
                 compiled = self._compiled.get(key)
+                if compiled is not None:
+                    return compiled
+                lock = self._key_locks.setdefault(key, threading.Lock())
+            with lock:
+                compiled = self._compiled.get(key)
                 if compiled is None:
                     if self._warmed and count_miss:
                         self.metrics.record_recompile(key)
                     compiled = self._compile(self._registry.get(model), op,
-                                             bucket)
+                                             bucket, model)
                     self._compiled[key] = compiled
         return compiled
 
@@ -312,7 +367,18 @@ class ServingEngine:
             x = pad
         compiled = self._get_compiled(model, op, bucket)
         fault_point("serve.dispatch")
-        out = compiled(self._registry.get(model).tree, jnp.asarray(x))
+        # §13 donation rule: a DONATED input must be a runtime-owned
+        # buffer. On non-TPU backends jnp.asarray wraps host numpy
+        # zero-copy — safe for a fresh compile (which drops donation
+        # there) but an executable loaded from the cache retains its
+        # input-output aliasing, and x may even be the caller's own
+        # request array. jnp.array materializes an owned copy; TPU
+        # transfers copy by construction, so the hot path stays asarray.
+        if self._donate and jax.default_backend() != "tpu":
+            dev_x = jnp.array(x)
+        else:
+            dev_x = jnp.asarray(x)
+        out = compiled(self._registry.get(model).tree, dev_x)
         rows_axis = 1 if self._registry.get(model).is_stack else 0
         sl = (slice(None),) * rows_axis + (slice(0, rows),)
         host = jax.tree.map(lambda a: np.asarray(a)[sl], out)

@@ -287,6 +287,28 @@ def sweep(
     profiling = False
     profile_done = False
 
+    # warm start (docs/ARCHITECTURE.md §13): with the executable cache
+    # enabled, compile-or-load every step program this sweep will
+    # dispatch BEFORE the first chunk is read or the device touched — a
+    # respawned child (the crash-only restart path) then pays disk loads,
+    # not XLA compiles, and each program lands in the warmup manifest as
+    # the record of what a restart must have warm
+    from sparse_coding_tpu import xcache
+
+    if xcache.enabled() and chunks_done < len(chunk_order):
+        t_warm = obs.monotime()
+        batch_shape = ((scan_k, cfg.batch_size, store.activation_dim)
+                       if scan_k > 1
+                       else (cfg.batch_size, store.activation_dim))
+        n_warm = 0
+        for ensemble, _, name in ensembles:
+            for j, sub in enumerate(_ensembles_of(ensemble)):
+                sub.precompile(batch_shape, dtype=train_np_dtype,
+                               label=f"sweep/{name}_{j}")
+                n_warm += 1
+        obs.record_span("sweep.warmstart", obs.monotime() - t_warm,
+                        programs=n_warm, shape=list(batch_shape))
+
     # remaining chunks stream through chunk_reader: the next chunk's disk
     # read overlaps the current chunk's training (native/chunkio.cpp
     # background threads; sequential without the lib)

@@ -88,11 +88,21 @@ def restore_ensemble(ens: Ensemble, path: str | Path) -> dict:
         params=tree["params"], buffers=tree["buffers"],
         opt_state=tree["opt_state"], lrs=tree["lrs"], step=tree["step"],
         static_buffers=state.static_buffers, sig_name=state.sig_name)
+    # RUNTIME-OWNED device copies, never zero-copy numpy wraps:
+    # from_bytes leaves are numpy views into the msgpack payload, and
+    # jnp.asarray/device_put wrap external memory zero-copy on CPU. The
+    # restored state is DONATED by the train step, and an executable
+    # loaded from the persistent compilation cache retains the
+    # input-output aliasing the fresh-compile path drops on CPU —
+    # aliasing a donated buffer whose memory jax does not own turns the
+    # first step into a use-after-release (inf/nan params, then a heap-
+    # corruption segfault; found by the §13 warm-restart chaos matrix).
+    # jnp.array (copy=True) materializes each leaf into a jax-allocated
+    # buffer; the mesh branch then re-places those owned buffers.
+    new_state = jax.tree.map(jax.numpy.array, new_state)
     if ens.mesh is not None:
         from sparse_coding_tpu.ensemble import shard_ensemble_state
         new_state = shard_ensemble_state(new_state, ens.mesh)
-    else:
-        new_state = jax.tree.map(jax.numpy.asarray, new_state)
     ens.state = new_state
     return meta
 

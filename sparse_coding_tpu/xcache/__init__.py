@@ -1,0 +1,273 @@
+"""Persistent executable cache + warm start: zero-recompile restarts.
+
+The crash-only architecture (docs/ARCHITECTURE.md §11) makes process
+death the normal case — every supervisor step attempt, serving cold
+start, and bench invocation is a fresh process — but XLA trace+compile
+made every one of those restarts pay seconds-to-minutes of host work
+before the first activation moved. This package converts "pays compile N
+times" into "pays compile once per program version" (§13):
+
+- :func:`enable` — process-wide bootstrap: turns on JAX's persistent
+  compilation cache under ``<cache_dir>/jaxcache`` (min-compile-time and
+  min-entry-size floors dropped so every program qualifies), which also
+  wires the previously-dormant ``jax.cache_hits`` / ``jax.cache_misses``
+  obs probes (obs/jaxprobes.py), and opens the explicit executable store
+  + warmup manifest;
+- :func:`cached_compile` — the explicit AOT store: serializes compiled
+  executables (``jax.experimental.serialize_executable``) keyed on the
+  lowered program text + shapes/dtypes + backend + device topology +
+  jax/jaxlib versions, behind ``resilience/atomic`` writes, the
+  ``xcache.load`` fault site, the ``xcache.store`` crash barrier, and a
+  size-capped LRU manifest (xcache/store.py). Loading a stored
+  executable performs NO backend compile — a fully warm process reports
+  ``jax.compiles == 0`` for its warmed program set;
+- the **warmup manifest** (xcache/manifest.py) — the record of every
+  program the serve engine / sweep compiled, so a restarted process
+  precompiles-or-loads the full set before admitting traffic or
+  touching the tunnel.
+
+Keying: two cache layers, one invalidation story. The jax persistent
+cache keys on the XLA computation + compile options + platform version
+(jax's own `cache_key`); the executable store keys on
+:func:`program_key` = sha256(lowered StableHLO text ‖ backend ‖ device
+kinds+count ‖ process count ‖ jax ‖ jaxlib ‖ XLA_FLAGS ‖ caller salt).
+Shapes, dtypes, donation, and sharding are all part of the lowered text,
+so any change to what would RUN yields a different key — the cache can
+change only *when* a program compiles, never *what* executes
+(tests/test_tpu_lowering.py proves the lowered HLO is bitwise identical
+with the cache enabled). Backend is in both keys, so one shared cache
+dir serves TPU runs and their degrade-to-CPU retries without collision.
+
+Everything degrades: no cache dir → plain ``lowered.compile()``; a
+runtime that cannot serialize → compile proceeds, entry skipped; a
+corrupt entry → fresh compile. Caching is never on the failure path of
+the workload it accelerates.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+from pathlib import Path
+from typing import Any, Optional, Sequence
+
+from sparse_coding_tpu.obs import get_registry, monotime
+from sparse_coding_tpu.xcache.manifest import WarmupManifest
+from sparse_coding_tpu.xcache.store import ExecutableStore
+
+logger = logging.getLogger(__name__)
+
+ENV_DIR = "SPARSE_CODING_XCACHE_DIR"
+
+# jax config knobs enable() flips; old values retained for disable()
+_JAX_CACHE_OPTIONS = (
+    ("jax_compilation_cache_dir", None),  # filled with <cache_dir>/jaxcache
+    ("jax_persistent_cache_min_compile_time_secs", 0.0),
+    ("jax_persistent_cache_min_entry_size_bytes", -1),
+)
+
+
+class XCache:
+    """One enabled cache: directory + executable store + warmup manifest."""
+
+    def __init__(self, cache_dir: str | Path,
+                 cap_bytes: Optional[int] = None):
+        self.cache_dir = Path(cache_dir)
+        self.cache_dir.mkdir(parents=True, exist_ok=True)
+        self.store = ExecutableStore(self.cache_dir, cap_bytes=cap_bytes)
+        self.warmup = WarmupManifest(self.cache_dir / "warmup.json")
+
+
+_active: Optional[XCache] = None
+_saved_config: list[tuple[str, Any]] = []
+_lock = threading.Lock()
+
+
+def default_cache_dir() -> Path:
+    """``SPARSE_CODING_XCACHE_DIR``, else the user cache dir — shared
+    across invocations on one machine, which is the point: a restarted
+    bench/serve/sweep finds the previous process's executables."""
+    env = os.environ.get(ENV_DIR, "").strip()
+    if env:
+        return Path(env)
+    base = os.environ.get("XDG_CACHE_HOME", "").strip() or str(
+        Path.home() / ".cache")
+    return Path(base) / "sparse_coding_tpu" / "xcache"
+
+
+def _reset_jax_cache() -> None:
+    """Drop jax's in-memory handle on the persistent cache so a cache-dir
+    change takes effect mid-process (tests switch dirs; production
+    enables once). Best-effort across jax versions."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private API, absence is fine
+        pass
+
+
+def enable(cache_dir: str | Path | None = None,
+           cap_bytes: Optional[int] = None) -> XCache:
+    """Turn on both cache layers for this process (idempotent per dir).
+
+    Sets jax's persistent compilation cache to ``<cache_dir>/jaxcache``
+    with the size/time floors dropped (our sweep/serve programs are many
+    and individually small — exactly the shape the floors exclude),
+    installs the obs jax probes so ``jax.cache_hits``/``jax.cache_misses``
+    fire, and opens the executable store for :func:`cached_compile`."""
+    global _active
+    cache_dir = Path(cache_dir) if cache_dir is not None \
+        else default_cache_dir()
+    with _lock:
+        if _active is not None and _active.cache_dir == cache_dir:
+            return _active
+        # build the store FIRST (its mkdir is the likely failure on a bad
+        # cache dir): enable() must be all-or-nothing — a failed enable
+        # must not leave jax's persistent cache pointed at an unusable
+        # path while enabled() reports False
+        cache = XCache(cache_dir, cap_bytes=cap_bytes)
+        import jax
+
+        for name, value in _JAX_CACHE_OPTIONS:
+            if name == "jax_compilation_cache_dir":
+                value = str(cache_dir / "jaxcache")
+            try:
+                if not any(n == name for n, _ in _saved_config):
+                    _saved_config.append((name, getattr(jax.config, name)))
+                jax.config.update(name, value)
+            except (AttributeError, KeyError) as e:
+                logger.warning("xcache: jax option %s unavailable (%s)",
+                               name, e)
+        _reset_jax_cache()
+        from sparse_coding_tpu.obs import install_jax_probes
+
+        install_jax_probes()  # wires /jax/compilation_cache/* -> registry
+        _active = cache
+        return _active
+
+
+def enable_from_env() -> Optional[XCache]:
+    """Enable iff ``SPARSE_CODING_XCACHE_DIR`` is set (how supervisor
+    step children opt in — the supervisor propagates one shared dir per
+    run); no-op returning None otherwise."""
+    env = os.environ.get(ENV_DIR, "").strip()
+    if not env:
+        return None
+    return enable(env)
+
+
+def disable() -> None:
+    """Restore the pre-:func:`enable` jax config and drop the active
+    cache (tests; a production process enables once and exits)."""
+    global _active
+    with _lock:
+        if _active is None and not _saved_config:
+            return
+        import jax
+
+        while _saved_config:
+            name, value = _saved_config.pop()
+            try:
+                jax.config.update(name, value)
+            except (AttributeError, KeyError):
+                pass
+        _reset_jax_cache()
+        _active = None
+
+
+def enabled() -> bool:
+    return _active is not None
+
+
+def active_cache() -> Optional[XCache]:
+    return _active
+
+
+def _env_fingerprint() -> str:
+    """Everything OUTSIDE the lowered program that can change the
+    executable: backend, device topology, process count, jax/jaxlib
+    versions, XLA flags. Per-backend keying is what lets one cache dir
+    serve a TPU run and its degrade-to-CPU retry without collision."""
+    import jax
+    import jaxlib
+
+    devs = jax.devices()
+    return "|".join([
+        jax.default_backend(),
+        ",".join(sorted({d.device_kind for d in devs})),
+        str(len(devs)), str(jax.process_count()),
+        jax.__version__, jaxlib.__version__,
+        os.environ.get("XLA_FLAGS", ""),
+    ])
+
+
+def program_key(lowered, extra: Any = None) -> str:
+    """The executable-store key of one lowered program (§13 key schema):
+    sha256 over the lowered StableHLO text (shapes, dtypes, donation and
+    sharding included by construction), the environment fingerprint, and
+    the caller's extra salt."""
+    h = hashlib.sha256()
+    h.update(lowered.as_text().encode())
+    h.update(_env_fingerprint().encode())
+    if extra is not None:
+        h.update(repr(extra).encode())
+    return h.hexdigest()
+
+
+def cached_compile(fn, args: Sequence[Any], *, key: Any = None,
+                   label: str = "", manifest_desc: Optional[dict] = None,
+                   jit_kwargs: Optional[dict] = None):
+    """Compile-or-load the executable of ``fn`` for ``args``.
+
+    ``fn`` is a function (jitted with ``jit_kwargs``) or an
+    already-jitted callable; ``args`` are the lowering arguments —
+    concrete arrays and/or ``jax.ShapeDtypeStruct`` specs. Always traces
+    and lowers (cheap, and the lowered text IS the cache key); with a
+    cache enabled, a stored entry is deserialized instead of compiled —
+    no backend compile event fires on a hit — and a fresh compile is
+    serialized back behind the ``xcache.store`` crash barrier. Without
+    :func:`enable`, this is exactly ``jit(fn).lower(*args).compile()``.
+
+    ``manifest_desc`` (a JSON dict) records the program in the warmup
+    manifest so restarts know the full warm set (xcache/manifest.py)."""
+    import jax
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn,
+                                                     **(jit_kwargs or {}))
+    lowered = jitted.lower(*args)
+    cache = _active
+    if cache is None:
+        return lowered.compile()
+    if manifest_desc is not None:
+        cache.warmup.record(manifest_desc)
+    k = program_key(lowered, extra=key)
+    compiled = cache.store.load(k, lowered.in_tree, lowered.out_tree)
+    if compiled is not None:
+        return compiled
+    reg = get_registry()
+    t0 = monotime()
+    compiled = lowered.compile()
+    dt = monotime() - t0
+    reg.counter("xcache.misses").inc()
+    reg.histogram("xcache.compile_s").observe(dt)
+    cache.store.put(k, compiled, compile_s=dt, label=label)
+    return compiled
+
+
+__all__ = [
+    "ENV_DIR",
+    "ExecutableStore",
+    "WarmupManifest",
+    "XCache",
+    "active_cache",
+    "cached_compile",
+    "default_cache_dir",
+    "disable",
+    "enable",
+    "enable_from_env",
+    "enabled",
+    "program_key",
+]

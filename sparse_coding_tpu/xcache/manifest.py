@@ -1,0 +1,67 @@
+"""Warmup manifest: the durable record of every program a process compiled.
+
+`cached_compile(..., manifest_desc=...)` appends one descriptor per
+distinct program — the serve engine records ``(model, op, bucket)``, the
+sweep records its step program's ``(signature, members, batch shape,
+dtype, fused path)`` — so a restarted process (and an operator reading
+the cache dir) knows the FULL program set a deployment needs warm before
+it admits traffic or touches the tunnel. The serve engine's ``warmup()``
+walks exactly this set for its registry; the sweep's warm-start
+precompiles its config's program before the first chunk is read
+(docs/ARCHITECTURE.md §13).
+
+Descriptors are data, not code: a descriptor cannot be compiled by
+itself — the owning subsystem maps it back to a function — which is why
+this file records *what must be warm* while the executable store holds
+*the warm bytes*. Writes are read-modify-write through
+``resilience.atomic`` and idempotent (a descriptor is its own key), so
+concurrent children of one supervisor can record freely.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+from typing import Optional
+
+
+class WarmupManifest:
+    """``<cache_dir>/warmup.json``: {descriptor-key: descriptor}."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._lock = threading.Lock()
+
+    def _read(self) -> dict:
+        try:
+            data = json.loads(self.path.read_text())
+            if isinstance(data, dict):
+                return data
+        except (OSError, ValueError):
+            pass
+        return {}
+
+    def record(self, desc: dict) -> None:
+        """Idempotently add one program descriptor (a plain JSON dict)."""
+        key = json.dumps(desc, sort_keys=True, default=str)
+        with self._lock:
+            data = self._read()
+            if data.get(key) == desc:
+                return
+            data[key] = desc
+            from sparse_coding_tpu.resilience.atomic import atomic_write_text
+
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            atomic_write_text(self.path,
+                              json.dumps(data, sort_keys=True, default=str))
+
+    def descriptors(self, kind: Optional[str] = None) -> list[dict]:
+        data = self._read()
+        out = [v for v in data.values() if isinstance(v, dict)]
+        if kind is not None:
+            out = [d for d in out if d.get("kind") == kind]
+        return out
+
+    def __len__(self) -> int:
+        return len(self._read())

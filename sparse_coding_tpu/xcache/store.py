@@ -1,0 +1,284 @@
+"""Persistent executable store: crash-safe serialized XLA programs.
+
+One directory of self-validating entry files plus an LRU manifest. An
+entry is the `jax.experimental.serialize_executable` payload of one
+compiled program wrapped in a small header (payload sha256, the compile
+seconds it replaces, jax/jaxlib versions, a human label) — so a loaded
+entry proves its own integrity before a byte of it reaches the runtime,
+and the report can say how many compile-seconds a warm start skipped.
+
+Durability rules (docs/ARCHITECTURE.md §13):
+
+- entry writes go through :func:`resilience.atomic.atomic_write_bytes`
+  (tmp + fsync + rename): a reader — possibly another supervisor child
+  sharing the cache dir — can never observe a half-written entry;
+- the worst instant is *entry durable, manifest not yet updated*: the
+  named crash barrier ``xcache.store`` sits exactly there, and the chaos
+  matrix SIGKILLs a real child at it (tests/test_pipeline_chaos.py). An
+  orphaned entry is harmless — the manifest reconciles against the
+  directory on its next write, and loads never consult the manifest;
+- every load sits behind the named fault site ``xcache.load`` (error and
+  corrupt modes, tests/test_resilience.py): a torn, bit-flipped, or
+  version-stale entry is detected (header parse / digest / deserialize),
+  counted in ``xcache.errors``, deleted, and the caller falls back to a
+  fresh compile — a bad cache entry can never poison a run;
+- eviction is size-capped LRU over the manifest's lamport clock (no wall
+  clock: two processes sharing a cache dir must not fight over mtimes),
+  rewritten atomically.
+
+The manifest is bookkeeping, never ground truth: entry files are. A lost
+manifest update (two processes racing the read-modify-write) costs at
+most one stale LRU position, not correctness.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import struct
+import threading
+from pathlib import Path
+from typing import Optional
+
+from sparse_coding_tpu.obs import get_registry
+from sparse_coding_tpu.resilience.atomic import atomic_write_bytes, atomic_write_text
+from sparse_coding_tpu.resilience.crash import crash_barrier, register_crash_site
+from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
+
+logger = logging.getLogger(__name__)
+
+register_fault_site("xcache.load",
+                    "executable-cache entry load (xcache/store.py) — "
+                    "corrupt/stale entries fall back to a fresh compile")
+register_crash_site("xcache.store",
+                    "executable-cache entry durable, LRU manifest not yet "
+                    "updated (xcache/store.py)")
+
+LOAD_FAULT_SITE = "xcache.load"
+STORE_CRASH_SITE = "xcache.store"
+
+ENV_CAP_BYTES = "SPARSE_CODING_XCACHE_CAP_BYTES"
+DEFAULT_CAP_BYTES = 2 << 30  # 2 GiB of serialized executables
+
+_HEADER_LEN = struct.Struct(">I")
+
+
+class EntryCorruptError(Exception):
+    """A cache entry failed its self-validation (header parse or payload
+    digest). Internal to the store — callers see a fallback compile."""
+
+
+def _pack_entry(payload: bytes, header: dict) -> bytes:
+    header = dict(header)
+    header["sha256"] = hashlib.sha256(payload).hexdigest()
+    hj = json.dumps(header, sort_keys=True).encode()
+    return _HEADER_LEN.pack(len(hj)) + hj + payload
+
+
+def _unpack_entry(raw: bytes) -> tuple[dict, bytes]:
+    if len(raw) < _HEADER_LEN.size:
+        raise EntryCorruptError("entry shorter than its header-length field")
+    (hlen,) = _HEADER_LEN.unpack(raw[:_HEADER_LEN.size])
+    body = raw[_HEADER_LEN.size:]
+    if hlen > len(body):
+        raise EntryCorruptError("entry header length exceeds file size")
+    try:
+        header = json.loads(body[:hlen])
+    except ValueError as e:
+        raise EntryCorruptError(f"entry header is not JSON: {e}") from e
+    payload = body[hlen:]
+    want = header.get("sha256", "")
+    if hashlib.sha256(payload).hexdigest() != want:
+        raise EntryCorruptError("payload digest mismatch")
+    return header, payload
+
+
+class ExecutableStore:
+    """The on-disk executable cache under ``<cache_dir>/exec``."""
+
+    def __init__(self, cache_dir: str | Path,
+                 cap_bytes: Optional[int] = None):
+        self.cache_dir = Path(cache_dir)
+        self.exec_dir = self.cache_dir / "exec"
+        self.manifest_path = self.cache_dir / "manifest.json"
+        self.exec_dir.mkdir(parents=True, exist_ok=True)
+        if cap_bytes is None:
+            cap_bytes = int(os.environ.get(ENV_CAP_BYTES,
+                                           str(DEFAULT_CAP_BYTES)))
+        self.cap_bytes = int(cap_bytes)
+        self._lock = threading.Lock()
+
+    # -- entry I/O -----------------------------------------------------------
+
+    def entry_path(self, key: str) -> Path:
+        return self.exec_dir / f"{key}.bin"
+
+    def load(self, key: str, in_tree, out_tree):
+        """The deserialized executable for ``key``, or None when the entry
+        is absent OR unusable (corrupt, stale, wrong runtime) — the caller
+        then compiles fresh; a bad entry is counted, logged, and deleted."""
+        path = self.entry_path(key)
+        try:
+            raw = path.read_bytes()
+        except OSError:
+            return None
+        reg = get_registry()
+        try:
+            # the fault site covers the whole load; corrupt-mode flips a
+            # payload byte, which the digest check below must catch
+            raw = fault_point(LOAD_FAULT_SITE, raw)
+            header, payload = _unpack_entry(raw)
+            from jax.experimental import serialize_executable as se
+
+            compiled = se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception as e:  # noqa: BLE001 — every failure means recompile
+            reg.counter("xcache.errors").inc()
+            logger.warning("xcache: entry %s unusable (%s: %s); falling "
+                           "back to a fresh compile", key[:12],
+                           type(e).__name__, e)
+            path.unlink(missing_ok=True)
+            self._forget(key)
+            return None
+        reg.counter("xcache.hits").inc()
+        # the seconds this load replaced, as recorded at store time — the
+        # report sums the histogram into "estimated compile seconds saved"
+        reg.histogram("xcache.saved_s").observe(
+            float(header.get("compile_s", 0.0)))
+        self._touch(key)
+        return compiled
+
+    def put(self, key: str, compiled, compile_s: float,
+            label: str = "") -> bool:
+        """Serialize and persist one compiled executable. Returns False
+        (counting ``xcache.errors``) when this runtime cannot serialize —
+        the program still runs; only the NEXT process recompiles."""
+        reg = get_registry()
+        try:
+            from jax.experimental import serialize_executable as se
+
+            payload, _, _ = se.serialize(compiled)
+        except Exception as e:  # noqa: BLE001 — caching is never fatal
+            reg.counter("xcache.errors").inc()
+            logger.warning("xcache: cannot serialize %s (%s: %s); entry "
+                           "skipped", label or key[:12], type(e).__name__, e)
+            return False
+        import jax
+        import jaxlib
+
+        blob = _pack_entry(payload, {
+            "compile_s": round(float(compile_s), 6), "label": label,
+            "jax": jax.__version__, "jaxlib": jaxlib.__version__})
+        atomic_write_bytes(self.entry_path(key), blob)
+        # the worst instant: the entry is durable, the manifest is not — a
+        # kill here leaves an orphan entry the next manifest write adopts
+        # (chaos matrix case; tests/test_pipeline_chaos.py)
+        crash_barrier(STORE_CRASH_SITE)
+        self._record(key, size=len(blob), compile_s=float(compile_s),
+                     label=label)
+        return True
+
+    def keys(self) -> list[str]:
+        return sorted(p.stem for p in self.exec_dir.glob("*.bin"))
+
+    def verify(self) -> dict[str, bool]:
+        """Self-validate every entry on disk: {key: digest_ok}. Used by
+        the chaos suite to prove a kill can never leave a torn entry."""
+        out = {}
+        for path in sorted(self.exec_dir.glob("*.bin")):
+            try:
+                _unpack_entry(path.read_bytes())
+                out[path.stem] = True
+            except EntryCorruptError:
+                out[path.stem] = False
+        return out
+
+    # -- LRU manifest --------------------------------------------------------
+
+    def _read_manifest(self) -> dict:
+        try:
+            data = json.loads(self.manifest_path.read_text())
+            if isinstance(data, dict) and isinstance(data.get("entries"),
+                                                     dict):
+                return data
+        except (OSError, ValueError):
+            pass
+        return {"clock": 0, "entries": {}}
+
+    def _write_manifest(self, data: dict) -> None:
+        # rename-atomic but fsync-free: the manifest is reconciled-from-
+        # directory bookkeeping (LRU positions), so losing a write to a
+        # power cut costs nothing — while a warm start performs one
+        # manifest touch per loaded program, where per-write fsyncs
+        # would eat the very latency the cache exists to remove
+        atomic_write_text(self.manifest_path,
+                          json.dumps(data, sort_keys=True), fsync=False)
+
+    def _reconcile(self, data: dict) -> None:
+        """Make the manifest agree with the directory: drop entries whose
+        file vanished (another process evicted), adopt orphan files (a
+        crash between entry write and manifest update — the
+        ``xcache.store`` barrier instant)."""
+        present = {p.stem: p for p in self.exec_dir.glob("*.bin")}
+        entries = data["entries"]
+        for key in [k for k in entries if k not in present]:
+            del entries[key]
+        for key, path in present.items():
+            if key not in entries:
+                try:
+                    size = path.stat().st_size
+                except OSError:
+                    continue
+                entries[key] = {"size": size, "compile_s": 0.0,
+                                "label": "", "last_used": data["clock"]}
+
+    def _mutate_manifest(self, fn) -> None:
+        with self._lock:
+            data = self._read_manifest()
+            data["clock"] = int(data.get("clock", 0)) + 1
+            self._reconcile(data)
+            fn(data)
+            self._write_manifest(data)
+
+    def _record(self, key: str, size: int, compile_s: float,
+                label: str) -> None:
+        def update(data):
+            data["entries"][key] = {"size": int(size),
+                                    "compile_s": round(compile_s, 6),
+                                    "label": label,
+                                    "last_used": data["clock"]}
+            self._evict(data, keep=key)
+
+        self._mutate_manifest(update)
+
+    def _touch(self, key: str) -> None:
+        def update(data):
+            if key in data["entries"]:
+                data["entries"][key]["last_used"] = data["clock"]
+
+        self._mutate_manifest(update)
+
+    def _forget(self, key: str) -> None:
+        def update(data):
+            data["entries"].pop(key, None)
+
+        self._mutate_manifest(update)
+
+    def _evict(self, data: dict, keep: str) -> None:
+        entries = data["entries"]
+        total = sum(int(e.get("size", 0)) for e in entries.values())
+        victims = sorted((k for k in entries if k != keep),
+                         key=lambda k: entries[k].get("last_used", 0))
+        reg = get_registry()
+        for key in victims:
+            if total <= self.cap_bytes:
+                break
+            total -= int(entries[key].get("size", 0))
+            del entries[key]
+            self.entry_path(key).unlink(missing_ok=True)
+            reg.counter("xcache.evictions").inc()
+
+    def manifest(self) -> dict:
+        with self._lock:
+            return self._read_manifest()

@@ -246,6 +246,70 @@ def test_journal_records_full_kill_story(tmp_path, monkeypatch, golden):
         ("step.done", "harvest"))
 
 
+def test_xcache_store_kill_mid_entry_write_restart_bitwise(tmp_path,
+                                                           monkeypatch,
+                                                           golden):
+    """ISSUE 5 chaos case: SIGKILL the sweep child at the ``xcache.store``
+    crash barrier — its step executable is durable in the run's shared
+    cache dir (the supervisor propagates SPARSE_CODING_XCACHE_DIR), the
+    LRU manifest update never ran. The restarted attempt must (a) never
+    load a torn entry — every entry on disk passes its own digest —
+    (b) LOAD the dead attempt's executable instead of recompiling, and
+    (c) finish with artifacts bitwise-identical to the cache-free golden
+    run: the cache can change when programs compile, never what they
+    compute (docs/ARCHITECTURE.md §13)."""
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.obs.report import build_report
+    from sparse_coding_tpu.xcache import ExecutableStore
+
+    # the supervisor runs IN-PROCESS here and flushes the process-wide
+    # registry into the run's obs dir — a fresh registry keeps counters
+    # other tests leaked (e.g. the fault matrix's injected xcache.errors)
+    # out of this run's report; the store hits/errors asserted below can
+    # then only come from this run's own processes
+    prev_registry = obs.set_registry(obs.Registry())
+    try:
+        _xcache_store_chaos_body(tmp_path, monkeypatch, golden,
+                                 build_report, ExecutableStore)
+    finally:
+        obs.set_registry(prev_registry)
+
+
+def _xcache_store_chaos_body(tmp_path, monkeypatch, golden, build_report,
+                             ExecutableStore):
+    base = tmp_path
+    _seed_from_golden(golden, base, ["chunks"])
+    config = _config(base)
+    run_dir = base / "run"
+
+    monkeypatch.setenv(crash_mod.ENV_VAR, "xcache.store:nth=1")
+    sup = Supervisor(run_dir, build_pipeline(run_dir, config,
+                                             only=["sweep"]),
+                     max_attempts=1, heartbeat_stale_s=STALE_S)
+    with pytest.raises(StepFailed, match="killed by signal 9"):
+        sup.run()
+    store = ExecutableStore(run_dir / "xcache")
+    # the kill landed AFTER the atomic entry write: the entry exists,
+    # whole, and self-validates — a torn entry is structurally impossible
+    assert store.keys(), "the killed attempt left no durable entry"
+    assert all(store.verify().values())
+
+    monkeypatch.delenv(crash_mod.ENV_VAR)
+    sup2 = Supervisor(run_dir, build_pipeline(run_dir, config,
+                                              only=["sweep"]),
+                      max_attempts=2, heartbeat_stale_s=STALE_S)
+    assert sup2.run() == {"sweep": "done"}
+    _assert_bitwise(golden, base, ["sweep"])
+    # the orphan entry was adopted (manifest reconciliation) and the
+    # restarted attempt warm-started from it: store hits in the report
+    assert all(store.verify().values())
+    assert set(store.manifest()["entries"]) >= set(store.keys())
+    report = build_report(run_dir)
+    assert report["compile_cache"]["store_hits"] >= 1
+    assert report["compile_cache"]["store_errors"] == 0
+    assert report["spans"]["sweep.warmstart"]["count"] >= 1
+
+
 def test_obs_sink_kill_mid_event_write_report_survives(tmp_path, golden):
     """SIGKILL the harvest child exactly between an event's payload write
     and its commit newline (``obs.sink.write`` crash barrier): the dead

@@ -17,6 +17,9 @@ Sites × handlers covered here:
 - ``obs.sink.write``→ a failing event write drops THAT event (counted),
                       never the workload; a corrupt line is skipped by
                       the torn-tail-tolerant reader
+- ``xcache.load``   → an erroring or bit-flipped executable-cache entry
+                      is counted, deleted, and replaced by a fresh
+                      compile — results identical, never a crash
 - SIGTERM           → sweep checkpoints at the chunk boundary and resume
                       continues BITWISE-identically
 """
@@ -501,6 +504,88 @@ def test_lock_acquire_fault_waits_then_acquires(tmp_path, monkeypatch):
     # permanently contended: times out CLEANLY (None), never hangs
     with inject(site="lock.acquire", nth=1, count=0):
         assert bench._acquire_tunnel_lock(wait_s=0.05, poll_s=0.01) is None
+
+
+# -- xcache.load (persistent executable cache) -------------------------------
+
+
+@pytest.fixture
+def _xcache(tmp_path):
+    from sparse_coding_tpu import xcache
+
+    cache = xcache.enable(tmp_path / "xc")
+    yield cache
+    xcache.disable()
+
+
+def test_xcache_load_error_fault_falls_back_to_fresh_compile(_xcache):
+    """An injected I/O failure on the entry load is counted, the entry
+    dropped, and the caller gets a freshly-compiled executable with the
+    same answers — a flaky cache disk can never fail a warm start."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_coding_tpu import obs, xcache
+
+    spec = jax.ShapeDtypeStruct((8,), jnp.float32)
+    fn = lambda x: x * 4 + 2  # noqa: E731
+    want = np.asarray(xcache.cached_compile(fn, (spec,))(
+        np.ones(8, np.float32)))
+    errors0 = obs.counter("xcache.errors").value
+    with inject(site="xcache.load", nth=1, error="OSError") as plan:
+        compiled = xcache.cached_compile(fn, (spec,))
+    assert plan.fired_count("xcache.load") == 1
+    np.testing.assert_array_equal(
+        np.asarray(compiled(np.ones(8, np.float32))), want)
+    assert obs.counter("xcache.errors").value == errors0 + 1
+    # the fresh compile was re-stored: the NEXT load is a clean hit
+    hits0 = obs.counter("xcache.hits").value
+    xcache.cached_compile(fn, (spec,))
+    assert obs.counter("xcache.hits").value == hits0 + 1
+
+
+def test_xcache_load_corrupt_fault_caught_by_digest(_xcache):
+    """A bit-flipped entry (corrupt-mode fault on the raw bytes) fails
+    the payload digest, is deleted, and falls back to a fresh compile —
+    the corrupted bytes never reach the runtime loader."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_coding_tpu import obs, xcache
+
+    spec = jax.ShapeDtypeStruct((8,), jnp.float32)
+    fn = lambda x: x - 7  # noqa: E731
+    want = np.asarray(xcache.cached_compile(fn, (spec,))(
+        np.ones(8, np.float32)))
+    errors0 = obs.counter("xcache.errors").value
+    with inject(site="xcache.load", nth=1, mode="corrupt",
+                seed=4200) as plan:
+        compiled = xcache.cached_compile(fn, (spec,))
+    assert plan.fired_count("xcache.load") == 1
+    np.testing.assert_array_equal(
+        np.asarray(compiled(np.ones(8, np.float32))), want)
+    assert obs.counter("xcache.errors").value == errors0 + 1
+    # the on-disk entry was re-stored clean (the flip was injected on the
+    # read path, but the store deletes any entry that fails to load)
+    assert all(_xcache.store.verify().values())
+
+
+def test_xcache_persistent_load_failure_is_bounded(_xcache):
+    """Every load failing (count=0) degrades to compile-every-time —
+    bounded cost, zero hangs, zero wrong answers."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_coding_tpu import xcache
+
+    spec = jax.ShapeDtypeStruct((8,), jnp.float32)
+    fn = lambda x: x * 9  # noqa: E731
+    xcache.cached_compile(fn, (spec,))
+    with inject(site="xcache.load", nth=1, count=0):
+        for _ in range(3):
+            out = xcache.cached_compile(fn, (spec,))(
+                np.ones(8, np.float32))
+            np.testing.assert_array_equal(np.asarray(out), np.full(8, 9.0))
 
 
 # -- obs.sink.write (observability event sink) -------------------------------

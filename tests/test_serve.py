@@ -284,6 +284,59 @@ def test_recompile_counter_counts_post_warmup_models(registry, rng):
         assert engine.stats()["recompiles"] == 1  # ...no further misses
 
 
+def test_parallel_warmup_bit_identical_to_serial(rng):
+    """ISSUE 5 satellite: warmup fans compiles out over a bounded thread
+    pool (XLA compiles release the GIL) under an ``obs.span`` — and the
+    engine it produces dispatches BIT-identically to one warmed in the
+    serial order. Integer-valued weights/inputs for exact f32 dot
+    products (the same isolation as the mixed-request test above)."""
+    from sparse_coding_tpu import obs
+
+    k1, k2 = jax.random.split(rng)
+    dicts = {
+        "a": UntiedSAE(
+            encoder=jax.random.randint(k1, (N, D), -4, 5).astype(
+                jnp.float32),
+            encoder_bias=jnp.zeros(N),
+            dictionary=jax.random.randint(k2, (N, D), -4, 5).astype(
+                jnp.float32)),
+        "b": TiedSAE(dictionary=jax.random.randint(
+            jax.random.fold_in(rng, 3), (N, D), -4, 5).astype(jnp.float32),
+            encoder_bias=jnp.zeros(N)),
+    }
+    payloads = {op: np.asarray(np.random.default_rng(5).integers(
+        -4, 5, (7, N if op == "decode" else D)), np.float32)
+        for op in ("encode", "decode", "topk")}
+    warmup_spans = obs.get_registry().histogram(
+        "span.serve.warmup.dur_s").count
+
+    def serve_all(max_workers):
+        reg = ModelRegistry()
+        for name, ld in dicts.items():
+            reg.register(name, ld)
+        with ServingEngine(reg, max_wait_ms=0.0, topk_k=4) as engine:
+            n = engine.warmup(max_workers=max_workers)
+            assert n == 2 * 3 * 3
+            assert engine.stats()["warmed"]
+            out = {}
+            for name in dicts:
+                for op, x in payloads.items():
+                    out[(name, op)] = engine.query(name, x, op=op,
+                                                   timeout=60)
+            assert engine.stats()["recompiles"] == 0
+            return out
+
+    serial = serve_all(max_workers=1)
+    parallel = serve_all(max_workers=8)
+    assert set(serial) == set(parallel)
+    for key, want in serial.items():
+        got = parallel[key]
+        jax.tree.map(np.testing.assert_array_equal, got, want)
+    # both warmups were timed under the serve.warmup span
+    assert obs.get_registry().histogram(
+        "span.serve.warmup.dur_s").count == warmup_spans + 2
+
+
 def test_dispatch_fault_typed_error_and_worker_survives(registry):
     """A dispatch-callback exception marks ONLY that flush's requests
     failed (typed DispatchError carrying the injected cause) and the

@@ -254,6 +254,54 @@ def test_perplexity_scan_program_lowers(rng):
             lowering_platforms=("tpu",))
 
 
+def test_xcache_never_changes_lowered_hlo(rng, tmp_path):
+    """ISSUE 5 AOT gate: the executable cache may change WHEN a program
+    compiles, never WHAT runs on chip — the TPU-lowered HLO of a serving
+    bucket program and the ensemble train step is BITWISE identical with
+    xcache fully enabled (persistent compilation cache on, executable
+    store live, a cached_compile round actually performed) vs disabled."""
+    from sparse_coding_tpu import xcache
+    from sparse_coding_tpu.models import TiedSAE
+    from sparse_coding_tpu.serve.engine import build_bucket_program
+    from sparse_coding_tpu.serve.registry import ModelRegistry
+
+    reg = ModelRegistry()
+    reg.register("tied", TiedSAE(dictionary=jax.random.normal(rng, (64, 32)),
+                                 encoder_bias=jnp.zeros(64)))
+    entry = reg.get("tied")
+    members = [FunctionalTiedSAE.init(k, 32, 64, l1_alpha=1e-3)
+               for k in jax.random.split(rng, 2)]
+    ens = Ensemble(members, FunctionalTiedSAE, donate=False)
+    batch = jnp.zeros((128, 32))
+
+    def lower_both():
+        fn, spec = build_bucket_program(entry, "encode", 64, jnp.float32,
+                                        topk_k=16)
+        serve_txt = jax.jit(fn).trace(entry.tree, spec).lower(
+            lowering_platforms=("tpu",)).as_text()
+        train_txt = jax.jit(
+            lambda s, b: ens._standard_step(s, b)).trace(
+            ens.state, batch).lower(lowering_platforms=("tpu",)).as_text()
+        return serve_txt, train_txt
+
+    baseline = lower_both()
+    cache = xcache.enable(tmp_path / "xc")
+    try:
+        # the cache machinery demonstrably ran while the identical HLO
+        # was produced: one compile-store plus one load round-trip
+        fn, spec = build_bucket_program(entry, "encode", 64, jnp.float32,
+                                        topk_k=16)
+        for _ in range(2):
+            xcache.cached_compile(jax.jit(fn), (entry.tree, spec),
+                                  label="lowering-gate")
+        assert len(cache.store.keys()) == 1
+        enabled = lower_both()
+    finally:
+        xcache.disable()
+    assert enabled[0] == baseline[0]  # serving bucket program
+    assert enabled[1] == baseline[1]  # ensemble train step
+
+
 def test_obs_instrumentation_is_zero_overhead_in_hlo(rng, tmp_path):
     """The observability layer is host-side by construction: with the XLA
     probes installed, an event sink live, and the lowering performed

@@ -1,0 +1,359 @@
+"""Persistent executable cache + warm start (docs/ARCHITECTURE.md §13).
+
+Covers the xcache tentpole's acceptance invariants, hermetic on CPU:
+
+- ``cached_compile`` round-trips: first call compiles + stores, second
+  call (same process or a different one) loads — bit-identical results,
+  hit/miss counters, manifest bookkeeping, LRU eviction under a size cap;
+- a corrupt entry is detected by its digest, deleted, and transparently
+  recompiled — a bad cache can never poison a run;
+- the dormant-probe regression: with ``xcache.enable()`` in a
+  subprocess, a second identical jit in a FRESH process increments
+  ``jax.cache_hits`` in the merged ``obs.report`` (the
+  ``/jax/compilation_cache/*`` listener keys in obs/jaxprobes.py were
+  mapped but never fired before anything enabled the persistent cache);
+- the warm-restart proof: a cold/warm subprocess pair sharing one cache
+  dir, where the warm process performs ZERO backend compiles for the
+  warmed program set (its serving engine loads every executable), its
+  startup-to-first-result wall time is measurably below the cold
+  process's, and the merged report carries both processes' evidence.
+"""
+
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import stripped_cpu_subprocess_env
+
+from sparse_coding_tpu import obs, xcache
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_xcache(monkeypatch):
+    """No cache state may leak across tests: the enable() config flip is
+    process-global, so every test that enables must end disabled."""
+    monkeypatch.delenv(xcache.ENV_DIR, raising=False)
+    yield
+    xcache.disable()
+
+
+def _counter(name: str) -> int:
+    return obs.counter(name).value
+
+
+def test_cached_compile_without_enable_is_plain_compile(tmp_path):
+    assert not xcache.enabled()
+    spec = jax.ShapeDtypeStruct((8,), jnp.float32)
+    compiled = xcache.cached_compile(lambda x: x * 2 + 1, (spec,))
+    np.testing.assert_array_equal(
+        np.asarray(compiled(np.ones(8, np.float32))), np.full(8, 3.0))
+    assert not list(tmp_path.iterdir())  # nothing touched disk
+
+
+def test_cached_compile_miss_then_hit_bit_identical(tmp_path):
+    cache = xcache.enable(tmp_path / "xc")
+    hits0, misses0 = _counter("xcache.hits"), _counter("xcache.misses")
+    spec = jax.ShapeDtypeStruct((16, 4), jnp.float32)
+    fn = lambda x: jnp.tanh(x @ x.T)  # noqa: E731
+    x = np.linspace(-1, 1, 64, dtype=np.float32).reshape(16, 4)
+    first = xcache.cached_compile(fn, (spec,), label="t")
+    want = np.asarray(first(x))
+    assert _counter("xcache.misses") == misses0 + 1
+    assert len(cache.store.keys()) == 1
+    # second call: loaded from the store, not recompiled, bit-identical
+    second = xcache.cached_compile(fn, (spec,), label="t")
+    np.testing.assert_array_equal(np.asarray(second(x)), want)
+    assert _counter("xcache.hits") == hits0 + 1
+    # manifest: entry recorded with its size, all entries digest-clean
+    man = cache.store.manifest()
+    key = cache.store.keys()[0]
+    assert man["entries"][key]["size"] == \
+        cache.store.entry_path(key).stat().st_size
+    assert cache.store.verify() == {key: True}
+
+
+def test_key_separates_shapes_and_salt(tmp_path):
+    cache = xcache.enable(tmp_path / "xc")
+    fn = lambda x: x + 1  # noqa: E731
+    xcache.cached_compile(fn, (jax.ShapeDtypeStruct((4,), jnp.float32),))
+    xcache.cached_compile(fn, (jax.ShapeDtypeStruct((8,), jnp.float32),))
+    xcache.cached_compile(fn, (jax.ShapeDtypeStruct((8,), jnp.float32),),
+                          key="other-salt")
+    assert len(cache.store.keys()) == 3
+
+
+def test_corrupt_entry_detected_deleted_recompiled(tmp_path):
+    cache = xcache.enable(tmp_path / "xc")
+    spec = jax.ShapeDtypeStruct((8,), jnp.float32)
+    fn = lambda x: x * 3  # noqa: E731
+    xcache.cached_compile(fn, (spec,))
+    key = cache.store.keys()[0]
+    path = cache.store.entry_path(key)
+    blob = bytearray(path.read_bytes())
+    blob[-1] ^= 0x01  # payload bit flip: the digest must catch it
+    path.write_bytes(bytes(blob))
+    assert cache.store.verify() == {key: False}
+    errors0 = _counter("xcache.errors")
+    compiled = xcache.cached_compile(fn, (spec,))
+    np.testing.assert_array_equal(
+        np.asarray(compiled(np.ones(8, np.float32))), np.full(8, 3.0))
+    assert _counter("xcache.errors") == errors0 + 1
+    # the bad entry was removed and the fresh compile re-stored
+    assert cache.store.verify() == {cache.store.keys()[0]: True}
+
+
+def test_lru_eviction_respects_size_cap(tmp_path):
+    # cap sized to hold ~2 of the 3 entries; the least-recently-USED one
+    # must be the victim. The size probe compiles a program of the same
+    # shape as the real ones (x + constant) — entry size tracks the
+    # serialized executable, not the source
+    probe = xcache.enable(tmp_path / "probe")
+    spec = jax.ShapeDtypeStruct((8,), jnp.float32)
+    xcache.cached_compile(lambda x: x + 99, (spec,))
+    entry_size = probe.store.entry_path(
+        probe.store.keys()[0]).stat().st_size
+    xcache.disable()
+
+    cache = xcache.enable(tmp_path / "xc", cap_bytes=int(entry_size * 2.7))
+    fns = [lambda x: x + 1, lambda x: x + 2, lambda x: x + 3]
+    xcache.cached_compile(fns[0], (spec,))
+    xcache.cached_compile(fns[1], (spec,))
+    xcache.cached_compile(fns[0], (spec,))  # touch: 0 is now most recent
+    evict0 = _counter("xcache.evictions")
+    xcache.cached_compile(fns[2], (spec,))  # over cap: evicts fn[1]'s entry
+    assert _counter("xcache.evictions") == evict0 + 1
+    assert len(cache.store.keys()) == 2
+    # the touched program survived: loading it is a hit, not a recompile
+    hits0 = _counter("xcache.hits")
+    xcache.cached_compile(fns[0], (spec,))
+    assert _counter("xcache.hits") == hits0 + 1
+
+
+def test_manifest_adopts_orphan_entry(tmp_path):
+    """The ``xcache.store`` crash instant, replayed in-process: an entry
+    file durable with NO manifest record (the kill landed between the
+    two writes). The next manifest write reconciles against the
+    directory and adopts the orphan — nothing is ever lost or torn."""
+    cache = xcache.enable(tmp_path / "xc")
+    spec = jax.ShapeDtypeStruct((8,), jnp.float32)
+    xcache.cached_compile(lambda x: x * 5, (spec,))
+    key = cache.store.keys()[0]
+    orphan = cache.store.entry_path(key).read_bytes()
+    cache.store.manifest_path.unlink()  # simulate: manifest never written
+    cache.store.entry_path("deadbeef" * 8).write_bytes(orphan)
+    xcache.cached_compile(lambda x: x * 6, (spec,))  # any manifest write
+    man = cache.store.manifest()
+    assert "deadbeef" * 8 in man["entries"]
+    assert key in man["entries"]
+
+
+def test_warmup_manifest_records_serve_product_and_sweep_programs(tmp_path):
+    from sparse_coding_tpu.ensemble import Ensemble
+    from sparse_coding_tpu.models import TiedSAE
+    from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+    from sparse_coding_tpu.serve import ModelRegistry, ServingEngine
+
+    cache = xcache.enable(tmp_path / "xc")
+    reg = ModelRegistry(audit=False)
+    w = np.arange(32 * 16, dtype=np.float32).reshape(32, 16) % 5
+    reg.register("tied", TiedSAE(dictionary=jnp.asarray(w),
+                                 encoder_bias=jnp.zeros(32)))
+    with ServingEngine(reg, max_wait_ms=0.0, buckets=(8, 64)) as engine:
+        n = engine.warmup()
+    serve_descs = cache.warmup.descriptors(kind="serve")
+    assert n == 6 and len(serve_descs) == 6
+    assert {(d["model"], d["op"], d["bucket"]) for d in serve_descs} == {
+        ("tied", op, b) for op in ("encode", "decode", "topk")
+        for b in (8, 64)}
+
+    members = [FunctionalTiedSAE.init(k, 16, 32, l1_alpha=1e-3)
+               for k in jax.random.split(jax.random.PRNGKey(0), 2)]
+    ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, donate=False)
+    ens.precompile((64, 16), label="sweep/e_0")
+    (sweep_desc,) = cache.warmup.descriptors(kind="sweep")
+    assert sweep_desc["shape"] == [64, 16]
+    assert sweep_desc["sig"] == "tied_sae"
+    assert sweep_desc["n_members"] == 2
+
+
+def test_precompile_changes_no_training_math(tmp_path):
+    """The cache must never change WHAT runs: a sweep step after
+    precompile produces bitwise the same params as one without it."""
+    from sparse_coding_tpu.ensemble import Ensemble
+    from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+
+    def run(precompile: bool):
+        members = [FunctionalTiedSAE.init(k, 16, 32, l1_alpha=1e-3)
+                   for k in jax.random.split(jax.random.PRNGKey(0), 2)]
+        ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, donate=False)
+        if precompile:
+            ens.precompile((64, 16))
+        batch = jax.random.normal(jax.random.PRNGKey(1), (64, 16))
+        for _ in range(2):
+            ens.step_batch(batch)
+        return np.asarray(jax.device_get(ens.state.params["encoder"]))
+
+    baseline = run(precompile=False)
+    xcache.enable(tmp_path / "xc")
+    np.testing.assert_array_equal(run(precompile=True), baseline)
+    np.testing.assert_array_equal(run(precompile=True), baseline)
+
+
+# -- subprocess proofs --------------------------------------------------------
+
+_PROBE_SCRIPT = """
+import json, os, sys
+import numpy as np
+from sparse_coding_tpu import obs, xcache
+obs.configure_sink_from_env(os.environ["SPARSE_CODING_OBS_STEP"])
+obs.install_jax_probes()
+xcache.enable(sys.argv[1])
+import jax
+f = jax.jit(lambda x: x * 3 + 1)
+f(np.ones(8, np.float32))
+print(json.dumps({
+    "cache_hits": obs.counter("jax.cache_hits").value,
+    "cache_misses": obs.counter("jax.cache_misses").value,
+}))
+obs.flush_metrics()
+obs.close_sink()
+"""
+
+
+def _run_script(tmp_path, name: str, body: str, argv: list[str],
+                env_extra: dict) -> dict:
+    script = tmp_path / name
+    script.write_text(body)
+    env = stripped_cpu_subprocess_env()
+    env.update(env_extra)
+    proc = subprocess.run([sys.executable, str(script)] + argv,
+                          capture_output=True, text=True, env=env,
+                          timeout=600)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_dormant_probes_fire_second_process_reports_cache_hit(tmp_path):
+    """ISSUE 5 satellite: the ``/jax/compilation_cache/*`` probe keys in
+    obs/jaxprobes.py never fired because nothing enabled the persistent
+    cache. With ``xcache.enable()`` in a subprocess, the SAME jit in a
+    second process is a persistent-cache hit, and the merged report's
+    compile_cache section shows it."""
+    from sparse_coding_tpu.obs.report import build_report
+
+    run_dir = tmp_path / "run"
+    cache_dir = str(tmp_path / "xc")
+    env = {"SPARSE_CODING_OBS_DIR": str(run_dir / "obs"),
+           "SPARSE_CODING_RUN_ID": "probe-regression"}
+    cold = _run_script(tmp_path, "probe.py", _PROBE_SCRIPT, [cache_dir],
+                       {**env, "SPARSE_CODING_OBS_STEP": "cold"})
+    warm = _run_script(tmp_path, "probe.py", _PROBE_SCRIPT, [cache_dir],
+                       {**env, "SPARSE_CODING_OBS_STEP": "warm"})
+    assert cold["cache_misses"] >= 1 and cold["cache_hits"] == 0
+    assert warm["cache_hits"] >= 1  # the dormant counter now fires
+    report = build_report(run_dir)
+    assert report["compile_cache"]["persistent_hits"] >= 1
+    assert report["compile_cache"]["persistent_misses"] >= 1
+    assert report["run_ids"] == ["probe-regression"]
+
+
+_WARM_SCRIPT = """
+import json, os, sys
+import numpy as np
+phase, cache_dir = sys.argv[1], sys.argv[2]
+from sparse_coding_tpu import obs, xcache
+obs.configure_sink_from_env(phase)
+obs.install_jax_probes()
+xcache.enable(cache_dir)
+import jax
+import jax.numpy as jnp
+# measured from runtime-ready (probes + cache up, jax imported): the
+# span isolates what the cache changes — registry setup, warmup
+# compile-vs-load, first dispatch — from import-time OS-cache noise
+t0 = obs.monotime()
+from sparse_coding_tpu.models import TiedSAE
+from sparse_coding_tpu.serve import ModelRegistry, ServingEngine
+
+D, N = 64, 256
+reg = ModelRegistry(audit=False)  # the eager audit probe is not a bucket
+w = (np.arange(N * D, dtype=np.float32).reshape(N, D) % 7) - 3.0
+reg.register("tied", TiedSAE(dictionary=jnp.asarray(w),
+                             encoder_bias=jnp.asarray(np.zeros(N, np.float32))))
+compiles_before_warmup = obs.counter("jax.compiles").value
+with ServingEngine(reg, max_wait_ms=0.0) as engine:
+    n_programs = engine.warmup()
+    out = engine.query("tied", np.ones((5, D), np.float32), timeout=120)
+    first_s = obs.monotime() - t0
+    obs.record_span("serve.startup_to_first_result", first_s, phase=phase,
+                    programs=n_programs)
+    snap = engine.stats()
+print(json.dumps({
+    "phase": phase,
+    "programs": n_programs,
+    "recompiles": snap["recompiles"],
+    "compiles_total": obs.counter("jax.compiles").value,
+    "compiles_warmed_set": obs.counter("jax.compiles").value
+                           - compiles_before_warmup,
+    "xc_hits": obs.counter("xcache.hits").value,
+    "xc_misses": obs.counter("xcache.misses").value,
+    "first_result_s": first_s,
+    "result_sum": float(np.asarray(out).sum()),
+}))
+obs.flush_metrics()
+obs.close_sink()
+"""
+
+
+def test_warm_restart_zero_compiles_and_faster_first_result(tmp_path):
+    """ISSUE 5 acceptance, hermetic on CPU: a cold/warm subprocess pair
+    sharing one cache dir. The warm process loads every serving program
+    from the executable store — ``jax.compiles == 0`` over the whole
+    warmup-through-first-result window (the warmed program set; the only
+    compiles either process ever pays outside it are the handful of
+    eager host→device transfer programs at registry setup),
+    ``recompiles == 0`` after ``warmup()`` — computes the identical
+    result, and reaches its first result measurably sooner; the merged
+    ``obs.report`` shows both attempts' spans and the store hits."""
+    from sparse_coding_tpu.obs.report import build_report
+
+    run_dir = tmp_path / "run"
+    cache_dir = str(tmp_path / "xc")
+    env = {"SPARSE_CODING_OBS_DIR": str(run_dir / "obs"),
+           "SPARSE_CODING_RUN_ID": "warm-restart"}
+    cold = _run_script(tmp_path, "warm.py", _WARM_SCRIPT,
+                       ["cold", cache_dir],
+                       {**env, "SPARSE_CODING_OBS_STEP": "cold"})
+    warm = _run_script(tmp_path, "warm.py", _WARM_SCRIPT,
+                       ["warm", cache_dir],
+                       {**env, "SPARSE_CODING_OBS_STEP": "warm"})
+
+    assert cold["programs"] == warm["programs"] == 9
+    assert cold["xc_misses"] == 9 and cold["xc_hits"] == 0
+    assert cold["compiles_warmed_set"] >= 9  # the cold start truly compiled
+    # the warm restart: every program loaded, ZERO backend compiles
+    assert warm["xc_hits"] == 9 and warm["xc_misses"] == 0
+    assert warm["compiles_warmed_set"] == 0
+    # the only compiles left anywhere in the warm process are the eager
+    # host→device transfer programs from registry setup, equal in both
+    # processes — the serving path itself compiled nothing
+    assert warm["compiles_total"] == cold["compiles_total"] - \
+        cold["compiles_warmed_set"]
+    assert warm["recompiles"] == 0
+    assert warm["result_sum"] == cold["result_sum"]  # bit-identical serving
+    # startup-to-first-result measurably below the cold process's
+    assert warm["first_result_s"] < cold["first_result_s"], (warm, cold)
+
+    report = build_report(run_dir)
+    span = report["spans"]["serve.startup_to_first_result"]
+    assert span["count"] == 2 and span["errors"] == 0
+    cc = report["compile_cache"]
+    assert cc["store_hits"] == 9 and cc["store_misses"] == 9
+    assert cc["saved_s"] > 0  # the report prices the skipped compiles
+    warmup_span = report["spans"]["serve.warmup"]
+    assert warmup_span["count"] == 2
+    assert report["run_ids"] == ["warm-restart"]
