@@ -18,6 +18,7 @@ import argparse
 import json
 import sys
 import time
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -303,6 +304,94 @@ def bench_serving(quick: bool) -> None:
           fill_ratio=round(fill, 3), recompiles=snap["recompiles"])
 
 
+def bench_gateway(quick: bool) -> None:
+    """Mixed-tenant gateway soak (ISSUE 6 / ROADMAP item 2): three
+    priority classes from concurrent tenants through a replica pool with
+    hedging live, reporting throughput, p50/p95/p99 request latency read
+    back from a merged ``obs.report`` (the production evidence path, not
+    an ad-hoc timer), sheds by priority, hedge accounting, and the
+    steady-state compile count — which must be 0: after warmup, no
+    request may ever pay a trace or compile in the latency path."""
+    import tempfile
+    import threading
+
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+    from sparse_coding_tpu.obs.report import build_report
+    from sparse_coding_tpu.serve import (
+        PRIORITIES,
+        ModelRegistry,
+        QueueFullError,
+        ServingGateway,
+    )
+
+    d, ratio = (256, 2) if quick else (512, 4)
+    n_threads, per_thread = (3, 40) if quick else (6, 150)
+    ld = FunctionalTiedSAE.to_learned_dict(
+        *FunctionalTiedSAE.init(jax.random.PRNGKey(0), d, d * ratio,
+                                l1_alpha=1e-3))
+    registry = ModelRegistry()
+    registry.register("sae", ld)
+    rng = np.random.default_rng(0)
+    sizes = rng.integers(1, 65, n_threads * per_thread)
+    payloads = [np.asarray(rng.standard_normal((int(s), d)), np.float32)
+                for s in sizes]
+    obs.install_jax_probes()
+    with ServingGateway(registry, n_replicas=2, n_spares=1,
+                        max_wait_ms=1.0, max_queue_rows=1 << 20,
+                        hedge_min_samples=64) as gw:
+        gw.warmup()
+        compiles0 = obs.counter("jax.compiles").value
+
+        def submitter(tid: int) -> None:
+            prio = PRIORITIES[tid % len(PRIORITIES)]
+            futures = []
+            for i in range(per_thread):
+                try:
+                    futures.append(gw.submit(
+                        "sae", payloads[tid * per_thread + i],
+                        priority=prio))
+                except QueueFullError:
+                    pass  # a handled shed; counted by the gateway
+            for f in futures:
+                f.result(timeout=120)
+
+        threads = [threading.Thread(target=submitter, args=(t,))
+                   for t in range(n_threads)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        dt = time.perf_counter() - t0
+        steady_compiles = obs.counter("jax.compiles").value - compiles0
+        snap = gw.stats()
+        # latency quantiles via the production evidence path: flush the
+        # gateway registry into an event file, merge with obs.report
+        with tempfile.TemporaryDirectory() as run_dir:
+            prev = obs.configure_sink(obs.EventSink(
+                Path(run_dir) / "obs" / "gateway.jsonl"))
+            try:
+                obs.flush_metrics(registry=gw.metrics.registry)
+            finally:
+                obs.configure_sink(prev)
+            report = build_report(run_dir)
+        lat = report["histograms"].get("gateway.latency_s", {})
+    # throughput counts the rows actually served (sheds excluded)
+    total_rows = sum(b["rows"] for b in snap["buckets"].values())
+    g = snap["gateway"]
+    _emit("gateway_soak", total_rows / dt, "activations/s",
+          n_requests=len(payloads), n_threads=n_threads, d=d,
+          n_replicas=2,
+          p50_ms=(round(lat["p50"] * 1e3, 3) if lat.get("p50") else None),
+          p95_ms=(round(lat["p95"] * 1e3, 3) if lat.get("p95") else None),
+          p99_ms=(round(lat["p99"] * 1e3, 3) if lat.get("p99") else None),
+          shed=sum(g["shed"].values()),
+          hedges_fired=g["hedges_fired"], hedges_won=g["hedges_won"],
+          failovers=g["failovers"],
+          recompiles=snap["recompiles"], steady_compiles=steady_compiles)
+
+
 def bench_seq_parallel(quick: bool) -> None:
     # The pre-r4 version of this suite hung indefinitely behind the axon
     # tunnel (eager shard_map); the jitted _sp_program fixed it, but a
@@ -359,7 +448,8 @@ def main() -> None:
     # seq_parallel runs LAST: its hang watchdog exits the process, and every
     # earlier suite's JSON line is flushed by then
     for suite in (bench_ensemble, bench_big_sae, bench_harvest,
-                  bench_chunk_io, bench_streaming_eval, bench_seq_parallel):
+                  bench_chunk_io, bench_streaming_eval, bench_gateway,
+                  bench_seq_parallel):
         try:
             suite(args.quick)
         except Exception as e:

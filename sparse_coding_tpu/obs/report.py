@@ -137,6 +137,42 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "compile_time_s": _hist_sum("jax.compile_dur_s"),
         "saved_s": _hist_sum("xcache.saved_s"),
     }
+
+    # gateway evidence (docs/ARCHITECTURE.md §14): the self-healing
+    # front door's hedge / shed / failover / spare-activation story in
+    # one place, so a replica incident reads out of the SAME merged
+    # report as its latency and compile evidence
+    def _by_label(prefix: str, label: str) -> dict:
+        out = {}
+        for name, v in counters.items():
+            if name.startswith(prefix + "{") and f"{label}=" in name:
+                val = name[name.index("{") + 1:-1]
+                for pair in val.split(","):
+                    k, _, lv = pair.partition("=")
+                    if k == label:
+                        out[lv] = out.get(lv, 0) + int(v)
+        return out
+
+    gateway = {
+        "hedges_fired": counters.get("gateway.hedges_fired", 0),
+        "hedges_won": counters.get("gateway.hedges_won", 0),
+        "hedges_wasted": counters.get("gateway.hedges_wasted", 0),
+        "hedges_abandoned": counters.get("gateway.hedges_abandoned", 0),
+        "failovers": counters.get("gateway.failovers", 0),
+        "route_errors": counters.get("gateway.route_errors", 0),
+        "spare_activations": counters.get("gateway.spare_activations", 0),
+        "spare_activation_errors":
+            counters.get("gateway.spare_activation_errors", 0),
+        "spare_exhausted": counters.get("gateway.spare_exhausted", 0),
+        "shed": _by_label("gateway.shed", "priority"),
+        "served": _by_label("gateway.served", "priority"),
+        "routes": _by_label("gateway.routes", "replica"),
+        "replica_errors": _by_label("gateway.replica_errors", "replica"),
+        "dispatch_timeouts": _by_label("gateway.dispatch_timeouts",
+                                       "replica"),
+        "admission_level":
+            gauges.get("gateway.admission_level", {}).get("value"),
+    }
     return {
         "run_dir": str(run_dir),
         "run_ids": sorted(run_ids),
@@ -153,6 +189,7 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "retraces": counters.get("jax.retraces", 0),
         "compiles": counters.get("jax.compiles", 0),
         "compile_cache": compile_cache,
+        "gateway": gateway,
         "dropped_events": counters.get("obs.sink.dropped", 0),
     }
 
@@ -194,6 +231,23 @@ def format_report(report: dict) -> str:
             f"{cc['store_misses']}m ({cc['store_errors']} bad), "
             f"{cc['compile_time_s']:.1f}s compiling, "
             f"~{cc['saved_s']:.1f}s saved")
+    gw = report.get("gateway", {})
+    if any(v for k, v in gw.items()
+           if k != "admission_level" and (v if isinstance(v, int)
+                                          else sum(v.values()))):
+        shed = ", ".join(f"{p}={n}" for p, n in sorted(gw["shed"].items()))
+        routes = ", ".join(f"{r}={n}"
+                           for r, n in sorted(gw["routes"].items()))
+        lines.append(
+            f"gateway: hedges {gw['hedges_fired']}f/{gw['hedges_won']}w/"
+            f"{gw['hedges_wasted']}x, failovers {gw['failovers']}, "
+            f"spares {gw['spare_activations']} activated "
+            f"({gw['spare_activation_errors']} failed), "
+            f"admission level {gw['admission_level']}")
+        if shed:
+            lines.append(f"  shed: {shed}")
+        if routes:
+            lines.append(f"  routes: {routes}")
     interesting = {k: v for k, v in report["counters"].items()
                    if not k.startswith(("jax.retraces", "jax.compiles"))}
     if interesting:

@@ -22,7 +22,17 @@ breaker (``resilience.CircuitBreaker``) that sheds load while the backend
 is sick — all driven deterministically in CI via the ``serve.dispatch``
 fault site.
 
-See docs/ARCHITECTURE.md §8 for design rationale.
+Above the single engine sits the **self-healing gateway**
+(docs/ARCHITECTURE.md §14):
+
+- :mod:`gateway`   — replica pools with per-replica breakers, health-
+  weighted routing + failover, p95-triggered request hedging, warm-spare
+  activation at zero compiles via the xcache warmup manifest.
+- :mod:`health`    — EWMA replica health scores.
+- :mod:`slo`       — priority classes, brownout admission ladder, and
+  the closed-loop p99 controller.
+
+See docs/ARCHITECTURE.md §8 for the engine design rationale.
 """
 
 from sparse_coding_tpu.resilience.breaker import CircuitBreaker
@@ -39,17 +49,34 @@ from sparse_coding_tpu.serve.engine import (
     bucket_op_fn,
     build_bucket_program,
 )
+from sparse_coding_tpu.serve.gateway import Replica, ServingGateway
+from sparse_coding_tpu.serve.health import EwmaHealth
 from sparse_coding_tpu.serve.metrics import ServingMetrics
 from sparse_coding_tpu.serve.offline import score_offline
 from sparse_coding_tpu.serve.registry import ModelRegistry, RegistryEntry
+from sparse_coding_tpu.serve.slo import (
+    BATCH,
+    INTERACTIVE,
+    PRIORITIES,
+    SCAVENGER,
+    AdmissionController,
+)
 
 __all__ = [
+    "AdmissionController",
+    "BATCH",
     "CircuitBreaker",
     "CircuitOpenError",
     "DispatchError",
+    "EwmaHealth",
+    "INTERACTIVE",
     "ModelRegistry",
+    "PRIORITIES",
     "RegistryEntry",
+    "Replica",
+    "SCAVENGER",
     "ServingEngine",
+    "ServingGateway",
     "ServingMetrics",
     "ServeError",
     "ServeFuture",

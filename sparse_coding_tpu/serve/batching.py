@@ -38,15 +38,23 @@ class ServeError(RuntimeError):
 
 class QueueFullError(ServeError):
     """Backpressure rejection: admitting the request would push the queue
-    past ``max_queue_rows``. Callers should shed load or retry with
-    backoff; the request was NOT enqueued."""
+    past ``max_queue_rows`` (or, at the gateway, past the SLO admission
+    ladder). The request was NOT enqueued. ``retry_after_s`` mirrors
+    :class:`CircuitOpenError`'s contract — the predicted time for the
+    current queue to drain (depth x recent per-row service rate) — so
+    shed clients back off intelligently instead of hot-retrying; ``None``
+    when no service rate has been observed yet."""
 
-    def __init__(self, queued_rows: int, max_queue_rows: int):
+    def __init__(self, queued_rows: int, max_queue_rows: int,
+                 retry_after_s: float | None = None):
+        hint = ("" if retry_after_s is None
+                else f"; retry in ~{retry_after_s:.2f}s")
         super().__init__(
             f"serving queue full: {queued_rows} rows queued "
-            f"(max {max_queue_rows}); request rejected")
+            f"(max {max_queue_rows}); request rejected{hint}")
         self.queued_rows = queued_rows
         self.max_queue_rows = max_queue_rows
+        self.retry_after_s = retry_after_s
 
 
 class RequestTooLargeError(ServeError):
@@ -135,7 +143,9 @@ class Request:
 class MicroBatcher:
     """Single worker thread draining per-(model, op) request streams into
     the dispatch callback. ``dispatch(key, requests, deadline_flush)`` owns
-    bucket selection, padding, the compiled call, and result fan-out."""
+    bucket selection, padding, the compiled call, and result fan-out; it
+    returns the number of rows actually served (None/0 for a shed or
+    failed flush — those must not feed the service-rate estimate)."""
 
     def __init__(self, dispatch: Callable[[tuple, list[Request], bool], None],
                  max_rows_per_batch: int, max_wait_s: float,
@@ -147,6 +157,11 @@ class MicroBatcher:
         self._metrics = metrics
         self._queues: dict[tuple, deque[Request]] = {}
         self._queued_rows = 0
+        # recent per-row service rate (rows/s EWMA over dispatch walls):
+        # feeds QueueFullError.retry_after_s and the gateway's predicted
+        # admission wait; None until the first dispatch completes
+        self._rate_rows_s: float | None = None
+        self._rate_alpha = 0.2
         self._cond = threading.Condition()
         self._stop = False
         self._paused = False
@@ -162,12 +177,43 @@ class MicroBatcher:
                 raise ServeError("serving engine is shut down")
             if self._queued_rows + request.rows > self._max_queue_rows:
                 self._metrics.record_reject()
-                raise QueueFullError(self._queued_rows, self._max_queue_rows)
+                raise QueueFullError(self._queued_rows, self._max_queue_rows,
+                                     self._predicted_wait_locked())
             self._queues.setdefault(request.key, deque()).append(request)
             self._queued_rows += request.rows
             self._metrics.record_enqueue(request.rows)
             self._cond.notify_all()
         return request.future
+
+    def _predicted_wait_locked(self, extra_rows: int = 0) -> float | None:
+        # _cond held by caller
+        if self._rate_rows_s is None or self._rate_rows_s <= 0:
+            return None
+        return (self._queued_rows + extra_rows) / self._rate_rows_s
+
+    def predicted_wait_s(self, extra_rows: int = 0) -> float | None:
+        """Predicted time for the current queue (plus ``extra_rows``) to
+        drain at the recent service rate; None before any dispatch has
+        been timed. The gateway's SLO admission compares this against a
+        request's deadline."""
+        with self._cond:
+            return self._predicted_wait_locked(extra_rows)
+
+    @property
+    def queued_rows(self) -> int:
+        with self._cond:
+            return self._queued_rows
+
+    def _observe_service(self, rows: int, dur_s: float) -> None:
+        if rows <= 0 or dur_s <= 0:
+            return
+        inst = rows / dur_s
+        with self._cond:
+            if self._rate_rows_s is None:
+                self._rate_rows_s = inst
+            else:
+                a = self._rate_alpha
+                self._rate_rows_s = (1 - a) * self._rate_rows_s + a * inst
 
     def pause(self) -> None:
         """Hold dispatch (drain-style maintenance and deterministic tests);
@@ -252,8 +298,17 @@ class MicroBatcher:
             if popped is None:
                 return
             key, reqs, deadline_flush = popped
+            t0 = monotime()
             try:
-                self._dispatch(key, reqs, deadline_flush)
+                served = self._dispatch(key, reqs, deadline_flush)
+                # only rows the backend actually SERVED feed the rate:
+                # a shed/failed flush "completes" in microseconds and
+                # would inflate the EWMA by orders of magnitude, turning
+                # retry_after_s into a hot-retry hint during the exact
+                # incidents it exists for (dispatchers return None for
+                # flushes that did no device work)
+                if isinstance(served, int) and served > 0:
+                    self._observe_service(served, monotime() - t0)
             except BaseException as e:  # noqa: BLE001 — fan the error out
                 err = e if isinstance(e, ServeError) else DispatchError(key, e)
                 n = 0

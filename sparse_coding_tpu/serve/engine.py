@@ -106,6 +106,56 @@ def op_width(entry: RegistryEntry, op: str) -> int:
     return entry.n_feats if op == "decode" else entry.d_activation
 
 
+def prepare_request(entry: RegistryEntry, op: str, ops: Sequence[str],
+                    buckets: Sequence[int], np_dtype,
+                    x) -> tuple[np.ndarray, int, bool]:
+    """Validate and canonicalize one request payload — the SINGLE home of
+    the submit-time contract, shared by the engine and the gateway front
+    door so the two can never drift. Returns ``(arr, rows, squeeze)``
+    with ``arr`` always [rows, width]."""
+    if op not in ops:
+        raise ValueError(f"op {op!r} not served (engine ops: {tuple(ops)})")
+    arr = np.asarray(x, dtype=np_dtype)
+    squeeze = arr.ndim == 1
+    if squeeze:
+        arr = arr[None, :]
+    if arr.ndim != 2:
+        raise ValueError(f"request must be 1-D or 2-D, got shape "
+                         f"{arr.shape}")
+    width = op_width(entry, op)
+    if arr.shape[1] != width:
+        raise ValueError(
+            f"{entry.name!r}/{op}: expected width {width}, got "
+            f"{arr.shape[1]}")
+    rows = arr.shape[0]
+    if rows == 0:
+        raise ValueError("empty request")
+    if rows > buckets[-1]:
+        raise RequestTooLargeError(rows, buckets[-1])
+    return arr, rows, squeeze
+
+
+def fanout_results(requests: list[Request], host, rows_axis: int,
+                   on_latency=None) -> None:
+    """Slice one dispatched batch's host result tree back to its
+    requests (in queue order) and resolve their futures; shared by the
+    engine dispatch and the gateway dispatch. ``on_latency(request,
+    seconds)`` fires per request before its future resolves."""
+    now = monotime()
+    ofs = 0
+    for r in requests:
+        sl = ((slice(None),) * rows_axis
+              + (slice(ofs, ofs + r.rows),))
+        res = jax.tree.map(lambda a: a[sl], host)
+        if r.squeeze:
+            sq = (slice(None),) * rows_axis + (0,)
+            res = jax.tree.map(lambda a: a[sq], res)
+        ofs += r.rows
+        if on_latency is not None:
+            on_latency(r, now - r.t_submit)
+        r.future._set_result(res)
+
+
 def build_bucket_program(entry: RegistryEntry, op: str, bucket: int,
                          dtype, topk_k: int):
     """(fn, input spec) for one (entry, op, bucket) program — the exact
@@ -118,6 +168,24 @@ def build_bucket_program(entry: RegistryEntry, op: str, bucket: int,
     spec = jax.ShapeDtypeStruct((bucket, op_width(entry, op)),
                                 jnp.dtype(dtype))
     return fn, spec
+
+
+class ProgramCache:
+    """Compiled-executable table, shareable between engines.
+
+    Engines serving the SAME registry (a gateway's replica pool) compile
+    IDENTICAL (model, op, bucket) programs — same lowered text, same
+    xcache key. Sharing one table means N in-process replicas hold one
+    executable instance instead of N deserialized clones: less memory,
+    and a warm spare activates by table lookup with zero loads and zero
+    compiles (cross-process restarts still load from the xcache store).
+    Executables are immutable and thread-safe to share; per-key locks
+    keep parallel warmup compiles from duplicating work."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.compiled: dict[tuple, Any] = {}
+        self.key_locks: dict[tuple, threading.Lock] = {}
 
 
 class ServingEngine:
@@ -144,7 +212,8 @@ class ServingEngine:
                  dispatch_retries: int = 2,
                  stream_retry_budget: int = 16,
                  retry_backoff_s: float = 0.002,
-                 warmup_workers: int | None = None):
+                 warmup_workers: int | None = None,
+                 program_cache: ProgramCache | None = None):
         if not buckets or list(buckets) != sorted(set(buckets)):
             raise ValueError(f"buckets must be unique ascending: {buckets}")
         self._registry = registry
@@ -173,13 +242,13 @@ class ServingEngine:
             reset_timeout_s=breaker_reset_s)
         # mirror every breaker transition into the metrics snapshot
         self._breaker.set_on_transition(self.metrics.record_breaker_transition)
-        self._compiled: dict[tuple, Any] = {}
-        # per-key locks (allocated under _compile_lock) rather than one
+        # per-key locks (allocated under the cache lock) rather than one
         # global compile lock: warmup fans compiles out over a thread
         # pool, and XLA releases the GIL while compiling — serializing on
-        # one lock would quietly undo the parallelism
-        self._compile_lock = threading.Lock()
-        self._key_locks: dict[tuple, threading.Lock] = {}
+        # one lock would quietly undo the parallelism. The table itself
+        # may be SHARED across a replica pool (see ProgramCache).
+        self._programs = (program_cache if program_cache is not None
+                          else ProgramCache())
         self._warmup_workers = (max(1, int(warmup_workers))
                                 if warmup_workers is not None
                                 else min(8, os.cpu_count() or 2))
@@ -210,7 +279,7 @@ class ServingEngine:
                 for name in self._registry.names()
                 for op in self._ops
                 for bucket in self._buckets
-                if (name, op, bucket) not in self._compiled]
+                if (name, op, bucket) not in self._programs.compiled]
         workers = (max(1, int(max_workers)) if max_workers is not None
                    else self._warmup_workers)
         workers = min(workers, len(todo)) if todo else 1
@@ -227,6 +296,61 @@ class ServingEngine:
                                for key in todo]
                     for f in futures:
                         f.result()  # propagate the first compile failure
+        self._warmed = True
+        return len(todo)
+
+    def warmup_from_manifest(self, manifest=None,
+                             max_workers: int | None = None) -> int:
+        """Warm exactly the program set the xcache warmup manifest
+        records (docs/ARCHITECTURE.md §13) — how a SPARE engine activates
+        at zero compiles: ``warmup.json`` is the durable statement of the
+        full warm set a deployment needs, and with the executable cache
+        enabled every listed program loads instead of compiling.
+
+        ``manifest`` defaults to the active cache's; descriptors naming
+        models/ops/buckets this engine does not serve are skipped. With
+        no manifest (or an empty one) this falls back to the full
+        registry-product :meth:`warmup` — a spare must never admit
+        traffic cold just because the manifest is missing. Returns the
+        number of programs prepared."""
+        from sparse_coding_tpu import xcache as _xcache
+
+        if manifest is None:
+            cache = _xcache.active_cache()
+            manifest = cache.warmup if cache is not None else None
+        descs = manifest.descriptors(kind="serve") if manifest else []
+        names = set(self._registry.names())
+        matched = sorted({
+            (d["model"], d["op"], int(d["bucket"]))
+            for d in descs
+            if (d.get("model") in names and d.get("op") in self._ops
+                and int(d.get("bucket", -1)) in self._buckets)})
+        if not matched:
+            # no manifest, or none of its descriptors name programs THIS
+            # engine serves (foreign deployment sharing the cache dir,
+            # renamed models): warm the full registry product — a spare
+            # must never admit traffic cold because the manifest had
+            # nothing useful to say about it
+            return self.warmup(max_workers=max_workers)
+        todo = [key for key in matched
+                if key not in self._programs.compiled]
+        workers = (max(1, int(max_workers)) if max_workers is not None
+                   else self._warmup_workers)
+        workers = min(workers, len(todo)) if todo else 1
+        with obs.span("serve.warmup", programs=len(todo), workers=workers,
+                      source="manifest"):
+            if workers <= 1:
+                for key in todo:
+                    self._get_compiled(*key, count_miss=False)
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    futures = [pool.submit(self._get_compiled, *key,
+                                           count_miss=False)
+                               for key in todo]
+                    for f in futures:
+                        f.result()
         self._warmed = True
         return len(todo)
 
@@ -254,9 +378,6 @@ class ServingEngine:
         :class:`QueueFullError` under backpressure and
         :class:`RequestTooLargeError` past the largest bucket."""
         entry = self._registry.get(model)
-        if op not in self._ops:
-            raise ValueError(f"op {op!r} not served (engine ops: "
-                             f"{self._ops})")
         if not self._breaker.admission_allowed():
             # graceful load shedding: while the circuit is open there is
             # no point queueing work behind a sick backend — refuse at
@@ -264,23 +385,9 @@ class ServingEngine:
             self.metrics.record_shed()
             raise CircuitOpenError((model, op),
                                    self._breaker.seconds_until_probe())
-        arr = np.asarray(x, dtype=self._np_dtype)
-        squeeze = arr.ndim == 1
-        if squeeze:
-            arr = arr[None, :]
-        if arr.ndim != 2:
-            raise ValueError(f"request must be 1-D or 2-D, got shape "
-                             f"{arr.shape}")
-        width = self._op_width(entry, op)
-        if arr.shape[1] != width:
-            raise ValueError(
-                f"{model!r}/{op}: expected width {width}, got "
-                f"{arr.shape[1]}")
-        rows = arr.shape[0]
-        if rows == 0:
-            raise ValueError("empty request")
-        if rows > self._buckets[-1]:
-            raise RequestTooLargeError(rows, self._buckets[-1])
+        arr, rows, squeeze = prepare_request(entry, op, self._ops,
+                                             self._buckets, self._np_dtype,
+                                             x)
         req = Request(key=(model, op), x=arr, rows=rows, squeeze=squeeze,
                       t_submit=monotime())
         return self._batcher.submit(req)
@@ -299,7 +406,7 @@ class ServingEngine:
     def stats(self) -> dict:
         snap = self.metrics.snapshot()
         snap["warmed"] = self._warmed
-        snap["compiled_programs"] = len(self._compiled)
+        snap["compiled_programs"] = len(self._programs.compiled)
         snap["breaker"] = self._breaker.snapshot()
         return snap
 
@@ -335,21 +442,22 @@ class ServingEngine:
     def _get_compiled(self, model: str, op: str, bucket: int,
                       count_miss: bool = True):
         key = (model, op, bucket)
-        compiled = self._compiled.get(key)
+        programs = self._programs
+        compiled = programs.compiled.get(key)
         if compiled is None:
-            with self._compile_lock:
-                compiled = self._compiled.get(key)
+            with programs.lock:
+                compiled = programs.compiled.get(key)
                 if compiled is not None:
                     return compiled
-                lock = self._key_locks.setdefault(key, threading.Lock())
+                lock = programs.key_locks.setdefault(key, threading.Lock())
             with lock:
-                compiled = self._compiled.get(key)
+                compiled = programs.compiled.get(key)
                 if compiled is None:
                     if self._warmed and count_miss:
                         self.metrics.record_recompile(key)
                     compiled = self._compile(self._registry.get(model), op,
                                              bucket, model)
-                    self._compiled[key] = compiled
+                    programs.compiled[key] = compiled
         return compiled
 
     # -- dispatch (runs on the batcher worker thread) ------------------------
@@ -404,15 +512,21 @@ class ServingEngine:
                 r.future._set_error(err)
 
     def _dispatch(self, key: tuple, requests: list[Request],
-                  deadline_flush: bool) -> None:
+                  deadline_flush: bool) -> int | None:
+        """Returns rows served (the batcher's service-rate input), None
+        for a shed or failed flush."""
         model, op = key
-        if not self._breaker.allow():
+        # the admission token identifies THIS dispatch to the breaker: a
+        # half-open probe's outcome is honored only when reported with
+        # its own token, so a raced stale dispatch can't fake-heal it
+        token = self._breaker.allow()
+        if not token:
             # fail-fast drain while the circuit is open: the queue keeps
             # moving (no wedge) and nothing touches the sick backend
             self.metrics.record_shed(len(requests))
             self._fail_requests(requests, CircuitOpenError(
                 key, self._breaker.seconds_until_probe()))
-            return
+            return None
         rows = sum(r.rows for r in requests)
         if len(requests) == 1:
             x = requests[0].x
@@ -432,25 +546,18 @@ class ServingEngine:
                     self.metrics.record_dispatch_retry()
                     time.sleep(self._retry_backoff_s * attempt)
                     continue
-                self._breaker.record_failure()
+                self._breaker.record_failure(token)
                 self.metrics.record_dispatch_failure()
                 err = e if isinstance(e, ServeError) else DispatchError(key, e)
                 self._fail_requests(requests, err)
-                return
-        self._breaker.record_success()
+                return None
+        self._breaker.record_success(token)
         self._refill_retry_budget(key)
         self.metrics.record_batch(bucket, len(requests), rows,
                                   deadline_flush)
         rows_axis = 1 if self._registry.get(model).is_stack else 0
-        now = monotime()
-        ofs = 0
-        for r in requests:
-            sl = ((slice(None),) * rows_axis
-                  + (slice(ofs, ofs + r.rows),))
-            res = jax.tree.map(lambda a: a[sl], host)
-            if r.squeeze:
-                sq = (slice(None),) * rows_axis + (0,)
-                res = jax.tree.map(lambda a: a[sq], res)
-            ofs += r.rows
-            self.metrics.record_latency(bucket, now - r.t_submit)
-            r.future._set_result(res)
+        fanout_results(
+            requests, host, rows_axis,
+            on_latency=lambda r, lat: self.metrics.record_latency(bucket,
+                                                                  lat))
+        return rows

@@ -1,0 +1,714 @@
+"""Self-healing serving gateway: replica pools, failover, hedging, SLO.
+
+One :class:`~sparse_coding_tpu.serve.engine.ServingEngine` is a solid
+single replica — AOT bucket programs, a breaker, typed backpressure —
+but a single replica is not a front door: one sick backend takes the
+whole service down, and there is no notion of request priority,
+per-request deadline, or failover (ROADMAP item 2). The gateway makes
+every failure mode a handled, observable path:
+
+- **replica pools with health scoring** — the gateway owns N engine
+  replicas over one shared :class:`ModelRegistry`. Each replica gets its
+  own :class:`~sparse_coding_tpu.resilience.breaker.CircuitBreaker`
+  (probe-token API: a raced stale outcome can never fake-heal it) plus
+  an EWMA health score (serve/health.py) fed by every dispatch outcome.
+  Routing is health-ordered; a failed dispatch **fails over** to the
+  next-healthiest replica inside the same flush, so one replica dying
+  loses zero admitted requests.
+- **warm spares** — a replica whose breaker opens is drained and
+  replaced by a spare activated at ZERO backend compiles: the xcache
+  warmup manifest (``warmup.json``, docs/ARCHITECTURE.md §13) tells the
+  spare the full warm set, and every program loads from the executable
+  store before the spare admits traffic. Activation is fault-injectable
+  (``gateway.spare.activate``) and crash-barriered at the worst instant
+  (warm set loaded, traffic not yet admitted).
+- **request hedging** — when a dispatched flush exceeds the bucket's
+  observed p95 (the gateway's own dispatch histograms), the same padded
+  batch fires at the next-healthiest replica and the first result wins.
+  Losers are not cancelled (XLA executions cannot be) but their cost is
+  counted: ``gateway.hedges_fired`` / ``hedges_won`` (hedge returned
+  first) / ``hedges_wasted`` (primary won after all).
+- **SLO admission** — requests carry a priority class
+  (interactive / batch / scavenger) and an optional deadline; admission
+  sheds scavenger-first via the brownout ladder (serve/slo.py), with a
+  closed-loop controller widening/narrowing from the observed p99.
+  Sheds reuse the typed ``QueueFullError`` (now with ``retry_after_s``)
+  / ``CircuitOpenError`` contracts.
+
+Every routing/hedge/activation decision point is a named fault site
+(``gateway.route``, ``gateway.hedge``, ``gateway.spare.activate`` —
+docs/ARCHITECTURE.md §10/§14) with deterministic fault-matrix entries in
+tests/test_resilience.py; the kill-a-replica drill and the
+SIGKILL-mid-activation chaos case live in tests/test_serve_gateway.py.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import deque
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from concurrent.futures import wait as futures_wait
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from sparse_coding_tpu import obs
+from sparse_coding_tpu.obs import monotime
+from sparse_coding_tpu.resilience.breaker import CircuitBreaker
+from sparse_coding_tpu.resilience.crash import (
+    crash_barrier,
+    register_crash_site,
+)
+from sparse_coding_tpu.resilience.faults import (
+    fault_point,
+    register_fault_site,
+)
+from sparse_coding_tpu.serve.batching import (
+    CircuitOpenError,
+    DispatchError,
+    MicroBatcher,
+    QueueFullError,
+    Request,
+    ServeFuture,
+)
+from sparse_coding_tpu.serve.engine import (
+    DEFAULT_BUCKETS,
+    DEFAULT_OPS,
+    ProgramCache,
+    ServingEngine,
+    fanout_results,
+    prepare_request,
+)
+from sparse_coding_tpu.serve.health import EwmaHealth
+from sparse_coding_tpu.serve.metrics import ServingMetrics
+from sparse_coding_tpu.serve.registry import ModelRegistry
+from sparse_coding_tpu.serve.slo import (
+    BATCH,
+    PRIORITIES,
+    AdmissionController,
+    windowed_quantile,
+)
+
+register_fault_site("gateway.route",
+                    "gateway dispatch — transport/decision point "
+                    "immediately before one replica attempt")
+register_fault_site("gateway.hedge",
+                    "gateway hedging — immediately before firing the "
+                    "hedge dispatch at the next-healthiest replica")
+register_fault_site("gateway.spare.activate",
+                    "warm-spare activation — before the manifest-driven "
+                    "warm set loads")
+register_crash_site("gateway.spare.activate",
+                    "warm spare fully loaded from the executable store, "
+                    "not yet admitted to the routing set")
+
+ACTIVE = "active"
+DRAINING = "draining"
+SPARE = "spare"
+
+
+@dataclass
+class GatewayRequest(Request):
+    """One admitted front-door request: a :class:`Request` carrying its
+    SLO contract (priority class + optional deadline)."""
+
+    priority: str = BATCH
+    deadline_s: Optional[float] = None
+
+
+class Replica:
+    """One pool member: an engine plus ITS OWN breaker + health score.
+
+    The engine's internal breaker/batcher are idle here — the gateway
+    owns coalescing and dispatches through ``run_padded`` directly, so
+    per-replica failure accounting lives at the gateway layer where the
+    routing decision is made."""
+
+    def __init__(self, name: str, engine: ServingEngine, state: str,
+                 breaker_threshold: int, breaker_reset_s: float,
+                 health_alpha: float, health_latency_scale_s: float,
+                 clock=None):
+        self.name = name
+        self.engine = engine
+        self.state = state
+        self._breaker_kwargs = dict(
+            failure_threshold=breaker_threshold,
+            reset_timeout_s=breaker_reset_s)
+        if clock is not None:
+            self._breaker_kwargs["clock"] = clock
+        self._health_kwargs = dict(
+            alpha=health_alpha, latency_scale_s=health_latency_scale_s)
+        self.breaker = CircuitBreaker(**self._breaker_kwargs)
+        self.health = EwmaHealth(**self._health_kwargs)
+
+    def reset(self) -> None:
+        """Fresh breaker + health (reinstating a drained replica): the
+        old instance's history describes the FAILED incarnation."""
+        self.breaker = CircuitBreaker(**self._breaker_kwargs)
+        self.health = EwmaHealth(**self._health_kwargs)
+
+    def snapshot(self) -> dict:
+        return {"state": self.state,
+                "breaker": self.breaker.snapshot(),
+                "health": self.health.snapshot(),
+                "recompiles": self.engine.metrics.recompiles}
+
+
+class _Attempt:
+    """One replica dispatch attempt: the breaker admission token plus
+    the ``abandoned`` flag a charged timeout sets — once an attempt has
+    been charged as its replica's failure, its eventual late resolution
+    must not touch the breaker (a late success would reset the failure
+    streak and keep a consistently-past-deadline replica permanently
+    routable)."""
+
+    __slots__ = ("rep", "token", "abandoned")
+
+    def __init__(self, rep: Replica, token):
+        self.rep = rep
+        self.token = token
+        self.abandoned = False
+
+
+class ServingGateway:
+    """Front door over a pool of :class:`ServingEngine` replicas.
+
+    ``submit(model, x, op, priority, deadline_s)`` admits through the
+    SLO ladder into ONE gateway-owned micro-batching queue; the dispatch
+    worker routes each coalesced flush to the healthiest admitting
+    replica with failover + hedging. ``warmup()`` warms every ACTIVE
+    replica (spares stay cold in memory — their executables are already
+    durable in the xcache store, which is exactly what makes activation
+    free). ``maintain()`` runs the self-healing pass (drain opened
+    replicas, activate spares); it also runs automatically after every
+    flush."""
+
+    def __init__(self, registry: ModelRegistry,
+                 n_replicas: int = 2,
+                 n_spares: int = 1,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 ops: Sequence[str] = DEFAULT_OPS,
+                 max_wait_ms: float = 2.0,
+                 max_queue_rows: int = 8192,
+                 breaker_threshold: int = 5,
+                 breaker_reset_s: float = 5.0,
+                 health_alpha: float = 0.2,
+                 health_latency_scale_s: float = 0.05,
+                 hedge_after_s: Optional[float] = None,
+                 hedge_min_samples: int = 20,
+                 dispatch_timeout_s: float = 60.0,
+                 admission: Optional[AdmissionController] = None,
+                 admission_window: int = 512,
+                 metrics_registry=None,
+                 breaker_clock=None,
+                 engine_kwargs: Optional[dict] = None):
+        if n_replicas < 1:
+            raise ValueError("need at least one active replica")
+        if n_spares < 0:
+            raise ValueError("n_spares must be >= 0")
+        self._registry = registry
+        self._buckets = tuple(int(b) for b in buckets)
+        self._ops = tuple(ops)
+        self._max_queue_rows = int(max_queue_rows)
+        self._hedge_after_s = hedge_after_s
+        self._hedge_min_samples = int(hedge_min_samples)
+        if dispatch_timeout_s <= 0:
+            raise ValueError("dispatch_timeout_s must be > 0")
+        self._dispatch_timeout_s = float(dispatch_timeout_s)
+        self._admission = admission if admission is not None \
+            else AdmissionController()
+        # the closed loop must see RECENT latency, not all-time history:
+        # a cumulative histogram's p99 would hold the brownout ladder up
+        # for tens of thousands of requests after an incident ends.
+        # Appended only on the dispatch worker thread.
+        self._recent_lat: deque = deque(maxlen=max(16,
+                                                   int(admission_window)))
+        self.metrics = ServingMetrics(registry=metrics_registry)
+        self._reg = self.metrics.registry
+        ekw = dict(engine_kwargs or {})
+        ekw.setdefault("buckets", self._buckets)
+        ekw.setdefault("ops", self._ops)
+        # one executable table for the whole pool: replicas of one
+        # registry compile identical programs, so N replicas (and the
+        # warm spare) share ONE executable instance per (model, op,
+        # bucket) — a spare activation is a table lookup in-process, and
+        # a restarted process still loads from the xcache store
+        ekw.setdefault("program_cache", ProgramCache())
+        self._np_dtype = None  # set from the first replica below
+        self._replicas: dict[str, Replica] = {}
+        self._order: list[str] = []  # construction order (stable tiebreak)
+        for i in range(n_replicas + n_spares):
+            name = (f"replica-{i}" if i < n_replicas
+                    else f"spare-{i - n_replicas}")
+            engine = ServingEngine(registry, **ekw)
+            if self._np_dtype is None:
+                self._np_dtype = engine._np_dtype
+            self._replicas[name] = Replica(
+                name, engine,
+                ACTIVE if i < n_replicas else SPARE,
+                breaker_threshold, breaker_reset_s,
+                health_alpha, health_latency_scale_s,
+                clock=breaker_clock)
+            self._order.append(name)
+        self._pool_lock = threading.Lock()
+        # sized past 2 because a HUNG dispatch (wedged tunnel: blocks,
+        # never raises) cannot be cancelled and holds its worker until
+        # the backend answers. The dispatch timeout below records such a
+        # replica as failing, so its breaker opens and routing stops
+        # feeding it — hung workers stay bounded by the failure
+        # threshold plus stray hedges, well under this cap.
+        self._hedge_pool = ThreadPoolExecutor(
+            max_workers=max(4, 2 * (n_replicas + n_spares)),
+            thread_name_prefix="gateway-dispatch")
+        self._batcher = MicroBatcher(
+            dispatch=self._dispatch,
+            max_rows_per_batch=self._buckets[-1],
+            max_wait_s=max_wait_ms / 1e3,
+            max_queue_rows=self._max_queue_rows,
+            metrics=self.metrics)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def warmup(self, max_workers: int | None = None) -> int:
+        """AOT compile-or-load every active replica's full program set
+        (spares warm on activation from the manifest). Returns the total
+        number of programs prepared across replicas."""
+        total = 0
+        with obs.span("gateway.warmup",
+                      replicas=len(self._active_replicas())):
+            for rep in self._active_replicas():
+                total += rep.engine.warmup(max_workers=max_workers)
+        return total
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._batcher.shutdown(wait=wait)
+        self._hedge_pool.shutdown(wait=wait)
+        for rep in self._replicas.values():
+            rep.engine.shutdown(wait=wait)
+
+    def pause(self) -> None:
+        """Hold gateway dispatch (deterministic tests / maintenance);
+        submissions still admit, enqueue, and backpressure."""
+        self._batcher.pause()
+
+    def resume(self) -> None:
+        self._batcher.resume()
+
+    def __enter__(self) -> "ServingGateway":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- pool views ----------------------------------------------------------
+
+    def _active_replicas(self) -> list[Replica]:
+        return [self._replicas[n] for n in self._order
+                if self._replicas[n].state == ACTIVE]
+
+    def _spare_replicas(self) -> list[Replica]:
+        return [self._replicas[n] for n in self._order
+                if self._replicas[n].state == SPARE]
+
+    def _routing_order(self) -> list[Replica]:
+        """Health-weighted routing: active replicas, healthiest first
+        (construction order breaks exact ties, so routing is
+        deterministic under deterministic traffic)."""
+        actives = self._active_replicas()
+        idx = {n: i for i, n in enumerate(self._order)}
+        return sorted(actives,
+                      key=lambda r: (-r.health.score, idx[r.name]))
+
+    def replica(self, name: str) -> Replica:
+        return self._replicas[name]
+
+    def replica_names(self) -> list[str]:
+        return list(self._order)
+
+    # -- request path --------------------------------------------------------
+
+    def submit(self, model: str, x, op: str = "encode",
+               priority: str = BATCH,
+               deadline_s: Optional[float] = None) -> ServeFuture:
+        """Admit one request through the SLO ladder and enqueue it.
+        Raises typed sheds: :class:`QueueFullError` (brownout ladder,
+        deadline, queue pressure — with ``retry_after_s``) or
+        :class:`CircuitOpenError` (no replica currently admits)."""
+        if priority not in PRIORITIES:
+            raise ValueError(f"unknown priority {priority!r} "
+                             f"(supported: {PRIORITIES})")
+        entry = self._registry.get(model)
+        actives = self._active_replicas()
+        admitting = [r for r in actives if r.breaker.admission_allowed()]
+        if not admitting:
+            self._record_shed(priority)
+            cooldown = min((r.breaker.seconds_until_probe()
+                            for r in actives), default=0.0)
+            raise CircuitOpenError((model, op), cooldown)
+        arr, rows, squeeze = prepare_request(entry, op, self._ops,
+                                             self._buckets, self._np_dtype,
+                                             x)
+        try:
+            self._admission.admit(
+                priority, deadline_s,
+                queued_rows=self._batcher.queued_rows,
+                max_queue_rows=self._max_queue_rows,
+                predicted_wait_s=self._batcher.predicted_wait_s(rows))
+        except QueueFullError:
+            self._record_shed(priority)
+            raise
+        req = GatewayRequest(key=(model, op), x=arr, rows=rows,
+                             squeeze=squeeze, t_submit=monotime(),
+                             priority=priority, deadline_s=deadline_s)
+        try:
+            return self._batcher.submit(req)
+        except QueueFullError:
+            # hard backpressure is also a shed, just the last-resort rung
+            self._reg.counter("gateway.shed", priority=priority).inc()
+            raise
+
+    def query(self, model: str, x, op: str = "encode",
+              priority: str = BATCH, deadline_s: Optional[float] = None,
+              timeout: float | None = 60.0):
+        """Blocking submit+result."""
+        return self.submit(model, x, op=op, priority=priority,
+                           deadline_s=deadline_s).result(timeout=timeout)
+
+    def _record_shed(self, priority: str) -> None:
+        self.metrics.record_shed()
+        self._reg.counter("gateway.shed", priority=priority).inc()
+
+    # -- dispatch (gateway batcher worker thread) ----------------------------
+
+    def _run_one(self, attempt: "_Attempt", model: str, op: str, x):
+        """One replica attempt: timed, breaker- and health-accounted.
+        Success/failure is recorded HERE so hedge losers that finish
+        after the winner still update their replica's score — UNLESS the
+        attempt was abandoned by a charged timeout: a late success must
+        not reset the breaker's failure streak (a replica consistently
+        finishing just past the deadline would otherwise never open,
+        never drain, and slowly park every pool worker)."""
+        rep = attempt.rep
+        t0 = monotime()
+        try:
+            bucket, host = rep.engine.run_padded(model, op, x)
+        except BaseException:
+            dur = monotime() - t0
+            rep.health.record(dur, ok=False)
+            if attempt.abandoned:
+                self._reg.counter("gateway.late_results",
+                                  replica=rep.name).inc()
+            else:
+                rep.breaker.record_failure(attempt.token)
+                self._reg.counter("gateway.replica_errors",
+                                  replica=rep.name).inc()
+            raise
+        dur = monotime() - t0
+        # health always learns the TRUE latency (late = slow = low score)
+        rep.health.record(dur, ok=True)
+        if attempt.abandoned:
+            self._reg.counter("gateway.late_results",
+                              replica=rep.name).inc()
+            return bucket, host
+        rep.breaker.record_success(attempt.token)
+        self._reg.counter("gateway.routes", replica=rep.name).inc()
+        self._reg.histogram("gateway.dispatch_s", bucket=bucket).observe(dur)
+        return bucket, host
+
+    def configure_hedging(self, hedge_after_s: Optional[float]) -> None:
+        """Operator knob: explicit hedge trigger override in seconds
+        (0.0 hedges every flush, a large value effectively disables);
+        ``None`` restores the observed-p95 default."""
+        self._hedge_after_s = hedge_after_s
+
+    def _hedge_deadline_s(self, rows: int) -> Optional[float]:
+        """When to hedge a flush of ``rows`` rows: the explicit override
+        if configured, else the observed p95 of its bucket's dispatch
+        wall (None — no hedging — until enough samples exist)."""
+        if self._hedge_after_s is not None:
+            return self._hedge_after_s
+        i = bisect.bisect_left(self._buckets, rows)
+        if i == len(self._buckets):
+            return None
+        h = self._reg.histogram("gateway.dispatch_s",
+                                bucket=self._buckets[i])
+        if h.count < self._hedge_min_samples:
+            return None
+        return h.quantile(0.95)
+
+    def _timeout_failure(self, attempt: "_Attempt") -> TimeoutError:
+        """A dispatch that neither returned nor raised within the budget
+        is a failure of ITS replica: a hung backend (wedged tunnel)
+        blocks forever instead of erroring, and without this its breaker
+        would never open and routing would keep feeding it. The call
+        itself cannot be cancelled — its worker is abandoned (pool is
+        sized for that) and the attempt is MARKED abandoned so its
+        eventual resolution cannot touch the breaker."""
+        attempt.abandoned = True
+        attempt.rep.breaker.record_failure(attempt.token)
+        attempt.rep.health.record(self._dispatch_timeout_s, ok=False)
+        self._reg.counter("gateway.dispatch_timeouts",
+                          replica=attempt.rep.name).inc()
+        return TimeoutError(
+            f"replica {attempt.rep.name} dispatch exceeded "
+            f"{self._dispatch_timeout_s}s (hung backend?)")
+
+    def _bounded_result(self, fut, attempt: "_Attempt", t_end: float):
+        try:
+            return fut.result(timeout=max(0.0, t_end - monotime()))
+        except FutureTimeoutError:
+            raise self._timeout_failure(attempt) from None
+
+    def _hedged_run(self, attempt: "_Attempt", backups: list[Replica],
+                    model: str, op: str, x, rows: int):
+        """Primary dispatch with p95-triggered hedging; first success
+        wins. Every wait is bounded by ``dispatch_timeout_s``: a hung
+        participant is recorded as that replica's failure and the caller
+        fails over — a wedged backend degrades the pool, never wedges
+        the gateway. Raises only when every participant failed or timed
+        out."""
+        t_end = monotime() + self._dispatch_timeout_s
+        fut = self._hedge_pool.submit(self._run_one, attempt, model, op, x)
+        deadline = self._hedge_deadline_s(rows)
+        if deadline is None or not backups:
+            return self._bounded_result(fut, attempt, t_end)
+        try:
+            return fut.result(
+                timeout=min(deadline, max(0.0, t_end - monotime())))
+        except FutureTimeoutError:
+            if monotime() >= t_end:
+                raise self._timeout_failure(attempt) from None
+            # primary is slow, not failed (nor timed out yet): hedge it
+        hedge = None
+        for rep in backups:
+            tok = rep.breaker.allow()
+            if tok:
+                hedge = _Attempt(rep, tok)
+                break
+        if hedge is None:
+            return self._bounded_result(fut, attempt, t_end)
+        try:
+            fault_point("gateway.hedge")
+            hfut = self._hedge_pool.submit(self._run_one, hedge,
+                                           model, op, x)
+        except BaseException:  # noqa: BLE001 — hedging is best-effort
+            # a failed hedge FIRING must never fail the request: the
+            # primary is still running and remains the answer
+            self._reg.counter("gateway.hedges_abandoned").inc()
+            return self._bounded_result(fut, attempt, t_end)
+        self._reg.counter("gateway.hedges_fired").inc()
+        owners = {fut: attempt, hfut: hedge}
+        pending = {fut, hfut}
+        first_err: Optional[BaseException] = None
+        while pending:
+            done, pending = futures_wait(pending,
+                                         timeout=max(0.0,
+                                                     t_end - monotime()),
+                                         return_when=FIRST_COMPLETED)
+            if not done:
+                # overall budget exhausted with participant(s) hung:
+                # charge each hung replica, fail over
+                err: Optional[BaseException] = first_err
+                for f in pending:
+                    err = self._timeout_failure(owners[f])
+                raise err
+            for f in done:
+                if f.exception() is None:
+                    if f is hfut:
+                        self._reg.counter("gateway.hedges_won").inc()
+                    else:
+                        self._reg.counter("gateway.hedges_wasted").inc()
+                    # first-wins cancel semantics: the loser cannot be
+                    # cancelled mid-execution; its outcome is recorded
+                    # by _run_one when it finishes and then discarded
+                    return f.result()
+                if first_err is None:
+                    first_err = f.exception()
+        raise first_err  # both participants failed
+
+    def _dispatch(self, key: tuple, requests: list[Request],
+                  deadline_flush: bool) -> int | None:
+        """Returns rows served (the batcher's service-rate input), None
+        for a shed or failed flush."""
+        model, op = key
+        rows = sum(r.rows for r in requests)
+        if len(requests) == 1:
+            x = requests[0].x
+        else:
+            x = np.concatenate([r.x for r in requests], axis=0)
+        candidates = self._routing_order()
+        last_err: Optional[BaseException] = None
+        try:
+            for i, rep in enumerate(candidates):
+                token = rep.breaker.allow()
+                if not token:
+                    continue
+                try:
+                    fault_point("gateway.route")
+                except BaseException as e:  # noqa: BLE001 — typed below
+                    # a routing/transport failure counts against the
+                    # replica it was destined for
+                    rep.breaker.record_failure(token)
+                    rep.health.record(0.0, ok=False)
+                    self._reg.counter("gateway.route_errors").inc()
+                    last_err = e
+                    if i + 1 < len(candidates):
+                        self._reg.counter("gateway.failovers").inc()
+                    continue
+                try:
+                    bucket, host = self._hedged_run(
+                        _Attempt(rep, token), candidates[i + 1:], model,
+                        op, x, rows)
+                except BaseException as e:  # noqa: BLE001 — typed below
+                    last_err = e
+                    if i + 1 < len(candidates):
+                        self._reg.counter("gateway.failovers").inc()
+                    continue
+                self._finish_flush(key, requests, rows, bucket, host,
+                                   deadline_flush)
+                return rows
+            # every candidate refused or failed
+            self.metrics.record_dispatch_failure()
+            if last_err is None:
+                self.metrics.record_shed(len(requests))
+                err: Exception = CircuitOpenError(
+                    key, min((r.breaker.seconds_until_probe()
+                              for r in candidates), default=0.0))
+            else:
+                err = (last_err if isinstance(last_err, DispatchError)
+                       else DispatchError(key, last_err))
+            self.metrics.record_request_errors(len(requests),
+                                               type(err).__name__)
+            for r in requests:
+                if not r.future.done():
+                    r.future._set_error(err)
+            return None
+        finally:
+            self.maintain()
+
+    def _finish_flush(self, key, requests, rows, bucket, host,
+                      deadline_flush) -> None:
+        model, _ = key
+        self.metrics.record_batch(bucket, len(requests), rows,
+                                  deadline_flush)
+        rows_axis = 1 if self._registry.get(model).is_stack else 0
+
+        def on_latency(r, lat):
+            self.metrics.record_latency(bucket, lat)
+            self._reg.counter("gateway.served",
+                              priority=getattr(r, "priority", BATCH)).inc()
+            self._lat_hist().observe(lat)
+            self._recent_lat.append(lat)
+
+        fanout_results(requests, host, rows_axis, on_latency=on_latency)
+        # closed loop: feed the controller the RECENT pool-wide p99 (the
+        # all-time histogram would pin the ladder up long after an
+        # incident ends) and expose the resulting rung as a gauge
+        p99 = windowed_quantile(list(self._recent_lat), 0.99)
+        level = self._admission.observe_p99(
+            None if p99 is None else p99 * 1e3)
+        self._reg.gauge("gateway.admission_level").set(level)
+
+    def _lat_hist(self):
+        return self._reg.histogram("gateway.latency_s")
+
+    # -- self-healing --------------------------------------------------------
+
+    def maintain(self) -> list[str]:
+        """One self-healing pass: every ACTIVE replica whose breaker is
+        OPEN is drained and (when a spare exists) replaced by a warm
+        spare activated from the manifest. Runs after every flush and on
+        demand; returns the names of replicas drained this pass."""
+        drained: list[str] = []
+        with self._pool_lock:
+            for rep in self._active_replicas():
+                if rep.breaker.state != "open":
+                    continue
+                spare = next(iter(self._spare_replicas()), None)
+                if spare is None:
+                    self._reg.counter("gateway.spare_exhausted").inc()
+                    continue
+                if self._activate_spare(spare, replacing=rep):
+                    drained.append(rep.name)
+        return drained
+
+    def _activate_spare(self, spare: Replica, replacing: Replica) -> bool:
+        """Warm the spare from the xcache warmup manifest, then swap it
+        into the routing set in place of ``replacing``. On failure the
+        spare stays a spare (retried next maintain pass) and the pool
+        keeps serving on the surviving replicas — activation is never on
+        the failure path of in-flight traffic."""
+        try:
+            with obs.span("gateway.spare.activate", spare=spare.name,
+                          replacing=replacing.name):
+                fault_point("gateway.spare.activate")
+                programs = spare.engine.warmup_from_manifest()
+                # worst instant: the spare's full warm set is loaded (and
+                # any fresh compiles are durable in the store), but the
+                # routing swap below has not happened — a SIGKILL here
+                # must leave a restart that heals identically
+                crash_barrier("gateway.spare.activate")
+                spare.state = ACTIVE
+                replacing.state = DRAINING
+        except BaseException:  # noqa: BLE001 — activation is off-path
+            self._reg.counter("gateway.spare_activation_errors").inc()
+            return False
+        self._reg.counter("gateway.spare_activations").inc()
+        self._reg.counter("gateway.spare_programs_warmed").inc(programs)
+        return True
+
+    def reinstate(self, name: str) -> None:
+        """Ops hook: return a drained (repaired) replica to the pool as
+        a warm-spare candidate with a fresh breaker + health score."""
+        rep = self._replicas[name]
+        if rep.state != DRAINING:
+            raise ValueError(f"{name!r} is {rep.state}, not draining")
+        rep.reset()
+        rep.state = SPARE
+
+    # -- read side -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        """One coherent snapshot: the serving-metrics schema (buckets,
+        latency quantiles, queue, sheds) plus the gateway section —
+        per-replica breaker/health/state, hedge and failover counters,
+        admission ladder state."""
+        snap = self.metrics.snapshot()
+        c = self._reg.counter
+        snap["replicas"] = {n: self._replicas[n].snapshot()
+                            for n in self._order}
+        snap["admission"] = self._admission.snapshot()
+        snap["gateway"] = {
+            "hedges_fired": c("gateway.hedges_fired").value,
+            "hedges_won": c("gateway.hedges_won").value,
+            "hedges_wasted": c("gateway.hedges_wasted").value,
+            "hedges_abandoned": c("gateway.hedges_abandoned").value,
+            "failovers": c("gateway.failovers").value,
+            "route_errors": c("gateway.route_errors").value,
+            "dispatch_timeouts": {
+                n: c("gateway.dispatch_timeouts", replica=n).value
+                for n in self._order},
+            "replica_errors": {
+                n: c("gateway.replica_errors", replica=n).value
+                for n in self._order},
+            "routes": {n: c("gateway.routes", replica=n).value
+                       for n in self._order},
+            "spare_activations": c("gateway.spare_activations").value,
+            "spare_activation_errors":
+                c("gateway.spare_activation_errors").value,
+            "spare_exhausted": c("gateway.spare_exhausted").value,
+            "shed": {p: c("gateway.shed", priority=p).value
+                     for p in PRIORITIES},
+            "served": {p: c("gateway.served", priority=p).value
+                       for p in PRIORITIES},
+            "late_results": {
+                n: c("gateway.late_results", replica=n).value
+                for n in self._order},
+            # the controller is the source of truth (the gauge only
+            # refreshes per flush and would lag a set_level override)
+            "admission_level": self._admission.level,
+        }
+        return snap

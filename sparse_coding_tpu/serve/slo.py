@@ -1,0 +1,167 @@
+"""SLO-driven admission control for the serving gateway.
+
+At front-door scale, overload is a scheduling decision, not an accident:
+when demand exceeds capacity SOMETHING will not be served, and the only
+question is whether the victim is chosen (scavenger work, with a typed
+retry hint) or random (every caller times out together). This module
+makes the choice explicit:
+
+- **priority classes** — ``interactive`` (a human is waiting), ``batch``
+  (a job is waiting), ``scavenger`` (nobody is waiting). Requests carry
+  one; admission sheds scavenger-first.
+- **brownout ladder** — admission level 0 admits everything, level 1
+  sheds scavenger, level 2 sheds scavenger+batch. Interactive traffic is
+  never shed by the ladder — only by hard queue backpressure — which is
+  what lets the gateway promise "zero interactive requests lost" through
+  a replica failure (ISSUE 6 acceptance).
+- **closed-loop controller** — the gateway feeds its observed p99 after
+  every flush; sustained p99 above ``target_p99_ms`` climbs the ladder
+  one rung, sustained p99 below ``narrow_frac * target`` descends.
+  Adjustment is count-gated (``adjust_every`` observations between
+  moves), so the loop is deterministic under a deterministic load and
+  cannot flap on a single slow dispatch.
+- **deadline + queue-pressure sheds** — a request whose predicted wait
+  (queue depth x recent per-row service rate, from the micro-batcher)
+  already exceeds its deadline is refused NOW, not after it times out;
+  lower priorities are refused earlier on the queue-depth ramp
+  (``scavenger_depth_frac`` / ``batch_depth_frac`` of the hard cap).
+
+Sheds reuse the typed contracts callers already handle:
+:class:`~sparse_coding_tpu.serve.batching.QueueFullError` carrying
+``retry_after_s`` (the predicted drain time). Everything here is plain
+host Python with no clock reads — state advances only on ``observe_p99``
+/ ``admit`` calls, so tests drive it exactly.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+from sparse_coding_tpu.serve.batching import QueueFullError
+
+INTERACTIVE = "interactive"
+BATCH = "batch"
+SCAVENGER = "scavenger"
+PRIORITIES = (INTERACTIVE, BATCH, SCAVENGER)
+
+
+def windowed_quantile(samples, q: float):
+    """Nearest-rank quantile over a RECENT-sample window (the gateway's
+    rolling latency deque). The closed loop must read this, never a
+    cumulative histogram: all-time quantiles hold an incident's slow
+    tail in the p99 for tens of thousands of requests after recovery,
+    pinning the brownout ladder up. Returns None on an empty window."""
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    idx = min(len(ordered) - 1,
+              max(0, math.ceil(q * len(ordered)) - 1))
+    return ordered[idx]
+
+# admission level -> priorities the ladder sheds at that level
+_LADDER: dict[int, frozenset] = {
+    0: frozenset(),
+    1: frozenset({SCAVENGER}),
+    2: frozenset({SCAVENGER, BATCH}),
+}
+MAX_LEVEL = max(_LADDER)
+
+
+class AdmissionController:
+    """Brownout ladder + closed-loop p99 controller (gateway-owned)."""
+
+    def __init__(self, target_p99_ms: float = 100.0,
+                 narrow_frac: float = 0.5,
+                 adjust_every: int = 32,
+                 scavenger_depth_frac: float = 0.5,
+                 batch_depth_frac: float = 0.85):
+        if target_p99_ms <= 0:
+            raise ValueError("target_p99_ms must be > 0")
+        if not (0.0 < narrow_frac < 1.0):
+            raise ValueError("narrow_frac must be in (0, 1)")
+        if not (0.0 < scavenger_depth_frac <= batch_depth_frac <= 1.0):
+            raise ValueError("need 0 < scavenger_depth_frac <= "
+                             "batch_depth_frac <= 1")
+        self.target_p99_ms = float(target_p99_ms)
+        self._narrow_frac = float(narrow_frac)
+        self._adjust_every = max(1, int(adjust_every))
+        self._depth_frac = {SCAVENGER: float(scavenger_depth_frac),
+                            BATCH: float(batch_depth_frac),
+                            INTERACTIVE: 1.0}
+        self._lock = threading.Lock()
+        self._level = 0
+        self._since_change = 0
+        self._n_widened = 0
+        self._n_narrowed = 0
+
+    # -- closed loop ----------------------------------------------------------
+
+    @property
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def set_level(self, level: int) -> None:
+        """Operator override (drills, tests): pin the ladder rung."""
+        if level not in _LADDER:
+            raise ValueError(f"admission level must be in "
+                             f"{sorted(_LADDER)}, got {level}")
+        with self._lock:
+            self._level = level
+            self._since_change = 0
+
+    def observe_p99(self, p99_ms: float | None) -> int:
+        """Feed one p99 observation (the gateway calls this after every
+        flush with its latency histogram's current p99); returns the
+        possibly-adjusted level. Count-gated: at most one rung move per
+        ``adjust_every`` observations."""
+        with self._lock:
+            if p99_ms is None:
+                return self._level
+            self._since_change += 1
+            if self._since_change < self._adjust_every:
+                return self._level
+            if p99_ms > self.target_p99_ms and self._level < MAX_LEVEL:
+                self._level += 1
+                self._n_widened += 1
+                self._since_change = 0
+            elif (p99_ms < self.target_p99_ms * self._narrow_frac
+                    and self._level > 0):
+                self._level -= 1
+                self._n_narrowed += 1
+                self._since_change = 0
+            return self._level
+
+    # -- per-request admission ------------------------------------------------
+
+    def admit(self, priority: str, deadline_s: float | None,
+              queued_rows: int, max_queue_rows: int,
+              predicted_wait_s: float | None) -> None:
+        """Admit or raise a typed shed for one request. Shed reasons, in
+        check order: brownout ladder (priority shed at the current
+        level), queue-depth ramp (lower priorities refused earlier), and
+        deadline (predicted wait already exceeds it)."""
+        if priority not in PRIORITIES:
+            raise ValueError(f"unknown priority {priority!r} "
+                             f"(supported: {PRIORITIES})")
+        with self._lock:
+            shed_priorities = _LADDER[self._level]
+        if priority in shed_priorities:
+            raise QueueFullError(queued_rows, max_queue_rows,
+                                 predicted_wait_s)
+        if queued_rows > self._depth_frac[priority] * max_queue_rows:
+            raise QueueFullError(queued_rows, max_queue_rows,
+                                 predicted_wait_s)
+        if (deadline_s is not None and predicted_wait_s is not None
+                and predicted_wait_s > deadline_s):
+            raise QueueFullError(queued_rows, max_queue_rows,
+                                 predicted_wait_s)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"level": self._level,
+                    "target_p99_ms": self.target_p99_ms,
+                    "sheds_priorities": sorted(_LADDER[self._level]),
+                    "widened": self._n_widened,
+                    "narrowed": self._n_narrowed}

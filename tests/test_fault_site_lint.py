@@ -1,0 +1,104 @@
+"""Mechanical enforcement of fault-site test coverage: every
+``register_fault_site("<site>", ...)`` in `sparse_coding_tpu/` must have
+a matching deterministic entry in the fault-matrix suite
+(`tests/test_resilience.py` — the site name appearing as a string
+literal there, which is what every real matrix entry does via
+``inject(site="...")`` / plan strings), or carry an explicit
+``# lint: allow-unmatrixed-fault <why>`` escape hatch on the
+registration line. A fault site without a matrix entry is a failure
+path that ships untested — exactly the rot the injection harness exists
+to prevent (docs/ARCHITECTURE.md §10).
+
+A grep, not a dataflow analysis, by design (the raw-timer, atomic-write
+and bare-compile lints' pattern): the convention is cheap to follow —
+registering a site and writing its matrix case are one PR — and the
+false-positive escape hatch is explicit and reviewed.
+"""
+
+import re
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "sparse_coding_tpu"
+MATRIX = ROOT / "tests" / "test_resilience.py"
+
+# register_fault_site( "site.name"  — the literal-name form every host
+# module uses; a computed name cannot be linted and would be flagged by
+# review instead
+REGISTER = re.compile(r"register_fault_site\(\s*['\"]([\w.]+)['\"]")
+OPT_OUT = "# lint: allow-unmatrixed-fault"
+
+
+def _registered_sites(package: Path):
+    """(site, file:line, excused) for every literal registration."""
+    out = []
+    for path in sorted(package.rglob("*.py")):
+        text = path.read_text()
+        lines = text.splitlines()
+        for m in REGISTER.finditer(text):
+            lineno = text.count("\n", 0, m.start()) + 1
+            excused = OPT_OUT in lines[lineno - 1]
+            rel = path.relative_to(package.parent).as_posix()
+            out.append((m.group(1), f"{rel}:{lineno}", excused))
+    return out
+
+
+def _violations(package: Path = PACKAGE, matrix_text: str = None):
+    if matrix_text is None:
+        matrix_text = MATRIX.read_text()
+    hits = []
+    for site, where, excused in _registered_sites(package):
+        if excused:
+            continue
+        # a matrix entry names the site as a string literal (inject(
+        # site="..."), a compact plan "site:nth=..", or a docstring row)
+        if f'"{site}"' in matrix_text or f"'{site}'" in matrix_text \
+                or f"{site}:" in matrix_text:
+            continue
+        hits.append(f"{where}: fault site {site!r} has no entry in "
+                    f"tests/test_resilience.py")
+    return hits
+
+
+def test_every_registered_fault_site_has_a_matrix_entry():
+    hits = _violations()
+    assert not hits, (
+        "fault site(s) registered without a deterministic fault-matrix "
+        "entry — add an inject()-driven case to tests/test_resilience.py "
+        "proving the site's designed recovery, or append "
+        "'# lint: allow-unmatrixed-fault <why>' to the registration "
+        "line:\n" + "\n".join(hits))
+
+
+def test_lint_catches_a_planted_unmatrixed_site(tmp_path):
+    """The lint must actually bite: plant registrations in a scratch
+    tree against a scratch matrix and watch exactly the uncovered,
+    unexcused one get flagged."""
+    pkg = tmp_path / "sparse_coding_tpu"
+    (pkg / "serve").mkdir(parents=True)
+    (pkg / "serve" / "x.py").write_text(
+        'register_fault_site("covered.site",\n'
+        '                    "in the matrix")\n'
+        'register_fault_site("orphan.site",\n'
+        '                    "nobody tests me")\n'
+        'register_fault_site("excused.site",  '
+        '# lint: allow-unmatrixed-fault exercised in test_serve.py\n'
+        '                    "covered elsewhere")\n'
+        'site = register_crash_site("crash.only")  # not a fault site\n')
+    matrix = ('def test_covered():\n'
+              '    with inject(site="covered.site", nth=1):\n'
+              '        pass\n')
+    hits = _violations(pkg, matrix)
+    assert len(hits) == 1, hits
+    assert "orphan.site" in hits[0] and "x.py:3" in hits[0]
+
+
+def test_current_tree_sites_all_known():
+    """Sanity: the scan actually sees the live registrations (engine,
+    gateway, chunk store, checkpoint, xcache) — an empty scan would make
+    the coverage assertion vacuously green."""
+    sites = {s for s, _, _ in _registered_sites(PACKAGE)}
+    for expected in ("serve.dispatch", "gateway.route", "gateway.hedge",
+                     "gateway.spare.activate", "chunk.read", "chunk.write",
+                     "ckpt.save", "ckpt.restore", "xcache.load"):
+        assert expected in sites, (expected, sites)

@@ -173,7 +173,7 @@ def test_circuit_breaker_state_machine():
     t = {"now": 0.0}
     br = CircuitBreaker(failure_threshold=2, reset_timeout_s=10.0,
                         clock=lambda: t["now"])
-    assert br.allow() and br.state == "closed"
+    assert br.allow() is True and br.state == "closed"
     br.record_failure()
     assert br.state == "closed"  # below threshold
     br.record_failure()
@@ -181,16 +181,66 @@ def test_circuit_breaker_state_machine():
     assert not br.allow() and not br.admission_allowed()
     t["now"] = 11.0
     assert br.admission_allowed()
-    assert br.allow()  # the probe
+    probe = br.allow()  # the probe: a token, not a bare True
+    assert probe and probe is not True
     assert br.state == "half_open"
     assert not br.allow()  # only one probe in flight
-    br.record_failure()  # probe failed -> re-open, cooldown restarts
+    br.record_failure(probe)  # probe failed -> re-open, cooldown restarts
     assert br.state == "open" and not br.allow()
     t["now"] = 22.0
-    assert br.allow()
-    br.record_success()
+    probe2 = br.allow()
+    assert probe2 and probe2 is not True
+    br.record_success(probe2)
     assert br.state == "closed"
     assert br.snapshot()["transitions"] == [
+        "closed->open", "open->half_open", "half_open->open",
+        "open->half_open", "half_open->closed"]
+
+
+def test_breaker_raced_outcome_cannot_fake_heal_half_open():
+    """ISSUE 6 satellite regression: a dispatch admitted while the
+    circuit was CLOSED can finish during a later HALF_OPEN window; its
+    stale success must not close the circuit (nor clear the probe slot),
+    and its stale failure must not consume the probe — only the
+    token-holder's outcome moves the state machine. Deterministic
+    clock throughout."""
+    t = {"now": 0.0}
+    br = CircuitBreaker(failure_threshold=2, reset_timeout_s=10.0,
+                        clock=lambda: t["now"])
+    stale = br.allow()  # the raced dispatch, admitted while closed
+    assert stale is True
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "open"
+    t["now"] = 11.0
+    probe = br.allow()
+    assert probe is not True and br.state == "half_open"
+    # the raced dispatch finishes now, reporting its stale success
+    br.record_success(stale)
+    assert br.state == "half_open"  # NOT closed: no real probe succeeded
+    assert br.snapshot()["probe_in_flight"]  # probe slot NOT freed
+    assert not br.allow()  # still exactly one probe outstanding
+    # a raced token-less failure must not consume the probe either
+    br.record_failure()
+    assert br.state == "half_open"
+    # only the live probe's outcome decides
+    br.record_success(probe)
+    assert br.state == "closed"
+    # and a STALE probe token (prior half-open cycle) is also refused
+    br.record_failure()
+    br.record_failure()
+    t["now"] = 22.0
+    old_probe = br.allow()
+    br.record_failure(old_probe)  # re-open; old_probe is now dead
+    t["now"] = 33.0
+    new_probe = br.allow()
+    assert br.state == "half_open"
+    br.record_success(old_probe)  # zombie outcome from the dead cycle
+    assert br.state == "half_open"
+    br.record_success(new_probe)
+    assert br.state == "closed"
+    assert br.snapshot()["transitions"] == [
+        "closed->open", "open->half_open", "half_open->closed",
         "closed->open", "open->half_open", "half_open->open",
         "open->half_open", "half_open->closed"]
 
@@ -347,6 +397,30 @@ def test_ckpt_save_fault_leaves_previous_checkpoint_intact(rng, tmp_path):
             save_ensemble(ens, path, extra={"chunks_done": 2})
     fresh = _mk_ens(rng)
     meta = restore_ensemble(fresh, path)  # previous save still whole
+    assert meta["chunks_done"] == 1
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(fresh.state.params["encoder"])), want)
+
+
+def test_ckpt_restore_transient_fault_typed_then_recovers(rng, tmp_path):
+    """``ckpt.restore`` matrix entry (a coverage gap the fault-site lint
+    found): an injected I/O failure at the restore entry surfaces TYPED
+    to the caller — which is what lets ``resume_sweep_state`` decide
+    between retry and the ckpt_prev fallback — never a silent
+    from-scratch restart, and once the fault clears the same file
+    restores intact."""
+    ens = _mk_ens(rng)
+    ens.step_batch(jax.random.normal(rng, (64, 16)))
+    path = tmp_path / "ck.msgpack"
+    save_ensemble(ens, path, extra={"chunks_done": 1})
+    want = np.asarray(jax.device_get(ens.state.params["encoder"]))
+    with inject(site="ckpt.restore", nth=1, error="OSError") as plan:
+        with pytest.raises(OSError) as exc:
+            restore_ensemble(_mk_ens(rng), path)
+    assert isinstance(exc.value, InjectedFault)
+    assert plan.fired_count("ckpt.restore") == 1
+    fresh = _mk_ens(rng)
+    meta = restore_ensemble(fresh, path)  # fault cleared: file was whole
     assert meta["chunks_done"] == 1
     np.testing.assert_array_equal(
         np.asarray(jax.device_get(fresh.state.params["encoder"])), want)
@@ -609,6 +683,125 @@ def test_obs_sink_write_fault_drops_event_never_the_workload(tmp_path):
     assert obs.counter("obs.sink.dropped").value == before + 1
     events, skipped = obs.scan_events(path)
     assert [e["n"] for e in events] == [1, 3] and skipped == 0
+
+
+# -- gateway (serve/gateway.py: route / hedge / spare activation) ------------
+
+
+def _mk_gateway(rng, **overrides):
+    from sparse_coding_tpu.models import TiedSAE
+    from sparse_coding_tpu.serve import ModelRegistry, ServingGateway
+
+    k1, k2 = jax.random.split(rng)
+    reg = ModelRegistry()
+    reg.register("tied", TiedSAE(
+        dictionary=jax.random.normal(k1, (32, 16)),
+        encoder_bias=0.1 * jax.random.normal(k2, (32,))))
+    kwargs = dict(n_replicas=2, n_spares=1, buckets=(8,),
+                  ops=("encode",), max_wait_ms=0.0,
+                  breaker_threshold=2, breaker_reset_s=3600.0)
+    kwargs.update(overrides)
+    return ServingGateway(reg, **kwargs)
+
+
+def test_gateway_route_fault_fails_over_and_request_succeeds(rng):
+    """``gateway.route`` matrix entry: an injected routing failure on
+    the first replica attempt counts against THAT replica's breaker and
+    health, the flush fails over to the next-healthiest replica inside
+    the same dispatch, and the request still SUCCEEDS — a single sick
+    route never loses admitted work."""
+    import numpy as np
+
+    with _mk_gateway(rng) as gw:
+        gw.warmup()
+        x = np.zeros((2, 16), np.float32)
+        want = gw.query("tied", x, timeout=30)  # healthy round first
+        with inject(site="gateway.route", nth=1, error="OSError") as plan:
+            out = gw.query("tied", x, timeout=30)
+        assert plan.fired_count("gateway.route") == 1
+        np.testing.assert_array_equal(out, want)
+        snap = gw.stats()
+        assert snap["gateway"]["failovers"] == 1
+        assert snap["gateway"]["route_errors"] == 1
+        assert snap["request_errors"] == {}  # nothing surfaced to callers
+        # exactly one replica absorbed the failure
+        cf = [r["breaker"]["consecutive_failures"]
+              for r in snap["replicas"].values()]
+        assert sorted(cf) == [0, 0, 1]
+
+
+def test_gateway_route_fault_exhausting_all_replicas_is_typed(rng):
+    """Every replica's route failing (count=0) fails ONLY that flush
+    with a typed DispatchError carrying the injected cause — bounded,
+    never a hang — and the pool recovers on the next clean dispatch."""
+    import numpy as np
+
+    from sparse_coding_tpu.serve import DispatchError
+
+    with _mk_gateway(rng, breaker_threshold=5) as gw:
+        gw.warmup()
+        x = np.zeros((2, 16), np.float32)
+        with inject(site="gateway.route", nth=1, count=2) as plan:
+            with pytest.raises(DispatchError) as exc:
+                gw.query("tied", x, timeout=30)
+        assert isinstance(exc.value.cause, InjectedFault)
+        assert plan.fired_count("gateway.route") == 2  # both candidates
+        out = gw.query("tied", x, timeout=30)  # the pool healed
+        assert out.shape == (2, 32)
+
+
+def test_gateway_hedge_fault_abandons_hedge_primary_still_wins(rng):
+    """``gateway.hedge`` matrix entry: an injected failure at the hedge
+    FIRING point abandons the hedge (counted) and the primary dispatch
+    still answers — hedging is never on the failure path of the request
+    it tries to accelerate."""
+    import numpy as np
+
+    with _mk_gateway(rng, hedge_after_s=0.0) as gw:
+        gw.warmup()
+        x = np.zeros((2, 16), np.float32)
+        want = gw.query("tied", x, timeout=30)
+        with inject(site="gateway.hedge", nth=1, count=0) as plan:
+            out = gw.query("tied", x, timeout=30)
+        assert plan.fired_count("gateway.hedge") >= 1
+        np.testing.assert_array_equal(out, want)
+        snap = gw.stats()
+        assert snap["gateway"]["hedges_abandoned"] >= 1
+        assert snap["request_errors"] == {}
+
+
+def test_gateway_spare_activate_fault_bounded_and_retried(rng):
+    """``gateway.spare.activate`` matrix entry: an injected activation
+    failure is counted, the spare stays a spare, and the pool keeps
+    serving on the surviving replicas; the NEXT maintain pass retries
+    and completes the swap."""
+    import numpy as np
+
+    with _mk_gateway(rng, breaker_threshold=1) as gw:
+        gw.warmup()
+        rep = gw.replica("replica-0")
+        rep.breaker.record_failure()  # threshold 1: opens immediately
+        assert rep.breaker.state == "open"
+        with inject(site="gateway.spare.activate", nth=1,
+                    count=0) as plan:
+            assert gw.maintain() == []  # activation failed, no swap
+            assert plan.fired_count("gateway.spare.activate") == 1
+            snap = gw.stats()
+            assert snap["gateway"]["spare_activation_errors"] >= 1
+            assert snap["gateway"]["spare_activations"] == 0
+            assert snap["replicas"]["spare-0"]["state"] == "spare"
+            # the pool still serves on the surviving replica while the
+            # activation keeps failing (the flush auto-retries it)
+            out = gw.query("tied", np.zeros((2, 16), np.float32),
+                           timeout=30)
+            assert out.shape == (2, 32)
+            assert gw.stats()["replicas"]["spare-0"]["state"] == "spare"
+        # retry heals: the fault plan is gone
+        assert gw.maintain() == ["replica-0"]
+        snap = gw.stats()
+        assert snap["gateway"]["spare_activations"] == 1
+        assert snap["replicas"]["spare-0"]["state"] == "active"
+        assert snap["replicas"]["replica-0"]["state"] == "draining"
 
 
 def test_obs_sink_write_corrupt_line_skipped_by_reader(tmp_path):

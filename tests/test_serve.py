@@ -423,6 +423,123 @@ def test_breaker_opens_sheds_and_recovers(registry):
         assert snap["breaker"]["state"] == "closed"
 
 
+def test_breaker_threaded_flapping_no_lost_transitions_bounded_history():
+    """ISSUE 6 satellite: the breaker under CONCURRENT dispatches with an
+    injectable (fixed — fully deterministic) clock. Aggressive flapping
+    (threshold 1, zero cooldown) across 8 threads must (a) lose no
+    transition — every state change reaches the on_transition mirror, in
+    order, as an unbroken old->new chain, (b) keep the snapshot history
+    bounded at TRANSITION_HISTORY while the true count runs far past it,
+    and (c) honor the probe-token contract: only token-holders ever
+    close a half-open circuit."""
+    from sparse_coding_tpu.resilience.breaker import (
+        TRANSITION_HISTORY,
+        CircuitBreaker,
+    )
+
+    events: list[tuple[str, str]] = []
+    br = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.0,
+                        clock=lambda: 0.0,
+                        on_transition=lambda old, new: events.append(
+                            (old, new)))
+    n_threads, iters = 8, 400
+    errors: list[BaseException] = []
+
+    def worker(tid):
+        try:
+            for i in range(iters):
+                tok = br.allow()
+                if not tok:
+                    continue
+                # deterministic per-slot outcome: odd slots fail, even
+                # slots succeed -> constant open/half_open/closed churn
+                if (tid + i) % 2:
+                    br.record_failure(tok)
+                else:
+                    br.record_success(tok)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    snap = br.snapshot()
+    # (a) no lost transitions: the mirror saw every one, and they chain
+    assert snap["n_transitions"] == len(events)
+    for (_, new), (nxt_old, _) in zip(events, events[1:]):
+        assert new == nxt_old, "transition chain broken: a state change "\
+            "was lost or reordered"
+    # the flap really flapped: far more transitions than the ring keeps
+    assert snap["n_transitions"] > TRANSITION_HISTORY
+    # (b) bounded memory: the snapshot ring never exceeds the cap
+    assert len(snap["transitions"]) == TRANSITION_HISTORY
+    # ...and it matches the TAIL of the true sequence exactly
+    want_tail = [f"{o}->{n}" for o, n in events[-TRANSITION_HISTORY:]]
+    assert snap["transitions"] == want_tail
+    # (c) the machine landed in a legal state with a consistent snapshot
+    assert snap["state"] in ("closed", "open", "half_open")
+    assert not (snap["state"] != "half_open" and snap["probe_in_flight"])
+
+
+def test_queue_full_rejection_carries_retry_after_hint(registry):
+    """ISSUE 6 satellite: once a service rate has been observed,
+    QueueFullError carries retry_after_s — the predicted drain time of
+    the queued rows — mirroring CircuitOpenError's typed back-off
+    contract."""
+    with ServingEngine(registry, max_wait_ms=200.0,
+                       max_queue_rows=4) as engine:
+        engine.warmup()
+        # establish a service rate (one timed dispatch)
+        engine.query("tied", np.zeros((2, D), np.float32), timeout=30)
+        engine.pause()
+        engine.submit("tied", np.zeros((2, D), np.float32))
+        engine.submit("tied", np.zeros((2, D), np.float32))
+        with pytest.raises(QueueFullError) as exc:
+            engine.submit("tied", np.zeros((1, D), np.float32))
+        assert exc.value.retry_after_s is not None
+        assert exc.value.retry_after_s > 0.0
+        assert "retry in" in str(exc.value)
+        # the hint is the queue's predicted drain, not a magic constant:
+        # 4 queued rows at the observed rows/s rate
+        predicted = engine._batcher.predicted_wait_s()
+        assert exc.value.retry_after_s == pytest.approx(predicted,
+                                                        rel=0.5)
+        engine.resume()
+
+
+def test_service_rate_ignores_shed_and_failed_flushes(registry):
+    """Regression (review finding): only rows the backend actually
+    SERVED feed the service-rate EWMA. A failed or breaker-shed flush
+    'completes' in microseconds — folding it in would inflate the rate
+    by orders of magnitude and turn ``QueueFullError.retry_after_s``
+    into a hot-retry hint during the exact incidents it exists for."""
+    from sparse_coding_tpu.resilience import inject
+    from sparse_coding_tpu.serve import CircuitOpenError, DispatchError
+
+    with ServingEngine(registry, max_wait_ms=5.0, dispatch_retries=0,
+                       breaker_threshold=1,
+                       breaker_reset_s=3600.0) as engine:
+        engine.warmup()
+        engine.query("tied", np.zeros((2, D), np.float32), timeout=30)
+        rate = engine._batcher._rate_rows_s
+        assert rate is not None and rate > 0
+        engine.pause()  # two streams -> one failed flush, one shed flush
+        f1 = engine.submit("tied", np.zeros((2, D), np.float32))
+        f2 = engine.submit("tied", np.zeros((2, N), np.float32),
+                           op="decode")
+        with inject(site="serve.dispatch", nth=1, error="ValueError"):
+            engine.resume()
+            with pytest.raises(DispatchError):
+                f1.result(timeout=30)  # failed flush opens the breaker
+            with pytest.raises(CircuitOpenError):
+                f2.result(timeout=30)  # second flush is shed fast
+        assert engine._batcher._rate_rows_s == rate  # untouched by both
+
+
 def test_capacity_flush_not_blocked_by_older_sparse_stream(registry):
     """A capacity-full stream must dispatch immediately even when an older,
     still-accumulating sparse stream exists (no head-of-line blocking): the

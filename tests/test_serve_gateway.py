@@ -1,0 +1,475 @@
+"""Self-healing gateway tests (docs/ARCHITECTURE.md §14): CPU-hermetic.
+
+Covers the ISSUE 6 acceptance invariants: health-weighted routing with
+failover losing zero admitted requests, p95/override-triggered hedging
+with first-wins accounting, SLO admission (brownout ladder + deadline
+sheds, interactive never ladder-shed), the kill-a-replica drill (breaker
+forced open -> warm spare activates at ZERO backend compiles via the
+xcache warmup manifest, results bit-identical, one merged obs.report
+showing hedge/shed/failover/spare events), and the SIGKILL chaos case at
+the ``gateway.spare.activate`` crash barrier.
+
+Integer-valued weights/inputs make every dot product exact in f32 (the
+test_serve.py isolation), so results are comparable to the BIT across
+replicas, spares, and killed-and-restarted processes.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.models import UntiedSAE
+from sparse_coding_tpu.serve import (
+    INTERACTIVE,
+    PRIORITIES,
+    SCAVENGER,
+    AdmissionController,
+    ModelRegistry,
+    QueueFullError,
+    ServingEngine,
+    ServingGateway,
+)
+from tests.conftest import stripped_cpu_subprocess_env
+
+D, N = 16, 32
+
+
+def _int_dict(seed: int = 0) -> UntiedSAE:
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return UntiedSAE(
+        encoder=jax.random.randint(k1, (N, D), -4, 5).astype(jnp.float32),
+        encoder_bias=jax.random.randint(k2, (N,), -4, 5).astype(
+            jnp.float32),
+        dictionary=jax.random.randint(k3, (N, D), -4, 5).astype(
+            jnp.float32))
+
+
+@pytest.fixture
+def int_registry():
+    reg = ModelRegistry()
+    reg.register("int", _int_dict())
+    return reg
+
+
+def _payloads(n, max_rows=8, seed=1):
+    nrng = np.random.default_rng(seed)
+    return [np.asarray(nrng.integers(-4, 5, (int(r), D)), np.float32)
+            for r in nrng.integers(1, max_rows + 1, n)]
+
+
+# -- routing / pool basics ----------------------------------------------------
+
+
+def test_pool_serving_bit_equal_and_health_routing(int_registry):
+    """Mixed traffic through a 2-replica pool: every result bit-equal to
+    the direct per-request encode, zero recompiles on either replica,
+    and the routing/served accounting consistent."""
+    payloads = _payloads(20)
+    enc = jax.jit(lambda ld, x: ld.encode(x))
+    expected = [np.asarray(enc(_int_dict(), jnp.asarray(p)))
+                for p in payloads]
+    with ServingGateway(int_registry, n_replicas=2, n_spares=0,
+                        buckets=(8,), ops=("encode",),
+                        max_wait_ms=0.5) as gw:
+        gw.warmup()
+        results = [gw.query("int", p, priority=PRIORITIES[i % 3],
+                            timeout=60)
+                   for i, p in enumerate(payloads)]
+        snap = gw.stats()
+    for got, want in zip(results, expected):
+        np.testing.assert_array_equal(got, want)
+    assert snap["recompiles"] == 0
+    assert sum(snap["gateway"]["served"].values()) == len(payloads)
+    assert sum(snap["gateway"]["routes"].values()) >= 1
+    assert snap["gateway"]["shed"] == {p: 0 for p in PRIORITIES}
+    for rep in snap["replicas"].values():
+        assert rep["state"] in ("active", "spare")
+        assert 0.0 < rep["health"]["score"] <= 1.0
+
+
+def test_admission_ladder_unit():
+    """The brownout ladder + closed loop, driven exactly: level 1 sheds
+    scavenger only, level 2 sheds batch too, interactive is NEVER
+    ladder-shed; the p99 loop widens above target and narrows below
+    half of it, one rung per adjust_every observations."""
+    ctl = AdmissionController(target_p99_ms=50.0, adjust_every=4)
+    ok = dict(queued_rows=0, max_queue_rows=100, predicted_wait_s=None)
+    for p in PRIORITIES:
+        ctl.admit(p, None, **ok)  # level 0 admits everything
+    # widen: 4 observations over target climb exactly one rung
+    for _ in range(3):
+        assert ctl.observe_p99(100.0) == 0
+    assert ctl.observe_p99(100.0) == 1
+    ctl.admit(INTERACTIVE, None, **ok)
+    ctl.admit("batch", None, **ok)
+    with pytest.raises(QueueFullError):
+        ctl.admit(SCAVENGER, None, **ok)
+    for _ in range(4):
+        ctl.observe_p99(100.0)
+    assert ctl.level == 2
+    with pytest.raises(QueueFullError):
+        ctl.admit("batch", None, **ok)
+    ctl.admit(INTERACTIVE, None, **ok)  # never shed by the ladder
+    for _ in range(4):
+        ctl.observe_p99(100.0)
+    assert ctl.level == 2  # ladder is capped: interactive stays admitted
+    # narrow: sustained recovery descends one rung at a time
+    for _ in range(4):
+        ctl.observe_p99(10.0)
+    assert ctl.level == 1
+    # queue-depth ramp: scavenger refused while interactive admits
+    with pytest.raises(QueueFullError) as exc:
+        ctl.admit(SCAVENGER, None, queued_rows=60, max_queue_rows=100,
+                  predicted_wait_s=1.5)
+    assert exc.value.retry_after_s == 1.5
+    ctl.set_level(0)
+    ctl.admit(SCAVENGER, None, queued_rows=40, max_queue_rows=100,
+              predicted_wait_s=None)  # below the scavenger ramp
+    # deadline shed: predicted wait beyond the request deadline
+    with pytest.raises(QueueFullError):
+        ctl.admit(INTERACTIVE, 0.01, queued_rows=1, max_queue_rows=100,
+                  predicted_wait_s=0.5)
+
+
+def test_gateway_deadline_shed_uses_predicted_wait(int_registry):
+    """A request whose deadline the queue's predicted wait already
+    exceeds is refused at admission with the typed QueueFullError +
+    retry hint — before it would waste queue space timing out."""
+    with ServingGateway(int_registry, n_replicas=1, n_spares=0,
+                        buckets=(8,), ops=("encode",), max_wait_ms=100.0,
+                        max_queue_rows=64) as gw:
+        gw.warmup()
+        gw.query("int", np.zeros((2, D), np.float32), timeout=30)
+        gw.pause()  # build a backlog so predicted wait is nonzero
+        gw.submit("int", np.zeros((4, D), np.float32))
+        with pytest.raises(QueueFullError) as exc:
+            gw.submit("int", np.zeros((1, D), np.float32),
+                      priority=SCAVENGER, deadline_s=0.0)
+        assert exc.value.retry_after_s is not None
+        gw.resume()
+        snap = gw.stats()
+        assert snap["gateway"]["shed"][SCAVENGER] == 1
+        assert snap["gateway"]["shed"][INTERACTIVE] == 0
+
+
+def test_hedging_first_wins_accounting(int_registry):
+    """hedge_after_s=0.0 hedges every flush between two healthy
+    replicas: results stay bit-equal (both replicas run the same
+    program) and every fired hedge is accounted exactly once as won or
+    wasted."""
+    payloads = _payloads(12, seed=5)
+    enc = jax.jit(lambda ld, x: ld.encode(x))
+    expected = [np.asarray(enc(_int_dict(), jnp.asarray(p)))
+                for p in payloads]
+    with ServingGateway(int_registry, n_replicas=2, n_spares=0,
+                        buckets=(8,), ops=("encode",), max_wait_ms=0.0,
+                        hedge_after_s=0.0) as gw:
+        gw.warmup()
+        results = [gw.query("int", p, timeout=60) for p in payloads]
+        snap = gw.stats()
+    for got, want in zip(results, expected):
+        np.testing.assert_array_equal(got, want)
+    g = snap["gateway"]
+    assert g["hedges_fired"] >= 1
+    assert g["hedges_won"] + g["hedges_wasted"] == g["hedges_fired"]
+    assert g["hedges_abandoned"] == 0
+    assert snap["request_errors"] == {}
+
+
+def test_hung_replica_times_out_fails_over_and_drains(int_registry,
+                                                      monkeypatch):
+    """A replica that HANGS (wedged tunnel: blocks forever, never
+    raises) must not wedge the gateway: the dispatch timeout charges the
+    hang to THAT replica — breaker failure, health hit, typed failover —
+    so the request is served elsewhere, the breaker opens, and the spare
+    replaces the hung replica. The abandoned worker thread is bounded by
+    the pool sizing and cannot corrupt the breaker when it eventually
+    resolves (stale probe-token contract)."""
+    import threading
+
+    release = threading.Event()
+    with ServingGateway(int_registry, n_replicas=2, n_spares=1,
+                        buckets=(8,), ops=("encode",), max_wait_ms=0.0,
+                        breaker_threshold=1, breaker_reset_s=3600.0,
+                        hedge_after_s=3600.0,
+                        dispatch_timeout_s=0.3) as gw:
+        gw.warmup()
+        hung = gw.replica("replica-0")
+        for _ in range(50):
+            hung.health.record(0.0, ok=True)  # rank it primary
+        real = hung.engine.run_padded
+
+        def wedge(model, op, x):
+            release.wait()  # a hang, not an error
+            return real(model, op, x)
+
+        monkeypatch.setattr(hung.engine, "run_padded", wedge)
+        try:
+            out = gw.query("int", np.zeros((2, D), np.float32),
+                           timeout=30)
+            assert out.shape == (2, N)  # served via failover
+            snap = gw.stats()
+            assert snap["gateway"]["dispatch_timeouts"]["replica-0"] == 1
+            assert snap["replicas"]["replica-0"]["breaker"]["state"] \
+                == "open"
+            assert snap["replicas"]["replica-0"]["state"] == "draining"
+            assert snap["replicas"]["spare-0"]["state"] == "active"
+            assert snap["request_errors"] == {}
+        finally:
+            release.set()  # unblock the abandoned worker before shutdown
+        # the abandoned attempt now resolves (successfully!) AFTER its
+        # timeout was charged: it is counted as a late result and must
+        # NOT fake-heal the breaker — a replica consistently finishing
+        # just past the deadline stays drained
+        import time
+
+        for _ in range(250):
+            if gw.stats()["gateway"]["late_results"]["replica-0"]:
+                break
+            time.sleep(0.02)
+        snap = gw.stats()
+        assert snap["gateway"]["late_results"]["replica-0"] == 1
+        assert snap["replicas"]["replica-0"]["breaker"]["state"] == "open"
+        assert snap["replicas"]["replica-0"]["state"] == "draining"
+
+
+def test_admission_ladder_recovers_once_incident_leaves_window():
+    """Regression (review finding): the closed loop reads a WINDOWED
+    p99. An incident's slow tail must stop poisoning the controller as
+    soon as it leaves the rolling window — an all-time cumulative
+    quantile would keep shedding batch/scavenger traffic for tens of
+    thousands of requests after full recovery."""
+    from collections import deque
+
+    from sparse_coding_tpu.serve.slo import windowed_quantile
+
+    ctl = AdmissionController(target_p99_ms=50.0, adjust_every=4)
+    window: deque = deque(maxlen=32)
+
+    def feed(lat_s, n):
+        for _ in range(n):
+            window.append(lat_s)
+            ctl.observe_p99(windowed_quantile(list(window), 0.99) * 1e3)
+
+    feed(0.5, 1000)  # the incident: sustained 500 ms latencies
+    assert ctl.level == 2
+    # recovery: fast traffic; once the window rolls over, the ladder
+    # walks back down promptly (NOT after ~99k requests)
+    feed(0.005, 100)
+    assert ctl.level == 0
+
+
+def test_spare_warmup_falls_back_when_manifest_is_foreign(int_registry,
+                                                          tmp_path):
+    """Regression (review finding): a manifest whose serve descriptors
+    all name programs this engine does not serve (foreign deployment
+    sharing the cache dir) must trigger the full-warmup fallback — the
+    spare never admits traffic cold with 'warmed' set."""
+    from sparse_coding_tpu.xcache.manifest import WarmupManifest
+
+    manifest = WarmupManifest(tmp_path / "warmup.json")
+    manifest.record({"kind": "serve", "model": "ghost", "op": "encode",
+                     "bucket": 8})
+    with ServingEngine(int_registry, buckets=(8,), ops=("encode",),
+                       max_wait_ms=0.0) as engine:
+        n = engine.warmup_from_manifest(manifest)
+        assert n == 1  # the registry product, not the empty match
+        assert engine.stats()["warmed"]
+        engine.query("int", np.zeros((2, D), np.float32), timeout=30)
+        assert engine.stats()["recompiles"] == 0
+
+
+# -- the kill-a-replica drill (ISSUE 6 acceptance) ----------------------------
+
+
+def test_kill_a_replica_drill(int_registry, tmp_path, monkeypatch):
+    """Sustained mixed-priority load; one replica's backend dies ->
+    its breaker opens, the flush fails over (zero admitted requests
+    lost), and the warm spare activates with ZERO backend compiles (the
+    xcache warmup manifest names the warm set, every program loads from
+    the executable store). Scavenger shed is allowed and counted,
+    interactive is never shed, every served result is bit-identical to
+    the single-healthy-replica computation, and hedge / shed / failover
+    / spare-activation evidence all land in ONE merged obs.report."""
+    from sparse_coding_tpu import obs, xcache
+    from sparse_coding_tpu.obs.report import build_report
+
+    run_dir = tmp_path / "run"
+    xcache.enable(tmp_path / "xc")
+    prev_sink = obs.configure_sink(
+        obs.EventSink(run_dir / "obs" / "gateway.jsonl"))
+    try:
+        payloads = _payloads(30, seed=7)
+        enc = jax.jit(lambda ld, x: ld.encode(x))
+        expected = [np.asarray(enc(_int_dict(), jnp.asarray(p)))
+                    for p in payloads]
+        admission = AdmissionController(target_p99_ms=1e9)  # manual rungs
+        gw = ServingGateway(int_registry, n_replicas=2, n_spares=1,
+                            buckets=(8,), ops=("encode",),
+                            max_wait_ms=0.5, breaker_threshold=1,
+                            breaker_reset_s=3600.0, hedge_after_s=0.0,
+                            admission=admission)
+        with gw:
+            gw.warmup()
+            results: dict[int, np.ndarray] = {}
+            # phase 1 — healthy mixed-priority load, hedging live
+            for i in range(10):
+                results[i] = gw.query("int", payloads[i],
+                                      priority=PRIORITIES[i % 3],
+                                      timeout=60)
+            snap = gw.stats()
+            assert snap["gateway"]["hedges_fired"] >= 1
+
+            # phase 2 — kill replica-0's backend. Hedging off so the
+            # failover path (not a lucky hedge) absorbs the failure;
+            # health boosted so the dead replica is ranked primary and
+            # the drill exercises the worst case.
+            gw.configure_hedging(3600.0)
+            dead = gw.replica("replica-0")
+            for _ in range(50):
+                dead.health.record(0.0, ok=True)
+
+            def boom(model, op, x):
+                raise OSError("replica backend died (drill)")
+
+            monkeypatch.setattr(dead.engine, "run_padded", boom)
+            compiles_before = obs.counter("jax.compiles").value
+            for i in range(10, 20):
+                results[i] = gw.query("int", payloads[i],
+                                      priority=PRIORITIES[i % 3],
+                                      timeout=60)
+            snap = gw.stats()
+            assert snap["replicas"]["replica-0"]["breaker"]["state"] \
+                == "open"
+            assert snap["replicas"]["replica-0"]["state"] == "draining"
+            assert snap["replicas"]["spare-0"]["state"] == "active"
+            assert snap["gateway"]["spare_activations"] == 1
+            assert snap["gateway"]["failovers"] >= 1
+            # the headline: spare activation + continued serving paid
+            # ZERO backend compiles — the manifest-named warm set loaded
+            # from the executable store
+            assert obs.counter("jax.compiles").value == compiles_before
+
+            # phase 3 — brownout: scavenger shed, interactive untouched
+            admission.set_level(1)
+            with pytest.raises(QueueFullError):
+                gw.submit("int", payloads[20], priority=SCAVENGER)
+            for i in range(20, 30):
+                results[i] = gw.query("int", payloads[i],
+                                      priority=(INTERACTIVE if i % 2
+                                                else "batch"),
+                                      timeout=60)
+            snap = gw.stats()
+            obs.flush_metrics(registry=gw.metrics.registry)
+
+        # zero admitted requests lost, all results bit-identical to the
+        # single-healthy-replica computation
+        assert snap["request_errors"] == {}
+        assert snap["gateway"]["shed"][INTERACTIVE] == 0
+        assert snap["gateway"]["shed"][SCAVENGER] == 1
+        for i, got in results.items():
+            np.testing.assert_array_equal(got, expected[i], err_msg=str(i))
+
+        # one merged report carries the whole incident
+        report = build_report(run_dir)
+        g = report["gateway"]
+        assert g["spare_activations"] == 1
+        assert g["hedges_fired"] >= 1
+        assert g["failovers"] >= 1
+        assert g["shed"].get("scavenger") == 1
+        assert "gateway.spare.activate" in report["spans"]
+        assert report["spans"]["gateway.spare.activate"]["errors"] == 0
+    finally:
+        obs.configure_sink(prev_sink)
+        xcache.disable()
+
+
+# -- SIGKILL chaos case at gateway.spare.activate -----------------------------
+
+_CHAOS_DRIVER = r"""
+import sys
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu import obs, xcache
+from sparse_coding_tpu.models import UntiedSAE
+from sparse_coding_tpu.serve import ModelRegistry, ServingGateway
+
+cache_dir, out_path = sys.argv[1], sys.argv[2]
+xcache.enable(cache_dir)
+k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+ld = UntiedSAE(
+    encoder=jax.random.randint(k1, (32, 16), -4, 5).astype(jnp.float32),
+    encoder_bias=jax.random.randint(k2, (32,), -4, 5).astype(jnp.float32),
+    dictionary=jax.random.randint(k3, (32, 16), -4, 5).astype(jnp.float32))
+reg = ModelRegistry()
+reg.register("int", ld)
+c0 = obs.counter("jax.compiles").value  # serve-section delta from here
+with ServingGateway(reg, n_replicas=1, n_spares=1, buckets=(8,),
+                    ops=("encode",), max_wait_ms=0.0,
+                    breaker_threshold=1, breaker_reset_s=3600.0) as gw:
+    gw.warmup()
+    gw.replica("replica-0").breaker.record_failure()  # force it open
+    drained = gw.maintain()  # crash barrier gateway.spare.activate is HERE
+    assert drained == ["replica-0"], drained
+    x = np.asarray(np.arange(7 * 16).reshape(7, 16) % 9 - 4, np.float32)
+    out = np.asarray(gw.query("int", x, timeout=60))
+with open(out_path, "wb") as f:  # process-private scratch result
+    np.save(f, out)
+print("SERVE_COMPILES", int(obs.counter("jax.compiles").value - c0))
+print("STORE", int(obs.counter("xcache.hits").value),
+      int(obs.counter("xcache.misses").value),
+      int(obs.counter("xcache.errors").value))
+"""
+
+
+@pytest.mark.chaos
+@pytest.mark.faults
+def test_spare_activation_sigkill_restart_bitwise(tmp_path):
+    """Chaos case for the ``gateway.spare.activate`` crash barrier:
+    SIGKILL a real gateway process at the worst instant (spare's warm
+    set fully loaded from the store, routing swap not yet made), restart
+    it over the same cache dir, and require (a) the restart completes
+    the identical activation with ZERO backend compiles — everything,
+    including the programs the dead run compiled, loads from the
+    executable store — and (b) the served result is bitwise identical to
+    the in-process direct computation."""
+    driver = tmp_path / "driver.py"
+    driver.write_text(_CHAOS_DRIVER)
+    cache_dir, out_path = tmp_path / "xc", tmp_path / "out.npy"
+    env = stripped_cpu_subprocess_env()
+
+    # run 1: killed BY SIGKILL exactly at the barrier
+    env_kill = dict(env)
+    env_kill["SPARSE_CODING_CRASH_PLAN"] = "gateway.spare.activate:nth=1"
+    p1 = subprocess.run(
+        [sys.executable, str(driver), str(cache_dir), str(out_path)],
+        env=env_kill, capture_output=True, text=True, timeout=300)
+    assert p1.returncode == -9, (p1.returncode, p1.stderr[-2000:])
+    assert "crash_barrier: SIGKILL at site 'gateway.spare.activate'" \
+        in p1.stderr
+    assert not out_path.exists()  # it died before serving
+
+    # run 2: same cache dir, no plan — the restart path
+    p2 = subprocess.run(
+        [sys.executable, str(driver), str(cache_dir), str(out_path)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    # zero-recompile restart: run 1 compiled + stored the program, so
+    # the restart's warmup LOADS it (a store hit, no backend compile)
+    # and the spare activates off the shared pool table
+    assert "SERVE_COMPILES 0" in p2.stdout, p2.stdout
+    store_hits = int(p2.stdout.split("STORE ")[1].split()[0])
+    assert store_hits >= 1, p2.stdout
+    got = np.load(out_path)
+
+    # bitwise-identical to the direct in-process computation
+    x = np.asarray(np.arange(7 * D).reshape(7, D) % 9 - 4, np.float32)
+    want = np.asarray(_int_dict().encode(jnp.asarray(x)))
+    np.testing.assert_array_equal(got, want)
