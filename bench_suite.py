@@ -199,6 +199,84 @@ def bench_chunk_io(quick: bool) -> None:
               "MB/s (warm-cache read + f32 cast)", rows=rows, d=d)
 
 
+def bench_ingest_soak(quick: bool) -> None:
+    """Sharded-store async ingest soak (ISSUE 8): chunk→device throughput
+    vs shard count × decode-stream count, with the per-stage walls read
+    back through ``obs.report``'s ingest section (the production evidence
+    path). The point to prove: with streams overlapping, the consumer's
+    wall stops being decode-bound — ``decode_s`` (summed across streams)
+    exceeds the wall it used to BE, i.e. the sweep goes compute-bound."""
+    import tempfile
+
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.data.chunk_store import ChunkWriter
+    from sparse_coding_tpu.data.ingest import chunk_stream, device_batches
+    from sparse_coding_tpu.data.shard_store import (
+        build_store_manifest,
+        open_store,
+        shard_name,
+        write_shard_digest,
+    )
+    from sparse_coding_tpu.obs.report import build_report
+
+    d = 256 if quick else 512
+    rows_per_chunk = 4096 if quick else 16384
+    chunks_per_shard = 2
+    shard_counts = (1, 2) if quick else (1, 2, 4)
+    stream_counts = (1, 2) if quick else (1, 2, 4)
+    rng = np.random.default_rng(0)
+    for n_shards in shard_counts:
+        with tempfile.TemporaryDirectory() as td:
+            root = Path(td) / "store"
+            for si in range(n_shards):
+                w = ChunkWriter(root / shard_name(si), d,
+                                chunk_size_gb=rows_per_chunk * d * 2 / 2**30,
+                                dtype="float16")
+                w.add(rng.standard_normal(
+                    (rows_per_chunk * chunks_per_shard, d),
+                    dtype=np.float32).astype(np.float16))
+                w.finalize({"synthetic": True})
+                write_shard_digest(root / shard_name(si))
+            build_store_manifest(root, expect_shards=n_shards)
+            n_chunks = open_store(root).n_chunks
+            total_bytes = n_chunks * rows_per_chunk * d * 2
+            order = list(range(n_chunks))
+            for streams in stream_counts:
+                # a FRESH store per config: ChunkStore caches digest
+                # verification per chunk (_digest_verified), so a shared
+                # instance would make the first config pay every sha256
+                # and later ones skip them — biasing the comparison
+                store = open_store(root)
+                store.load_chunk(0)  # warm lazy imports + page cache
+                with tempfile.TemporaryDirectory() as run_dir:
+                    prev = obs.configure_sink(obs.EventSink(
+                        Path(run_dir) / "obs" / "ingest.jsonl"))
+                    t0 = time.perf_counter()
+                    try:
+                        # the sweep's exact feed: multi-stream decode →
+                        # double-buffered device staging
+                        for batch in device_batches(
+                                c for c in chunk_stream(store, order,
+                                                        streams=streams)
+                                if c is not None):
+                            jax.block_until_ready(batch)
+                    finally:
+                        dt = time.perf_counter() - t0
+                        obs.flush_metrics()
+                        obs.configure_sink(prev)
+                    ing = build_report(run_dir)["ingest"]
+                _emit("ingest_soak", total_bytes / dt / 2**20, "MB/s to device",
+                      n_shards=n_shards, streams=streams, chunks=n_chunks,
+                      rows_per_chunk=rows_per_chunk, d=d,
+                      decode_s=round(ing["decode_s"], 3),
+                      transfer_s=round(ing["transfer_s"], 3),
+                      wall_s=round(dt, 3),
+                      # >1.0 == decode overlapped past the wall: the
+                      # consumer is no longer decode-bound
+                      decode_overlap=round(ing["decode_s"] / dt, 2)
+                      if dt else None)
+
+
 def bench_streaming_eval(quick: bool) -> None:
     """Dataset-scale metric sweep over a multi-chunk ChunkStore (bounded
     memory): activations/s through n_ever_active + moment accumulation."""
@@ -448,8 +526,8 @@ def main() -> None:
     # seq_parallel runs LAST: its hang watchdog exits the process, and every
     # earlier suite's JSON line is flushed by then
     for suite in (bench_ensemble, bench_big_sae, bench_harvest,
-                  bench_chunk_io, bench_streaming_eval, bench_gateway,
-                  bench_seq_parallel):
+                  bench_chunk_io, bench_ingest_soak, bench_streaming_eval,
+                  bench_gateway, bench_seq_parallel):
         try:
             suite(args.quick)
         except Exception as e:

@@ -65,6 +65,7 @@ int64_t parallel_pread(int fd, char *dst, int64_t offset, int64_t size,
 struct PrefetchJob {
   std::thread worker;
   std::atomic<int64_t> result{0};
+  std::atomic<bool> done{false};
 };
 
 }  // namespace
@@ -98,13 +99,25 @@ void *chunkio_prefetch_start(const char *path, char *dst, int64_t offset,
     int fd = open(path_copy.c_str(), O_RDONLY);
     if (fd < 0) {
       job->result.store(-1);
+      job->done.store(true);
       return;
     }
     int64_t n = parallel_pread(fd, dst, offset, size, nthreads);
     close(fd);
     job->result.store(n == size ? n : -1);
+    job->done.store(true);
   });
   return job;
+}
+
+// Non-blocking completion check: 1 when the prefetch has finished (wait will
+// not block), 0 while still in flight. Readiness primitive for a consumer
+// keeping several prefetch handles outstanding; the current multi-stream
+// ingest (data/ingest.py) multiplexes pool threads over blocking reads
+// instead, so today's only caller is NativePrefetcher.poll (tested in
+// tests/test_native_io.py).
+int chunkio_prefetch_poll(void *handle) {
+  return static_cast<PrefetchJob *>(handle)->done.load() ? 1 : 0;
 }
 
 // Block until the prefetch finishes (data is already in the caller's dst).

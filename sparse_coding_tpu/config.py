@@ -170,6 +170,15 @@ class EnsembleArgs(BaseArgs):
     # granularity becomes per-window and host RAM briefly holds a
     # [scan_steps, batch, d] stack (~200 MB at 50x2048x512 f32)
     scan_steps: int = 1
+    # concurrent chunk-decode streams feeding the sweep (data/ingest.py
+    # chunk_stream). 0 = auto: bounded by usable cores AND by free host
+    # RAM vs decoded chunk size (the pipeline holds up to streams+2
+    # decoded chunks resident; auto never exceeds half of available RAM,
+    # dropping to the serial reader's two-chunk bound when chunks are
+    # huge). 1 pins the foreground single-stream reader with the native
+    # 1-slab readahead — also the path a dying stream degrades to when a
+    # worker dies mid-epoch
+    ingest_streams: int = 0
 
 
 @dataclass

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sparse_coding_tpu import obs
+from sparse_coding_tpu.data.ledger import load_quarantine, record_quarantine
 from sparse_coding_tpu.resilience import lease
 from sparse_coding_tpu.resilience.atomic import atomic_save_npy, atomic_write_text
 from sparse_coding_tpu.resilience.crash import crash_barrier, register_crash_site
@@ -224,7 +225,6 @@ class ChunkStore:
         self.verify_digests = bool(verify_digests)
         self.io_retries = int(io_retries)
         self.retry_base_delay_s = float(retry_base_delay_s)
-        self.quarantined: set[int] = set()
         # chunks whose digest already verified this process: a sha256 over
         # a multi-GB chunk costs ~1s serial with training, so epoch
         # repetitions must not re-pay it — first read still catches
@@ -232,19 +232,34 @@ class ChunkStore:
         # AFTER a clean in-process read implies failing RAM, not disk)
         self._digest_verified: set[str] = set()
         self.folder = Path(folder)
-        self.chunk_paths = sorted(
-            (p for p in self.folder.glob("*.npy") if p.stem.isdigit()),
-            key=lambda p: int(p.stem))
-        self.format = "npy"
-        if not self.chunk_paths:
-            self.chunk_paths = sorted(
-                (p for p in self.folder.glob("*.pt") if p.stem.isdigit()),
-                key=lambda p: int(p.stem))
-            self.format = "pt"
-        if not self.chunk_paths:
-            raise FileNotFoundError(f"no .npy or .pt chunks in {self.folder}")
         meta_path = self.folder / "meta.json"
         self.meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
+        by_index = {int(p.stem): p for p in self.folder.glob("*.npy")
+                    if p.stem.isdigit()}
+        self.format = "npy"
+        if not by_index:
+            pt = {int(p.stem): p for p in self.folder.glob("*.pt")
+                  if p.stem.isdigit()}
+            if pt:
+                by_index = pt
+                self.format = "pt"
+        if not by_index and self.meta.get("n_chunks") is None:
+            raise FileNotFoundError(f"no .npy or .pt chunks in {self.folder}")
+        # index -> path tolerates GAPS — or a fully EMPTY live set when
+        # meta.json declares the store: a scrub-repaired store keeps its
+        # positional index space (meta n_chunks) with the quarantined
+        # chunks' files moved aside — readers yield None at those
+        # positions instead of shifting every later chunk down one (or
+        # refusing to open a store the scrub just finished healing)
+        self._paths_by_index = by_index
+        self.chunk_paths = [by_index[i] for i in sorted(by_index)]
+        declared = self.meta.get("n_chunks")
+        self._n_chunks = (int(declared) if declared is not None
+                          else max(by_index) + 1)
+        # durable quarantine ledger (data/ledger.py): chunks a previous
+        # process proved corrupt are known at open, so a supervised resume
+        # never re-pays (or retries forever on) a known-bad chunk
+        self.quarantined: set[int] = set(load_quarantine(self.folder))
         if self.format == "pt":
             if "activation_dim" in self.meta:
                 self.activation_dim = int(self.meta["activation_dim"])
@@ -256,25 +271,42 @@ class ChunkStore:
                 self.activation_dim = int(
                     read_pt_chunk(self.chunk_paths[0],
                                   dtype=np.float16).shape[-1])
-        else:
+        elif self.chunk_paths:
             first = np.load(self.chunk_paths[0], mmap_mode="r")
             self.activation_dim = int(first.shape[-1])
+        else:  # empty live set: the meta that admitted us carries the dim
+            self.activation_dim = int(self.meta["activation_dim"])
 
     @property
     def n_chunks(self) -> int:
-        return len(self.chunk_paths)
+        """The store's POSITIONAL chunk count (meta.json's n_chunks when
+        finalized): indices of scrub-quarantined chunks whose files were
+        moved aside still count — they read as None/corrupt, they do not
+        shift later chunks down."""
+        return self._n_chunks
+
+    def _path(self, i: int) -> Path:
+        """Path of chunk ``i``; a missing file (scrub moved it aside, or
+        the store was damaged) is typed corruption, never an IndexError."""
+        p = self._paths_by_index.get(int(i))
+        if p is None:
+            raise ChunkCorruptionError(
+                int(i), self.folder / f"{i}.{self.format}",
+                "chunk file missing (quarantined by scrub, or damaged "
+                "store)")
+        return p
 
     def load_chunk(self, i: int, dtype=np.float32) -> np.ndarray:
         if self.format == "pt":
             from sparse_coding_tpu.utils.ref_interop import read_pt_chunk
 
-            return read_pt_chunk(self.chunk_paths[i], dtype=dtype)
+            return read_pt_chunk(self._path(i), dtype=dtype)
         from sparse_coding_tpu.data.native_io import (
             DEFAULT_THREADS,
             read_npy_native,
         )
 
-        path = self.chunk_paths[i]
+        path = self._path(i)
 
         def _load_once() -> np.ndarray:
             try:
@@ -372,54 +404,78 @@ class ChunkStore:
         consumers stay aligned with ``indices``."""
         if self.format == "pt":
             # torch deserialization isn't a raw pread — no native readahead
+            # to cancel, but the rest of the raw branch's contract holds:
+            # ledger-known chunks are skipped unread, and every delivered
+            # chunk beats the lease so a WEDGED torch deserialize stops
+            # the beats and the supervisor's hang watchdog catches it
             for ci in indices:
+                ci = int(ci)
+                if self.quarantine_corrupt and ci in self.quarantined:
+                    # a quarantined position is still reader progress —
+                    # beat like the raw branch does, or a long run of
+                    # ledger-known chunks starves the hang watchdog
+                    lease.beat()
+                    yield None
+                    continue
                 try:
-                    yield self.load_chunk(int(ci), dtype)
+                    chunk = self.load_chunk(ci, dtype)
                 except ChunkCorruptionError as e:
                     if not self.quarantine_corrupt:
                         raise
                     self._quarantine(e)
-                    yield None
+                    chunk = None
+                lease.beat()
+                yield chunk
             return
         from sparse_coding_tpu.data.native_io import NativePrefetcher
 
         indices = [int(i) for i in indices]
         prefetcher = NativePrefetcher()
 
-        def _start(path) -> bool:
-            # a truncated/corrupt header must not crash the reader from
-            # the prefetch side: degrade to the foreground path, which
-            # types the failure (ChunkCorruptionError) properly
+        def _start(ci) -> bool:
+            # never prefetch a ledger-known chunk (a resume must not
+            # re-pay a known-corrupt read), and a truncated/corrupt
+            # header must not crash the reader from the prefetch side:
+            # degrade to the foreground path, which types the failure
+            # (ChunkCorruptionError) properly
+            if self.quarantine_corrupt and ci in self.quarantined:
+                return False
             try:
-                return prefetcher.start(path)
-            except (ValueError, EOFError, OSError):
+                return prefetcher.start(self._path(ci))
+            except (ChunkCorruptionError, ValueError, EOFError, OSError):
                 return False
 
         try:
-            prefetching = (_start(self.chunk_paths[indices[0]])
-                           if indices else False)
+            prefetching = _start(indices[0]) if indices else False
             for pos, ci in enumerate(indices):
                 raw = prefetcher.wait() if prefetching else None
-                try:
-                    try:
-                        chunk = (self._finish_raw(raw, dtype,
-                                                  self.chunk_paths[ci])
-                                 if raw is not None
-                                 else self.load_chunk(ci, dtype))
-                    except OSError:
-                        # transient failure on the prefetched buffer:
-                        # re-read through load_chunk's bounded-retry path
-                        chunk = self.load_chunk(ci, dtype)
-                except ChunkCorruptionError as e:
-                    if not self.quarantine_corrupt:
-                        raise
-                    self._quarantine(e)
+                if self.quarantine_corrupt and ci in self.quarantined:
+                    # ledger-known corrupt (possibly from a previous
+                    # process): skip without paying the read
                     chunk = None
+                else:
+                    try:
+                        try:
+                            chunk = (self._finish_raw(raw, dtype,
+                                                      self._path(ci))
+                                     if raw is not None
+                                     else self.load_chunk(ci, dtype))
+                        except OSError:
+                            # transient failure on the prefetched buffer:
+                            # re-read through load_chunk's bounded retry
+                            chunk = self.load_chunk(ci, dtype)
+                    except ChunkCorruptionError as e:
+                        if not self.quarantine_corrupt:
+                            raise
+                        self._quarantine(e)
+                        chunk = None
                 # _finish_raw copied: drop the on-disk dtype buffer before
                 # the yield (keeps the documented two-chunk RAM bound)
                 raw = None
                 if pos + 1 < len(indices):
-                    prefetching = _start(self.chunk_paths[indices[pos + 1]])
+                    prefetching = _start(indices[pos + 1])
+                # a delivered chunk is reader progress (throttled inside)
+                lease.beat()
                 # a quarantined chunk yields None (never silently dropped):
                 # positional consumers — the sweep zips chunk indices with
                 # this stream — must stay aligned with the index sequence
@@ -428,14 +484,32 @@ class ChunkStore:
             # early generator exit must not leak the in-flight native read
             prefetcher.cancel()
 
+    # the foreground single-stream contract path: data/ingest.py's
+    # multi-stream chunk_stream delegates here for streams<=1 / pt stores
+    # and degrades here when a stream worker dies mid-epoch
+    serial_chunk_reader = chunk_reader
+
     def _quarantine(self, err: ChunkCorruptionError) -> None:
         """Record + warn about a corrupt chunk exactly once; later visits
-        (n_repetitions > 1) skip silently."""
+        (n_repetitions > 1) skip silently. The quarantine is DURABLE
+        (data/ledger.py): the ledger next to meta.json is rewritten
+        atomically, so a supervised resume — a fresh process — opens the
+        store already knowing and never re-pays the read. A ledger write
+        failure (read-only store, full disk) only loses the durability,
+        never the run: the in-memory set still protects this process."""
         if err.chunk_index not in self.quarantined:
             logger.warning(
                 "quarantining corrupt chunk %d (%s): %s — skipping it for "
                 "the rest of this run", err.chunk_index, err.path, err.reason)
             self.quarantined.add(err.chunk_index)
+            try:
+                record_quarantine(self.folder, err.chunk_index, err.reason,
+                                  err.path.name)
+            except OSError as write_err:
+                logger.warning(
+                    "quarantine ledger write failed for chunk %d (%s) — "
+                    "the quarantine holds in-memory only this run",
+                    err.chunk_index, write_err)
 
     def epoch(self, batch_size: int, rng: np.random.Generator,
               n_repetitions: int = 1, dtype=np.float32) -> Iterator[np.ndarray]:
@@ -506,25 +580,10 @@ def window_stacks(batches: Iterable[np.ndarray], k: int) -> Iterator[np.ndarray]
 def device_prefetch(batches: Iterable[np.ndarray], sharding=None,
                     buffer_size: int = 2) -> Iterator[Array]:
     """Double-buffered host→device pipeline: batch i+1 transfers while batch i
-    computes. jax.device_put is async, so a small lookahead queue suffices."""
-    from collections import deque
+    computes. One implementation, hardened: delegates to
+    ``data.ingest.device_batches`` (fault site ``ingest.transfer``, bounded
+    retry, lease beats, stage span), so every caller — big_sae, dispatch,
+    basic_sweep — rides the same contract as the sweep hot loop."""
+    from sparse_coding_tpu.data.ingest import device_batches
 
-    queue: deque[Array] = deque()
-    it = iter(batches)
-
-    def put(x):
-        x = jnp.asarray(x) if sharding is None else jax.device_put(x, sharding)
-        return x
-
-    try:
-        for _ in range(buffer_size):
-            queue.append(put(next(it)))
-    except StopIteration:
-        pass
-    while queue:
-        out = queue.popleft()
-        try:
-            queue.append(put(next(it)))
-        except StopIteration:
-            pass
-        yield out
+    yield from device_batches(batches, sharding, buffer_size=buffer_size)

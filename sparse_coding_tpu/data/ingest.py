@@ -1,0 +1,328 @@
+"""Fault-tolerant async device ingest: multi-stream decode → staging →
+device transfer.
+
+The sweep hot loop used to feed itself through a 1-slab lookahead
+(`ChunkStore.chunk_reader` + `device_prefetch`): one chunk decoding while
+one trains, 654 MB/s single-stream host decode (BENCH_SUITE_TPU.json).
+Every open ROADMAP front — pod-scale sharded training, roofline kernels,
+Group-SAE multi-layer harvests — multiplies chunk volume, so the data
+plane must overlap MULTIPLE disk/decode streams with host staging and
+``device_put`` and stay alive when any one stream dies. This module is
+that pipeline:
+
+- :func:`chunk_stream` — in-order chunk delivery with up to ``streams``
+  concurrent decodes in flight (each decode rides the store's own
+  hardened read path: native threaded pread, digest verify, bounded
+  retry). Corrupt chunks quarantine through the store's durable ledger
+  and yield ``None`` in position, so positional consumers stay aligned.
+  A stream worker dying mid-epoch (native library failure, injected
+  fault, OOM-killed thread) **degrades to the foreground single-stream
+  path** for the rest of the sequence — the epoch completes with
+  identical data, and the incident is counted (``ingest.degraded``).
+- :func:`device_batches` — the host→device stage: double-buffered
+  ``device_put`` against an optional sharding, with bounded retry behind
+  fault site ``ingest.transfer`` and one ``ingest.transfer`` span per
+  drained stream.
+
+Progress contract (docs/ARCHITECTURE.md §11): ``lease.beat()`` fires on
+the CONSUMER side at every delivered chunk and staged batch — main-thread
+only, so a wedged decode or transfer stops the beats and the supervisor's
+hang watchdog catches it (a side-thread heartbeat would beat straight
+through the hang).
+
+Fault sites (§10 scheme): ``ingest.decode`` (before each stream decode —
+an injected error kills that stream and exercises the degrade path),
+``ingest.transfer`` (inside the device-put retry scope). Deterministic
+matrix entries live in tests/test_resilience.py.
+
+Import discipline: jax is imported only inside :func:`device_batches`, so
+:func:`chunk_stream` (and everything the scrub/shard layers need) stays
+usable in jax-free processes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+from sparse_coding_tpu import obs
+from sparse_coding_tpu.resilience import lease
+from sparse_coding_tpu.resilience.errors import ChunkCorruptionError
+from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
+from sparse_coding_tpu.resilience.retry import retry_io
+
+logger = logging.getLogger(__name__)
+
+register_fault_site("ingest.decode",
+                    "async ingest stream decode — before each background "
+                    "chunk read (data/ingest.py chunk_stream); an injected "
+                    "error kills the stream and forces the degraded "
+                    "single-stream path")
+register_fault_site("ingest.transfer",
+                    "host->device batch transfer — inside device_batches' "
+                    "bounded-retry scope (data/ingest.py)")
+
+
+def _available_ram_bytes() -> Optional[int]:
+    try:
+        return (os.sysconf("SC_AVPHYS_PAGES") * os.sysconf("SC_PAGE_SIZE"))
+    except (ValueError, OSError, AttributeError):
+        return None
+
+
+def default_streams(chunk_nbytes: Optional[int] = None) -> int:
+    """Decode streams that actually pay: bounded by real cores (threaded
+    preads on a 1-CPU host just contend — native_io's measurement) AND,
+    when the decoded chunk size is known, by free host RAM — the stream
+    pipeline holds up to ``streams + 2`` decoded chunks resident
+    (lookahead + the one being consumed), and auto mode must never turn
+    a sweep that fit the serial reader's two-chunk bound into an OOM
+    kill (which would bypass the in-thread degrade path entirely)."""
+    from sparse_coding_tpu.data.native_io import _usable_cpus
+
+    n = max(1, min(4, _usable_cpus()))
+    if chunk_nbytes:
+        avail = _available_ram_bytes()
+        if avail is not None:
+            # streams + 2 resident decoded chunks must fit in half of
+            # currently-available RAM; below that, serial (streams=1,
+            # the old two-chunk bound) is the only safe answer
+            n = max(1, min(n, avail // (2 * int(chunk_nbytes)) - 2))
+    return n
+
+
+def _decoded_chunk_nbytes(store, indices, dtype) -> Optional[int]:
+    """Size of one decoded (cast to ``dtype``) chunk, from the first
+    SOUND index's .npy header alone — no payload read; skips ledger-
+    quarantined positions so a scrub-repaired hole at the front of a
+    shuffled order doesn't silently drop the RAM bound. None when it
+    can't be determined cheaply (pt stores, no sound chunk)."""
+    try:
+        from sparse_coding_tpu.data.native_io import _npy_header
+
+        quarantined = getattr(store, "quarantined", None) or set()
+        ci = next(i for i in indices if i not in quarantined)
+        _dt, shape, _off = _npy_header(store._path(ci))
+        return int(np.prod(shape)) * np.dtype(dtype).itemsize
+    except Exception:
+        return None
+
+
+def _serial_chunks(store, indices, dtype) -> Iterator[Optional[np.ndarray]]:
+    """The foreground single-stream path over any store: the degrade
+    target when a stream worker dies, and the generic fallback for stores
+    without their own serial reader. Same contract as chunk_stream:
+    positional Nones for quarantined chunks, a lease beat per delivery —
+    and the same ``ingest.decode`` span per delivered chunk, so a
+    decode-bound serial run (the streams=1 bench baseline, a degraded
+    epoch) reports its decode wall instead of a misleading 0.0."""
+    serial = getattr(store, "serial_chunk_reader", None)
+    if serial is not None:
+        it = serial(indices, dtype)
+        for ci in indices:
+            t0 = obs.monotime()
+            try:
+                chunk = next(it)
+            except StopIteration:  # reader ended early (defensive)
+                return
+            if chunk is not None:
+                obs.record_span("ingest.decode", obs.monotime() - t0,
+                                chunk=int(ci), rows=int(chunk.shape[0]))
+            yield chunk
+        return
+    for ci in indices:
+        ci = int(ci)
+        if store.quarantine_corrupt and ci in store.quarantined:
+            # a skipped position is still reader progress: a long run of
+            # ledger-known chunks must not starve the hang watchdog
+            lease.beat()
+            yield None
+            continue
+        t0 = obs.monotime()
+        try:
+            chunk = store.load_chunk(ci, dtype)
+        except ChunkCorruptionError as e:
+            if not store.quarantine_corrupt:
+                raise
+            store._quarantine(e)
+            chunk = None
+        if chunk is not None:
+            obs.record_span("ingest.decode", obs.monotime() - t0,
+                            chunk=ci, rows=int(chunk.shape[0]))
+        lease.beat()
+        yield chunk
+
+
+def chunk_stream(store, indices, dtype=np.float32, streams: Optional[int] = None,
+                 lookahead: Optional[int] = None) -> Iterator[Optional[np.ndarray]]:
+    """Yield in-RAM chunks for ``indices`` in order, with up to ``streams``
+    decodes concurrently in flight and at most ``lookahead`` decoded
+    chunks resident beyond the one being consumed (the host-RAM bound).
+
+    ``streams <= 1`` — and every ``pt``-format store, whose torch
+    deserialization is not a thread-friendly raw read — delegates to the
+    store's own single-stream reader, which keeps the native 1-slab
+    readahead contract. Otherwise each in-flight decode is one
+    ``store.load_chunk`` on a pool thread: digest verification, bounded
+    retry, and the durable quarantine ledger all apply unchanged, so this
+    pipeline changes WHEN chunks decode, never what arrives."""
+    indices = [int(i) for i in indices]
+    if streams is None:
+        streams = default_streams(_decoded_chunk_nbytes(store, indices,
+                                                        dtype))
+    if (streams <= 1 or not indices
+            or getattr(store, "format", "npy") == "pt"):
+        yield from _serial_chunks(store, indices, dtype)
+        return
+    if lookahead is None:
+        lookahead = streams + 1
+    lookahead = max(1, int(lookahead))
+
+    def decode(ci: int):
+        t0 = obs.monotime()
+        fault_point("ingest.decode")
+        chunk = store.load_chunk(ci, dtype)
+        return chunk, obs.monotime() - t0
+
+    pool = ThreadPoolExecutor(max_workers=int(streams),
+                              thread_name_prefix="ingest")
+    pending: deque = deque()  # (chunk_index, future | None) in delivery order
+    cursor = 0
+
+    def submit_up_to_lookahead() -> None:
+        nonlocal cursor
+        while cursor < len(indices) and len(pending) < lookahead:
+            ci = indices[cursor]
+            if store.quarantine_corrupt and ci in store.quarantined:
+                # ledger-known corrupt: never re-pay the read; the None
+                # placeholder keeps delivery positional
+                pending.append((ci, None))
+            else:
+                pending.append((ci, pool.submit(decode, ci)))
+            cursor += 1
+
+    try:
+        submit_up_to_lookahead()
+        while pending:
+            ci, fut = pending.popleft()
+            if fut is None:
+                chunk = None
+            else:
+                try:
+                    chunk, dur = fut.result()
+                    obs.record_span("ingest.decode", dur, chunk=ci,
+                                    rows=int(chunk.shape[0]))
+                except ChunkCorruptionError as e:
+                    if not store.quarantine_corrupt:
+                        raise
+                    store._quarantine(e)
+                    chunk = None
+                except Exception as e:
+                    # a stream worker died (not data corruption): finish
+                    # the epoch on the foreground single-stream path —
+                    # same chunks, same order, the incident counted and
+                    # visible in obs.report instead of a dead sweep
+                    obs.counter("ingest.degraded").inc()
+                    logger.warning(
+                        "ingest stream failed on chunk %d (%r); degrading "
+                        "to the foreground single-stream path for the "
+                        "remaining %d chunk(s)", ci, e,
+                        1 + len(pending) + len(indices) - cursor)
+                    pool.shutdown(wait=False, cancel_futures=True)
+                    # the failed chunk itself retries once, foreground
+                    yield from _serial_chunks(store, [ci], dtype)
+                    # decodes that already FINISHED in pending are not
+                    # thrown away (each can be a multi-GB read): drain
+                    # the done prefix in delivery order, then go serial
+                    while pending:
+                        ci2, fut2 = pending[0]
+                        if fut2 is not None and (not fut2.done()
+                                                 or fut2.cancelled()):
+                            break
+                        chunk2 = None
+                        if fut2 is not None:
+                            try:
+                                chunk2, dur2 = fut2.result()
+                                obs.record_span("ingest.decode", dur2,
+                                                chunk=ci2,
+                                                rows=int(chunk2.shape[0]))
+                            except ChunkCorruptionError as e2:
+                                if not store.quarantine_corrupt:
+                                    raise
+                                store._quarantine(e2)
+                            except Exception:
+                                break  # also died: re-reads serially
+                        pending.popleft()
+                        lease.beat()
+                        yield chunk2
+                        chunk2 = None
+                    rest = [c for c, _ in pending] + indices[cursor:]
+                    pending.clear()
+                    yield from _serial_chunks(store, rest, dtype)
+                    return
+            # consumer-side progress beat (main thread — a wedged decode
+            # stops these, by design)
+            lease.beat()
+            yield chunk
+            chunk = None  # drop before refilling: the RAM bound
+            submit_up_to_lookahead()
+    finally:
+        # early generator exit must not leave decode threads working for
+        # nobody; in-flight loads finish their current pread and exit
+        pool.shutdown(wait=False, cancel_futures=True)
+
+
+def device_batches(batches: Iterable[np.ndarray], sharding=None,
+                   buffer_size: int = 2) -> Iterator:
+    """Double-buffered host→device stage: batch i+1 transfers while batch
+    i computes (``jax.device_put`` is async, so a small lookahead queue
+    suffices). THE host→device implementation —
+    ``chunk_store.device_prefetch`` delegates here, so every training
+    driver shares identical delivery order plus the hardening contract:
+    transfers sit behind fault site ``ingest.transfer`` with bounded
+    retry, every staged batch beats the lease, and one
+    ``ingest.transfer`` span per drained stream records the host-side
+    stage wall (dispatch wait, not on-wire time — device_put is async)."""
+    import jax
+    import jax.numpy as jnp
+
+    queue: deque = deque()
+    it = iter(batches)
+    stage = {"batches": 0, "wait_s": 0.0}
+
+    def put(x):
+        t0 = obs.monotime()
+
+        def _put_once():
+            fault_point("ingest.transfer")
+            return (jnp.asarray(x) if sharding is None
+                    else jax.device_put(x, sharding))
+
+        out = retry_io(_put_once, attempts=3)
+        stage["wait_s"] += obs.monotime() - t0
+        stage["batches"] += 1
+        lease.beat()
+        return out
+
+    try:
+        try:
+            for _ in range(buffer_size):
+                queue.append(put(next(it)))
+        except StopIteration:
+            pass
+        while queue:
+            out = queue.popleft()
+            try:
+                queue.append(put(next(it)))
+            except StopIteration:
+                pass
+            yield out
+    finally:
+        if stage["batches"]:
+            obs.record_span("ingest.transfer", stage["wait_s"],
+                            batches=stage["batches"])

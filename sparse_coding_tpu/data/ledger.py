@@ -1,0 +1,95 @@
+"""Durable quarantine ledger for chunk folders.
+
+A reader that discovers a corrupt chunk must not keep that knowledge in
+process memory only: a supervised resume (crash-only contract, docs/
+ARCHITECTURE.md §11) would re-pay the multi-GB read + digest of a chunk
+that is KNOWN bad — or, with ``quarantine_corrupt=False``, retry it
+forever. The ledger is that knowledge on disk: ``quarantine.json`` next
+to ``meta.json``, one entry per quarantined chunk index, rewritten
+atomically (tmp+fsync+rename) on every addition and loaded by
+``ChunkStore.__init__`` so a fresh process starts already knowing.
+
+Deliberately jax-free (and import-light): the scrub step
+(:mod:`sparse_coding_tpu.data.scrub`) reads and writes the same ledger
+from a process that must be able to run against a wedged TPU tunnel.
+
+Entry values record only the failure ``reason`` and the chunk's file
+NAME — never an absolute path, so a store moved between hosts (or a
+chaos-matrix golden copy) keeps a byte-identical ledger.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from sparse_coding_tpu.resilience.atomic import atomic_write_text
+from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
+
+LEDGER_NAME = "quarantine.json"
+
+register_fault_site("ledger.write",
+                    "durable quarantine-ledger rewrite (data/ledger.py "
+                    "record_quarantine) — ChunkStore._quarantine degrades "
+                    "to in-memory-only on failure (read-only store, full "
+                    "disk); the scrub propagates, so a re-run converges")
+
+
+def ledger_path(folder: str | Path) -> Path:
+    return Path(folder) / LEDGER_NAME
+
+
+def load_quarantine(folder: str | Path) -> dict[int, dict]:
+    """``{chunk_index: {"reason": ..., "file": ...}}`` from the folder's
+    ledger; ``{}`` when missing. Atomic writes make torn ledgers
+    impossible, so an unreadable file means no valid ledger — treated as
+    empty rather than poisoning the reader (the chunk digests themselves
+    still catch any corruption the lost ledger knew about)."""
+    try:
+        raw = json.loads(ledger_path(folder).read_text())
+        return {int(k): dict(v) for k, v in raw.get("chunks", {}).items()}
+    except (OSError, ValueError, TypeError, AttributeError):
+        return {}
+
+
+def record_quarantine(folder: str | Path, chunk_index: int, reason: str,
+                      file_name: str = "") -> dict[int, dict]:
+    """Add (or overwrite) one ledger entry and rewrite the ledger
+    atomically; returns the updated entry map. Writing the same entry
+    twice produces byte-identical ledgers (sorted keys, stable dump) —
+    the idempotence the scrub resume path depends on."""
+    folder = Path(folder)
+    entries = load_quarantine(folder)
+    entries[int(chunk_index)] = {"reason": str(reason),
+                                 "file": str(file_name)}
+    _rewrite(folder, entries)
+    return entries
+
+
+def clear_quarantine(folder: str | Path,
+                     chunk_index: int) -> dict[int, dict]:
+    """Drop one ledger entry — the chunk HEALED (a re-harvest put a sound
+    file back at its position and a scrub verified it). Rewrites the
+    ledger atomically; when the last entry goes, the ledger file itself
+    is removed (readers treat a missing ledger as empty, and a
+    fully-healed store is byte-identical to one that never rotted).
+    Clearing an absent entry is a no-op. Returns the updated map."""
+    folder = Path(folder)
+    entries = load_quarantine(folder)
+    if entries.pop(int(chunk_index), None) is not None:
+        _rewrite(folder, entries)
+    return entries
+
+
+def _rewrite(folder: Path, entries: dict[int, dict]) -> None:
+    path = ledger_path(folder)
+    if not entries:
+        try:
+            path.unlink()
+        except FileNotFoundError:
+            pass
+        return
+    payload = {"version": 1,
+               "chunks": {str(k): entries[k] for k in sorted(entries)}}
+    fault_point("ledger.write")
+    atomic_write_text(path, json.dumps(payload, indent=2, sort_keys=True))

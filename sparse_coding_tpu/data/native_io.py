@@ -109,6 +109,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.chunkio_prefetch_wait.argtypes = [ctypes.c_void_p]
         lib.chunkio_prefetch_cancel.restype = None
         lib.chunkio_prefetch_cancel.argtypes = [ctypes.c_void_p]
+        # chunkio_prefetch_poll: a stale prebuilt .so may predate it —
+        # poll degrades to "unknown" (None) rather than making the whole
+        # library unusable
+        try:
+            lib.chunkio_prefetch_poll.restype = ctypes.c_int
+            lib.chunkio_prefetch_poll.argtypes = [ctypes.c_void_p]
+        except AttributeError:
+            pass
         _lib = lib
         return _lib
 
@@ -169,6 +177,21 @@ class NativePrefetcher:
         self._buffer = out
         self._size = out.nbytes
         return True
+
+    def poll(self) -> Optional[bool]:
+        """Non-blocking readiness check for the in-flight prefetch: True
+        when ``wait()`` will not block, False while the read is still in
+        flight, None when nothing is in flight or the loaded library
+        predates the poll entry point. Readiness primitive for a consumer
+        keeping several handles outstanding (chunk_stream currently
+        multiplexes pool threads over blocking ``load_chunk`` instead, so
+        no production path calls this yet)."""
+        if self._handle is None:
+            return None
+        lib = get_lib()
+        if not hasattr(lib, "chunkio_prefetch_poll"):
+            return None
+        return bool(lib.chunkio_prefetch_poll(ctypes.c_void_p(self._handle)))
 
     def wait(self) -> Optional[np.ndarray]:
         if self._handle is None:

@@ -1,0 +1,356 @@
+"""Self-healing sharded chunk store: shard dirs, sealed metas, one manifest.
+
+A single harvest process writing one flat chunk folder is the data
+plane's scaling ceiling (ROADMAP item 5): pod-scale sweeps and Group-SAE
+multi-layer harvests need WRITERS that parallelize and a store that
+localizes damage. The sharded layout is the smallest structure that buys
+both:
+
+```
+store/
+  manifest.json            # store-level truth, written LAST, atomically
+  shard-000/
+    0.npy 1.npy ...        # an ordinary ChunkStore folder
+    meta.json              # per-shard chunk digests (ChunkWriter.finalize)
+    shard.digest           # seal: sha256 of meta.json's bytes
+    quarantine.json        # durable quarantine ledger (data/ledger.py)
+  shard-001/ ...
+```
+
+- each shard is owned by ONE writer (a supervisor child —
+  `pipeline.steps shard_harvest --shard i`): writers share nothing, so
+  they parallelize across processes/hosts and a kill costs one shard's
+  in-flight chunk, nothing else;
+- a finished shard is **sealed**: `shard.digest` records the sha256 of
+  its `meta.json` bytes (crash barrier ``shard.finalize`` sits between
+  the two durable writes — the chaos matrix kills a real writer there);
+- `manifest.json` aggregates the sealed shards (names, chunk counts,
+  meta digests) and is written last and atomically behind fault site
+  ``shard.write`` — its presence certifies a complete store, exactly as
+  `meta.json` does for a flat folder.
+
+:class:`ShardedChunkStore` reads the manifest and presents ONE
+positional chunk index space (shard-major) with the full `ChunkStore`
+reader contract: digest-verified loads, durable per-shard quarantine
+ledgers, positional ``None`` for quarantined chunks, and multi-stream
+reads via :func:`data.ingest.chunk_stream`.
+
+Import discipline: module import stays jax-free (the scrub step and the
+manifest-building supervisor child run against a wedged tunnel);
+`ChunkStore` — whose module imports jax — loads lazily inside
+:class:`ShardedChunkStore`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Iterator, Optional
+
+import numpy as np
+
+from sparse_coding_tpu.data.ledger import load_quarantine
+from sparse_coding_tpu.resilience.atomic import atomic_write_text
+from sparse_coding_tpu.resilience.crash import crash_barrier, register_crash_site
+from sparse_coding_tpu.resilience.errors import (
+    ChunkCorruptionError,
+    ResilienceError,
+)
+from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
+from sparse_coding_tpu.resilience.manifest import bytes_sha256
+from sparse_coding_tpu.resilience.retry import retry_io
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_NAME = "manifest.json"
+SHARD_PREFIX = "shard-"
+SHARD_DIGEST_NAME = "shard.digest"
+
+register_fault_site("shard.write",
+                    "sharded-store durable writes: the per-shard "
+                    "shard.digest seal and the store-level manifest "
+                    "(data/shard_store.py, inside the bounded-retry scope)")
+register_crash_site("shard.finalize",
+                    "a shard's meta.json is durable, its shard.digest seal "
+                    "not yet written (data/shard_store.py "
+                    "write_shard_digest)")
+
+
+class ShardLayoutError(ResilienceError):
+    """A sharded store's on-disk structure contradicts itself: a shard
+    missing its meta or seal, a seal that no longer matches the meta
+    bytes, or shards disagreeing on activation width/dtype. Typed so the
+    manifest step fails loudly instead of aggregating a damaged store."""
+
+
+def shard_name(i: int) -> str:
+    return f"{SHARD_PREFIX}{int(i):03d}"
+
+
+def shard_dirs(root: str | Path) -> list[Path]:
+    """Existing shard directories in shard INDEX order — numeric, not
+    lexical: shard_name pads to 3 digits, so at >= 1000 shards a lexical
+    sort would interleave ("shard-1000" < "shard-999") and silently break
+    the bitwise shard-major concatenation contract."""
+    root = Path(root)
+    dirs = [p for p in root.glob(f"{SHARD_PREFIX}*") if p.is_dir()]
+    return sorted(dirs, key=lambda p: (int(p.name[len(SHARD_PREFIX):])
+                                       if p.name[len(SHARD_PREFIX):].isdigit()
+                                       else -1, p.name))
+
+
+def _durable_write(path: Path, text: str) -> None:
+    def _once():
+        fault_point("shard.write")
+        atomic_write_text(path, text)
+
+    retry_io(_once, attempts=3)
+
+
+def write_shard_digest(shard_dir: str | Path) -> str:
+    """Seal a completed shard: record sha256(meta.json bytes) in
+    ``shard.digest``. Idempotent — resealing an unchanged shard rewrites
+    identical bytes, which is what lets a killed writer's restart
+    converge bitwise. The ``shard.finalize`` crash barrier sits at the
+    worst instant: meta durable, seal not yet written."""
+    shard_dir = Path(shard_dir)
+    meta = shard_dir / "meta.json"
+    if not meta.exists():
+        raise ShardLayoutError(
+            f"cannot seal {shard_dir}: no meta.json (unfinalized shard)")
+    digest = bytes_sha256(meta.read_bytes())
+    crash_barrier("shard.finalize")
+    _durable_write(shard_dir / SHARD_DIGEST_NAME,
+                   json.dumps({"meta_sha256": digest}, sort_keys=True) + "\n")
+    return digest
+
+
+def read_shard_digest(shard_dir: str | Path) -> Optional[str]:
+    try:
+        raw = json.loads((Path(shard_dir) / SHARD_DIGEST_NAME).read_text())
+        return str(raw["meta_sha256"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def build_store_manifest(root: str | Path,
+                         expect_shards: Optional[int] = None) -> dict:
+    """Aggregate the sealed shards under ``root`` into ``manifest.json``
+    (written LAST, atomically — its presence certifies a complete store).
+    Every shard must be sealed and its seal must still match its meta
+    bytes; shards must agree on activation width and dtype. Byte-
+    deterministic: rebuilding over an unchanged store rewrites identical
+    bytes (the chaos-matrix contract)."""
+    root = Path(root)
+    dirs = shard_dirs(root)
+    if not dirs:
+        raise ShardLayoutError(f"no {SHARD_PREFIX}* directories in {root}")
+    if expect_shards is not None and len(dirs) != int(expect_shards):
+        raise ShardLayoutError(
+            f"{root}: expected {expect_shards} shard(s), found {len(dirs)}")
+    shards = []
+    dim: Optional[int] = None
+    dtype: Optional[str] = None
+    total = 0
+    for d in dirs:
+        meta_path = d / "meta.json"
+        if not meta_path.exists():
+            raise ShardLayoutError(f"{d} has no meta.json (unfinalized)")
+        meta_bytes = meta_path.read_bytes()
+        sealed = read_shard_digest(d)
+        if sealed is None:
+            raise ShardLayoutError(f"{d} is not sealed (no shard.digest)")
+        got = bytes_sha256(meta_bytes)
+        if got != sealed:
+            raise ShardLayoutError(
+                f"{d}: meta.json changed after sealing "
+                f"({got[:12]}… != {sealed[:12]}…) — damaged or tampered "
+                "shard; re-harvest or re-seal it deliberately")
+        meta = json.loads(meta_bytes)
+        d_dim = int(meta["activation_dim"])
+        d_dtype = str(meta.get("dtype", ""))
+        if dim is None:
+            dim, dtype = d_dim, d_dtype
+        elif (d_dim, d_dtype) != (dim, dtype):
+            raise ShardLayoutError(
+                f"{d}: activation_dim/dtype {(d_dim, d_dtype)} disagrees "
+                f"with earlier shards {(dim, dtype)}")
+        n = int(meta["n_chunks"])
+        total += n
+        shards.append({"name": d.name, "n_chunks": n, "meta_sha256": got})
+    manifest = {"version": 1, "kind": "sharded_chunk_store",
+                "n_shards": len(shards), "n_chunks": total,
+                "activation_dim": dim, "dtype": dtype, "shards": shards}
+    _durable_write(root / MANIFEST_NAME,
+                   json.dumps(manifest, indent=2, sort_keys=True))
+    return manifest
+
+
+def read_store_manifest(root: str | Path) -> Optional[dict]:
+    path = Path(root) / MANIFEST_NAME
+    if not path.exists():
+        return None
+    return json.loads(path.read_text())
+
+
+class ShardedChunkStore:
+    """Reader over a sharded store: one positional chunk index space
+    (shard-major, per the manifest's shard order) with the ChunkStore
+    contract — so the sweep, eval, and streaming metrics run over a
+    sharded store unchanged. Corruption stays shard-local: quarantine
+    ledgers, digests, and scrub repairs all live in the owning shard."""
+
+    def __init__(self, root: str | Path, quarantine_corrupt: bool = False,
+                 verify_digests: bool = True, io_retries: int = 3):
+        from sparse_coding_tpu.data.chunk_store import ChunkStore
+
+        self.folder = Path(root)
+        manifest = read_store_manifest(self.folder)
+        if manifest is None:
+            raise FileNotFoundError(
+                f"no {MANIFEST_NAME} in {self.folder} — not a (complete) "
+                "sharded store; build_store_manifest aggregates sealed "
+                "shards")
+        self.meta = manifest
+        self.quarantine_corrupt = bool(quarantine_corrupt)
+        self.format = "npy"
+        self.shards: list = []
+        self._offsets: list[int] = []
+        off = 0
+        for s in manifest["shards"]:
+            store = ChunkStore(self.folder / s["name"],
+                               quarantine_corrupt=quarantine_corrupt,
+                               verify_digests=verify_digests,
+                               io_retries=io_retries)
+            if store.n_chunks != int(s["n_chunks"]):
+                raise ShardLayoutError(
+                    f"{store.folder}: meta says {store.n_chunks} chunk(s), "
+                    f"manifest says {s['n_chunks']} — stale manifest?")
+            self._offsets.append(off)
+            off += int(s["n_chunks"])
+            self.shards.append(store)
+        self.n_total = off
+        self.activation_dim = int(manifest["activation_dim"])
+
+    @property
+    def n_chunks(self) -> int:
+        return self.n_total
+
+    @property
+    def quarantined(self) -> set[int]:
+        """Global indices of quarantined chunks, unioned from every
+        shard's (durable) ledger-backed set."""
+        out: set[int] = set()
+        for store, off in zip(self.shards, self._offsets):
+            out.update(off + li for li in store.quarantined)
+        return out
+
+    def _locate(self, i: int):
+        i = int(i)
+        if not 0 <= i < self.n_total:
+            raise IndexError(f"chunk {i} out of range [0, {self.n_total})")
+        for store, off in zip(reversed(self.shards),
+                              reversed(self._offsets)):
+            if i >= off:
+                return store, i - off, off
+        raise IndexError(i)  # unreachable: offsets start at 0
+
+    def _path(self, i: int) -> Path:
+        store, local, _off = self._locate(i)
+        return store._path(local)
+
+    def load_chunk(self, i: int, dtype=np.float32) -> np.ndarray:
+        store, local, _off = self._locate(i)
+        try:
+            return store.load_chunk(local, dtype)
+        except ChunkCorruptionError as e:
+            # re-type with the GLOBAL index (positional consumers and
+            # operators see store coordinates; the path still names the
+            # shard file)
+            raise ChunkCorruptionError(int(i), e.path, e.reason) from e
+
+    def _quarantine(self, err: ChunkCorruptionError) -> None:
+        """Route a (global-index) quarantine into the owning shard's
+        durable ledger, preserving the shard-local index on disk."""
+        store, local, _off = self._locate(err.chunk_index)
+        store._quarantine(ChunkCorruptionError(local, err.path, err.reason))
+
+    def chunk_mean(self, i: int = 0) -> np.ndarray:
+        return self.load_chunk(i).mean(axis=0)
+
+    @property
+    def center(self) -> Optional[np.ndarray]:
+        # sharded harvests are written uncentered (each shard writer only
+        # ever sees its own rows; a shared translation would need a
+        # cross-shard reduction step — not provided yet)
+        return None
+
+    def batches(self, chunk: np.ndarray, batch_size: int,
+                rng: np.random.Generator,
+                drop_last: bool = True) -> Iterator[np.ndarray]:
+        from sparse_coding_tpu.data.chunk_store import shuffled_batches
+
+        return shuffled_batches(chunk, batch_size, rng, drop_last)
+
+    # NOTE deliberately no serial_chunk_reader here: the foreground
+    # single-stream path (the ingest degrade target) is ingest.py's
+    # generic fallback loop — load_chunk + positional-None quarantine +
+    # per-chunk beats — which this class satisfies by contract. The flat
+    # ChunkStore DOES define one (aliasing its chunk_reader) to keep the
+    # native 1-slab readahead; a sharded store has no equivalent slab.
+
+    def chunk_reader(self, indices,
+                     dtype=np.float32) -> Iterator[Optional[np.ndarray]]:
+        """Multi-stream reader (data/ingest.py): decodes overlap across
+        shards — which is exactly where sharding pays, since each
+        stream's pread hits a different shard's files."""
+        from sparse_coding_tpu.data.ingest import chunk_stream
+
+        return chunk_stream(self, indices, dtype)
+
+    def epoch(self, batch_size: int, rng: np.random.Generator,
+              n_repetitions: int = 1,
+              dtype=np.float32) -> Iterator[np.ndarray]:
+        order = np.concatenate([rng.permutation(self.n_chunks)
+                                for _ in range(n_repetitions)])
+        for chunk in self.chunk_reader(order, dtype):
+            if chunk is None:  # quarantined (quarantine_corrupt=True)
+                continue
+            yield from self.batches(chunk, batch_size, rng)
+
+    def shard_quarantine_ledgers(self) -> dict[str, dict[int, dict]]:
+        """{shard name: its ledger entries} — the operator's one-call view
+        of everything the store has durably quarantined."""
+        return {s.folder.name: load_quarantine(s.folder)
+                for s in self.shards}
+
+
+def first_sound_chunk(store) -> int:
+    """Index of the first chunk the store can actually deliver — skips
+    ledger-quarantined positions, so every one-chunk consumer (sweep
+    centering, eval batch, baseline fits, centered-experiment PCA) rides
+    a scrub-repaired store instead of crashing into the hole the scrub
+    just healed. Raises when EVERY chunk is quarantined."""
+    quarantined = getattr(store, "quarantined", None) or set()
+    try:
+        return next(i for i in range(store.n_chunks)
+                    if i not in quarantined)
+    except StopIteration:
+        raise RuntimeError(
+            f"{getattr(store, 'folder', store)}: every chunk is "
+            "quarantined — nothing sound to read "
+            "(see scrub/reharvest.json)") from None
+
+
+def open_store(folder: str | Path, **kwargs):
+    """The one store-opening entry point: a folder with a store-level
+    ``manifest.json`` opens as a :class:`ShardedChunkStore`, anything
+    else as a flat :class:`ChunkStore` — so sweep/eval/bench code is
+    layout-agnostic."""
+    folder = Path(folder)
+    if (folder / MANIFEST_NAME).exists():
+        return ShardedChunkStore(folder, **kwargs)
+    from sparse_coding_tpu.data.chunk_store import ChunkStore
+
+    return ChunkStore(folder, **kwargs)

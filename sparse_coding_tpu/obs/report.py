@@ -173,6 +173,25 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "admission_level":
             gauges.get("gateway.admission_level", {}).get("value"),
     }
+    # data-plane evidence (docs/ARCHITECTURE.md §15): the async ingest
+    # pipeline's per-stage walls (decode vs host→device staging vs the
+    # whole sweep.chunk block — "compute-bound" means decode stops
+    # dominating sweep.chunk), stream-death degradations, and the scrub's
+    # verify/quarantine tallies — one place an operator reads a data
+    # incident out of, alongside the latency and compile evidence
+    def _span_wall(name: str) -> float:
+        s = span_stats.get(name)
+        return float(s["total_s"]) if s else 0.0
+
+    ingest = {
+        "decode_s": _span_wall("ingest.decode"),
+        "transfer_s": _span_wall("ingest.transfer"),
+        "sweep_chunk_s": _span_wall("sweep.chunk"),
+        "decoded_chunks": span_stats.get("ingest.decode", {}).get("count", 0),
+        "degraded_streams": counters.get("ingest.degraded", 0),
+        "scrub_checked": counters.get("scrub.chunks_checked", 0),
+        "scrub_quarantined": counters.get("scrub.chunks_quarantined", 0),
+    }
     return {
         "run_dir": str(run_dir),
         "run_ids": sorted(run_ids),
@@ -190,6 +209,7 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "compiles": counters.get("jax.compiles", 0),
         "compile_cache": compile_cache,
         "gateway": gateway,
+        "ingest": ingest,
         "dropped_events": counters.get("obs.sink.dropped", 0),
     }
 
@@ -248,6 +268,17 @@ def format_report(report: dict) -> str:
             lines.append(f"  shed: {shed}")
         if routes:
             lines.append(f"  routes: {routes}")
+    ing = report.get("ingest", {})
+    if any(ing.get(k) for k in ("decoded_chunks", "degraded_streams",
+                                "scrub_checked", "scrub_quarantined")):
+        lines.append(
+            f"ingest: {ing['decoded_chunks']} async decode(s) "
+            f"({_fmt_s(ing['decode_s'])} decoding, "
+            f"{_fmt_s(ing['transfer_s'])} staging, "
+            f"{_fmt_s(ing['sweep_chunk_s'])} sweep.chunk), "
+            f"{ing['degraded_streams']} stream death(s) degraded; "
+            f"scrub {ing['scrub_checked']} checked / "
+            f"{ing['scrub_quarantined']} quarantined")
     interesting = {k: v for k, v in report["counters"].items()
                    if not k.startswith(("jax.retraces", "jax.compiles"))}
     if interesting:

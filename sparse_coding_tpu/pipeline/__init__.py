@@ -20,6 +20,7 @@ from sparse_coding_tpu.pipeline.supervisor import (
     StepHung,
     Supervisor,
     build_pipeline,
+    build_sharded_pipeline,
     load_or_create_run_id,
     step_argv,
     supervise_bench,
@@ -34,6 +35,7 @@ __all__ = [
     "StepHung",
     "Supervisor",
     "build_pipeline",
+    "build_sharded_pipeline",
     "load_or_create_run_id",
     "step_argv",
     "supervise_bench",

@@ -26,8 +26,10 @@ the same config must produce byte-identical artifacts.
 from __future__ import annotations
 
 import json
+import os
 import sys
 from pathlib import Path
+from typing import Optional
 
 import numpy as np
 
@@ -64,12 +66,19 @@ def run_harvest(config: dict) -> None:
         _lm_harvest(cfg)
 
 
-def _synthetic_harvest(cfg: dict) -> None:
+def _synthetic_harvest(cfg: dict, folder: Path = None,
+                       row_range: tuple = None) -> None:
     """Deterministic synthetic activation store with crash-resume: the
     generator stream is replayed from its seed and the rows already
     covered by durable chunks are skipped, so the finished store —
     chunks, digests, meta — is byte-identical however many times the
-    process died along the way."""
+    process died along the way.
+
+    ``row_range=(lo, hi)`` writes only that slice of the generator stream
+    into ``folder`` — the sharded-writer case: every shard writer replays
+    the SAME seeded stream and keeps its own rows, so N writers sharing
+    nothing produce a store whose concatenation is bitwise the unsharded
+    harvest's."""
     import jax
 
     from sparse_coding_tpu.data.chunk_store import (
@@ -78,7 +87,7 @@ def _synthetic_harvest(cfg: dict) -> None:
     )
     from sparse_coding_tpu.data.synthetic import RandomDatasetGenerator
 
-    folder = Path(cfg["dataset_folder"])
+    folder = Path(cfg["dataset_folder"]) if folder is None else folder
     dim = int(cfg["activation_dim"])
     total = int(cfg["dataset_size"])
     n_chunks = int(cfg.get("n_chunks", 4))
@@ -87,6 +96,7 @@ def _synthetic_harvest(cfg: dict) -> None:
     rows_per_chunk = total // n_chunks
     bytes_per_row = dim * np.dtype(np.float16 if dtype == "float16"
                                    else np.float32).itemsize
+    lo_row, hi_row = row_range if row_range is not None else (0, total)
     k = complete_chunk_count(folder)
     gen = RandomDatasetGenerator.create(
         jax.random.PRNGKey(seed), dim, int(cfg["n_ground_truth_features"]),
@@ -96,20 +106,24 @@ def _synthetic_harvest(cfg: dict) -> None:
     writer = ChunkWriter(folder, dim,
                          chunk_size_gb=rows_per_chunk * bytes_per_row / 2**30,
                          dtype=dtype, start_index=k)
-    skip_rows = k * writer.rows_per_chunk
+    skip_rows = lo_row + k * writer.rows_per_chunk
     key = jax.random.PRNGKey(seed + 1)
     batch_rows = int(cfg.get("batch_rows", 8192))
     produced = 0
-    while produced < total:
+    while produced < hi_row:
         key, sub = jax.random.split(key)
         n = min(total - produced, batch_rows)
         if produced + n > skip_rows:
             batch = np.asarray(jax.device_get(gen.batch(sub, n)))
-            lo = max(0, skip_rows - produced)
-            writer.add(batch[lo:])
+            b_lo = max(0, skip_rows - produced)
+            b_hi = min(n, hi_row - produced)
+            if b_hi > b_lo:
+                writer.add(batch[b_lo:b_hi])
         produced += n
         lease.beat()
-    writer.finalize({"synthetic": True, "seed": seed})
+    writer.finalize({"synthetic": True, "seed": seed,
+                     **({"row_range": [lo_row, hi_row]}
+                        if row_range is not None else {})})
 
 
 def _lm_harvest(cfg: dict) -> None:
@@ -142,6 +156,114 @@ def _lm_harvest(cfg: dict) -> None:
         chunk_size_gb=float(cfg["chunk_size_gb"]),
         skip_chunks=complete_chunk_count(folder),
         dtype=cfg.get("dtype", "float16"))
+
+
+def run_shard_harvest(config: dict, shard: int) -> None:
+    """One PARALLEL harvest writer owning one shard (ISSUE 8 tentpole):
+    ``config["harvest"]`` plus ``n_shards`` — this child writes
+    ``<dataset_folder>/shard-<i>/`` and NOTHING else, so shard writers
+    share no files and can run as concurrent supervisor children on a
+    pod (this container runs them serially — one jax process at a time,
+    CLAUDE.md — but the DAG carries no edges between them).
+
+    The shard covers rows ``[i*per_shard, (i+1)*per_shard)`` of the same
+    seeded generator stream the unsharded harvest replays, so the store's
+    shard-major concatenation is bitwise the unsharded harvest. Resume is
+    the flat harvest's contract per shard: durable chunk prefix + row
+    skip; a finished shard re-seals idempotently (``shard.finalize``
+    crash barrier inside ``write_shard_digest``)."""
+    from sparse_coding_tpu.data.shard_store import shard_name, write_shard_digest
+
+    cfg = config["harvest"]
+    if cfg.get("mode", "synthetic") != "synthetic":
+        raise ValueError(
+            "sharded harvest currently supports mode='synthetic' only "
+            "(the LM path needs a token-row partitioner first)")
+    n_shards = int(cfg["n_shards"])
+    shard = int(shard)
+    if not 0 <= shard < n_shards:
+        raise ValueError(f"shard {shard} out of range [0, {n_shards})")
+    total = int(cfg["dataset_size"])
+    n_chunks = int(cfg.get("n_chunks", 4))
+    if total % n_chunks or n_chunks % n_shards:
+        raise ValueError(
+            f"dataset_size={total} must divide into n_chunks={n_chunks} "
+            f"and n_chunks into n_shards={n_shards} for bitwise-stable "
+            "shard boundaries")
+    folder = Path(cfg["dataset_folder"]) / shard_name(shard)
+    per_shard = total // n_shards
+    if not (folder / "meta.json").exists():
+        from sparse_coding_tpu.data.chunk_store import clean_write_debris
+
+        folder.mkdir(parents=True, exist_ok=True)
+        clean_write_debris(folder)  # tmp debris from a killed writer
+        _synthetic_harvest(cfg, folder=folder,
+                           row_range=(shard * per_shard,
+                                      (shard + 1) * per_shard))
+    # seal (idempotent): meta durable -> crash barrier -> shard.digest
+    write_shard_digest(folder)
+
+
+def run_store_manifest(config: dict) -> None:
+    """Aggregate the sealed shards into the store-level manifest (the
+    sharded store's completeness marker). Backend-free — never touches a
+    jax device, so the step runs against a wedged tunnel. A manifest
+    that already matches the configured shard count is idempotent-skip;
+    one from a run with a DIFFERENT n_shards is rebuilt (byte-
+    deterministic) — silently training on the stale subset it lists
+    would ignore the shards this run just harvested."""
+    from sparse_coding_tpu.data.shard_store import (
+        build_store_manifest,
+        read_store_manifest,
+    )
+
+    cfg = config["harvest"]
+    folder = Path(cfg["dataset_folder"])
+    n_shards = int(cfg["n_shards"])
+    existing = read_store_manifest(folder)
+    if existing is not None and int(existing.get("n_shards", -1)) == n_shards:
+        return  # complete store at THIS shard count: idempotent
+    build_store_manifest(folder, expect_shards=n_shards)
+
+
+SCRUB_MARKER_NAME = "scrub.done.json"
+
+
+def scrub_marker_path() -> Optional[Path]:
+    """RUN-scoped scrub completion marker: ``<run_dir>/scrub.done.json``,
+    derived from the obs dir the supervisor exports to every child
+    (``<run_dir>/obs``). None outside a supervised run (bare
+    ``run_scrub`` invocations just run — the scrub is idempotent)."""
+    obs_dir = os.environ.get(obs.ENV_OBS_DIR)
+    if not obs_dir:
+        return None
+    return Path(obs_dir).parent / SCRUB_MARKER_NAME
+
+
+def run_scrub(config: dict) -> None:
+    """Scrub DAG node: re-verify every chunk digest between harvest and
+    sweep, quarantine/repair corrupt chunks, emit the re-harvest
+    worklist. Backend-free (data/scrub.py) — schedulable while the
+    tunnel is wedged. ``config["scrub"]``: ``repair`` (default true).
+
+    The completion marker is RUN-scoped (``<run_dir>/scrub.done.json``),
+    never the store-resident report: a finished run's report must not
+    make a LATER run over the same store skip its scrub — re-verifying a
+    store that has had time to rot (and clearing ledger entries for
+    chunks a re-harvest healed) is the step's whole point. Within one
+    run the marker keeps the resume idempotent; the scrub itself is
+    idempotent and byte-deterministic anyway."""
+    from sparse_coding_tpu.data.scrub import scrub_store
+
+    cfg = config.get("scrub", {})
+    store = Path(config["harvest"]["dataset_folder"])
+    marker = scrub_marker_path()
+    if marker is not None and marker.exists():
+        return  # resume within THIS run: already scrubbed
+    report = scrub_store(store, repair=bool(cfg.get("repair", True)))
+    if marker is not None:
+        atomic_write_text(marker,
+                          json.dumps(report, indent=2, sort_keys=True))
 
 
 def run_sweep(config: dict) -> None:
@@ -179,7 +301,10 @@ def run_eval(config: dict) -> None:
     atomically behind the ``eval.write`` crash barrier."""
     import jax.numpy as jnp
 
-    from sparse_coding_tpu.data.chunk_store import ChunkStore
+    from sparse_coding_tpu.data.shard_store import (
+        first_sound_chunk,
+        open_store,
+    )
     from sparse_coding_tpu.metrics.core import (
         fraction_variance_unexplained,
         mean_l0,
@@ -196,8 +321,11 @@ def run_eval(config: dict) -> None:
     pkl = (Path(config["sweep"]["ensemble"]["output_folder"]) / "final"
            / f"{name}_learned_dicts.pkl")
     tagged = load_learned_dicts(pkl)
-    store = ChunkStore(config["harvest"]["dataset_folder"])
-    chunk = store.load_chunk(0)
+    store = open_store(config["harvest"]["dataset_folder"],
+                       quarantine_corrupt=True)
+    # first non-quarantined chunk: a scrub-repaired store must still
+    # evaluate (the self-healing contract), it just skips the holes
+    chunk = store.load_chunk(first_sound_chunk(store))
     rng = np.random.default_rng(int(cfg.get("seed", 0)))
     rows = rng.permutation(chunk.shape[0])[:int(cfg.get("n_eval_rows", 2048))]
     eval_batch = jnp.asarray(chunk[rows], jnp.float32)
@@ -215,15 +343,26 @@ def run_eval(config: dict) -> None:
          "dicts": records}, indent=2))
 
 
-STEPS = {"harvest": run_harvest, "sweep": run_sweep, "eval": run_eval}
+STEPS = {"harvest": run_harvest, "shard_harvest": run_shard_harvest,
+         "manifest": run_store_manifest, "scrub": run_scrub,
+         "sweep": run_sweep, "eval": run_eval}
 
 
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
-    if len(argv) != 3 or argv[1] != "--config" or argv[0] not in STEPS:
+    shard = None
+    if "--shard" in argv:
+        at = argv.index("--shard")
+        if at + 1 >= len(argv) or not argv[at + 1].lstrip("-").isdigit():
+            raise SystemExit("--shard requires an integer value")
+        shard = int(argv[at + 1])
+        del argv[at:at + 2]
+    if len(argv) != 3 or argv[1] != "--config" or argv[0] not in STEPS \
+            or (argv[0] == "shard_harvest") != (shard is not None):
         raise SystemExit(
             f"usage: python -m sparse_coding_tpu.pipeline.steps "
-            f"{{{'|'.join(STEPS)}}} --config pipeline.json")
+            f"{{{'|'.join(STEPS)}}} --config pipeline.json "
+            "[--shard I  (shard_harvest only)]")
     step, config_path = argv[0], argv[2]
     # claim the lease before any real work: from here on, silence = hang
     lease.configure_from_env(step=step)
@@ -243,7 +382,10 @@ def main(argv=None) -> None:
     config = json.loads(Path(config_path).read_text())
     try:
         with obs.span(f"step.{step}"):
-            STEPS[step](config)
+            if shard is not None:
+                STEPS[step](config, shard)
+            else:
+                STEPS[step](config)
     finally:
         obs.update_memory_gauges()
         obs.flush_metrics()

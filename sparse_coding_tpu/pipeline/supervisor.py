@@ -450,6 +450,19 @@ def build_pipeline(run_dir: str | Path, config: dict,
     dropped): an operator re-running just the eval over finished sweep
     artifacts — or the chaos matrix seeding a case from golden copies —
     names the steps it wants."""
+    cfg_path, anchor = _persist_pipeline_config(run_dir, config)
+    dataset = anchor(config["harvest"]["dataset_folder"])
+    steps = [
+        Step("harvest", step_argv("harvest", cfg_path),
+             done=lambda: (dataset / "meta.json").exists()),
+    ] + _sweep_eval_steps(cfg_path, config, anchor, sweep_dep="harvest")
+    return _prune(steps, only)
+
+
+def _persist_pipeline_config(run_dir: str | Path, config: dict):
+    """Shared builder preamble: persist the config into the run dir (a
+    restarted supervisor or an operator rebuilds the exact pipeline from
+    disk) and return ``(cfg_path, anchor)``."""
     import json
 
     from sparse_coding_tpu.resilience.atomic import atomic_write_text
@@ -466,19 +479,27 @@ def build_pipeline(run_dir: str | Path, config: dict,
         p = Path(p)
         return p if p.is_absolute() else REPO_ROOT / p
 
-    dataset = anchor(config["harvest"]["dataset_folder"])
+    return cfg_path, anchor
+
+
+def _sweep_eval_steps(cfg_path: Path, config: dict, anchor,
+                      sweep_dep: str) -> list[Step]:
+    """The sweep → eval DAG tail, shared by every pipeline builder so the
+    step argv, dependency shape, and done() markers cannot drift between
+    the flat and sharded data planes."""
     sweep_out = anchor(config["sweep"]["ensemble"]["output_folder"])
     eval_out = anchor(config["eval"]["output_folder"])
     name = config["sweep"].get("experiment", "dense_l1_range")
-    steps = [
-        Step("harvest", step_argv("harvest", cfg_path),
-             done=lambda: (dataset / "meta.json").exists()),
-        Step("sweep", step_argv("sweep", cfg_path), deps=("harvest",),
+    return [
+        Step("sweep", step_argv("sweep", cfg_path), deps=(sweep_dep,),
              done=lambda: (sweep_out / "final"
                            / f"{name}_learned_dicts.pkl").exists()),
         Step("eval", step_argv("eval", cfg_path), deps=("sweep",),
              done=lambda: (eval_out / "eval.json").exists()),
     ]
+
+
+def _prune(steps: list[Step], only: Optional[Sequence[str]]) -> list[Step]:
     if only is None:
         return steps
     keep = set(only)
@@ -491,6 +512,69 @@ def build_pipeline(run_dir: str | Path, config: dict,
             s.deps = tuple(d for d in s.deps if d in keep)
             pruned.append(s)
     return pruned
+
+
+def _manifest_matches(dataset: Path, n_shards: int) -> bool:
+    from sparse_coding_tpu.data.shard_store import read_store_manifest
+
+    m = read_store_manifest(dataset)
+    return m is not None and int(m.get("n_shards", -1)) == n_shards
+
+
+def build_sharded_pipeline(run_dir: str | Path, config: dict,
+                           only: Optional[Sequence[str]] = None) -> list[Step]:
+    """The sharded data-plane DAG (ISSUE 8 tentpole):
+
+        harvest-<i> (one writer child per shard, no edges between them)
+          → manifest (aggregate sealed shards, backend-free)
+          → scrub (digest re-verify + quarantine/repair, backend-free)
+          → sweep → eval
+
+    ``config["harvest"]["n_shards"]`` sets the writer count. The shard
+    writers carry NO dependency edges on each other — on a pod they run
+    concurrently (each owns its shard directory and nothing else); this
+    container's supervisor executes them serially, which is the same DAG
+    under the one-jax-process rule. Each writer is the flat harvest's
+    crash-only contract scoped to its shard: durable chunk prefix + row
+    skip on resume, ``shard.finalize`` crash barrier at the seal.
+    ``done()`` for a writer is its shard's SEAL (digest after meta), for
+    the manifest the store-level ``manifest.json``, for the scrub the
+    RUN-scoped ``<run_dir>/scrub.done.json`` (store-resident markers
+    would make every later run over the same store skip its scrub)."""
+    from sparse_coding_tpu.data.shard_store import (
+        SHARD_DIGEST_NAME,
+        shard_name,
+    )
+    from sparse_coding_tpu.pipeline.steps import SCRUB_MARKER_NAME
+
+    cfg_path, anchor = _persist_pipeline_config(run_dir, config)
+    dataset = anchor(config["harvest"]["dataset_folder"])
+    # RUN-scoped (unlike every store-resident marker above/below): a
+    # later run over the same store must scrub again — see run_scrub
+    scrub_done = Path(run_dir) / SCRUB_MARKER_NAME
+    n_shards = int(config["harvest"]["n_shards"])
+
+    def sealed(i: int) -> Callable[[], bool]:
+        d = dataset / shard_name(i)
+        return lambda: ((d / "meta.json").exists()
+                        and (d / SHARD_DIGEST_NAME).exists())
+
+    writers = [Step(f"harvest-{i}",
+                    step_argv("shard_harvest", cfg_path)
+                    + ["--shard", str(i)],
+                    done=sealed(i))
+               for i in range(n_shards)]
+    steps = writers + [
+        Step("manifest", step_argv("manifest", cfg_path),
+             deps=tuple(w.name for w in writers),
+             # presence is not enough: a manifest from a run with a
+             # different n_shards lists a stale shard subset — the step
+             # rebuilds it (run_store_manifest applies the same check)
+             done=lambda: _manifest_matches(dataset, n_shards)),
+        Step("scrub", step_argv("scrub", cfg_path), deps=("manifest",),
+             done=scrub_done.exists),
+    ] + _sweep_eval_steps(cfg_path, config, anchor, sweep_dep="scrub")
+    return _prune(steps, only)
 
 
 def supervise_bench(run_dir: str | Path, *, max_attempts: int = 2,

@@ -29,6 +29,10 @@ Canonical sites (hosts register theirs at import, like fault sites):
                       not yet written (the torn-tail instant)
 ``xcache.store``      xcache/store.py — executable-cache entry durable,
                       LRU manifest not yet updated
+``shard.finalize``    data/shard_store.py — a shard's meta.json durable,
+                      its shard.digest seal NOT yet written
+``scrub.repair``      data/scrub.py — quarantine ledger entry durable, the
+                      corrupt chunk file not yet moved aside
 ====================  =====================================================
 
 The chaos matrix (tests/test_pipeline_chaos.py, marker ``chaos``) kills a
@@ -68,6 +72,13 @@ CRASH_SITES: dict[str, str] = {
                       "written (obs/sink.py — the torn-tail instant)",
     "xcache.store": "executable-cache entry durable, LRU manifest not yet "
                     "updated (xcache/store.py)",
+    # seeded here (not only registered at host import) because a plan can
+    # be parsed at a child's FIRST barrier hit — often obs.sink.write at
+    # startup, before data/shard_store.py or data/scrub.py ever import
+    "shard.finalize": "a shard's meta.json is durable, its shard.digest "
+                      "seal not yet written (data/shard_store.py)",
+    "scrub.repair": "scrub: quarantine ledger entry durable, the corrupt "
+                    "chunk file not yet moved aside (data/scrub.py)",
 }
 
 

@@ -101,7 +101,7 @@ register_fault_site("gateway.hedge",
 register_fault_site("gateway.spare.activate",
                     "warm-spare activation — before the manifest-driven "
                     "warm set loads")
-register_crash_site("gateway.spare.activate",
+register_crash_site("gateway.spare.activate",  # lint: allow-unmatrixed-crash SIGKILL chaos case lives in tests/test_serve_gateway.py (real gateway at the barrier)
                     "warm spare fully loaded from the executable store, "
                     "not yet admitted to the routing set")
 

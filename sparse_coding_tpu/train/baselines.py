@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sparse_coding_tpu.data.chunk_store import ChunkStore
+from sparse_coding_tpu.data.shard_store import first_sound_chunk, open_store
 from sparse_coding_tpu.metrics.core import mean_nonzero_activations
 from sparse_coding_tpu.models import IdentityReLU, RandomDict
 from sparse_coding_tpu.models.ica import ICAEncoder
@@ -53,8 +53,8 @@ def run_layer_baselines(
     {name: LearnedDict}."""
     out = Path(output_folder)
     out.mkdir(parents=True, exist_ok=True)
-    store = ChunkStore(chunk_folder)
-    chunk = store.load_chunk(0)
+    store = open_store(chunk_folder)
+    chunk = store.load_chunk(first_sound_chunk(store))
     d = store.activation_dim
 
     if reference_dict is not None:

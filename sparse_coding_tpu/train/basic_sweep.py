@@ -18,6 +18,7 @@ import numpy as np
 
 from sparse_coding_tpu.config import EnsembleArgs
 from sparse_coding_tpu.data.chunk_store import ChunkStore, device_prefetch
+from sparse_coding_tpu.data.shard_store import open_store
 from sparse_coding_tpu.ensemble import Ensemble
 from sparse_coding_tpu.metrics.core import fraction_variance_unexplained, mean_l0
 from sparse_coding_tpu.models.sae import FunctionalSAE, FunctionalTiedSAE
@@ -44,7 +45,11 @@ def basic_l1_sweep(
     """Train one ensemble member per l1 value; save per-epoch artifacts.
     Returns the final list of (LearnedDict, hyperparams). scan_steps > 1
     fuses K steps per device program (see EnsembleArgs.scan_steps)."""
-    store = ChunkStore(dataset_dir)
+    # layout-agnostic: a store-level manifest.json opens the sharded
+    # reader, anything else the flat ChunkStore. quarantine_corrupt: a
+    # scrub-repaired store trains through positional holes (same
+    # contract as the ensemble sweep)
+    store = open_store(dataset_dir, quarantine_corrupt=True)
     d = store.activation_dim  # inferred from chunk 0, as basic_l1_sweep.py:59-62
     n_dict = int(d * dict_ratio)
     sig = FunctionalTiedSAE if tied else FunctionalSAE
@@ -101,8 +106,15 @@ def _save_epoch(ens: Ensemble, l1_values, dict_ratio, store: ChunkStore,
     tagged = [(ld, {"l1_alpha": float(l1), "dict_ratio": dict_ratio})
               for ld, l1 in zip(dicts, l1_values)]
     save_learned_dicts(tagged, out / "learned_dicts.pkl")
-    # quick eval on a fresh slab (reference logs fvu/sparsity per save)
-    chunk = store.load_chunk(int(rng.integers(store.n_chunks)))
+    # quick eval on a fresh slab (reference logs fvu/sparsity per save).
+    # Same RNG draw whatever the store's health; only a draw that lands
+    # on a scrub-repaired hole falls through to the first sound chunk
+    ci = int(rng.integers(store.n_chunks))
+    if ci in (store.quarantined or set()):
+        from sparse_coding_tpu.data.shard_store import first_sound_chunk
+
+        ci = first_sound_chunk(store)
+    chunk = store.load_chunk(ci)
     eval_batch = jnp.asarray(chunk[rng.permutation(chunk.shape[0])[:4096]])
     stats = []
     for ld, hyper in tagged:

@@ -411,9 +411,14 @@ def train_big_sae(cfg, store=None, mesh: Optional[Mesh] = None,
                   logger=None) -> BigSAEState:
     """Chunk-driven training loop (reference: process_main/process_reinit
     loops, huge_batch_size.py:150-335) with periodic resurrection."""
-    from sparse_coding_tpu.data.chunk_store import ChunkStore, device_prefetch
+    from sparse_coding_tpu.data.chunk_store import device_prefetch
+    from sparse_coding_tpu.data.shard_store import open_store
 
-    store = store or ChunkStore(cfg.dataset_folder)
+    # layout-agnostic: a store-level manifest.json opens the sharded
+    # reader, anything else the flat ChunkStore. quarantine_corrupt: a
+    # scrub-repaired store trains through positional holes (same
+    # contract as the ensemble sweep)
+    store = store or open_store(cfg.dataset_folder, quarantine_corrupt=True)
     state, optimizer, l1 = init_big_sae(
         jax.random.PRNGKey(cfg.seed), cfg.activation_dim, cfg.n_feats,
         cfg.l1_alpha, lr=cfg.lr)

@@ -30,9 +30,9 @@ DEFAULT_L1_RANGE = list(np.logspace(-4, -2, 16))  # big_sweep_experiments.py:295
 
 
 def _activation_dim(cfg: EnsembleArgs) -> int:
-    from sparse_coding_tpu.data.chunk_store import ChunkStore
+    from sparse_coding_tpu.data.shard_store import open_store
 
-    return ChunkStore(cfg.dataset_folder).activation_dim
+    return open_store(cfg.dataset_folder).activation_dim
 
 
 def dense_l1_range_experiment(cfg: EnsembleArgs, mesh=None,
@@ -179,9 +179,13 @@ def centered_l1_range_experiment(cfg: EnsembleArgs, mesh=None,
             "cfg.center_activations would double-shift the data relative to "
             "the stored transform")
     if centering is None:
-        from sparse_coding_tpu.data.chunk_store import ChunkStore
+        from sparse_coding_tpu.data.shard_store import (
+            first_sound_chunk,
+            open_store,
+        )
 
-        acts = ChunkStore(cfg.dataset_folder).load_chunk(0)
+        store = open_store(cfg.dataset_folder)
+        acts = store.load_chunk(first_sound_chunk(store))
         pca = BatchedPCA(acts.shape[-1])
         pca.train_batch(acts)
         mean, rot, inv_std = pca.get_centering_transform()

@@ -35,9 +35,10 @@ from sparse_coding_tpu.config import EnsembleArgs, SyntheticEnsembleArgs
 from sparse_coding_tpu.data.chunk_store import (
     ChunkStore,
     ChunkWriter,
-    device_prefetch,
     window_stacks,
 )
+from sparse_coding_tpu.data.ingest import chunk_stream, device_batches
+from sparse_coding_tpu.data.shard_store import first_sound_chunk, open_store
 from sparse_coding_tpu.ensemble import Ensemble, EnsembleGroup
 from sparse_coding_tpu.metrics.core import (
     fraction_variance_unexplained,
@@ -206,7 +207,12 @@ def sweep(
         if isinstance(cfg, SyntheticEnsembleArgs):
             store = init_synthetic_dataset(cfg)
         else:
-            store = ChunkStore(cfg.dataset_folder)
+            # layout-agnostic: a store-level manifest.json opens the
+            # sharded reader, anything else the flat ChunkStore.
+            # quarantine_corrupt: a scrub-repaired store (chunks moved
+            # aside, ledger knows) must train through positional Nones,
+            # not crash the sweep the scrub just healed
+            store = open_store(cfg.dataset_folder, quarantine_corrupt=True)
 
     if mesh is None and (cfg.mesh_data > 1 or cfg.mesh_model > 1):
         mesh = make_mesh(cfg.mesh_model, cfg.mesh_data)
@@ -230,7 +236,11 @@ def sweep(
 
     center = None
     if cfg.center_activations:
-        center = store.chunk_mean(0)  # (reference: big_sweep.py:359-364)
+        # reference centers on chunk 0 (big_sweep.py:359-364); over a
+        # scrub-repaired store the first SOUND chunk stands in — the
+        # sweep must train through the holes the scrub just healed, not
+        # crash at startup (same contract as run_eval's batch pick)
+        center = store.chunk_mean(first_sound_chunk(store))
 
     # bf16 keeps activations half-width from disk through the host→device
     # pipe; the jitted step promotes to f32 against the f32 params, so only
@@ -309,12 +319,16 @@ def sweep(
         obs.record_span("sweep.warmstart", obs.monotime() - t_warm,
                         programs=n_warm, shape=list(batch_shape))
 
-    # remaining chunks stream through chunk_reader: the next chunk's disk
-    # read overlaps the current chunk's training (native/chunkio.cpp
-    # background threads; sequential without the lib)
+    # remaining chunks stream through the async ingest pipeline
+    # (data/ingest.py): up to cfg.ingest_streams decodes overlap the
+    # current chunk's training, each on the store's hardened read path; a
+    # dying stream degrades to the foreground single-stream reader and
+    # the epoch completes with identical data. streams<=1 keeps the
+    # native 1-slab readahead contract (chunkio.cpp background threads).
     todo = list(range(chunks_done, len(chunk_order)))
-    reader = store.chunk_reader([int(chunk_order[ci]) for ci in todo],
-                                dtype=train_np_dtype)
+    reader = chunk_stream(store, [int(chunk_order[ci]) for ci in todo],
+                          dtype=train_np_dtype,
+                          streams=cfg.ingest_streams or None)
     # SIGTERM (preemptible capacity, the unattended recovery loop) sets a
     # flag polled at chunk boundaries: the in-flight chunk finishes, a
     # checkpoint set is forced regardless of cadence, and SweepPreempted
@@ -346,7 +360,7 @@ def sweep(
                                    if mesh is not None else None)
             else:
                 window_sharding = sharding
-            for batch in device_prefetch(batches, window_sharding):
+            for batch in device_batches(batches, window_sharding):
                 k_steps = batch.shape[0] if scan_k > 1 else 1
                 step += k_steps
                 if (cfg.profile_steps > 0 and not profiling

@@ -362,3 +362,176 @@ def test_obs_sink_kill_mid_event_write_report_survives(tmp_path, golden):
     # chunk itself was already durable; bitwise assert above)
     assert report["spans"]["chunk.write"]["count"] == 3
     assert report["spans"]["pipeline.step"]["count"] == 2  # kill + done
+
+
+# -- sharded store chaos cases (ISSUE 8 acceptance) ---------------------------
+
+
+def _store_digests(root: Path) -> dict[str, str]:
+    return {str(p.relative_to(root)): hashlib.sha256(p.read_bytes()).hexdigest()
+            for p in sorted(root.rglob("*")) if p.is_file()}
+
+
+def _sharded_config(base: Path) -> dict:
+    config = _config(base)
+    config["harvest"]["n_shards"] = 2
+    return config
+
+
+def test_shard_finalize_kill_restart_bitwise_store(tmp_path, monkeypatch,
+                                                   golden):
+    """ISSUE 8 acceptance chaos case: SIGKILL a PARALLEL harvest writer at
+    ``shard.finalize`` — its shard's meta.json durable, the seal not yet
+    written. A restarted supervisor re-runs the writer (which finds the
+    finished chunk prefix, skips the harvest, and re-seals idempotently)
+    and the finished store — every chunk, meta, seal, and the store-level
+    manifest — is bitwise identical to an uninterrupted sharded harvest.
+    No quarantine ledger appears anywhere: a kill is never corruption."""
+    from sparse_coding_tpu.pipeline import build_sharded_pipeline
+    from sparse_coding_tpu.pipeline.steps import (
+        run_shard_harvest,
+        run_store_manifest,
+    )
+
+    # the golden sharded store, in-process and uninterrupted (built before
+    # any crash plan enters the environment)
+    gcfg = _sharded_config(tmp_path / "g")
+    run_shard_harvest(gcfg, 0)
+    run_shard_harvest(gcfg, 1)
+    run_store_manifest(gcfg)
+    want = _store_digests(tmp_path / "g" / "chunks")
+    # the sharding contract: every writer replays the SAME seeded stream
+    # and keeps its rows, so the shard-major concatenation is bitwise the
+    # UNSHARDED golden harvest
+    flat = golden["digests"]
+    assert want["shard-000/0.npy"] == flat["chunks/0.npy"]
+    assert want["shard-000/1.npy"] == flat["chunks/1.npy"]
+    assert want["shard-001/0.npy"] == flat["chunks/2.npy"]
+    assert want["shard-001/1.npy"] == flat["chunks/3.npy"]
+
+    config = _sharded_config(tmp_path)
+    run_dir = tmp_path / "run"
+    only = ["harvest-0", "harvest-1", "manifest"]
+
+    # run 1: the first writer dies BY SIGKILL exactly between its two
+    # durable writes
+    monkeypatch.setenv(crash_mod.ENV_VAR, "shard.finalize:nth=1")
+    sup = Supervisor(run_dir,
+                     build_sharded_pipeline(run_dir, config, only=only),
+                     max_attempts=1, heartbeat_stale_s=STALE_S)
+    with pytest.raises(StepFailed, match="killed by signal 9"):
+        sup.run()
+    s0 = tmp_path / "chunks" / "shard-000"
+    assert (s0 / "meta.json").exists(), "kill landed before the meta"
+    assert not (s0 / "shard.digest").exists(), "kill landed after the seal"
+
+    # run 2: fresh supervisor, no plan — writer 0 re-seals, writer 1 and
+    # the manifest run for the first time
+    monkeypatch.delenv(crash_mod.ENV_VAR)
+    sup2 = Supervisor(run_dir,
+                      build_sharded_pipeline(run_dir, config, only=only),
+                      max_attempts=2, heartbeat_stale_s=STALE_S)
+    summary = sup2.run()
+    assert all(v in ("done", "skipped") for v in summary.values())
+    assert _store_digests(tmp_path / "chunks") == want
+    assert not list((tmp_path / "chunks").rglob("quarantine.json"))
+
+
+def test_scrub_repair_kill_restart_bitwise_ledger_intact(tmp_path,
+                                                         monkeypatch):
+    """ISSUE 8 acceptance chaos case: SIGKILL a scrub child at
+    ``scrub.repair`` — the quarantine ledger entry is durable, the corrupt
+    chunk file not yet moved aside. At that instant readers already skip
+    the chunk correctly (the ledger is the knowledge; the move is only an
+    optimization), and a restarted scrub converges to a store byte-
+    identical to an uninterrupted repair scrub's: same ledger bytes, same
+    ``quarantine/`` forensics copy, same worklist and report."""
+    from sparse_coding_tpu.data.ledger import load_quarantine
+    from sparse_coding_tpu.data.scrub import scrub_store
+    from sparse_coding_tpu.pipeline import build_sharded_pipeline
+    from sparse_coding_tpu.pipeline.steps import (
+        run_shard_harvest,
+        run_store_manifest,
+    )
+
+    config = _sharded_config(tmp_path)
+    run_shard_harvest(config, 0)
+    run_shard_harvest(config, 1)
+    run_store_manifest(config)
+    store = tmp_path / "chunks"
+    victim = store / "shard-000" / "1.npy"
+    blob = bytearray(victim.read_bytes())
+    blob[-1] ^= 0x01  # payload bit flip: loads fine, the digest catches it
+    victim.write_bytes(bytes(blob))
+
+    # golden: an identically-damaged copy, repair-scrubbed uninterrupted
+    gstore = tmp_path / "golden_chunks"
+    shutil.copytree(store, gstore)
+    scrub_store(gstore, repair=True)
+    want = _store_digests(gstore)
+    assert "shard-000/quarantine.json" in want
+    assert "shard-000/quarantine/1.npy" in want
+
+    # run 1: the scrub child dies BY SIGKILL between ledger and move
+    run_dir = tmp_path / "run"
+    monkeypatch.setenv(crash_mod.ENV_VAR, "scrub.repair:nth=1")
+    sup = Supervisor(run_dir,
+                     build_sharded_pipeline(run_dir, config, only=["scrub"]),
+                     max_attempts=1, heartbeat_stale_s=STALE_S)
+    with pytest.raises(StepFailed, match="killed by signal 9"):
+        sup.run()
+    assert set(load_quarantine(store / "shard-000")) == {1}  # ledger KNOWS
+    assert victim.exists()  # the move never ran
+    assert not (store / "shard-000" / "quarantine").exists()
+    assert not (store / "scrub").exists()  # report (written LAST) absent
+    assert not (run_dir / "scrub.done.json").exists()  # run marker too
+
+    # run 2: fresh supervisor, no plan — the scrub resumes and completes
+    monkeypatch.delenv(crash_mod.ENV_VAR)
+    sup2 = Supervisor(run_dir,
+                      build_sharded_pipeline(run_dir, config, only=["scrub"]),
+                      max_attempts=2, heartbeat_stale_s=STALE_S)
+    assert sup2.run() == {"scrub": "done"}
+    assert _store_digests(store) == want
+
+
+def test_scrub_runs_again_for_a_new_run_over_same_store(tmp_path):
+    """The scrub completion marker is RUN-scoped: a LATER supervised run
+    over the same store re-verifies (the store has had time to rot since
+    the last run) instead of skipping on the previous run's
+    store-resident report — while a resume WITHIN a run still skips."""
+    from sparse_coding_tpu.data.ledger import load_quarantine
+    from sparse_coding_tpu.pipeline import build_sharded_pipeline
+    from sparse_coding_tpu.pipeline.steps import (
+        run_shard_harvest,
+        run_store_manifest,
+    )
+
+    config = _sharded_config(tmp_path)
+    run_shard_harvest(config, 0)
+    run_shard_harvest(config, 1)
+    run_store_manifest(config)
+
+    def scrub_run(run_dir):
+        sup = Supervisor(run_dir,
+                         build_sharded_pipeline(run_dir, config,
+                                                only=["scrub"]),
+                         max_attempts=1, heartbeat_stale_s=STALE_S)
+        return sup.run()
+
+    r1 = tmp_path / "run1"
+    assert scrub_run(r1) == {"scrub": "done"}
+    assert (r1 / "scrub.done.json").exists()
+    assert (tmp_path / "chunks" / "scrub" / "scrub_report.json").exists()
+
+    # the store rots AFTER run 1 finished and reported clean
+    victim = tmp_path / "chunks" / "shard-000" / "1.npy"
+    blob = bytearray(victim.read_bytes())
+    blob[-1] ^= 0x01
+    victim.write_bytes(bytes(blob))
+
+    r2 = tmp_path / "run2"
+    assert scrub_run(r2) == {"scrub": "done"}  # ran — NOT "skipped"
+    assert set(load_quarantine(tmp_path / "chunks" / "shard-000")) == {1}
+    # a RESUME of run 2 (its own marker present) does skip
+    assert scrub_run(r2) == {"scrub": "skipped"}

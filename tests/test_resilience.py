@@ -824,3 +824,253 @@ def test_obs_sink_write_corrupt_line_skipped_by_reader(tmp_path):
     report = build_report(tmp_path)
     assert report["spans"]["s"]["count"] == 2
     assert report["skipped_lines"] == 1
+
+
+# -- sharded store + async ingest (ISSUE 8) ----------------------------------
+
+
+def _flat_chunks(folder, n_chunks=4, dim=8, rows_per_chunk=16, seed=0):
+    """A finalized flat chunk folder; returns the f32 data on disk."""
+    w = ChunkWriter(folder, dim,
+                    chunk_size_gb=dim * rows_per_chunk * 2 / 2**30,
+                    dtype="float16")
+    data = np.random.default_rng(seed).normal(
+        size=(n_chunks * rows_per_chunk, dim)).astype(np.float32)
+    w.add(data)
+    w.finalize({})
+    return data.astype(np.float16).astype(np.float32)
+
+
+def test_shard_write_fault_retried_then_bounded_no_torn_manifest(tmp_path):
+    """``shard.write`` guards BOTH sharded-store durable writes: a
+    transient fault is absorbed by the bounded retry (the seal/manifest
+    still lands), a persistent one propagates typed after the budget —
+    and never leaves a torn manifest behind (atomic write + fail-loud is
+    the store's completeness contract)."""
+    from sparse_coding_tpu.data.shard_store import (
+        build_store_manifest,
+        shard_name,
+        write_shard_digest,
+    )
+
+    d = tmp_path / shard_name(0)
+    _flat_chunks(d)
+    with inject(site="shard.write", nth=1) as plan:
+        write_shard_digest(d)
+    assert plan.fired_count("shard.write") == 1
+    assert (d / "shard.digest").exists()
+    with inject(site="shard.write", nth=1, count=0) as plan:
+        with pytest.raises(OSError):
+            build_store_manifest(tmp_path, expect_shards=1)
+    assert plan.fired_count("shard.write") >= 3  # the whole retry budget
+    assert not (tmp_path / "manifest.json").exists()
+    build_store_manifest(tmp_path, expect_shards=1)  # heals, plan gone
+
+
+def test_shard_scrub_fault_retried_and_never_quarantines_good_data(tmp_path):
+    """``shard.scrub``: a transient verify-read error is retried and the
+    sound chunk stays OK; a PERSISTENT I/O failure propagates instead of
+    quarantining — a flaky disk must never condemn good data, only
+    structural damage and digest mismatches may."""
+    from sparse_coding_tpu.data.ledger import load_quarantine
+    from sparse_coding_tpu.data.scrub import scrub_folder
+
+    folder = tmp_path / "flat"
+    _flat_chunks(folder, n_chunks=2)
+    with inject(site="shard.scrub", nth=1) as plan:
+        rep = scrub_folder(folder)
+    assert plan.fired_count("shard.scrub") == 1
+    assert rep["ok"] == rep["checked"] == 2 and rep["quarantined"] == []
+    with inject(site="shard.scrub", nth=1, count=0):
+        with pytest.raises(OSError):
+            scrub_folder(folder)
+    assert load_quarantine(folder) == {}  # nothing condemned by the disk
+
+
+def test_ingest_decode_stream_death_degrades_and_delivers_identically(
+        tmp_path):
+    """``ingest.decode``: a stream worker dying mid-epoch (injected
+    RuntimeError — NOT data corruption) degrades to the foreground
+    single-stream path; the consumer still receives every chunk, in
+    order, bit-identical to the serial reader, and the incident is
+    counted."""
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.data.ingest import chunk_stream
+
+    folder = tmp_path / "flat"
+    _flat_chunks(folder)
+    store = ChunkStore(folder)
+    serial = list(store.chunk_reader(range(4)))
+    before = obs.counter("ingest.degraded").value
+    with inject(site="ingest.decode", nth=2, error="RuntimeError") as plan:
+        got = list(chunk_stream(store, range(4), streams=2))
+    assert plan.fired_count("ingest.decode") == 1
+    assert obs.counter("ingest.degraded").value == before + 1
+    assert len(got) == len(serial) == 4
+    for a, b in zip(got, serial):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_ingest_transfer_fault_retried_then_bounded():
+    """``ingest.transfer``: a transient device-put failure is retried in
+    place (same values, same order), a persistent one propagates typed
+    after the bounded budget."""
+    from sparse_coding_tpu.data.ingest import device_batches
+
+    batches = [np.full((2, 4), i, np.float32) for i in range(3)]
+    with inject(site="ingest.transfer", nth=2) as plan:
+        out = list(device_batches(iter(batches)))
+    assert plan.fired_count("ingest.transfer") == 1
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(b), batches[i])
+    with inject(site="ingest.transfer", nth=1, count=0):
+        with pytest.raises(OSError):
+            list(device_batches(iter(batches)))
+
+
+def test_ingest_stream_death_drill_sweep_completes_identically(tmp_path):
+    """ISSUE 8 acceptance fault drill: kill one ingest stream mid-epoch →
+    the sweep completes on the degraded single-stream path with final
+    params BITWISE identical to the healthy multi-stream run, and the
+    whole incident — stream death, degradation, scrub tallies — reads
+    out of ONE merged obs.report."""
+    from sparse_coding_tpu import obs
+    import sparse_coding_tpu.train.sweep as sweep_mod
+    from sparse_coding_tpu.config import EnsembleArgs
+    from sparse_coding_tpu.data.scrub import scrub_store
+    from sparse_coding_tpu.data.shard_store import (
+        build_store_manifest,
+        shard_name,
+        write_shard_digest,
+    )
+    from sparse_coding_tpu.obs.report import build_report
+    from sparse_coding_tpu.train.experiments import dense_l1_range_experiment
+
+    dim, rows_per_shard = 16, 512  # 2 chunks of 256 rows per shard
+    root = tmp_path / "store"
+    rng = np.random.default_rng(0)
+    for si in range(2):
+        d = root / shard_name(si)
+        w = ChunkWriter(d, dim, chunk_size_gb=dim * 256 * 2 / 2**30,
+                        dtype="float16")
+        w.add(rng.standard_normal((rows_per_shard, dim), dtype=np.float32))
+        w.finalize({"synthetic": True})
+        write_shard_digest(d)
+    build_store_manifest(root, expect_shards=2)
+
+    build = lambda c, m: dense_l1_range_experiment(
+        c, m, l1_range=[1e-3], activation_dim=dim)
+
+    def cfg(name):
+        return EnsembleArgs(output_folder=str(tmp_path / name),
+                            dataset_folder=str(root), batch_size=64,
+                            n_chunks=4, learned_dict_ratio=2.0,
+                            tied_ae=True, ingest_streams=2, seed=0)
+
+    healthy = sweep_mod.sweep(build, cfg("healthy"), log_every=50)
+
+    run_dir = tmp_path / "run"
+    prev = obs.configure_sink(obs.EventSink(run_dir / "obs" / "drill.jsonl"))
+    prev_registry = obs.set_registry(obs.Registry())  # counters from zero:
+    # flush_metrics writes absolutes, and earlier tests in this process
+    # already bumped ingest.degraded / scrub.* on the shared registry
+    try:
+        scrub_store(root)  # the DAG's pre-sweep scrub, same merged report
+        with inject(site="ingest.decode", nth=3,
+                    error="RuntimeError") as plan:
+            degraded = sweep_mod.sweep(build, cfg("degraded"), log_every=50)
+        obs.flush_metrics()
+    finally:
+        obs.set_registry(prev_registry)
+        obs.configure_sink(prev)
+    assert plan.fired_count("ingest.decode") == 1
+
+    for (ld_h, _), (ld_d, _) in zip(healthy["dense_l1_range"],
+                                    degraded["dense_l1_range"]):
+        for k in ld_h.__dict__:
+            a, b = getattr(ld_h, k), getattr(ld_d, k)
+            if hasattr(a, "shape"):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                              err_msg=k)
+
+    ing = build_report(run_dir)["ingest"]
+    assert ing["degraded_streams"] == 1
+    assert ing["scrub_checked"] == 4 and ing["scrub_quarantined"] == 0
+
+
+def test_ledger_write_fault_degrades_reader_but_fails_scrub(tmp_path):
+    """``ledger.write``: the durable quarantine rewrite failing (read-only
+    store, full disk) must DEGRADE a reader — the in-memory quarantine
+    still protects this process and the epoch continues — but PROPAGATE
+    from the scrub, whose ledger-durable-before-repair ordering is
+    load-bearing (a re-run converges once the disk heals)."""
+    from sparse_coding_tpu.data.ledger import ledger_path, load_quarantine
+    from sparse_coding_tpu.data.scrub import scrub_folder
+
+    folder = tmp_path / "flat"
+    _flat_chunks(folder, n_chunks=3)
+    blob = bytearray((folder / "1.npy").read_bytes())
+    blob[-1] ^= 0x01  # payload bit flip: loads fine, the digest catches it
+    (folder / "1.npy").write_bytes(bytes(blob))
+    with inject(site="ledger.write", nth=1, count=0) as plan:
+        store = ChunkStore(folder, quarantine_corrupt=True)
+        out = list(store.chunk_reader([0, 1, 2]))
+    assert plan.fired_count("ledger.write") >= 1
+    assert [c is None for c in out] == [False, True, False]
+    assert store.quarantined == {1}          # in-memory protection holds
+    assert not ledger_path(folder).exists()  # durability lost, run saved
+    with inject(site="ledger.write", nth=1, count=0):
+        with pytest.raises(OSError):
+            scrub_folder(folder)
+    assert load_quarantine(folder) == {}  # no torn ledger left behind
+    rep = scrub_folder(folder)  # plan gone: heals, the entry lands durably
+    assert rep["quarantined"] == [1] and set(load_quarantine(folder)) == {1}
+
+
+def test_sweep_completes_over_scrub_repaired_store(tmp_path):
+    """The production DAG orders scrub --repair BEFORE the sweep, so the
+    sweep's own store open (cfg.dataset_folder → open_store) must ride
+    quarantine_corrupt=True: a repaired store (chunk file moved into
+    quarantine/, ledger durable) trains through a positional None —
+    it must never crash the sweep the scrub just healed. The repaired
+    chunk is GLOBAL CHUNK 0 and centering is on: the centering path
+    (reference: mean of chunk 0) must fall through to the first sound
+    chunk instead of crashing on the hole."""
+    import sparse_coding_tpu.train.sweep as sweep_mod
+    from sparse_coding_tpu.config import EnsembleArgs
+    from sparse_coding_tpu.data.scrub import scrub_store
+    from sparse_coding_tpu.data.shard_store import (
+        build_store_manifest,
+        shard_name,
+        write_shard_digest,
+    )
+    from sparse_coding_tpu.train.experiments import dense_l1_range_experiment
+
+    dim = 16
+    root = tmp_path / "store"
+    rng = np.random.default_rng(0)
+    for si in range(2):
+        d = root / shard_name(si)
+        w = ChunkWriter(d, dim, chunk_size_gb=dim * 256 * 2 / 2**30,
+                        dtype="float16")
+        w.add(rng.standard_normal((512, dim), dtype=np.float32))
+        w.finalize({"synthetic": True})
+        write_shard_digest(d)
+    build_store_manifest(root, expect_shards=2)
+    victim = root / shard_name(0) / "0.npy"  # global chunk 0
+    blob = bytearray(victim.read_bytes())
+    blob[-1] ^= 0x01
+    victim.write_bytes(bytes(blob))
+    rep = scrub_store(root, repair=True)
+    assert rep["quarantined"] == 1 and not victim.exists()
+
+    build = lambda c, m: dense_l1_range_experiment(
+        c, m, l1_range=[1e-3], activation_dim=dim)
+    cfg = EnsembleArgs(output_folder=str(tmp_path / "out"),
+                       dataset_folder=str(root), batch_size=64,
+                       n_chunks=4, learned_dict_ratio=2.0, tied_ae=True,
+                       ingest_streams=2, center_activations=True, seed=0)
+    out = sweep_mod.sweep(build, cfg, log_every=50)  # must not raise
+    ld, _hp = out["dense_l1_range"][0]
+    arrays = [v for v in ld.__dict__.values() if hasattr(v, "shape")]
+    assert arrays and all(np.isfinite(np.asarray(a)).all() for a in arrays)

@@ -1,0 +1,711 @@
+"""Sharded chunk store + durable quarantine ledger + scrub + async ingest
+(ISSUE 8 tentpole). Covers:
+
+- the durable quarantine ledger: a corrupt chunk discovered by one process
+  is known to every later process (satellite 1), with byte-stable writes;
+- the pt-branch reader contract: lease beats per delivered chunk and
+  ledger-known skips, same as the raw branch (satellite 2);
+- `complete_chunk_count` / `clean_write_debris` against multi-writer shard
+  layouts, including a writer SIGKILLed mid-flush (satellite 3);
+- the sharded store itself: shard-major positional index space, seal +
+  manifest integrity, shard-local quarantine routing, `open_store`
+  layout dispatch;
+- the scrub: verify → quarantine → repair → worklist, idempotent and
+  byte-deterministic across re-runs and resumes, backend-free;
+- the async ingest pipeline: multi-stream delivery identical to the
+  foreground reader, positional Nones, device staging order;
+- the sweep-side acceptance fault drill lives in tests/test_resilience.py
+  (`ingest.decode` / `ingest.transfer` matrix entries) and the SIGKILL
+  chaos cases in tests/test_pipeline_chaos.py (`shard.finalize`,
+  `scrub.repair`).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.data.chunk_store import (
+    ChunkStore,
+    ChunkWriter,
+    clean_write_debris,
+    complete_chunk_count,
+)
+from sparse_coding_tpu.data.ingest import chunk_stream, device_batches
+from sparse_coding_tpu.data.ledger import (
+    clear_quarantine,
+    ledger_path,
+    load_quarantine,
+    record_quarantine,
+)
+from sparse_coding_tpu.data.scrub import scrub_folder, scrub_store
+from sparse_coding_tpu.data.shard_store import (
+    ShardedChunkStore,
+    ShardLayoutError,
+    build_store_manifest,
+    open_store,
+    read_store_manifest,
+    shard_name,
+    write_shard_digest,
+)
+from sparse_coding_tpu.resilience import lease as lease_mod
+from sparse_coding_tpu.resilience.errors import ChunkCorruptionError
+
+DIM = 8
+ROWS_PER_CHUNK = 16
+
+
+@pytest.fixture(autouse=True)
+def _no_lease_leak():
+    yield
+    lease_mod.configure(None)
+
+
+def _write_folder(folder: Path, rows: int, seed: int) -> np.ndarray:
+    """One flat chunk folder of 16-row float16 chunks; returns the f32
+    data the store should read back."""
+    w = ChunkWriter(folder, DIM,
+                    chunk_size_gb=DIM * ROWS_PER_CHUNK * 2 / 2**30,
+                    dtype="float16")
+    data = np.random.default_rng(seed).normal(
+        size=(rows, DIM)).astype(np.float32).astype(np.float16)
+    w.add(data.astype(np.float32))
+    w.finalize({"tag": "shard-tests"})
+    return data.astype(np.float32)
+
+
+def _mk_sharded(root: Path, n_shards: int = 2,
+                chunks_per_shard: int = 2) -> np.ndarray:
+    """A sealed, manifested sharded store; returns the shard-major
+    concatenation the global index space must read back."""
+    parts = []
+    for si in range(n_shards):
+        d = root / shard_name(si)
+        parts.append(_write_folder(d, ROWS_PER_CHUNK * chunks_per_shard,
+                                   seed=si))
+        write_shard_digest(d)
+    build_store_manifest(root, expect_shards=n_shards)
+    return np.concatenate(parts)
+
+
+def _corrupt(path: Path) -> None:
+    blob = bytearray(path.read_bytes())
+    blob[-1] ^= 0x01  # payload bit flip: loads fine, digest catches it
+    path.write_bytes(bytes(blob))
+
+
+# -- durable quarantine ledger (satellite 1) ---------------------------------
+
+
+def test_quarantine_survives_restart(tmp_path):
+    _write_folder(tmp_path, 64, seed=0)
+    _corrupt(tmp_path / "1.npy")
+    first = ChunkStore(tmp_path, quarantine_corrupt=True)
+    out = list(first.chunk_reader([0, 1, 2]))
+    assert [c is None for c in out] == [False, True, False]
+    assert first.quarantined == {1}
+    # the knowledge is on disk next to meta.json...
+    entries = load_quarantine(tmp_path)
+    assert set(entries) == {1} and entries[1]["file"] == "1.npy"
+    # ...so a FRESH process (a supervised resume) opens already knowing,
+    # and never re-pays the read: chunk.read would fire if it tried
+    fresh = ChunkStore(tmp_path, quarantine_corrupt=True)
+    assert fresh.quarantined == {1}
+    from sparse_coding_tpu.resilience import inject
+
+    with inject(site="chunk.read", nth=1, count=0) as plan:
+        out = list(fresh.chunk_reader([1, 1]))
+    assert out == [None, None]
+    assert plan.fired_count("chunk.read") == 0  # skipped unread
+
+
+def test_ledger_writes_are_idempotent_bytes(tmp_path):
+    record_quarantine(tmp_path, 3, "digest mismatch", "3.npy")
+    once = ledger_path(tmp_path).read_bytes()
+    record_quarantine(tmp_path, 3, "digest mismatch", "3.npy")
+    assert ledger_path(tmp_path).read_bytes() == once
+
+
+def test_unreadable_ledger_treated_as_empty(tmp_path):
+    ledger_path(tmp_path).parent.mkdir(parents=True, exist_ok=True)
+    ledger_path(tmp_path).write_text("{not json")
+    assert load_quarantine(tmp_path) == {}
+
+
+def test_strict_reader_still_raises_but_records(tmp_path):
+    """quarantine_corrupt=False: the corruption still raises (a direct
+    consumer asked for THAT chunk) — and stays in-memory-only, because
+    only the opt-in quarantine path owns the skip decision."""
+    _write_folder(tmp_path, 64, seed=0)
+    _corrupt(tmp_path / "2.npy")
+    strict = ChunkStore(tmp_path)
+    with pytest.raises(ChunkCorruptionError):
+        list(strict.chunk_reader([2]))
+    assert load_quarantine(tmp_path) == {}
+
+
+# -- pt-branch reader contract (satellite 2) ---------------------------------
+
+
+def test_pt_reader_beats_lease_and_skips_ledger_known(tmp_path):
+    torch = pytest.importorskip("torch")
+    folder = tmp_path / "ref"
+    folder.mkdir()
+    chunks = [np.random.default_rng(i).normal(size=(8, 4)).astype(np.float16)
+              for i in range(3)]
+    for i, a in enumerate(chunks):
+        torch.save(torch.tensor(a), folder / f"{i}.pt")
+
+    lease = lease_mod.Lease(tmp_path / "lease.json", step="pt",
+                            interval_s=0.0)
+    lease_mod.configure(lease)
+    seq0 = lease_mod.read_lease(lease.path).seq
+    store = ChunkStore(folder, quarantine_corrupt=True)
+    assert store.format == "pt"
+    out = list(store.chunk_reader([2, 0, 1]))
+    assert all(c is not None for c in out)
+    # one beat per DELIVERED chunk: a wedged torch deserialize stops the
+    # beats, so the supervisor's hang watchdog catches it
+    assert lease_mod.read_lease(lease.path).seq >= seq0 + 3
+    # ledger-known chunks skip without a deserialize attempt — and the
+    # skipped position still beats (reader progress), like the raw branch
+    record_quarantine(folder, 1, "planted", "1.pt")
+    fresh = ChunkStore(folder, quarantine_corrupt=True)
+    assert fresh.quarantined == {1}
+    seq1 = lease_mod.read_lease(lease.path).seq
+    out = list(fresh.chunk_reader([0, 1, 2]))
+    assert [c is None for c in out] == [False, True, False]
+    assert lease_mod.read_lease(lease.path).seq >= seq1 + 3
+
+
+# -- multi-writer debris (satellite 3) ---------------------------------------
+
+
+def test_complete_chunk_count_per_shard_with_debris(tmp_path):
+    """Each shard dir has its own durable prefix; atomic-write tmp debris
+    (the exact `.N.npy.tmp.<pid>` names a mid-flush kill leaves) never
+    counts as a chunk and never leaks across shards."""
+    s0, s1 = tmp_path / shard_name(0), tmp_path / shard_name(1)
+    _write_folder(s0, 32, seed=0)  # 2 durable chunks
+    s1.mkdir()
+    w = ChunkWriter(s1, DIM, chunk_size_gb=DIM * ROWS_PER_CHUNK * 2 / 2**30,
+                    dtype="float16")
+    w.add(np.zeros((ROWS_PER_CHUNK, DIM), np.float32))  # 1 durable chunk
+    # mid-flush debris in shard 1 only (tmp written, rename never ran)
+    (s1 / f".1.npy.tmp.{os.getpid()}").write_bytes(b"half a chunk")
+    assert complete_chunk_count(s0) == 2
+    assert complete_chunk_count(s1) == 1
+    assert clean_write_debris(s0) == 0
+    assert clean_write_debris(s1) == 1
+    assert not list(s1.glob(".*.tmp.*"))
+    assert (s1 / "0.npy").exists()  # durable chunks untouched
+
+
+def test_debris_from_writer_sigkilled_mid_flush(tmp_path):
+    """A REAL writer killed inside the tmp-write (before the rename):
+    the durable prefix is exactly the finished chunks, the debris is
+    swept, and a resumed writer finishes a store whose meta counts only
+    whole chunks."""
+    folder = tmp_path / shard_name(0)
+    script = (
+        "import os, numpy as np\n"
+        "from sparse_coding_tpu.data import chunk_store\n"
+        "from sparse_coding_tpu.resilience import atomic\n"
+        "real = atomic.atomic_save_npy\n"
+        "calls = {'n': 0}\n"
+        "def dying(path, arr):\n"
+        "    calls['n'] += 1\n"
+        "    if calls['n'] == 2:\n"
+        "        # write the tmp the way atomic does, then die before the\n"
+        "        # rename - the mid-flush instant SIGKILL actually hits\n"
+        "        tmp = path.parent / f'.{path.name}.tmp.{os.getpid()}'\n"
+        "        tmp.write_bytes(b'torn half-chunk')\n"
+        "        os.kill(os.getpid(), 9)\n"
+        "    real(path, arr)\n"
+        "chunk_store.atomic_save_npy = dying\n"
+        f"w = chunk_store.ChunkWriter(r'{folder}', {DIM}, "
+        f"chunk_size_gb={DIM} * {ROWS_PER_CHUNK} * 2 / 2**30, "
+        "dtype='float16')\n"
+        "data = np.random.default_rng(0).normal(size=(48, 8))\n"
+        "w.add(data.astype(np.float32))\n"
+        "w.finalize({})\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          cwd=str(Path(__file__).resolve().parent.parent),
+                          capture_output=True, text=True)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    assert complete_chunk_count(folder) == 1  # chunk 0 durable, 1 torn
+    assert len(list(folder.glob(".*.tmp.*"))) == 1
+    assert clean_write_debris(folder) == 1
+    # resume: durable prefix + fresh writer converges to a whole store
+    w = ChunkWriter(folder, DIM,
+                    chunk_size_gb=DIM * ROWS_PER_CHUNK * 2 / 2**30,
+                    dtype="float16", start_index=1)
+    data = np.random.default_rng(0).normal(size=(48, DIM))
+    w.add(data[ROWS_PER_CHUNK:].astype(np.float32))
+    w.finalize({})
+    store = ChunkStore(folder)
+    assert store.n_chunks == 3
+    np.testing.assert_allclose(
+        np.concatenate([store.load_chunk(i) for i in range(3)]),
+        data.astype(np.float16).astype(np.float32), atol=2e-3)
+
+
+# -- sharded store -----------------------------------------------------------
+
+
+def test_sharded_store_positional_space_matches_concat(tmp_path):
+    data = _mk_sharded(tmp_path, n_shards=2, chunks_per_shard=2)
+    store = ShardedChunkStore(tmp_path)
+    assert store.n_chunks == 4
+    assert store.activation_dim == DIM
+    got = np.concatenate([store.load_chunk(i) for i in range(4)])
+    np.testing.assert_allclose(got, data, atol=2e-3)
+    # the reader contract over the same global order the sweep would use
+    order = [3, 0, 2, 1, 0]
+    out = list(store.chunk_reader(order))
+    for pos, ci in enumerate(order):
+        np.testing.assert_allclose(
+            out[pos], data[ci * ROWS_PER_CHUNK:(ci + 1) * ROWS_PER_CHUNK],
+            atol=2e-3)
+
+
+def test_sharded_quarantine_routes_to_owning_shard(tmp_path):
+    _mk_sharded(tmp_path, n_shards=2, chunks_per_shard=2)
+    _corrupt(tmp_path / shard_name(1) / "0.npy")  # global index 2
+    store = ShardedChunkStore(tmp_path, quarantine_corrupt=True)
+    out = list(store.chunk_reader([0, 1, 2, 3]))
+    assert [c is None for c in out] == [False, False, True, False]
+    assert store.quarantined == {2}
+    # the ledger lives in the OWNING shard, recorded in shard-local
+    # coordinates (the scrub and a shard re-harvest both work per shard)
+    assert set(load_quarantine(tmp_path / shard_name(1))) == {0}
+    assert load_quarantine(tmp_path / shard_name(0)) == {}
+    ledgers = store.shard_quarantine_ledgers()
+    assert set(ledgers[shard_name(1)]) == {0}
+
+
+def test_manifest_is_byte_deterministic_and_validates(tmp_path):
+    _mk_sharded(tmp_path, n_shards=2)
+    manifest_file = tmp_path / "manifest.json"
+    once = manifest_file.read_bytes()
+    build_store_manifest(tmp_path, expect_shards=2)
+    assert manifest_file.read_bytes() == once  # rebuild converges bitwise
+    m = read_store_manifest(tmp_path)
+    assert m["n_shards"] == 2 and m["n_chunks"] == 4
+    # a shard whose meta changed after sealing fails loudly
+    meta = tmp_path / shard_name(0) / "meta.json"
+    meta.write_text(meta.read_text().replace("shard-tests", "tampered"))
+    with pytest.raises(ShardLayoutError, match="changed after sealing"):
+        build_store_manifest(tmp_path)
+
+
+def test_unsealed_shard_rejected(tmp_path):
+    _write_folder(tmp_path / shard_name(0), 32, seed=0)
+    with pytest.raises(ShardLayoutError, match="not sealed"):
+        build_store_manifest(tmp_path)
+    with pytest.raises(ShardLayoutError, match="no meta.json"):
+        write_shard_digest(tmp_path / "nonexistent")
+
+
+def test_write_shard_digest_idempotent(tmp_path):
+    d = tmp_path / shard_name(0)
+    _write_folder(d, 32, seed=0)
+    first = write_shard_digest(d)
+    blob = (d / "shard.digest").read_bytes()
+    assert write_shard_digest(d) == first  # a killed writer's restart
+    assert (d / "shard.digest").read_bytes() == blob
+
+
+def test_open_store_dispatches_on_layout(tmp_path):
+    flat = tmp_path / "flat"
+    _write_folder(flat, 32, seed=0)
+    assert isinstance(open_store(flat), ChunkStore)
+    sharded = tmp_path / "sharded"
+    _mk_sharded(sharded)
+    assert isinstance(open_store(sharded), ShardedChunkStore)
+
+
+# -- scrub -------------------------------------------------------------------
+
+
+def test_manifest_rebuilt_when_shard_count_changes(tmp_path):
+    """A manifest from an n_shards=2 run must not survive a re-run with
+    n_shards=4: the stale subset it lists would make every reader
+    silently drop the shards the new run just harvested. The manifest
+    step (and its DAG done() probe) compare the configured count and
+    rebuild; a matching count stays an idempotent byte-stable skip."""
+    from sparse_coding_tpu.pipeline.steps import run_store_manifest
+
+    _mk_sharded(tmp_path, n_shards=2)
+    assert read_store_manifest(tmp_path)["n_shards"] == 2
+    for si in (2, 3):
+        d = tmp_path / shard_name(si)
+        _write_folder(d, ROWS_PER_CHUNK * 2, seed=si)
+        write_shard_digest(d)
+    config = {"harvest": {"dataset_folder": str(tmp_path), "n_shards": 4}}
+    run_store_manifest(config)
+    m = read_store_manifest(tmp_path)
+    assert m["n_shards"] == 4 and m["n_chunks"] == 8
+    once = (tmp_path / "manifest.json").read_bytes()
+    run_store_manifest(config)  # matching count: idempotent skip
+    assert (tmp_path / "manifest.json").read_bytes() == once
+
+
+def test_scrub_clean_store_is_all_ok_and_idempotent(tmp_path):
+    _mk_sharded(tmp_path, n_shards=2)
+    report = scrub_store(tmp_path)
+    assert report["checked"] == 4 and report["ok"] == 4
+    assert report["quarantined"] == 0 and report["reharvest_entries"] == 0
+    out = tmp_path / "scrub"
+    once = {p.name: p.read_bytes() for p in out.iterdir()}
+    scrub_store(tmp_path)  # re-run over an unchanged store
+    assert {p.name: p.read_bytes() for p in out.iterdir()} == once
+
+
+def test_scrub_quarantines_repairs_and_emits_worklist(tmp_path):
+    data = _mk_sharded(tmp_path, n_shards=2, chunks_per_shard=2)
+    victim = tmp_path / shard_name(0) / "1.npy"
+    _corrupt(victim)
+    report = scrub_store(tmp_path, repair=True)
+    assert report["quarantined"] == 1
+    # repair moved the file aside (bytes preserved for forensics)...
+    assert not victim.exists()
+    assert (tmp_path / shard_name(0) / "quarantine" / "1.npy").exists()
+    # ...the ledger knows, shard-locally...
+    assert set(load_quarantine(tmp_path / shard_name(0))) == {1}
+    # ...and the worklist names exactly what a re-harvest must regenerate
+    worklist = json.loads((tmp_path / "scrub" / "reharvest.json").read_text())
+    assert worklist == [{"shard": shard_name(0), "chunk": 1,
+                         "rows": ROWS_PER_CHUNK}]
+    # readers over the repaired store: positional None, no re-trip
+    store = ShardedChunkStore(tmp_path, quarantine_corrupt=True)
+    out = list(store.chunk_reader([0, 1, 2, 3]))
+    assert [c is None for c in out] == [False, True, False, False]
+    np.testing.assert_allclose(out[2], data[2 * ROWS_PER_CHUNK:
+                                            3 * ROWS_PER_CHUNK], atol=2e-3)
+
+
+def test_scrub_resumes_over_half_repaired_store(tmp_path):
+    """Re-running a repair scrub after any interruption point converges:
+    a chunk already moved aside (ledger entry durable) is re-reported,
+    not re-tripped over, and the outputs are byte-identical to a
+    single-pass scrub's."""
+    _mk_sharded(tmp_path, n_shards=2, chunks_per_shard=2)
+    _corrupt(tmp_path / shard_name(0) / "1.npy")
+    scrub_store(tmp_path, repair=True)
+    once = {p.name: p.read_bytes()
+            for p in (tmp_path / "scrub").iterdir()}
+    ledger_once = ledger_path(tmp_path / shard_name(0)).read_bytes()
+    report = scrub_store(tmp_path, repair=True)  # the resume pass
+    assert report["quarantined"] == 1
+    assert {p.name: p.read_bytes()
+            for p in (tmp_path / "scrub").iterdir()} == once
+    assert ledger_path(tmp_path / shard_name(0)).read_bytes() == ledger_once
+
+
+def test_scrub_heals_reharvested_chunk(tmp_path):
+    """The full self-healing cycle: rot → repair scrub (ledger entry +
+    file moved aside) → re-harvest puts a sound file back at the position
+    (the reharvest.json worklist's whole purpose) → the next scrub clears
+    the stale ledger entry, so readers deliver the healed chunk again
+    instead of skipping it forever while the report claims clean."""
+    data = _mk_sharded(tmp_path, n_shards=2, chunks_per_shard=2)
+    victim = tmp_path / shard_name(0) / "1.npy"
+    sound = victim.read_bytes()
+    _corrupt(victim)
+    scrub_store(tmp_path, repair=True)
+    shard = tmp_path / shard_name(0)
+    assert set(load_quarantine(shard)) == {1}
+    victim.write_bytes(sound)  # the re-harvest
+    report = scrub_store(tmp_path, repair=True)
+    assert report["ok"] == 4 and report["quarantined"] == 0
+    assert report["reharvest_entries"] == 0
+    # fully healed: the ledger file itself is gone (byte-identical to a
+    # store that never rotted); the forensics copy stays
+    assert load_quarantine(shard) == {}
+    assert not ledger_path(shard).exists()
+    assert (shard / "quarantine" / "1.npy").exists()
+    store = ShardedChunkStore(tmp_path, quarantine_corrupt=True)
+    out = list(store.chunk_reader([0, 1, 2, 3]))
+    assert all(c is not None for c in out)
+    np.testing.assert_allclose(np.concatenate(out), data, atol=2e-3)
+
+
+def test_clear_quarantine_last_entry_removes_ledger_file(tmp_path):
+    record_quarantine(tmp_path, 1, "r", "1.npy")
+    record_quarantine(tmp_path, 2, "r", "2.npy")
+    assert set(clear_quarantine(tmp_path, 1)) == {2}
+    assert set(load_quarantine(tmp_path)) == {2}
+    clear_quarantine(tmp_path, 2)
+    assert not ledger_path(tmp_path).exists()
+    clear_quarantine(tmp_path, 5)  # absent entry: no-op, no file created
+    assert not ledger_path(tmp_path).exists()
+
+
+def test_scrub_meta_damaged_shard_goes_whole_on_worklist(tmp_path):
+    _mk_sharded(tmp_path, n_shards=2)
+    meta = tmp_path / shard_name(1) / "meta.json"
+    meta.write_text(meta.read_text().replace("shard-tests", "tampered"))
+    report = scrub_store(tmp_path)
+    assert report["shards"][shard_name(1)]["meta_damaged"] is True
+    worklist = json.loads((tmp_path / "scrub" / "reharvest.json").read_text())
+    assert {"shard": shard_name(1), "chunk": None, "rows": None,
+            "whole_shard": True} in worklist
+
+
+def test_scrub_flat_store(tmp_path):
+    _write_folder(tmp_path / "flat", 64, seed=3)
+    _corrupt(tmp_path / "flat" / "2.npy")
+    report = scrub_folder(tmp_path / "flat")
+    assert report["checked"] == 4 and report["quarantined"] == [2]
+
+
+def test_scrub_never_initializes_a_backend(tmp_path):
+    """The RUNBOOK promise: scrub runs while the tunnel is wedged. Proof
+    by hostile environment — JAX_PLATFORMS names a platform that does
+    not exist, so ANY backend initialization raises; the scrub completing
+    means it never asked for one."""
+    store = tmp_path / "store"
+    _mk_sharded(store, n_shards=2)
+    _corrupt(store / shard_name(0) / "0.npy")
+    env = {**os.environ, "JAX_PLATFORMS": "no_such_backend"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the tunnel
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparse_coding_tpu.data.scrub", str(store),
+         "--repair"],
+        env=env, cwd=str(Path(__file__).resolve().parent.parent),
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["quarantined"] == 1
+    assert (store / shard_name(0) / "quarantine" / "0.npy").exists()
+
+
+# -- async ingest ------------------------------------------------------------
+
+
+def test_chunk_stream_matches_foreground_reader(tmp_path):
+    data = _mk_sharded(tmp_path, n_shards=2, chunks_per_shard=2)
+    store = ShardedChunkStore(tmp_path)
+    order = [1, 3, 0, 2, 1, 1, 3]
+    serial = [store.load_chunk(i) for i in order]
+    for streams in (1, 2, 3):
+        got = list(chunk_stream(store, order, streams=streams))
+        assert len(got) == len(serial)
+        for a, b in zip(got, serial):
+            np.testing.assert_array_equal(a, b)
+    del data
+
+
+def test_chunk_stream_positional_nones_and_durable_quarantine(tmp_path):
+    _mk_sharded(tmp_path, n_shards=2, chunks_per_shard=2)
+    _corrupt(tmp_path / shard_name(1) / "1.npy")  # global 3
+    store = ShardedChunkStore(tmp_path, quarantine_corrupt=True)
+    order = [3, 0, 3, 1, 2]
+    out = list(chunk_stream(store, order, streams=2))
+    assert [c is None for c in out] == [True, False, True, False, False]
+    # the discovery went straight to the owning shard's durable ledger
+    assert set(load_quarantine(tmp_path / shard_name(1))) == {1}
+
+
+def test_chunk_stream_strict_store_propagates_corruption(tmp_path):
+    _mk_sharded(tmp_path, n_shards=1, chunks_per_shard=2)
+    _corrupt(tmp_path / shard_name(0) / "0.npy")
+    store = ShardedChunkStore(tmp_path)
+    with pytest.raises(ChunkCorruptionError):
+        list(chunk_stream(store, [0, 1], streams=2))
+
+
+def test_chunk_stream_early_close_releases_threads(tmp_path):
+    _mk_sharded(tmp_path, n_shards=2, chunks_per_shard=2)
+    store = ShardedChunkStore(tmp_path)
+    gen = chunk_stream(store, [0, 1, 2, 3], streams=2)
+    first = next(gen)
+    assert first is not None
+    gen.close()  # must not hang or leak the pool
+
+
+def test_first_sound_chunk_skips_holes(tmp_path):
+    """Every one-chunk consumer (sweep centering, eval batch, baselines,
+    centered-experiment PCA) picks its chunk through this helper, so a
+    scrub-repaired chunk 0 must fall through to the next sound one."""
+    from sparse_coding_tpu.data.shard_store import first_sound_chunk
+
+    _write_folder(tmp_path, 64, seed=0)
+    store = ChunkStore(tmp_path, quarantine_corrupt=True)
+    assert first_sound_chunk(store) == 0
+    record_quarantine(tmp_path, 0, "r", "0.npy")
+    record_quarantine(tmp_path, 1, "r", "1.npy")
+    assert first_sound_chunk(
+        ChunkStore(tmp_path, quarantine_corrupt=True)) == 2
+    for i in (2, 3):
+        record_quarantine(tmp_path, i, "r", f"{i}.npy")
+    with pytest.raises(RuntimeError, match="every chunk is quarantined"):
+        first_sound_chunk(ChunkStore(tmp_path, quarantine_corrupt=True))
+
+
+def test_serial_fallback_beats_for_quarantined_positions(tmp_path):
+    """The generic serial path (sharded stores have no native-slab
+    serial reader; also the stream-death degrade target) must beat for
+    skipped ledger-known positions too — a long run of quarantined
+    chunks is reader progress, not a hang."""
+    _mk_sharded(tmp_path, n_shards=2, chunks_per_shard=2)
+    record_quarantine(tmp_path / shard_name(0), 1, "planted", "1.npy")
+    lease = lease_mod.Lease(tmp_path / "lease.json", step="ingest",
+                            interval_s=0.0)
+    lease_mod.configure(lease)
+    seq0 = lease_mod.read_lease(lease.path).seq
+    store = ShardedChunkStore(tmp_path, quarantine_corrupt=True)
+    out = list(chunk_stream(store, [0, 1, 2, 3], streams=1))
+    assert [c is None for c in out] == [False, True, False, False]
+    assert lease_mod.read_lease(lease.path).seq >= seq0 + 4
+
+
+def test_default_streams_ram_bound(monkeypatch):
+    """Auto stream count must never turn a sweep that fit the serial
+    reader's two-chunk RAM bound into an OOM kill: streams+2 resident
+    decoded chunks are held to half of available host RAM."""
+    from sparse_coding_tpu.data import ingest
+
+    monkeypatch.setattr(ingest, "_available_ram_bytes",
+                        lambda: 100 * 2**20)
+    core_bound = ingest.default_streams()
+    assert core_bound >= 1
+    # tiny chunks: RAM is no constraint
+    assert ingest.default_streams(chunk_nbytes=1024) == core_bound
+    # huge chunks: collapse to the serial bound rather than risk the OOM
+    assert ingest.default_streams(chunk_nbytes=40 * 2**20) == 1
+    # free RAM unreadable (non-Linux sysconf): fall back to the core bound
+    monkeypatch.setattr(ingest, "_available_ram_bytes", lambda: None)
+    assert ingest.default_streams(chunk_nbytes=40 * 2**20) == core_bound
+
+
+def test_decoded_chunk_nbytes_header_only(tmp_path):
+    from sparse_coding_tpu.data.ingest import _decoded_chunk_nbytes
+
+    _write_folder(tmp_path / "flat", 64, seed=0)
+    flat = ChunkStore(tmp_path / "flat")
+    assert (_decoded_chunk_nbytes(flat, [0], np.float32)
+            == ROWS_PER_CHUNK * DIM * 4)
+    _mk_sharded(tmp_path / "sharded")
+    sharded = ShardedChunkStore(tmp_path / "sharded")
+    assert (_decoded_chunk_nbytes(sharded, [2], np.float32)
+            == ROWS_PER_CHUNK * DIM * 4)
+    # undeterminable (empty index list) degrades to None, never raises
+    assert _decoded_chunk_nbytes(flat, [], np.float32) is None
+    # a repaired hole at the front of the order is skipped, not fatal —
+    # the RAM bound must survive a shuffled order starting on a hole
+    record_quarantine(tmp_path / "flat", 0, "r", "0.npy")
+    (tmp_path / "flat" / "0.npy").unlink()
+    flat2 = ChunkStore(tmp_path / "flat", quarantine_corrupt=True)
+    assert (_decoded_chunk_nbytes(flat2, [0, 1], np.float32)
+            == ROWS_PER_CHUNK * DIM * 4)
+
+
+def test_device_batches_order_and_values(tmp_path):
+    batches = [np.full((4, DIM), i, np.float32) for i in range(7)]
+    out = list(device_batches(iter(batches)))
+    assert len(out) == 7
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(b), batches[i])
+
+
+def test_chunk_stream_pt_store_stays_serial(tmp_path):
+    torch = pytest.importorskip("torch")
+    folder = tmp_path / "ref"
+    folder.mkdir()
+    chunks = [np.random.default_rng(i).normal(size=(8, 4)).astype(np.float16)
+              for i in range(3)]
+    for i, a in enumerate(chunks):
+        torch.save(torch.tensor(a), folder / f"{i}.pt")
+    store = ChunkStore(folder)
+    # torch deserialization is not a thread-friendly raw read: the stream
+    # must delegate to the store's own single-stream reader
+    got = list(chunk_stream(store, [2, 0], streams=4))
+    np.testing.assert_allclose(got[0], chunks[2].astype(np.float32))
+    np.testing.assert_allclose(got[1], chunks[0].astype(np.float32))
+
+
+def test_scrub_refuses_pt_reference_store(tmp_path):
+    """A pt-format reference store (utils/ref_interop.py layout: .pt
+    chunks + meta.json with n_chunks but no raw-chunk digests) must be
+    REFUSED, not scrubbed: every healthy chunk would hit the
+    missing-.npy branch and be durably quarantined — a scrub that
+    silently empties a good dataset. No ledger may be written."""
+    (tmp_path / "0.pt").write_bytes(b"not-actually-read")
+    (tmp_path / "1.pt").write_bytes(b"not-actually-read")
+    (tmp_path / "meta.json").write_text(json.dumps({"n_chunks": 2}))
+    with pytest.raises(ValueError, match="pt-format"):
+        scrub_folder(tmp_path)
+    with pytest.raises(ValueError, match="pt-format"):
+        scrub_store(tmp_path)
+    assert not ledger_path(tmp_path).exists()
+    assert not (tmp_path / "scrub").exists()
+
+
+def test_scrub_allows_fully_repaired_npy_store(tmp_path):
+    """The pt guard must not false-positive on an npy store whose every
+    live chunk was already repaired away (all files in quarantine/):
+    re-scrubbing it is the documented resume path and converges."""
+    _write_folder(tmp_path, ROWS_PER_CHUNK * 2, seed=0)
+    for i in range(2):
+        _corrupt(tmp_path / f"{i}.npy")
+    first = scrub_folder(tmp_path, repair=True)
+    assert first["quarantined"] == [0, 1]
+    again = scrub_folder(tmp_path, repair=True)  # zero live .npy files left
+    assert again["quarantined"] == [0, 1] and again["ok"] == 0
+
+
+def test_shard_dirs_orders_numerically_past_padding(tmp_path):
+    """shard_name pads to 3 digits; at >=1000 shards a lexical sort would
+    interleave ('shard-1000' < 'shard-999') and silently permute the
+    shard-major positional space. The listing must be numeric."""
+    from sparse_coding_tpu.data.shard_store import shard_dirs
+
+    for i in (0, 2, 999, 1000, 1001):
+        (tmp_path / shard_name(i)).mkdir()
+    (tmp_path / "shard-extra").mkdir()  # non-numeric suffix: sorts first
+    names = [p.name for p in shard_dirs(tmp_path)]
+    assert names == ["shard-extra", "shard-000", "shard-002", "shard-999",
+                     "shard-1000", "shard-1001"]
+
+
+def test_fully_repaired_store_still_opens_and_yields_nones(tmp_path):
+    """A folder whose EVERY live chunk was scrub-repaired away (all files
+    in quarantine/, ledger + meta intact) must still open — the DAG's
+    sweep/eval run right after a successful scrub, and a store the scrub
+    just healed must not brick them with FileNotFoundError. Readers see
+    the full positional space as Nones; a sharded store with one such
+    shard opens whole."""
+    flat = tmp_path / "flat"
+    _write_folder(flat, ROWS_PER_CHUNK * 2, seed=0)
+    for i in range(2):
+        _corrupt(flat / f"{i}.npy")
+    rep = scrub_folder(flat, repair=True)
+    assert rep["quarantined"] == [0, 1] and not list(flat.glob("*.npy"))
+    store = ChunkStore(flat, quarantine_corrupt=True)
+    assert store.n_chunks == 2 and store.activation_dim == DIM
+    assert list(store.chunk_reader([0, 1])) == [None, None]
+
+    root = tmp_path / "sharded"
+    data = _mk_sharded(root, n_shards=2, chunks_per_shard=2)
+    for i in range(2):
+        _corrupt(root / shard_name(0) / f"{i}.npy")
+    scrub_store(root, repair=True)
+    sharded = ShardedChunkStore(root, quarantine_corrupt=True)
+    out = list(sharded.chunk_reader([0, 1, 2, 3]))
+    assert [c is None for c in out] == [True, True, False, False]
+    np.testing.assert_array_equal(out[2], data[2 * ROWS_PER_CHUNK:
+                                               3 * ROWS_PER_CHUNK])
+    # a store with no chunks AND no meta is still a loud, typed failure
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError):
+        ChunkStore(empty)
