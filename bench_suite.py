@@ -9,7 +9,8 @@ real hardware starts from a complete profile:
 Suites: ensemble train (autodiff + fused + bf16-precision variants), big-SAE
 train (single giant dict), activation harvesting (tokens/s through the LM
 with taps), sequence-parallel long-context forward (over whatever mesh the
-host offers), and chunk-store IO.
+host offers), chunk-store IO, and the guardian divergence soak (sentinel
+step overhead + frozen-member/zero-rollback drill semantics).
 """
 
 from __future__ import annotations
@@ -329,6 +330,80 @@ def bench_streaming_eval(quick: bool) -> None:
               d=d, n_feats=d * ratio, single_pass=True)
 
 
+def bench_guardian_soak(quick: bool) -> None:
+    """Divergence-drill soak (ISSUE 10): three synthetic sweeps over one
+    store — sentinel OFF (the pre-guardian step programs), sentinel ON
+    (same data, no injection), and sentinel ON with a member-targeted NaN
+    injected mid-sweep. Reports the sentinel's step overhead (ON vs OFF
+    ``sweep.chunk`` span walls, read back through ``obs.report`` — the
+    production evidence path; acceptance wants <2%), and proves the drill
+    semantics at bench scale: exactly one member frozen, ZERO rollbacks
+    (live members never pay for a neighbor's divergence)."""
+    import shutil
+    import tempfile
+
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.config import SyntheticEnsembleArgs
+    from sparse_coding_tpu.obs.report import build_report
+    from sparse_coding_tpu.resilience import faults
+    import sparse_coding_tpu.train.sweep as sweep_mod
+    from sparse_coding_tpu.train.experiments import dense_l1_range_experiment
+
+    d, members, rows = (64, 4, 60_000) if quick else (128, 8, 200_000)
+    l1s = list(np.logspace(-4, -2, members))
+    with tempfile.TemporaryDirectory() as td:
+        root = Path(td)
+
+        def cfg(name, sentinel):
+            return SyntheticEnsembleArgs(
+                output_folder=str(root / name),
+                dataset_folder=str(root / "chunks"), batch_size=1024,
+                n_chunks=4, activation_dim=d,
+                n_ground_truth_features=2 * d, dataset_size=rows,
+                learned_dict_ratio=2.0, sentinel=sentinel, seed=0)
+
+        build = lambda c, m: dense_l1_range_experiment(  # noqa: E731
+            c, m, l1_range=l1s, activation_dim=d)
+
+        def run(name, sentinel, plan=None):
+            run_dir = root / f"obs_{name}"
+            prev_sink = obs.configure_sink(
+                obs.EventSink(run_dir / "obs" / "soak.jsonl"))
+            prev_reg = obs.set_registry(obs.Registry())
+            try:
+                if plan:
+                    faults.install_plan(faults.parse_fault_plan(plan))
+                sweep_mod.sweep(build, cfg(name, sentinel), log_every=10**9,
+                                image_metrics_every=None)
+                obs.flush_metrics()
+            finally:
+                faults.install_plan(None)
+                obs.set_registry(prev_reg)
+                obs.configure_sink(prev_sink)
+            report = build_report(run_dir)
+            # p50 chunk wall = steady state: chunk 0's wall carries the
+            # step program's XLA compile, which at soak scale would drown
+            # the per-step signal this scenario exists to measure
+            chunk = report["spans"].get("sweep.chunk", {})
+            return (chunk.get("p50_s") or 0.0, report["guardian"])
+
+        run("warmup", sentinel=True)  # store materialization
+        off_s, _ = run("off", sentinel=False)
+        on_s, _ = run("on", sentinel=True)
+        inj_s, guard = run(
+            "inject", sentinel=True,
+            plan=f"sweep.anomaly:nth=5,mode=error,message=member="
+                 f"{members // 2}")
+        overhead_pct = (on_s - off_s) / off_s * 100.0 if off_s else 0.0
+        _emit("guardian_soak", overhead_pct, "% sentinel step overhead",
+              n_members=members, d=d, rows=rows,
+              chunk_p50_off=round(off_s, 4), chunk_p50_on=round(on_s, 4),
+              chunk_p50_injected=round(inj_s, 4),
+              frozen_members=guard["members_quarantined"],
+              rollbacks=guard["rollbacks"], halts=guard["halts"])
+        shutil.rmtree(root / "chunks", ignore_errors=True)
+
+
 def bench_serving(quick: bool) -> None:
     """Online feature-extraction serving: concurrent mixed-size requests
     through the micro-batching engine's AOT bucket programs. Reports
@@ -527,7 +602,7 @@ def main() -> None:
     # earlier suite's JSON line is flushed by then
     for suite in (bench_ensemble, bench_big_sae, bench_harvest,
                   bench_chunk_io, bench_ingest_soak, bench_streaming_eval,
-                  bench_gateway, bench_seq_parallel):
+                  bench_guardian_soak, bench_gateway, bench_seq_parallel):
         try:
             suite(args.quick)
         except Exception as e:

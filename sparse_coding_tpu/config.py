@@ -179,6 +179,22 @@ class EnsembleArgs(BaseArgs):
     # 1-slab readahead — also the path a dying stream degrades to when a
     # worker dies mid-epoch
     ingest_streams: int = 0
+    # training health guardian (train/guardian.py, docs/ARCHITECTURE.md
+    # §16): divergence detection → per-member quarantine → last-good
+    # rollback → typed halt. False runs bare (no ledger, no rollback).
+    guardian: bool = True
+    # quarantined-member fraction that escalates from freezing individual
+    # members to rolling the whole sweep back (a systemic incident)
+    guardian_member_fraction: float = 0.5
+    # total rollbacks before the guardian halts with a typed diagnosis —
+    # every rollback quarantines one chunk, so this also bounds how much
+    # of the store an unattended run may discard before a human looks
+    guardian_rollback_budget: int = 4
+    # in-graph anomaly sentinel (ensemble.py §16): per-member finite
+    # flags/grad norms in the aux + the non-finite-update freeze. False
+    # rebuilds the exact pre-sentinel step programs — the bench A/B knob
+    # (guardian_soak measures the sentinel's step overhead against it)
+    sentinel: bool = True
 
 
 @dataclass

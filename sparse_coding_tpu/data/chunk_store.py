@@ -214,7 +214,8 @@ class ChunkStore:
     streaming throughput matters."""
 
     def __init__(self, folder: str | Path, quarantine_corrupt: bool = False,
-                 verify_digests: bool = True, io_retries: int = 3,
+                 verify_digests: bool = True, verify_finite: bool = True,
+                 io_retries: int = 3,
                  retry_base_delay_s: float = 0.01):
         # quarantine_corrupt=True: streaming readers (chunk_reader/epoch)
         # skip a corrupt chunk with one logged warning instead of raising —
@@ -223,6 +224,14 @@ class ChunkStore:
         # asked for THAT chunk).
         self.quarantine_corrupt = bool(quarantine_corrupt)
         self.verify_digests = bool(verify_digests)
+        # decode-side finite guard (docs/ARCHITECTURE.md §16): a chunk
+        # whose decoded rows contain NaN/Inf is typed corruption exactly
+        # like a digest mismatch — a harvest that wrote garbage passes
+        # every digest, and non-finite activations silently poison any
+        # member that trains on them. Verified once per chunk per process
+        # (same cache rationale as _digest_verified).
+        self.verify_finite = bool(verify_finite)
+        self._finite_verified: set[str] = set()
         self.io_retries = int(io_retries)
         self.retry_base_delay_s = float(retry_base_delay_s)
         # chunks whose digest already verified this process: a sha256 over
@@ -300,7 +309,19 @@ class ChunkStore:
         if self.format == "pt":
             from sparse_coding_tpu.utils.ref_interop import read_pt_chunk
 
-            return read_pt_chunk(self._path(i), dtype=dtype)
+            path = self._path(i)
+            arr = read_pt_chunk(path, dtype=dtype)
+            # same finite gate as _finish_raw: reference-interop chunks
+            # have no digests at all, so NaN rows are the ONLY corruption
+            # this path can even detect
+            stem = str(path.stem)
+            if self.verify_finite and stem not in self._finite_verified:
+                if not np.isfinite(arr).all():
+                    raise ChunkCorruptionError(
+                        int(path.stem), path,
+                        "non-finite values in decoded rows")
+                self._finite_verified.add(stem)
+            return arr
         from sparse_coding_tpu.data.native_io import (
             DEFAULT_THREADS,
             read_npy_native,
@@ -388,6 +409,15 @@ class ChunkStore:
                     "meta.json is missing or lacks dtype=bfloat16 — likely an "
                     "interrupted harvest; re-run it or write meta.json by hand")
             raw = raw.view(jnp.bfloat16)
+        if self.verify_finite and stem not in self._finite_verified:
+            # checked on the on-disk dtype (f16/bf16/f32 — np.isfinite
+            # handles the ml_dtypes bfloat16 view) BEFORE the cast, so
+            # garbage never reaches the training step via any read path
+            if not np.isfinite(raw).all():
+                raise ChunkCorruptionError(
+                    int(path.stem), path,
+                    "non-finite values in decoded rows")
+            self._finite_verified.add(stem)
         from sparse_coding_tpu.data.native_io import fast_astype
 
         return fast_astype(raw, dtype)

@@ -59,10 +59,12 @@ from sparse_coding_tpu.resilience.retry import retry_io
 logger = logging.getLogger(__name__)
 
 register_fault_site("ingest.decode",
-                    "async ingest stream decode — before each background "
-                    "chunk read (data/ingest.py chunk_stream); an injected "
-                    "error kills the stream and forces the degraded "
-                    "single-stream path")
+                    "async ingest stream decode — each background chunk "
+                    "read (data/ingest.py chunk_stream), the DECODED chunk "
+                    "as payload; an injected error kills the stream and "
+                    "forces the degraded single-stream path, an injected "
+                    "nan/corrupt payload must fail the finite gate and "
+                    "quarantine positionally")
 register_fault_site("ingest.transfer",
                     "host->device batch transfer — inside device_batches' "
                     "bounded-retry scope (data/ingest.py)")
@@ -185,9 +187,19 @@ def chunk_stream(store, indices, dtype=np.float32, streams: Optional[int] = None
 
     def decode(ci: int):
         t0 = obs.monotime()
-        fault_point("ingest.decode")
         chunk = store.load_chunk(ci, dtype)
-        return chunk, obs.monotime() - t0
+        out = fault_point("ingest.decode", chunk)
+        if out is not chunk:
+            # a fired corrupt/nan-mode fault returned a mutated COPY
+            # (identity is the fired-vs-clean contract, resilience/
+            # faults.py): the injected payload must re-pass the finite
+            # gate the store applied to the real bytes — the drill for
+            # post-digest in-memory rot reaching the step
+            if not np.isfinite(out).all():
+                raise ChunkCorruptionError(
+                    int(ci), store._path(ci),
+                    "non-finite values in decoded rows")
+        return out, obs.monotime() - t0
 
     pool = ThreadPoolExecutor(max_workers=int(streams),
                               thread_name_prefix="ingest")

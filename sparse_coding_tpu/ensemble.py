@@ -76,6 +76,13 @@ class EnsembleState(struct.PyTreeNode):
     opt_state: Pytree
     lrs: Array  # [N] per-member learning rate
     step: Array  # scalar step counter
+    # [N] bool live-mask (docs/ARCHITECTURE.md §16): False freezes a
+    # member — its params and optimizer state pass through every step
+    # unchanged (a bitwise no-op for True members). Host-owned: only the
+    # training guardian (train/guardian.py) flips it; the in-graph
+    # sentinel additionally skips any single step whose loss/grads went
+    # non-finite without touching this mask.
+    live: Optional[Array] = None
     static_buffers: StaticBuffers = struct.field(pytree_node=False, default=())
     sig_name: str = struct.field(pytree_node=False, default="")
 
@@ -107,18 +114,74 @@ def _fused_aux(losses: dict, activity: Array) -> AuxData:
         feat_activity=activity.astype(jnp.int32))
 
 
+def _select_members(ok: Array, new: Pytree, old: Pytree) -> Pytree:
+    """Per-member select over stacked [N, ...] trees: where ``ok[i]`` the
+    new leaf slice, else the old one. ``jnp.where`` on a boolean mask is
+    an exact element copy, so a True member's result is BITWISE the
+    unguarded update (property-tested, tests/test_ensemble.py) and a
+    False member's state — params, Adam moments, bias-correction count —
+    passes through untouched, NaN/Inf in the discarded branch included."""
+
+    def sel(n, o):
+        mask = ok.reshape(ok.shape + (1,) * (n.ndim - 1))
+        return jnp.where(mask, n, o)
+
+    return jax.tree.map(sel, new, old)
+
+
+def _member_delta_norm(new: Pytree, old: Pytree) -> Array:
+    """Per-member global L2 norm of (new − old) over stacked [N, ...]
+    trees — the whole-step kernels' grad-norm surrogate: any non-finite
+    leaf in the kernel's output propagates into this one [N] reduction,
+    so finiteness of the whole update is checkable without re-scanning
+    every tensor with isfinite."""
+
+    def sq(n, o):
+        d = n - o
+        return jnp.sum(jnp.square(d), axis=tuple(range(1, d.ndim)))
+
+    return jnp.sqrt(sum(jax.tree.leaves(jax.tree.map(sq, new, old))))
+
+
+def _sentinel_finite(loss: Array, *norms: Array) -> Array:
+    """[N] step-finite flag from the per-member loss and norm reductions
+    (each norm already folds a whole tree's non-finites into one value)."""
+    finite = jnp.isfinite(loss)
+    for n in norms:
+        finite = finite & jnp.isfinite(n)
+    return finite
+
+
 def _apply_fused_updates(optimizer, losses, grads, activity,
-                         params, opt_state, lrs):
+                         params, opt_state, lrs, live=None):
     """Shared tail of the two-stage fused steps: vmapped per-member Adam
-    update from kernel-produced grads + shared AuxData assembly."""
+    update from kernel-produced grads + shared AuxData assembly. With
+    ``live`` (the state's [N] live-mask) the in-graph anomaly sentinel is
+    woven in: per-member grad/update global norms, a step-finite flag,
+    and a member-select that freezes quarantined or non-finite members —
+    all device-side, nothing synced to the host (§16)."""
+
+    sentinel = live is not None
 
     def member_update(g, opt_state, params, lr):
+        norms = (optax.global_norm(g),) if sentinel else ()
         updates, opt_state = optimizer.update(g, opt_state, params)
         updates = jax.tree.map(lambda u: -lr * u, updates)
-        return optax.apply_updates(params, updates), opt_state
+        if sentinel:
+            norms += (optax.global_norm(updates),)
+        return optax.apply_updates(params, updates), opt_state, norms
 
-    params, opt_state = jax.vmap(member_update)(grads, opt_state, params, lrs)
-    return params, opt_state, _fused_aux(losses, activity)
+    new_params, new_opt, norms = jax.vmap(member_update)(
+        grads, opt_state, params, lrs)
+    aux = _fused_aux(losses, activity)
+    if not sentinel:  # the pre-guardian step, bit for bit
+        return new_params, new_opt, aux
+    gn, un = norms
+    finite = _sentinel_finite(aux.losses["loss"], gn, un)
+    ok = live & finite
+    return (_select_members(ok, new_params, params),
+            _select_members(ok, new_opt, opt_state),
+            aux.replace(finite=finite, grad_norm=gn))
 
 
 def _tied_producer(batch_tile, interpret, compute_dtype):
@@ -158,10 +221,21 @@ def _untied_producer(batch_tile, interpret, compute_dtype):
     return producer
 
 
+def _stamp_inputs_finite(aux: AuxData, batch: Array,
+                         sentinel: bool) -> AuxData:
+    """Fold the batch-finite flag into the aux (device-side scalar; the
+    guardian's data-corruption incident class). Computed at the step
+    wrapper, outside any vmap/shard_map, so it is one replicated scalar."""
+    if not sentinel:
+        return aux
+    return aux.replace(inputs_finite=jnp.all(jnp.isfinite(batch)))
+
+
 def make_fused_step(
     producer: Callable,
     optimizer: optax.GradientTransformation,
     donate: bool = True,
+    sentinel: bool = True,
 ) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
     """Single-device fused-kernel step: loss + exact grads come from one
     Pallas pass (via `producer`, see _tied_producer/_untied_producer) instead
@@ -171,7 +245,9 @@ def make_fused_step(
         losses, grads, activity = producer(state.params, state.buffers, batch)
         params, opt_state, aux = _apply_fused_updates(
             optimizer, losses, grads, activity,
-            state.params, state.opt_state, state.lrs)
+            state.params, state.opt_state, state.lrs,
+            live=state.live if sentinel else None)
+        aux = _stamp_inputs_finite(aux, batch, sentinel)
         new_state = state.replace(params=params, opt_state=opt_state,
                                   step=state.step + 1)
         return new_state, aux
@@ -184,6 +260,7 @@ def make_fused_step_sharded(
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
     donate: bool = True,
+    sentinel: bool = True,
 ) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
     """Mesh-composed fused step: the flagship multi-chip configuration
     (replacing /root/reference/cluster_runs.py:100-157's all-GPUs-training
@@ -197,28 +274,54 @@ def make_fused_step_sharded(
     one [N_local, n, d] grad reduce-scatter-shaped psum riding ICI."""
     from jax import shard_map
 
-    def local_step(params, buffers, opt_state, lrs, local_batch, total_batch):
+    def local_step(params, buffers, opt_state, lrs, live, local_batch,
+                   total_batch):
         losses, grads, activity = producer(params, buffers, local_batch,
                                            total_batch=total_batch,
                                            psum_axis="data")
+        # the post-psum losses/grads are identical on every data shard, so
+        # the sentinel's finite flags — and therefore the member-select —
+        # agree across the whole mesh by construction
         return _apply_fused_updates(optimizer, losses, grads, activity,
-                                    params, opt_state, lrs)
+                                    params, opt_state, lrs,
+                                    live=live if sentinel else None)
 
     def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
         sharded = shard_map(
             functools.partial(local_step, total_batch=batch.shape[0]),
             mesh=mesh,
             in_specs=(P("model"), P("model"), P("model"), P("model"),
-                      P("data")),
+                      P("model"), P("data")),
             out_specs=(P("model"), P("model"), P("model")),
             check_vma=False)
         params, opt_state, aux = sharded(
-            state.params, state.buffers, state.opt_state, state.lrs, batch)
+            state.params, state.buffers, state.opt_state, state.lrs,
+            state.live, batch)
+        aux = _stamp_inputs_finite(aux, batch, sentinel)
         new_state = state.replace(params=params, opt_state=opt_state,
                                   step=state.step + 1)
         return new_state, aux
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def _guard_fullfused(state: EnsembleState, params, opt_state, aux, batch,
+                     sentinel: bool):
+    """Sentinel tail shared by both whole-step kernel paths: grads never
+    leave the kernel, so the per-member update-delta norm (any NaN/Inf in
+    the kernel's new params propagates into it) stands in for the grad
+    norm, and the member-select freezes quarantined/non-finite members.
+    One elementwise pass over the [N, n, d] tensors the kernel already
+    wrote — no extra host traffic, no second isfinite scan."""
+    if not sentinel or state.live is None:
+        return params, opt_state, aux
+    un = _member_delta_norm(params, state.params)
+    finite = _sentinel_finite(aux.losses["loss"], un)
+    ok = state.live & finite
+    return (_select_members(ok, params, state.params),
+            _select_members(ok, opt_state, state.opt_state),
+            _stamp_inputs_finite(aux.replace(finite=finite, grad_norm=un),
+                                 batch, True))
 
 
 def make_fullfused_tied_step(
@@ -227,6 +330,7 @@ def make_fullfused_tied_step(
     interpret: bool = False,
     batch_tile: Optional[int] = None,
     compute_dtype: str = "float32",
+    sentinel: bool = True,
 ) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
     """Single-device tied-SAE step where the WHOLE step — normalization,
     loss, exact grads, normalization VJP, and the optax-Adam update — runs in
@@ -241,6 +345,7 @@ def make_fullfused_tied_step(
 
     def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
         opt = state.opt_state
+        raw_batch = batch
         batch, tile = prepare_kernel_batch(
             batch, state.params["encoder"].shape[1],
             state.params["encoder"].shape[2], batch_tile, compute_dtype,
@@ -264,6 +369,8 @@ def make_fullfused_tied_step(
             mu={"encoder": mu_e, "encoder_bias": mu_b},
             nu={"encoder": nu_e, "encoder_bias": nu_b})
         aux = _fused_aux(losses, activity)
+        params, opt_state, aux = _guard_fullfused(
+            state, params, opt_state, aux, raw_batch, sentinel)
         new_state = state.replace(params=params, opt_state=opt_state,
                                   step=state.step + 1)
         return new_state, aux
@@ -277,6 +384,7 @@ def make_fullfused_untied_step(
     interpret: bool = False,
     batch_tile: Optional[int] = None,
     compute_dtype: str = "float32",
+    sentinel: bool = True,
 ) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
     """Single-device untied-SAE whole-step path: TWO Pallas passes and no XLA
     prologue/epilogue on the big matrices. Pass 1 (fused_untied_sae_grads)
@@ -305,6 +413,7 @@ def make_fullfused_untied_step(
         dec = state.params["decoder"]
         bias = state.params["encoder_bias"]
         n_feats, d = e.shape[1], e.shape[2]
+        raw_batch = batch
         batch, tile = prepare_kernel_batch(batch, n_feats, d, batch_tile,
                                            compute_dtype, n_mats=2)
         ftile = pick_epilogue_tile(n_feats, d)
@@ -334,6 +443,8 @@ def make_fullfused_untied_step(
             mu={"encoder": mu_e, "encoder_bias": mu_b, "decoder": mu_d},
             nu={"encoder": nu_e, "encoder_bias": nu_b, "decoder": nu_d})
         aux = _fused_aux(losses, activity)
+        params, opt_state, aux = _guard_fullfused(
+            state, params, opt_state, aux, raw_batch, sentinel)
         new_state = state.replace(params=params, opt_state=opt_state,
                                   step=state.step + 1)
         return new_state, aux
@@ -342,32 +453,35 @@ def make_fullfused_untied_step(
 
 
 def make_fused_tied_step(optimizer, donate=True, interpret=False,
-                         batch_tile=None, compute_dtype="float32"):
+                         batch_tile=None, compute_dtype="float32",
+                         sentinel=True):
     return make_fused_step(
         _tied_producer(batch_tile, interpret, compute_dtype), optimizer,
-        donate=donate)
+        donate=donate, sentinel=sentinel)
 
 
 def make_fused_tied_step_sharded(optimizer, mesh, donate=True, interpret=False,
-                                 batch_tile=None, compute_dtype="float32"):
+                                 batch_tile=None, compute_dtype="float32",
+                                 sentinel=True):
     return make_fused_step_sharded(
         _tied_producer(batch_tile, interpret, compute_dtype), optimizer, mesh,
-        donate=donate)
+        donate=donate, sentinel=sentinel)
 
 
 def make_fused_untied_step(optimizer, donate=True, interpret=False,
-                           batch_tile=None, compute_dtype="float32"):
+                           batch_tile=None, compute_dtype="float32",
+                           sentinel=True):
     return make_fused_step(
         _untied_producer(batch_tile, interpret, compute_dtype), optimizer,
-        donate=donate)
+        donate=donate, sentinel=sentinel)
 
 
 def make_fused_untied_step_sharded(optimizer, mesh, donate=True,
                                    interpret=False, batch_tile=None,
-                                   compute_dtype="float32"):
+                                   compute_dtype="float32", sentinel=True):
     return make_fused_step_sharded(
         _untied_producer(batch_tile, interpret, compute_dtype), optimizer,
-        mesh, donate=donate)
+        mesh, donate=donate, sentinel=sentinel)
 
 
 def can_use_fused_untied_step(sig: Any, members,
@@ -423,27 +537,43 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     statics: StaticBuffers = (),
     donate: bool = True,
+    sentinel: bool = True,
 ) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
     """Build the jitted (state, batch) -> (state, aux) step for a signature.
 
     One minibatch is shared by every member (the reference expands it across
     the ensemble axis, ensemble.py:175-181 — under vmap with in_axes=None the
-    broadcast is free)."""
+    broadcast is free). With ``sentinel`` (the default) the in-graph anomaly
+    sentinel rides the same program (§16): per-member grad/update norms and
+    finite flags fold into the returned aux, and a member whose step went
+    non-finite — or whose ``state.live`` flag the guardian cleared — keeps
+    its params and optimizer state bit-identically unchanged."""
 
     def member_step(params, buffers, opt_state, lr, batch):
         def loss_fn(p):
             return sig.loss(p, merge_buffers(buffers, statics), batch)
 
         (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        norms = (optax.global_norm(grads),) if sentinel else ()
         updates, opt_state = optimizer.update(grads, opt_state, params)
         updates = jax.tree.map(lambda u: -lr * u, updates)
+        if sentinel:
+            norms += (optax.global_norm(updates),)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, aux
+        return params, opt_state, aux, norms
 
     def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
         vstep = jax.vmap(member_step, in_axes=(0, 0, 0, 0, None))
-        params, opt_state, aux = vstep(
+        params, opt_state, aux, norms = vstep(
             state.params, state.buffers, state.opt_state, state.lrs, batch)
+        if sentinel and state.live is not None:
+            gn, un = norms
+            finite = _sentinel_finite(aux.losses["loss"], gn, un)
+            ok = state.live & finite
+            params = _select_members(ok, params, state.params)
+            opt_state = _select_members(ok, opt_state, state.opt_state)
+            aux = _stamp_inputs_finite(
+                aux.replace(finite=finite, grad_norm=gn), batch, True)
         new_state = state.replace(params=params, opt_state=opt_state,
                                   step=state.step + 1)
         return new_state, aux
@@ -475,6 +605,7 @@ class Ensemble:
         fused_compute_dtype: str = "float32",
         fused_path: Optional[str] = None,
         fused_moments_dtype: str = "float32",
+        sentinel: bool = True,
     ):
         if fused_path not in (None, "two_stage", "train_step"):
             raise ValueError(
@@ -539,16 +670,24 @@ class Ensemble:
                                            nu=cast(opt_state.nu))
         self._moments_itemsize = 2 if fused_moments_dtype == "bfloat16" else 4
 
+        # in-graph anomaly sentinel (docs/ARCHITECTURE.md §16): detection +
+        # per-member freeze woven into every step program. The opt-out is
+        # the bench A/B knob (guardian_soak measures the sentinel's step
+        # overhead against it) and the escape hatch should a shape ever
+        # regress — live stays in the state either way, so checkpoints
+        # keep one format.
+        self.sentinel = bool(sentinel)
         self.state = EnsembleState(
             params=params, buffers=buffers, opt_state=opt_state, lrs=lrs,
-            step=jnp.zeros((), jnp.int32), static_buffers=statics0,
-            sig_name=self.sig_name,
+            step=jnp.zeros((), jnp.int32), live=jnp.ones((n,), jnp.bool_),
+            static_buffers=statics0, sig_name=self.sig_name,
         )
         if mesh is not None:
             self.state = shard_ensemble_state(self.state, mesh)
 
         self._standard_step = make_train_step(sig, self.optimizer,
-                                              statics=statics0, donate=donate)
+                                              statics=statics0, donate=donate,
+                                              sentinel=self.sentinel)
         self._fused_step = None
         # pick the fused family for this signature, if any: tied_sae (one
         # weight matrix resident per member) or plain sae (two). The
@@ -577,12 +716,14 @@ class Ensemble:
                 make_sharded(self.optimizer, mesh, donate=donate,
                              interpret=fused_interpret,
                              batch_tile=fused_batch_tile,
-                             compute_dtype=fused_compute_dtype)
+                             compute_dtype=fused_compute_dtype,
+                             sentinel=self.sentinel)
                 if mesh is not None else
                 make_single(self.optimizer, donate=donate,
                             interpret=fused_interpret,
                             batch_tile=fused_batch_tile,
-                            compute_dtype=fused_compute_dtype))
+                            compute_dtype=fused_compute_dtype,
+                            sentinel=self.sentinel))
             # single-device whole-step paths, resolved per batch in
             # _resolve_step and preferred in auto mode when their working
             # sets admit (r4 on-chip A/B: ~9% faster than two_stage):
@@ -602,7 +743,8 @@ class Ensemble:
                 self._fullfused_step = make_fullfused(
                     self._adam_hypers, donate=donate,
                     interpret=fused_interpret, batch_tile=fused_batch_tile,
-                    compute_dtype=fused_compute_dtype)
+                    compute_dtype=fused_compute_dtype,
+                    sentinel=self.sentinel)
         # the fused kernel additionally needs a VMEM-fitting batch tile — only
         # known once the real batch arrives, so the final choice happens on
         # the first step_batch call (and is re-checked per batch size).
@@ -637,6 +779,26 @@ class Ensemble:
     @property
     def n_members(self) -> int:
         return self.state.n_members
+
+    def freeze_members(self, indices: Sequence[int]) -> None:
+        """Clear live-mask bits (host-side; the guardian's per-member
+        quarantine, train/guardian.py). Idempotent. A frozen member's
+        params and optimizer state pass through every subsequent step
+        bit-identically unchanged; live members are untouched."""
+        indices = [int(i) for i in indices]
+        if not indices or self.state.live is None:
+            return
+        live = self.state.live.at[jnp.asarray(indices, jnp.int32)].set(False)
+        self.state = self.state.replace(live=live)
+
+    def live_mask(self) -> "np.ndarray":
+        """Host copy of the [N] live-mask (all-True when the state
+        predates the sentinel)."""
+        import numpy as np
+
+        if self.state.live is None:
+            return np.ones((self.n_members,), np.bool_)
+        return np.asarray(jax.device_get(self.state.live))
 
     def _resolve_step(self, batch_size: int, batch_itemsize: int = 4):
         """Pick fused vs autodiff for this batch size: the fused kernel needs
@@ -947,6 +1109,7 @@ def shard_ensemble_state(state: EnsembleState, mesh: Mesh) -> EnsembleState:
         opt_state=jax.tree.map(place, state.opt_state),
         lrs=place(state.lrs),
         step=jax.device_put(state.step, NamedSharding(mesh, P())),
+        live=place(state.live) if state.live is not None else None,
         static_buffers=state.static_buffers,
         sig_name=state.sig_name,
     )

@@ -191,7 +191,11 @@ def _finalize_moments(carry, k: int):
     normalization shared by calc_moments_streaming, streaming_eval_sweep and
     geometry.kurtosis_sweep."""
     if k == 0:
-        raise ValueError(
+        from sparse_coding_tpu.resilience.errors import UndersizedInputError
+
+        # typed (still a ValueError for old callers): the same fail-loudly-
+        # on-silent-NaN contract the training guardian enforces (§16)
+        raise UndersizedInputError(
             "no full batch was consumed (dataset smaller than batch_size); "
             "moment statistics would be NaN — use a batch_size <= the row "
             "count (ADVICE r5 #4)")

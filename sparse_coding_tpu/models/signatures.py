@@ -19,7 +19,7 @@ Contract:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Protocol, Tuple
+from typing import Any, Callable, Optional, Protocol, Tuple
 
 import flax.struct as struct
 import jax
@@ -31,11 +31,31 @@ Buffers = Any
 
 
 class AuxData(struct.PyTreeNode):
-    """Reduced per-step statistics returned by every signature's loss."""
+    """Reduced per-step statistics returned by every signature's loss.
+
+    The three sentinel fields (docs/ARCHITECTURE.md §16) are filled in by
+    the ensemble step functions — device-side, folded into the aux the
+    step already returns, so detection costs no extra host sync — and
+    stay ``None`` when a signature's bare ``loss`` builds the aux or the
+    sentinel is disabled (``Ensemble(sentinel=False)``):
+
+    - ``finite``: per-member bool — this step's loss, grads, and update
+      were all finite (on the whole-step fused paths, where grads never
+      leave the kernel, the update delta stands in for the grads);
+    - ``grad_norm``: per-member global grad L2 norm (update-delta norm on
+      the whole-step fused paths — finiteness is what the guardian keys
+      on, and the scale is still a divergence trend signal);
+    - ``inputs_finite``: scalar bool — the batch itself was finite
+      (splits the data-corruption incident class from hyperparameter
+      divergence, train/guardian.py).
+    """
 
     losses: dict[str, Array]  # scalar loss components, incl. "loss"
     l0: Array  # mean number of nonzero coefficients per sample
     feat_activity: Array  # [n_feats] count of samples activating each feature
+    finite: Optional[Array] = None  # [N] bool per-member step-finite flag
+    grad_norm: Optional[Array] = None  # [N] member global grad/update norm
+    inputs_finite: Optional[Array] = None  # scalar bool: batch was finite
 
 
 def make_aux(losses: dict[str, Array], c: Array) -> AuxData:

@@ -192,6 +192,21 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "scrub_checked": counters.get("scrub.chunks_checked", 0),
         "scrub_quarantined": counters.get("scrub.chunks_quarantined", 0),
     }
+    # guardian evidence (docs/ARCHITECTURE.md §16): the sweep's divergence
+    # ladder — member quarantines, chunk quarantines, rollbacks, typed
+    # halts — plus the boundary-check and rollback walls, so one merged
+    # report tells the whole incident story next to the throughput and
+    # ingest evidence it disturbed
+    guardian = {
+        "members_quarantined":
+            counters.get("guardian.members_quarantined", 0),
+        "chunks_quarantined": counters.get("guardian.chunks_quarantined", 0),
+        "rollbacks": counters.get("guardian.rollbacks", 0),
+        "halts": counters.get("guardian.halts", 0),
+        "checks": span_stats.get("guardian.check", {}).get("count", 0),
+        "check_s": _span_wall("guardian.check"),
+        "rollback_s": _span_wall("guardian.rollback"),
+    }
     return {
         "run_dir": str(run_dir),
         "run_ids": sorted(run_ids),
@@ -210,6 +225,7 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "compile_cache": compile_cache,
         "gateway": gateway,
         "ingest": ingest,
+        "guardian": guardian,
         "dropped_events": counters.get("obs.sink.dropped", 0),
     }
 
@@ -279,6 +295,15 @@ def format_report(report: dict) -> str:
             f"{ing['degraded_streams']} stream death(s) degraded; "
             f"scrub {ing['scrub_checked']} checked / "
             f"{ing['scrub_quarantined']} quarantined")
+    gd = report.get("guardian", {})
+    if any(gd.get(k) for k in ("members_quarantined", "chunks_quarantined",
+                               "rollbacks", "halts")):
+        lines.append(
+            f"guardian: {gd['members_quarantined']} member(s) quarantined, "
+            f"{gd['chunks_quarantined']} chunk(s) quarantined, "
+            f"{gd['rollbacks']} rollback(s), {gd['halts']} halt(s) "
+            f"({gd['checks']} checks, {_fmt_s(gd['check_s'])} checking, "
+            f"{_fmt_s(gd['rollback_s'])} restoring)")
     interesting = {k: v for k, v in report["counters"].items()
                    if not k.startswith(("jax.retraces", "jax.compiles"))}
     if interesting:

@@ -1,0 +1,49 @@
+"""Parallelism helpers: mesh construction (:mod:`parallel.mesh`) and
+cross-host consensus.
+
+``agree_any`` is the single home for the any-host-flags-all-hosts-act
+agreement rule that multi-host control flow depends on: any branch that
+contains collective operations (checkpoint barriers, rollback restores)
+must be taken by EVERY host together, or the hosts that skipped it
+deadlock the ones inside it. ``train/sweep.py`` uses it for SIGTERM
+preemption (the original ``_agree_preempted``) and the training guardian
+uses it for anomaly/rollback decisions (train/guardian.py,
+docs/ARCHITECTURE.md §16) — one consensus rule, two callers, proven
+deadlock-free in tests/test_multihost.py.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def agree_any(flag: bool, tag: str = "") -> bool:
+    """Cross-host OR-consensus on a local boolean (identity single-host):
+    returns True everywhere iff ANY process passed True. ``tag`` names
+    the call site — every multi-host agreement logs it (DEBUG, or WARNING
+    when the decision fires), so an operator reading a multi-host hang or
+    an unexpected preemption/rollback can tell which agreement was in
+    flight; distinct decisions at one boundary must use distinct tags.
+
+    jax is imported lazily so jax-free tools can import the package.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return bool(flag)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray(bool(flag), dtype=np.bool_))
+    agreed = bool(np.any(flags))
+    logger.log(logging.WARNING if agreed else logging.DEBUG,
+               "agree_any[%s]: local=%s -> global=%s (process %d/%d)",
+               tag, bool(flag), agreed, jax.process_index(),
+               jax.process_count())
+    return agreed
+
+
+__all__ = ["agree_any"]

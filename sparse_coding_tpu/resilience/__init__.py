@@ -40,7 +40,9 @@ from sparse_coding_tpu.resilience.crash import (
 from sparse_coding_tpu.resilience.errors import (
     CheckpointCorruptionError,
     ChunkCorruptionError,
+    DivergenceHaltError,
     ResilienceError,
+    UndersizedInputError,
     UnknownFaultSiteError,
 )
 from sparse_coding_tpu.resilience.faults import (
@@ -76,6 +78,7 @@ __all__ = [
     "ChunkCorruptionError",
     "CrashPlan",
     "CrashSpec",
+    "DivergenceHaltError",
     "FAULT_SITES",
     "FaultPlan",
     "FaultSpec",
@@ -85,6 +88,7 @@ __all__ = [
     "PreemptionGuard",
     "ResilienceError",
     "SweepPreempted",
+    "UndersizedInputError",
     "UnknownFaultSiteError",
     "classify_hang",
     "crash_barrier",

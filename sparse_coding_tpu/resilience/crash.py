@@ -79,6 +79,9 @@ CRASH_SITES: dict[str, str] = {
                       "seal not yet written (data/shard_store.py)",
     "scrub.repair": "scrub: quarantine ledger entry durable, the corrupt "
                     "chunk file not yet moved aside (data/scrub.py)",
+    "guardian.rollback": "guardian incident ledger + chunk quarantine "
+                         "durable, the last-good checkpoint restore not "
+                         "yet performed (train/guardian.py)",
 }
 
 

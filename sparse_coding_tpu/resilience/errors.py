@@ -55,3 +55,37 @@ class CheckpointCorruptionError(ResilienceError):
         super().__init__(f"checkpoint corrupt at {path}: {reason}")
         self.path = Path(path)
         self.reason = reason
+
+
+class UndersizedInputError(ResilienceError, ValueError):
+    """A streaming statistic consumed ZERO complete batches (input smaller
+    than ``batch_size``) — the result would be silent NaN, which is exactly
+    the failure class the training guardian exists to keep out of sweeps
+    (docs/ARCHITECTURE.md §16; ADVICE r5 #4). Subclasses ValueError so
+    pre-existing ``except ValueError`` callers keep working."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+
+
+class DivergenceHaltError(ResilienceError):
+    """The training guardian exhausted its rollback ladder: a rollback
+    was demanded again at a site that already rolled back (or past the
+    run's rollback budget), so the incident is structural, not transient
+    (train/guardian.py, docs/ARCHITECTURE.md §16). ``diagnosis`` is the
+    operator's triage fork:
+
+    - ``"poisoned-data"`` — non-finite activations keep reaching the step
+      (the chunk quarantine did not stick, or the rot is store-wide);
+      re-harvest / scrub the store before re-running.
+    - ``"hyperparameter"`` — members keep diverging on inputs the sentinel
+      proved finite; shrink the lr/l1 corners of the grid.
+    """
+
+    def __init__(self, site: str, diagnosis: str, detail: str = ""):
+        super().__init__(
+            f"sweep halted by the guardian at {site}: {diagnosis}"
+            + (f" ({detail})" if detail else ""))
+        self.site = site
+        self.diagnosis = diagnosis
+        self.detail = detail

@@ -28,6 +28,10 @@ pre-registered here so env plans validate before any host module loads):
 ``xcache.load``       xcache/store.py ExecutableStore.load — every
                       executable-cache entry read (corrupt/stale entries
                       fall back to a fresh compile)
+``sweep.anomaly``     train/guardian.py — every host batch in the sweep
+                      hot loop (mode=nan: non-finite-input incident;
+                      mode=error + message member=<i>: per-member
+                      divergence drill)
 ====================  =====================================================
 
 Plan syntax (``SPARSE_CODING_FAULT_PLAN`` or :func:`parse_fault_plan`):
@@ -39,9 +43,12 @@ Plan syntax (``SPARSE_CODING_FAULT_PLAN`` or :func:`parse_fault_plan`):
 Spec keys: ``nth`` (1-based hit that first fires, default 1), ``count``
 (how many consecutive hits fire, default 1; 0 = every hit from nth on),
 ``mode`` (``error`` raises a typed injected exception; ``corrupt``
-bit-flips the payload an array/bytes site passes through), ``error``
-(exception class name for mode=error), ``message``, ``seed`` (byte offset
-selector for mode=corrupt).
+bit-flips the payload an array/bytes site passes through; ``nan`` writes
+one NaN into a float-array payload — the divergence/garbage-data drill
+for finite guards, a failure class a single bit flip cannot reproduce
+deterministically), ``error`` (exception class name for mode=error),
+``message``, ``seed`` (byte/element offset selector for
+mode=corrupt/nan).
 
 Injected exceptions subclass BOTH the requested builtin (so real handlers
 — retry loops, breakers — treat them exactly like the genuine failure)
@@ -70,6 +77,16 @@ FAULT_SITES: dict[str, str] = {
     "lock.acquire": "tunnel flock acquisition attempt",
     "obs.sink.write": "observability event-sink line append (obs/sink.py)",
     "xcache.load": "executable-cache entry load (xcache/store.py)",
+    # seeded here (not only registered at train/guardian.py import): a
+    # child process parses SPARSE_CODING_FAULT_PLAN lazily at its FIRST
+    # fault_point hit — often obs.sink.write at startup, before the sweep
+    # (and therefore guardian) modules ever import
+    "sweep.anomaly": "training-batch anomaly injection — every host batch "
+                     "passes through this site in the sweep hot loop "
+                     "(train/guardian.py); mode=nan poisons the batch "
+                     "(non-finite-input incident), mode=error with "
+                     "message member=<i> poisons that member's loss-scale "
+                     "buffer (per-member divergence drill)",
 }
 
 
@@ -122,7 +139,7 @@ class FaultSpec:
             # typed + eager: a typo'd site in SPARSE_CODING_FAULT_PLAN must
             # fail the plan parse loudly, never silently disable the fault
             raise UnknownFaultSiteError(self.site, FAULT_SITES, kind="fault")
-        if self.mode not in ("error", "corrupt"):
+        if self.mode not in ("error", "corrupt", "nan"):
             raise ValueError(f"unknown fault mode {self.mode!r}")
         if self.mode == "error" and self.error not in _ERROR_BASES:
             raise ValueError(
@@ -250,11 +267,40 @@ def _corrupt_payload(payload, spec: FaultSpec):
     return arr
 
 
+def _nan_payload(payload, spec: FaultSpec):
+    """Deterministically overwrite one float element with NaN (the
+    ``seed`` selects the element). The divergence-drill twin of
+    ``_corrupt_payload``: a bit flip produces a wrong-but-usually-finite
+    value, while finite guards need a guaranteed non-finite input."""
+    import numpy as np
+
+    if payload is None:
+        raise ValueError(
+            f"fault site {spec.site!r} carries no payload; mode=nan is "
+            "only valid at float-array sites (use mode=error)")
+    arr = np.array(payload, copy=True)
+    # floatness by capability, not np.floating lineage: ml_dtypes types
+    # (bfloat16 — the train_dtype='bfloat16' ingest payload) hold NaN but
+    # are not np.floating subdtypes; int dtypes raise on the cast
+    try:
+        holds_nan = bool(np.isnan(np.asarray(np.nan).astype(arr.dtype)))
+    except (TypeError, ValueError):
+        holds_nan = False
+    if not holds_nan:
+        raise ValueError(
+            f"fault site {spec.site!r} payload dtype {arr.dtype} cannot "
+            "hold NaN; mode=nan needs a float-array payload")
+    arr.reshape(-1)[spec.seed % arr.size] = arr.dtype.type(np.nan)
+    return arr
+
+
 def fault_point(site: str, payload=None):
     """The single injection hook every hardened path calls. Returns the
-    payload (possibly corrupted by an active corrupt-mode fault); raises
-    the injected exception for error-mode faults. Near-zero cost when no
-    plan is active."""
+    payload (possibly mutated by an active corrupt-/nan-mode fault);
+    raises the injected exception for error-mode faults. Near-zero cost
+    when no plan is active — and a fired mutation always returns a COPY,
+    so callers can tell an injected payload from the original by
+    identity."""
     plan = active_plan()
     if plan is None:
         return payload
@@ -263,6 +309,8 @@ def fault_point(site: str, payload=None):
         return payload
     if spec.mode == "error":
         raise spec.build_error()
+    if spec.mode == "nan":
+        return _nan_payload(payload, spec)
     return _corrupt_payload(payload, spec)
 
 

@@ -29,6 +29,12 @@ from sparse_coding_tpu.models.topk import TopKEncoder
 DEFAULT_L1_RANGE = list(np.logspace(-4, -2, 16))  # big_sweep_experiments.py:295
 
 
+def _sentinel(cfg: EnsembleArgs) -> bool:
+    """cfg.sentinel with a default for ad-hoc config objects (the in-graph
+    anomaly sentinel is on unless explicitly disabled — config.py)."""
+    return bool(getattr(cfg, "sentinel", True))
+
+
 def _activation_dim(cfg: EnsembleArgs) -> int:
     from sparse_coding_tpu.data.shard_store import open_store
 
@@ -47,7 +53,8 @@ def dense_l1_range_experiment(cfg: EnsembleArgs, mesh=None,
     keys = jax.random.split(jax.random.PRNGKey(cfg.seed), len(l1s))
     members = [sig.init(k, d, n_dict, l1_alpha=float(l1))
                for k, l1 in zip(keys, l1s)]
-    ens = Ensemble(members, sig, lr=cfg.lr, adam_eps=cfg.adam_epsilon, mesh=mesh)
+    ens = Ensemble(members, sig, lr=cfg.lr, adam_eps=cfg.adam_epsilon,
+                   mesh=mesh, sentinel=_sentinel(cfg))
     hypers = [{"l1_alpha": float(l1), "dict_size": n_dict, "tied": cfg.tied_ae}
               for l1 in l1s]
     return [(ens, hypers, "dense_l1_range")]
@@ -67,7 +74,8 @@ def tied_vs_not_experiment(cfg: EnsembleArgs, mesh=None,
         keys = jax.random.split(jax.random.PRNGKey(cfg.seed + tied), len(l1s))
         members = [sig.init(k, d, n_dict, l1_alpha=float(l1))
                    for k, l1 in zip(keys, l1s)]
-        ens = Ensemble(members, sig, lr=cfg.lr, adam_eps=cfg.adam_epsilon, mesh=mesh)
+        ens = Ensemble(members, sig, lr=cfg.lr, adam_eps=cfg.adam_epsilon,
+                   mesh=mesh, sentinel=_sentinel(cfg))
         hypers = [{"l1_alpha": float(l1), "dict_size": n_dict, "tied": tied}
                   for l1 in l1s]
         out.append((ens, hypers, name))
@@ -85,7 +93,8 @@ def topk_experiment(cfg: EnsembleArgs, mesh=None,
     keys = jax.random.split(jax.random.PRNGKey(cfg.seed), len(ks))
     members = [TopKEncoder.init(k_rng, d, n_dict, k=int(k))
                for k_rng, k in zip(keys, ks)]
-    group = EnsembleGroup.build(TopKEncoder, members, lr=cfg.lr, mesh=mesh)
+    group = EnsembleGroup.build(TopKEncoder, members, lr=cfg.lr, mesh=mesh,
+                                sentinel=_sentinel(cfg))
     # hypers must follow bucket-flattening order (group.to_learned_dicts
     # iterates buckets in insertion order), not sorted(ks)
     hypers = [{"k": dict(ens.state.static_buffers)["k"], "dict_size": n_dict}
@@ -109,7 +118,8 @@ def dict_ratio_experiment(cfg: EnsembleArgs, mesh=None,
     members = [FunctionalMaskedTiedSAE.init(k, d, n, n_stack, l1_alpha=l1_alpha)
                for k, n in zip(keys, sizes)]
     ens = Ensemble(members, FunctionalMaskedTiedSAE, lr=cfg.lr,
-                   adam_eps=cfg.adam_epsilon, mesh=mesh)
+                   adam_eps=cfg.adam_epsilon, mesh=mesh,
+                   sentinel=_sentinel(cfg))
     hypers = [{"l1_alpha": l1_alpha, "dict_size": n, "dict_ratio": r}
               for n, r in zip(sizes, ratios)]
     return [(ens, hypers, "dict_ratio")]
@@ -149,7 +159,8 @@ def residual_denoising_experiment(cfg: EnsembleArgs, mesh=None,
         k, d, n_dict, l1_alpha=float(l1), n_hidden_layers=n_hidden_layers)
         for k, l1 in zip(keys, l1s)]
     group = EnsembleGroup.build(FunctionalLISTADenoisingSAE, members,
-                                lr=cfg.lr, mesh=mesh)
+                                lr=cfg.lr, mesh=mesh,
+                                sentinel=_sentinel(cfg))
     hypers = [{"l1_alpha": float(l1), "dict_size": n_dict,
                "n_hidden_layers": n_hidden_layers} for l1 in l1s]
     return [(group, hypers, "residual_denoising")]
@@ -203,7 +214,8 @@ def centered_l1_range_experiment(cfg: EnsembleArgs, mesh=None,
                                       scaling=scale)
                for k, l1 in zip(keys, l1s)]
     ens = Ensemble(members, FunctionalTiedSAE, lr=cfg.lr,
-                   adam_eps=cfg.adam_epsilon, mesh=mesh)
+                   adam_eps=cfg.adam_epsilon, mesh=mesh,
+                   sentinel=_sentinel(cfg))
     hypers = [{"l1_alpha": float(l1), "dict_size": n_dict, "tied": True,
                "centered": True, "whitened": whiten} for l1 in l1s]
     return [(ens, hypers, "centered_l1_range")]
@@ -217,7 +229,8 @@ def _simple_grid_experiment(sig, name, cfg: EnsembleArgs, mesh, l1s, d,
     members = [sig.init(k, d, n_dict, float(l1), **(init_kwargs or {}))
                for k, l1 in zip(keys, l1s)]
     group = EnsembleGroup.build(sig, members, lr=cfg.lr, mesh=mesh,
-                                adam_eps=cfg.adam_epsilon)
+                                adam_eps=cfg.adam_epsilon,
+                                sentinel=_sentinel(cfg))
     hypers = [{hyper_key: float(l1), "dict_size": n_dict} for l1 in l1s]
     return [(group, hypers, name)]
 

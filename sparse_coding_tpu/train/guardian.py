@@ -1,0 +1,507 @@
+"""Training health guardian: divergence quarantine + last-good rollback.
+
+The in-graph anomaly sentinel (ensemble.py, docs/ARCHITECTURE.md §16)
+detects and CONTAINS numerical failure device-side: a member whose step
+went non-finite keeps its params bit-identically unchanged, and the
+per-member finite flags / grad norms ride the aux the step already
+returns. This module is the host half of the ladder — it decides what a
+detection MEANS and makes the outcome durable:
+
+1. **Per-member quarantine.** A member whose steps go non-finite while
+   the batch itself was finite has diverged (hyperparameter corner, the
+   paper's deliberately aggressive l1/lr grids): its live-mask bit is
+   cleared (``Ensemble.freeze_members``), the incident is recorded in a
+   durable ``guardian.json`` ledger next to the sweep's checkpoints
+   (atomic rewrite, mirroring data/ledger.py), and its artifact is
+   tagged ``diverged=True`` so evals/serving can skip it.
+2. **Escalation + auto-rollback.** Non-finite *inputs* (data corruption —
+   a distinct incident class, flagged by the sentinel's batch-finite
+   scalar) or a quarantined-member fraction crossing the threshold
+   trigger a rollback: incident + chunk quarantine become durable FIRST
+   (the PR-8 ledger makes the offending chunk a positional hole), the
+   ``guardian.rollback`` crash barrier sits between that durability and
+   the restore, and then the sweep restores the retained last-good
+   checkpoint set (``resume_sweep_state``) and replays — bitwise the run
+   that never saw the poisoned chunk.
+3. **Typed halt.** A rollback demanded again at a site that already
+   rolled back — or past the run's rollback budget — is structural:
+   :class:`~sparse_coding_tpu.resilience.errors.DivergenceHaltError`
+   carries the diagnosis (``poisoned-data`` vs ``hyperparameter``,
+   triage recipe in docs/RUNBOOK_TUNNEL.md).
+
+Multi-host: every rollback/halt decision passes through
+:func:`sparse_coding_tpu.parallel.agree_any` — the branch contains
+collective barriers, so any host's anomaly must move all hosts together
+(the ``_agree_preempted`` rule, generalized).
+
+Determinism: detection is in-graph; accumulation across a chunk is one
+tiny device-side combine per training window (no host sync until the
+chunk boundary); the drill fault site ``sweep.anomaly`` injects NaN into
+a chosen batch (mode=nan) or a chosen member's loss-scale buffer
+(mode=error, message ``member=<i>``) so every ladder rung replays
+identically in CI (tests/test_resilience.py, tests/test_pipeline_chaos.py).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from sparse_coding_tpu import obs
+from sparse_coding_tpu.parallel import agree_any
+from sparse_coding_tpu.resilience.atomic import atomic_write_text
+from sparse_coding_tpu.resilience.crash import crash_barrier, register_crash_site
+from sparse_coding_tpu.resilience.errors import (
+    ChunkCorruptionError,
+    DivergenceHaltError,
+)
+from sparse_coding_tpu.resilience.faults import (
+    InjectedFault,
+    fault_point,
+    register_fault_site,
+)
+
+LEDGER_NAME = "guardian.json"
+
+register_fault_site("sweep.anomaly",
+                    "training-batch anomaly injection — every host batch "
+                    "passes through this site in the sweep hot loop "
+                    "(train/guardian.py inject_anomaly); mode=nan poisons "
+                    "the batch (non-finite-input incident), mode=error "
+                    "with message member=<i> poisons that member's "
+                    "loss-scale buffer (per-member divergence drill)")
+register_crash_site("guardian.rollback",
+                    "guardian incident ledger + chunk quarantine durable, "
+                    "the last-good checkpoint restore not yet performed "
+                    "(train/guardian.py rollback_restore)")
+
+_MEMBER_RE = re.compile(r"member=(\d+)")
+
+
+class GuardianRollback(Exception):
+    """Internal control-flow signal: the guardian decided to roll back.
+    ``train/sweep.py`` catches it at the chunk loop, restores the
+    last-good checkpoint set through :meth:`Guardian.rollback_restore`,
+    and replays. Never escapes ``sweep()``."""
+
+    def __init__(self, site: str, incident: str, chunk_pos: int,
+                 chunk_index: int):
+        super().__init__(
+            f"guardian rollback at {site}: {incident} "
+            f"(chunk {chunk_index} quarantined)")
+        self.site = site
+        self.incident = incident
+        self.chunk_pos = int(chunk_pos)
+        self.chunk_index = int(chunk_index)
+
+
+def _subensembles(e) -> list:
+    """Buckets of an EnsembleGroup in insertion order, or [e] for a plain
+    Ensemble (duck-typed twin of train/sweep.py::_ensembles_of, local so
+    guardian never imports the sweep module)."""
+    sub = getattr(e, "ensembles", None)
+    return list(sub.values()) if isinstance(sub, dict) else [e]
+
+
+def _bucket_items(e) -> list:
+    """[(bucket_name, Ensemble)] — for a plain Ensemble the bucket name
+    is empty (raw_items in the sweep use the ENTRY name there)."""
+    sub = getattr(e, "ensembles", None)
+    if isinstance(sub, dict):
+        return list(sub.items())
+    return [("", e)]
+
+
+def _reduce_leading(x, op):
+    """Reduce any leading (scan-window) axes down to the trailing member
+    axis — aux under ``run_steps`` arrives stacked [K, N]."""
+    import jax.numpy as jnp
+
+    ops = {"all": jnp.all, "max": jnp.max}
+    while x.ndim > 1:
+        x = ops[op](x, axis=0)
+    return x
+
+
+def _combine_acc(acc, finite, grad_norm, inputs_finite):
+    """One training window folded into the per-bucket device accumulator
+    (finite_all [N], inputs_all scalar, grad_norm_max [N]) — an async
+    [N]-sized device op per window, never a host sync; the boundary check
+    pulls the accumulator once per chunk. Dispatched jitted (one program
+    per aux shape): per-op eager dispatch through the axon tunnel costs
+    ~ms each, which would tax the hot loop this sentinel must not."""
+    import jax.numpy as jnp
+
+    f = _reduce_leading(finite, "all")
+    g = _reduce_leading(grad_norm, "max")
+    i = (jnp.all(inputs_finite) if inputs_finite is not None
+         else jnp.asarray(True))
+    if acc is None:
+        return f, i, g
+    return acc[0] & f, acc[1] & i, jnp.maximum(acc[2], g)
+
+
+_COMBINE_JIT = None
+
+
+def _combine(acc, finite, grad_norm, inputs_finite):
+    global _COMBINE_JIT
+    if _COMBINE_JIT is None:
+        import jax
+
+        _COMBINE_JIT = jax.jit(_combine_acc)
+    return _COMBINE_JIT(acc, finite, grad_norm, inputs_finite)
+
+
+class Guardian:
+    """Host-side divergence bookkeeping for one sweep run.
+
+    ``ensembles`` is the sweep's ``[(Ensemble|EnsembleGroup, hypers,
+    name)]`` list; ``member_names`` the per-entry stream names (for the
+    ledger's human-readable ``member`` field). State lives in
+    ``<out_dir>/guardian.json`` — written atomically with sorted keys and
+    no wall-clock fields, so an interrupted-and-resumed incident leaves a
+    ledger byte-identical to an uninterrupted one (the chaos-matrix
+    contract).
+    """
+
+    def __init__(self, out_dir: str | Path, ensembles: Sequence,
+                 member_names: Sequence[Sequence[str]],
+                 member_fraction: float = 0.5,
+                 rollback_budget: int = 4,
+                 fresh: bool = False):
+        self.path = Path(out_dir) / LEDGER_NAME
+        self.ensembles = list(ensembles)
+        self.member_names = [list(n) for n in member_names]
+        self.member_fraction = float(member_fraction)
+        self.rollback_budget = int(rollback_budget)
+        self._acc: dict = {}  # (ens_idx, sub_name) -> device accumulator
+        if fresh:
+            # a NON-resume run into a reused out_dir starts over (like its
+            # checkpoints): inheriting a previous run's quarantines and
+            # spent rollback budget would tag healthy members diverged and
+            # could halt the new run on its first incident. Resumes
+            # (fresh=False) keep the ledger — that persistence is the
+            # whole point.
+            self._drop_stale_ledger()
+            self._state = {"version": 1, "members": {}, "rollbacks": {}}
+        else:
+            self._state = self._load()
+
+    # -- ledger ---------------------------------------------------------------
+
+    def _drop_stale_ledger(self) -> None:
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        try:
+            self.path.unlink()
+        except FileNotFoundError:
+            pass
+
+    def _load(self) -> dict:
+        try:
+            raw = json.loads(self.path.read_text())
+            if isinstance(raw, dict) and raw.get("version") == 1:
+                raw.setdefault("members", {})
+                raw.setdefault("rollbacks", {})
+                return raw
+        except (OSError, ValueError):
+            pass
+        return {"version": 1, "members": {}, "rollbacks": {}}
+
+    def _write(self) -> None:
+        # atomic + deterministic bytes (sorted keys, no timestamps):
+        # rewriting the same incident twice — a resumed rollback — is
+        # byte-idempotent, which the chaos matrix compares on. Multi-host:
+        # decisions are replicated (replicated flags in, replicated ledger
+        # state), so process 0 alone owns the file, like checkpoint swaps.
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        atomic_write_text(self.path,
+                          json.dumps(self._state, indent=2, sort_keys=True))
+
+    @property
+    def quarantined_members(self) -> dict[str, dict]:
+        return dict(self._state["members"])
+
+    def total_rollbacks(self) -> int:
+        return sum(rb["count"] for rb in self._state["rollbacks"].values())
+
+    # -- injection drill ------------------------------------------------------
+
+    def inject_anomaly(self, batch: np.ndarray) -> np.ndarray:
+        """Fault site ``sweep.anomaly``: every host batch passes through.
+        mode=nan returns a NaN-poisoned copy of the batch (the
+        data-corruption drill); mode=error whose message names
+        ``member=<i>`` poisons that member's loss-scale buffer instead
+        (the hyperparameter-divergence drill: the member's loss and grads
+        go NaN while its params stay finite). Any other error-mode
+        injection propagates — this site hosts drills, not I/O faults."""
+        try:
+            return fault_point("sweep.anomaly", batch)
+        except InjectedFault as e:
+            m = _MEMBER_RE.search(str(e))
+            if m is None:
+                raise
+            self._poison_member(int(m.group(1)))
+            return batch
+
+    def _poison_member(self, index: int) -> None:
+        """Drill target: member ``index`` of the FIRST bucket of the
+        FIRST sweep entry (the drill grammar names one index; multi-entry
+        grids drill their first entry by design — documented in §16). An
+        out-of-range index is a plan bug and fails loudly: jax's
+        ``.at[oob].set`` would silently drop the write and the drill
+        would report success while poisoning nothing."""
+        import jax.numpy as jnp
+
+        ens = _subensembles(self.ensembles[0][0])[0]
+        if not 0 <= int(index) < ens.n_members:
+            raise ValueError(
+                f"sweep.anomaly drill names member={index} but the first "
+                f"bucket has {ens.n_members} member(s)")
+        buffers = dict(ens.state.buffers) if ens.state.buffers else {}
+        if "l1_alpha" in buffers:
+            arr = buffers["l1_alpha"]
+            buffers["l1_alpha"] = arr.at[index].set(jnp.nan)
+            ens.state = ens.state.replace(buffers=buffers)
+        else:
+            # signatures without a loss-scale buffer: a NaN lr makes the
+            # member's UPDATE non-finite, which the sentinel catches the
+            # same way (params still frozen at their last finite values)
+            ens.state = ens.state.replace(
+                lrs=ens.state.lrs.at[index].set(jnp.nan))
+
+    # -- per-window observation (device-side, async) --------------------------
+
+    def observe(self, ens_idx: int, sub_name: str, aux) -> None:
+        """Fold one training window's aux into the (ens, bucket)
+        accumulator. No-op when the sentinel is off (aux carries no
+        finite field). Dispatches a tiny device combine; never syncs."""
+        if getattr(aux, "finite", None) is None:
+            return
+        key = (int(ens_idx), str(sub_name))
+        self._acc[key] = _combine(self._acc.get(key), aux.finite,
+                                  aux.grad_norm, aux.inputs_finite)
+
+    # -- the chunk-boundary decision ladder -----------------------------------
+
+    def check_boundary(self, chunk_pos: int, chunk_index: int,
+                       store=None) -> None:
+        """One host sync per chunk: pull the window accumulators, then run
+        the ladder — input incident (rollback), new member quarantines
+        (freeze + ledger), fraction escalation (rollback). Raises
+        :class:`GuardianRollback` or (ladder exhausted)
+        :class:`DivergenceHaltError`. The consensus calls run in a fixed
+        order on every host so the collective branches stay aligned."""
+        if not self._acc:
+            # nothing trained this chunk (quarantined hole) — but a prior
+            # fraction breach must still escalate at this site, or a
+            # rolled-back run would sail past the very state it rolled
+            # back for (the halt that ends the hyperparameter ladder).
+            # agree_any runs UNCONDITIONALLY: every host must make the
+            # same sequence of consensus calls (the ledger is replicated,
+            # but the call pattern must not depend on it)
+            if agree_any(self._dead_fraction() >= self.member_fraction,
+                         "guardian-fraction"):
+                self._escalate(chunk_pos, chunk_index, "hyperparameter",
+                               store)
+            return
+        t0 = obs.monotime()
+        import jax
+
+        pulled = {k: jax.device_get(v) for k, v in self._acc.items()}
+        self._acc.clear()
+
+        inputs_bad = agree_any(
+            any(not bool(np.all(inputs)) for _, inputs, _ in pulled.values()),
+            "guardian-input")
+        if inputs_bad:
+            self._escalate(chunk_pos, chunk_index, "poisoned-data", store)
+
+        # member incidents on sound inputs: freeze + durable ledger
+        newly: list[tuple[int, str, int, Optional[float]]] = []
+        for (ens_idx, sub), (finite, _inputs, gn) in sorted(pulled.items()):
+            finite = np.asarray(finite).reshape(-1)
+            gn = np.asarray(gn).reshape(-1)
+            for i in np.flatnonzero(~finite):
+                key = self._member_key(ens_idx, sub, int(i))
+                if key in self._state["members"]:
+                    continue  # already quarantined (stays non-finite)
+                norm = float(gn[i]) if np.isfinite(gn[i]) else None
+                newly.append((ens_idx, sub, int(i), norm))
+        if newly:
+            self._quarantine_members(newly, chunk_pos, chunk_index)
+
+        if agree_any(self._dead_fraction() >= self.member_fraction,
+                     "guardian-fraction"):
+            self._escalate(chunk_pos, chunk_index, "hyperparameter", store)
+        obs.record_span("guardian.check", obs.monotime() - t0,
+                        chunk=chunk_index, pos=chunk_pos,
+                        quarantined=len(newly))
+
+    def _member_key(self, ens_idx: int, sub: str, i: int) -> str:
+        name = self.ensembles[ens_idx][2]
+        return f"{name}/{sub or name}/{i}"
+
+    def dead_indices(self, ens_idx: int, sub_name: str) -> list[int]:
+        """Quarantined member indices of one (entry, bucket) — the
+        sweep's logging path masks these out of its loss-mean/max streams
+        instead of letting their NaN losses poison the aggregates."""
+        entry_name = self.ensembles[ens_idx][2]
+        bucket = sub_name or entry_name
+        return sorted(info["index"]
+                      for info in self._state["members"].values()
+                      if info["entry"] == entry_name
+                      and info["bucket"] == bucket)
+
+    def _quarantine_members(self, newly, chunk_pos: int,
+                            chunk_index: int) -> None:
+        frozen = []
+        for ens_idx, sub, i, norm in newly:
+            entry_name = self.ensembles[ens_idx][2]
+            names = self.member_names[ens_idx] if ens_idx < len(
+                self.member_names) else []
+            self._state["members"][self._member_key(ens_idx, sub, i)] = {
+                "entry": entry_name, "bucket": sub or entry_name,
+                "index": i,
+                "member": names[i] if i < len(names) else f"member{i}",
+                "reason": "non-finite loss/grads on finite inputs",
+                "grad_norm": norm,
+                "chunk_pos": chunk_pos, "chunk": chunk_index,
+            }
+            frozen.append(self._member_key(ens_idx, sub, i))
+        # freeze BEFORE the durable write: even a ledger-write failure
+        # (read-only dir, full disk) leaves this process protected
+        by_bucket: dict[tuple[int, str], list[int]] = {}
+        for ens_idx, sub, i, _ in newly:
+            by_bucket.setdefault((ens_idx, sub), []).append(i)
+        for (ens_idx, sub), idxs in by_bucket.items():
+            entry, _, entry_name = self.ensembles[ens_idx]
+            for bucket_name, ens in _bucket_items(entry):
+                if (bucket_name or entry_name) == (sub or entry_name):
+                    ens.freeze_members(idxs)
+        self._write()
+        obs.counter("guardian.members_quarantined").inc(len(newly))
+        obs.emit_event("guardian.incident", incident="member-divergence",
+                       members=frozen, chunk=chunk_index, pos=chunk_pos)
+
+    def _dead_fraction(self) -> float:
+        total = sum(ens.n_members for e, _, _ in self.ensembles
+                    for ens in _subensembles(e))
+        return len(self._state["members"]) / max(1, total)
+
+    def _escalate(self, chunk_pos: int, chunk_index: int, incident: str,
+                  store) -> None:
+        """Record the rollback durably (or halt typed if this site already
+        rolled back / the budget is spent), quarantine the chunk through
+        the PR-8 ledger, and raise the rollback signal."""
+        site = f"chunk[{chunk_pos}]"
+        rb = self._state["rollbacks"].get(site)
+        exhausted = (rb is not None and rb["count"] >= 1) or \
+            self.total_rollbacks() >= self.rollback_budget
+        if exhausted:
+            self._state["halt"] = {"site": site, "diagnosis": incident,
+                                   "chunk": chunk_index}
+            self._write()
+            obs.counter("guardian.halts").inc()
+            obs.emit_event("guardian.halt", site=site, diagnosis=incident,
+                           chunk=chunk_index)
+            raise DivergenceHaltError(
+                site, incident,
+                detail=f"chunk {chunk_index}; "
+                       f"{len(self._state['members'])} member(s) "
+                       f"quarantined, {self.total_rollbacks()} rollback(s)")
+        self._state["rollbacks"][site] = {
+            "count": (rb["count"] + 1 if rb else 1),
+            "incident": incident, "chunk": chunk_index}
+        self._write()
+        self._quarantine_chunk(store, chunk_index)
+        obs.counter("guardian.rollbacks").inc()
+        obs.emit_event("guardian.incident", incident=incident,
+                       chunk=chunk_index, pos=chunk_pos, rollback=True)
+        raise GuardianRollback(site, incident, chunk_pos, chunk_index)
+
+    def _quarantine_chunk(self, store, chunk_index: int) -> None:
+        if store is None or not hasattr(store, "_quarantine"):
+            return
+        try:
+            path = store._path(chunk_index)
+        except ChunkCorruptionError:
+            return  # already a hole
+        store._quarantine(ChunkCorruptionError(
+            chunk_index, path,
+            "guardian: non-finite activations reached the training step"))
+        obs.counter("guardian.chunks_quarantined").inc()
+
+    # -- rollback + resume plumbing -------------------------------------------
+
+    def rollback_restore(self, restore_fn: Callable[[], tuple]) -> tuple:
+        """The restore half of a rollback: the crash barrier sits exactly
+        between the durable ledger writes (_escalate, already done) and
+        the checkpoint restore — the chaos matrix kills here and proves a
+        restarted run resumes bitwise. ``restore_fn`` is the sweep's
+        closure over ``resume_sweep_state`` (or re-init for a pre-first-
+        checkpoint incident); returns its (chunks_done, rng_state)."""
+        crash_barrier("guardian.rollback")
+        t0 = obs.monotime()
+        done, rng_state = restore_fn()
+        self.refreeze()
+        obs.record_span("guardian.rollback", obs.monotime() - t0,
+                        chunks_done=int(done))
+        return done, rng_state
+
+    def refreeze(self) -> None:
+        """Re-apply every ledgered member quarantine to the live ensembles
+        — a restored (or re-initialized) checkpoint predates the freeze,
+        and a quarantined member must stay dead across rollbacks and
+        resumes."""
+        for info in self._state["members"].values():
+            for e, _, name in self.ensembles:
+                if name != info["entry"]:
+                    continue
+                for bucket_name, ens in _bucket_items(e):
+                    if (bucket_name or name) == info["bucket"]:
+                        ens.freeze_members([info["index"]])
+
+    # -- artifact hygiene -----------------------------------------------------
+
+    def diverged_flat(self, entry_name: str) -> dict[int, dict]:
+        """Flat member index → ledger info for one entry, in the same
+        bucket-insertion-order flattening ``_flat_dicts`` uses — the map
+        artifact tagging keys on."""
+        out: dict[int, dict] = {}
+        for e, _, name in self.ensembles:
+            if name != entry_name:
+                continue
+            offset = 0
+            for bucket_name, ens in _bucket_items(e):
+                bucket = bucket_name or name
+                for info in self._state["members"].values():
+                    if info["entry"] == name and info["bucket"] == bucket:
+                        out[offset + info["index"]] = info
+                offset += ens.n_members
+        return out
+
+    def tag_hypers(self, entry_name: str,
+                   tagged: Sequence[tuple]) -> list[tuple]:
+        """[(dict, hyper)] → same list with quarantined members' hypers
+        carrying ``diverged=True`` (+ the ledger reason), so every
+        artifact save and the sweep's return value agree on which members
+        are poisoned."""
+        diverged = self.diverged_flat(entry_name)
+        out = []
+        for i, (ld, hyper) in enumerate(tagged):
+            if i in diverged:
+                hyper = {**hyper, "diverged": True,
+                         "diverged_reason": diverged[i]["reason"]}
+            out.append((ld, hyper))
+        return out

@@ -46,12 +46,14 @@ from sparse_coding_tpu.metrics.core import (
     mean_nonzero_activations,
     mmcs_from_list,
 )
+from sparse_coding_tpu.parallel import agree_any
 from sparse_coding_tpu.parallel.mesh import batch_sharding, make_mesh
 from sparse_coding_tpu.resilience import lease
 from sparse_coding_tpu.resilience.atomic import atomic_save_npy, atomic_write_text
 from sparse_coding_tpu.resilience.crash import crash_barrier, register_crash_site
 from sparse_coding_tpu.resilience.errors import CheckpointCorruptionError
 from sparse_coding_tpu.resilience.preempt import PreemptionGuard, SweepPreempted
+from sparse_coding_tpu.train.guardian import Guardian, GuardianRollback
 from sparse_coding_tpu.utils.artifacts import save_learned_dicts
 from sparse_coding_tpu.utils.checkpoint import restore_ensemble, save_ensemble
 from sparse_coding_tpu.utils.orbax_ckpt import checkpoint_path
@@ -135,14 +137,10 @@ def _agree_preempted(local_flag: bool) -> bool:
     """Cross-host consensus on the preemption flag (identity single-host).
     SIGTERM may reach only ONE process of a multi-host sweep; the
     checkpoint branch below contains collective barriers, so every host
-    must take it (or not) together — any host preempted preempts all."""
-    if jax.process_count() == 1:
-        return local_flag
-    from jax.experimental import multihost_utils
-
-    flags = multihost_utils.process_allgather(
-        np.asarray(local_flag, dtype=np.bool_))
-    return bool(np.any(flags))
+    must take it (or not) together — any host preempted preempts all.
+    The rule itself now lives in ``parallel.agree_any`` (shared with the
+    guardian's anomaly/rollback decisions, train/guardian.py)."""
+    return agree_any(local_flag, "sweep-preempt")
 
 
 def _sync_hosts(tag: str) -> None:
@@ -223,16 +221,40 @@ def sweep(
     logger = MetricsLogger(out_dir, use_wandb=cfg.use_wandb,
                            run_name=out_dir.name, config=cfg.to_dict())
 
+    # the training health guardian (train/guardian.py, §16): host half of
+    # the divergence ladder — member quarantine ledger, rollback
+    # escalation, typed halt. The in-graph sentinel in the step programs
+    # feeds it through the aux; cfg.guardian=False runs bare (the aux
+    # fields also vanish with cfg.sentinel=False, the bench A/B knob).
+    guardian: Optional[Guardian] = None
+    if getattr(cfg, "guardian", True):
+        guardian = Guardian(
+            out_dir, ensembles, member_names,
+            member_fraction=getattr(cfg, "guardian_member_fraction", 0.5),
+            rollback_budget=getattr(cfg, "guardian_rollback_budget", 4),
+            fresh=not resume)
+        # the rollback contract needs the positional-hole reader: a chunk
+        # the guardian quarantines must REPLAY as None (synthetic and
+        # caller-provided stores default to the strict reader)
+        store.quarantine_corrupt = True
+
     rng = np.random.default_rng(cfg.seed)
     n_chunks = min(cfg.n_chunks, store.n_chunks)
     chunk_order = np.concatenate([rng.permutation(n_chunks)
                                   for _ in range(cfg.n_repetitions)])
+    # the batch-RNG state at chunk 0 — the rollback target when an
+    # incident lands before the first checkpoint set exists
+    rng0_state = rng.bit_generator.state
 
     chunks_done = 0
     if resume:
         chunks_done, rng_state = resume_sweep_state(ensembles, out_dir)
         if rng_state is not None:
             rng.bit_generator.state = rng_state
+        if guardian is not None:
+            # ledgered quarantines must outlive the process: a restored
+            # checkpoint predates the freeze it is resumed past
+            guardian.refreeze()
 
     center = None
     if cfg.center_activations:
@@ -325,10 +347,27 @@ def sweep(
     # dying stream degrades to the foreground single-stream reader and
     # the epoch completes with identical data. streams<=1 keeps the
     # native 1-slab readahead contract (chunkio.cpp background threads).
-    todo = list(range(chunks_done, len(chunk_order)))
-    reader = chunk_stream(store, [int(chunk_order[ci]) for ci in todo],
-                          dtype=train_np_dtype,
-                          streams=cfg.ingest_streams or None)
+    def _open_reader(from_chunk: int):
+        """(todo, reader) over positions from_chunk..end — re-opened by a
+        guardian rollback with the quarantined chunk now a ledger-known
+        positional hole."""
+        positions = list(range(from_chunk, len(chunk_order)))
+        return positions, chunk_stream(
+            store, [int(chunk_order[ci]) for ci in positions],
+            dtype=train_np_dtype, streams=cfg.ingest_streams or None)
+
+    def _reinit_states() -> None:
+        """Rollback target when no checkpoint set exists yet: member init
+        is keyed on cfg.seed, so a fresh ensemble_init_fn reproduces the
+        chunk-0 state bitwise; only the device states move (the compiled
+        step programs on the existing objects stay)."""
+        for (e_old, _, _), (e_new, _, _) in zip(ensembles,
+                                                ensemble_init_fn(cfg, mesh)):
+            for s_old, s_new in zip(_ensembles_of(e_old),
+                                    _ensembles_of(e_new)):
+                s_old.state = s_new.state
+
+    todo, reader = _open_reader(chunks_done)
     # SIGTERM (preemptible capacity, the unattended recovery loop) sets a
     # flag polled at chunk boundaries: the in-flight chunk finishes, a
     # checkpoint set is forced regardless of cadence, and SweepPreempted
@@ -337,165 +376,247 @@ def sweep(
     preempt = PreemptionGuard()
     preempt.__enter__()  # paired in the finally (keeps the loop unindented)
     try:
-        for ci, chunk in zip(todo, reader):
-            # fresh throughput window per chunk: checkpoint/artifact wall
-            # time between chunks must not dilute the training-rate signal
-            timer.reset()
-            t_chunk = obs.monotime()
-            if chunk is not None and center is not None:
-                # cast the mean down rather than the chunk up: keeps the
-                # bf16 path bf16 end to end (host RAM + host→device traffic
-                # halved). In place: load_chunk returns a fresh array, and
-                # out-of-place would briefly hold two full chunks in RAM
-                chunk -= center.astype(train_np_dtype)
-            # chunk is None when the store quarantined it
-            # (quarantine_corrupt=True): no batches to train, but the
-            # boundary bookkeeping below (checkpoint cadence, preemption
-            # consensus) still runs at this ci so indices stay aligned
-            batches = (iter(()) if chunk is None
-                       else store.batches(chunk, cfg.batch_size, rng))
-            if scan_k > 1:
-                batches = window_stacks(batches, scan_k)
-                window_sharding = (batch_sharding(mesh, stacked=True)
-                                   if mesh is not None else None)
-            else:
-                window_sharding = sharding
-            for batch in device_batches(batches, window_sharding):
-                k_steps = batch.shape[0] if scan_k > 1 else 1
-                step += k_steps
-                if (cfg.profile_steps > 0 and not profiling
-                        and not profile_done and step >= profile_start):
-                    jax.profiler.start_trace(str(out_dir / "trace"))
-                    profiling = True
-                elif profiling and step >= profile_start + cfg.profile_steps:
-                    jax.profiler.stop_trace()
-                    profiling = False
-                    profile_done = True
-                do_log = step - last_log >= log_every
-                if do_log:
-                    last_log = step
-                for ens_idx, (ensemble, hypers, name) in enumerate(ensembles):
-                    is_group = isinstance(ensemble, EnsembleGroup)
+        # the rollback loop: one pass is the whole sweep; a guardian
+        # escalation (GuardianRollback) restores the last-good
+        # checkpoint set and replays from there with the offending
+        # chunk quarantined (docs/ARCHITECTURE.md §16)
+        while True:
+            try:
+                for ci, chunk in zip(todo, reader):
+                    # fresh throughput window per chunk: checkpoint/artifact wall
+                    # time between chunks must not dilute the training-rate signal
+                    timer.reset()
+                    t_chunk = obs.monotime()
+                    if chunk is not None and center is not None:
+                        # cast the mean down rather than the chunk up: keeps the
+                        # bf16 path bf16 end to end (host RAM + host→device traffic
+                        # halved). In place: load_chunk returns a fresh array, and
+                        # out-of-place would briefly hold two full chunks in RAM
+                        chunk -= center.astype(train_np_dtype)
+                    # chunk is None when the store quarantined it
+                    # (quarantine_corrupt=True): no batches to train, but the
+                    # boundary bookkeeping below (checkpoint cadence, preemption
+                    # consensus) still runs at this ci so indices stay aligned
+                    batches = (iter(()) if chunk is None
+                               else store.batches(chunk, cfg.batch_size, rng))
+                    if guardian is not None:
+                        # fault site sweep.anomaly: the divergence drill's
+                        # injection point — every host batch passes through
+                        # (no-op without an active plan)
+                        batches = map(guardian.inject_anomaly, batches)
                     if scan_k > 1:
-                        # aux comes back stacked [K, ...]; the window's last
-                        # step is sliced out ONLY when logging (the slice is
-                        # its own device dispatch — paying it per window
-                        # would re-import the overhead scan_steps removes)
-                        stepper = ensemble.run_steps
-                        last = lambda aux: jax.tree.map(lambda a: a[-1], aux)
+                        batches = window_stacks(batches, scan_k)
+                        window_sharding = (batch_sharding(mesh, stacked=True)
+                                           if mesh is not None else None)
                     else:
-                        stepper = ensemble.step_batch
-                        last = lambda aux: aux
-                    if is_group:
-                        raw_items = list(stepper(batch).items())
-                    else:
-                        raw_items = [(name, stepper(batch))]
-                    if do_log:
-                        aux_items = [(n, last(a)) for n, a in raw_items]
-                        for sub_name, aux in aux_items:
-                            losses = jax.device_get(aux.losses["loss"])
-                            l0 = jax.device_get(aux.l0)
-                            rec = {f"{sub_name}/loss_mean": float(np.mean(losses)),
-                                   f"{sub_name}/loss_max": float(np.max(losses)),
-                                   f"{sub_name}/l0_mean": float(np.mean(l0))}
-                            # per-member streams, named from hyperparams like
-                            # the reference's per-model wandb logs
-                            # (big_sweep.py:173-197). Group buckets use
-                            # positional names — the flat hypers list doesn't
-                            # align with bucket-local member indices (the
-                            # bucket name carries the static hyperparameter
-                            # already).
-                            names_i = member_names[ens_idx]
-                            for mi, (loss_i, l0_i) in enumerate(zip(losses, l0)):
-                                member = (f"member{mi}" if is_group
-                                          else names_i[mi] if mi < len(names_i)
-                                          else f"member{mi}")
-                                rec[f"{sub_name}/{member}/loss"] = float(loss_i)
-                                rec[f"{sub_name}/{member}/l0"] = float(l0_i)
-                            logger.log(rec, step=step)
-                timer.tick(batch.shape[0] * (batch.shape[1]
-                                             if scan_k > 1 else 1))
-                # supervised runs: each completed training window is
-                # progress (throttled inside; a hang anywhere in the
-                # dispatch→sync path stops these beats)
-                lease.beat()
-                if do_log:
-                    logger.log({"activations_per_sec": timer.items_per_sec},
-                               step=step)
-            # checkpoint + periodic artifact saves; the RNG state makes the
-            # data stream resume exactly where it stopped. The whole
-            # checkpoint SET is written to a staging dir and swapped in by
-            # renames, so a crash mid-save can never leave ensembles at
-            # mixed chunks_done (ADVICE r1 #5); cadence is
-            # cfg.checkpoint_every_chunks (VERDICT r1 weak#6). Orbax sets
-            # are issued async and swapped in at the NEXT round (or in the
-            # finally below), so their disk writes overlap a full chunk of
-            # training; msgpack sets swap immediately.
-            last_chunk = ci == len(chunk_order) - 1
-            cadence = cfg.checkpoint_every_chunks
-            # sample the preemption flag ONCE per boundary (a signal landing
-            # mid-checkpoint is honored at the next chunk's boundary) and
-            # agree on it cross-host BEFORE gating the barrier-containing
-            # branch — a host-local flag would desync the collectives
-            preempted = _agree_preempted(preempt.requested)
-            if ((cadence > 0 and (ci + 1) % cadence == 0) or last_chunk
-                    or preempted):
-                rng_state = rng.bit_generator.state
-                staging = out_dir / "ckpt_staging"
+                        window_sharding = sharding
+                    for batch in device_batches(batches, window_sharding):
+                        k_steps = batch.shape[0] if scan_k > 1 else 1
+                        step += k_steps
+                        if (cfg.profile_steps > 0 and not profiling
+                                and not profile_done and step >= profile_start):
+                            jax.profiler.start_trace(str(out_dir / "trace"))
+                            profiling = True
+                        elif profiling and step >= profile_start + cfg.profile_steps:
+                            jax.profiler.stop_trace()
+                            profiling = False
+                            profile_done = True
+                        do_log = step - last_log >= log_every
+                        if do_log:
+                            last_log = step
+                        for ens_idx, (ensemble, hypers, name) in enumerate(ensembles):
+                            is_group = isinstance(ensemble, EnsembleGroup)
+                            if scan_k > 1:
+                                # aux comes back stacked [K, ...]; the window's last
+                                # step is sliced out ONLY when logging (the slice is
+                                # its own device dispatch — paying it per window
+                                # would re-import the overhead scan_steps removes)
+                                stepper = ensemble.run_steps
+                                last = lambda aux: jax.tree.map(lambda a: a[-1], aux)
+                            else:
+                                stepper = ensemble.step_batch
+                                last = lambda aux: aux
+                            if is_group:
+                                raw_items = list(stepper(batch).items())
+                            else:
+                                raw_items = [(name, stepper(batch))]
+                            if guardian is not None:
+                                # per-window anomaly accumulation: a tiny
+                                # async device combine, host-synced only at
+                                # the chunk boundary (check_boundary)
+                                for sub_name, raw_aux in raw_items:
+                                    guardian.observe(ens_idx, sub_name,
+                                                     raw_aux)
+                            if do_log:
+                                aux_items = [(n, last(a)) for n, a in raw_items]
+                                for sub_name, aux in aux_items:
+                                    losses = jax.device_get(aux.losses["loss"])
+                                    l0 = jax.device_get(aux.l0)
+                                    # quarantined members' NaN losses must
+                                    # not poison the aggregate streams —
+                                    # masked out (and counted) here; their
+                                    # per-member streams below still log,
+                                    # so the divergence stays diagnosable
+                                    mask = np.ones(len(losses), np.bool_)
+                                    if guardian is not None:
+                                        dead = guardian.dead_indices(
+                                            ens_idx, sub_name)
+                                        mask[dead] = False
+                                    rec = {}
+                                    if mask.any():
+                                        rec = {f"{sub_name}/loss_mean":
+                                               float(np.mean(losses[mask])),
+                                               f"{sub_name}/loss_max":
+                                               float(np.max(losses[mask])),
+                                               f"{sub_name}/l0_mean":
+                                               float(np.mean(l0[mask]))}
+                                    if not mask.all():
+                                        rec[f"{sub_name}/quarantined"] = int(
+                                            (~mask).sum())
+                                    # per-member streams, named from hyperparams like
+                                    # the reference's per-model wandb logs
+                                    # (big_sweep.py:173-197). Group buckets use
+                                    # positional names — the flat hypers list doesn't
+                                    # align with bucket-local member indices (the
+                                    # bucket name carries the static hyperparameter
+                                    # already).
+                                    names_i = member_names[ens_idx]
+                                    for mi, (loss_i, l0_i) in enumerate(zip(losses, l0)):
+                                        member = (f"member{mi}" if is_group
+                                                  else names_i[mi] if mi < len(names_i)
+                                                  else f"member{mi}")
+                                        rec[f"{sub_name}/{member}/loss"] = float(loss_i)
+                                        rec[f"{sub_name}/{member}/l0"] = float(l0_i)
+                                    logger.log(rec, step=step)
+                        timer.tick(batch.shape[0] * (batch.shape[1]
+                                                     if scan_k > 1 else 1))
+                        # supervised runs: each completed training window is
+                        # progress (throttled inside; a hang anywhere in the
+                        # dispatch→sync path stops these beats)
+                        lease.beat()
+                        if do_log:
+                            logger.log({"activations_per_sec": timer.items_per_sec},
+                                       step=step)
+                    # checkpoint + periodic artifact saves; the RNG state makes the
+                    # data stream resume exactly where it stopped. The whole
+                    # checkpoint SET is written to a staging dir and swapped in by
+                    # renames, so a crash mid-save can never leave ensembles at
+                    # mixed chunks_done (ADVICE r1 #5); cadence is
+                    # cfg.checkpoint_every_chunks (VERDICT r1 weak#6). Orbax sets
+                    # are issued async and swapped in at the NEXT round (or in the
+                    # finally below), so their disk writes overlap a full chunk of
+                    # training; msgpack sets swap immediately.
+                    # the guardian's one host sync per chunk — BEFORE the
+                    # checkpoint block, so a poisoned chunk's advanced
+                    # state is never checkpointed: an input incident or a
+                    # member-fraction breach raises GuardianRollback (or a
+                    # typed DivergenceHaltError when the ladder is spent),
+                    # a plain member divergence freezes + ledgers here
+                    if guardian is not None:
+                        guardian.check_boundary(ci, int(chunk_order[ci]),
+                                                store)
+                    last_chunk = ci == len(chunk_order) - 1
+                    cadence = cfg.checkpoint_every_chunks
+                    # sample the preemption flag ONCE per boundary (a signal landing
+                    # mid-checkpoint is honored at the next chunk's boundary) and
+                    # agree on it cross-host BEFORE gating the barrier-containing
+                    # branch — a host-local flag would desync the collectives
+                    preempted = _agree_preempted(preempt.requested)
+                    if ((cadence > 0 and (ci + 1) % cadence == 0) or last_chunk
+                            or preempted):
+                        rng_state = rng.bit_generator.state
+                        staging = out_dir / "ckpt_staging"
+                        if pending_staging is not None:
+                            # previous round's writes overlapped this chunk's
+                            # training; make them the current set before reusing
+                            # the staging dir
+                            orbax_ckptr.wait()
+                            _sync_hosts("ckpt-durable")
+                            if jax.process_index() == 0:
+                                _swap_in_checkpoint_set(out_dir, pending_staging)
+                            _sync_hosts("ckpt-swapped")
+                            pending_staging = None
+                        if jax.process_index() == 0:
+                            shutil.rmtree(staging, ignore_errors=True)
+                        _sync_hosts("ckpt-staging-clean")
+                        for ensemble, hypers, name in ensembles:
+                            for j, sub in enumerate(_ensembles_of(ensemble)):
+                                extra = {"chunks_done": ci + 1, "rng_state": rng_state}
+                                if orbax_ckptr is not None:
+                                    orbax_ckptr.save(
+                                        sub, checkpoint_path(staging, f"{name}_{j}"),
+                                        extra=extra)
+                                else:
+                                    save_ensemble(sub, staging / f"{name}_{j}.msgpack",
+                                                  extra=extra)
+                        if orbax_ckptr is not None:
+                            # fully issued — safe to swap once durable (next round
+                            # or the finally below); a crash mid-save-loop leaves
+                            # pending_staging unset and the staged set is discarded
+                            pending_staging = staging
+                        elif jax.process_index() == 0:
+                            _swap_in_checkpoint_set(out_dir, staging)
+                    if (ci in save_points or ci == len(chunk_order) - 1) \
+                            and chunk is not None:
+                        _save_artifacts(ensembles, out_dir / f"_{ci}", chunk, cfg,
+                                        logger,
+                                        image_metrics=image_metrics_every is not None
+                                        and (ci + 1) % image_metrics_every == 0,
+                                        guardian=guardian)
+                    # chunk telemetry BEFORE the barrier: a kill at the barrier
+                    # leaves the span + metrics snapshot as durable as the chunk's
+                    # artifacts. StepTimer.snapshot() is the single throughput
+                    # surface (bench shares it), published as the sweep gauge.
+                    snap = timer.snapshot()
+                    timer.publish(prefix="sweep")
+                    obs.record_span("sweep.chunk", obs.monotime() - t_chunk,
+                                    index=ci, chunk=int(chunk_order[ci]),
+                                    steps=snap["steps"],
+                                    acts_per_sec=round(snap["items_per_sec"], 1))
+                    obs.flush_metrics()
+                    # one chunk's full train+checkpoint+artifact block is durable —
+                    # the crash-resume unit the chaos matrix kills at
+                    crash_barrier("sweep.chunk")
+                    if preempted and not last_chunk:
+                        # checkpoint for chunks 0..ci is issued (and for msgpack
+                        # already swapped in); exit cleanly so resume continues
+                        raise SweepPreempted(ci + 1)
+            except GuardianRollback as rollback:
+                # guardian escalation (train/guardian.py): the incident
+                # record + chunk quarantine are already durable; close the
+                # stream, make any fully-issued async set current (it is
+                # the NEWEST last-good state), cross the guardian.rollback
+                # crash barrier, restore, and replay — bitwise the run
+                # that never saw the poisoned chunk
+                reader.close()
                 if pending_staging is not None:
-                    # previous round's writes overlapped this chunk's
-                    # training; make them the current set before reusing
-                    # the staging dir
                     orbax_ckptr.wait()
                     _sync_hosts("ckpt-durable")
                     if jax.process_index() == 0:
                         _swap_in_checkpoint_set(out_dir, pending_staging)
                     _sync_hosts("ckpt-swapped")
                     pending_staging = None
-                if jax.process_index() == 0:
-                    shutil.rmtree(staging, ignore_errors=True)
-                _sync_hosts("ckpt-staging-clean")
-                for ensemble, hypers, name in ensembles:
-                    for j, sub in enumerate(_ensembles_of(ensemble)):
-                        extra = {"chunks_done": ci + 1, "rng_state": rng_state}
-                        if orbax_ckptr is not None:
-                            orbax_ckptr.save(
-                                sub, checkpoint_path(staging, f"{name}_{j}"),
-                                extra=extra)
-                        else:
-                            save_ensemble(sub, staging / f"{name}_{j}.msgpack",
-                                          extra=extra)
-                if orbax_ckptr is not None:
-                    # fully issued — safe to swap once durable (next round
-                    # or the finally below); a crash mid-save-loop leaves
-                    # pending_staging unset and the staged set is discarded
-                    pending_staging = staging
-                elif jax.process_index() == 0:
-                    _swap_in_checkpoint_set(out_dir, staging)
-            if (ci in save_points or ci == len(chunk_order) - 1) \
-                    and chunk is not None:
-                _save_artifacts(ensembles, out_dir / f"_{ci}", chunk, cfg,
-                                logger,
-                                image_metrics=image_metrics_every is not None
-                                and (ci + 1) % image_metrics_every == 0)
-            # chunk telemetry BEFORE the barrier: a kill at the barrier
-            # leaves the span + metrics snapshot as durable as the chunk's
-            # artifacts. StepTimer.snapshot() is the single throughput
-            # surface (bench shares it), published as the sweep gauge.
-            snap = timer.snapshot()
-            timer.publish(prefix="sweep")
-            obs.record_span("sweep.chunk", obs.monotime() - t_chunk,
-                            index=ci, chunk=int(chunk_order[ci]),
-                            steps=snap["steps"],
-                            acts_per_sec=round(snap["items_per_sec"], 1))
-            obs.flush_metrics()
-            # one chunk's full train+checkpoint+artifact block is durable —
-            # the crash-resume unit the chaos matrix kills at
-            crash_barrier("sweep.chunk")
-            if preempted and not last_chunk:
-                # checkpoint for chunks 0..ci is issued (and for msgpack
-                # already swapped in); exit cleanly so resume continues
-                raise SweepPreempted(ci + 1)
+
+                def _restore():
+                    done, rng_state = resume_sweep_state(ensembles, out_dir)
+                    if done == 0 and rng_state is None:
+                        # incident before the first checkpoint set: the
+                        # last-good state is the chunk-0 init, reproduced
+                        # bitwise from cfg.seed
+                        _reinit_states()
+                        rng_state = rng0_state
+                    return done, rng_state
+
+                chunks_done, rng_state = guardian.rollback_restore(_restore)
+                if rng_state is not None:
+                    rng.bit_generator.state = rng_state
+                logger_mod.warning(
+                    "guardian rollback (%s at %s): resuming from chunk %d "
+                    "with chunk %d quarantined", rollback.incident,
+                    rollback.site, chunks_done, rollback.chunk_index)
+                todo, reader = _open_reader(chunks_done)
+                continue
+            break
         clean_exit = True
     except SweepPreempted:
         # a preemption exit IS clean: the staged orbax set (if any) is
@@ -536,15 +657,25 @@ def sweep(
     result = {}
     for ensemble, hypers, name in ensembles:
         dicts = _flat_dicts(ensemble)
-        result[name] = list(zip(dicts, hypers))
+        tagged = list(zip(dicts, hypers))
+        if guardian is not None:
+            # quarantined members ship tagged diverged=True — the same
+            # flag every periodic artifact carries, so downstream loads
+            # (load_learned_dicts(skip_diverged=True), eval, serving
+            # registries) can filter them uniformly
+            tagged = guardian.tag_hypers(name, tagged)
+        result[name] = tagged
     return result
 
 
 def _save_artifacts(ensembles, folder: Path, chunk: np.ndarray,
                     cfg: EnsembleArgs, logger: MetricsLogger,
-                    image_metrics: bool = False) -> None:
+                    image_metrics: bool = False, guardian=None) -> None:
     """Save learned dicts + quick evals (reference: big_sweep.py:368-384 +
-    log_standard_metrics :86-156)."""
+    log_standard_metrics :86-156). Members the guardian quarantined are
+    tagged ``diverged=True`` in the artifact, skipped (and counted) by the
+    quick evals, and excluded from the MMCS/sparsity panels — a NaN
+    dictionary must never poison a sweep's eval surface."""
     folder.mkdir(parents=True, exist_ok=True)
     rng = np.random.default_rng(0)
     # evals always run in f32 even when training streams bf16 activations
@@ -553,24 +684,36 @@ def _save_artifacts(ensembles, folder: Path, chunk: np.ndarray,
     for ensemble, hypers, name in ensembles:
         dicts = _flat_dicts(ensemble)
         tagged = list(zip(dicts, hypers))
+        if guardian is not None:
+            tagged = guardian.tag_hypers(name, tagged)
         save_learned_dicts(tagged, folder / f"{name}_learned_dicts.pkl")
         evals = []
         for ld, hyper in tagged:
-            evals.append({**{k: v for k, v in hyper.items()
-                             if isinstance(v, (int, float, str))},
+            scalars = {k: v for k, v in hyper.items()
+                       if isinstance(v, (int, float, str))}
+            if hyper.get("diverged"):
+                evals.append({**scalars, "skipped": True})
+                continue
+            evals.append({**scalars,
                           "fvu": float(fraction_variance_unexplained(ld, eval_batch)),
                           "l0": float(mean_l0(ld, eval_batch))})
         atomic_write_text(folder / f"{name}_eval.json",
                           json.dumps(evals, indent=2))
         if image_metrics:
             # MMCS grid + per-dict sparsity histograms (reference's wandb
-            # image panels, big_sweep.py:86-156, as files)
+            # image panels, big_sweep.py:86-156, as files); diverged
+            # members are excluded — one NaN row would blank the panels
             from sparse_coding_tpu.plotting.helpers import plot_hist
 
-            if len(dicts) > 1:
-                grid = np.asarray(mmcs_from_list(dicts[: min(len(dicts), 8)]))
+            live_dicts = [ld for ld, hyper in tagged
+                          if not hyper.get("diverged")]
+            if len(live_dicts) > 1:
+                grid = np.asarray(
+                    mmcs_from_list(live_dicts[: min(len(live_dicts), 8)]))
                 atomic_save_npy(folder / f"{name}_mmcs_grid.npy", grid)
-            for di, ld in enumerate(dicts):
+            for di, (ld, hyper) in enumerate(tagged):
+                if hyper.get("diverged"):
+                    continue
                 freqs = mean_nonzero_activations(ld, eval_batch)
                 plot_hist(jnp.log10(jnp.clip(freqs, 1e-6)),
                           x_label="log10 firing frequency", y_label="features",

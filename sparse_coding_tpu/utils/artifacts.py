@@ -68,16 +68,25 @@ def save_learned_dicts(dicts: Sequence[tuple[Any, dict]], path: str | Path) -> N
 
 
 def load_learned_dicts(path: str | Path,
-                       select: Optional[Callable[[dict], bool]] = None
+                       select: Optional[Callable[[dict], bool]] = None,
+                       skip_diverged: bool = False,
                        ) -> list[tuple[Any, dict]]:
     """``select(hyperparams) -> bool`` filters records BEFORE their arrays
     are reconstructed as jax trees — a serving registry loading two dicts
-    out of a 64-member sweep artifact skips 62 host→device transfers."""
+    out of a 64-member sweep artifact skips 62 host→device transfers.
+
+    ``skip_diverged=True`` drops members the training guardian quarantined
+    (hyperparams tagged ``diverged=True`` by train/guardian.py — their
+    dictionaries froze at the last finite pre-divergence step and must not
+    enter ensembles, evals, or serving stacks); the default keeps them so
+    forensic loads can inspect exactly what the artifact holds."""
     with Path(path).open("rb") as fh:
         records = pickle.load(fh)
     reg = _dict_registry()
     out = []
     for rec in records:
+        if skip_diverged and rec["hyperparams"].get("diverged"):
+            continue
         if select is not None and not select(rec["hyperparams"]):
             continue
         cls = reg[rec["cls"]]

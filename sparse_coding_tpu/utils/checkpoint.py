@@ -46,7 +46,8 @@ def save_ensemble(ens: Ensemble, path: str | Path,
     path.parent.mkdir(parents=True, exist_ok=True)
     state = jax.device_get(ens.state)
     tree = {"params": state.params, "buffers": state.buffers,
-            "opt_state": state.opt_state, "lrs": state.lrs, "step": state.step}
+            "opt_state": state.opt_state, "lrs": state.lrs,
+            "step": state.step, "live": state.live}
     payload = serialization.to_bytes(tree)
     fault_point("ckpt.save")
     atomic_write_bytes(path, payload)
@@ -78,15 +79,26 @@ def restore_ensemble(ens: Ensemble, path: str | Path) -> dict:
     state = jax.device_get(ens.state)
     template = {"params": state.params, "buffers": state.buffers,
                 "opt_state": state.opt_state, "lrs": state.lrs,
-                "step": state.step}
+                "step": state.step, "live": state.live}
+    legacy = {k: v for k, v in template.items() if k != "live"}
     try:
         tree = serialization.from_bytes(template, payload)
-    except Exception as e:  # msgpack unpack errors are library-specific
-        raise CheckpointCorruptionError(
-            path, f"payload does not deserialize: {e}") from e
+    except Exception as first_err:  # msgpack errors are library-specific
+        # pre-guardian checkpoint (no live leaf): from_bytes rejects a
+        # template key the payload lacks — restore the legacy tree and
+        # default every member live, instead of misdiagnosing a perfectly
+        # sound old checkpoint as corruption
+        try:
+            tree = dict(serialization.from_bytes(legacy, payload))
+            tree["live"] = state.live
+        except Exception:
+            raise CheckpointCorruptionError(
+                path,
+                f"payload does not deserialize: {first_err}") from first_err
     new_state = EnsembleState(
         params=tree["params"], buffers=tree["buffers"],
         opt_state=tree["opt_state"], lrs=tree["lrs"], step=tree["step"],
+        live=tree.get("live"),
         static_buffers=state.static_buffers, sig_name=state.sig_name)
     # RUNTIME-OWNED device copies, never zero-copy numpy wraps:
     # from_bytes leaves are numpy views into the msgpack payload, and

@@ -61,7 +61,7 @@ register_fault_site("ckpt.restore",
 def _state_tree(state: EnsembleState) -> dict:
     return {"params": state.params, "buffers": state.buffers,
             "opt_state": state.opt_state, "lrs": state.lrs,
-            "step": state.step}
+            "step": state.step, "live": state.live}
 
 
 def _meta_path(path: Path) -> Path:
@@ -137,10 +137,22 @@ class AsyncEnsembleCheckpointer:
         verify_dir_manifest(path)
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
                                 _state_tree(ens.state))
-        tree = self._ckptr_for(path).restore(path.absolute(), abstract)
+        try:
+            tree = self._ckptr_for(path).restore(path.absolute(), abstract)
+        except Exception:
+            # pre-guardian checkpoint (no live leaf): restore the legacy
+            # tree and default every member live — a sound old checkpoint
+            # must not read as corruption (mirrors utils/checkpoint.py);
+            # a genuinely damaged payload fails this retry too and
+            # propagates
+            legacy = {k: v for k, v in abstract.items() if k != "live"}
+            tree = dict(self._ckptr_for(path).restore(path.absolute(),
+                                                      legacy))
+            tree["live"] = ens.state.live
         ens.state = EnsembleState(
             params=tree["params"], buffers=tree["buffers"],
             opt_state=tree["opt_state"], lrs=tree["lrs"], step=tree["step"],
+            live=tree.get("live"),
             static_buffers=ens.state.static_buffers,
             sig_name=ens.state.sig_name)
         meta = _meta_path(path)

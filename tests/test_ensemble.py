@@ -227,3 +227,122 @@ def test_resurrect_rica_and_positive(rng):
                                             scalar_defaults={"extra": 0.0})
     bias = np.asarray(pos.state.params["encoder_bias"])
     np.testing.assert_allclose(bias[0, :4], -1.0, rtol=1e-6)
+
+
+# -- in-graph anomaly sentinel (ISSUE 10; docs/ARCHITECTURE.md §16) -----------
+
+
+def _enc(ens):
+    return np.asarray(jax.device_get(ens.state.params["encoder"]))
+
+
+def test_sentinel_live_mask_freeze_is_bitwise_noop_for_live_members(rng):
+    """The quarantine select property: freezing member 1 leaves members
+    0/2 BITWISE identical to an all-live run (jnp.where on a True mask is
+    an exact copy), while member 1's params AND optimizer state stay at
+    their pre-freeze values forever."""
+    k_init, k_data = jax.random.split(rng)
+    members = _members(k_init, FunctionalTiedSAE, 3, l1_alpha=1e-3)
+    batch = jax.random.normal(k_data, (BATCH, D))
+    ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, donate=False)
+    frozen = Ensemble(members, FunctionalTiedSAE, lr=1e-3, donate=False)
+    frozen.freeze_members([1])
+    init_enc = np.asarray(members[1][0]["encoder"])
+    for _ in range(5):
+        ens.step_batch(batch)
+        frozen.step_batch(batch)
+    a, b = _enc(ens), _enc(frozen)
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[2], b[2])
+    np.testing.assert_array_equal(b[1], init_enc)  # frozen never moved
+    assert not np.array_equal(a[1], b[1])  # the live twin kept training
+    mu = np.asarray(jax.device_get(frozen.state.opt_state.mu["encoder"]))
+    np.testing.assert_array_equal(mu[1], np.zeros_like(mu[1]))  # opt frozen
+    assert mu[0].any() and mu[2].any()  # live members' moments advanced
+    assert list(frozen.live_mask()) == [True, False, True]
+
+
+def test_sentinel_nonfinite_batch_step_is_in_graph_noop(rng):
+    """A NaN batch must leave EVERY member's params bitwise unchanged
+    (containment is in-graph, before any host check), flag
+    inputs_finite=False and all members non-finite — and the very next
+    clean batch trains normally (a transient bad input is not a death
+    sentence)."""
+    k_init, k_data = jax.random.split(rng)
+    members = _members(k_init, FunctionalTiedSAE, 3, l1_alpha=1e-3)
+    batch = jax.random.normal(k_data, (BATCH, D))
+    ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, donate=False)
+    ens.step_batch(batch)
+    before = _enc(ens)
+    poisoned = np.array(batch)
+    poisoned[3, 2] = np.nan
+    aux = ens.step_batch(jnp.asarray(poisoned))
+    np.testing.assert_array_equal(before, _enc(ens))
+    assert not bool(aux.inputs_finite)
+    assert not np.asarray(aux.finite).any()
+    aux = ens.step_batch(batch)
+    assert bool(aux.inputs_finite) and np.asarray(aux.finite).all()
+    assert not np.array_equal(before, _enc(ens))
+
+
+def test_sentinel_member_divergence_frozen_in_graph(rng):
+    """A single member's loss going NaN (poisoned l1 buffer — the
+    guardian drill's mechanism) freezes exactly that member at its last
+    finite params; neighbors keep training and report finite flags."""
+    k_init, k_data = jax.random.split(rng)
+    members = _members(k_init, FunctionalTiedSAE, 3, l1_alpha=1e-3)
+    batch = jax.random.normal(k_data, (BATCH, D))
+    ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, donate=False)
+    ens.step_batch(batch)
+    buffers = dict(ens.state.buffers)
+    buffers["l1_alpha"] = buffers["l1_alpha"].at[0].set(jnp.nan)
+    ens.state = ens.state.replace(buffers=buffers)
+    before = _enc(ens)
+    for _ in range(3):
+        aux = ens.step_batch(batch)
+    assert list(np.asarray(aux.finite)) == [False, True, True]
+    assert bool(aux.inputs_finite)  # the BATCH was sound: member incident
+    after = _enc(ens)
+    np.testing.assert_array_equal(before[0], after[0])
+    assert not np.array_equal(before[1], after[1])
+    assert not np.array_equal(before[2], after[2])
+    gn = np.asarray(aux.grad_norm)
+    assert not np.isfinite(gn[0]) and np.isfinite(gn[1:]).all()
+
+
+def test_sentinel_fields_ride_scan_and_default_off(rng):
+    """run_steps stacks the sentinel fields on the window axis like every
+    other aux leaf; sentinel=False rebuilds the pre-sentinel aux (fields
+    None) — the guardian_soak A/B contract."""
+    k_init, k_data = jax.random.split(rng)
+    members = _members(k_init, FunctionalTiedSAE, 2, l1_alpha=1e-3)
+    batch = jax.random.normal(k_data, (BATCH, D))
+    stack = jnp.stack([batch, batch, batch])
+    ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, donate=False)
+    aux = ens.run_steps(stack)
+    assert np.asarray(aux.finite).shape == (3, 2)
+    assert np.asarray(aux.grad_norm).shape == (3, 2)
+    assert np.asarray(aux.inputs_finite).shape == (3,)
+    bare = Ensemble(members, FunctionalTiedSAE, lr=1e-3, donate=False,
+                    sentinel=False)
+    aux = bare.step_batch(batch)
+    assert aux.finite is None and aux.grad_norm is None
+    assert aux.inputs_finite is None
+
+
+def test_sentinel_untied_autodiff_path_guards_too(rng):
+    """The sentinel is woven through every step family — the untied
+    autodiff path freezes a NaN-lr member (non-finite UPDATE, finite
+    grads) the same way."""
+    k_init, k_data = jax.random.split(rng)
+    members = _members(k_init, FunctionalSAE, 2, l1_alpha=1e-3)
+    batch = jax.random.normal(k_data, (BATCH, D))
+    ens = Ensemble(members, FunctionalSAE, lr=1e-3, donate=False)
+    ens.step_batch(batch)
+    ens.state = ens.state.replace(lrs=ens.state.lrs.at[1].set(jnp.nan))
+    before = _enc(ens)
+    aux = ens.step_batch(batch)
+    assert list(np.asarray(aux.finite)) == [True, False]
+    after = _enc(ens)
+    np.testing.assert_array_equal(before[1], after[1])
+    assert not np.array_equal(before[0], after[0])

@@ -176,3 +176,62 @@ def _check_against_single_process(losses: list[float]) -> None:
                      single.stdout.split("SINGLE")[1].split()]
     _single_process_losses.extend(single_losses)
     np.testing.assert_allclose(losses, single_losses, rtol=1e-5)
+
+
+_CONSENSUS_WORKER = textwrap.dedent("""
+    import os, sys
+    pid, port, nprocs, out_path = (int(sys.argv[1]), sys.argv[2],
+                                   int(sys.argv[3]), sys.argv[4])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nprocs, process_id=pid)
+    from sparse_coding_tpu.parallel import agree_any
+    # only host 1 observes the anomaly; consensus must move EVERY host
+    anomaly = agree_any(pid == 1, "guardian-input")
+    quiet = agree_any(False, "guardian-fraction")
+    # the agreed branch contains collectives (checkpoint barriers, the
+    # rollback restore): prove deadlock-freedom by actually taking a
+    # collective on every host, gated on the agreed flag — a host that
+    # disagreed would hang the world here
+    from jax.experimental import multihost_utils
+    if anomaly:
+        multihost_utils.sync_global_processes("guardian-rollback")
+    with open(out_path, "w") as fh:
+        fh.write(f"{int(anomaly)} {int(quiet)}")
+    jax.distributed.shutdown()
+""")
+
+
+@pytest.mark.slow
+def test_agree_any_one_hosts_anomaly_moves_all_hosts(tmp_path):
+    """ISSUE 10 satellite: the shared ``parallel.agree_any`` consensus
+    helper (preemption + guardian). One host's local anomaly flag must
+    return True on EVERY host (and a no-anomaly round False everywhere),
+    and the flagged branch's collective completes without deadlock."""
+    worker = tmp_path / "consensus_worker.py"
+    worker.write_text(_CONSENSUS_WORKER)
+    port = _free_port()
+    env = _stripped_env()
+    n_procs = 2
+    out_files = [tmp_path / f"agree_{pid}.txt" for pid in range(n_procs)]
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(pid), str(port), str(n_procs),
+         str(out_files[pid])],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for pid in range(n_procs)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+    for f in out_files:
+        assert f.read_text() == "1 0"

@@ -535,3 +535,69 @@ def test_scrub_runs_again_for_a_new_run_over_same_store(tmp_path):
     assert set(load_quarantine(tmp_path / "chunks" / "shard-000")) == {1}
     # a RESUME of run 2 (its own marker present) does skip
     assert scrub_run(r2) == {"scrub": "skipped"}
+
+
+def test_guardian_rollback_kill_restart_bitwise(tmp_path, monkeypatch):
+    """ISSUE 10 chaos case: a NaN batch mid-sweep triggers the guardian's
+    auto-rollback; SIGKILL the sweep child exactly at the
+    ``guardian.rollback`` crash barrier — incident ledger + chunk
+    quarantine durable, the last-good restore never ran. A restarted
+    supervisor resumes from the retained checkpoint set (the poisoned
+    chunk now a ledger-known hole) and the finished run — final dicts,
+    checkpoints, guardian.json, and the store's quarantine ledger — is
+    bitwise identical to an UNINTERRUPTED run of the same incident."""
+    from sparse_coding_tpu.resilience import faults
+
+    fault_plan = "sweep.anomaly:nth=7,mode=nan"  # chunk pos 1 (5 batches/chunk)
+
+    def _digest_set(base):
+        out = _digests(base, ["sweep"])
+        for extra in (base / "sweep" / "guardian.json",
+                      base / "chunks" / "quarantine.json"):
+            assert extra.exists(), extra
+            out[str(extra.relative_to(base))] = hashlib.sha256(
+                extra.read_bytes()).hexdigest()
+        return out
+
+    # golden: identical store, identical fault plan, NO kill — the
+    # rollback completes in-process
+    gold = tmp_path / "gold"
+    gconfig = _config(gold)
+    run_harvest(gconfig)
+    prev = faults.install_plan(faults.parse_fault_plan(fault_plan))
+    try:
+        run_sweep(gconfig)
+    finally:
+        faults.install_plan(prev)
+    want = _digest_set(gold)
+
+    # case: same harvest, the child runs under BOTH plans and dies at the
+    # barrier's worst instant
+    base = tmp_path / "case"
+    config = _config(base)
+    run_harvest(config)
+    run_dir = base / "run"
+    monkeypatch.setenv("SPARSE_CODING_FAULT_PLAN", fault_plan)
+    monkeypatch.setenv(crash_mod.ENV_VAR, "guardian.rollback:nth=1")
+    sup = Supervisor(run_dir, build_pipeline(run_dir, config, only=["sweep"]),
+                     max_attempts=1, heartbeat_stale_s=STALE_S)
+    with pytest.raises(StepFailed, match="killed by signal 9"):
+        sup.run()
+    # the kill landed AFTER durability, BEFORE the restore: both ledgers
+    # already know the incident
+    gj = json.loads((base / "sweep" / "guardian.json").read_text())
+    assert gj["rollbacks"] and not gj["members"]
+    assert (base / "chunks" / "quarantine.json").exists()
+
+    # restart: no plans — resume from the last-good set, the quarantined
+    # chunk replays as a positional hole
+    monkeypatch.delenv("SPARSE_CODING_FAULT_PLAN")
+    monkeypatch.delenv(crash_mod.ENV_VAR)
+    sup2 = Supervisor(run_dir,
+                      build_pipeline(run_dir, config, only=["sweep"]),
+                      max_attempts=2, heartbeat_stale_s=STALE_S)
+    assert sup2.run() == {"sweep": "done"}
+    got = _digest_set(base)
+    assert set(got) == set(want), set(got) ^ set(want)
+    diff = [k for k in want if got[k] != want[k]]
+    assert not diff, f"artifacts differ after kill+resume: {diff}"

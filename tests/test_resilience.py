@@ -1074,3 +1074,328 @@ def test_sweep_completes_over_scrub_repaired_store(tmp_path):
     ld, _hp = out["dense_l1_range"][0]
     arrays = [v for v in ld.__dict__.values() if hasattr(v, "shape")]
     assert arrays and all(np.isfinite(np.asarray(a)).all() for a in arrays)
+
+
+# -- guardian: divergence-safe sweeps (ISSUE 10) ------------------------------
+
+
+def test_fault_mode_nan_poisons_float_payload_deterministically():
+    """mode=nan (the divergence drill's injection): a fired hit returns a
+    COPY with exactly one NaN at the seed-selected element; float16 and
+    float32 payloads both work; an int payload is refused loudly (a plan
+    bug, not a silent no-op)."""
+    plan = parse_fault_plan("chunk.read:nth=1,mode=nan,seed=5")
+    faults.install_plan(plan)
+    payload = np.arange(12, dtype=np.float32)
+    out = faults.fault_point("chunk.read", payload)
+    assert out is not payload  # fired => copy (the identity contract)
+    assert np.isnan(out[5]) and np.isfinite(np.delete(out, 5)).all()
+    assert np.isfinite(payload).all()  # the original is never mutated
+    faults.install_plan(None)
+    with inject(site="chunk.read", nth=1, mode="nan"):
+        with pytest.raises(ValueError, match="cannot hold NaN"):
+            faults.fault_point("chunk.read", np.arange(4))
+    with pytest.raises(ValueError, match="unknown fault mode"):
+        parse_fault_plan("chunk.read:mode=nam")
+
+
+def test_nonfinite_chunk_on_disk_quarantined_by_finite_guard(tmp_path):
+    """Decode-side finite guard: a chunk whose rows hold NaN passes every
+    digest (the harvest wrote it that way) but is typed corruption at
+    decode and rides the PR-8 ledger/positional-None path — garbage never
+    reaches the step."""
+    from sparse_coding_tpu.data.ledger import load_quarantine
+
+    w = ChunkWriter(tmp_path, 8, chunk_size_gb=8 * 16 * 2 / 2**30,
+                    dtype="float16")
+    data = np.random.default_rng(0).normal(size=(64, 8)).astype(np.float32)
+    data[20, 3] = np.inf  # lands in chunk 1 (16 rows per chunk)
+    w.add(data)
+    w.finalize({})
+    strict = ChunkStore(tmp_path)
+    with pytest.raises(ChunkCorruptionError, match="non-finite"):
+        strict.load_chunk(1)
+    strict.load_chunk(0)  # neighbors unaffected
+    lenient = ChunkStore(tmp_path, quarantine_corrupt=True)
+    out = list(lenient.chunk_reader([0, 1, 2, 3]))
+    assert [c is None for c in out] == [False, True, False, False]
+    assert set(load_quarantine(tmp_path)) == {1}
+    # opt-out for forensic reads
+    forensic = ChunkStore(tmp_path, verify_finite=False)
+    assert not np.isfinite(forensic.load_chunk(1)).all()
+
+
+def test_ingest_decode_nan_injection_quarantined_positionally(tmp_path):
+    """``ingest.decode`` corrupt-mode matrix entry (mode=nan): an injected
+    non-finite payload on a stream decode fails the finite gate, the
+    chunk quarantines through the durable ledger, and delivery stays
+    positional — neighbors arrive bit-identical to the serial reader."""
+    from sparse_coding_tpu.data.ingest import chunk_stream
+    from sparse_coding_tpu.data.ledger import load_quarantine
+
+    folder = tmp_path / "flat"
+    _flat_chunks(folder)
+    serial = list(ChunkStore(folder).chunk_reader(range(4)))
+    store = ChunkStore(folder, quarantine_corrupt=True)
+    with inject(site="ingest.decode", nth=2, mode="nan") as plan:
+        got = list(chunk_stream(store, range(4), streams=2))
+    assert plan.fired_count("ingest.decode") == 1
+    assert [c is None for c in got] == [False, True, False, False]
+    for a, b in zip([got[0], got[2], got[3]],
+                    [serial[0], serial[2], serial[3]]):
+        np.testing.assert_array_equal(a, b)
+    assert set(load_quarantine(folder)) == {1}
+
+
+def _drill_build(dim=16, l1s=(1e-3, 2e-3, 4e-3)):
+    from sparse_coding_tpu.train.experiments import dense_l1_range_experiment
+
+    return lambda c, m: dense_l1_range_experiment(c, m, l1_range=list(l1s),
+                                                  activation_dim=dim)
+
+
+def test_guardian_divergence_drill_member_frozen_others_bitwise(tmp_path):
+    """ISSUE 10 acceptance drill: inject NaN into member 1 at step 3
+    (``sweep.anomaly`` member mode) → member 1 is frozen in-graph and
+    ledgered in guardian.json, its artifact is tagged diverged=True,
+    ALL other members' final dictionaries are bitwise identical to an
+    uninjected run — and ONE merged obs.report shows the whole
+    incident."""
+    import json as json_mod
+
+    import sparse_coding_tpu.train.sweep as sweep_mod
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.obs.report import build_report
+
+    build = _drill_build()
+    full = sweep_mod.sweep(build, _sweep_cfg(tmp_path, "full"), log_every=50)
+
+    run_dir = tmp_path / "run"
+    prev_sink = obs.configure_sink(
+        obs.EventSink(run_dir / "obs" / "drill.jsonl"))
+    prev_registry = obs.set_registry(obs.Registry())
+    try:
+        with inject(site="sweep.anomaly", nth=3, error="RuntimeError",
+                    message="member=1") as plan:
+            injected = sweep_mod.sweep(build, _sweep_cfg(tmp_path, "inj"),
+                                       log_every=50)
+        obs.flush_metrics()
+    finally:
+        obs.set_registry(prev_registry)
+        obs.configure_sink(prev_sink)
+    assert plan.fired_count("sweep.anomaly") == 1
+
+    tags = []
+    for i, ((ld_f, _), (ld_i, hp_i)) in enumerate(
+            zip(full["dense_l1_range"], injected["dense_l1_range"])):
+        tags.append(bool(hp_i.get("diverged")))
+        if i == 1:
+            continue  # the victim froze at its last finite params
+        for k in ld_f.__dict__:
+            a, b = getattr(ld_f, k), getattr(ld_i, k)
+            if hasattr(a, "shape"):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                              err_msg=f"member {i}/{k}")
+    assert tags == [False, True, False]
+
+    ledger = json_mod.loads((tmp_path / "inj" / "guardian.json").read_text())
+    assert list(ledger["members"]) == ["dense_l1_range/dense_l1_range/1"]
+    entry = ledger["members"]["dense_l1_range/dense_l1_range/1"]
+    assert entry["reason"] == "non-finite loss/grads on finite inputs"
+    assert ledger["rollbacks"] == {}  # live members never paid
+
+    guard = build_report(run_dir)["guardian"]
+    assert guard["members_quarantined"] == 1
+    assert guard["rollbacks"] == 0 and guard["halts"] == 0
+    assert guard["checks"] >= 1
+
+    # artifact hygiene end to end: the tagged member filters out on load
+    from sparse_coding_tpu.utils.artifacts import load_learned_dicts
+
+    art = tmp_path / "inj" / "_3" / "dense_l1_range_learned_dicts.pkl"
+    assert len(load_learned_dicts(art)) == 3
+    kept = load_learned_dicts(art, skip_diverged=True)
+    assert len(kept) == 2
+    assert all(not hp.get("diverged") for _, hp in kept)
+
+
+def test_guardian_input_nan_rolls_back_to_last_good_and_quarantines_chunk(
+        tmp_path):
+    """The poisoned-data rung: a NaN batch (``sweep.anomaly`` mode=nan)
+    mid-sweep triggers ONE rollback to the retained last-good checkpoint
+    set with the offending chunk quarantined through the PR-8 ledger —
+    and the final dictionaries are bitwise identical to a sweep over a
+    store where that chunk was ALWAYS quarantined."""
+    import json as json_mod
+    import shutil
+
+    import sparse_coding_tpu.train.sweep as sweep_mod
+    from sparse_coding_tpu.data.ledger import load_quarantine, record_quarantine
+
+    build = _drill_build(l1s=(1e-3, 2e-3))
+    # 5 batches/chunk (750 rows, batch 128): nth=7 lands in chunk pos 1
+    with inject(site="sweep.anomaly", nth=7, mode="nan") as plan:
+        injected = sweep_mod.sweep(build, _sweep_cfg(tmp_path, "inj"),
+                                   log_every=50)
+    assert plan.fired_count("sweep.anomaly") == 1
+    ledger = json_mod.loads((tmp_path / "inj" / "guardian.json").read_text())
+    assert ledger["rollbacks"] == {"chunk[1]": {
+        "chunk": list(load_quarantine(tmp_path / "chunks"))[0],
+        "count": 1, "incident": "poisoned-data"}}
+    assert ledger["members"] == {}  # an input incident blames no member
+
+    bad_chunk = list(load_quarantine(tmp_path / "chunks"))[0]
+    gold_store = tmp_path / "chunks_gold"
+    shutil.copytree(tmp_path / "chunks", gold_store)
+    (gold_store / "quarantine.json").unlink()
+    record_quarantine(gold_store, bad_chunk, "pre-quarantined golden",
+                      f"{bad_chunk}.npy")
+    golden = sweep_mod.sweep(build,
+                             _sweep_cfg(tmp_path, "gold",
+                                        dataset_folder=str(gold_store)),
+                             log_every=50)
+    for (ld_g, _), (ld_i, hp_i) in zip(golden["dense_l1_range"],
+                                       injected["dense_l1_range"]):
+        assert not hp_i.get("diverged")
+        for k in ld_g.__dict__:
+            a, b = getattr(ld_g, k), getattr(ld_i, k)
+            if hasattr(a, "shape"):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                              err_msg=k)
+
+
+def test_guardian_persistent_poison_halts_typed_poisoned_data(tmp_path):
+    """Ladder exhaustion, data flavor: EVERY batch poisoned (count=0 nan
+    plan) burns the rollback budget chunk by chunk and halts with the
+    typed poisoned-data diagnosis — never an unbounded rollback loop,
+    never silent NaN artifacts."""
+    import sparse_coding_tpu.train.sweep as sweep_mod
+    from sparse_coding_tpu.resilience.errors import DivergenceHaltError
+
+    build = _drill_build(l1s=(1e-3, 2e-3))
+    cfg = _sweep_cfg(tmp_path, "halt", guardian_rollback_budget=2)
+    with inject(site="sweep.anomaly", nth=1, count=0, mode="nan"):
+        with pytest.raises(DivergenceHaltError) as exc:
+            sweep_mod.sweep(build, cfg, log_every=50)
+    assert exc.value.diagnosis == "poisoned-data"
+
+
+def test_guardian_fraction_breach_rolls_back_then_halts_hyperparameter(
+        tmp_path):
+    """Ladder exhaustion, hyperparameter flavor: half the (2-member) grid
+    diverging crosses the member-fraction threshold → one rollback (the
+    member stays ledger-frozen across the restore), and the re-breach at
+    the same site halts with the hyperparameter diagnosis."""
+    import json as json_mod
+
+    import sparse_coding_tpu.train.sweep as sweep_mod
+    from sparse_coding_tpu.resilience.errors import DivergenceHaltError
+
+    build = _drill_build(l1s=(1e-3, 2e-3))
+    cfg = _sweep_cfg(tmp_path, "fhalt")
+    with inject(site="sweep.anomaly", nth=3, error="RuntimeError",
+                message="member=0"):
+        with pytest.raises(DivergenceHaltError) as exc:
+            sweep_mod.sweep(build, cfg, log_every=50)
+    assert exc.value.diagnosis == "hyperparameter"
+    ledger = json_mod.loads(
+        (tmp_path / "fhalt" / "guardian.json").read_text())
+    assert ledger["halt"]["diagnosis"] == "hyperparameter"
+    assert "dense_l1_range/dense_l1_range/0" in ledger["members"]
+    assert sum(rb["count"] for rb in ledger["rollbacks"].values()) == 1
+
+
+def test_fault_mode_nan_accepts_bfloat16_payload():
+    """The bf16 ingest path (cfg.train_dtype='bfloat16') must be
+    drillable too: ml_dtypes bfloat16 is not an np.floating subdtype but
+    holds NaN — mode=nan poisons it instead of refusing."""
+    import jax.numpy as jnp
+
+    payload = np.asarray([1.0, 2.0, 3.0, 4.0]).astype(jnp.bfloat16)
+    with inject(site="chunk.read", nth=1, mode="nan", seed=2):
+        out = faults.fault_point("chunk.read", payload)
+    assert out.dtype == payload.dtype
+    assert np.isnan(np.asarray(out, np.float32)[2])
+    assert np.isfinite(np.asarray(payload, np.float32)).all()
+
+
+def test_pre_guardian_checkpoint_restores_with_all_members_live(rng,
+                                                                tmp_path):
+    """Back-compat: a checkpoint written BEFORE the sentinel (no 'live'
+    leaf in the payload) restores cleanly with every member defaulted
+    live — never misdiagnosed as corruption — while a genuinely damaged
+    payload still raises typed."""
+    import jax as jax_mod
+    from flax import serialization
+
+    from sparse_coding_tpu.resilience.atomic import (
+        atomic_write_bytes,
+        atomic_write_text,
+    )
+    from sparse_coding_tpu.resilience.manifest import bytes_sha256
+
+    ens = _mk_ens(rng)
+    ens.step_batch(jax.random.normal(rng, (64, 16)))
+    state = jax_mod.device_get(ens.state)
+    legacy_tree = {"params": state.params, "buffers": state.buffers,
+                   "opt_state": state.opt_state, "lrs": state.lrs,
+                   "step": state.step}  # the pre-guardian format
+    payload = serialization.to_bytes(legacy_tree)
+    path = tmp_path / "legacy.msgpack"
+    atomic_write_bytes(path, payload)
+    atomic_write_text(path.with_suffix(path.suffix + ".meta.json"),
+                      json.dumps({"payload_sha256": bytes_sha256(payload),
+                                  "chunks_done": 1}))
+    fresh = _mk_ens(rng)
+    meta = restore_ensemble(fresh, path)
+    assert meta["chunks_done"] == 1
+    assert list(fresh.live_mask()) == [True, True]
+    np.testing.assert_array_equal(
+        np.asarray(jax_mod.device_get(fresh.state.params["encoder"])),
+        np.asarray(state.params["encoder"]))
+    # damage still reads as damage, not as a legacy format
+    blob = bytearray(path.read_bytes())
+    blob[len(blob) // 2] ^= 0x01
+    path.write_bytes(bytes(blob))
+    with pytest.raises(CheckpointCorruptionError):
+        restore_ensemble(_mk_ens(rng), path)
+
+
+def test_guardian_fresh_run_drops_stale_ledger(tmp_path):
+    """A NON-resume sweep into a reused out_dir must not inherit the
+    previous run's quarantines/rollback budget: the drill run writes a
+    ledger, a fresh run over the same folder starts clean and tags
+    nothing."""
+    import json as json_mod
+
+    import sparse_coding_tpu.train.sweep as sweep_mod
+
+    build = _drill_build()  # 3 members: one quarantine stays sub-fraction
+    cfg = _sweep_cfg(tmp_path, "reuse")
+    with inject(site="sweep.anomaly", nth=3, error="RuntimeError",
+                message="member=1"):
+        first = sweep_mod.sweep(build, cfg, log_every=50)
+    assert any(hp.get("diverged") for _, hp in first["dense_l1_range"])
+    assert (tmp_path / "reuse" / "guardian.json").exists()
+    second = sweep_mod.sweep(build, cfg, log_every=50)  # no injection
+    assert not any(hp.get("diverged") for _, hp in second["dense_l1_range"])
+    assert not (tmp_path / "reuse" / "guardian.json").exists()
+
+
+def test_nonfinite_pt_chunk_quarantined_by_finite_guard(tmp_path):
+    """Reference-interop (.pt) chunks have NO digests — the finite guard
+    is the only corruption detection that path can have, so it must fire
+    there too."""
+    torch = pytest.importorskip("torch")
+
+    data = np.random.default_rng(0).normal(size=(16, 8)).astype(np.float32)
+    data[5, 1] = np.nan
+    torch.save(torch.from_numpy(data), tmp_path / "0.pt")
+    torch.save(torch.from_numpy(np.ones_like(data)), tmp_path / "1.pt")
+    store = ChunkStore(tmp_path)
+    with pytest.raises(ChunkCorruptionError, match="non-finite"):
+        store.load_chunk(0)
+    store.load_chunk(1)
+    lenient = ChunkStore(tmp_path, quarantine_corrupt=True)
+    out = list(lenient.chunk_reader([0, 1]))
+    assert out[0] is None and out[1] is not None

@@ -195,11 +195,20 @@ def test_streaming_eval_sweep_matches_separate_passes(rng, tmp_path):
 def test_moments_undersized_input_fails_loudly(rng):
     """A dataset smaller than batch_size consumes zero full batches; the
     moment sweep must raise instead of silently returning NaN statistics
-    (ADVICE r5 #4)."""
+    (ADVICE r5 #4) — TYPED (UndersizedInputError, still a ValueError for
+    old callers), the same fail-loudly-on-silent-NaN contract the
+    training guardian enforces (ISSUE 10 / docs/ARCHITECTURE.md §16)."""
     from sparse_coding_tpu.models.learned_dict import Identity
+    from sparse_coding_tpu.resilience.errors import UndersizedInputError
 
     ident = Identity.create(8)
     x = jnp.asarray(np.random.default_rng(0).standard_normal((10, 8)),
                     jnp.float32)
-    with pytest.raises(ValueError, match="no full batch"):
+    with pytest.raises(UndersizedInputError, match="no full batch"):
         calc_moments_streaming(ident, x, batch_size=100)
+    with pytest.raises(ValueError):  # back-compat: still a ValueError
+        calc_moments_streaming(ident, x, batch_size=100)
+    from sparse_coding_tpu.metrics.core import streaming_eval_sweep
+
+    with pytest.raises(UndersizedInputError):
+        streaming_eval_sweep(ident, x, batch_size=100)

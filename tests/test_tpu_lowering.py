@@ -347,3 +347,36 @@ def test_obs_instrumentation_is_zero_overhead_in_hlo(rng, tmp_path):
     assert instrumented[1] == baseline[1]  # ensemble train step
     # the probes were live while the identical HLO was produced
     assert obs.counter("jax.retraces").value > retraces_before
+
+
+def test_sentinel_guarded_step_lowers_with_no_added_host_transfer(rng):
+    """ISSUE 10 AOT gate: the anomaly-sentinel-guarded step (per-member
+    finite flags, grad/update norms, live-mask select — the DEFAULT step)
+    lowers for TPU, and its lowered HLO gains NO host transfer over the
+    sentinel-off program: detection is entirely device-side, folded into
+    the aux the step already returns."""
+    batch = jnp.zeros((128, 32))
+    texts = {}
+    for sentinel in (True, False):
+        members = [FunctionalTiedSAE.init(k, 32, 64, l1_alpha=1e-3)
+                   for k in jax.random.split(rng, 3)]
+        ens = Ensemble(members, FunctionalTiedSAE, donate=False,
+                       sentinel=sentinel)
+        texts[sentinel] = jax.jit(
+            lambda s, b, e=ens: e._standard_step(s, b)).trace(
+            ens.state, batch).lower(
+            lowering_platforms=("tpu",)).as_text()
+    assert texts[True] != texts[False]  # the sentinel is really in there
+    for marker in ("infeed", "outfeed", "send-start", "recv-start",
+                   "SendToHost", "RecvFromHost", "host_compute"):
+        assert texts[True].count(marker) == texts[False].count(marker) == 0, \
+            marker
+
+    # untied family too (update-norm guard over a two-matrix tree)
+    members = [FunctionalSAE.init(k, 32, 64, l1_alpha=1e-3)
+               for k in jax.random.split(rng, 2)]
+    ens = Ensemble(members, FunctionalSAE, donate=False)
+    text = jax.jit(lambda s, b: ens._standard_step(s, b)).trace(
+        ens.state, batch).lower(lowering_platforms=("tpu",)).as_text()
+    for marker in ("infeed", "outfeed", "SendToHost", "RecvFromHost"):
+        assert marker not in text
