@@ -115,13 +115,18 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
                    scan_chunk=None, batch_dtype=None,
                    batch_tile=None, fused_compute_dtype=None,
                    sig="tied_sae", fused_path=None,
-                   fused_moments_dtype=None) -> WindowedRate:
+                   fused_moments_dtype=None, feat_tile=None) -> WindowedRate:
     """Shared ensemble-throughput measurement (bench_suite.py and tune.py
     reuse it with their own scales; batch_tile forces the fused kernel's
-    batch tile, None = auto-pick; fused_compute_dtype="bfloat16" runs the
+    batch tile, None = auto-pick; feat_tile pins the feature-axis-TILED
+    kernels' feature tile (and thereby the tiled paths);
+    fused_compute_dtype="bfloat16" runs the
     kernel's dots on the MXU bf16 path — matmul_precision does not reach
     Pallas dots; sig="sae" times the untied FunctionalSAE family instead;
-    fused_path forces the tied kernel choice: "two_stage" | "train_step")."""
+    fused_path forces the kernel choice: "two_stage" | "train_step" |
+    "two_stage_tiled" | "train_step_tiled". The returned rate carries the
+    RESOLVED path as ``.fused_path`` so ratio sweeps can record which
+    program actually ran."""
     import contextlib
 
     from sparse_coding_tpu import obs
@@ -150,6 +155,7 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
                    for k, l1 in zip(keys, l1s)]
         ens = Ensemble(members, sig_cls, lr=1e-3, use_fused=use_fused,
                        fused_batch_tile=batch_tile,
+                       fused_feat_tile=feat_tile,
                        fused_compute_dtype=fused_compute_dtype or "float32",
                        fused_path=fused_path,
                        fused_moments_dtype=fused_moments_dtype or "float32")
@@ -195,7 +201,9 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
         if ens.fused_path is not None:
             print(f"  (fused kernel path: {ens.fused_path})", file=sys.stderr)
         snap = timer.publish(prefix="bench")
-        return WindowedRate(list(snap["window_s"]), acts_per_window)
+        rate = WindowedRate(list(snap["window_s"]), acts_per_window)
+        rate.fused_path = ens.fused_path  # resolved kernel path label
+        return rate
 
 
 def _emit(acts_per_sec_per_chip: float, *, backend: str,
@@ -342,7 +350,7 @@ def _load_tuned_variant(path: str | None = None) -> dict | None:
         return None
     best = data.get("best") or {}
     keys = ("use_fused", "matmul_precision", "batch_dtype", "scan_chunk",
-            "batch_tile", "fused_compute_dtype", "fused_path",
+            "batch_tile", "feat_tile", "fused_compute_dtype", "fused_path",
             "fused_moments_dtype")
     variant = {k: v for k, v in best.items() if k in keys and v is not None}
     if variant.get("scan_chunk") == SCAN_CHUNK:
@@ -529,6 +537,12 @@ def main() -> None:
         # 10-step window): their ratio is pool-state- and dispatch-invariant
         variants = [{"use_fused": True, "fused_path": "two_stage"},
                     {"use_fused": True, "fused_path": "train_step"},
+                    # the feature-axis-tiled pair (r11): at the canonical
+                    # ratio-4 shape these are the A/B against the untiled
+                    # kernels; at ratio 16+ they are the ONLY fused paths
+                    # (the ensemble_ratio suite measures those shapes)
+                    {"use_fused": True, "fused_path": "two_stage_tiled"},
+                    {"use_fused": True, "fused_path": "train_step_tiled"},
                     {"use_fused": False, "scan_chunk": 50},
                     {"use_fused": True, "fused_path": "train_step",
                      "fused_compute_dtype": "bfloat16", "scan_chunk": 50},

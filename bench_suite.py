@@ -6,7 +6,9 @@ real hardware starts from a complete profile:
 
     python bench_suite.py [--quick]
 
-Suites: ensemble train (autodiff + fused + bf16-precision variants), big-SAE
+Suites: ensemble train (autodiff + fused + bf16-precision variants), the
+canonical-dict-ratio sweep (ensemble_ratio: resolved kernel path +
+fused-vs-autodiff A/B at ratios 4–32 — ISSUE 11), big-SAE
 train (single giant dict), activation harvesting (tokens/s through the LM
 with taps), sequence-parallel long-context forward (over whatever mesh the
 host offers), chunk-store IO, and the guardian divergence soak (sentinel
@@ -85,6 +87,59 @@ def bench_ensemble(quick: bool) -> None:
                   n_members=n_members, d=d, n_dict=d * ratio, batch=batch)
         except Exception as e:
             print(f"ensemble variant {name} failed: {e!r}", file=sys.stderr)
+
+
+def bench_ensemble_ratio(quick: bool) -> None:
+    """Canonical-dict-ratio sweep (ISSUE 11): the paper's headline shapes
+    live at ratios 16–96 (reference standard_metrics.py:745,
+    big_sweep_experiments.py:543) — exactly where the untiled fused
+    kernels used to fall back to autodiff silently. Per ratio this suite
+    records WHICH kernel path auto mode resolved (plus the roofline plan
+    at the canonical TPU scale) and the fused-vs-autodiff acts/s A/B.
+    On a tunnel-down host it degrades per the bench conventions: a
+    reduced-scale autodiff CPU measurement labeled backend "cpu", with
+    the planned TPU path still recorded from the roofline model (pure
+    host arithmetic), so the admission decision is auditable per round
+    even without the chip."""
+    from bench import _time_ensemble
+    from sparse_coding_tpu.ops import roofline
+
+    on_tpu = jax.default_backend() == "tpu"
+    d = 256 if quick else 512
+    ratios = (2, 4) if quick else (4, 8, 16, 32)
+    # canonical TPU scale for the PLANNED-path record (what a sweep on
+    # the chip would resolve); the measured scale shrinks off-chip
+    plan_members, plan_batch = 8, 2048
+    if on_tpu:
+        n_members, batch, steps, scan = (4, 512, 6, 2) if quick \
+            else (8, 2048, 40, 10)
+    else:
+        n_members, batch, steps, scan = (2, 256, 4, 2)
+    for ratio in ratios:
+        n_dict = d * ratio
+        plan = roofline.choose_plan(
+            n_members=plan_members, batch=plan_batch, n_feats=n_dict, d=d,
+            family="tied")
+        planned = plan.path or "autodiff"
+        variants = [("autodiff", dict(use_fused=False))]
+        if on_tpu:
+            variants.insert(0, ("fused_auto", dict(use_fused="auto")))
+        for name, kwargs in variants:
+            try:
+                rate = _time_ensemble(d_act=d, n_dict=n_dict,
+                                      n_members=n_members, batch=batch,
+                                      bench_steps=steps, scan_chunk=scan,
+                                      **kwargs)
+                _emit("ensemble_ratio", rate, "activations/s", variant=name,
+                      ratio=ratio, d=d, n_dict=n_dict,
+                      n_members=n_members, batch=batch,
+                      resolved_path=getattr(rate, "fused_path", None)
+                      or "autodiff",
+                      planned_tpu_path=planned,
+                      planned_tiles=[plan.batch_tile, plan.feat_tile])
+            except Exception as e:
+                print(f"ensemble_ratio ratio={ratio} variant {name} "
+                      f"failed: {e!r}", file=sys.stderr)
 
 
 def bench_big_sae(quick: bool) -> None:
@@ -600,7 +655,8 @@ def main() -> None:
     args = parser.parse_args()
     # seq_parallel runs LAST: its hang watchdog exits the process, and every
     # earlier suite's JSON line is flushed by then
-    for suite in (bench_ensemble, bench_big_sae, bench_harvest,
+    for suite in (bench_ensemble, bench_ensemble_ratio, bench_big_sae,
+                  bench_harvest,
                   bench_chunk_io, bench_ingest_soak, bench_streaming_eval,
                   bench_guardian_soak, bench_gateway, bench_seq_parallel):
         try:

@@ -195,6 +195,24 @@ class EnsembleArgs(BaseArgs):
     # rebuilds the exact pre-sentinel step programs — the bench A/B knob
     # (guardian_soak measures the sentinel's step overhead against it)
     sentinel: bool = True
+    # fused-kernel engine knobs (ensemble.py / ops/roofline.py — ISSUE 11).
+    # use_fused: "auto" (roofline admission picks the path per shape,
+    # autodiff only when nothing admits), "on" (fail fast if ineligible),
+    # "off" (pure XLA autodiff)
+    use_fused: str = "auto"
+    # pin the kernel path (None = roofline auto): "two_stage" |
+    # "train_step" | "two_stage_tiled" | "train_step_tiled" — the
+    # bench/tune/fault-drill A/B knob
+    fused_path: Optional[str] = None
+    # explicit kernel tiles (None = admission picks). fused_feat_tile pins
+    # resolution to the feature-axis-TILED kernels (it has no meaning for
+    # the untiled ones)
+    fused_batch_tile: Optional[int] = None
+    fused_feat_tile: Optional[int] = None
+    # run the Pallas kernels in interpret mode (CPU tests/drills only —
+    # the fault matrix exercises quarantine semantics on the tiled path
+    # with this)
+    fused_interpret: bool = False
 
 
 @dataclass

@@ -40,6 +40,20 @@ from sparse_coding_tpu.utils.trees import stack_trees, tree_index
 Array = jax.Array
 Pytree = Any
 
+# version portability for the container's baked toolchain: older optax
+# names safe_increment safe_int32_increment; older jax exposes shard_map
+# under jax.experimental with check_rep instead of check_vma
+_safe_increment = getattr(optax, "safe_increment",
+                          getattr(optax, "safe_int32_increment", None))
+
+# every kernel path _resolve_step can land on (ops/roofline.py is the
+# single source; re-exported here because the path label is engine API —
+# bench/tune variants, obs counters, and the parity-coverage lint key on it)
+from sparse_coding_tpu.ops.roofline import KERNEL_PATHS  # noqa: E402
+
+
+from sparse_coding_tpu.parallel.mesh import compat_shard_map as _shard_map  # noqa: E402
+
 _STATIC_TYPES = (int, float, bool, str, type(None))
 
 StaticBuffers = tuple[tuple[str, Any], ...]  # hashable, jit-static
@@ -153,18 +167,25 @@ def _sentinel_finite(loss: Array, *norms: Array) -> Array:
 
 
 def _apply_fused_updates(optimizer, losses, grads, activity,
-                         params, opt_state, lrs, live=None):
+                         params, opt_state, lrs, live=None,
+                         kernel_gnorm=None):
     """Shared tail of the two-stage fused steps: vmapped per-member Adam
     update from kernel-produced grads + shared AuxData assembly. With
     ``live`` (the state's [N] live-mask) the in-graph anomaly sentinel is
     woven in: per-member grad/update global norms, a step-finite flag,
     and a member-select that freezes quarantined or non-finite members —
-    all device-side, nothing synced to the host (§16)."""
+    all device-side, nothing synced to the host (§16). ``kernel_gnorm``
+    ([N], tiled producers): the grad norm was already folded into the
+    kernel's backward epilogue, so the XLA ``optax.global_norm`` pass
+    over the [N, n, d] grads is skipped — divergence safety stays free
+    at high MFU (ISSUE 11); the reported grad_norm is then the
+    KERNEL-grad norm (pre normalization-VJP — see fused_sae_tiled)."""
 
     sentinel = live is not None
+    need_gn = sentinel and kernel_gnorm is None
 
     def member_update(g, opt_state, params, lr):
-        norms = (optax.global_norm(g),) if sentinel else ()
+        norms = (optax.global_norm(g),) if need_gn else ()
         updates, opt_state = optimizer.update(g, opt_state, params)
         updates = jax.tree.map(lambda u: -lr * u, updates)
         if sentinel:
@@ -176,7 +197,10 @@ def _apply_fused_updates(optimizer, losses, grads, activity,
     aux = _fused_aux(losses, activity)
     if not sentinel:  # the pre-guardian step, bit for bit
         return new_params, new_opt, aux
-    gn, un = norms
+    if need_gn:
+        gn, un = norms
+    else:
+        gn, un = kernel_gnorm, norms[0]
     finite = _sentinel_finite(aux.losses["loss"], gn, un)
     ok = live & finite
     return (_select_members(ok, new_params, params),
@@ -186,20 +210,22 @@ def _apply_fused_updates(optimizer, losses, grads, activity,
 
 def _tied_producer(batch_tile, interpret, compute_dtype):
     """(params, buffers, batch, total_batch, psum_axis) -> (losses, grads,
-    activity) via the tied kernel (ops/fused_sae.fused_tied_sae_loss_and_grads).
-    Serves both the plain tied family and the masked family
-    (FunctionalMaskedTiedSAE): when the bucket's buffers carry a coef_mask it
-    rides into the kernel as one extra [N, n] operand."""
+    activity, gnorm) via the tied kernel
+    (ops/fused_sae.fused_tied_sae_loss_and_grads; gnorm is None — the
+    untiled kernels leave the sentinel norms to XLA). Serves both the plain
+    tied family and the masked family (FunctionalMaskedTiedSAE): when the
+    bucket's buffers carry a coef_mask it rides into the kernel as one
+    extra [N, n] operand."""
     from sparse_coding_tpu.ops.fused_sae import fused_tied_sae_loss_and_grads
 
     def producer(params, buffers, batch, total_batch=None, psum_axis=None):
-        return fused_tied_sae_loss_and_grads(
+        return (*fused_tied_sae_loss_and_grads(
             {"encoder": params["encoder"],
              "encoder_bias": params["encoder_bias"]},
             buffers["l1_alpha"], batch, batch_tile=batch_tile,
             interpret=interpret, total_batch=total_batch,
             compute_dtype=compute_dtype, psum_axis=psum_axis,
-            coef_mask=buffers.get("coef_mask"))
+            coef_mask=buffers.get("coef_mask")), None)
 
     return producer
 
@@ -212,9 +238,45 @@ def _untied_producer(batch_tile, interpret, compute_dtype):
     from sparse_coding_tpu.ops.fused_sae import fused_untied_sae_loss_and_grads
 
     def producer(params, buffers, batch, total_batch=None, psum_axis=None):
-        return fused_untied_sae_loss_and_grads(
+        return (*fused_untied_sae_loss_and_grads(
             params, buffers["l1_alpha"], buffers["bias_decay"], batch,
             batch_tile=batch_tile, interpret=interpret,
+            total_batch=total_batch, compute_dtype=compute_dtype,
+            psum_axis=psum_axis), None)
+
+    return producer
+
+
+def _tied_tiled_producer(batch_tile, feat_tile, interpret, compute_dtype):
+    """Feature-axis-tiled tied/masked producer
+    (ops/fused_sae_tiled.fused_tied_sae_tiled_loss_and_grads) — the path
+    the canonical ratio-16/96 sweep shapes resolve to. Returns the
+    kernel-epilogue grad norm as the 4th element (None under shard_map)."""
+    from sparse_coding_tpu.ops.fused_sae_tiled import (
+        fused_tied_sae_tiled_loss_and_grads)
+
+    def producer(params, buffers, batch, total_batch=None, psum_axis=None):
+        return fused_tied_sae_tiled_loss_and_grads(
+            {"encoder": params["encoder"],
+             "encoder_bias": params["encoder_bias"]},
+            buffers["l1_alpha"], batch, batch_tile=batch_tile,
+            feat_tile=feat_tile, interpret=interpret,
+            total_batch=total_batch, compute_dtype=compute_dtype,
+            psum_axis=psum_axis, coef_mask=buffers.get("coef_mask"))
+
+    return producer
+
+
+def _untied_tiled_producer(batch_tile, feat_tile, interpret, compute_dtype):
+    """Feature-axis-tiled untied producer
+    (ops/fused_sae_tiled.fused_untied_sae_tiled_loss_and_grads)."""
+    from sparse_coding_tpu.ops.fused_sae_tiled import (
+        fused_untied_sae_tiled_loss_and_grads)
+
+    def producer(params, buffers, batch, total_batch=None, psum_axis=None):
+        return fused_untied_sae_tiled_loss_and_grads(
+            params, buffers["l1_alpha"], buffers["bias_decay"], batch,
+            batch_tile=batch_tile, feat_tile=feat_tile, interpret=interpret,
             total_batch=total_batch, compute_dtype=compute_dtype,
             psum_axis=psum_axis)
 
@@ -242,11 +304,13 @@ def make_fused_step(
     of vmap(value_and_grad); the optimizer update stays vmapped optax."""
 
     def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
-        losses, grads, activity = producer(state.params, state.buffers, batch)
+        losses, grads, activity, gnorm = producer(state.params,
+                                                  state.buffers, batch)
         params, opt_state, aux = _apply_fused_updates(
             optimizer, losses, grads, activity,
             state.params, state.opt_state, state.lrs,
-            live=state.live if sentinel else None)
+            live=state.live if sentinel else None,
+            kernel_gnorm=gnorm if sentinel else None)
         aux = _stamp_inputs_finite(aux, batch, sentinel)
         new_state = state.replace(params=params, opt_state=opt_state,
                                   step=state.step + 1)
@@ -272,28 +336,32 @@ def make_fused_step_sharded(
     it) yields exact full-batch losses/grads, then the optimizer update runs
     locally per member shard. HBM/ICI traffic per step: x once into VMEM,
     one [N_local, n, d] grad reduce-scatter-shaped psum riding ICI."""
-    from jax import shard_map
 
     def local_step(params, buffers, opt_state, lrs, live, local_batch,
                    total_batch):
-        losses, grads, activity = producer(params, buffers, local_batch,
-                                           total_batch=total_batch,
-                                           psum_axis="data")
+        # tiled producers return gnorm=None on sharded calls by
+        # construction (the kernel epilogue's per-shard partial norms
+        # don't psum into the true norm), so the sentinel here always
+        # takes the XLA norm over the post-psum grads
+        losses, grads, activity, gnorm = producer(params, buffers,
+                                                  local_batch,
+                                                  total_batch=total_batch,
+                                                  psum_axis="data")
         # the post-psum losses/grads are identical on every data shard, so
         # the sentinel's finite flags — and therefore the member-select —
         # agree across the whole mesh by construction
         return _apply_fused_updates(optimizer, losses, grads, activity,
                                     params, opt_state, lrs,
-                                    live=live if sentinel else None)
+                                    live=live if sentinel else None,
+                                    kernel_gnorm=gnorm if sentinel else None)
 
     def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
-        sharded = shard_map(
+        sharded = _shard_map(
             functools.partial(local_step, total_batch=batch.shape[0]),
-            mesh=mesh,
+            mesh,
             in_specs=(P("model"), P("model"), P("model"), P("model"),
                       P("model"), P("data")),
-            out_specs=(P("model"), P("model"), P("model")),
-            check_vma=False)
+            out_specs=(P("model"), P("model"), P("model")))
         params, opt_state, aux = sharded(
             state.params, state.buffers, state.opt_state, state.lrs,
             state.live, batch)
@@ -306,22 +374,40 @@ def make_fused_step_sharded(
 
 
 def _guard_fullfused(state: EnsembleState, params, opt_state, aux, batch,
-                     sentinel: bool):
-    """Sentinel tail shared by both whole-step kernel paths: grads never
-    leave the kernel, so the per-member update-delta norm (any NaN/Inf in
-    the kernel's new params propagates into it) stands in for the grad
-    norm, and the member-select freezes quarantined/non-finite members.
-    One elementwise pass over the [N, n, d] tensors the kernel already
-    wrote — no extra host traffic, no second isfinite scan."""
+                     sentinel: bool, un=None, gn=None):
+    """Sentinel tail shared by the whole-step kernel paths. Default: grads
+    never leave the kernel, so the per-member update-delta norm (any
+    NaN/Inf in the kernel's new params propagates into it) stands in for
+    the grad norm, and the member-select freezes quarantined/non-finite
+    members — one elementwise pass over the [N, n, d] tensors the kernel
+    already wrote. Paths whose epilogue kernels fold the norms in
+    (ISSUE 11: the feature-tiled epilogues, the untied Adam/VJP kernel)
+    pass them as ``un``/``gn`` and skip even that pass."""
     if not sentinel or state.live is None:
         return params, opt_state, aux
-    un = _member_delta_norm(params, state.params)
-    finite = _sentinel_finite(aux.losses["loss"], un)
+    if un is None:
+        un = _member_delta_norm(params, state.params)
+    norms = (un,) if gn is None else (gn, un)
+    finite = _sentinel_finite(aux.losses["loss"], *norms)
     ok = state.live & finite
     return (_select_members(ok, params, state.params),
             _select_members(ok, opt_state, state.opt_state),
-            _stamp_inputs_finite(aux.replace(finite=finite, grad_norm=un),
-                                 batch, True))
+            _stamp_inputs_finite(
+                aux.replace(finite=finite,
+                            grad_norm=un if gn is None else gn),
+                batch, True))
+
+
+def _bias_adam_update(bias, db, opt, lrs, bc1, bc2, b1, b2, eps):
+    """Exact optax-Adam on the [N, n] bias in XLA (negligible traffic next
+    to the matrices the kernels carry) — the SINGLE home of this formula
+    for every whole-step builder below, so the tiled and untiled paths can
+    never diverge optimizer-wise. Returns (new_bias, mu_b, nu_b)."""
+    mu_b = b1 * opt.mu["encoder_bias"] + (1.0 - b1) * db
+    nu_b = b2 * opt.nu["encoder_bias"] + (1.0 - b2) * db * db
+    bias2 = bias - lrs[:, None] * (mu_b / bc1[:, None]) / (
+        jnp.sqrt(nu_b / bc2[:, None]) + eps)
+    return bias2, mu_b, nu_b
 
 
 def make_fullfused_tied_step(
@@ -352,7 +438,7 @@ def make_fullfused_tied_step(
             picker=functools.partial(
                 pick_train_step_tile,
                 moments_itemsize=opt.mu["encoder"].dtype.itemsize))
-        count_inc = optax.safe_increment(opt.count)
+        count_inc = _safe_increment(opt.count)
         bc1 = 1.0 - b1 ** count_inc
         bc2 = 1.0 - b2 ** count_inc
         losses, e2, bias2, mu_e, nu_e, mu_b, nu_b, activity = (
@@ -418,7 +504,7 @@ def make_fullfused_untied_step(
                                            compute_dtype, n_mats=2)
         ftile = pick_epilogue_tile(n_feats, d)
         opt = state.opt_state
-        count_inc = optax.safe_increment(opt.count)
+        count_inc = _safe_increment(opt.count)
         bc1 = 1.0 - b1 ** count_inc
         bc2 = 1.0 - b2 ** count_inc
         losses, de, dwn, db, activity = fused_untied_sae_grads(
@@ -428,23 +514,150 @@ def make_fullfused_untied_step(
         decay_loss, db = untied_bias_decay_terms(
             bias, state.buffers["bias_decay"], db)
         losses = dict(losses, bias_decay=decay_loss)
-        e2, mu_e, nu_e, d2, mu_d, nu_d = fused_adam_vjp_update(
+        e2, mu_e, nu_e, d2, mu_d, nu_d, un_sq = fused_adam_vjp_update(
             e, de, opt.mu["encoder"], opt.nu["encoder"],
             dec, dwn, opt.mu["decoder"], opt.nu["decoder"],
             state.lrs, bc1, bc2, ftile=ftile, interpret=interpret,
             b1=b1, b2=b2, eps=eps)
-        mu_b = b1 * opt.mu["encoder_bias"] + (1.0 - b1) * db
-        nu_b = b2 * opt.nu["encoder_bias"] + (1.0 - b2) * db * db
-        bias2 = bias - state.lrs[:, None] * (mu_b / bc1[:, None]) / (
-            jnp.sqrt(nu_b / bc2[:, None]) + eps)
+        bias2, mu_b, nu_b = _bias_adam_update(bias, db, opt, state.lrs,
+                                              bc1, bc2, b1, b2, eps)
         params = {"encoder": e2, "encoder_bias": bias2, "decoder": d2}
         opt_state = opt._replace(
             count=count_inc,
             mu={"encoder": mu_e, "encoder_bias": mu_b, "decoder": mu_d},
             nu={"encoder": nu_e, "encoder_bias": nu_b, "decoder": nu_d})
         aux = _fused_aux(losses, activity)
+        # update norm = kernel-epilogue matrix term + the (tiny, [N, n])
+        # bias delta — no XLA pass over the big tensors (ISSUE 11)
+        un = jnp.sqrt(un_sq + jnp.sum(jnp.square(bias2 - bias), axis=-1))
         params, opt_state, aux = _guard_fullfused(
-            state, params, opt_state, aux, raw_batch, sentinel)
+            state, params, opt_state, aux, raw_batch, sentinel, un=un)
+        new_state = state.replace(params=params, opt_state=opt_state,
+                                  step=state.step + 1)
+        return new_state, aux
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_tiled_step(
+    family: str,
+    optimizer: optax.GradientTransformation,
+    batch_tile: int,
+    feat_tile: int,
+    mesh: Optional[Mesh] = None,
+    donate: bool = True,
+    interpret: bool = False,
+    compute_dtype: str = "float32",
+    sentinel: bool = True,
+) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
+    """Two-stage FEATURE-AXIS-TILED step (ISSUE 11): grads from the
+    flash-style tiled kernel pair (ops/fused_sae_tiled.py — the path the
+    canonical ratio-16/96 shapes resolve to), optimizer update in vmapped
+    optax. The sentinel's grad norm arrives from the backward kernel's
+    epilogue (single-device; sharded falls back to the post-psum XLA
+    norm). ``family``: "tied" | "masked_tied" | "untied"."""
+    make_producer = (_untied_tiled_producer if family == "untied"
+                     else _tied_tiled_producer)
+    producer = make_producer(batch_tile, feat_tile, interpret, compute_dtype)
+    if mesh is not None:
+        return make_fused_step_sharded(producer, optimizer, mesh,
+                                       donate=donate, sentinel=sentinel)
+    return make_fused_step(producer, optimizer, donate=donate,
+                           sentinel=sentinel)
+
+
+def make_fullfused_tiled_step(
+    family: str,
+    adam_hypers: tuple[float, float, float],
+    batch_tile: int,
+    feat_tile: int,
+    donate: bool = True,
+    interpret: bool = False,
+    compute_dtype: str = "float32",
+    sentinel: bool = True,
+) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
+    """Whole-step FEATURE-AXIS-TILED path (single device, ISSUE 11): the
+    tiled grads kernels followed by the feature-tiled Adam/normalization-
+    VJP epilogue kernel — the Adam moment blocks stream through VMEM in
+    [ftile, d] tiles, so the whole-step path now exists at ANY n_feats
+    (the one-kernel tied path needs the full matrix resident and dies at
+    exactly the canonical high-ratio shapes). Both sentinel norms come
+    out of kernel epilogues: grad norm from the backward kernel, update
+    norm from the Adam/VJP kernel (+ the [N, n] bias delta in XLA) — no
+    extra pass over any [N, n, d] tensor. Bias (+ decay term) updates
+    stay XLA, exactly as in make_fullfused_untied_step. Numerically the
+    two-stage tiled path (same grad kernels, same optax formulas)."""
+    from sparse_coding_tpu.ops.fused_sae import (
+        fused_adam_vjp_update,
+        fused_tied_adam_vjp_update,
+        pick_epilogue_tile,
+        pick_tied_epilogue_tile,
+        untied_bias_decay_terms,
+    )
+    from sparse_coding_tpu.ops.fused_sae_tiled import (
+        prepare_tiled_batch,
+        tiled_tied_sae_grads,
+        tiled_untied_sae_grads,
+    )
+
+    if family not in ("tied", "untied"):
+        raise ValueError(
+            f"no whole-step tiled path for family {family!r} (the masked "
+            "family's coef_mask rides the two-stage kernels only)")
+    b1, b2, eps = adam_hypers
+    tied = family == "tied"
+
+    def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
+        e = state.params["encoder"]
+        bias = state.params["encoder_bias"]
+        n_feats, d = e.shape[1], e.shape[2]
+        raw_batch = batch
+        batch2, bt, ft = prepare_tiled_batch(
+            batch, n_feats, d, batch_tile, feat_tile, compute_dtype,
+            n_mats=1 if tied else 2, lane_rule=not interpret)
+        opt = state.opt_state
+        count_inc = _safe_increment(opt.count)
+        bc1 = 1.0 - b1 ** count_inc
+        bc2 = 1.0 - b2 ** count_inc
+        if tied:
+            losses, dw, db, activity, grad_sq = tiled_tied_sae_grads(
+                e, bias, state.buffers["l1_alpha"], batch2, batch_tile=bt,
+                feat_tile=ft, interpret=interpret,
+                compute_dtype=compute_dtype)
+            e2, mu_e, nu_e, un_sq = fused_tied_adam_vjp_update(
+                e, dw, opt.mu["encoder"], opt.nu["encoder"], state.lrs,
+                bc1, bc2, ftile=pick_tied_epilogue_tile(n_feats, d),
+                interpret=interpret, b1=b1, b2=b2, eps=eps)
+        else:
+            dec = state.params["decoder"]
+            losses, de, dwn, db, activity, grad_sq = tiled_untied_sae_grads(
+                e, dec, bias, state.buffers["l1_alpha"], batch2,
+                batch_tile=bt, feat_tile=ft, interpret=interpret,
+                compute_dtype=compute_dtype)
+            decay_loss, db = untied_bias_decay_terms(
+                bias, state.buffers["bias_decay"], db)
+            losses = dict(losses, bias_decay=decay_loss)
+            e2, mu_e, nu_e, d2, mu_d, nu_d, un_sq = fused_adam_vjp_update(
+                e, de, opt.mu["encoder"], opt.nu["encoder"], dec, dwn,
+                opt.mu["decoder"], opt.nu["decoder"], state.lrs, bc1, bc2,
+                ftile=pick_epilogue_tile(n_feats, d), interpret=interpret,
+                b1=b1, b2=b2, eps=eps)
+        bias2, mu_b, nu_b = _bias_adam_update(bias, db, opt, state.lrs,
+                                              bc1, bc2, b1, b2, eps)
+        if tied:
+            params = {"encoder": e2, "encoder_bias": bias2}
+            mu = {"encoder": mu_e, "encoder_bias": mu_b}
+            nu = {"encoder": nu_e, "encoder_bias": nu_b}
+        else:
+            params = {"encoder": e2, "encoder_bias": bias2, "decoder": d2}
+            mu = {"encoder": mu_e, "encoder_bias": mu_b, "decoder": mu_d}
+            nu = {"encoder": nu_e, "encoder_bias": nu_b, "decoder": nu_d}
+        opt_state = opt._replace(count=count_inc, mu=mu, nu=nu)
+        aux = _fused_aux(losses, activity)
+        gn = jnp.sqrt(grad_sq)
+        un = jnp.sqrt(un_sq + jnp.sum(jnp.square(bias2 - bias), axis=-1))
+        params, opt_state, aux = _guard_fullfused(
+            state, params, opt_state, aux, raw_batch, sentinel, un=un, gn=gn)
         new_state = state.replace(params=params, opt_state=opt_state,
                                   step=state.step + 1)
         return new_state, aux
@@ -602,23 +815,26 @@ class Ensemble:
         use_fused: str | bool = "auto",
         fused_interpret: bool = False,
         fused_batch_tile: Optional[int] = None,
+        fused_feat_tile: Optional[int] = None,
         fused_compute_dtype: str = "float32",
         fused_path: Optional[str] = None,
         fused_moments_dtype: str = "float32",
         sentinel: bool = True,
     ):
-        if fused_path not in (None, "two_stage", "train_step"):
+        if fused_path not in (None, *KERNEL_PATHS):
             raise ValueError(
-                f"fused_path must be None, 'two_stage' or 'train_step', got "
+                f"fused_path must be None or one of {KERNEL_PATHS}, got "
                 f"{fused_path!r}")
         if fused_moments_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"fused_moments_dtype must be 'float32' or 'bfloat16', got "
                 f"{fused_moments_dtype!r}")
-        if fused_moments_dtype != "float32" and fused_path != "train_step":
+        if (fused_moments_dtype != "float32"
+                and fused_path not in ("train_step", "train_step_tiled")):
             raise ValueError(
                 "fused_moments_dtype='bfloat16' requires "
-                "fused_path='train_step': only the whole-step kernels carry "
+                "fused_path='train_step' or 'train_step_tiled': only the "
+                "whole-step kernels carry "
                 "moments through VMEM (the win is their halved HBM traffic),"
                 " and an auto-mode path flip would silently change the "
                 "optimizer-state dtype mid-run. It is an opt-in DEVIATION "
@@ -694,15 +910,20 @@ class Ensemble:
         # eligibility scan costs per-member host syncs — skip it entirely
         # when the fused path was not requested.
         self._fused_n_mats = 1
+        self._fused_family: Optional[str] = None
         builders = None
         if use_fused is not False:
             if can_use_fused_tied_step(sig, members, interpret=fused_interpret):
                 builders = (make_fused_tied_step, make_fused_tied_step_sharded)
+                self._fused_family = ("masked_tied"
+                                      if self.sig_name == "masked_tied_sae"
+                                      else "tied")
             elif can_use_fused_untied_step(sig, members,
                                            interpret=fused_interpret):
                 builders = (make_fused_untied_step,
                             make_fused_untied_step_sharded)
                 self._fused_n_mats = 2
+                self._fused_family = "untied"
         if use_fused is True and builders is None:
             # explicit request: fail fast with a clear message if ineligible
             raise ValueError(
@@ -745,13 +966,17 @@ class Ensemble:
                     interpret=fused_interpret, batch_tile=fused_batch_tile,
                     compute_dtype=fused_compute_dtype,
                     sentinel=self.sentinel)
-        # the fused kernel additionally needs a VMEM-fitting batch tile — only
-        # known once the real batch arrives, so the final choice happens on
-        # the first step_batch call (and is re-checked per batch size).
-        # fused_path records WHICH fused kernel actually resolved
-        # ("train_step" | "two_stage" | None) for bench/tune labeling; the
-        # fused_path CONSTRUCTOR arg forces that choice (the bench/tune A/B
-        # knob — a perf-regressing default must stay measurable).
+        # which fused program actually runs is resolved PER BATCH SHAPE by
+        # the roofline admission model (ops/roofline.py, _resolve_step):
+        # among the VMEM-admissible candidates — the untiled kernels, the
+        # feature-axis-tiled kernels (ops/fused_sae_tiled.py, the path the
+        # canonical ratio-16/96 shapes land on), and the whole-step
+        # variants of each — the lowest modeled bytes/flops step time
+        # wins. fused_path records the resolved choice (a KERNEL_PATHS
+        # label | None) for bench/tune labeling and the
+        # ensemble.path_resolved obs counter; the fused_path CONSTRUCTOR
+        # arg pins it (the bench/tune A/B knob — a perf-regressing
+        # default must stay measurable).
         self._forced_fused_path = fused_path
         if fused_path == "train_step" and self._fullfused_step is None:
             raise ValueError(
@@ -760,17 +985,38 @@ class Ensemble:
                 "(one-kernel whole step) or plain sae (grads + fused "
                 "Adam/VJP epilogue); the whole-step path has no sharded "
                 "variant")
-        if fused_path == "two_stage" and self._fused_step is None:
+        if fused_path in ("two_stage", "two_stage_tiled") and \
+                self._fused_step is None:
             raise ValueError(
-                "fused_path='two_stage' but no fused kernel is eligible for "
-                "this bucket (see use_fused=True error for the conditions)")
+                f"fused_path={fused_path!r} but no fused kernel is eligible "
+                "for this bucket (see use_fused=True error for the "
+                "conditions)")
+        if fused_path == "train_step_tiled":
+            if mesh is not None:
+                raise ValueError(
+                    "fused_path='train_step_tiled' requires a single-device "
+                    "bucket (the whole-step paths have no sharded variant: "
+                    "the data-axis psum must run between grads and Adam)")
+            if self._fused_family not in ("tied", "untied"):
+                raise ValueError(
+                    "fused_path='train_step_tiled' requires an eligible "
+                    "identity-centered tied_sae or plain sae bucket (the "
+                    "masked family rides the two-stage kernels only)")
         self.fused = self._fused_step is not None
         self.fused_path = None
+        self.fused_plan = None  # the resolved roofline.KernelPlan
         self._fused_explicit = use_fused is True
+        self._fused_disabled = use_fused is False
         self._fused_batch_tile = fused_batch_tile
+        self._fused_feat_tile = fused_feat_tile
+        self._fused_interpret = fused_interpret
+        self._fused_compute_dtype = fused_compute_dtype
         # same derivation fused_tied_sae_loss_and_grads uses for its own
         # tile pick, so resolution and kernel admission can never disagree
         self._fused_compute_itemsize = jnp.dtype(fused_compute_dtype).itemsize
+        # tiled step programs are built per resolved (path, tiles) and
+        # cached — a sweep alternating two batch sizes must not recompile
+        self._tiled_steps: dict = {}
         self._step_fn = self._standard_step
         self._scan_fn = None
         self._resolved_batch: Optional[tuple[int, int]] = None
@@ -800,82 +1046,100 @@ class Ensemble:
             return np.ones((self.n_members,), np.bool_)
         return np.asarray(jax.device_get(self.state.live))
 
-    def _resolve_step(self, batch_size: int, batch_itemsize: int = 4):
-        """Pick fused vs autodiff for this batch size: the fused kernel needs
-        a VMEM-fitting tile of the PER-DEVICE batch slice. `batch_itemsize`
-        must be the itemsize the KERNEL will see (2 only for bf16 — every
-        other dtype is cast to f32 before the kernel, see
-        fused_tied_sae_loss_and_grads), so this check and the kernel's own
-        tile pick always agree. Re-checked whenever the incoming batch
-        size/dtype changes (a later batch with no fitting tile quietly falls
-        back in auto mode instead of erroring mid-sweep), and the
-        scanned-step cache is invalidated when the choice flips."""
-        if (self._fused_step is None
-                or (batch_size, batch_itemsize) == self._resolved_batch):
-            return
-        from sparse_coding_tpu.ops.fused_sae import (
-            pick_batch_tile, pick_epilogue_tile, pick_train_step_tile,
-            tile_fits, train_tile_fits)
+    def _count_resolution(self, path_label: str, reason: str) -> None:
+        """The silent-fallback fix (ISSUE 11): every path resolution is a
+        counted, reported event — ``ensemble.path_resolved{path=,reason=}``
+        through the obs registry, surfaced by obs.report's "kernel paths"
+        section — so a sweep that quietly ran autodiff is visible in every
+        run report instead of invisible in all artifacts."""
+        from sparse_coding_tpu import obs
 
-        n_feats = self.state.params["encoder"].shape[1]
-        d = self.state.params["encoder"].shape[2]
-        local = (batch_size // self.mesh.shape["data"]
-                 if self.mesh is not None else batch_size)
+        obs.counter("ensemble.path_resolved", path=path_label,
+                    reason=reason).inc()
+
+    def _step_for_plan(self, plan):
+        """The jitted step program for a resolved KernelPlan. Untiled paths
+        reuse the construction-time programs; tiled paths are built per
+        (path, batch_tile, feat_tile) and cached."""
+        if plan.path == "train_step":
+            return self._fullfused_step
+        if plan.path == "two_stage":
+            return self._fused_step
+        key = (plan.path, plan.batch_tile, plan.feat_tile)
+        fn = self._tiled_steps.get(key)
+        if fn is None:
+            if plan.path == "two_stage_tiled":
+                fn = make_tiled_step(
+                    self._fused_family, self.optimizer, plan.batch_tile,
+                    plan.feat_tile, mesh=self.mesh, donate=self._donate,
+                    interpret=self._fused_interpret,
+                    compute_dtype=self._fused_compute_dtype,
+                    sentinel=self.sentinel)
+            else:  # train_step_tiled
+                fn = make_fullfused_tiled_step(
+                    self._fused_family, self._adam_hypers, plan.batch_tile,
+                    plan.feat_tile, donate=self._donate,
+                    interpret=self._fused_interpret,
+                    compute_dtype=self._fused_compute_dtype,
+                    sentinel=self.sentinel)
+            self._tiled_steps[key] = fn
+        return fn
+
+    def _resolve_step(self, batch_size: int, batch_itemsize: int = 4):
+        """Roofline-driven admission (ISSUE 11, ops/roofline.py): for this
+        PER-DEVICE batch slice, rank every VMEM-admissible kernel path —
+        untiled two-stage/whole-step, feature-axis-tiled two-stage/whole-
+        step — by modeled HBM-bytes/MXU-flops step time and pick the
+        winner's (path, batch_tile, feat_tile); autodiff only when NO
+        fused tile admits (e.g. a batch no candidate divides), and then
+        as a counted ``ensemble.path_resolved`` event, never a silent
+        flip. `batch_itemsize` must be the itemsize the KERNEL will see
+        (2 only for bf16, see kernel_batch_itemsize) so this check and
+        the kernels' own tile picks always agree. Re-resolved whenever
+        the incoming batch size/dtype changes; the scanned-step cache is
+        invalidated when the program flips."""
+        if (batch_size, batch_itemsize) == self._resolved_batch:
+            return
         prev_fn = self._step_fn
-        # an explicit fused_batch_tile must itself pass admission (divide
-        # the local batch, fit VMEM) — same rule the kernel will apply
-        ci = self._fused_compute_itemsize
-        nm = self._fused_n_mats
-        workable = (tile_fits(local, self._fused_batch_tile, n_feats, d,
-                              batch_itemsize, compute_itemsize=ci, n_mats=nm)
-                    if self._fused_batch_tile is not None else
-                    pick_batch_tile(local, n_feats, d,
-                                    batch_itemsize=batch_itemsize,
-                                    compute_itemsize=ci, n_mats=nm) is not None)
-        # the whole-step kernel carries the Adam state through VMEM too, so
-        # its admission is separate (larger working set). A fused_path
-        # override pins the choice (the bench/tune A/B knob); in auto mode
-        # train_step wins when it admits — the r4 on-chip A/B
-        # (BENCH_VARIANTS.json) measured it ~9% faster than two_stage at
-        # bench scale, consistently across dtype variants.
+        plan = None
+        local = n_feats = d = None
+        if self._fused_step is not None:
+            from sparse_coding_tpu.ops import roofline
+
+            n_feats = self.state.params["encoder"].shape[1]
+            d = self.state.params["encoder"].shape[2]
+            local = (batch_size // self.mesh.shape["data"]
+                     if self.mesh is not None else batch_size)
+            plan = roofline.choose_plan(
+                n_members=self.n_members, batch=local, n_feats=n_feats,
+                d=d, family=self._fused_family,
+                sharded=self.mesh is not None,
+                batch_itemsize=batch_itemsize,
+                compute_itemsize=self._fused_compute_itemsize,
+                moments_itemsize=self._moments_itemsize,
+                forced_path=self._forced_fused_path,
+                batch_tile=self._fused_batch_tile,
+                feat_tile=self._fused_feat_tile,
+                sentinel=self.sentinel,
+                # interpret-mode buckets (CPU drills) admit feature tiles
+                # Mosaic's lane rule would reject on real TPU — mirror
+                # prepare_tiled_batch so resolution and kernel admission
+                # can never disagree
+                lane_rule=not self._fused_interpret)
         force = self._forced_fused_path
-        if nm == 2:
-            # untied whole-step = the SAME grads kernel as two_stage plus the
-            # feature-tiled Adam/VJP epilogue kernel, so its batch-tile
-            # admission equals `workable`; the epilogue only needs a feature
-            # tile dividing n_feats
-            workable_full = (self._fullfused_step is not None and workable
-                             and pick_epilogue_tile(n_feats, d) is not None)
-        else:
-            mi = self._moments_itemsize
-            workable_full = self._fullfused_step is not None and (
-                train_tile_fits(local, self._fused_batch_tile, n_feats, d,
-                                batch_itemsize, compute_itemsize=ci,
-                                n_mats=nm, moments_itemsize=mi)
-                if self._fused_batch_tile is not None else
-                pick_train_step_tile(local, n_feats, d,
-                                     batch_itemsize=batch_itemsize,
-                                     compute_itemsize=ci, n_mats=nm,
-                                     moments_itemsize=mi)
-                is not None)
-        if force == "train_step" and not workable_full:
+        if (plan is None or plan.path is None) and force is not None:
+            kind = {"train_step": "train-step tile",
+                    "two_stage": "batch tile"}.get(
+                        force, "(batch, feature) tile pair")
             raise ValueError(
-                f"fused_path='train_step' but no VMEM-fitting train-step "
-                f"tile exists for per-device batch={local}, "
-                f"n_feats={n_feats}, d={d}")
-        if force == "two_stage" and not workable:
-            raise ValueError(
-                f"fused_path='two_stage' but no VMEM-fitting batch tile "
-                f"exists for per-device batch={local}, n_feats={n_feats}, "
-                f"d={d}")
-        if force == "train_step" or (force is None and workable_full):
-            self._step_fn = self._fullfused_step
+                f"fused_path={force!r} but no VMEM-fitting {kind} exists "
+                f"for per-device batch={local}, n_feats={n_feats}, d={d}")
+        if plan is not None and plan.path is not None:
+            self._step_fn = self._step_for_plan(plan)
             self.fused = True
-            self.fused_path = "train_step"
-        elif workable:
-            self._step_fn = self._fused_step
-            self.fused = True
-            self.fused_path = "two_stage"
+            self.fused_path = plan.path
+            self.fused_plan = plan
+            self._count_resolution(plan.path, plan.reason)
         elif self._fused_explicit:
             raise ValueError(
                 f"use_fused=True but no VMEM-fitting batch tile exists for "
@@ -883,8 +1147,13 @@ class Ensemble:
                 "a batch size divisible by 64/128/256/512 or drop use_fused")
         else:
             self._step_fn = self._standard_step
-            self.fused = False  # auto mode: quietly keep autodiff
+            self.fused = False  # auto mode: keep autodiff — COUNTED
             self.fused_path = None
+            self.fused_plan = plan
+            reason = (plan.reason if plan is not None else
+                      "fused_disabled" if self._fused_disabled else
+                      "family_ineligible")
+            self._count_resolution("autodiff", reason)
         if self._step_fn is not prev_fn:
             self._scan_fn = None
         self._resolved_batch = (batch_size, batch_itemsize)

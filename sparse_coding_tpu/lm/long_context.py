@@ -62,7 +62,9 @@ def _sp_forward_local(params: dict, tokens: Array, cfg: LMConfig,
 
     x = params["embed_in"][tokens]
     rotary_ndims = int(cfg.d_head * cfg.rotary_pct)
-    total_s = s_local * jax.lax.axis_size(axis_name)
+    from sparse_coding_tpu.parallel.mesh import compat_axis_size
+
+    total_s = s_local * compat_axis_size(axis_name)
     cos_full, sin_full = _rotary_cos_sin(total_s, rotary_ndims, dtype=x.dtype)
     cos = jax.lax.dynamic_slice_in_dim(cos_full, offset, s_local)
     sin = jax.lax.dynamic_slice_in_dim(sin_full, offset, s_local)
@@ -121,15 +123,16 @@ def _sp_program(cfg: LMConfig, mesh: Mesh, taps: tuple,
     seq_sharded = P(None, axis_name)
     early_stop = stop_at_layer is not None and stop_at_layer < cfg.n_layers
 
+    from sparse_coding_tpu.parallel.mesh import compat_shard_map
+
     if early_stop:
-        return early_stop, jax.jit(jax.shard_map(
+        return early_stop, jax.jit(compat_shard_map(
             lambda p, t: body(p, t)[1],  # taps only; logits is None
-            mesh=mesh, in_specs=(P(), seq_sharded), out_specs=seq_sharded,
-            check_vma=False))
-    return early_stop, jax.jit(jax.shard_map(
+            mesh, in_specs=(P(), seq_sharded), out_specs=seq_sharded))
+    return early_stop, jax.jit(compat_shard_map(
         lambda p, t: body(p, t),
-        mesh=mesh, in_specs=(P(), seq_sharded),
-        out_specs=(seq_sharded, seq_sharded), check_vma=False))
+        mesh, in_specs=(P(), seq_sharded),
+        out_specs=(seq_sharded, seq_sharded)))
 
 
 def sequence_parallel_forward(params: dict, tokens: Array, cfg: LMConfig,

@@ -53,7 +53,9 @@ def ring_attention(q: Array, k: Array, v: Array, axis_name: str,
 
     q, k, v: [B, S_local, H, Dh], sequence-sharded over `axis_name`.
     Returns [B, S_local, H, Dh]."""
-    n_shards = jax.lax.axis_size(axis_name)
+    from sparse_coding_tpu.parallel.mesh import compat_axis_size
+
+    n_shards = compat_axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     s_local = q.shape[1]
     scale = scale if scale is not None else q.shape[-1] ** -0.5

@@ -192,6 +192,24 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "scrub_checked": counters.get("scrub.chunks_checked", 0),
         "scrub_quarantined": counters.get("scrub.chunks_quarantined", 0),
     }
+    # kernel-path evidence (ISSUE 11): every Ensemble._resolve_step
+    # decision is a counted event — which program each bucket's steps ran
+    # (two_stage / train_step / the feature-tiled variants / autodiff)
+    # and why (roofline | forced | no_admissible_tile | ...) — so a sweep
+    # that quietly fell back to autodiff is visible in every run report
+    # instead of invisible in all artifacts
+    kernel_paths: dict = {}
+    for name, v in counters.items():
+        if not name.startswith("ensemble.path_resolved{"):
+            continue
+        labels = dict(pair.partition("=")[::2]
+                      for pair in name[name.index("{") + 1:-1].split(","))
+        ent = kernel_paths.setdefault(labels.get("path", "?"),
+                                      {"count": 0, "reasons": {}})
+        ent["count"] += int(v)
+        reason = labels.get("reason", "?")
+        ent["reasons"][reason] = ent["reasons"].get(reason, 0) + int(v)
+
     # guardian evidence (docs/ARCHITECTURE.md §16): the sweep's divergence
     # ladder — member quarantines, chunk quarantines, rollbacks, typed
     # halts — plus the boundary-check and rollback walls, so one merged
@@ -226,6 +244,7 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "gateway": gateway,
         "ingest": ingest,
         "guardian": guardian,
+        "kernel_paths": kernel_paths,
         "dropped_events": counters.get("obs.sink.dropped", 0),
     }
 
@@ -304,6 +323,15 @@ def format_report(report: dict) -> str:
             f"{gd['rollbacks']} rollback(s), {gd['halts']} halt(s) "
             f"({gd['checks']} checks, {_fmt_s(gd['check_s'])} checking, "
             f"{_fmt_s(gd['rollback_s'])} restoring)")
+    kp = report.get("kernel_paths", {})
+    if kp:
+        parts = []
+        for path, ent in sorted(kp.items()):
+            reasons = ",".join(f"{r}={n}"
+                               for r, n in sorted(ent["reasons"].items()))
+            parts.append(f"{path}={ent['count']} [{reasons}]")
+        lines.append("kernel paths (step-path resolutions): "
+                     + ", ".join(parts))
     interesting = {k: v for k, v in report["counters"].items()
                    if not k.startswith(("jax.retraces", "jax.compiles"))}
     if interesting:

@@ -31,6 +31,15 @@ r = x̂ (+ctr if tied) − x, per-example MSEs (worst-example tracking), the
 dict-normalization VJP chain (ops/fused_sae.normalize_with_vjp), and the
 tied decode-centering gradient Σ (2/(B·d))·r.
 
+Since r11 this (batch_tiles × feat_tiles) blocked-recompute grid is no
+longer big-SAE-only: ops/fused_sae_tiled.py ports it to the vmapped
+ENSEMBLE kernels, so the old "ensemble kernels need the full [n, d]
+working set per member" rule is gone — canonical ratio-16/96 sweep
+shapes ride a tiled fused path there, with admission decided by the
+roofline model in ops/roofline.py (which also covers this pair's
+shapes conceptually; pick_big_sae_tiles below stays this file's
+concrete VMEM gate).
+
 Gradient semantics match jax.grad of train/big_sae.py::_sae_loss exactly
 (locked by tests/test_fused_big_sae.py).
 """
@@ -48,6 +57,7 @@ from sparse_coding_tpu.ops.fused_sae import (
     VMEM_BUDGET_BYTES,
     VMEM_LIMIT_BYTES,
     normalize_with_vjp,
+    tpu_compiler_params,
 )
 
 Array = jax.Array
@@ -218,7 +228,7 @@ def big_sae_forward(params: dict, xc: Array, batch_tile: int, feat_tile: int,
                                compute_dtype=jnp.dtype(compute_dtype))
     # batch axis is parallel (disjoint x̂ blocks); feat axis accumulates
     # into them sequentially. vmem_limit_bytes: see fused_sae budget comment.
-    compiler_params = (None if interpret else pltpu.CompilerParams(
+    compiler_params = (None if interpret else tpu_compiler_params(
         dimension_semantics=("parallel", "arbitrary"),
         vmem_limit_bytes=VMEM_LIMIT_BYTES))
     return pl.pallas_call(
@@ -283,7 +293,7 @@ def big_sae_backward(params: dict, alpha: Array, xc: Array, r: Array,
     # no dimension_semantics here: dctr/scal blocks are shared across the
     # feat axis (every program accumulates into them), so neither grid axis
     # may be declared parallel
-    compiler_params = (None if interpret else pltpu.CompilerParams(
+    compiler_params = (None if interpret else tpu_compiler_params(
         vmem_limit_bytes=VMEM_LIMIT_BYTES))
     de, dwn, dt, dctr_enc, c_totals, scal = pl.pallas_call(
         kernel,

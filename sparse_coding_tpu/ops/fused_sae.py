@@ -18,8 +18,13 @@ reference: sae_ensemble.py:134-162):
     ∂L/∂b   = Σ_batch ∂L/∂pre
 
 Grid: (n_members, n_batch_tiles); batch tiles accumulate into member-indexed
-output blocks (TPU sequential grid revisiting). Falls back to the jax.grad
-path for shapes whose per-member working set exceeds the VMEM budget.
+output blocks (TPU sequential grid revisiting). Shapes whose per-member
+working set exceeds the VMEM budget — the paper's canonical ratio-16/96
+dict shapes — ride the feature-axis-tiled kernels in ops/fused_sae_tiled.py
+instead (flash-style blocked recompute); the roofline admission model in
+ops/roofline.py picks between the two families per shape. Only shapes with
+no admissible tile at all (e.g. a batch no candidate tile divides) fall
+back to the jax.grad path.
 """
 
 from __future__ import annotations
@@ -45,10 +50,24 @@ VMEM_LIMIT_BYTES = 100 * 2**20  # requested scoped-VMEM window per kernel
 VMEM_BUDGET_BYTES = 80 * 2**20  # admission ceiling for the modeled set
 _DB = 2  # Mosaic double-buffer factor on in/out blocks
 
+
+def tpu_compiler_params(**kwargs):
+    """Version-portable ``pltpu.CompilerParams`` (older jax releases name
+    the class ``TPUCompilerParams``; the container's baked toolchain is one
+    of those). Single home so every kernel file stays lowerable on either."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
 # batch-tile candidates in preference order (the first VMEM-fitting,
 # batch-dividing entry wins); an explicit tile (Ensemble fused_batch_tile /
-# tune.py's tile scan) bypasses this list via tile_fits
-PREFERRED_TILES: tuple = (512, 256, 128, 64)
+# tune.py's tile scan) bypasses this list via tile_fits. 1024 leads since
+# r11: at the canonical bench shape (n=2048, d=512) it fits with ~36 MiB
+# of headroom and halves the grid revisits of tile 512.
+PREFERRED_TILES: tuple = (1024, 512, 256, 128, 64)
 
 
 def _working_set(batch_tile: int, n_feats: int, d: int,
@@ -290,7 +309,7 @@ def fused_tied_sae_grads(encoder: Array, bias: Array, alphas: Array,
     # blocks); batch-tile axis accumulates into them and must stay
     # sequential. "parallel" lets Mosaic split members across cores on
     # multi-core chips (e.g. v4); harmless on single-core generations.
-    compiler_params = (None if interpret else pltpu.CompilerParams(
+    compiler_params = (None if interpret else tpu_compiler_params(
         dimension_semantics=("parallel", "arbitrary"),
         vmem_limit_bytes=VMEM_LIMIT_BYTES))
 
@@ -587,7 +606,7 @@ def fused_tied_sae_train_step(encoder: Array, bias: Array,
             pltpu.VMEM((1, n_feats), jnp.float32),  # db accumulator
         ],
     )
-    compiler_params = (None if interpret else pltpu.CompilerParams(
+    compiler_params = (None if interpret else tpu_compiler_params(
         dimension_semantics=("parallel", "arbitrary"),
         vmem_limit_bytes=VMEM_LIMIT_BYTES))
 
@@ -739,7 +758,7 @@ def fused_untied_sae_grads(encoder: Array, decoder: Array, bias: Array,
         ],
         scratch_shapes=[pltpu.VMEM((n_feats, d), jnp.float32)],  # wn
     )
-    compiler_params = (None if interpret else pltpu.CompilerParams(
+    compiler_params = (None if interpret else tpu_compiler_params(
         dimension_semantics=("parallel", "arbitrary"),
         vmem_limit_bytes=VMEM_LIMIT_BYTES))
     de, dw, db, activity, losses = pl.pallas_call(
@@ -849,10 +868,12 @@ def _adam_vjp_kernel(lr_ref, bc1_ref, bc2_ref,
                      e_ref, de_ref, mue_ref, nue_ref,
                      d_ref, dwn_ref, mud_ref, nud_ref,
                      e_out, mue_out, nue_out, d_out, mud_out, nud_out,
+                     un_out,
                      *, b1: float, b2: float, eps: float):
     import jax.experimental.pallas as pl
 
     m = pl.program_id(0)
+    f = pl.program_id(1)
     lr = lr_ref[m]
     bc1 = bc1_ref[m]
     bc2 = bc2_ref[m]
@@ -860,12 +881,16 @@ def _adam_vjp_kernel(lr_ref, bc1_ref, bc2_ref,
     def adam(p, g, mu_in, nu_in):
         # exact optax scale_by_adam (eps_root=0) + the engine's lr scaling;
         # moments may be STORED sub-f32 (bf16 halves their HBM traffic) —
-        # the math always runs f32
+        # the math always runs f32. The update u is formed explicitly so
+        # the sentinel epilogue below can fold its squared norm into a
+        # per-member reduction; p + u is bitwise p - lr·(...) (IEEE
+        # a − b ≡ a + (−b)), so parity with the pre-r11 kernel holds.
         mu = b1 * mu_in.astype(jnp.float32) + (1.0 - b1) * g
         nu = b2 * nu_in.astype(jnp.float32) + (1.0 - b2) * g * g
-        return p - lr * (mu / bc1) / (jnp.sqrt(nu / bc2) + eps), mu, nu
+        u = -lr * (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
+        return p + u, mu, nu, u
 
-    e2, mue, nue = adam(e_ref[0], de_ref[0], mue_ref[0], nue_ref[0])
+    e2, mue, nue, ue = adam(e_ref[0], de_ref[0], mue_ref[0], nue_ref[0])
     e_out[0] = e2
     mue_out[0] = mue.astype(mue_out.dtype)
     nue_out[0] = nue.astype(nue_out.dtype)
@@ -878,10 +903,25 @@ def _adam_vjp_kernel(lr_ref, bc1_ref, bc2_ref,
     dwn = dwn_ref[0]
     radial = jnp.sum(dwn * w_hat, axis=-1, keepdims=True)
     dd = (dwn - w_hat * radial) / norms
-    d2, mud, nud = adam(dmat, dd, mud_ref[0], nud_ref[0])
+    d2, mud, nud, ud = adam(dmat, dd, mud_ref[0], nud_ref[0])
     d_out[0] = d2
     mud_out[0] = mud.astype(mud_out.dtype)
     nud_out[0] = nud.astype(nud_out.dtype)
+
+    # sentinel epilogue (ISSUE 11): the per-member update squared norm
+    # accumulates across feature tiles in VMEM — the whole-step paths'
+    # update-norm sentinel input comes out of the kernel for free instead
+    # of a second XLA delta-norm pass over the [N, n, d] params in HBM
+    part = jnp.stack([jnp.sum(ue * ue) + jnp.sum(ud * ud),
+                      jnp.zeros((), jnp.float32)])[None, None, :]
+
+    @pl.when(f == 0)
+    def _un_init():
+        un_out[...] = part
+
+    @pl.when(f > 0)
+    def _un_acc():
+        un_out[...] += part
 
 
 @functools.partial(jax.jit,
@@ -897,7 +937,10 @@ def fused_adam_vjp_update(encoder: Array, de: Array, mu_e: Array, nu_e: Array,
     matrices feature-tiled ([1, ftile, d] blocks). bc1/bc2: [N] bias
     corrections 1−β^count_inc precomputed by the caller (exactly optax's).
     Returns (new_encoder, new_mu_e, new_nu_e, new_decoder, new_mu_d,
-    new_nu_d). Bias updates stay outside — [N, n] is negligible traffic."""
+    new_nu_d, update_sq_norm [N]) — the last is the sentinel's per-member
+    update squared norm (both matrices), accumulated in the kernel epilogue
+    so the whole-step sentinel costs no extra HBM pass (ISSUE 11). Bias
+    updates stay outside — [N, n] is negligible traffic."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -910,10 +953,13 @@ def fused_adam_vjp_update(encoder: Array, de: Array, mu_e: Array, nu_e: Array,
         num_scalar_prefetch=3,
         grid=(n_members, n_feats // ftile),
         in_specs=[blk] * 8,
-        out_specs=[blk] * 6,
+        out_specs=[blk] * 6 + [
+            pl.BlockSpec((1, 1, 2), lambda m, f, *_: (m, 0, 0))],  # unorm
     )
-    compiler_params = (None if interpret else pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel"),
+    # the unorm block is shared across the feature axis (every tile
+    # accumulates into it), so only the member axis may be parallel
+    compiler_params = (None if interpret else tpu_compiler_params(
+        dimension_semantics=("parallel", "arbitrary"),
         vmem_limit_bytes=VMEM_LIMIT_BYTES))
 
     def big(dtype=jnp.float32):
@@ -921,13 +967,127 @@ def fused_adam_vjp_update(encoder: Array, de: Array, mu_e: Array, nu_e: Array,
 
     # moment outputs keep their STORAGE dtype (bf16 when the engine opted
     # into half-width moments); params always f32
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[big(), big(mu_e.dtype), big(nu_e.dtype),
-                   big(), big(mu_d.dtype), big(nu_d.dtype)],
+                   big(), big(mu_d.dtype), big(nu_d.dtype),
+                   jax.ShapeDtypeStruct((n_members, 1, 2), jnp.float32)],
         interpret=interpret,
         compiler_params=compiler_params,
     )(lrs.astype(jnp.float32), bc1.astype(jnp.float32),
       bc2.astype(jnp.float32),
       encoder, de, mu_e, nu_e, decoder, dwn, mu_d, nu_d)
+    return (*out[:6], out[6][:, 0, 0])
+
+
+# --- tied feature-tiled Adam(+normalization-VJP) epilogue (r11) --------------
+#
+# The tied whole-step ONE-kernel path (fused_tied_sae_train_step) needs the
+# full [n, d] matrix resident, so exactly the canonical high-ratio shapes it
+# matters for don't admit it. The tiled tied whole-step instead runs the
+# feature-tiled grads kernels (ops/fused_sae_tiled.py) followed by THIS
+# kernel: per [1, ftile, d] block, chain dL/dW (W = row-normalized E)
+# through the normalization VJP and apply the exact optax-Adam update — the
+# Adam moment blocks stream through VMEM feature-tiled, one HBM read+write
+# per tensor, any n_feats.
+
+TIED_EPILOGUE_BLOCKS = 7  # e, dw, mu, nu in + e', mu', nu' out
+
+
+def pick_tied_epilogue_tile(n_feats: int, d: int) -> Optional[int]:
+    """Largest feature tile dividing n_feats whose 7 grid-varying
+    [ftile, d] f32 blocks (4 in + 3 out) fit VMEM double-buffered."""
+    f32 = 4
+    for t in EPILOGUE_TILES:
+        if n_feats % t == 0 and (
+                _DB * TIED_EPILOGUE_BLOCKS * t * d * f32 <= VMEM_BUDGET_BYTES):
+            return t
+    return None
+
+
+def _tied_adam_vjp_kernel(lr_ref, bc1_ref, bc2_ref,
+                          e_ref, dw_ref, mu_ref, nu_ref,
+                          e_out, mu_out, nu_out, un_out,
+                          *, b1: float, b2: float, eps: float):
+    import jax.experimental.pallas as pl
+
+    m = pl.program_id(0)
+    f = pl.program_id(1)
+    lr = lr_ref[m]
+    bc1 = bc1_ref[m]
+    bc2 = bc2_ref[m]
+
+    # normalization VJP per row (rows live wholly inside a [ftile, d]
+    # block, so the reduction is tile-local): dE = (dW − Ŵ⟨dW, Ŵ⟩)/‖E‖
+    e = e_ref[0]
+    norms = jnp.clip(jnp.sqrt(jnp.sum(e * e, axis=-1, keepdims=True)), 1e-8)
+    w_hat = e / norms
+    dw = dw_ref[0]
+    radial = jnp.sum(dw * w_hat, axis=-1, keepdims=True)
+    de = (dw - w_hat * radial) / norms
+    # exact optax scale_by_adam (eps_root=0) + engine lr; f32 math, moments
+    # stored at their own width (bf16 opt-in halves their HBM traffic)
+    mu = b1 * mu_ref[0].astype(jnp.float32) + (1.0 - b1) * de
+    nu = b2 * nu_ref[0].astype(jnp.float32) + (1.0 - b2) * de * de
+    u = -lr * (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
+    e_out[0] = e + u
+    mu_out[0] = mu.astype(mu_out.dtype)
+    nu_out[0] = nu.astype(nu_out.dtype)
+
+    part = jnp.stack([jnp.sum(u * u),
+                      jnp.zeros((), jnp.float32)])[None, None, :]
+
+    @pl.when(f == 0)
+    def _un_init():
+        un_out[...] = part
+
+    @pl.when(f > 0)
+    def _un_acc():
+        un_out[...] += part
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("ftile", "interpret", "b1", "b2", "eps"))
+def fused_tied_adam_vjp_update(encoder: Array, dw: Array,
+                               mu_e: Array, nu_e: Array,
+                               lrs: Array, bc1: Array, bc2: Array,
+                               ftile: int, interpret: bool = False,
+                               b1: float = 0.9, b2: float = 0.999,
+                               eps: float = 1e-8):
+    """Feature-tiled normalization-VJP + exact optax-Adam update for the
+    tied family's RAW dictionary (the tiled whole-step path's pass 2).
+    Returns (new_encoder, new_mu_e, new_nu_e, update_sq_norm [N]); bias
+    updates stay outside (negligible [N, n] traffic)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_members, n_feats, d = encoder.shape
+    assert n_feats % ftile == 0
+
+    kernel = functools.partial(_tied_adam_vjp_kernel, b1=b1, b2=b2, eps=eps)
+    blk = pl.BlockSpec((1, ftile, d), lambda m, f, *_: (m, f, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(n_members, n_feats // ftile),
+        in_specs=[blk] * 4,
+        out_specs=[blk] * 3 + [
+            pl.BlockSpec((1, 1, 2), lambda m, f, *_: (m, 0, 0))],
+    )
+    compiler_params = (None if interpret else tpu_compiler_params(
+        dimension_semantics=("parallel", "arbitrary"),
+        vmem_limit_bytes=VMEM_LIMIT_BYTES))
+
+    def big(dtype=jnp.float32):
+        return jax.ShapeDtypeStruct((n_members, n_feats, d), dtype)
+
+    e2, mu2, nu2, un = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[big(), big(mu_e.dtype), big(nu_e.dtype),
+                   jax.ShapeDtypeStruct((n_members, 1, 2), jnp.float32)],
+        interpret=interpret,
+        compiler_params=compiler_params,
+    )(lrs.astype(jnp.float32), bc1.astype(jnp.float32),
+      bc2.astype(jnp.float32), encoder, dw, mu_e, nu_e)
+    return e2, mu2, nu2, un[:, 0, 0]

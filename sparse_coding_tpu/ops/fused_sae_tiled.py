@@ -1,0 +1,603 @@
+"""Feature-axis-tiled fused ensemble kernels (Pallas/TPU) — r11.
+
+The untiled two-stage kernels (ops/fused_sae.py) keep a member's whole
+[n_feats, d] dictionary — plus its gradient accumulator and normalized
+copy, double-buffered — resident in VMEM, so exactly the paper's headline
+sweep shapes at dict ratios 16–96 (reference standard_metrics.py:745,
+big_sweep_experiments.py:543) never admitted a batch tile and silently
+fell back to the ~1.8x-slower autodiff path (BENCH_VARIANTS.json). These
+kernels port the flash-style (batch_tiles x feat_tiles) blocked-recompute
+grid of ops/fused_big_sae.py to the vmapped ENSEMBLE step:
+
+- **forward** — grid (members, batch_tiles, feat_tiles): each program
+  row-normalizes its weight tile in registers and accumulates
+  ``x̂[m, batch_tile] += relu(x·W_tᵀ + b_t) @ W_t``. Only x̂ [N, B, d]
+  reaches HBM; the [B, n_feats] code matrix never exists anywhere.
+- **residual** — one XLA elementwise pass forms r = x̂ − x [N, B, d].
+- **backward** — grid (members, feat_tiles, batch_tiles): each program
+  RECOMPUTES its code tile (the flash trade: ~2·B·Ft·d extra MXU flops
+  instead of B·Ft·4-byte HBM round trips) and accumulates dW_t, db_t,
+  activity and the member loss partials.
+- **sentinel epilogue** — on each (member, feat-tile)'s LAST batch step
+  the finished grad tile's squared norm folds into a per-member [N]
+  reduction, so the PR-10 anomaly sentinel's grad-norm input comes out
+  of the kernel for free instead of a second XLA ``optax.global_norm``
+  pass over the [N, n, d] grads in HBM. The reported ``aux.grad_norm``
+  is therefore the KERNEL-grad norm (pre normalization-VJP for the
+  dictionary matrices) — equivalent for finiteness detection (the VJP
+  is a row-local linear map with clipped denominators, so it neither
+  creates nor destroys non-finites when params are finite), and the
+  update-norm check still covers the full post-optimizer update.
+  Under shard_map the per-shard partial grads make this nonlinear
+  reduction wrong (‖Σ_shards g‖ ≠ √Σ_shards ‖g‖²), so sharded callers
+  receive ``gnorm=None`` and fall back to the XLA norm after the psum.
+
+Grid order matters on TPU: an output block accumulates in VMEM only
+across CONSECUTIVE grid steps, so the per-batch x̂ lives in the
+(batch, feat)-ordered forward grid and the per-feature grads in the
+(feat, batch)-ordered backward grid (same rule as fused_big_sae.py).
+
+Gradient semantics equal the untiled kernels' (same tile math, locked
+against vmapped autodiff — including ratio-32 shapes — by
+tests/test_fused_tiled.py). VMEM admission and the tiled-vs-untiled-vs-
+autodiff path choice live in ops/roofline.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu.ops.fused_sae import (
+    _DB,
+    VMEM_BUDGET_BYTES,
+    VMEM_LIMIT_BYTES,
+    normalize_with_vjp,
+    tpu_compiler_params,
+    untied_bias_decay_terms,
+)
+
+Array = jax.Array
+
+# tile candidates in preference order (first dividing + VMEM-fitting combo
+# wins; batch tile scanned outermost). Real sweep shapes land on the
+# 1024–4096 feature entries; the small entries serve the ft == n_feats
+# equality case (Mosaic's lane rule below) so small-n buckets still ride
+# the tiled program as a degenerate one-feature-tile grid.
+TILED_BATCH_TILES: tuple = (512, 256, 128, 64)
+TILED_FEAT_TILES: tuple = (4096, 2048, 1024, 512, 256, 128, 64, 32, 16, 8)
+
+
+def _lane_legal(n_feats: int, ft: int) -> bool:
+    # Mosaic lane rule for the [1, 1, ft] bias/db/activity blocks: the
+    # last block dim must be a multiple of 128 or equal the whole array
+    # dim (caught by the AOT lowering gates; interpret mode — the CPU
+    # parity/fault drills — is exempt via lane_rule=False)
+    return ft == n_feats or ft % 128 == 0
+
+
+def _tiled_fwd_working_set(bt: int, ft: int, d: int,
+                           batch_itemsize: int = 4,
+                           compute_itemsize: int = 4,
+                           n_mats: int = 1) -> int:
+    """VMEM model for the forward kernel (same conventions as
+    fused_sae._working_set: grid-varying in/out blocks ×_DB for Mosaic's
+    double buffering, in-kernel intermediates ×1, sub-f32 cast copies
+    counted)."""
+    f32 = 4
+    cast_copy = f32 if batch_itemsize < f32 else 0
+    extra = 0
+    if compute_itemsize < f32:
+        extra = (ft * d * compute_itemsize * n_mats   # weight-tile casts
+                 + bt * ft * compute_itemsize         # c cast
+                 + (0 if batch_itemsize == compute_itemsize
+                    else bt * d * compute_itemsize))  # xc
+    blocks = (ft * d * f32 * n_mats      # weight tile(s) in
+              + bt * d * batch_itemsize  # x tile (stream width)
+              + bt * d * f32             # x̂ accumulator out
+              + ft * f32 * 2)            # b (+ coef_mask)
+    interm = (bt * ft * f32 * 2          # pre/c + decode partial
+              + bt * d * cast_copy
+              + ft * d * f32             # normalized weight tile
+              + extra)
+    return _DB * blocks + interm
+
+
+def _tiled_bwd_working_set(bt: int, ft: int, d: int,
+                           batch_itemsize: int = 4,
+                           compute_itemsize: int = 4,
+                           n_mats: int = 1) -> int:
+    """VMEM model for the backward kernel — the larger of the pair (it
+    carries the residual tile and the grad accumulators on top of the
+    forward's set); admission checks both anyway."""
+    f32 = 4
+    cast_copy = f32 if batch_itemsize < f32 else 0
+    extra = 0
+    if compute_itemsize < f32:
+        extra = (ft * d * compute_itemsize * n_mats
+                 + bt * d * compute_itemsize          # rc
+                 + bt * ft * compute_itemsize * 2     # c cast, dpre cast
+                 + (0 if batch_itemsize == compute_itemsize
+                    else bt * d * compute_itemsize))  # xc
+    blocks = (ft * d * f32 * 2 * n_mats  # weight tiles in + grad accums out
+              + bt * d * batch_itemsize  # x tile
+              + bt * d * f32             # r tile
+              + ft * f32 * 4             # b, db, act (+ coef_mask)
+              + 4 * f32)                 # loss/gnorm vector
+    interm = (bt * ft * f32 * 3          # pre/c, dpre, mask
+              + bt * d * cast_copy
+              + ft * d * f32             # normalized weight tile
+              + extra)
+    return _DB * blocks + interm
+
+
+def tiled_tiles_fit(batch: int, bt: int, n_feats: int, ft: int, d: int,
+                    batch_itemsize: int = 4, compute_itemsize: int = 4,
+                    n_mats: int = 1, lane_rule: bool = True) -> bool:
+    """Would this EXPLICIT (batch_tile, feat_tile) pair work? Divides both
+    axes, respects Mosaic's lane rule on the feature tile (skipped for
+    interpret-mode callers via lane_rule=False), and both kernels' working
+    sets fit the VMEM budget."""
+    return (batch % bt == 0 and n_feats % ft == 0
+            and (not lane_rule or _lane_legal(n_feats, ft))
+            and _tiled_fwd_working_set(bt, ft, d, batch_itemsize,
+                                       compute_itemsize, n_mats)
+            <= VMEM_BUDGET_BYTES
+            and _tiled_bwd_working_set(bt, ft, d, batch_itemsize,
+                                       compute_itemsize, n_mats)
+            <= VMEM_BUDGET_BYTES)
+
+
+def pick_tiled_tiles(batch: int, n_feats: int, d: int,
+                     batch_itemsize: int = 4, compute_itemsize: int = 4,
+                     n_mats: int = 1,
+                     batch_tile: Optional[int] = None,
+                     feat_tile: Optional[int] = None,
+                     lane_rule: bool = True
+                     ) -> Optional[tuple[int, int]]:
+    """Largest admissible (batch_tile, feat_tile): batch tile scanned
+    outermost (preference order TILED_BATCH_TILES × TILED_FEAT_TILES),
+    each axis pinnable by an explicit tile (Ensemble fused_batch_tile /
+    fused_feat_tile, tune.py's scans); None when nothing divides + fits."""
+    bts = (batch_tile,) if batch_tile is not None else TILED_BATCH_TILES
+    fts = (feat_tile,) if feat_tile is not None else TILED_FEAT_TILES
+    for bt in bts:
+        if batch % bt:
+            continue
+        for ft in fts:
+            if n_feats % ft:
+                continue
+            if tiled_tiles_fit(batch, bt, n_feats, ft, d, batch_itemsize,
+                               compute_itemsize, n_mats,
+                               lane_rule=lane_rule):
+                return bt, ft
+    return None
+
+
+# --- kernels -----------------------------------------------------------------
+
+
+def _normalize_tile(mat):
+    # same formula as the untiled kernels' in-scratch normalization
+    # (fused_sae._kernel/_untied_kernel): rows live wholly inside a
+    # [ftile, d] block, so the reduction is tile-local
+    norms = jnp.sqrt(jnp.sum(mat * mat, axis=-1, keepdims=True))
+    return mat / jnp.clip(norms, 1e-8)
+
+
+def _fwd_kernel(x_ref, e_ref, *rest, tied: bool, masked: bool,
+                compute_dtype):
+    import jax.experimental.pallas as pl
+
+    rest = list(rest)
+    dec_ref = None if tied else rest.pop(0)
+    b_ref = rest.pop(0)
+    mask_ref = rest.pop(0) if masked else None
+    (xhat_ref,) = rest
+
+    ft = pl.program_id(2)
+    x_in = x_ref[...]
+    xb = x_in.astype(jnp.float32)
+    xc = x_in if x_in.dtype == compute_dtype else xb.astype(compute_dtype)
+
+    if tied:
+        enc = _normalize_tile(e_ref[0]).astype(compute_dtype)
+        dec = enc
+    else:
+        enc = e_ref[0].astype(compute_dtype)
+        dec = _normalize_tile(dec_ref[0]).astype(compute_dtype)
+
+    pre = (jnp.dot(xc, enc.T, preferred_element_type=jnp.float32)
+           + b_ref[0, 0][None, :])
+    c = jnp.maximum(pre, 0.0)
+    if masked:
+        c = c * mask_ref[0, 0][None, :]
+    part = jnp.dot(c.astype(compute_dtype), dec,
+                   preferred_element_type=jnp.float32)
+
+    @pl.when(ft == 0)
+    def _init():
+        xhat_ref[0] = part
+
+    @pl.when(ft > 0)
+    def _acc():
+        xhat_ref[0] += part
+
+
+def _bwd_kernel(alpha_ref, x_ref, r_ref, e_ref, *rest, total_batch: int,
+                d_act: int, n_bt: int, tied: bool, masked: bool,
+                compute_dtype):
+    import jax.experimental.pallas as pl
+
+    rest = list(rest)
+    dec_ref = None if tied else rest.pop(0)
+    b_ref = rest.pop(0)
+    mask_ref = rest.pop(0) if masked else None
+    if tied:
+        dw_ref, db_ref, act_ref, loss_ref = rest
+        de_ref = dwn_ref = None
+    else:
+        de_ref, dwn_ref, db_ref, act_ref, loss_ref = rest
+        dw_ref = None
+
+    m = pl.program_id(0)
+    ft_idx = pl.program_id(1)
+    bt_idx = pl.program_id(2)
+
+    x_in = x_ref[...]
+    xb = x_in.astype(jnp.float32)
+    xc = x_in if x_in.dtype == compute_dtype else xb.astype(compute_dtype)
+    r = r_ref[0]  # [Bt, d] f32 (precomputed residual)
+    rc = r.astype(compute_dtype)
+    alpha = alpha_ref[m]
+    b = b_ref[0, 0]
+
+    if tied:
+        enc = _normalize_tile(e_ref[0]).astype(compute_dtype)
+        dec = enc
+    else:
+        enc = e_ref[0].astype(compute_dtype)
+        dec = _normalize_tile(dec_ref[0]).astype(compute_dtype)
+
+    # code-tile recomputation (the flash trade)
+    pre = jnp.dot(xc, enc.T, preferred_element_type=jnp.float32) + b[None, :]
+    c = jnp.maximum(pre, 0.0)
+    mask = (pre > 0.0).astype(jnp.float32)
+    if masked:
+        cm = mask_ref[0, 0][None, :]
+        c = c * cm
+        mask = mask * cm
+
+    coef = 2.0 / (total_batch * d_act)
+    dpre = (coef * jnp.dot(rc, dec.T, preferred_element_type=jnp.float32)
+            + alpha / total_batch) * mask
+    dprec = dpre.astype(compute_dtype)
+    cc = c.astype(compute_dtype)
+    if tied:
+        dmain = (jnp.dot(dprec.T, xc, preferred_element_type=jnp.float32)
+                 + coef * jnp.dot(cc.T, rc,
+                                  preferred_element_type=jnp.float32))
+    else:
+        de = jnp.dot(dprec.T, xc, preferred_element_type=jnp.float32)
+        dwn = coef * jnp.dot(cc.T, rc, preferred_element_type=jnp.float32)
+    db = jnp.sum(dpre, axis=0)
+    activity = jnp.sum(mask, axis=0)
+    zero = jnp.zeros((), jnp.float32)
+    # mse comes from the residual tile and must count once per batch tile,
+    # not once per feature tile
+    mse_part = jnp.where(ft_idx == 0,
+                         jnp.sum(r * r) / (total_batch * d_act), 0.0)
+    part = jnp.stack([mse_part, alpha * jnp.sum(c) / total_batch,
+                      jnp.sum(mask) / total_batch, zero])[None, None, :]
+
+    @pl.when(bt_idx == 0)
+    def _init():
+        if tied:
+            dw_ref[0] = dmain
+        else:
+            de_ref[0] = de
+            dwn_ref[0] = dwn
+        db_ref[0, 0] = db
+        act_ref[0, 0] = activity
+
+    @pl.when(bt_idx > 0)
+    def _acc():
+        if tied:
+            dw_ref[0] += dmain
+        else:
+            de_ref[0] += de
+            dwn_ref[0] += dwn
+        db_ref[0, 0] += db
+        act_ref[0, 0] += activity
+
+    first = jnp.logical_and(ft_idx == 0, bt_idx == 0)
+
+    @pl.when(first)
+    def _loss_init():
+        loss_ref[...] = part
+
+    @pl.when(jnp.logical_not(first))
+    def _loss_acc():
+        loss_ref[...] += part
+
+    # sentinel epilogue: fold this feature tile's FINISHED grads into the
+    # member's grad squared norm on its last batch step — the PR-10
+    # sentinel's norm reduction rides the kernel, no extra HBM pass
+    @pl.when(bt_idx == n_bt - 1)
+    def _gnorm():
+        if tied:
+            g = jnp.sum(dw_ref[0] * dw_ref[0])
+        else:
+            g = (jnp.sum(de_ref[0] * de_ref[0])
+                 + jnp.sum(dwn_ref[0] * dwn_ref[0]))
+        dbf = db_ref[0, 0]
+        g = g + jnp.sum(dbf * dbf)
+        loss_ref[...] += jnp.stack([zero, zero, zero, g])[None, None, :]
+
+
+# --- pallas_call wrappers ----------------------------------------------------
+
+
+def _fwd_call(encoder, decoder, bias3, mask3, batch, batch_tile, feat_tile,
+              interpret, compute_dtype):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_members, n_feats, d = encoder.shape
+    local_batch = batch.shape[0]
+    tied = decoder is None
+    masked = mask3 is not None
+    kernel = functools.partial(_fwd_kernel, tied=tied, masked=masked,
+                               compute_dtype=jnp.dtype(compute_dtype))
+
+    big = pl.BlockSpec((1, feat_tile, d), lambda m, b, f: (m, f, 0))
+    vec = pl.BlockSpec((1, 1, feat_tile), lambda m, b, f: (m, 0, f))
+    in_specs = [pl.BlockSpec((batch_tile, d), lambda m, b, f: (b, 0)),  # x
+                big]                                                    # E
+    operands = [batch, encoder]
+    if not tied:
+        in_specs.append(big)          # raw decoder
+        operands.append(decoder)
+    in_specs.append(vec)              # b
+    operands.append(bias3)
+    if masked:
+        in_specs.append(vec)          # coef_mask
+        operands.append(mask3)
+
+    # members and batch tiles own disjoint x̂ blocks (parallel); the
+    # feature axis accumulates into them and must stay sequential
+    compiler_params = (None if interpret else tpu_compiler_params(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        vmem_limit_bytes=VMEM_LIMIT_BYTES))
+    return pl.pallas_call(
+        kernel,
+        grid=(n_members, local_batch // batch_tile, n_feats // feat_tile),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, batch_tile, d), lambda m, b, f: (m, b, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_members, local_batch, d),
+                                       jnp.float32),
+        interpret=interpret,
+        compiler_params=compiler_params,
+    )(*operands)
+
+
+def _bwd_call(alphas, encoder, decoder, bias3, mask3, batch, resid,
+              batch_tile, feat_tile, interpret, total_batch, compute_dtype):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_members, n_feats, d = encoder.shape
+    local_batch = batch.shape[0]
+    n_bt = local_batch // batch_tile
+    tied = decoder is None
+    masked = mask3 is not None
+    kernel = functools.partial(
+        _bwd_kernel, total_batch=total_batch, d_act=d, n_bt=n_bt,
+        tied=tied, masked=masked, compute_dtype=jnp.dtype(compute_dtype))
+
+    big = pl.BlockSpec((1, feat_tile, d), lambda m, f, b, *_: (m, f, 0))
+    vec = pl.BlockSpec((1, 1, feat_tile), lambda m, f, b, *_: (m, 0, f))
+    in_specs = [
+        pl.BlockSpec((batch_tile, d), lambda m, f, b, *_: (b, 0)),   # x
+        pl.BlockSpec((1, batch_tile, d), lambda m, f, b, *_: (m, b, 0)),  # r
+        big,                                                         # E
+    ]
+    operands = [batch, resid, encoder]
+    if not tied:
+        in_specs.append(big)
+        operands.append(decoder)
+    in_specs.append(vec)
+    operands.append(bias3)
+    if masked:
+        in_specs.append(vec)
+        operands.append(mask3)
+
+    n_big_out = 1 if tied else 2
+    out_specs = ([big] * n_big_out
+                 + [vec, vec,
+                    pl.BlockSpec((1, 1, 4), lambda m, f, b, *_: (m, 0, 0))])
+    out_shape = ([jax.ShapeDtypeStruct((n_members, n_feats, d), jnp.float32)]
+                 * n_big_out
+                 + [jax.ShapeDtypeStruct((n_members, 1, n_feats),
+                                         jnp.float32)] * 2
+                 + [jax.ShapeDtypeStruct((n_members, 1, 4), jnp.float32)])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_members, n_feats // feat_tile, n_bt),
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )
+    # the loss/gnorm block is shared across the feature axis (every tile
+    # accumulates into it), so only the member axis may be parallel
+    compiler_params = (None if interpret else tpu_compiler_params(
+        dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        vmem_limit_bytes=VMEM_LIMIT_BYTES))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        compiler_params=compiler_params,
+    )(alphas.astype(jnp.float32), *operands)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("batch_tile", "feat_tile", "interpret",
+                                    "total_batch", "compute_dtype"))
+def tiled_tied_sae_grads(encoder: Array, bias: Array, alphas: Array,
+                         batch: Array, batch_tile: int, feat_tile: int,
+                         interpret: bool = False,
+                         total_batch: Optional[int] = None,
+                         compute_dtype: str = "float32",
+                         coef_mask: Optional[Array] = None):
+    """All-member tied-SAE losses and RAW kernel gradients via the tiled
+    forward/backward pair. Returns (losses {mse, l1, l0}, dW [N, n, d] wrt
+    the row-normalized W — chain through normalize_with_vjp for dE,
+    db [N, n], activity [N, n], grad_sq [N] — the sentinel's per-member
+    kernel-grad squared norm, accumulated in the backward epilogue).
+    total_batch: global batch under shard_map (see fused_tied_sae_grads)."""
+    n_members, n_feats, d = encoder.shape
+    if total_batch is None:
+        total_batch = batch.shape[0]
+    assert batch.shape[0] % batch_tile == 0
+    assert n_feats % feat_tile == 0
+    bias3 = bias.reshape(n_members, 1, n_feats)
+    mask3 = (None if coef_mask is None
+             else coef_mask.astype(jnp.float32).reshape(n_members, 1, n_feats))
+    xhat = _fwd_call(encoder, None, bias3, mask3, batch, batch_tile,
+                     feat_tile, interpret, compute_dtype)
+    resid = xhat - batch.astype(jnp.float32)[None]
+    dw, db, act, loss4 = _bwd_call(
+        alphas, encoder, None, bias3, mask3, batch, resid, batch_tile,
+        feat_tile, interpret, total_batch, compute_dtype)
+    loss4 = loss4.reshape(n_members, 4)
+    losses = {"mse": loss4[:, 0], "l1": loss4[:, 1], "l0": loss4[:, 2]}
+    return (losses, dw, db.reshape(n_members, n_feats),
+            act.reshape(n_members, n_feats), loss4[:, 3])
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("batch_tile", "feat_tile", "interpret",
+                                    "total_batch", "compute_dtype"))
+def tiled_untied_sae_grads(encoder: Array, decoder: Array, bias: Array,
+                           alphas: Array, batch: Array, batch_tile: int,
+                           feat_tile: int, interpret: bool = False,
+                           total_batch: Optional[int] = None,
+                           compute_dtype: str = "float32"):
+    """Untied (FunctionalSAE) tiled grads: (losses, dE raw, dWn wrt the
+    normalized decoder, db, activity, grad_sq [N]). Bias-decay terms are
+    the caller's (untied_bias_decay_terms), exactly as in the untiled
+    path."""
+    n_members, n_feats, d = encoder.shape
+    if total_batch is None:
+        total_batch = batch.shape[0]
+    assert batch.shape[0] % batch_tile == 0
+    assert n_feats % feat_tile == 0
+    bias3 = bias.reshape(n_members, 1, n_feats)
+    xhat = _fwd_call(encoder, decoder, bias3, None, batch, batch_tile,
+                     feat_tile, interpret, compute_dtype)
+    resid = xhat - batch.astype(jnp.float32)[None]
+    de, dwn, db, act, loss4 = _bwd_call(
+        alphas, encoder, decoder, bias3, None, batch, resid, batch_tile,
+        feat_tile, interpret, total_batch, compute_dtype)
+    loss4 = loss4.reshape(n_members, 4)
+    losses = {"mse": loss4[:, 0], "l1": loss4[:, 1], "l0": loss4[:, 2]}
+    return (losses, de, dwn, db.reshape(n_members, n_feats),
+            act.reshape(n_members, n_feats), loss4[:, 3])
+
+
+# --- producer-level wrappers (ensemble entry points) -------------------------
+
+
+def prepare_tiled_batch(batch: Array, n_feats: int, d: int,
+                        batch_tile: Optional[int], feat_tile: Optional[int],
+                        compute_dtype: str,
+                        n_mats: int = 1,
+                        lane_rule: bool = True) -> tuple[Array, int, int]:
+    """Tiled twin of fused_sae.prepare_kernel_batch: same dtype contract
+    (bf16 streams pass half-width, everything else casts to f32), then the
+    (batch, feature) tile pair resolves through pick_tiled_tiles — the
+    SAME admission rule ops/roofline.py applies, so resolution and the
+    kernels can never disagree. lane_rule=False (interpret-mode callers)
+    admits feature tiles Mosaic's lane rule would reject on real TPU."""
+    if batch.dtype != jnp.bfloat16:
+        batch = batch.astype(jnp.float32)
+    pair = pick_tiled_tiles(
+        batch.shape[0], n_feats, d,
+        batch_itemsize=batch.dtype.itemsize,
+        compute_itemsize=jnp.dtype(compute_dtype).itemsize,
+        n_mats=n_mats, batch_tile=batch_tile, feat_tile=feat_tile,
+        lane_rule=lane_rule)
+    if pair is None:
+        raise ValueError(
+            f"no VMEM-fitting (batch, feature) tile pair for shapes "
+            f"n={n_feats} d={d} batch={batch.shape[0]} "
+            f"(batch_tile={batch_tile}, feat_tile={feat_tile}); "
+            f"use the autodiff path")
+    return batch, pair[0], pair[1]
+
+
+def fused_tied_sae_tiled_loss_and_grads(
+        params_stacked: dict, alphas: Array, batch: Array,
+        batch_tile: Optional[int] = None, feat_tile: Optional[int] = None,
+        interpret: bool = False, total_batch: Optional[int] = None,
+        compute_dtype: str = "float32", psum_axis: Optional[str] = None,
+        coef_mask: Optional[Array] = None):
+    """Tiled-path producer for tied (and masked-tied) buckets: same
+    contract as fused_tied_sae_loss_and_grads plus a 4th return — the
+    per-member kernel-grad norm [N] from the backward epilogue (None
+    under shard_map, where the per-shard partials make the reduction
+    wrong; the sharded sentinel falls back to the XLA norm)."""
+    e = params_stacked["encoder"]
+    batch, bt, ft = prepare_tiled_batch(
+        batch, e.shape[1], e.shape[2], batch_tile, feat_tile, compute_dtype,
+        lane_rule=not interpret)
+    losses, dw, db, activity, grad_sq = tiled_tied_sae_grads(
+        e, params_stacked["encoder_bias"], alphas, batch, batch_tile=bt,
+        feat_tile=ft, interpret=interpret, total_batch=total_batch,
+        compute_dtype=compute_dtype, coef_mask=coef_mask)
+    if psum_axis is not None:
+        losses, dw, db, activity = jax.lax.psum(
+            (losses, dw, db, activity), psum_axis)
+        gnorm = None
+    else:
+        gnorm = jnp.sqrt(grad_sq)
+    grads = {"encoder": normalize_with_vjp(e, dw), "encoder_bias": db}
+    return losses, grads, activity, gnorm
+
+
+def fused_untied_sae_tiled_loss_and_grads(
+        params_stacked: dict, alphas: Array, bias_decays: Array,
+        batch: Array, batch_tile: Optional[int] = None,
+        feat_tile: Optional[int] = None, interpret: bool = False,
+        total_batch: Optional[int] = None, compute_dtype: str = "float32",
+        psum_axis: Optional[str] = None):
+    """Tiled-path producer for untied FunctionalSAE buckets (contract of
+    fused_untied_sae_loss_and_grads + the kernel-grad norm; the
+    batch-independent bias-decay terms are added AFTER the psum, exactly
+    once per member)."""
+    e = params_stacked["encoder"]
+    dec = params_stacked["decoder"]
+    batch, bt, ft = prepare_tiled_batch(
+        batch, e.shape[1], e.shape[2], batch_tile, feat_tile, compute_dtype,
+        n_mats=2, lane_rule=not interpret)
+    losses, de, dwn, db, activity, grad_sq = tiled_untied_sae_grads(
+        e, dec, params_stacked["encoder_bias"], alphas, batch,
+        batch_tile=bt, feat_tile=ft, interpret=interpret,
+        total_batch=total_batch, compute_dtype=compute_dtype)
+    if psum_axis is not None:
+        losses, de, dwn, db, activity = jax.lax.psum(
+            (losses, de, dwn, db, activity), psum_axis)
+        gnorm = None
+    else:
+        gnorm = jnp.sqrt(grad_sq)
+    bias = params_stacked["encoder_bias"]
+    decay_loss, db = untied_bias_decay_terms(bias, bias_decays, db)
+    losses["bias_decay"] = decay_loss
+    grads = {"encoder": de, "encoder_bias": db,
+             "decoder": normalize_with_vjp(dec, dwn)}
+    return losses, grads, activity, gnorm

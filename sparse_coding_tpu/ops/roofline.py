@@ -1,0 +1,326 @@
+"""Roofline-driven kernel-path admission for the ensemble engine (r11).
+
+``Ensemble._resolve_step`` used to make a BINARY choice: if the untiled
+fused kernels' VMEM working set admitted a batch tile, ride them,
+otherwise silently drop to XLA autodiff — which is exactly what happened
+at the paper's canonical dict ratios 16–96 (the untiled kernels keep a
+whole [n_feats, d] matrix resident per member). This module replaces
+that with an explicit per-step accounting of **HBM bytes moved** and
+**MXU flops executed** for every candidate kernel path, plus the VMEM
+admission rule for each, and picks the ``(path, batch_tile, feat_tile)``
+with the lowest modeled step time.
+
+The model is for RANKING admissible paths, not predicting wall clock:
+
+- ``est_s = max(hbm_bytes / HBM_BYTES_PER_S,
+                mxu_flops / (MXU_PEAK_FLOPS · efficiency))`` — the
+  classic roofline, with a measured efficiency for the Pallas kernels
+  (0.61 MFU on-chip at the bench shape, BENCH_r02/BENCH_SUITE_TPU) and
+  a calibrated discount for XLA autodiff (the fused/autodiff throughput
+  ratio measured 1.5–1.8x at compute-bound shapes, BENCH_VARIANTS.json
+  r4: 170k vs 112k acts/s).
+- Chip constants default to v5e (the tunnel-attached generation);
+  absolute seconds are wrong on other chips but every RANKING the
+  engine needs is bandwidth/peak-ratio-stable across generations.
+- Ties (common: same-flops paths at compute-bound shapes) break by the
+  fixed preference order ``train_step > train_step_tiled > two_stage >
+  two_stage_tiled`` — whole-step beats two-stage (the r4 on-chip A/B
+  measured ~9%, consistent with its smaller byte count), untiled beats
+  tiled (no recompute flops, no weight re-streaming).
+- Autodiff is never RANKED against fused candidates — at every measured
+  shape a fitting fused kernel won — it is the fallback when no fused
+  tile admits (e.g. a batch size no candidate tile divides), and the
+  resolution is now a counted, reported event
+  (``ensemble.path_resolved`` — obs.report "kernel paths" section)
+  instead of an invisible flip.
+
+Per-step byte accounting (per member; N members; P = n·d·4 param bytes,
+Pm with the moments itemsize, X = B·d·stream bytes, X4 = B·d·4,
+C = B·n·4 the code matrix):
+
+| path             | HBM bytes                                | flops    |
+|------------------|------------------------------------------|----------|
+| autodiff         | X4 + 4·C + 2·P·mats + adam + sentinel    | 12·B·n·d |
+| two_stage        | X + 2·P·mats + adam + sentinel           | 10·B·n·d |
+| train_step tied  | X + 2·(P+2·Pm) + 2·P (delta sentinel)    | 10·B·n·d |
+| train_step untied| X + 4·P + epilogue                       | 10·B·n·d |
+| two_stage_tiled  | fwd+resid+bwd streams + adam + ½sentinel | 12·B·n·d |
+| train_step_tiled | fwd+resid+bwd streams + epilogue         | 12·B·n·d |
+
+where ``adam = mats·(3·P + 4·Pm)`` (XLA optimizer pass), ``sentinel =
+2·P·mats`` (the XLA grad+update global-norm passes the PR-10 sentinel
+costs on paths that don't fold norms into a kernel epilogue — the tiled
+kernels and the whole-step epilogues fold them, see
+ops/fused_sae_tiled.py), ``epilogue = mats·(3·P + 4·Pm)`` (the fused
+Adam/VJP kernel pass), and the tiled streams are
+``(B/bt)·P·mats + X + X4`` (forward: weights re-streamed per batch
+tile), ``2·X4 + X`` (residual formation), and
+``(n/ft)·(X + X4) + 2·P·mats`` (backward: x and r re-streamed per
+feature tile). The 12-vs-10 flops gap is the flash recompute trade.
+
+Unit-pinned by tests/test_roofline.py; the admission tile pickers are
+the SAME functions the kernel wrappers call, so a chosen plan can never
+disagree with the kernel's own admission.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from sparse_coding_tpu.ops.fused_sae import (
+    pick_batch_tile,
+    pick_epilogue_tile,
+    pick_tied_epilogue_tile,
+    pick_train_step_tile,
+    tile_fits,
+    train_tile_fits,
+)
+from sparse_coding_tpu.ops.fused_sae_tiled import pick_tiled_tiles
+
+# v5e spec-sheet constants (see the module docstring: ranking, not
+# wall-clock) and the measured efficiency calibrations
+HBM_BYTES_PER_S = 819e9
+MXU_PEAK_FLOPS = 197e12
+KERNEL_MXU_EFF = 0.61   # BENCH_r02 on-chip MFU at the bench shape
+AUTODIFF_MXU_EFF = 0.35  # fused/autodiff ≈ 1.5–1.8x (BENCH_VARIANTS r4)
+
+# every kernel path _resolve_step can land on; the parity-coverage lint
+# (tests/test_roofline.py) asserts each has a named parity test
+KERNEL_PATHS = ("train_step", "train_step_tiled", "two_stage",
+                "two_stage_tiled")
+_PREFERENCE = {p: i for i, p in enumerate(KERNEL_PATHS)}
+
+# which paths exist per bucket family / placement. masked_tied: the
+# coef_mask operand rides the two-stage grads kernels only. sharded:
+# the whole-step paths fold the optimizer update into the kernel, but
+# under shard_map the data-axis psum must run BETWEEN grads and Adam,
+# so meshes keep the two-stage paths.
+FAMILY_PATHS = {
+    "tied": KERNEL_PATHS,
+    "untied": KERNEL_PATHS,
+    "masked_tied": ("two_stage", "two_stage_tiled"),
+}
+SHARDED_PATHS = ("two_stage", "two_stage_tiled")
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelPlan:
+    """One resolved admission decision: which program the next step runs
+    and why. ``path`` is a KERNEL_PATHS entry, or None = autodiff.
+    bytes/flops/est_s are the ranking model's numbers (whole step, all
+    members)."""
+
+    path: Optional[str]
+    batch_tile: Optional[int] = None
+    feat_tile: Optional[int] = None
+    hbm_bytes: float = 0.0
+    mxu_flops: float = 0.0
+    est_s: float = 0.0
+    reason: str = ""
+
+
+def _est_s(hbm_bytes: float, mxu_flops: float, eff: float) -> float:
+    return max(hbm_bytes / HBM_BYTES_PER_S,
+               mxu_flops / (MXU_PEAK_FLOPS * eff))
+
+
+def path_cost(path: Optional[str], n_members: int, batch: int, n_feats: int,
+              d: int, *, batch_itemsize: int = 4, n_mats: int = 1,
+              moments_itemsize: int = 4, batch_tile: Optional[int] = None,
+              feat_tile: Optional[int] = None,
+              sentinel: bool = True) -> tuple[float, float]:
+    """(hbm_bytes, mxu_flops) for one whole step on this path — the table
+    in the module docstring. ``path=None`` models XLA autodiff."""
+    p = n_feats * d * 4
+    pm = n_feats * d * moments_itemsize
+    x = batch * d * batch_itemsize
+    x4 = batch * d * 4
+    c = batch * n_feats * 4
+    adam = n_mats * (3 * p + 4 * pm)
+    sent = (2 * p * n_mats) if sentinel else 0
+    epilogue = n_mats * (3 * p + 4 * pm)
+    mad = 2.0 * batch * n_feats * d  # one [B,d]x[d,n] matmul
+
+    if path is None:  # autodiff
+        per = x4 + 4 * c + 2 * p * n_mats + adam + sent
+        flops = 6 * mad
+    elif path == "two_stage":
+        per = x + 2 * p * n_mats + adam + sent
+        flops = 5 * mad
+    elif path == "train_step":
+        if n_mats == 1:  # tied one-kernel pass + XLA delta-norm sentinel
+            per = x + 2 * (p + 2 * pm) + (2 * p if sentinel else 0)
+        else:  # untied: grads kernel + fused Adam/VJP epilogue kernel
+            per = x + 2 * p * n_mats + epilogue
+        flops = 5 * mad
+    elif path in ("two_stage_tiled", "train_step_tiled"):
+        bt = batch_tile or batch
+        ft = feat_tile or n_feats
+        fwd = (batch // bt) * p * n_mats + x + x4
+        resid = 2 * x4 + x
+        bwd = (n_feats // ft) * (x + x4) + 2 * p * n_mats
+        per = fwd + resid + bwd
+        if path == "two_stage_tiled":
+            # grad norms are kernel-folded; the update norm stays XLA
+            per += adam + (p * n_mats if sentinel else 0)
+        else:
+            per += epilogue
+        flops = 6 * mad
+    else:
+        raise ValueError(f"unknown kernel path {path!r}")
+    return float(n_members) * per, float(n_members) * flops
+
+
+def _admit(path: str, batch: int, n_feats: int, d: int, *,
+           batch_itemsize: int, compute_itemsize: int, n_mats: int,
+           moments_itemsize: int, batch_tile: Optional[int],
+           feat_tile: Optional[int],
+           lane_rule: bool = True) -> Optional[tuple[Optional[int],
+                                                     Optional[int]]]:
+    """(batch_tile, feat_tile) admission for one path, or None. Explicit
+    tiles must themselves pass (same rule the kernels apply); an explicit
+    feat_tile pins resolution to the TILED paths (it has no meaning for
+    the untiled kernels)."""
+    if path in ("two_stage", "train_step") and feat_tile is not None:
+        return None
+    if path == "two_stage":
+        if batch_tile is not None:
+            ok = tile_fits(batch, batch_tile, n_feats, d, batch_itemsize,
+                           compute_itemsize=compute_itemsize, n_mats=n_mats)
+            return (batch_tile, None) if ok else None
+        bt = pick_batch_tile(batch, n_feats, d, batch_itemsize=batch_itemsize,
+                             compute_itemsize=compute_itemsize, n_mats=n_mats)
+        return None if bt is None else (bt, None)
+    if path == "train_step":
+        if n_mats == 2:
+            # untied whole-step = the SAME grads kernel as two_stage plus
+            # the feature-tiled Adam/VJP epilogue kernel
+            pair = _admit("two_stage", batch, n_feats, d,
+                          batch_itemsize=batch_itemsize,
+                          compute_itemsize=compute_itemsize, n_mats=n_mats,
+                          moments_itemsize=moments_itemsize,
+                          batch_tile=batch_tile, feat_tile=None)
+            if pair is None or pick_epilogue_tile(n_feats, d) is None:
+                return None
+            return pair
+        if batch_tile is not None:
+            ok = train_tile_fits(batch, batch_tile, n_feats, d,
+                                 batch_itemsize, compute_itemsize=compute_itemsize,
+                                 n_mats=n_mats, moments_itemsize=moments_itemsize)
+            return (batch_tile, None) if ok else None
+        bt = pick_train_step_tile(batch, n_feats, d,
+                                  batch_itemsize=batch_itemsize,
+                                  compute_itemsize=compute_itemsize,
+                                  n_mats=n_mats,
+                                  moments_itemsize=moments_itemsize)
+        return None if bt is None else (bt, None)
+    # tiled paths (lane_rule=False for interpret-mode buckets — same
+    # relaxation prepare_tiled_batch applies, so resolution and the
+    # kernels' own admission can never disagree)
+    pair = pick_tiled_tiles(batch, n_feats, d, batch_itemsize=batch_itemsize,
+                            compute_itemsize=compute_itemsize, n_mats=n_mats,
+                            batch_tile=batch_tile, feat_tile=feat_tile,
+                            lane_rule=lane_rule)
+    if pair is None:
+        return None
+    if path == "train_step_tiled":
+        epi = (pick_epilogue_tile(n_feats, d) if n_mats == 2
+               else pick_tied_epilogue_tile(n_feats, d))
+        if epi is None:
+            return None
+    return pair
+
+
+def candidate_plans(*, n_members: int, batch: int, n_feats: int, d: int,
+                    family: str, sharded: bool = False,
+                    batch_itemsize: int = 4, compute_itemsize: int = 4,
+                    moments_itemsize: int = 4,
+                    batch_tile: Optional[int] = None,
+                    feat_tile: Optional[int] = None,
+                    sentinel: bool = True,
+                    lane_rule: bool = True,
+                    paths: Optional[tuple] = None) -> list[KernelPlan]:
+    """Every VMEM-admissible fused plan for this shape, unranked."""
+    n_mats = 2 if family == "untied" else 1
+    allowed = paths if paths is not None else FAMILY_PATHS[family]
+    if sharded:
+        allowed = tuple(p for p in allowed if p in SHARDED_PATHS)
+    out = []
+    for path in allowed:
+        pair = _admit(path, batch, n_feats, d, batch_itemsize=batch_itemsize,
+                      compute_itemsize=compute_itemsize, n_mats=n_mats,
+                      moments_itemsize=moments_itemsize,
+                      batch_tile=batch_tile, feat_tile=feat_tile,
+                      lane_rule=lane_rule)
+        if pair is None:
+            continue
+        bt, ft = pair
+        hbm, flops = path_cost(path, n_members, batch, n_feats, d,
+                               batch_itemsize=batch_itemsize, n_mats=n_mats,
+                               moments_itemsize=moments_itemsize,
+                               batch_tile=bt, feat_tile=ft,
+                               sentinel=sentinel)
+        out.append(KernelPlan(path=path, batch_tile=bt, feat_tile=ft,
+                              hbm_bytes=hbm, mxu_flops=flops,
+                              est_s=_est_s(hbm, flops, KERNEL_MXU_EFF),
+                              reason="roofline"))
+    return out
+
+
+def autodiff_plan(n_members: int, batch: int, n_feats: int, d: int, *,
+                  batch_itemsize: int = 4, n_mats: int = 1,
+                  moments_itemsize: int = 4, sentinel: bool = True,
+                  reason: str = "no_admissible_tile") -> KernelPlan:
+    hbm, flops = path_cost(None, n_members, batch, n_feats, d,
+                           batch_itemsize=batch_itemsize, n_mats=n_mats,
+                           moments_itemsize=moments_itemsize,
+                           sentinel=sentinel)
+    return KernelPlan(path=None, hbm_bytes=hbm, mxu_flops=flops,
+                      est_s=_est_s(hbm, flops, AUTODIFF_MXU_EFF),
+                      reason=reason)
+
+
+def choose_plan(*, n_members: int, batch: int, n_feats: int, d: int,
+                family: str, sharded: bool = False, batch_itemsize: int = 4,
+                compute_itemsize: int = 4, moments_itemsize: int = 4,
+                forced_path: Optional[str] = None,
+                batch_tile: Optional[int] = None,
+                feat_tile: Optional[int] = None,
+                sentinel: bool = True,
+                lane_rule: bool = True) -> KernelPlan:
+    """The admission decision: lowest-modeled-time admissible fused plan
+    (ties break by the KERNEL_PATHS preference order), the forced path if
+    ``forced_path`` pins one, or the autodiff fallback plan (path=None,
+    reason says why) when nothing admits. ``lane_rule=False`` relaxes the
+    Mosaic lane rule on feature tiles for interpret-mode buckets, exactly
+    as the kernels' own prepare_tiled_batch does."""
+    n_mats = 2 if family == "untied" else 1
+    paths = None
+    if forced_path is not None:
+        allowed = FAMILY_PATHS[family]
+        if sharded:
+            allowed = tuple(p for p in allowed if p in SHARDED_PATHS)
+        if forced_path not in allowed:
+            return autodiff_plan(
+                n_members, batch, n_feats, d, batch_itemsize=batch_itemsize,
+                n_mats=n_mats, moments_itemsize=moments_itemsize,
+                sentinel=sentinel, reason=f"forced_unavailable:{forced_path}")
+        paths = (forced_path,)
+    plans = candidate_plans(
+        n_members=n_members, batch=batch, n_feats=n_feats, d=d,
+        family=family, sharded=sharded, batch_itemsize=batch_itemsize,
+        compute_itemsize=compute_itemsize, moments_itemsize=moments_itemsize,
+        batch_tile=batch_tile, feat_tile=feat_tile, sentinel=sentinel,
+        lane_rule=lane_rule, paths=paths)
+    if not plans:
+        return autodiff_plan(
+            n_members, batch, n_feats, d, batch_itemsize=batch_itemsize,
+            n_mats=n_mats, moments_itemsize=moments_itemsize,
+            sentinel=sentinel,
+            reason=(f"forced_unfit:{forced_path}" if forced_path
+                    else "no_admissible_tile"))
+    best = min(plans, key=lambda pl: (pl.est_s, _PREFERENCE[pl.path]))
+    if forced_path is not None:
+        best = dataclasses.replace(best, reason="forced")
+    return best

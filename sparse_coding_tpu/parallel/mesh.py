@@ -32,6 +32,32 @@ MODEL_AXIS = "model"
 DATA_AXIS = "data"
 
 
+def compat_shard_map(fn, mesh: Mesh, in_specs, out_specs):
+    """Version-portable ``shard_map`` with replication checking off: newer
+    jax exposes ``jax.shard_map(check_vma=...)``, older releases (the
+    container's baked toolchain among them) have
+    ``jax.experimental.shard_map.shard_map(check_rep=...)``. One home so
+    every mesh-composed program (ensemble, big-SAE, sequence-parallel
+    forward) builds on either."""
+    smap = getattr(jax, "shard_map", None)
+    if smap is not None:
+        return smap(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    check_vma=False)
+    from jax.experimental.shard_map import shard_map as smap_exp
+
+    return smap_exp(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    check_rep=False)
+
+
+def compat_axis_size(axis_name: str):
+    """Version-portable ``jax.lax.axis_size`` (missing on older jax):
+    ``psum(1, axis)`` is the portable axis-size idiom — constant-folded
+    by XLA, no runtime collective. Call inside shard_map/vmap bodies."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def make_mesh(mesh_model: int = 1, mesh_data: Optional[int] = None,
               devices: Optional[list] = None) -> Mesh:
     """Build a ("model", "data") mesh.

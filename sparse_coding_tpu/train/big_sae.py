@@ -284,7 +284,7 @@ def _sharded_fused_loss_and_grads(params: dict, batch: Array, l1_alpha,
     leaves stay local to their shard), the centering grad and scalar
     metrics over both axes. Same global-batch normalization convention as
     ensemble.make_fused_tied_step_sharded."""
-    from jax import shard_map
+    from sparse_coding_tpu.parallel.mesh import compat_shard_map
 
     from sparse_coding_tpu.ops.fused_big_sae import (
         big_sae_backward,
@@ -340,10 +340,9 @@ def _sharded_fused_loss_and_grads(params: dict, batch: Array, l1_alpha,
     aux_specs = {"mse": P(), "sparsity": P(), "c_totals_delta": P("model"),
                  "mse_losses": P("data"), "l0_mean": P()}
     grad_specs = dict(param_specs)
-    fn = shard_map(local_fn, mesh=mesh,
-                   in_specs=(param_specs, P(), P("data")),
-                   out_specs=(P(), aux_specs, grad_specs),
-                   check_vma=False)
+    fn = compat_shard_map(local_fn, mesh,
+                          in_specs=(param_specs, P(), P("data")),
+                          out_specs=(P(), aux_specs, grad_specs))
     return fn(params, jnp.asarray(l1_alpha, jnp.float32), batch)
 
 

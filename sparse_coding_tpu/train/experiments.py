@@ -35,6 +35,22 @@ def _sentinel(cfg: EnsembleArgs) -> bool:
     return bool(getattr(cfg, "sentinel", True))
 
 
+def _engine_kwargs(cfg: EnsembleArgs) -> dict:
+    """Fused-kernel engine knobs from the sweep config (config.py, ISSUE
+    11) — one home so every builder passes the same set and the fault
+    matrix can pin a sweep to e.g. the tiled path with fused_interpret
+    on CPU. Defaults reproduce the pre-knob behavior (auto admission)."""
+    use_fused = {"on": True, "off": False}.get(
+        str(getattr(cfg, "use_fused", "auto")), "auto")
+    return dict(
+        sentinel=_sentinel(cfg),
+        use_fused=use_fused,
+        fused_path=getattr(cfg, "fused_path", None),
+        fused_batch_tile=getattr(cfg, "fused_batch_tile", None),
+        fused_feat_tile=getattr(cfg, "fused_feat_tile", None),
+        fused_interpret=bool(getattr(cfg, "fused_interpret", False)))
+
+
 def _activation_dim(cfg: EnsembleArgs) -> int:
     from sparse_coding_tpu.data.shard_store import open_store
 
@@ -54,7 +70,7 @@ def dense_l1_range_experiment(cfg: EnsembleArgs, mesh=None,
     members = [sig.init(k, d, n_dict, l1_alpha=float(l1))
                for k, l1 in zip(keys, l1s)]
     ens = Ensemble(members, sig, lr=cfg.lr, adam_eps=cfg.adam_epsilon,
-                   mesh=mesh, sentinel=_sentinel(cfg))
+                   mesh=mesh, **_engine_kwargs(cfg))
     hypers = [{"l1_alpha": float(l1), "dict_size": n_dict, "tied": cfg.tied_ae}
               for l1 in l1s]
     return [(ens, hypers, "dense_l1_range")]
@@ -75,7 +91,7 @@ def tied_vs_not_experiment(cfg: EnsembleArgs, mesh=None,
         members = [sig.init(k, d, n_dict, l1_alpha=float(l1))
                    for k, l1 in zip(keys, l1s)]
         ens = Ensemble(members, sig, lr=cfg.lr, adam_eps=cfg.adam_epsilon,
-                   mesh=mesh, sentinel=_sentinel(cfg))
+                   mesh=mesh, **_engine_kwargs(cfg))
         hypers = [{"l1_alpha": float(l1), "dict_size": n_dict, "tied": tied}
                   for l1 in l1s]
         out.append((ens, hypers, name))
@@ -119,7 +135,7 @@ def dict_ratio_experiment(cfg: EnsembleArgs, mesh=None,
                for k, n in zip(keys, sizes)]
     ens = Ensemble(members, FunctionalMaskedTiedSAE, lr=cfg.lr,
                    adam_eps=cfg.adam_epsilon, mesh=mesh,
-                   sentinel=_sentinel(cfg))
+                   **_engine_kwargs(cfg))
     hypers = [{"l1_alpha": l1_alpha, "dict_size": n, "dict_ratio": r}
               for n, r in zip(sizes, ratios)]
     return [(ens, hypers, "dict_ratio")]
@@ -215,7 +231,7 @@ def centered_l1_range_experiment(cfg: EnsembleArgs, mesh=None,
                for k, l1 in zip(keys, l1s)]
     ens = Ensemble(members, FunctionalTiedSAE, lr=cfg.lr,
                    adam_eps=cfg.adam_epsilon, mesh=mesh,
-                   sentinel=_sentinel(cfg))
+                   **_engine_kwargs(cfg))
     hypers = [{"l1_alpha": float(l1), "dict_size": n_dict, "tied": True,
                "centered": True, "whitened": whiten} for l1 in l1s]
     return [(ens, hypers, "centered_l1_range")]

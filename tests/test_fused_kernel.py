@@ -18,6 +18,11 @@ from sparse_coding_tpu.utils.trees import stack_trees
 
 N_MEMBERS, N_FEATS, D, BATCH = 3, 64, 32, 512
 
+# ensemble.KERNEL_PATHS labels whose end-to-end training parity this
+# module locks (the coverage lint in tests/test_roofline.py fails if a
+# path ever lands without a parity test naming it)
+PARITY_COVERS = {"two_stage", "train_step"}
+
 
 def _stacked_members(key):
     keys = jax.random.split(key, N_MEMBERS)
@@ -231,8 +236,10 @@ def test_fused_bf16_tile_accounting():
 def test_fused_supported_budget():
     from sparse_coding_tpu.ops.fused_sae import pick_batch_tile
 
-    assert fused_supported(32, 2048, 2048, 512)  # bench config fits (tile 512)
-    assert pick_batch_tile(2048, 2048, 512) == 512
+    assert fused_supported(32, 2048, 2048, 512)  # bench config fits
+    # r11 extended PREFERRED_TILES with 1024: it fits the bench shape with
+    # ~36 MiB headroom and halves the grid revisits of tile 512
+    assert pick_batch_tile(2048, 2048, 512) == 1024
     assert not fused_supported(1, 2048, 65536, 2048)  # too big for VMEM
     assert not fused_supported(1, 1000, 64, 32)  # no dividing tile
 

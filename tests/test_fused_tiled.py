@@ -1,0 +1,473 @@
+"""Feature-axis-tiled fused ensemble kernels (ops/fused_sae_tiled.py,
+ISSUE 11) vs the autodiff reference path — Pallas interpret mode on the
+CPU mesh, plus AOT Mosaic lowering for the real TPU programs.
+
+PARITY_COVERS declares which ensemble.KERNEL_PATHS labels this module's
+training-parity tests exercise end to end; the coverage lint
+(tests/test_roofline.py) asserts the union over test modules covers every
+path reachable from Ensemble._resolve_step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.ensemble import (
+    Ensemble,
+    adam_optimizer,
+    make_tiled_step,
+)
+from sparse_coding_tpu.models.sae import (
+    FunctionalMaskedTiedSAE,
+    FunctionalSAE,
+    FunctionalTiedSAE,
+)
+from sparse_coding_tpu.ops.fused_sae_tiled import (
+    fused_tied_sae_tiled_loss_and_grads,
+    fused_untied_sae_tiled_loss_and_grads,
+    pick_tiled_tiles,
+    tiled_tied_sae_grads,
+)
+from sparse_coding_tpu.utils.trees import stack_trees
+
+PARITY_COVERS = {"two_stage_tiled", "train_step_tiled"}
+
+N_MEMBERS, N_FEATS, D, BATCH = 3, 64, 32, 512
+
+
+def _stacked_members(key, sig=FunctionalTiedSAE, n_feats=N_FEATS, d=D,
+                     **init_kwargs):
+    keys = jax.random.split(key, N_MEMBERS)
+    l1s = [1e-4, 1e-3, 3e-3]
+    members = [sig.init(k, d, n_feats, l1_alpha=l1, **init_kwargs)
+               for k, l1 in zip(keys, l1s)]
+    params = stack_trees([p for p, _ in members])
+    buffers = stack_trees([b for _, b in members])
+    return members, params, buffers, jnp.asarray(l1s)
+
+
+def test_tiled_tied_matches_autodiff(rng):
+    """Multi-feature-tile, multi-batch-tile grads vs vmapped autodiff —
+    the tiled twin of test_fused_kernel.test_fused_matches_autodiff."""
+    k_init, k_data = jax.random.split(rng)
+    _, params, buffers, alphas = _stacked_members(k_init)
+    batch = jax.random.normal(k_data, (BATCH, D))
+
+    losses, grads, activity, gnorm = fused_tied_sae_tiled_loss_and_grads(
+        params, alphas, batch, batch_tile=128, feat_tile=16, interpret=True)
+
+    (ref_loss, ref_aux), ref_grads = jax.vmap(
+        jax.value_and_grad(FunctionalTiedSAE.loss, has_aux=True),
+        in_axes=(0, 0, None))(params, buffers, batch)
+    total = losses["mse"] + losses["l1"]
+    np.testing.assert_allclose(np.asarray(total), np.asarray(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(losses["l0"]),
+                               np.asarray(ref_aux.l0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(activity),
+                               np.asarray(ref_aux.feat_activity), atol=0.5)
+    for name in ("encoder", "encoder_bias"):
+        np.testing.assert_allclose(np.asarray(grads[name]),
+                                   np.asarray(ref_grads[name]),
+                                   rtol=2e-4, atol=1e-6,
+                                   err_msg=f"grad mismatch: {name}")
+    assert gnorm.shape == (N_MEMBERS,) and np.isfinite(np.asarray(gnorm)).all()
+
+
+@pytest.mark.parametrize("bias_decay", [0.0, 0.03])
+def test_tiled_untied_matches_autodiff(rng, bias_decay):
+    k_init, k_data = jax.random.split(rng)
+    _, params, buffers, alphas = _stacked_members(
+        k_init, sig=FunctionalSAE, bias_decay=bias_decay)
+    bds = jnp.full((N_MEMBERS,), bias_decay)
+    batch = jax.random.normal(k_data, (BATCH, D))
+
+    losses, grads, activity, gnorm = fused_untied_sae_tiled_loss_and_grads(
+        params, alphas, bds, batch, batch_tile=128, feat_tile=32,
+        interpret=True)
+    (ref_loss, ref_aux), ref_grads = jax.vmap(
+        jax.value_and_grad(FunctionalSAE.loss, has_aux=True),
+        in_axes=(0, 0, None))(params, buffers, batch)
+    total = losses["mse"] + losses["l1"] + losses["bias_decay"]
+    np.testing.assert_allclose(np.asarray(total), np.asarray(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    for name in ("encoder", "encoder_bias", "decoder"):
+        np.testing.assert_allclose(np.asarray(grads[name]),
+                                   np.asarray(ref_grads[name]),
+                                   rtol=2e-4, atol=1e-6,
+                                   err_msg=f"grad mismatch: {name}")
+
+
+def test_tiled_masked_matches_autodiff(rng):
+    """Masked family (the dict-ratio grid's padded stacks) through the
+    tiled kernels: coef_mask rides both the forward and the recompute."""
+    k_init, k_data = jax.random.split(rng)
+    keys = jax.random.split(k_init, 3)
+    sizes = [16, 32, 64]
+    members = [FunctionalMaskedTiedSAE.init(k, D, n, 64, l1_alpha=l1)
+               for k, n, l1 in zip(keys, sizes, [1e-4, 1e-3, 3e-3])]
+    params = stack_trees([p for p, _ in members])
+    buffers = stack_trees([b for _, b in members])
+    alphas = jnp.asarray([1e-4, 1e-3, 3e-3])
+    batch = jax.random.normal(k_data, (BATCH, D))
+
+    losses, grads, activity, _ = fused_tied_sae_tiled_loss_and_grads(
+        params, alphas, batch, batch_tile=64, feat_tile=16, interpret=True,
+        coef_mask=buffers["coef_mask"])
+    (ref_loss, ref_aux), ref_grads = jax.vmap(
+        jax.value_and_grad(FunctionalMaskedTiedSAE.loss, has_aux=True),
+        in_axes=(0, 0, None))(params, buffers, batch)
+    np.testing.assert_allclose(np.asarray(losses["mse"] + losses["l1"]),
+                               np.asarray(ref_loss), rtol=1e-5, atol=1e-6)
+    for name in ("encoder", "encoder_bias"):
+        np.testing.assert_allclose(np.asarray(grads[name]),
+                                   np.asarray(ref_grads[name]),
+                                   rtol=2e-4, atol=1e-6, err_msg=name)
+    # padded (masked-off) features never fire
+    coef_mask = np.asarray(buffers["coef_mask"]).astype(bool)
+    assert not np.asarray(activity)[~coef_mask].any()
+
+
+@pytest.mark.parametrize("sig", [FunctionalTiedSAE, FunctionalSAE])
+def test_tiled_ratio32_parity(rng, sig):
+    """ISSUE 11 acceptance: EXACT fused-vs-autodiff parity at the
+    canonical ratio-32 shape (n_feats=16384, d=512) — the shape the
+    untiled kernels could never admit — for tied and untied."""
+    k_init, k_data = jax.random.split(rng)
+    params0, buffers0 = sig.init(k_init, 512, 16384, l1_alpha=1e-3)
+    params = stack_trees([params0])
+    buffers = stack_trees([buffers0])
+    alphas = jnp.asarray([1e-3])
+    batch = jax.random.normal(k_data, (128, 512))
+
+    if sig is FunctionalTiedSAE:
+        losses, grads, _, _ = fused_tied_sae_tiled_loss_and_grads(
+            params, alphas, batch, batch_tile=64, feat_tile=4096,
+            interpret=True)
+        total = losses["mse"] + losses["l1"]
+    else:
+        losses, grads, _, _ = fused_untied_sae_tiled_loss_and_grads(
+            params, alphas, jnp.zeros((1,)), batch, batch_tile=64,
+            feat_tile=4096, interpret=True)
+        total = losses["mse"] + losses["l1"] + losses["bias_decay"]
+    (ref_loss, _), ref_grads = jax.vmap(
+        jax.value_and_grad(sig.loss, has_aux=True),
+        in_axes=(0, 0, None))(params, buffers, batch)
+    np.testing.assert_allclose(np.asarray(total), np.asarray(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    for name in grads:
+        np.testing.assert_allclose(np.asarray(grads[name]),
+                                   np.asarray(ref_grads[name]),
+                                   rtol=2e-4, atol=1e-6,
+                                   err_msg=f"ratio-32 grad mismatch: {name}")
+
+
+def test_tiled_tile_boundaries(rng):
+    """n_feats not divisible by the big feature tiles: the picker walks
+    down to a dividing candidate; parity holds across ragged tile counts;
+    an explicit non-dividing feat_tile refuses loudly."""
+    k_init, k_data = jax.random.split(rng)
+    batch = jax.random.normal(k_data, (256, D))
+    for n_feats in (96, 40):  # 3×32 and 5×8 feature tiles (interpret)
+        _, params, buffers, alphas = _stacked_members(k_init,
+                                                      n_feats=n_feats)
+        # Mosaic's lane rule rejects sub-128 partial feature tiles on real
+        # TPU (no dividing candidate here → no tiled plan); interpret-mode
+        # admission (lane_rule=False) still exercises the ragged grids
+        assert pick_tiled_tiles(256, n_feats, D) is None
+        pair = pick_tiled_tiles(256, n_feats, D, lane_rule=False)
+        assert pair is not None and n_feats % pair[1] == 0 < pair[1] < n_feats
+        losses, grads, _, _ = fused_tied_sae_tiled_loss_and_grads(
+            params, alphas, batch, interpret=True)
+        (ref_loss, _), ref_grads = jax.vmap(
+            jax.value_and_grad(FunctionalTiedSAE.loss, has_aux=True),
+            in_axes=(0, 0, None))(params, buffers, batch)
+        np.testing.assert_allclose(
+            np.asarray(losses["mse"] + losses["l1"]),
+            np.asarray(ref_loss), rtol=1e-5, atol=1e-6)
+        for name in grads:
+            np.testing.assert_allclose(np.asarray(grads[name]),
+                                       np.asarray(ref_grads[name]),
+                                       rtol=2e-4, atol=1e-6,
+                                       err_msg=f"n={n_feats}: {name}")
+    _, params, _, alphas = _stacked_members(k_init)
+    with pytest.raises(ValueError, match="tile pair"):
+        fused_tied_sae_tiled_loss_and_grads(
+            params, alphas, batch, feat_tile=48, interpret=True)
+
+
+def test_tiled_two_stage_training_matches_standard(rng):
+    """Whole tiled two-stage training runs track the autodiff path
+    step-for-step (forced fused_path='two_stage_tiled')."""
+    k_init, k_data = jax.random.split(rng)
+    members = [FunctionalTiedSAE.init(k, D, N_FEATS, l1_alpha=1e-3)
+               for k in jax.random.split(k_init, 2)]
+    batch = jax.random.normal(k_data, (BATCH, D))
+
+    tiled = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=True,
+                     fused_interpret=True, donate=False,
+                     fused_path="two_stage_tiled")
+    standard = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=False,
+                        donate=False)
+    for _ in range(5):
+        aux_t = tiled.step_batch(batch)
+        aux_s = standard.step_batch(batch)
+    assert tiled.fused_path == "two_stage_tiled"
+    assert tiled.fused_plan.reason == "forced"
+    np.testing.assert_allclose(np.asarray(aux_t.losses["loss"]),
+                               np.asarray(aux_s.losses["loss"]), rtol=1e-4)
+    p_t = jax.device_get(tiled.state.params)
+    p_s = jax.device_get(standard.state.params)
+    for name in p_t:
+        np.testing.assert_allclose(p_t[name], p_s[name], rtol=1e-4,
+                                   atol=1e-6, err_msg=f"param drift: {name}")
+
+
+@pytest.mark.parametrize("sig", [FunctionalTiedSAE, FunctionalSAE])
+def test_tiled_train_step_matches_standard(rng, sig):
+    """The tiled WHOLE-STEP path (tiled grads + feature-tiled Adam/VJP
+    epilogue kernel) is numerically the autodiff path step for step,
+    including the optimizer moments the epilogue streams through VMEM."""
+    k_init, k_data = jax.random.split(rng)
+    kwargs = {} if sig is FunctionalTiedSAE else {"bias_decay": 0.01}
+    members = [sig.init(k, D, N_FEATS, l1_alpha=l1, **kwargs)
+               for k, l1 in zip(jax.random.split(k_init, 2), [1e-4, 3e-3])]
+    batch = jax.random.normal(k_data, (BATCH, D))
+
+    full = Ensemble(members, sig, lr=1e-3, use_fused=True,
+                    fused_interpret=True, donate=False,
+                    fused_path="train_step_tiled")
+    standard = Ensemble(members, sig, lr=1e-3, use_fused=False, donate=False)
+    for _ in range(5):
+        aux_f = full.step_batch(batch)
+        aux_s = standard.step_batch(batch)
+    assert full.fused_path == "train_step_tiled"
+    np.testing.assert_allclose(np.asarray(aux_f.losses["loss"]),
+                               np.asarray(aux_s.losses["loss"]), rtol=1e-4)
+    p_f = jax.device_get(full.state.params)
+    p_s = jax.device_get(standard.state.params)
+    for name in p_f:
+        np.testing.assert_allclose(p_f[name], p_s[name], rtol=1e-4,
+                                   atol=1e-6, err_msg=f"param drift: {name}")
+    mu_f = jax.device_get(full.state.opt_state.mu)
+    mu_s = jax.device_get(standard.state.opt_state.mu)
+    for name in mu_f:
+        np.testing.assert_allclose(mu_f[name], mu_s[name], rtol=1e-4,
+                                   atol=1e-7, err_msg=f"moment drift: {name}")
+    np.testing.assert_array_equal(
+        np.asarray(full.state.opt_state.count),
+        np.asarray(standard.state.opt_state.count))
+
+
+def test_tiled_sharded_matches_standard(rng):
+    """Mesh-composed tiled step: shard_map + the tiled kernel pair on each
+    device's (members × batch-rows) slice + psum — step-for-step equal to
+    the unsharded autodiff path. The sharded sentinel falls back to the
+    post-psum XLA grad norm (the kernel's per-shard partial norms don't
+    psum into the true norm)."""
+    from sparse_coding_tpu.parallel.mesh import make_mesh
+
+    k_init, k_data = jax.random.split(rng)
+    members = [FunctionalTiedSAE.init(k, D, N_FEATS, l1_alpha=1e-3)
+               for k in jax.random.split(k_init, 4)]
+    batch = jax.random.normal(k_data, (512, D))
+
+    mesh = make_mesh(2, 4)
+    sharded = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=True,
+                       fused_interpret=True, mesh=mesh, donate=False,
+                       fused_path="two_stage_tiled")
+    standard = Ensemble(members, FunctionalTiedSAE, lr=1e-3,
+                        use_fused=False, donate=False)
+    for _ in range(3):
+        aux_t = sharded.step_batch(batch)
+        aux_s = standard.step_batch(batch)
+    assert sharded.fused_path == "two_stage_tiled"
+    np.testing.assert_allclose(np.asarray(aux_t.losses["loss"]),
+                               np.asarray(aux_s.losses["loss"]), rtol=1e-4)
+    p_t = jax.device_get(sharded.state.params)
+    p_s = jax.device_get(standard.state.params)
+    for name in p_t:
+        np.testing.assert_allclose(p_t[name], p_s[name], rtol=1e-4,
+                                   atol=1e-6, err_msg=f"param drift: {name}")
+
+
+def test_ratio_shapes_resolve_tiled_in_auto(rng):
+    """ISSUE 11 acceptance: ratio-16 and ratio-32 shapes (d=512,
+    n_feats=8192/16384) resolve to a fused TILED path in auto mode — no
+    silent autodiff fallback. Resolution only (no kernel dispatch at this
+    scale on CPU); the resolved plan's tiles must divide the shape."""
+    for n_feats in (8192, 16384):
+        members = [FunctionalTiedSAE.init(k, 512, n_feats, l1_alpha=1e-3)
+                   for k in jax.random.split(rng, 2)]
+        ens = Ensemble(members, FunctionalTiedSAE, fused_interpret=True,
+                       donate=False)
+        ens._resolve_step(2048, 4)
+        assert ens.fused, f"ratio {n_feats // 512} fell back to autodiff"
+        assert ens.fused_path in ("two_stage_tiled", "train_step_tiled")
+        plan = ens.fused_plan
+        assert 2048 % plan.batch_tile == 0 and n_feats % plan.feat_tile == 0
+        assert plan.reason == "roofline"
+
+
+def test_sentinel_epilogue_freeze_bitwise_across_paths(rng):
+    """Guardian/sentinel semantics survive feature-axis tiling bit-exactly:
+    a quarantined (live-mask-frozen) member's params pass through tiled
+    steps bitwise unchanged — identical to the untiled path's freeze —
+    and a member whose step goes non-finite (NaN l1 coefficient) freezes
+    in-graph on the tiled paths while its neighbors keep training."""
+    k_init, k_data = jax.random.split(rng)
+    members = [FunctionalTiedSAE.init(k, D, N_FEATS, l1_alpha=1e-3)
+               for k in jax.random.split(k_init, 3)]
+    batch = jax.random.normal(k_data, (BATCH, D))
+    p0 = jax.device_get(stack_trees([p for p, _ in members]))
+
+    for path in ("two_stage", "two_stage_tiled", "train_step_tiled"):
+        ens = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=True,
+                       fused_interpret=True, donate=False, fused_path=path)
+        ens.freeze_members([1])
+        for _ in range(3):
+            aux = ens.step_batch(batch)
+        p = jax.device_get(ens.state.params)
+        for name in p:
+            np.testing.assert_array_equal(
+                p[name][1], p0[name][1],
+                err_msg=f"{path}: frozen member moved ({name})")
+            assert not np.array_equal(p[name][0], p0[name][0]), \
+                f"{path}: live member did not train ({name})"
+
+    # non-finite step: NaN alpha on member 0 → kernel-epilogue gnorm/loss
+    # go NaN → finite flag False → bitwise freeze, neighbors unaffected
+    for path in ("two_stage_tiled", "train_step_tiled"):
+        nan_members = [(dict(p), dict(b)) for p, b in members]
+        nan_members[0][1]["l1_alpha"] = jnp.asarray(jnp.nan)
+        ens = Ensemble(nan_members, FunctionalTiedSAE, lr=1e-3,
+                       use_fused=True, fused_interpret=True, donate=False,
+                       fused_path=path)
+        ref = Ensemble(members, FunctionalTiedSAE, lr=1e-3, use_fused=True,
+                       fused_interpret=True, donate=False, fused_path=path)
+        for _ in range(2):
+            aux = ens.step_batch(batch)
+            ref.step_batch(batch)
+        assert not bool(np.asarray(aux.finite)[0]), path
+        assert np.asarray(aux.finite)[1:].all(), path
+        p = jax.device_get(ens.state.params)
+        p_ref = jax.device_get(ref.state.params)
+        for name in p:
+            np.testing.assert_array_equal(
+                p[name][0], p0[name][0],
+                err_msg=f"{path}: NaN member not frozen at init ({name})")
+            np.testing.assert_array_equal(
+                p[name][1:], p_ref[name][1:],
+                err_msg=f"{path}: healthy members disturbed ({name})")
+
+
+def test_interpret_admission_matches_kernel_admission(rng):
+    """Code-review regression: resolution must apply the SAME lane-rule
+    relaxation the interpret-mode kernels do — an interpret bucket whose
+    n_feats has no 128-multiple tile (48 = 3×16) still resolves to a
+    forced tiled path and trains, instead of _resolve_step refusing a
+    shape prepare_tiled_batch would happily run."""
+    members = [FunctionalTiedSAE.init(k, 16, 48, l1_alpha=1e-3)
+               for k in jax.random.split(rng, 2)]
+    ens = Ensemble(members, FunctionalTiedSAE, use_fused=True,
+                   fused_interpret=True, donate=False,
+                   fused_path="two_stage_tiled")
+    ens.step_batch(jnp.ones((128, 16)))
+    assert ens.fused_path == "two_stage_tiled"
+    assert 48 % ens.fused_plan.feat_tile == 0
+
+
+def test_explicit_feat_tile_pins_tiled_path(rng):
+    """fused_feat_tile pins resolution to the tiled kernels (it has no
+    meaning for the untiled ones) and the explicit tile is honored."""
+    members = [FunctionalTiedSAE.init(k, D, N_FEATS, l1_alpha=1e-3)
+               for k in jax.random.split(rng, 2)]
+    ens = Ensemble(members, FunctionalTiedSAE, use_fused=True,
+                   fused_interpret=True, fused_feat_tile=N_FEATS,
+                   donate=False)
+    ens.step_batch(jnp.ones((256, D)))
+    assert ens.fused_path in ("two_stage_tiled", "train_step_tiled")
+    assert ens.fused_plan.feat_tile == N_FEATS
+
+
+# --- AOT Mosaic lowering gates ----------------------------------------------
+
+
+def test_tiled_kernels_lower_for_tpu():
+    """AOT Mosaic lowering for the tiled grads kernels at small and the
+    CANONICAL ratio-16/32 shapes (d=512, n_feats=8192/16384 — the ISSUE 11
+    acceptance shapes), f32/bf16 streams × f32/bf16 compute."""
+    from sparse_coding_tpu.ops.fused_sae_tiled import tiled_untied_sae_grads
+
+    shapes = [((2, 256, 32), (256, 32), 64, 128),
+              ((2, 8192, 512), (2048, 512), 256, 2048),
+              ((2, 16384, 512), (2048, 512), 256, 4096)]
+    for ws, xs, bt, ft in shapes:
+        e = jnp.zeros(ws)
+        b = jnp.zeros(ws[:2])
+        a = jnp.zeros((ws[0],))
+        for x_dtype in (jnp.float32, jnp.bfloat16):
+            for compute in ("float32", "bfloat16"):
+                x = jnp.zeros(xs, x_dtype)
+                jax.jit(
+                    lambda e, b, a, x, cd=compute, bt=bt, ft=ft:
+                    tiled_tied_sae_grads(e, b, a, x, bt, ft,
+                                         compute_dtype=cd)
+                ).trace(e, b, a, x).lower(lowering_platforms=("tpu",))
+        # untied (two weight matrices) and masked (coef_mask operand)
+        jax.jit(
+            lambda e, w, b, a, x, bt=bt, ft=ft:
+            tiled_untied_sae_grads(e, w, b, a, x, bt, ft)
+        ).trace(e, e, b, a, jnp.zeros(xs)).lower(lowering_platforms=("tpu",))
+        jax.jit(
+            lambda e, b, a, x, cm, bt=bt, ft=ft:
+            tiled_tied_sae_grads(e, b, a, x, bt, ft, coef_mask=cm)
+        ).trace(e, b, a, jnp.zeros(xs), jnp.ones(ws[:2])).lower(
+            lowering_platforms=("tpu",))
+
+
+def test_tied_epilogue_kernel_lowers_for_tpu():
+    """AOT Mosaic lowering of the tied feature-tiled Adam/VJP epilogue
+    (the tiled whole-step path's pass 2) incl. bf16 moment storage."""
+    from sparse_coding_tpu.ops.fused_sae import (
+        fused_tied_adam_vjp_update,
+        pick_tied_epilogue_tile,
+    )
+
+    for n_members, n_feats, d in ((2, 64, 32), (2, 16384, 512)):
+        big = jnp.zeros((n_members, n_feats, d))
+        vecn = jnp.zeros((n_members,))
+        ftile = pick_tied_epilogue_tile(n_feats, d)
+        assert ftile is not None
+        for m_dtype in (jnp.float32, jnp.bfloat16):
+            m = jnp.zeros((n_members, n_feats, d), m_dtype)
+            jax.jit(
+                lambda e, dw, mu, nu, lrs, bc1, bc2, ft=ftile:
+                fused_tied_adam_vjp_update(e, dw, mu, nu, lrs, bc1, bc2,
+                                           ftile=ft)
+            ).trace(big, big, m, m, vecn, vecn, vecn).lower(
+                lowering_platforms=("tpu",))
+
+
+def test_tiled_step_lowers_with_no_added_host_transfer(rng):
+    """ISSUE 11 AOT gate: the sentinel-guarded TILED step lowers for TPU
+    and its HLO gains NO host transfer over the sentinel-off program —
+    the kernel-epilogue norm fold keeps divergence safety entirely
+    device-side at high MFU."""
+    members = [FunctionalTiedSAE.init(k, 32, 256, l1_alpha=1e-3)
+               for k in jax.random.split(rng, 3)]
+    batch = jnp.zeros((128, 32))
+    texts = {}
+    for sentinel in (True, False):
+        ens = Ensemble(members, FunctionalTiedSAE, donate=False,
+                       sentinel=sentinel, fused_interpret=True)
+        step = make_tiled_step("tied", adam_optimizer(), batch_tile=64,
+                               feat_tile=128, donate=False,
+                               sentinel=sentinel)
+        texts[sentinel] = jax.jit(step).trace(ens.state, batch).lower(
+            lowering_platforms=("tpu",)).as_text()
+    assert texts[True] != texts[False]  # the sentinel is really in there
+    for marker in ("infeed", "outfeed", "send-start", "recv-start",
+                   "SendToHost", "RecvFromHost", "host_compute"):
+        assert texts[True].count(marker) == texts[False].count(marker) == 0, \
+            marker

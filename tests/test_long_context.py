@@ -31,11 +31,13 @@ def test_ring_attention_matches_full(rng, devices8):
     k = jax.random.normal(keys[1], (b, s, h, dh))
     v = jax.random.normal(keys[2], (b, s, h, dh))
 
-    ring = jax.shard_map(
+    from sparse_coding_tpu.parallel.mesh import compat_shard_map
+
+    ring = compat_shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis_name="data"),
-        mesh=mesh,
+        mesh,
         in_specs=(P(None, "data"), P(None, "data"), P(None, "data")),
-        out_specs=P(None, "data"), check_vma=False)
+        out_specs=P(None, "data"))
     out_ring = ring(q, k, v)
     out_full = _full_causal_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_full),
@@ -48,9 +50,11 @@ def test_ring_attention_single_shard(rng, devices8):
     b, s, h, dh = 1, 16, 2, 8
     keys = jax.random.split(rng, 3)
     q, k, v = (jax.random.normal(kk, (b, s, h, dh)) for kk in keys)
-    ring = jax.shard_map(
+    from sparse_coding_tpu.parallel.mesh import compat_shard_map
+
+    ring = compat_shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis_name="data"),
-        mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(), check_vma=False)
+        mesh, in_specs=(P(), P(), P()), out_specs=P())
     np.testing.assert_allclose(np.asarray(ring(q, k, v)),
                                np.asarray(_full_causal_attention(q, k, v)),
                                rtol=2e-5, atol=2e-5)

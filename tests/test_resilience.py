@@ -1219,6 +1219,48 @@ def test_guardian_divergence_drill_member_frozen_others_bitwise(tmp_path):
     assert all(not hp.get("diverged") for _, hp in kept)
 
 
+def test_guardian_member_drill_on_tiled_fused_path(tmp_path):
+    """ISSUE 11 fault-matrix rung: the member-targeted ``sweep.anomaly``
+    drill run against the feature-axis-TILED fused path (fused_path=
+    'two_stage_tiled', interpret kernels on CPU) — quarantine freeze
+    semantics survive feature-axis tiling: the victim freezes in-graph at
+    its last finite params and is ledgered, every other member's final
+    dictionary is BITWISE identical to an uninjected tiled run, zero
+    rollbacks (live members never pay for a neighbor's divergence)."""
+    import json as json_mod
+
+    import sparse_coding_tpu.train.sweep as sweep_mod
+
+    tiled = dict(use_fused="on", fused_path="two_stage_tiled",
+                 fused_interpret=True)
+    build = _drill_build()
+    full = sweep_mod.sweep(build, _sweep_cfg(tmp_path, "full", **tiled),
+                           log_every=50)
+    with inject(site="sweep.anomaly", nth=3, error="RuntimeError",
+                message="member=1") as plan:
+        injected = sweep_mod.sweep(build,
+                                   _sweep_cfg(tmp_path, "inj", **tiled),
+                                   log_every=50)
+    assert plan.fired_count("sweep.anomaly") == 1
+
+    tags = []
+    for i, ((ld_f, _), (ld_i, hp_i)) in enumerate(
+            zip(full["dense_l1_range"], injected["dense_l1_range"])):
+        tags.append(bool(hp_i.get("diverged")))
+        if i == 1:
+            continue  # the victim froze at its last finite params
+        for k in ld_f.__dict__:
+            a, b = getattr(ld_f, k), getattr(ld_i, k)
+            if hasattr(a, "shape"):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                              err_msg=f"member {i}/{k}")
+    assert tags == [False, True, False]
+
+    ledger = json_mod.loads((tmp_path / "inj" / "guardian.json").read_text())
+    assert list(ledger["members"]) == ["dense_l1_range/dense_l1_range/1"]
+    assert ledger["rollbacks"] == {}  # live members never paid
+
+
 def test_guardian_input_nan_rolls_back_to_last_good_and_quarantines_chunk(
         tmp_path):
     """The poisoned-data rung: a NaN batch (``sweep.anomaly`` mode=nan)

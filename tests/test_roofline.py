@@ -1,0 +1,215 @@
+"""The roofline admission model (ops/roofline.py, ISSUE 11): VMEM
+accounting pinned against hand-computed working sets, deterministic plan
+choices at the paper's canonical shapes, the parity-coverage lint (every
+kernel path reachable from Ensemble._resolve_step must have a parity test
+naming it), and the path-resolution observability loop."""
+
+import ast
+from pathlib import Path
+
+import jax
+import pytest
+
+from sparse_coding_tpu.ops import roofline
+from sparse_coding_tpu.ops.fused_sae_tiled import (
+    _tiled_bwd_working_set,
+    _tiled_fwd_working_set,
+    pick_tiled_tiles,
+)
+
+TESTS_DIR = Path(__file__).parent
+
+
+def test_tiled_working_sets_match_hand_computation():
+    """The tiled kernels' VMEM model, pinned term by term (same block/
+    intermediate conventions as fused_sae._working_set: grid-varying
+    blocks ×2 for Mosaic double buffering, intermediates ×1)."""
+    bt, ft, d = 256, 1024, 512
+    f32 = 4
+    bwd_blocks = (ft * d * f32 * 2      # weight tile in + grad accumulator
+                  + bt * d * f32        # x tile
+                  + bt * d * f32        # r tile
+                  + ft * f32 * 4        # b, db, act (+ mask headroom)
+                  + 4 * f32)            # loss/gnorm vector
+    bwd_interm = (bt * ft * f32 * 3     # pre/c, dpre, mask
+                  + ft * d * f32)       # normalized weight tile
+    assert _tiled_bwd_working_set(bt, ft, d) == 2 * bwd_blocks + bwd_interm
+
+    fwd_blocks = (ft * d * f32          # weight tile in
+                  + bt * d * f32        # x tile
+                  + bt * d * f32        # x̂ accumulator
+                  + ft * f32 * 2)       # b (+ mask)
+    fwd_interm = (bt * ft * f32 * 2     # pre/c + decode partial
+                  + ft * d * f32)       # normalized weight tile
+    assert _tiled_fwd_working_set(bt, ft, d) == 2 * fwd_blocks + fwd_interm
+
+    # the untied kernel holds two weight matrices + two grad accumulators
+    assert (_tiled_bwd_working_set(bt, ft, d, n_mats=2)
+            - _tiled_bwd_working_set(bt, ft, d)
+            == 2 * 2 * ft * d * f32)
+    # a bf16 stream halves the double-buffered x block but pays one f32
+    # upcast copy in VMEM — exactly offsetting (same invariant as the
+    # untiled kernels: bf16 streams never cost extra VMEM)
+    assert (_tiled_bwd_working_set(bt, ft, d, batch_itemsize=2)
+            == _tiled_bwd_working_set(bt, ft, d))
+
+
+@pytest.mark.parametrize("ratio", [4, 16, 32])
+def test_canonical_ratio_admission_d512(ratio):
+    """d=512 canonical shapes: ratio 4 admits the untiled whole-step path
+    (lowest modeled bytes at equal flops); ratios 16/32 exceed the untiled
+    kernels' VMEM and resolve to a feature-tiled plan whose tiles divide
+    the shape — never autodiff (the pre-r11 silent fallback)."""
+    n_feats = 512 * ratio
+    plan = roofline.choose_plan(n_members=8, batch=2048, n_feats=n_feats,
+                                d=512, family="tied")
+    if ratio == 4:
+        assert plan.path == "train_step"
+    else:
+        assert plan.path in ("two_stage_tiled", "train_step_tiled")
+        assert 2048 % plan.batch_tile == 0
+        assert n_feats % plan.feat_tile == 0 and plan.feat_tile % 128 == 0
+    assert plan.reason == "roofline"
+    # the flash recompute trade is visible in the model: tiled plans carry
+    # 12·B·n·d flops vs the untiled kernels' 10
+    untiled_bytes, untiled_flops = roofline.path_cost(
+        "two_stage", 8, 2048, n_feats, 512)
+    tiled_bytes, tiled_flops = roofline.path_cost(
+        "two_stage_tiled", 8, 2048, n_feats, 512,
+        batch_tile=512, feat_tile=min(n_feats, 4096))
+    assert tiled_flops == untiled_flops * 12 / 10
+
+
+def test_canonical_ratio_admission_d1024():
+    """d=1024 ratio-16 (the big-SAE-adjacent shape): one [n, d] matrix is
+    already 64 MiB, far past the untiled budget — the tiled plan must
+    admit where the untiled kernels cannot."""
+    from sparse_coding_tpu.ops.fused_sae import pick_batch_tile
+
+    assert pick_batch_tile(2048, 16384, 1024) is None
+    plan = roofline.choose_plan(n_members=4, batch=2048, n_feats=16384,
+                                d=1024, family="tied")
+    assert plan.path in ("two_stage_tiled", "train_step_tiled")
+    assert 16384 % plan.feat_tile == 0
+
+
+def test_autodiff_only_when_nothing_admits():
+    """batch=96 has no dividing batch-tile candidate: the plan degrades to
+    autodiff with the countable reason — the ONLY route to autodiff in
+    auto mode."""
+    plan = roofline.choose_plan(n_members=8, batch=96, n_feats=2048, d=512,
+                                family="tied")
+    assert plan.path is None and plan.reason == "no_admissible_tile"
+    assert plan.est_s > 0  # the fallback still carries a cost estimate
+
+
+def test_forced_path_and_family_restrictions():
+    kw = dict(n_members=8, batch=2048, n_feats=2048, d=512)
+    plan = roofline.choose_plan(**kw, family="tied",
+                                forced_path="two_stage_tiled")
+    assert plan.path == "two_stage_tiled" and plan.reason == "forced"
+    # forced but unfit (no dividing batch tile) → countable refusal the
+    # engine converts into the fail-fast ValueError
+    plan = roofline.choose_plan(n_members=8, batch=96, n_feats=2048, d=512,
+                                family="tied", forced_path="two_stage")
+    assert plan.path is None and plan.reason.startswith("forced_unfit")
+    # whole-step paths never run under shard_map (psum must sit between
+    # grads and Adam) nor for the masked family (coef_mask is a
+    # two-stage-kernel operand)
+    plan = roofline.choose_plan(**kw, family="tied", sharded=True)
+    assert plan.path == "two_stage"
+    plan = roofline.choose_plan(n_members=8, batch=2048, n_feats=8192,
+                                d=512, family="tied", sharded=True)
+    assert plan.path == "two_stage_tiled"
+    plan = roofline.choose_plan(**kw, family="masked_tied")
+    assert plan.path == "two_stage"
+    plan = roofline.choose_plan(**kw, family="tied", sharded=True,
+                                forced_path="train_step")
+    assert plan.path is None and "forced_unavailable" in plan.reason
+
+
+def test_explicit_tiles_respected():
+    plan = roofline.choose_plan(n_members=8, batch=2048, n_feats=8192,
+                                d=512, family="tied", feat_tile=1024)
+    assert plan.feat_tile == 1024
+    assert plan.path in ("two_stage_tiled", "train_step_tiled")
+    # an explicit feat_tile pins the TILED paths even where untiled admits
+    plan = roofline.choose_plan(n_members=8, batch=2048, n_feats=2048,
+                                d=512, family="tied", feat_tile=1024)
+    assert plan.path in ("two_stage_tiled", "train_step_tiled")
+    # explicit pair that cannot fit → autodiff refusal
+    plan = roofline.choose_plan(n_members=8, batch=2048, n_feats=8192,
+                                d=512, family="tied", batch_tile=100)
+    assert plan.path is None
+
+
+def test_admission_equals_kernel_pickers():
+    """The plan's tiles come from the SAME pickers the kernel wrappers
+    call, so a resolved plan can never disagree with kernel admission."""
+    for n_feats in (8192, 16384):
+        plan = roofline.choose_plan(n_members=8, batch=2048,
+                                    n_feats=n_feats, d=512, family="tied")
+        pair = pick_tiled_tiles(2048, n_feats, 512)
+        assert (plan.batch_tile, plan.feat_tile) == pair
+
+
+def test_parity_coverage_lint():
+    """Every kernel path reachable from Ensemble._resolve_step must be
+    named by a PARITY_COVERS declaration in a test module whose tests lock
+    that path's training parity — a future kernel variant cannot land
+    untested."""
+    from sparse_coding_tpu.ensemble import KERNEL_PATHS
+
+    covered: set = set()
+    for path in sorted(TESTS_DIR.glob("test_fused*.py")):
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign)
+                    and any(getattr(t, "id", None) == "PARITY_COVERS"
+                            for t in node.targets)):
+                covered |= set(ast.literal_eval(node.value))
+    missing = set(KERNEL_PATHS) - covered
+    assert not missing, (
+        f"kernel paths without a declared parity test: {sorted(missing)} — "
+        "add training-parity coverage and list the path in a test module's "
+        "PARITY_COVERS")
+    unknown = covered - set(KERNEL_PATHS)
+    assert not unknown, f"PARITY_COVERS names unknown paths: {unknown}"
+
+
+def test_path_resolution_is_counted_and_reported(rng, tmp_path):
+    """ISSUE 11 observability loop end to end: resolutions — fused AND the
+    autodiff fallback — increment ensemble.path_resolved{path=,reason=},
+    and obs.report renders them as the "kernel paths" section."""
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.ensemble import Ensemble
+    from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+    from sparse_coding_tpu.obs.report import build_report, format_report
+
+    members = [FunctionalTiedSAE.init(k, 32, 64, l1_alpha=1e-3)
+               for k in jax.random.split(rng, 2)]
+    prev_reg = obs.set_registry(obs.Registry())
+    prev_sink = obs.configure_sink(
+        obs.EventSink(tmp_path / "obs" / "events.jsonl"))
+    try:
+        ens = Ensemble(members, FunctionalTiedSAE, fused_interpret=True,
+                       donate=False)
+        ens._resolve_step(512, 4)   # fused resolution
+        ens._resolve_step(96, 4)    # no dividing tile → counted fallback
+        off = Ensemble(members, FunctionalTiedSAE, use_fused=False,
+                       donate=False)
+        off._resolve_step(512, 4)   # fused disabled → counted
+        obs.flush_metrics()
+    finally:
+        obs.configure_sink(prev_sink)
+        obs.set_registry(prev_reg)
+
+    kp = build_report(tmp_path)["kernel_paths"]
+    assert ens.fused_path is None  # last resolution fell back
+    fused_paths = [p for p in kp if p != "autodiff"]
+    assert len(fused_paths) == 1 and kp[fused_paths[0]]["count"] == 1
+    assert kp["autodiff"]["count"] == 2
+    assert kp["autodiff"]["reasons"] == {"no_admissible_tile": 1,
+                                         "fused_disabled": 1}
+    rendered = format_report(build_report(tmp_path))
+    assert "kernel paths" in rendered and "no_admissible_tile" in rendered

@@ -25,10 +25,15 @@ def test_tune_quick_writes_best(tmp_path, monkeypatch, capsys):
     rates = [r["acts_per_sec"] for r in data["results"]]
     assert rates == sorted(rates, reverse=True)
     assert data["best"]["acts_per_sec"] == rates[0]
-    # one JSON line per configuration on stdout
+    # one JSON line per configuration on stdout (the ratio-stage records
+    # print too but live under ratio_results, never in results/best — a
+    # different n_dict is a different workload)
     lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
              if l.startswith("{")]
-    assert len(lines) == len(data["results"])
+    assert len(lines) == len(data["results"]) + len(data["ratio_results"])
+    assert len(data["ratio_results"]) >= 1
+    for rec in data["ratio_results"]:
+        assert rec["resolved_path"] == "autodiff"  # CPU smoke: no kernels
 
 
 def test_bench_ignores_non_tpu_tune_file(tmp_path):

@@ -47,9 +47,12 @@ SCAN_CHUNKS = (5, 10, 25, 50, 100, 200)
 
 def stage1_grid(on_tpu: bool, quick: bool) -> list[dict]:
     """Stage 1: step IMPLEMENTATION scan — autodiff (default / bf16 matmul
-    precision) vs both tied fused kernels (two_stage and the whole-step
-    train_step), auto tile, f32 everywhere. Tile/dtype refinement happens in
-    stage 1b for the winner only, keeping the grid tractable."""
+    precision) vs all four tied fused kernel paths (untiled two_stage /
+    train_step AND the feature-axis-tiled pair — at the canonical ratio-4
+    scale the tiled kernels are the measured A/B for the recompute trade;
+    at ratio 16+ they are the only fused option), auto tiles, f32
+    everywhere. Tile/dtype refinement happens in stage 1b for the winner
+    only, keeping the grid tractable."""
     configs: list[dict] = [
         {"use_fused": False},
         {"use_fused": False, "matmul_precision": "bfloat16"},
@@ -58,15 +61,28 @@ def stage1_grid(on_tpu: bool, quick: bool) -> list[dict]:
         return configs
     configs.append({"use_fused": True, "fused_path": "two_stage"})
     configs.append({"use_fused": True, "fused_path": "train_step"})
+    configs.append({"use_fused": True, "fused_path": "two_stage_tiled"})
+    configs.append({"use_fused": True, "fused_path": "train_step_tiled"})
     return configs
 
 
+TILED_PATHS = ("two_stage_tiled", "train_step_tiled")
+
+
 def tile_grid(best: dict) -> list[dict]:
-    """Stage 1b (fused winners only): explicit batch tiles for the winning
-    kernel path (auto pick = the stage-1 winner itself)."""
+    """Stage 1b (fused winners only): explicit tiles for the winning
+    kernel path (auto pick = the stage-1 winner itself). Tiled winners
+    scan the (batch_tile × feat_tile) grid — the two interact through
+    both kernels' VMEM working sets, so combinations are measured."""
     if not best.get("use_fused"):
         return []
-    return [{"use_fused": True, "fused_path": best.get("fused_path"),
+    path = best.get("fused_path")
+    if path in TILED_PATHS:
+        return [{"use_fused": True, "fused_path": path,
+                 "batch_tile": bt, "feat_tile": ft}
+                for bt in (512, 256, 128)
+                for ft in (4096, 2048, 1024)]
+    return [{"use_fused": True, "fused_path": path,
              "batch_tile": t} for t in (2048, 1024, 512, 256, 128, 64)]
 
 
@@ -78,8 +94,12 @@ def dtype_grid(best: dict) -> list[dict]:
     in-kernel analogue."""
     if not best.get("use_fused"):
         return []
+    # the tile winner's FULL tile pair carries into the dtype stage —
+    # dropping feat_tile here would re-resolve a different tiled program
+    # than the one whose rate was measured
     base = {"use_fused": True, "fused_path": best.get("fused_path"),
-            "batch_tile": best.get("batch_tile")}
+            "batch_tile": best.get("batch_tile"),
+            "feat_tile": best.get("feat_tile")}
     configs = []
     for compute, batch_dtype in itertools.product(
             (None, "bfloat16"), (None, "bfloat16")):
@@ -87,7 +107,7 @@ def dtype_grid(best: dict) -> list[dict]:
             continue  # == the tile winner itself
         configs.append({**base, "fused_compute_dtype": compute,
                         "batch_dtype": batch_dtype})
-    if base.get("fused_path") == "train_step":
+    if base.get("fused_path") in ("train_step", "train_step_tiled"):
         # opt-in bf16 moment storage (halves the whole-step kernel's
         # optimizer-state HBM traffic; documented optax-parity deviation) —
         # measured with BOTH batch streams so the moments effect is
@@ -102,8 +122,10 @@ def dtype_grid(best: dict) -> list[dict]:
 def run_config(cfg: dict, quick: bool) -> float:
     kwargs = {k: v for k, v in cfg.items() if v is not None}
     if quick:
-        kwargs.update(d_act=64, n_dict=128, n_members=4, batch=256,
-                      bench_steps=10)
+        # an explicit n_dict survives (the ratio stage sweeps it); the
+        # default quick shape is ratio 2 at d=64
+        kwargs.setdefault("n_dict", 128)
+        kwargs.update(d_act=64, n_members=4, batch=256, bench_steps=10)
         kwargs.setdefault("scan_chunk", 5)
     return _time_ensemble(**kwargs)
 
@@ -138,7 +160,11 @@ def main() -> None:
             return None
         rec = {**cfg, "acts_per_sec": round(rate, 1),
                "mfu": (round(rate * fpa / peak / n_chips, 4)
-                       if peak else None)}
+                       if peak else None),
+               # which kernel program actually ran (ensemble.KERNEL_PATHS
+               # label or "autodiff") — the ratio stage's key output
+               "resolved_path": getattr(rate, "fused_path", None)
+               or "autodiff"}
         print(json.dumps(rec), flush=True)
         return rec
 
@@ -152,7 +178,8 @@ def main() -> None:
     # stage 1b/1c: tile then dtype refinement for the winning implementation
     # (dtype configs inherit the tile winner, so combos are measured)
     def strip(rec: dict) -> dict:
-        return {k: v for k, v in rec.items() if k not in ("acts_per_sec", "mfu")}
+        return {k: v for k, v in rec.items()
+                if k not in ("acts_per_sec", "mfu", "resolved_path")}
 
     for grid_fn in (tile_grid, dtype_grid):
         for cfg in grid_fn(strip(best)):
@@ -173,7 +200,26 @@ def main() -> None:
             if rec["acts_per_sec"] > best["acts_per_sec"]:
                 best = rec
 
+    # stage 3: canonical-ratio scan (ISSUE 11) — auto-mode admission and
+    # throughput at the paper's headline dict ratios (reference
+    # standard_metrics.py:745 / big_sweep_experiments.py:543), recording
+    # which kernel path each ratio RESOLVED to: before the feature-tiled
+    # kernels, ratios ≥16 silently ran autodiff and no artifact showed it
+    d_ratio = 64 if args.quick else 512
+    ratio_results = []
+    for ratio in (2, 4) if args.quick else (4, 16, 32):
+        rec = measure({"use_fused": "auto", "n_dict": d_ratio * ratio})
+        if rec is not None:
+            # NOT folded into `results`/`best`: a different n_dict is a
+            # different workload — its rate must never displace the
+            # canonical-shape winner bench.py loads
+            ratio_results.append({
+                "ratio": ratio, "n_dict": d_ratio * ratio,
+                "resolved_path": rec["resolved_path"],
+                "acts_per_sec": rec["acts_per_sec"], "mfu": rec["mfu"]})
+
     out = {"backend": backend, "quick": args.quick, "best": best,
+           "ratio_results": ratio_results,
            "results": sorted(results, key=lambda r: -r["acts_per_sec"])}
     out_path.write_text(json.dumps(out, indent=2))
     print(f"tune: best {best} -> {out_path}", file=sys.stderr)
