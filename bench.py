@@ -35,15 +35,12 @@ import jax.numpy as jnp
 # real target is the ≥5x north star in BASELINE.json.
 GPU_BASELINE_ACTS_PER_SEC = 37_000.0
 
-# bf16 MXU peak flops/s by TPU generation (public spec sheets), used for the
-# measured-MFU figure: mfu = acts/s × flops-per-activation ÷ chip peak. JAX's
+# bf16 MXU peak flops/s by TPU generation — the table itself now lives in
+# obs/perf.py (the single home; the runtime DeviceStepProbe divides by the
+# same denominator): mfu = acts/s × flops-per-activation ÷ chip peak. JAX's
 # DEFAULT f32 matmul precision on TPU runs bf16 passes on the MXU, so the
 # bf16 peak is the honest denominator for every variant benched here.
-TPU_PEAK_FLOPS = {
-    "v2": 45e12, "v3": 123e12, "v4": 275e12,
-    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
-    "v6 lite": 918e12, "v6e": 918e12,
-}
+from sparse_coding_tpu.obs.perf import TPU_PEAK_FLOPS  # noqa: F401 (re-export)
 
 D_ACT = 512          # pythia-70m residual width
 DICT_RATIO = 4
@@ -59,17 +56,19 @@ CPU_FALLBACK = dict(n_members=8, batch=1024, bench_steps=10, scan_chunk=5)
 
 def flops_per_activation(n_members: int = N_MEMBERS, n_dict: int = N_DICT,
                          d_act: int = D_ACT) -> float:
-    """~12·n·d flops per activation per member (encode+decode matmuls fwd,
-    ~2x for backward; see the baseline-estimate comment above)."""
-    return 12.0 * n_dict * d_act * n_members
+    """~12·n·d flops per activation per member — delegated to the SHARED
+    FLOP model (ops/roofline.model_flops_per_activation, ISSUE 12): bench
+    MFU and the runtime train.mfu gauge are the same number at the same
+    shape by construction."""
+    from sparse_coding_tpu.ops.roofline import model_flops_per_activation
+
+    return model_flops_per_activation(n_members, n_dict, d_act)
 
 
 def chip_peak_flops() -> float | None:
-    kind = jax.devices()[0].device_kind.lower()
-    for tag, peak in sorted(TPU_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
-        if tag in kind:
-            return peak
-    return None
+    from sparse_coding_tpu.obs.perf import device_peak_flops
+
+    return device_peak_flops()
 
 
 SCAN_CHUNK = 10  # steps fused into one device program (amortizes dispatch)
@@ -269,6 +268,27 @@ def _emit(acts_per_sec_per_chip: float, *, backend: str,
               f"({'warm' if p_hits or saved_s else 'cold'} start)",
               file=sys.stderr)
     obs.update_memory_gauges()
+    # perf regression ledger (ISSUE 12): every emit path — cpu-fallback
+    # included — appends one durable row {variant, backend, path mix,
+    # mfu, step walls}; under the supervisor the env routes it into the
+    # run dir, standalone rounds append to the repo-root ledger
+    from sparse_coding_tpu.obs import ledger as perf_ledger
+    from sparse_coding_tpu.obs.report import split_labels
+
+    # path mix keyed by KERNEL PATH (summed over resolution reasons) —
+    # the ledger row schema run_summary_row shares (obs/ledger.py)
+    paths: dict = {}
+    for k, v in reg.snapshot()["counters"].items():
+        base, labels = split_labels(k)
+        if base == "ensemble.path_resolved" and labels:
+            p = labels.get("path", "?")
+            paths[p] = paths.get(p, 0) + int(v)
+    perf_ledger.append_row({
+        "kind": "bench", "run": obs.run_id(), "backend": backend,
+        "variant": variant, "mfu": record["mfu"],
+        "value": record["value"], "unit": record["unit"],
+        "vs_baseline": record["vs_baseline"], "paths": paths,
+        "note": note or ""})
     # under the supervisor the obs env points at the run dir: the metrics
     # snapshot (throughput gauges, retrace counters) joins the run's event
     # stream for obs.report — a no-op on bare invocations

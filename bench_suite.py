@@ -11,8 +11,11 @@ canonical-dict-ratio sweep (ensemble_ratio: resolved kernel path +
 fused-vs-autodiff A/B at ratios 4–32 — ISSUE 11), big-SAE
 train (single giant dict), activation harvesting (tokens/s through the LM
 with taps), sequence-parallel long-context forward (over whatever mesh the
-host offers), chunk-store IO, and the guardian divergence soak (sentinel
-step overhead + frozen-member/zero-rollback drill semantics).
+host offers), chunk-store IO, the guardian divergence soak (sentinel
+step overhead + frozen-member/zero-rollback drill semantics), and the
+device-time perf-probe overhead A/B (ISSUE 12; probe ON at default
+cadence must sit within noise of OFF). Every scenario row also lands in
+the durable perf_ledger.jsonl, asserted at exit.
 """
 
 from __future__ import annotations
@@ -40,13 +43,25 @@ def _timed(fn, n_iters: int, payload: float, warmup: int = 2) -> float:
     return n_iters * payload / (time.perf_counter() - t0)
 
 
+# emitted-vs-landed accounting for the perf ledger (ISSUE 12): every
+# scenario row is also appended to perf_ledger.jsonl, and main() asserts
+# at exit that the rows actually landed — a silently-broken ledger would
+# otherwise rot the round-over-round regression record
+_LEDGER = {"emitted": 0, "appended": 0}
+
+
 def _emit(suite: str, value: float, unit: str, **extra) -> None:
     # backend on every record so unattended captures can tell a real TPU
     # profile from a CPU run (scripts/on_tunnel_return.sh only assembles
     # BENCH_SUITE_TPU.json from backend:"tpu" records)
-    print(json.dumps({"suite": suite, "value": round(value, 1), "unit": unit,
-                      "backend": jax.default_backend(), **extra}),
-          flush=True)
+    record = {"suite": suite, "value": round(value, 1), "unit": unit,
+              "backend": jax.default_backend(), **extra}
+    print(json.dumps(record), flush=True)
+    from sparse_coding_tpu.obs import ledger as perf_ledger
+
+    _LEDGER["emitted"] += 1
+    if perf_ledger.append_row({"kind": "suite", **record}):
+        _LEDGER["appended"] += 1
 
 
 def bench_ensemble(quick: bool) -> None:
@@ -459,6 +474,87 @@ def bench_guardian_soak(quick: bool) -> None:
         shutil.rmtree(root / "chunks", ignore_errors=True)
 
 
+def bench_perf_probe(quick: bool) -> None:
+    """Device-time probe overhead A/B (ISSUE 12 acceptance): two
+    identical synthetic sweeps over one store — probe OFF
+    (``perf_probe_every=0``, the pre-probe step loop) vs probe ON at the
+    DEFAULT cadence — compared on steady-state ``sweep.chunk`` p50 walls
+    read back through ``obs.report``. The acceptance bar is <2% overhead
+    (the bracketed windows are 1-in-32; everything between them keeps
+    full dispatch pipelining). The ON run's report must also show the
+    perf section populated and backend-labeled: per-path MFU and the
+    predicted-vs-achieved roofline gap — on this host that is the
+    cpu-fallback labeling path the runbook documents."""
+    import shutil
+    import tempfile
+
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.config import SyntheticEnsembleArgs
+    from sparse_coding_tpu.obs.report import build_report
+    import sparse_coding_tpu.train.sweep as sweep_mod
+    from sparse_coding_tpu.train.experiments import dense_l1_range_experiment
+
+    d, members, rows = (64, 4, 80_000) if quick else (128, 8, 240_000)
+    l1s = list(np.logspace(-4, -2, members))
+    with tempfile.TemporaryDirectory() as td:
+        root = Path(td)
+
+        def cfg(name, probe_every):
+            return SyntheticEnsembleArgs(
+                output_folder=str(root / name),
+                dataset_folder=str(root / "chunks"), batch_size=1024,
+                n_chunks=4, activation_dim=d,
+                n_ground_truth_features=2 * d, dataset_size=rows,
+                learned_dict_ratio=2.0, seed=0,
+                perf_probe_every=probe_every)
+
+        build = lambda c, m: dense_l1_range_experiment(  # noqa: E731
+            c, m, l1_range=l1s, activation_dim=d)
+
+        def run(name, probe_every):
+            run_dir = root / f"obs_{name}"
+            prev_sink = obs.configure_sink(
+                obs.EventSink(run_dir / "obs" / "probe.jsonl"))
+            prev_reg = obs.set_registry(obs.Registry())
+            try:
+                sweep_mod.sweep(build, cfg(name, probe_every),
+                                log_every=10**9, image_metrics_every=None)
+                obs.flush_metrics()
+            finally:
+                obs.set_registry(prev_reg)
+                obs.configure_sink(prev_sink)
+            report = build_report(run_dir)
+            chunk = report["spans"].get("sweep.chunk", {})
+            return (chunk.get("p50_s") or 0.0, report["perf"])
+
+        run("warmup", 0)  # store materialization + compile warmth
+        # interleaved min-of-two per arm: single p50-of-4-chunks reads
+        # carry ±5-7% host noise (measured), which would drown the <2%
+        # acceptance bar; the min of two interleaved passes is robust to
+        # one-sided spikes without hiding a systematic cost
+        off_s = min(run("off_a", 0)[0], run("off_b", 0)[0])
+        on_a, perf = run("on_a", obs.perf.DEFAULT_PROBE_EVERY)
+        on_s = min(on_a, run("on_b", obs.perf.DEFAULT_PROBE_EVERY)[0])
+        overhead_pct = (on_s - off_s) / off_s * 100.0 if off_s else 0.0
+        mfu_rows = perf.get("mfu", {})
+        gap_rows = perf.get("roofline_gap", {})
+        assert perf.get("samples", 0) >= 1, \
+            "probe ON at default cadence took no samples"
+        assert mfu_rows, "perf section has no MFU rows"
+        assert any("backend=" in k for k in mfu_rows), \
+            f"MFU rows are not backend-labeled: {sorted(mfu_rows)}"
+        assert gap_rows, "perf section has no roofline-gap rows"
+        _emit("perf_probe", overhead_pct, "% probe step overhead",
+              n_members=members, d=d, rows=rows,
+              cadence=obs.perf.DEFAULT_PROBE_EVERY,
+              chunk_p50_off=round(off_s, 4), chunk_p50_on=round(on_s, 4),
+              samples=perf.get("samples"),
+              mfu={k: round(v, 4) for k, v in sorted(mfu_rows.items())},
+              gap_p50={k: round(s["p50"], 3)
+                       for k, s in sorted(gap_rows.items())})
+        shutil.rmtree(root / "chunks", ignore_errors=True)
+
+
 def bench_serving(quick: bool) -> None:
     """Online feature-extraction serving: concurrent mixed-size requests
     through the micro-batching engine's AOT bucket programs. Reports
@@ -653,16 +749,30 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
     args = parser.parse_args()
+    from sparse_coding_tpu.obs import ledger as perf_ledger
+
+    rows_before = len(perf_ledger.read_rows())
     # seq_parallel runs LAST: its hang watchdog exits the process, and every
     # earlier suite's JSON line is flushed by then
     for suite in (bench_ensemble, bench_ensemble_ratio, bench_big_sae,
                   bench_harvest,
                   bench_chunk_io, bench_ingest_soak, bench_streaming_eval,
-                  bench_guardian_soak, bench_gateway, bench_seq_parallel):
+                  bench_guardian_soak, bench_perf_probe, bench_gateway,
+                  bench_seq_parallel):
         try:
             suite(args.quick)
         except Exception as e:
             print(f"{suite.__name__} failed: {e!r}", file=sys.stderr)
+    # ledger accounting (ISSUE 12): every emitted scenario row must have
+    # LANDED in the durable perf ledger — the regression record is only
+    # trustworthy if writing it is verified, not assumed
+    landed = len(perf_ledger.read_rows()) - rows_before
+    print(f"perf ledger: {_LEDGER['emitted']} row(s) emitted, "
+          f"{_LEDGER['appended']} appended, {landed} landed at "
+          f"{perf_ledger.ledger_path()}", file=sys.stderr)
+    assert landed >= _LEDGER["emitted"], (
+        f"perf ledger lost rows: emitted {_LEDGER['emitted']}, "
+        f"landed {landed}")
 
 
 if __name__ == "__main__":

@@ -160,8 +160,18 @@ class EnsembleArgs(BaseArgs):
     checkpoint_backend: str = "msgpack"
     # >0: capture a jax.profiler device trace of that many training steps
     # (after compile/warmup) into <output_folder>/trace — TensorBoard/XProf
-    # readable, the on-hardware tuning loop's first artifact
+    # readable, the on-hardware tuning loop's first artifact. Captures are
+    # crash-safe and bounded (obs/trace.py: tmp-then-atomic finalize; an
+    # error or kill mid-capture costs only the trace, never the sweep)
     profile_steps: int = 0
+    # device-time perf probe cadence (obs/perf.py, ARCHITECTURE.md §12):
+    # every Nth training window is bracketed with block_until_ready timing
+    # — measured device wall → train.mfu gauge + the counted
+    # perf.roofline_gap predicted-vs-achieved ratio in every run report.
+    # Steady state between samples keeps full dispatch pipelining;
+    # overhead at the default cadence is within noise (bench_suite.py
+    # perf_probe A/B). 0 disables sampling entirely.
+    perf_probe_every: int = 32
     # steps fused into one device program via lax.scan (Ensemble.run_steps).
     # Per-dispatch overhead through the axon tunnel measured ~54 ms (r4), so
     # scan_steps=50 turns a dispatch-bound sweep into a compute-bound one —

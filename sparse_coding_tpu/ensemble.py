@@ -1252,6 +1252,43 @@ class Ensemble:
                            "shape": list(shape), "dtype": str(dt),
                            "fused_path": self.fused_path})
 
+    def step_cost(self, batch_rows: int) -> "obs.StepCost":
+        """The :class:`obs.perf.StepCost` of ONE step at ``batch_rows``
+        for the currently-resolved program (ISSUE 12): model flops from
+        the SHARED FLOP model (``roofline.model_flops_per_activation`` —
+        required flops, so the MFU numerator never depends on which
+        kernel executed), prediction + path/tile labels from the resolved
+        :class:`~sparse_coding_tpu.ops.roofline.KernelPlan`. Signatures
+        without an "encoder" dictionary param return a zero-flops cost
+        (the probe then records device walls only)."""
+        from sparse_coding_tpu import obs
+        from sparse_coding_tpu.ops import roofline
+
+        enc = self.state.params.get("encoder") \
+            if isinstance(self.state.params, dict) else None
+        if enc is None or enc.ndim != 3:
+            return obs.StepCost(path=self.fused_path or "autodiff",
+                                activations=int(batch_rows))
+        n_feats, d = int(enc.shape[1]), int(enc.shape[2])
+        flops = roofline.model_flops_per_activation(
+            self.n_members, n_feats, d) * batch_rows
+        plan = self.fused_plan
+        if plan is None:
+            # fused disabled / family ineligible: model the autodiff
+            # program so the roofline gap stays populated on this path
+            plan = roofline.autodiff_plan(
+                self.n_members, batch_rows, n_feats, d,
+                n_mats=2 if "decoder" in self.state.params else 1,
+                sentinel=self.sentinel, reason="unresolved")
+        tile = ""
+        if plan.batch_tile or plan.feat_tile:
+            tile = f"{plan.batch_tile or '-'}x{plan.feat_tile or '-'}"
+        return obs.StepCost(flops=flops,
+                            path=self.fused_path or "autodiff",
+                            predicted_s=float(plan.est_s),
+                            hbm_bytes=float(plan.hbm_bytes), tile=tile,
+                            activations=int(batch_rows))
+
     def unstack(self) -> list[tuple[Pytree, dict]]:
         """Per-member (params, buffers incl. statics), host-side
         (reference: ensemble.py:59-66 unstack_dict)."""
@@ -1425,6 +1462,14 @@ class EnsembleGroup:
         Ensemble.run_steps); buckets still pipeline on device."""
         return {name: ens.run_steps(batches)
                 for name, ens in self.ensembles.items()}
+
+    def step_cost(self, batch_rows: int):
+        """Aggregate :class:`obs.perf.StepCost` across buckets (mixed
+        paths label ``mixed``; see obs.perf.combine_costs)."""
+        from sparse_coding_tpu import obs
+
+        return obs.combine_costs([ens.step_cost(batch_rows)
+                                  for ens in self.ensembles.values()])
 
     def to_learned_dicts(self) -> dict[str, list]:
         return {name: ens.to_learned_dicts() for name, ens in self.ensembles.items()}

@@ -22,11 +22,21 @@ complete profile on the first try:
   installed — tests/test_tpu_lowering.py);
 - :mod:`report`    — ``python -m sparse_coding_tpu.obs.report <run_dir>``
   merges a run's event files into per-step p50/p95/p99 durations,
-  throughput, retrace and error counts.
+  throughput, retrace and error counts, and the device-time ``perf``
+  section (``--diff`` compares two runs);
+- :mod:`perf`      — sampling :class:`~perf.DeviceStepProbe`: measured
+  device walls, MFU gauges against the shared roofline FLOP model, and
+  the counted predicted-vs-achieved ``perf.roofline_gap`` ratio;
+- :mod:`trace`     — crash-safe managed profiler capture (bounded
+  window, tmp-then-atomic finalize, counted skip on error) — the only
+  module allowed to touch ``jax.profiler`` (tests/test_profiler_lint.py);
+- :mod:`ledger`    — the durable ``perf_ledger.jsonl`` every bench
+  round, suite scenario, and supervised run appends a summary row to.
 
 Import discipline: this package (minus :mod:`jaxprobes`) never imports
 jax, so the serving metrics path and the report CLI stay device-free;
-``install_jax_probes`` defers the jax import to call time.
+``install_jax_probes`` and the :mod:`perf`/:mod:`trace` entry points
+defer the jax import to call time.
 
 Design: docs/ARCHITECTURE.md §12. Raw-clock reads in hot paths
 (data/train/serve/pipeline) go through :func:`monotime` — enforced
@@ -61,12 +71,16 @@ from sparse_coding_tpu.obs.spans import (
     ENV_STEP,
     emit_event,
     flush_metrics,
+    mint_trace_id,
     monotime,
     record_span,
     run_id,
     span,
     step_name,
 )
+from sparse_coding_tpu.obs import ledger, perf, trace
+from sparse_coding_tpu.obs.perf import DeviceStepProbe, StepCost, combine_costs
+from sparse_coding_tpu.obs.trace import TraceCapture
 
 
 def counter(name: str, **labels) -> Counter:
@@ -103,6 +117,7 @@ def update_memory_gauges(registry: Optional[Registry] = None) -> int:
 
 __all__ = [
     "Counter",
+    "DeviceStepProbe",
     "ENV_OBS_DIR",
     "ENV_RUN_ID",
     "ENV_STEP",
@@ -110,8 +125,11 @@ __all__ = [
     "Gauge",
     "Histogram",
     "Registry",
+    "StepCost",
+    "TraceCapture",
     "active_sink",
     "close_sink",
+    "combine_costs",
     "configure_sink",
     "configure_sink_from_env",
     "counter",
@@ -121,7 +139,10 @@ __all__ = [
     "get_registry",
     "histogram",
     "install_jax_probes",
+    "ledger",
+    "mint_trace_id",
     "monotime",
+    "perf",
     "read_events",
     "record_span",
     "run_id",
@@ -129,6 +150,7 @@ __all__ = [
     "set_registry",
     "span",
     "step_name",
+    "trace",
     "uninstall_jax_probes",
     "update_memory_gauges",
 ]

@@ -1,0 +1,225 @@
+"""Device-time performance evidence: sampled MFU, roofline gap (§12).
+
+The obs layer timed only host walls, bench.py computed MFU once per
+round, and PR 11's roofline model predicted per-path HBM-bytes/MXU-flops
+that nothing ever checked against reality. This module closes the loop
+for EVERY supervised run and serve flush:
+
+- :class:`DeviceStepProbe` — a sampling probe: on a configurable cadence
+  (``every``-th window; 0 disables) the host brackets one dispatched
+  train window / serve flush with ``block_until_ready`` timing, so
+  steady-state dispatch pipelining is unperturbed between samples. Each
+  sample lands as
+
+  * ``<prefix>.device_step_s{path=...}`` histograms — measured device
+    wall per step, per resolved kernel path;
+  * ``<prefix>.mfu`` / ``<prefix>.mfu{backend=,path=}`` gauges —
+    model-flops utilization. The numerator is the SHARED FLOP model
+    (``ops/roofline.model_flops_per_activation`` — the same function
+    bench.py divides by, so bench MFU and runtime MFU are one number at
+    one shape); the denominator is the attached chip's bf16 peak, or the
+    roofline's v5e reference peak off-chip (the figure is then a
+    cross-chip reference number, not a utilization — the ``backend``
+    label marks it, and report/diff never compare across backends);
+  * a counted ``perf.roofline_gap{path=,tile=}`` histogram + event —
+    measured/predicted device seconds against the resolved
+    ``KernelPlan.est_s``, making the calibration constants
+    (``KERNEL_MXU_EFF`` etc.) checkable instead of folklore.
+
+- :class:`StepCost` — the plain-data description of what one measured
+  region was worth (model flops, resolved path label, roofline
+  prediction). Hosts build it from their resolved plans
+  (``Ensemble.step_cost``, ``roofline.serve_flush_plan``) so the probe
+  itself stays shape-agnostic.
+
+Import discipline: jax is imported at call time only (the obs package
+contract); constructing a probe is device-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+from sparse_coding_tpu.obs.registry import Registry, get_registry
+from sparse_coding_tpu.obs.spans import emit_event, monotime
+
+# bf16 MXU peak flops/s by TPU generation (public spec sheets) — the
+# single home of the MFU denominator table (bench.py reads it from here)
+TPU_PEAK_FLOPS = {
+    "v2": 45e12, "v3": 123e12, "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+}
+
+DEFAULT_PROBE_EVERY = 32
+
+
+def device_peak_flops(default: Optional[float] = None) -> Optional[float]:
+    """bf16 MXU peak of the attached device's generation, ``default``
+    when the device kind matches no known TPU (CPU hosts). Call-time jax
+    import; longest-tag-first so "v5 lite" wins over "v5"."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for tag, peak in sorted(TPU_PEAK_FLOPS.items(),
+                            key=lambda kv: -len(kv[0])):
+        if tag in kind:
+            return peak
+    return default
+
+
+def _default_backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+@dataclasses.dataclass(frozen=True)
+class StepCost:
+    """What one measured dispatch was worth: ``flops`` is the MFU
+    numerator (model-REQUIRED flops, per the shared FLOP model — never
+    the executed count, so kernel recompute can't inflate utilization);
+    ``predicted_s`` is the roofline model's device seconds for the same
+    region (0 = no prediction, gap not emitted); ``path``/``tile`` label
+    the resolved kernel program."""
+
+    flops: float = 0.0
+    path: str = "autodiff"
+    predicted_s: float = 0.0
+    hbm_bytes: float = 0.0
+    tile: str = ""
+    activations: int = 0
+
+
+def combine_costs(costs: Sequence[StepCost]) -> StepCost:
+    """Aggregate the per-ensemble costs of one training window (flops and
+    predictions add; a window whose buckets resolved different programs
+    is labeled ``mixed``)."""
+    costs = [c for c in costs if c is not None]
+    if not costs:
+        return StepCost()
+    paths = {c.path for c in costs}
+    tiles = {c.tile for c in costs}
+    return StepCost(
+        flops=sum(c.flops for c in costs),
+        path=paths.pop() if len(paths) == 1 else "mixed",
+        predicted_s=sum(c.predicted_s for c in costs),
+        hbm_bytes=sum(c.hbm_bytes for c in costs),
+        tile=tiles.pop() if len(tiles) == 1 else "mixed",
+        activations=sum(c.activations for c in costs))
+
+
+class DeviceStepProbe:
+    """Sampling device-time probe for one stream of dispatches.
+
+    Call :meth:`should_sample` once per dispatched window; on the
+    cadence it returns True and the host either wraps the dispatch in
+    :meth:`measure` (sync → time → sync) or times it itself and calls
+    :meth:`record`. ``every=0`` disables sampling entirely (the probe
+    then costs one integer increment per window)."""
+
+    def __init__(self, prefix: str, every: int = DEFAULT_PROBE_EVERY,
+                 registry: Optional[Registry] = None,
+                 peak_flops: Optional[float] = None,
+                 backend: Optional[str] = None, warmup: int = 2):
+        self.prefix = prefix
+        self.every = max(0, int(every))
+        # first `warmup` windows are never sampled: they carry XLA
+        # compile/dispatch warmth, and one compile through the tunnel
+        # would dominate every histogram this probe feeds (the same
+        # policy as StepTimer's warmup)
+        self.warmup = max(0, int(warmup))
+        self._registry = registry
+        self._peak = peak_flops
+        self._peak_checked = peak_flops is not None
+        self._backend = backend
+        self._count = 0
+        self.samples = 0
+
+    @property
+    def registry(self) -> Registry:
+        return self._registry if self._registry is not None \
+            else get_registry()
+
+    def _resolve_peak(self) -> Optional[float]:
+        if not self._peak_checked:
+            # off-chip: the v5e reference peak keeps the arithmetic
+            # populated; the backend label marks the row as
+            # not-a-utilization (docs/RUNBOOK_TUNNEL.md)
+            from sparse_coding_tpu.ops.roofline import MXU_PEAK_FLOPS
+
+            self._peak = device_peak_flops(default=MXU_PEAK_FLOPS)
+            self._peak_checked = True
+        return self._peak
+
+    def _resolve_backend(self) -> str:
+        if self._backend is None:
+            self._backend = _default_backend()
+        return self._backend
+
+    def should_sample(self) -> bool:
+        """One call per dispatched window; True every ``every``-th call
+        past the warmup (the first post-warmup window samples
+        immediately, so short runs still yield evidence)."""
+        if self.every == 0:
+            return False
+        self._count += 1
+        if self._count <= self.warmup:
+            return False
+        return (self._count - self.warmup - 1) % self.every == 0
+
+    def measure(self, dispatch: Callable[[], object],
+                cost: Optional[StepCost] = None, steps: int = 1,
+                block_before=None):
+        """The bracketed sample: drain in-flight device work
+        (``block_before`` — typically the state the step mutates), time
+        ``dispatch()`` to ``block_until_ready`` completion, record, and
+        return the dispatch's value."""
+        import jax
+
+        if block_before is not None:
+            jax.block_until_ready(block_before)
+        t0 = monotime()
+        out = dispatch()
+        jax.block_until_ready(out)
+        self.record(monotime() - t0, cost=cost, steps=steps)
+        return out
+
+    def record(self, device_s: float, cost: Optional[StepCost] = None,
+               steps: int = 1) -> None:
+        """Fold one measured device wall into the evidence: per-path
+        ``device_step_s`` histogram, ``mfu`` gauges, and (when the cost
+        carries a roofline prediction) the counted
+        ``perf.roofline_gap{path,tile}`` ratio."""
+        reg = self.registry
+        self.samples += 1
+        steps = max(1, int(steps))
+        # cost (flops, predicted_s) describes ONE step; the measured
+        # window ran `steps` of them — every figure below is per-step
+        per_step_s = device_s / steps
+        path = (cost.path if cost is not None else "") or "autodiff"
+        backend = self._resolve_backend()
+        reg.histogram(f"{self.prefix}.device_step_s",
+                      path=path).observe(per_step_s)
+        reg.counter("perf.samples", stream=self.prefix).inc()
+        mfu = None
+        peak = self._resolve_peak()
+        if cost is not None and cost.flops > 0 and device_s > 0 and peak:
+            mfu = cost.flops / per_step_s / peak
+            reg.gauge(f"{self.prefix}.mfu").set(mfu)
+            reg.gauge(f"{self.prefix}.mfu", backend=backend,
+                      path=path).set(mfu)
+        ratio = None
+        if (cost is not None and cost.predicted_s > 0 and device_s > 0):
+            ratio = per_step_s / cost.predicted_s
+            reg.histogram("perf.roofline_gap", path=path,
+                          tile=cost.tile or "-").observe(ratio)
+        emit_event("perf.sample", stream=self.prefix, path=path,
+                   backend=backend, steps=steps,
+                   device_s=round(device_s, 6),
+                   **({"mfu": round(mfu, 4)} if mfu is not None else {}),
+                   **({"roofline_gap": round(ratio, 3),
+                       "predicted_s": round(cost.predicted_s, 6),
+                       "tile": cost.tile or "-"}
+                      if ratio is not None else {}))

@@ -19,7 +19,13 @@ ID the supervisor propagated (obs/spans.py correlation contract):
 - hygiene: files scanned, torn/corrupt lines skipped (a SIGKILLed
   writer's tail is skipped by the reader contract, so it can never
   corrupt this report), run IDs seen (one, unless files from different
-  runs were mixed into the directory).
+  runs were mixed into the directory);
+- device-time perf evidence (§12, ISSUE 12): sampled per-kernel-path
+  MFU, device step walls, the predicted-vs-achieved roofline gap, the
+  request critical-path stage decomposition, and trace-capture tallies.
+  ``--diff <run_a> <run_b>`` compares two runs' perf sections and flags
+  MFU/latency regressions (label-exact matching plus run-backend
+  detection, so cpu-fallback rows never compare against on-chip rows).
 
 Diagnostics go to the returned dict / stdout only — this module never
 touches jax, so the CLI runs on a host with a wedged tunnel.
@@ -46,6 +52,18 @@ def _quantile(values: list[float], q: float) -> Optional[float]:
     return ordered[idx]
 
 
+def split_labels(name: str) -> tuple[str, dict]:
+    """``"base{k=v,k2=v2}"`` → ``(base, {k: v, k2: v2})`` (``{}`` for a
+    bare name) — the ONE parser of the registry's instrument-label
+    encoding (obs/registry._label_key), shared by every section below."""
+    if "{" not in name:
+        return name, {}
+    base = name[:name.index("{")]
+    labels = dict(pair.partition("=")[::2]
+                  for pair in name[name.index("{") + 1:-1].split(","))
+    return base, labels
+
+
 def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
     """The merged summary dict for one run directory."""
     run_dir = Path(run_dir)
@@ -57,6 +75,7 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
     merged: dict[str, Histogram] = {}
     run_ids: set[str] = set()
     steps: set[str] = set()
+    perf_backends: set[str] = set()
     skipped_total = 0
     n_events = 0
     errors: dict[str, int] = {}
@@ -82,6 +101,12 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
                     errors[err] = errors.get(err, 0) + 1
                 if isinstance(ev.get("dur_s"), (int, float)):
                     s["dur_s"].append(float(ev["dur_s"]))
+            elif kind == "perf.sample":
+                # which backend(s) this run's device-time samples were
+                # measured on — the diff's cross-backend guard reads it
+                # even when a sample carried no MFU (zero-flops costs)
+                if ev.get("backend"):
+                    perf_backends.add(str(ev["backend"]))
             elif kind == "metrics":
                 last_metrics = ev
         if last_metrics is not None:
@@ -145,12 +170,9 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
     def _by_label(prefix: str, label: str) -> dict:
         out = {}
         for name, v in counters.items():
-            if name.startswith(prefix + "{") and f"{label}=" in name:
-                val = name[name.index("{") + 1:-1]
-                for pair in val.split(","):
-                    k, _, lv = pair.partition("=")
-                    if k == label:
-                        out[lv] = out.get(lv, 0) + int(v)
+            base, labels = split_labels(name)
+            if base == prefix and label in labels:
+                out[labels[label]] = out.get(labels[label], 0) + int(v)
         return out
 
     gateway = {
@@ -200,15 +222,52 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
     # instead of invisible in all artifacts
     kernel_paths: dict = {}
     for name, v in counters.items():
-        if not name.startswith("ensemble.path_resolved{"):
+        base, labels = split_labels(name)
+        if base != "ensemble.path_resolved" or not labels:
             continue
-        labels = dict(pair.partition("=")[::2]
-                      for pair in name[name.index("{") + 1:-1].split(","))
         ent = kernel_paths.setdefault(labels.get("path", "?"),
                                       {"count": 0, "reasons": {}})
         ent["count"] += int(v)
         reason = labels.get("reason", "?")
         ent["reasons"][reason] = ent["reasons"].get(reason, 0) + int(v)
+
+    # device-time perf evidence (docs/ARCHITECTURE.md §12, ISSUE 12): the
+    # sampled probe's measured MFU per kernel path (backend-labeled —
+    # cpu rows are reference numbers, never compared against on-chip
+    # rows), per-path device step walls, the predicted-vs-achieved
+    # roofline gap, the request critical-path stage decomposition, and
+    # the managed-trace capture tallies — the section --diff compares
+    # between runs
+    def _hist_stats(h: dict) -> dict:
+        return {"count": h["count"], "p50": h.get("p50"),
+                "p95": h.get("p95"), "p99": h.get("p99")}
+
+    perf_mfu: dict = {}
+    for name, g in gauges.items():
+        if split_labels(name)[0] in ("train.mfu", "serve.mfu"):
+            perf_mfu[name] = g["value"]
+    device_steps: dict = {}
+    gaps: dict = {}
+    stages: dict = {}
+    for name, h in histograms.items():
+        base, labels = split_labels(name)
+        if base in ("train.device_step_s", "serve.device_step_s"):
+            device_steps[name] = _hist_stats(h)
+        elif base == "perf.roofline_gap":
+            gaps[name] = _hist_stats(h)
+        elif base == "serve.stage_s":
+            stages[labels.get("stage", "?")] = _hist_stats(h)
+    perf = {
+        "mfu": perf_mfu,
+        "device_step_s": device_steps,
+        "roofline_gap": gaps,
+        "request_stages": stages,
+        "backends": sorted(perf_backends),
+        "samples": sum(v for n, v in counters.items()
+                       if n.startswith("perf.samples")),
+        "trace_captured": counters.get("obs.trace.captured", 0),
+        "trace_skipped": counters.get("obs.trace.skipped", 0),
+    }
 
     # guardian evidence (docs/ARCHITECTURE.md §16): the sweep's divergence
     # ladder — member quarantines, chunk quarantines, rollbacks, typed
@@ -245,6 +304,7 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "ingest": ingest,
         "guardian": guardian,
         "kernel_paths": kernel_paths,
+        "perf": perf,
         "dropped_events": counters.get("obs.sink.dropped", 0),
     }
 
@@ -332,6 +392,27 @@ def format_report(report: dict) -> str:
             parts.append(f"{path}={ent['count']} [{reasons}]")
         lines.append("kernel paths (step-path resolutions): "
                      + ", ".join(parts))
+    pf = report.get("perf", {})
+    if pf.get("samples") or pf.get("trace_captured") or pf.get(
+            "trace_skipped"):
+        lines.append(
+            f"perf: {pf['samples']} device-time sample(s), traces "
+            f"{pf['trace_captured']} captured / {pf['trace_skipped']} "
+            "skipped")
+        for name, v in sorted(pf.get("mfu", {}).items()):
+            lines.append(f"  {name:<40} {v:.4f}")
+        for name, s in sorted(pf.get("device_step_s", {}).items()):
+            lines.append(f"  {name:<40} p50 {_fmt_s(s['p50'])}  "
+                         f"p95 {_fmt_s(s['p95'])}  ({s['count']})")
+        for name, s in sorted(pf.get("roofline_gap", {}).items()):
+            lines.append(f"  {name:<40} x{s['p50']:.2f} measured/"
+                         f"predicted  ({s['count']})")
+        if pf.get("request_stages"):
+            stage_bits = "  ".join(
+                f"{st}={_fmt_s(s['p50'])}/{_fmt_s(s['p95'])}/"
+                f"{_fmt_s(s['p99'])}"
+                for st, s in sorted(pf["request_stages"].items()))
+            lines.append(f"  request stages (p50/p95/p99): {stage_bits}")
     interesting = {k: v for k, v in report["counters"].items()
                    if not k.startswith(("jax.retraces", "jax.compiles"))}
     if interesting:
@@ -343,14 +424,131 @@ def format_report(report: dict) -> str:
     return "\n".join(lines)
 
 
+def _perf_backends(perf: dict) -> set:
+    """The backends a run's perf samples were measured on: the
+    ``perf.sample`` events' backend field (present even for zero-flops
+    samples that set no MFU gauge) unioned with the backend-labeled MFU
+    gauge names."""
+    out = set(perf.get("backends", []))
+    for name in perf.get("mfu", {}):
+        backend = split_labels(name)[1].get("backend")
+        if backend:
+            out.add(backend)
+    return out
+
+
+def diff_reports(report_a: dict, report_b: dict,
+                 threshold: float = 0.10) -> dict:
+    """Compare two runs' perf evidence (A = baseline, B = candidate):
+    MFU drops and latency/step-wall increases beyond ``threshold`` are
+    flagged as regressions. A cpu-fallback run never compares against an
+    on-chip run (docs/RUNBOOK_TUNNEL.md): backend-labeled rows only
+    match their exact label twin, and when the two runs' detected
+    backends differ, every backend-UNLABELED metric (step walls,
+    roofline gaps, request stages, latency histograms) is skipped and
+    counted instead of flagged as a bogus cross-backend regression."""
+    pa, pb = report_a.get("perf", {}), report_b.get("perf", {})
+    ba, bb = _perf_backends(pa), _perf_backends(pb)
+    cross_backend = bool(ba) and bool(bb) and ba != bb
+    regressions: list[str] = []
+    improvements: list[str] = []
+    compared = 0
+    skipped_cross_backend = 0
+
+    def _flag(name: str, a: float, b: float, higher_is_better: bool,
+              fmt: str = "{:.4f}", backend_labeled: bool = False) -> None:
+        nonlocal compared, skipped_cross_backend
+        if not a or a <= 0 or b is None:
+            return
+        if cross_backend and not backend_labeled:
+            skipped_cross_backend += 1
+            return
+        compared += 1
+        rel = (b - a) / a
+        worse = rel < -threshold if higher_is_better else rel > threshold
+        better = rel > threshold if higher_is_better else rel < -threshold
+        line = (f"{name}: {fmt.format(a)} -> {fmt.format(b)} "
+                f"({rel * 100.0:+.1f}%)")
+        if worse:
+            regressions.append(line)
+        elif better:
+            improvements.append(line)
+
+    for name, a in pa.get("mfu", {}).items():
+        b = pb.get("mfu", {}).get(name)
+        if b is not None:
+            _flag(name, a, b, higher_is_better=True,
+                  backend_labeled="backend" in split_labels(name)[1])
+    for section, stat in (("device_step_s", "p50"),
+                          ("roofline_gap", "p50"),
+                          ("request_stages", "p95")):
+        for name, sa in pa.get(section, {}).items():
+            sb = pb.get(section, {}).get(name)
+            if sb is not None and sa.get(stat) and sb.get(stat) is not None:
+                _flag(f"{section}:{name}:{stat}", sa[stat], sb[stat],
+                      higher_is_better=False, fmt="{:.6f}")
+    for hist in ("gateway.latency_s",):
+        ha = report_a.get("histograms", {}).get(hist)
+        hb = report_b.get("histograms", {}).get(hist)
+        if ha and hb and ha.get("p95") and hb.get("p95") is not None:
+            _flag(f"{hist}:p95", ha["p95"], hb["p95"],
+                  higher_is_better=False, fmt="{:.6f}")
+    return {"run_a": report_a.get("run_dir"), "run_b": report_b.get("run_dir"),
+            "threshold": threshold, "compared": compared,
+            "backends_a": sorted(ba), "backends_b": sorted(bb),
+            "skipped_cross_backend": skipped_cross_backend,
+            "regressions": regressions, "improvements": improvements}
+
+
+def format_diff(diff: dict) -> str:
+    lines = [f"perf diff {diff['run_a']} -> {diff['run_b']} "
+             f"({diff['compared']} metric(s) compared, threshold "
+             f"{diff['threshold'] * 100:.0f}%)"]
+    if diff.get("skipped_cross_backend"):
+        lines.append(
+            f"  note: runs measured on different backends "
+            f"({','.join(diff['backends_a']) or '?'} vs "
+            f"{','.join(diff['backends_b']) or '?'}); "
+            f"{diff['skipped_cross_backend']} backend-unlabeled metric(s) "
+            "skipped, not compared (docs/RUNBOOK_TUNNEL.md)")
+    for r in diff["regressions"]:
+        lines.append(f"  REGRESSION  {r}")
+    for i in diff["improvements"]:
+        lines.append(f"  improvement {i}")
+    if not diff["regressions"] and not diff["improvements"]:
+        lines.append("  no significant change")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     as_json = "--json" in argv
     argv = [a for a in argv if a != "--json"]
+    if "--diff" in argv:
+        argv.remove("--diff")
+        threshold = 0.10
+        if "--threshold" in argv:
+            i = argv.index("--threshold")
+            try:
+                threshold = float(argv[i + 1])
+            except (IndexError, ValueError):
+                raise SystemExit(
+                    "--threshold needs a numeric value (e.g. "
+                    "--threshold 0.1)") from None
+            del argv[i:i + 2]
+        if len(argv) != 2:
+            raise SystemExit(
+                "usage: python -m sparse_coding_tpu.obs.report --diff "
+                "<run_a> <run_b> [--threshold 0.1] [--json]")
+        diff = diff_reports(build_report(argv[0]), build_report(argv[1]),
+                            threshold=threshold)
+        print(json.dumps(diff, indent=2, default=float) if as_json
+              else format_diff(diff))
+        return
     if len(argv) != 1:
         raise SystemExit(
             "usage: python -m sparse_coding_tpu.obs.report <run_dir> "
-            "[--json]")
+            "[--json] | --diff <run_a> <run_b>")
     report = build_report(argv[0])
     try:
         print(json.dumps(report, indent=2, default=float) if as_json

@@ -68,6 +68,13 @@ def _current_parent() -> Optional[str]:
     return stack[-1] if stack else None
 
 
+def mint_trace_id(prefix: str = "req") -> str:
+    """A process-unique correlation id for one request's critical path
+    (minted at gateway admission, carried queue → flush → dispatch →
+    hedge; §12). Same identity scheme as span ids."""
+    return f"{prefix}-{os.getpid()}-{_next_seq()}"
+
+
 def emit_event(kind: str, *, sink: Optional[sink_mod.EventSink] = None,
                **fields) -> bool:
     """One correlated event to the given (or process-default) sink.

@@ -1,0 +1,143 @@
+"""Crash-safe managed jax.profiler capture (§12).
+
+The bare ``jax.profiler.start_trace``/``stop_trace`` pairs this replaces
+had no exception-path guarantee (the sweep's stop sat 200 lines from its
+start) and wrote the trace straight into its final directory — a SIGKILL
+mid-capture left a half-written artifact indistinguishable from a real
+one. Here every capture is:
+
+- **bounded and explicit** — :class:`TraceCapture` is the begin()/end()
+  state machine for loop hosts (the sweep opens the window at one step
+  boundary and closes it N steps later); :func:`capture` is the
+  context-manager sugar with try/finally semantics;
+- **fault-isolated** — the named fault site ``obs.trace.capture`` covers
+  begin AND finalize: any error is a counted skip
+  (``obs.trace.skipped``) that never kills the sweep it was profiling;
+- **atomic on disk** — the profiler writes into a tmp sibling of the
+  destination; ``end()`` stops the profiler, crosses the
+  ``obs.trace.capture`` crash barrier (tmp durable, final name not yet
+  present — the worst instant the chaos matrix SIGKILLs at,
+  tests/test_pipeline_chaos.py), then renames tmp into place. A reader
+  can only ever see a complete capture or none, and a torn capture
+  leaves the run's training artifacts bitwise identical.
+
+This module is the ONLY place allowed to call the raw profiler API —
+``tests/test_profiler_lint.py`` enforces it mechanically (escape hatch
+``# lint: allow-raw-profiler <why>``).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from pathlib import Path
+from typing import Iterator, Optional
+
+import contextlib
+
+from sparse_coding_tpu.obs.registry import get_registry
+from sparse_coding_tpu.obs.spans import emit_event, monotime
+from sparse_coding_tpu.resilience.crash import crash_barrier, register_crash_site
+from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
+
+SITE = "obs.trace.capture"
+
+register_fault_site(SITE,
+                    "managed profiler capture — begin and atomic finalize "
+                    "(obs/trace.py); error = counted skip, never fatal")
+register_crash_site(SITE,
+                    "profiler stopped, trace tmp dir durable, final "
+                    "rename not yet performed (obs/trace.py)")
+
+
+class TraceCapture:
+    """One managed capture window into ``out_dir``.
+
+    ``begin()`` returns whether profiling actually started (False = a
+    counted skip — the host should stop re-trying the window);
+    ``end()`` is idempotent and safe in a host's finally. A failed or
+    torn capture never raises into the host and never leaves a partial
+    artifact under the final name."""
+
+    def __init__(self, out_dir: str | Path):
+        self.out_dir = Path(out_dir)
+        self._tmp = self.out_dir.parent / \
+            f".{self.out_dir.name}.tmp.{os.getpid()}"
+        self._active = False
+        self._t0 = 0.0
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def _skip(self, stage: str) -> None:
+        get_registry().counter("obs.trace.skipped").inc()
+        emit_event("trace.skipped", dir=str(self.out_dir), stage=stage)
+        shutil.rmtree(self._tmp, ignore_errors=True)
+
+    def begin(self) -> bool:
+        """Start the profiler into the tmp dir. Returns False (counted,
+        tmp cleaned) on any error — profiling must never kill the host
+        workload."""
+        if self._active:
+            return True
+        try:
+            import jax
+
+            # clean debris from a KILLED capture (dead pid's tmp dir):
+            # one capture host per out_dir by contract, so any sibling
+            # tmp is an orphan, never a live writer's
+            for stale in self.out_dir.parent.glob(
+                    f".{self.out_dir.name}.tmp.*"):
+                shutil.rmtree(stale, ignore_errors=True)
+            self._tmp.mkdir(parents=True, exist_ok=True)
+            fault_point(SITE)
+            jax.profiler.start_trace(str(self._tmp))  # lint: allow-raw-profiler the managed wrapper itself
+        except Exception:  # noqa: BLE001 — counted skip by contract
+            self._skip("begin")
+            return False
+        self._active = True
+        self._t0 = monotime()
+        return True
+
+    def end(self) -> Optional[Path]:
+        """Stop the profiler and atomically finalize the artifact into
+        ``out_dir``; returns the final path, or None for a no-op/failed
+        finalize (counted). Idempotent."""
+        if not self._active:
+            return None
+        self._active = False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()  # lint: allow-raw-profiler the managed wrapper itself
+            # the worst instant: the capture is whole in tmp, the final
+            # name absent — a SIGKILL here must cost only the trace
+            crash_barrier(SITE)
+            fault_point(SITE)
+            if self.out_dir.exists():
+                # recapture into the same destination: the old artifact
+                # is replaced whole (never merged with the new one)
+                shutil.rmtree(self.out_dir)
+            self._tmp.rename(self.out_dir)
+        except Exception:  # noqa: BLE001 — counted skip by contract
+            self._skip("finalize")
+            return None
+        dur = monotime() - self._t0
+        get_registry().counter("obs.trace.captured").inc()
+        emit_event("trace.captured", dir=str(self.out_dir),
+                   dur_s=round(dur, 3))
+        return self.out_dir
+
+
+@contextlib.contextmanager
+def capture(out_dir: str | Path) -> Iterator[TraceCapture]:
+    """Context-manager form: profile the body into ``out_dir`` with
+    guaranteed stop+finalize on ANY exit path (the body's exception still
+    propagates; the steps it did capture stay viewable)."""
+    cap = TraceCapture(out_dir)
+    cap.begin()
+    try:
+        yield cap
+    finally:
+        cap.end()

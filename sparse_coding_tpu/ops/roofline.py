@@ -125,6 +125,44 @@ def _est_s(hbm_bytes: float, mxu_flops: float, eff: float) -> float:
                mxu_flops / (MXU_PEAK_FLOPS * eff))
 
 
+def model_flops_per_activation(n_members: int, n_feats: int, d: int) -> float:
+    """~12·n·d flops per activation per member: encode + decode matmuls
+    forward (2·n·d each), ~2x for backward — the flops the MODEL requires,
+    independent of which kernel path executes them (the tiled flash paths
+    EXECUTE 12·B·n·d via recompute, the fused whole-step paths 10·B·n·d;
+    see ``path_cost``). This is the SINGLE home of the MFU numerator
+    (ISSUE 12): bench.py's headline MFU and obs/perf.py's runtime
+    ``train.mfu`` both divide this figure by wall × chip peak, so the two
+    are the same number at the same shape by construction. Counting
+    required (not executed) flops is the standard MFU convention — kernel
+    recompute must never inflate utilization."""
+    return 12.0 * float(n_feats) * float(d) * float(n_members)
+
+
+def serve_flush_plan(op: str, bucket: int, n_feats: int, d: int, *,
+                     n_stack: int = 1, itemsize: int = 4) -> KernelPlan:
+    """Roofline (hbm_bytes, mxu_flops, est_s) for ONE serving bucket
+    dispatch (engine ``run_padded``): the dict params stream once per
+    stacked member, the padded input and the result stream once. Used by
+    ``obs/perf.py``'s serve probe for the predicted-vs-achieved gap; the
+    serving ops are plain XLA programs, so the efficiency calibration is
+    ``AUTODIFF_MXU_EFF`` (the measured XLA discount), and off-chip the
+    prediction is the v5e reference number — the probe labels the backend
+    so cpu rows are never read as on-chip."""
+    n = max(1, int(n_stack))
+    p = float(n_feats) * d * 4  # dict params (f32 resident)
+    x = float(bucket) * (d if op != "decode" else n_feats) * itemsize
+    out_w = {"encode": n_feats, "decode": d, "predict": d}.get(op, n_feats)
+    c = float(bucket) * out_w * itemsize
+    mad = 2.0 * bucket * n_feats * d  # one [bucket,d]x[d,n] matmul
+    flops = {"encode": mad, "decode": mad, "predict": 2 * mad,
+             "topk": mad}.get(op, mad) * n
+    hbm = n * p + x + n * c
+    return KernelPlan(path=None, hbm_bytes=hbm, mxu_flops=flops,
+                      est_s=_est_s(hbm, flops, AUTODIFF_MXU_EFF),
+                      reason=f"serve:{op}")
+
+
 def path_cost(path: Optional[str], n_members: int, batch: int, n_feats: int,
               d: int, *, batch_itemsize: int = 4, n_mats: int = 1,
               moments_itemsize: int = 4, batch_tile: Optional[int] = None,

@@ -254,11 +254,30 @@ class Supervisor:
             self.journal.append("run.done")
             self._record_span("pipeline.run", obs.monotime() - t_run,
                               summary=dict(summary))
+            self._append_perf_ledger()
             return summary
         finally:
             obs.flush_metrics(sink=self._sink)
             self._sink.close()
             self._sink = None
+
+    def _append_perf_ledger(self) -> None:
+        """One durable perf summary row per completed run (ISSUE 12):
+        the run's MFU gauges, kernel-path mix, and step walls distilled
+        from its own merged report — the row obs.report --diff compares
+        round over round. Bookkeeping: a failure here is counted, never
+        fatal to the run that just succeeded."""
+        from sparse_coding_tpu.obs import ledger as ledger_mod
+        from sparse_coding_tpu.obs.report import build_report
+
+        try:
+            row = ledger_mod.run_summary_row(build_report(self.run_dir),
+                                             run_id=self.run_id)
+            row["run_dir"] = str(self.run_dir)
+            ledger_mod.append_row(
+                row, ledger_mod.ledger_path(self.run_dir))
+        except Exception:  # noqa: BLE001 — bookkeeping is never fatal
+            obs.get_registry().counter("obs.ledger.dropped").inc()
 
     # -- lease takeover ------------------------------------------------------
 
@@ -309,6 +328,12 @@ class Supervisor:
         from sparse_coding_tpu.xcache import ENV_DIR as _XCACHE_ENV_DIR
 
         env.setdefault(_XCACHE_ENV_DIR, str(self.run_dir / "xcache"))
+        # perf-ledger propagation (§12, ISSUE 12): every child of this
+        # run — bench included — appends its summary rows to ONE durable
+        # per-run ledger, which obs.report --diff reads across runs
+        from sparse_coding_tpu.obs.ledger import ENV_LEDGER, LEDGER_NAME
+
+        env.setdefault(ENV_LEDGER, str(self.run_dir / LEDGER_NAME))
         if self.cpu_only or degraded:
             env = stripped_cpu_env(env)
         return env

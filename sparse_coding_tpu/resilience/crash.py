@@ -82,6 +82,8 @@ CRASH_SITES: dict[str, str] = {
     "guardian.rollback": "guardian incident ledger + chunk quarantine "
                          "durable, the last-good checkpoint restore not "
                          "yet performed (train/guardian.py)",
+    "obs.trace.capture": "profiler stopped, trace tmp dir durable, final "
+                         "rename not yet performed (obs/trace.py)",
 }
 
 

@@ -87,6 +87,9 @@ FAULT_SITES: dict[str, str] = {
                      "(non-finite-input incident), mode=error with "
                      "message member=<i> poisons that member's loss-scale "
                      "buffer (per-member divergence drill)",
+    "obs.trace.capture": "managed profiler capture — begin and atomic "
+                         "finalize (obs/trace.py)",
+    "obs.ledger.append": "perf-ledger row append (obs/ledger.py)",
 }
 
 

@@ -130,7 +130,11 @@ class ServeFuture:
 @dataclass
 class Request:
     """One submitted unit of work: ``x`` is always [rows, width] float;
-    ``squeeze`` remembers a 1-D submission so the result matches."""
+    ``squeeze`` remembers a 1-D submission so the result matches.
+    ``trace_id`` is the critical-path correlation id minted at admission
+    (obs.mint_trace_id, §12); ``queue_s`` is stamped by the dispatcher
+    when the request leaves the queue, so the completion event can
+    decompose latency into queue wait vs dispatch."""
 
     key: tuple  # (model_name, op)
     x: np.ndarray
@@ -138,6 +142,8 @@ class Request:
     squeeze: bool
     t_submit: float
     future: ServeFuture = field(default_factory=ServeFuture)
+    trace_id: str = ""
+    queue_s: float = 0.0
 
 
 class MicroBatcher:

@@ -213,7 +213,8 @@ class ServingEngine:
                  stream_retry_budget: int = 16,
                  retry_backoff_s: float = 0.002,
                  warmup_workers: int | None = None,
-                 program_cache: ProgramCache | None = None):
+                 program_cache: ProgramCache | None = None,
+                 perf_probe_every: int = obs.perf.DEFAULT_PROBE_EVERY):
         if not buckets or list(buckets) != sorted(set(buckets)):
             raise ValueError(f"buckets must be unique ascending: {buckets}")
         self._registry = registry
@@ -252,6 +253,15 @@ class ServingEngine:
         self._warmup_workers = (max(1, int(warmup_workers))
                                 if warmup_workers is not None
                                 else min(8, os.cpu_count() or 2))
+        # device-time perf evidence (obs/perf.py, §12): every Nth flush's
+        # dispatch wall (already host-synced by the numpy readback) lands
+        # as serve.mfu + serve.device_step_s + the roofline-gap ratio.
+        # Deliberately on the PROCESS registry (not the engine-private
+        # one): a replica pool's device-time samples merge into one
+        # distribution, and flush_metrics() carries them into the run's
+        # report without per-engine plumbing.
+        self._perf_probe = obs.DeviceStepProbe(
+            "serve", every=max(0, int(perf_probe_every)))
         self._warmed = False
         self._batcher = MicroBatcher(
             dispatch=self._dispatch,
@@ -388,6 +398,9 @@ class ServingEngine:
         arr, rows, squeeze = prepare_request(entry, op, self._ops,
                                              self._buckets, self._np_dtype,
                                              x)
+        # no trace id here: the critical-path correlation id is minted
+        # at GATEWAY admission (the front door owns the request story);
+        # a bare engine emits no per-request events
         req = Request(key=(model, op), x=arr, rows=rows, squeeze=squeeze,
                       t_submit=monotime())
         return self._batcher.submit(req)
@@ -474,6 +487,12 @@ class ServingEngine:
             pad[:rows] = x
             x = pad
         compiled = self._get_compiled(model, op, bucket)
+        # perf sample (obs/perf.py): the flush is host-synced by the
+        # numpy readback below, so the dispatch wall IS the device wall —
+        # no extra barrier needed, just the cadence check
+        sample_perf = self._perf_probe.should_sample()
+        if sample_perf:
+            t_perf = monotime()
         fault_point("serve.dispatch")
         # §13 donation rule: a DONATED input must be a runtime-owned
         # buffer. On non-TPU backends jnp.asarray wraps host numpy
@@ -487,9 +506,29 @@ class ServingEngine:
         else:
             dev_x = jnp.asarray(x)
         out = compiled(self._registry.get(model).tree, dev_x)
-        rows_axis = 1 if self._registry.get(model).is_stack else 0
+        entry = self._registry.get(model)
+        rows_axis = 1 if entry.is_stack else 0
         sl = (slice(None),) * rows_axis + (slice(0, rows),)
         host = jax.tree.map(lambda a: np.asarray(a)[sl], out)
+        if sample_perf:
+            from sparse_coding_tpu.ops.roofline import serve_flush_plan
+
+            plan = serve_flush_plan(op, bucket, entry.n_feats,
+                                    entry.d_activation,
+                                    n_stack=entry.n_stack or 1,
+                                    itemsize=self._np_dtype.itemsize)
+            # MFU numerator policy (StepCost): model-REQUIRED flops — the
+            # real `rows`, not the padded bucket, so an underfilled flush
+            # reads as LOW utilization (exactly the pad waste the bucket
+            # ladder must see). The roofline prediction stays at the
+            # padded cost: the device really executes the full bucket.
+            self._perf_probe.record(
+                monotime() - t_perf,
+                cost=obs.StepCost(flops=plan.mxu_flops * (rows / bucket),
+                                  path=f"serve.{op}",
+                                  predicted_s=plan.est_s,
+                                  hbm_bytes=plan.hbm_bytes,
+                                  tile=str(bucket), activations=rows))
         return bucket, host
 
     def _take_retry_token(self, key: tuple) -> bool:

@@ -254,6 +254,10 @@ class ServingGateway:
                 clock=breaker_clock)
             self._order.append(name)
         self._pool_lock = threading.Lock()
+        # per-flush critical-path scratch (winner replica, hedged flag):
+        # written only on the single batcher worker thread (and by
+        # _hedged_run, which runs on that same thread)
+        self._last_flush: dict = {}
         # sized past 2 because a HUNG dispatch (wedged tunnel: blocks,
         # never raises) cannot be cancelled and holds its worker until
         # the backend answers. The dispatch timeout below records such a
@@ -360,9 +364,14 @@ class ServingGateway:
         except QueueFullError:
             self._record_shed(priority)
             raise
+        # critical-path identity (§12): minted at admission, carried
+        # through queue wait → flush assembly → replica dispatch → hedge,
+        # and emitted with the per-stage walls on completion so
+        # obs.report decomposes p50/p95/p99 request latency by stage
         req = GatewayRequest(key=(model, op), x=arr, rows=rows,
                              squeeze=squeeze, t_submit=monotime(),
-                             priority=priority, deadline_s=deadline_s)
+                             priority=priority, deadline_s=deadline_s,
+                             trace_id=obs.mint_trace_id())
         try:
             return self._batcher.submit(req)
         except QueueFullError:
@@ -500,6 +509,7 @@ class ServingGateway:
             self._reg.counter("gateway.hedges_abandoned").inc()
             return self._bounded_result(fut, attempt, t_end)
         self._reg.counter("gateway.hedges_fired").inc()
+        self._last_flush["hedged"] = True
         owners = {fut: attempt, hfut: hedge}
         pending = {fut, hfut}
         first_err: Optional[BaseException] = None
@@ -521,6 +531,7 @@ class ServingGateway:
                         self._reg.counter("gateway.hedges_won").inc()
                     else:
                         self._reg.counter("gateway.hedges_wasted").inc()
+                    self._last_flush["replica"] = owners[f].rep.name
                     # first-wins cancel semantics: the loser cannot be
                     # cancelled mid-execution; its outcome is recorded
                     # by _run_one when it finishes and then discarded
@@ -534,13 +545,23 @@ class ServingGateway:
         """Returns rows served (the batcher's service-rate input), None
         for a shed or failed flush."""
         model, op = key
+        # critical-path stage 1, queue wait: stamped per request the
+        # moment the flush leaves the queue (§12)
+        t_flush = monotime()
+        queue_hist = self._reg.histogram("serve.stage_s", stage="queue")
+        for r in requests:
+            r.queue_s = t_flush - r.t_submit
+            queue_hist.observe(r.queue_s)
         rows = sum(r.rows for r in requests)
         if len(requests) == 1:
             x = requests[0].x
         else:
             x = np.concatenate([r.x for r in requests], axis=0)
+        self._reg.histogram("serve.stage_s", stage="assemble").observe(
+            monotime() - t_flush)
         candidates = self._routing_order()
         last_err: Optional[BaseException] = None
+        t_disp = monotime()
         try:
             for i, rep in enumerate(candidates):
                 token = rep.breaker.allow()
@@ -559,6 +580,8 @@ class ServingGateway:
                         self._reg.counter("gateway.failovers").inc()
                     continue
                 try:
+                    self._last_flush = {"replica": rep.name,
+                                        "hedged": False}
                     bucket, host = self._hedged_run(
                         _Attempt(rep, token), candidates[i + 1:], model,
                         op, x, rows)
@@ -567,6 +590,11 @@ class ServingGateway:
                     if i + 1 < len(candidates):
                         self._reg.counter("gateway.failovers").inc()
                     continue
+                # stage 3, replica dispatch (failovers + hedge included:
+                # this is the request's actual critical path)
+                self._reg.histogram("serve.stage_s",
+                                    stage="dispatch").observe(
+                    monotime() - t_disp)
                 self._finish_flush(key, requests, rows, bucket, host,
                                    deadline_flush)
                 return rows
@@ -591,10 +619,12 @@ class ServingGateway:
 
     def _finish_flush(self, key, requests, rows, bucket, host,
                       deadline_flush) -> None:
-        model, _ = key
+        model, op = key
         self.metrics.record_batch(bucket, len(requests), rows,
                                   deadline_flush)
         rows_axis = 1 if self._registry.get(model).is_stack else 0
+        flush = getattr(self, "_last_flush", {})
+        t_fan = monotime()
 
         def on_latency(r, lat):
             self.metrics.record_latency(bucket, lat)
@@ -602,8 +632,23 @@ class ServingGateway:
                               priority=getattr(r, "priority", BATCH)).inc()
             self._lat_hist().observe(lat)
             self._recent_lat.append(lat)
+            # the request's whole critical path in ONE correlated event,
+            # keyed by the trace id minted at admission — obs.report's
+            # request-stage decomposition reads the stage histograms;
+            # this event is the per-request drill-down
+            obs.emit_event(
+                "serve.request", trace=getattr(r, "trace_id", ""),
+                model=model, op=op,
+                priority=getattr(r, "priority", BATCH), rows=r.rows,
+                bucket=bucket, replica=flush.get("replica", ""),
+                hedged=flush.get("hedged", False),
+                queue_s=round(getattr(r, "queue_s", 0.0), 6),
+                total_s=round(lat, 6))
 
         fanout_results(requests, host, rows_axis, on_latency=on_latency)
+        # stage 4, result fan-out back to the waiters
+        self._reg.histogram("serve.stage_s", stage="fanout").observe(
+            monotime() - t_fan)
         # closed loop: feed the controller the RECENT pool-wide p99 (the
         # all-time histogram would pin the ladder up long after an
         # incident ends) and expose the resulting rung as a gauge

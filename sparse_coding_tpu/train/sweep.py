@@ -309,15 +309,25 @@ def sweep(
     # orbax: a fully-issued async checkpoint set whose swap is deferred so
     # its disk writes overlap the next chunk's training
     pending_staging: Optional[Path] = None
-    # cfg.profile_steps > 0: one jax.profiler trace window opens once the
-    # first program has compiled — step 2 per-step, the SECOND window under
-    # scan (the first window compiles the scanned program; starting there
-    # would trace minutes of XLA compile instead of steady-state steps) —
-    # and closes profile_steps later, on a window boundary, so it covers AT
+    # cfg.profile_steps > 0: one managed trace window (obs/trace.py —
+    # crash-safe: tmp-then-atomic finalize, counted skip on error, and a
+    # guaranteed close in the finally below) opens once the first program
+    # has compiled — step 2 per-step, the SECOND window under scan (the
+    # first window compiles the scanned program; starting there would
+    # trace minutes of XLA compile instead of steady-state steps) — and
+    # closes profile_steps later, on a window boundary, so it covers AT
     # LEAST profile_steps steps.
     profile_start = 2 if scan_k == 1 else scan_k + 1
     profiling = False
     profile_done = False
+    tracer = (obs.TraceCapture(out_dir / "trace")
+              if cfg.profile_steps > 0 else None)
+    # device-time perf evidence (obs/perf.py, §12): every Nth window is
+    # bracketed with block_until_ready timing → train.mfu + roofline-gap
+    # instruments in this run's report; 0 disables
+    probe_every = max(0, int(getattr(cfg, "perf_probe_every", 0)))
+    perf_probe = (obs.DeviceStepProbe("train", every=probe_every)
+                  if probe_every else None)
 
     # warm start (docs/ARCHITECTURE.md §13): with the executable cache
     # enabled, compile-or-load every step program this sweep will
@@ -415,15 +425,30 @@ def sweep(
                         step += k_steps
                         if (cfg.profile_steps > 0 and not profiling
                                 and not profile_done and step >= profile_start):
-                            jax.profiler.start_trace(str(out_dir / "trace"))
-                            profiling = True
+                            profiling = tracer.begin()
+                            # a counted begin-skip must not retry per step
+                            profile_done = not profiling
                         elif profiling and step >= profile_start + cfg.profile_steps:
-                            jax.profiler.stop_trace()
+                            tracer.end()
                             profiling = False
                             profile_done = True
                         do_log = step - last_log >= log_every
                         if do_log:
                             last_log = step
+                        # perf sample (obs/perf.py): bracket this window —
+                        # drain in-flight work, dispatch, sync — so the
+                        # measured wall is pure device time. Log windows
+                        # (their device_get syncs mid-window) and trace
+                        # windows are skipped.
+                        sample_perf = (perf_probe is not None and not do_log
+                                       and not profiling
+                                       and perf_probe.should_sample())
+                        window_aux = []
+                        if sample_perf:
+                            jax.block_until_ready(
+                                [sub.state.params for e, _, _ in ensembles
+                                 for sub in _ensembles_of(e)])
+                            t_perf = obs.monotime()
                         for ens_idx, (ensemble, hypers, name) in enumerate(ensembles):
                             is_group = isinstance(ensemble, EnsembleGroup)
                             if scan_k > 1:
@@ -440,6 +465,8 @@ def sweep(
                                 raw_items = list(stepper(batch).items())
                             else:
                                 raw_items = [(name, stepper(batch))]
+                            if sample_perf:
+                                window_aux.extend(a for _, a in raw_items)
                             if guardian is not None:
                                 # per-window anomaly accumulation: a tiny
                                 # async device combine, host-synced only at
@@ -488,6 +515,16 @@ def sweep(
                                         rec[f"{sub_name}/{member}/loss"] = float(loss_i)
                                         rec[f"{sub_name}/{member}/l0"] = float(l0_i)
                                     logger.log(rec, step=step)
+                        if sample_perf:
+                            jax.block_until_ready(window_aux)
+                            rows = (batch.shape[1] if scan_k > 1
+                                    else batch.shape[0])
+                            perf_probe.record(
+                                obs.monotime() - t_perf,
+                                cost=obs.combine_costs(
+                                    [e.step_cost(rows)
+                                     for e, _, _ in ensembles]),
+                                steps=k_steps)
                         timer.tick(batch.shape[0] * (batch.shape[1]
                                                      if scan_k > 1 else 1))
                         # supervised runs: each completed training window is
@@ -630,9 +667,10 @@ def sweep(
         preempt.__exit__(None, None, None)
         reader.close()  # release any in-flight native chunk read
         if profiling:
-            # short sweeps / crashes inside the window: the trace is still
-            # flushed so the steps it did capture are viewable
-            jax.profiler.stop_trace()
+            # short sweeps / crashes inside the window: the capture is
+            # still finalized (atomically) so the steps it did record are
+            # viewable; a failed finalize is a counted skip, not a crash
+            tracer.end()
         if orbax_ckptr is not None:
             # a FULLY-ISSUED async set is waited on and swapped in even on
             # a crash (it reflects completed training) — but cross-host

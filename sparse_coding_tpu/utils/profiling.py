@@ -1,8 +1,10 @@
 """Tracing / profiling instrumentation.
 
 The reference has none (SURVEY.md §5: progressbar counters only). Here:
-- `trace(path)`: context manager around `jax.profiler` for TensorBoard-
-  readable device traces of any training region;
+- `trace(path)`: context manager for TensorBoard-readable device traces
+  of any training region — a thin alias of the crash-safe managed
+  capture (`obs/trace.py`: tmp-then-atomic finalize, counted skip on
+  error, guaranteed stop on every exit path);
 - `StepTimer`: wall-clock + throughput (activations/sec) tracking with
   warmup skipping — the north-star metric feed for bench.py and sweep logs;
 - `annotate`: named trace regions (shows up in the profiler timeline).
@@ -21,13 +23,14 @@ import jax
 
 @contextlib.contextmanager
 def trace(log_dir: str | Path) -> Iterator[None]:
-    """Capture a device trace viewable in TensorBoard/XProf."""
-    Path(log_dir).mkdir(parents=True, exist_ok=True)
-    jax.profiler.start_trace(str(log_dir))
-    try:
+    """Capture a device trace viewable in TensorBoard/XProf. Managed by
+    ``obs.trace.capture``: the artifact appears atomically at ``log_dir``
+    on close, and a failed capture is a counted skip, never an error in
+    the profiled region."""
+    from sparse_coding_tpu.obs import trace as obs_trace
+
+    with obs_trace.capture(log_dir):
         yield
-    finally:
-        jax.profiler.stop_trace()
 
 
 def annotate(name: str):

@@ -330,7 +330,27 @@ def test_supervised_run_yields_single_correlated_report(tmp_path):
     assert report["dropped_events"] == 0
     assert report["skipped_lines"] == 0
 
+    # device-time perf evidence (ISSUE 12): the DEFAULT probe cadence
+    # sampled the sweep's windows, measured MFU is populated and
+    # backend-labeled (the cpu path here — the labeling rule the runbook
+    # documents), the predicted-vs-achieved roofline gap is counted, and
+    # the supervisor appended the run's summary row to the per-run
+    # perf ledger
+    perf = report["perf"]
+    assert perf["samples"] >= 1
+    assert perf["mfu"].get("train.mfu", 0) > 0
+    assert any("backend=" in k for k in perf["mfu"]), sorted(perf["mfu"])
+    assert perf["roofline_gap"]
+    assert perf["device_step_s"]
+    from sparse_coding_tpu.obs import ledger as perf_ledger
+
+    rows = perf_ledger.read_rows(run_dir / "perf_ledger.jsonl")
+    run_rows = [r for r in rows if r.get("kind") == "run"]
+    assert run_rows and run_rows[-1]["run"] == sup.run_id
+    assert run_rows[-1]["mfu"].get("train.mfu", 0) > 0
+
     # the human rendering holds the headline evidence
     text = format_report(report)
     assert "step.sweep" in text and "retrace" in text
     assert "sweep.items_per_sec" in text
+    assert "perf:" in text

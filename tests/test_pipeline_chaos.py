@@ -601,3 +601,43 @@ def test_guardian_rollback_kill_restart_bitwise(tmp_path, monkeypatch):
     assert set(got) == set(want), set(got) ^ set(want)
     diff = [k for k in want if got[k] != want[k]]
     assert not diff, f"artifacts differ after kill+resume: {diff}"
+
+
+def test_trace_capture_kill_restart_bitwise(tmp_path, monkeypatch, golden):
+    """ISSUE 12 chaos case: SIGKILL the sweep child at the
+    ``obs.trace.capture`` barrier — profiler stopped, the capture whole
+    in its tmp dir, the final rename not yet performed. The restarted
+    attempt re-runs (and re-profiles) from scratch, cleans the dead
+    pid's tmp debris, finalizes its own capture, and the training
+    artifacts are bitwise identical to the UNPROFILED golden run: a torn
+    capture costs at most the trace, never the sweep."""
+    base = tmp_path
+    _seed_from_golden(golden, base, ["chunks"])
+    config = _config(base)
+    # profile steps 2..3 of chunk 0 (4 windows/chunk at batch 128): the
+    # capture closes — and the barrier fires — before the first durable
+    # checkpoint, so the restart replays the whole sweep
+    config["sweep"]["ensemble"]["profile_steps"] = 2
+    run_dir = base / "run"
+    sweep_dir = base / "sweep"
+
+    monkeypatch.setenv(crash_mod.ENV_VAR, "obs.trace.capture:nth=1")
+    sup = Supervisor(run_dir, build_pipeline(run_dir, config, only=["sweep"]),
+                     max_attempts=1, heartbeat_stale_s=STALE_S)
+    with pytest.raises(StepFailed, match="killed by signal 9"):
+        sup.run()
+    # the kill landed between tmp durability and the final rename
+    assert not (sweep_dir / "trace").exists()
+    assert [p for p in sweep_dir.iterdir()
+            if p.name.startswith(".trace.tmp.")], "no torn capture left"
+
+    monkeypatch.delenv(crash_mod.ENV_VAR)
+    sup2 = Supervisor(run_dir, build_pipeline(run_dir, config,
+                                              only=["sweep"]),
+                      max_attempts=2, heartbeat_stale_s=STALE_S)
+    assert sup2.run() == {"sweep": "done"}
+    _assert_bitwise(golden, base, ["sweep"])
+    # the retry's capture finalized atomically and the orphan tmp is gone
+    assert (sweep_dir / "trace").exists()
+    assert not [p for p in sweep_dir.iterdir()
+                if p.name.startswith(".trace.tmp.")]

@@ -1,0 +1,84 @@
+"""Mechanical enforcement of the managed-profiler convention (ISSUE 12):
+bare ``jax.profiler.start_trace``/``stop_trace`` calls anywhere outside
+``obs/trace.py`` fail the build — an unmanaged pair has no exception-path
+guarantee and writes straight into its final directory, so a crash
+mid-capture leaves a half-written artifact indistinguishable from a real
+one (exactly the bug this replaced in train/sweep.py:418/421/635).
+Capture goes through ``obs.trace.capture`` / ``TraceCapture`` (bounded
+window, tmp-then-atomic finalize, counted skip on error).
+
+A grep, not a dataflow analysis, by design (the raw-timer lint's
+pattern): the escape hatch is explicit — append
+``# lint: allow-raw-profiler <why>`` to a line that provably must touch
+the raw API. ``TraceAnnotation``/``annotate`` regions are fine (they
+only label an open trace, they cannot tear one).
+"""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "sparse_coding_tpu"
+
+RAW_PROFILER = re.compile(r"\bprofiler\.(start_trace|stop_trace)\s*\(")
+OPT_OUT = "# lint: allow-raw-profiler"
+# the managed wrapper itself is the one sanctioned home of the raw API
+EXEMPT = ("obs/trace.py",)
+
+
+def _scan(paths, label_root: Path):
+    hits = []
+    for path in paths:
+        rel = path.relative_to(label_root).as_posix()
+        if rel in EXEMPT:
+            continue
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            # match only the code portion: a mention inside a comment or
+            # docstring reference is not a capture call
+            code = line.split("#", 1)[0]
+            if RAW_PROFILER.search(code) and OPT_OUT not in line:
+                hits.append(f"{rel}:{lineno}: {line.strip()}")
+    return hits
+
+
+def _violations(package: Path = None):
+    root = package if package is not None else PACKAGE
+    hits = _scan(sorted(root.rglob("*.py")), root)
+    if package is None:
+        # root scripts (bench.py, tune.py, bench_suite.py, ...) profile
+        # through the same managed path
+        hits += _scan(sorted(REPO.glob("*.py")), REPO)
+    return hits
+
+
+def test_no_raw_profiler_calls():
+    hits = _violations()
+    assert not hits, (
+        "bare jax.profiler.start_trace/stop_trace outside obs/trace.py — "
+        "use obs.trace.capture / TraceCapture (crash-safe: bounded "
+        "window, atomic finalize, counted skip; docs/ARCHITECTURE.md "
+        "§12), or append '# lint: allow-raw-profiler <why>' with a "
+        "reason:\n" + "\n".join(hits))
+
+
+def test_lint_catches_a_planted_violation(tmp_path):
+    """The lint must actually bite: plant raw profiler calls in a scratch
+    tree and watch exactly the unexcused ones get flagged (guards against
+    the regex rotting)."""
+    pkg = tmp_path / "sparse_coding_tpu"
+    (pkg / "train").mkdir(parents=True)
+    (pkg / "obs").mkdir()
+    (pkg / "train" / "bad.py").write_text(
+        "import jax\n"
+        "jax.profiler.start_trace('/tmp/t')\n"
+        "jax.profiler.stop_trace()  # lint: allow-raw-profiler test shim\n"
+        "ok = 1  # jax.profiler.start_trace( in a comment does not count\n"
+        "from jax import profiler\n"
+        "profiler.stop_trace()\n"
+        "jax.profiler.TraceAnnotation('fine')\n")
+    # the managed wrapper itself is exempt by scope
+    (pkg / "obs" / "trace.py").write_text(
+        "import jax\njax.profiler.start_trace('/tmp/t')\n")
+    hits = _violations(pkg)
+    assert len(hits) == 2, hits
+    assert "bad.py:2" in hits[0] and "bad.py:6" in hits[1]

@@ -20,6 +20,11 @@ Sites × handlers covered here:
 - ``xcache.load``   → an erroring or bit-flipped executable-cache entry
                       is counted, deleted, and replaced by a fresh
                       compile — results identical, never a crash
+- ``obs.trace.capture`` → a failing profiler begin/finalize is a counted
+                      skip: the profiled region still runs, no partial
+                      artifact under the final name
+- ``obs.ledger.append`` → a failing perf-ledger row append drops THAT
+                      row (counted), never the bench/run it records
 - SIGTERM           → sweep checkpoints at the chunk boundary and resume
                       continues BITWISE-identically
 """
@@ -1441,3 +1446,51 @@ def test_nonfinite_pt_chunk_quarantined_by_finite_guard(tmp_path):
     lenient = ChunkStore(tmp_path, quarantine_corrupt=True)
     out = list(lenient.chunk_reader([0, 1]))
     assert out[0] is None and out[1] is not None
+
+
+# -- obs.trace.capture / obs.ledger.append (ISSUE 12 perf evidence) ----------
+
+
+def test_trace_capture_fault_skips_capture_never_the_workload(tmp_path):
+    """``obs.trace.capture`` matrix entry: an injected failure at capture
+    begin leaves the profiled region running unprofiled — counted in
+    ``obs.trace.skipped`` — and a failure at finalize leaves NO partial
+    artifact under the final name. Profiling must never take down the
+    sweep it was measuring."""
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.obs import trace as obs_trace
+
+    ran = []
+    before = obs.counter("obs.trace.skipped").value
+    with inject(site="obs.trace.capture", nth=1, error="OSError") as plan:
+        with obs_trace.capture(tmp_path / "t1") as cap:
+            ran.append(cap.active)  # begin failed: body still runs
+    assert ran == [False]
+    assert plan.fired_count("obs.trace.capture") == 1
+    assert not (tmp_path / "t1").exists()
+    with inject(site="obs.trace.capture", nth=2, error="OSError"):
+        with obs_trace.capture(tmp_path / "t2"):
+            ran.append(True)  # begin ok, finalize injected
+    assert not (tmp_path / "t2").exists()
+    assert not list(tmp_path.glob(".t2.tmp.*")), "tmp debris left behind"
+    assert obs.counter("obs.trace.skipped").value == before + 2
+
+
+def test_ledger_append_fault_drops_row_counted(tmp_path, monkeypatch):
+    """``obs.ledger.append`` matrix entry: an injected I/O failure on the
+    perf-ledger append drops exactly that row — counted in
+    ``obs.ledger.dropped`` — and returns False instead of raising into
+    the bench/run being recorded."""
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.obs import ledger as perf_ledger
+
+    target = tmp_path / "perf_ledger.jsonl"
+    monkeypatch.setenv(perf_ledger.ENV_LEDGER, str(target))
+    before = obs.counter("obs.ledger.dropped").value
+    assert perf_ledger.append_row({"kind": "bench", "n": 1}) is True
+    with inject(site="obs.ledger.append", nth=1, error="OSError") as plan:
+        assert perf_ledger.append_row({"kind": "bench", "n": 2}) is False
+    assert plan.fired_count("obs.ledger.append") == 1
+    assert perf_ledger.append_row({"kind": "bench", "n": 3}) is True
+    assert obs.counter("obs.ledger.dropped").value == before + 1
+    assert [r["n"] for r in perf_ledger.read_rows()] == [1, 3]

@@ -380,3 +380,35 @@ def test_sentinel_guarded_step_lowers_with_no_added_host_transfer(rng):
         ens.state, batch).lower(lowering_platforms=("tpu",)).as_text()
     for marker in ("infeed", "outfeed", "SendToHost", "RecvFromHost"):
         assert marker not in text
+
+
+def test_device_step_probe_leaves_fused_step_hlo_bitwise_identical(rng):
+    """ISSUE 12 AOT gate: the DeviceStepProbe is host-side by
+    construction — bracketing the lowering of the FUSED train step in a
+    sampling probe (block_until_ready + monotonic timers + registry
+    writes) leaves the TPU-lowered HLO bitwise identical, and the probe
+    demonstrably recorded the sample it took to prove it."""
+    from sparse_coding_tpu import obs
+
+    members = [FunctionalTiedSAE.init(k, 32, 64, l1_alpha=1e-3)
+               for k in jax.random.split(rng, 2)]
+    ens = Ensemble(members, FunctionalTiedSAE, donate=False)
+    batch = jnp.zeros((128, 32))
+    ens._resolve_step(128)  # the roofline-admitted fused program
+
+    def lower_fused():
+        return ens._step_fn.trace(ens.state, batch).lower(
+            lowering_platforms=("tpu",)).as_text()
+
+    baseline = lower_fused()
+    probe = obs.DeviceStepProbe("train", every=1, warmup=0,
+                                registry=obs.Registry(), backend="cpu")
+    assert probe.should_sample()
+    instrumented = probe.measure(lower_fused, cost=ens.step_cost(128),
+                                 block_before=ens.state.params)
+    assert instrumented == baseline
+    assert probe.samples == 1
+    snap = probe.registry.snapshot()
+    assert snap["counters"]["perf.samples{stream=train}"] == 1
+    assert any(k.startswith("train.device_step_s{")
+               for k in snap["histograms"])
