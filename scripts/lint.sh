@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Standalone static analysis (docs/ARCHITECTURE.md §17): the unified AST
+# engine over the whole tree — reliability conventions (atomic writes,
+# obs timers, managed profiler, xcache compiles, fault/crash coverage)
+# plus the JAX-hazard passes (host-sync, donation safety, stale escape
+# hatches, in-trace nondeterminism).
+#
+# Safe under a wedged TPU tunnel BY CONSTRUCTION: the analysis package's
+# import chain is jax-free (the package __init__ is lazy —
+# tests/test_analysis.py::test_cli_import_chain_is_jax_free enforces it),
+# so this never becomes the second tunnel-touching process. The env strip
+# below is belt and braces.
+#
+# Usage: scripts/lint.sh [--json] [--rule <id>] [--list-rules] [paths...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env -u PALLAS_AXON_POOL_IPS python -m sparse_coding_tpu.analysis "$@"

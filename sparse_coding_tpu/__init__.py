@@ -16,13 +16,42 @@ A ground-up re-design of the capabilities of HoagyC/sparse_coding (see
 - a request-driven serving engine (serve/) — micro-batched, AOT-compiled
   shape-bucket feature extraction over a multi-dict registry — a workload
   the reference has no counterpart for.
+
+Submodules and the convenience re-exports (``Ensemble``,
+``EnsembleGroup``, ``make_mesh``) resolve LAZILY (PEP 562): importing
+``sparse_coding_tpu`` alone must not import jax, so the jax-free tooling
+under ``sparse_coding_tpu.analysis`` (the static-analysis CLI,
+``scripts/lint.sh``) can run while another process owns the TPU tunnel —
+the axon plugin initializes the tunnel in every jax-importing process
+(see CLAUDE.md), and a lint must never be that second process.
 """
+
+import importlib
 
 __version__ = "0.1.0"
 
-from sparse_coding_tpu import config as config
-from sparse_coding_tpu import ensemble as ensemble
-from sparse_coding_tpu import models as models
-from sparse_coding_tpu import serve as serve
-from sparse_coding_tpu.ensemble import Ensemble, EnsembleGroup
-from sparse_coding_tpu.parallel.mesh import make_mesh
+_SUBMODULES = (
+    "analysis", "config", "data", "ensemble", "interp", "lm", "metrics",
+    "models", "obs", "ops", "parallel", "pipeline", "plotting",
+    "resilience", "serve", "tasks", "train", "utils", "xcache",
+)
+
+_LAZY_ATTRS = {
+    "Ensemble": ("sparse_coding_tpu.ensemble", "Ensemble"),
+    "EnsembleGroup": ("sparse_coding_tpu.ensemble", "EnsembleGroup"),
+    "make_mesh": ("sparse_coding_tpu.parallel.mesh", "make_mesh"),
+}
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f"sparse_coding_tpu.{name}")
+    if name in _LAZY_ATTRS:
+        module, attr = _LAZY_ATTRS[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(
+        f"module 'sparse_coding_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_SUBMODULES) | set(_LAZY_ATTRS))

@@ -1,0 +1,68 @@
+"""Unified AST static-analysis engine (docs/ARCHITECTURE.md §17).
+
+One parse per file, a registry of passes per parse. Public surface:
+
+- :func:`run_analysis` — run every pass, return an
+  :class:`AnalysisResult` (``findings`` / ``matches`` / ``hatches`` /
+  ``meta``)
+- :class:`Finding` / :class:`Match` / :class:`Hatch` — the record types
+- :func:`rule_ids`, :data:`ALL_RULES` — the registered rule table
+- CLI: ``python -m sparse_coding_tpu.analysis [--json] [--rule ID]
+  [paths...]`` (jax-free import; safe under a wedged TPU tunnel —
+  ``scripts/lint.sh`` is the one-command wrapper)
+
+Importing the pass modules registers them; keep that import list in sync
+with new pass modules.
+"""
+
+from sparse_coding_tpu.analysis.core import (
+    AnalysisResult,
+    FileCtx,
+    Finding,
+    Hatch,
+    Match,
+    Pass,
+    RepoCtx,
+    register,
+    rule_ids,
+    run_analysis,
+)
+
+# importing registers the passes
+from sparse_coding_tpu.analysis import coverage as _coverage  # noqa: F401
+from sparse_coding_tpu.analysis import hazards as _hazards  # noqa: F401
+from sparse_coding_tpu.analysis import legacy as _legacy  # noqa: F401
+from sparse_coding_tpu.analysis import nondet as _nondet  # noqa: F401
+from sparse_coding_tpu.analysis.core import _REGISTRY, STALE_HATCH_RULE
+
+
+def rule_table() -> dict[str, str]:
+    """rule id -> one-line description (the §17 rule table)."""
+    from sparse_coding_tpu.analysis.core import (
+        PARSE_ERROR_RULE,
+        STALE_HATCH_DESCRIPTION,
+    )
+    table = {rid: cls.description for rid, cls in sorted(_REGISTRY.items())}
+    table[PARSE_ERROR_RULE] = (
+        "the file does not parse — no pass can analyze it, so every "
+        "rule's verdict on it would be vacuous (never filtered out)")
+    table[STALE_HATCH_RULE] = STALE_HATCH_DESCRIPTION
+    return table
+
+
+ALL_RULES = tuple(rule_ids())
+
+__all__ = [
+    "ALL_RULES",
+    "AnalysisResult",
+    "FileCtx",
+    "Finding",
+    "Hatch",
+    "Match",
+    "Pass",
+    "RepoCtx",
+    "register",
+    "rule_ids",
+    "rule_table",
+    "run_analysis",
+]

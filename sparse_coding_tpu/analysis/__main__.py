@@ -1,0 +1,89 @@
+"""CLI for the static-analysis engine.
+
+    python -m sparse_coding_tpu.analysis [--json] [--rule ID]...
+                                         [--list-rules] [paths...]
+
+Exit status 1 when findings remain, 0 on a clean tree. ``paths``
+restricts REPORTING to files under the given paths (the whole tree is
+still analyzed — hatch staleness needs the full match set). The import
+chain is jax-free by construction (the package ``__init__`` is lazy), so
+this is safe to run while a training process owns the TPU tunnel; use
+``scripts/lint.sh`` for the env-stripped belt-and-braces invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from sparse_coding_tpu.analysis import rule_ids, rule_table, run_analysis
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sparse_coding_tpu.analysis",
+        description="AST static analysis: reliability-convention and "
+                    "JAX-hazard passes (docs/ARCHITECTURE.md §17)")
+    parser.add_argument("paths", nargs="*",
+                        help="restrict reported findings to these "
+                             "files/directories (default: whole repo)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the machine-readable JSON report")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="ID", choices=rule_ids(),
+                        help="report only this rule (repeatable)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    parser.add_argument("--package", type=Path, default=None,
+                        help="package dir to analyze (default: this "
+                             "installed sparse_coding_tpu)")
+    parser.add_argument("--repo-root", type=Path, default=None,
+                        help="repo root for root-script scanning and "
+                             "matrix suites (default: package parent)")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid, desc in rule_table().items():
+            print(f"{rid:20s} {desc}")
+        return 0
+
+    package = args.package or Path(__file__).resolve().parent.parent
+    repo_root = args.repo_root or package.parent
+    result = run_analysis(package=package, repo_root=repo_root,
+                          rules=args.rule)
+
+    findings = result.findings
+    if args.paths:
+        prefixes = []
+        for p in args.paths:
+            rp = Path(p).resolve()
+            try:
+                prefixes.append(rp.relative_to(repo_root).as_posix())
+            except ValueError:
+                prefixes.append(str(p))
+        findings = [f for f in findings
+                    if any(f.rel == pre or f.rel.startswith(pre + "/")
+                           for pre in prefixes)]
+
+    if args.as_json:
+        payload = result.to_json()
+        payload["findings"] = [
+            {"rule": f.rule, "file": f.rel, "line": f.line,
+             "message": f.message} for f in findings]
+        payload["counts"] = {}
+        for f in findings:
+            payload["counts"][f.rule] = payload["counts"].get(f.rule, 0) + 1
+        print(json.dumps(payload, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"{result.meta.get('files_scanned', 0)} files scanned, "
+              f"{n} finding{'s' if n != 1 else ''}", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,345 @@
+"""Unified static-analysis engine (docs/ARCHITECTURE.md §17).
+
+One parse per file, many passes per parse: each ``*.py`` under the
+package (plus the repo-root scripts) is read ONCE into a
+:class:`FileCtx` — source text, split lines, ``ast`` tree, and a
+tokenize-accurate comment map — and every registered pass walks that
+shared tree emitting :class:`Match` records. The engine then applies the
+single escape-hatch protocol (``# lint: allow-<rule> <why>``, reason
+mandatory) and the per-rule scope to turn matches into
+:class:`Finding`s, and finally cross-checks every hatch against the
+match set so a hatch whose line no longer triggers its rule fails the
+build instead of rotting silently.
+
+Matches vs findings: a pass reports every place its pattern occurs
+(``in_scope`` marks whether the rule's convention actually covers that
+file) so hatch staleness can be judged pattern-level — moving a file out
+of a rule's scope does not strand its hatches — while findings are only
+the in-scope, unexcused matches.
+
+This module is deliberately import-light: no jax, no numpy — the CLI
+(``python -m sparse_coding_tpu.analysis``) must run under a wedged TPU
+tunnel without ever touching the axon plugin.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+HATCH_RE = re.compile(r"#\s*lint:\s*allow-([A-Za-z0-9_-]+)[ \t]*(.*)$")
+
+
+@dataclass(frozen=True)
+class Hatch:
+    """One ``# lint: allow-<rule> <why>`` escape-hatch comment."""
+
+    rule: str
+    reason: str
+    line: int
+
+
+@dataclass(frozen=True)
+class Match:
+    """A pattern occurrence reported by a pass (pre-excusal, pre-scope).
+
+    ``line``..``end_line`` is the excusable span: a hatch on any line of
+    the span excuses the match (multi-line call chains put the hatch
+    wherever it reads best, as the legacy bare-compile lint allowed).
+    """
+
+    rule: str
+    rel: str
+    line: int
+    end_line: int
+    message: str
+    in_scope: bool = True
+
+
+@dataclass(frozen=True)
+class Finding:
+    """An in-scope, unexcused match — what the build fails on."""
+
+    rule: str
+    rel: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.rel}:{self.line}: [{self.rule}] {self.message}"
+
+
+class FileCtx:
+    """One parsed file: text, lines, AST, comments, and hatches."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(self.text)
+        except SyntaxError as err:
+            self.tree = None
+            self.parse_error = err
+        self.comments: dict[int, str] = self._comment_map()
+        self.hatches: dict[int, Hatch] = {}
+        for lineno, comment in self.comments.items():
+            m = HATCH_RE.search(comment)
+            if m:
+                self.hatches[lineno] = Hatch(rule=m.group(1),
+                                             reason=m.group(2).strip(),
+                                             line=lineno)
+
+    def _comment_map(self) -> dict[int, str]:
+        """line -> comment text, from real COMMENT tokens only (a hatch
+        string quoted inside a docstring is documentation, not a hatch)."""
+        out: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    out[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # unparseable tail: fall back to a naive scan so hatches on
+            # the intact prefix still register
+            for i, line in enumerate(self.lines, 1):
+                if "#" in line:
+                    out[i] = line[line.index("#"):]
+        return out
+
+    def line_of(self, node: ast.AST, needle: str) -> int:
+        """First line in ``node``'s span whose source contains ``needle``
+        (the legacy lints reported e.g. the ``.lower(`` line of a
+        multi-line chain); falls back to ``node.lineno``."""
+        start = node.lineno
+        end = getattr(node, "end_lineno", start) or start
+        for i in range(start, min(end, len(self.lines)) + 1):
+            if needle in self.lines[i - 1]:
+                return i
+        return start
+
+    def src(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+@dataclass
+class RepoCtx:
+    """Cross-file state shared by all passes in one run."""
+
+    package: Path
+    repo_root: Optional[Path] = None
+    fault_matrix_text: str = ""
+    crash_matrix_text: str = ""
+    meta: dict = field(default_factory=dict)
+
+
+@dataclass
+class AnalysisResult:
+    findings: list[Finding]
+    matches: list[Match]
+    hatches: list[tuple[str, Hatch]]  # (rel, hatch)
+    meta: dict
+
+    def for_rule(self, rule: str) -> list[Finding]:
+        return [f for f in self.findings if f.rule == rule]
+
+    def render(self) -> list[str]:
+        return [f.render() for f in self.findings]
+
+    def to_json(self) -> dict:
+        counts: dict[str, int] = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {
+            "findings": [{"rule": f.rule, "file": f.rel, "line": f.line,
+                          "message": f.message} for f in self.findings],
+            "counts": counts,
+            "files_scanned": self.meta.get("files_scanned", 0),
+            "hatches": [{"file": rel, "line": h.line, "rule": h.rule,
+                         "reason": h.reason} for rel, h in self.hatches],
+        }
+
+
+class Pass:
+    """Base pass: subclasses set ``rule`` and implement :meth:`run`.
+
+    ``rule`` doubles as the escape-hatch suffix: ``# lint: allow-<rule>
+    <why>`` on any line of a match's span excuses it.
+    """
+
+    rule: str = ""
+    description: str = ""
+
+    def run(self, ctx: FileCtx, repo: RepoCtx) -> Iterable[Match]:
+        raise NotImplementedError
+
+
+# registry ----------------------------------------------------------------
+
+_REGISTRY: dict[str, Callable[[], Pass]] = {}
+
+
+def register(cls):
+    """Class decorator: add a pass to the default registry."""
+    _REGISTRY[cls.rule] = cls
+    return cls
+
+
+def rule_ids() -> list[str]:
+    return sorted(_REGISTRY) + [PARSE_ERROR_RULE, STALE_HATCH_RULE]
+
+
+PARSE_ERROR_RULE = "parse-error"
+STALE_HATCH_RULE = "stale-hatch"
+STALE_HATCH_DESCRIPTION = (
+    "escape hatches must stay earned: a '# lint: allow-<rule> <why>' "
+    "comment whose line no longer triggers <rule>, names an unknown "
+    "rule, or omits the mandatory <why> reason fails the build")
+
+
+def _iter_files(package: Path, repo_root: Optional[Path]):
+    """(path, rel) for every scanned file, package first then repo-root
+    scripts; rel is the display path (package-parent- or repo-relative)."""
+    base = package.parent
+    for path in sorted(package.rglob("*.py")):
+        yield path, path.relative_to(base).as_posix()
+    if repo_root is not None:
+        for path in sorted(repo_root.glob("*.py")):
+            yield path, path.relative_to(repo_root).as_posix()
+
+
+def _stale_hatch_findings(ctxs: list[FileCtx],
+                          matches: list[Match]) -> list[Finding]:
+    known = set(_REGISTRY)
+    covered: dict[tuple[str, str], list[tuple[int, int]]] = {}
+    for m in matches:
+        covered.setdefault((m.rel, m.rule), []).append((m.line, m.end_line))
+    out = []
+    for ctx in ctxs:
+        if ctx.parse_error is not None:
+            # no pass ran on this file: hatch staleness is unjudgeable
+            # (the parse-error finding already fails the build)
+            continue
+        for h in ctx.hatches.values():
+            if h.rule not in known:
+                out.append(Finding(
+                    STALE_HATCH_RULE, ctx.rel, h.line,
+                    f"escape hatch names unknown rule 'allow-{h.rule}' "
+                    f"(known: {', '.join(sorted(known))})"))
+                continue
+            if not h.reason:
+                out.append(Finding(
+                    STALE_HATCH_RULE, ctx.rel, h.line,
+                    f"escape hatch 'allow-{h.rule}' has no reason — "
+                    "'# lint: allow-<rule> <why>' requires the <why>"))
+            spans = covered.get((ctx.rel, h.rule), ())
+            if not any(lo <= h.line <= hi for lo, hi in spans):
+                out.append(Finding(
+                    STALE_HATCH_RULE, ctx.rel, h.line,
+                    f"stale escape hatch: this line no longer triggers "
+                    f"rule '{h.rule}' — delete the "
+                    f"'# lint: allow-{h.rule}' comment"))
+    return out
+
+
+def run_analysis(package: Path | str,
+                 repo_root: Path | str | None = None,
+                 rules: Optional[Iterable[str]] = None,
+                 fault_matrix_text: Optional[str] = None,
+                 crash_matrix_text: Optional[str] = None) -> AnalysisResult:
+    """Parse every file once and run every registered pass over it.
+
+    All passes always execute (hatch staleness needs the full match set);
+    ``rules`` only filters the REPORTED findings. Matrix texts default to
+    the repo's fault/chaos suites when ``repo_root`` is given, else empty
+    (scratch trees in tests pass their own).
+    """
+    package = Path(package)
+    repo_root = Path(repo_root) if repo_root is not None else None
+    if fault_matrix_text is None:
+        fault_matrix_text = _read_matrix(repo_root, "test_resilience.py")
+    if crash_matrix_text is None:
+        crash_matrix_text = _read_matrix(repo_root, "test_pipeline_chaos.py")
+    repo = RepoCtx(package=package, repo_root=repo_root,
+                   fault_matrix_text=fault_matrix_text,
+                   crash_matrix_text=crash_matrix_text)
+    passes = [cls() for _, cls in sorted(_REGISTRY.items())]
+
+    ctxs: list[FileCtx] = []
+    matches: list[Match] = []
+    findings: list[Finding] = []
+    for path, rel in _iter_files(package, repo_root):
+        ctx = FileCtx(path, rel)
+        ctxs.append(ctx)
+        if ctx.parse_error is not None:
+            findings.append(Finding(
+                PARSE_ERROR_RULE, rel, ctx.parse_error.lineno or 1,
+                f"file does not parse: {ctx.parse_error.msg}"))
+            continue
+        for p in passes:
+            matches.extend(p.run(ctx, repo))
+
+    hatch_by_rel = {ctx.rel: ctx.hatches for ctx in ctxs}
+    for m in matches:
+        if not m.in_scope:
+            continue
+        file_hatches = hatch_by_rel.get(m.rel, {})
+        if any(h.rule == m.rule
+               for ln in range(m.line, m.end_line + 1)
+               if (h := file_hatches.get(ln)) is not None):
+            continue
+        findings.append(Finding(m.rule, m.rel, m.line, m.message))
+
+    findings.extend(_stale_hatch_findings(ctxs, matches))
+
+    if rules is not None:
+        # parse errors always survive the filter: a file no pass could
+        # analyze makes every rule's verdict on it meaningless
+        wanted = set(rules) | {PARSE_ERROR_RULE}
+        findings = [f for f in findings if f.rule in wanted]
+    findings.sort(key=lambda f: (f.rel, f.line, f.rule))
+    repo.meta["files_scanned"] = len(ctxs)
+    hatches = [(ctx.rel, h) for ctx in ctxs for h in ctx.hatches.values()]
+    return AnalysisResult(findings=findings, matches=matches,
+                          hatches=hatches, meta=repo.meta)
+
+
+def _read_matrix(repo_root: Optional[Path], name: str) -> str:
+    if repo_root is None:
+        return ""
+    path = repo_root / "tests" / name
+    return path.read_text() if path.exists() else ""
+
+
+# shared AST helpers ------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.profiler.start_trace' for a Name/Attribute chain, '' if the
+    chain bottoms out in anything else (a call, a subscript, ...)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def last_segment(node: ast.AST) -> str:
+    """Final attribute/name of a callee expression ('item' for
+    ``x.y.item``), '' when the callee is not a name chain tail."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""

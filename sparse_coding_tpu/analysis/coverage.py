@@ -1,0 +1,132 @@
+"""Fault-site and crash-site coverage passes (legacy
+tests/test_fault_site_lint.py and tests/test_crash_site_lint.py ported
+onto the shared engine).
+
+Every ``register_fault_site("<site>", ...)`` needs a deterministic entry
+in the fault matrix (tests/test_resilience.py), every
+``register_crash_site("<site>", ...)`` — and every seed entry in
+``resilience/crash.py``'s canonical ``CRASH_SITES`` table — needs a
+SIGKILL case in the chaos matrix (tests/test_pipeline_chaos.py). A
+failure path without its matrix case ships untested, which is exactly
+the rot the injection harness exists to prevent
+(docs/ARCHITECTURE.md §10/§11).
+
+A matrix "covers" a site when it names it as a string literal (the
+``inject(site="...")`` form, a compact ``site:nth=...`` plan string, or
+a docstring row) — same containment check the legacy lints used, driven
+from the engine's single tree walk. The collected registrations are
+published in ``repo.meta['fault_sites']``/``['crash_sites']`` for the
+sanity tests that guard against a vacuously-green scan.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from sparse_coding_tpu.analysis.core import (
+    FileCtx,
+    Match,
+    Pass,
+    RepoCtx,
+    last_segment,
+    register,
+)
+from sparse_coding_tpu.analysis.legacy import _in_package
+
+
+def _literal_registrations(tree: ast.AST, register_name: str):
+    """(site, lineno) for every ``<register_name>("literal", ...)`` call.
+    A computed name cannot be linted and is left to review."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and last_segment(node.func) == register_name
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            yield node.args[0].value, node.lineno
+
+
+def _covered(site: str, matrix_text: str) -> bool:
+    return (f'"{site}"' in matrix_text or f"'{site}'" in matrix_text
+            or f"{site}:" in matrix_text)
+
+
+class _SiteCoveragePass(Pass):
+    register_name = ""
+    matrix_attr = ""        # RepoCtx attribute holding the matrix text
+    matrix_file = ""        # display name for messages
+    meta_key = ""
+    kind = ""
+
+    def run(self, ctx: FileCtx, repo: RepoCtx) -> Iterable[Match]:
+        in_scope = _in_package(ctx)
+        matrix = getattr(repo, self.matrix_attr)
+        sites = repo.meta.setdefault(self.meta_key, [])
+        for site, lineno in self._registrations(ctx):
+            excused = lineno in ctx.hatches and \
+                ctx.hatches[lineno].rule == self.rule
+            sites.append((site, f"{ctx.rel}:{lineno}", excused))
+            if _covered(site, matrix):
+                continue
+            yield Match(
+                self.rule, ctx.rel, lineno, lineno,
+                f"{self.kind} site {site!r} has no entry in "
+                f"tests/{self.matrix_file}", in_scope=in_scope)
+
+    def _registrations(self, ctx: FileCtx):
+        yield from _literal_registrations(ctx.tree, self.register_name)
+
+
+@register
+class UnmatrixedFaultPass(_SiteCoveragePass):
+    rule = "unmatrixed-fault"
+    description = ("fault site registered without a deterministic "
+                   "fault-matrix entry in tests/test_resilience.py "
+                   "(docs/ARCHITECTURE.md §10)")
+    register_name = "register_fault_site"
+    matrix_attr = "fault_matrix_text"
+    matrix_file = "test_resilience.py"
+    meta_key = "fault_sites"
+    kind = "fault"
+
+
+@register
+class UnmatrixedCrashPass(_SiteCoveragePass):
+    rule = "unmatrixed-crash"
+    description = ("crash site registered without a SIGKILL chaos-matrix "
+                   "case in tests/test_pipeline_chaos.py "
+                   "(docs/ARCHITECTURE.md §11)")
+    register_name = "register_crash_site"
+    matrix_attr = "crash_matrix_text"
+    matrix_file = "test_pipeline_chaos.py"
+    meta_key = "crash_sites"
+    kind = "crash"
+
+    def _registrations(self, ctx: FileCtx):
+        yield from _literal_registrations(ctx.tree, self.register_name)
+        # the canonical seed table in resilience/crash.py: a child's plan
+        # can parse before host modules import, so its quoted keys are
+        # registrations of crash.py itself
+        if ctx.rel.endswith("resilience/crash.py"):
+            found = False
+            for node in ast.walk(ctx.tree):
+                target = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target = node.targets[0]
+                elif isinstance(node, ast.AnnAssign):
+                    target = node.target
+                if not (isinstance(target, ast.Name)
+                        and target.id == "CRASH_SITES"
+                        and isinstance(getattr(node, "value", None),
+                                       ast.Dict)):
+                    continue
+                found = True
+                for key in node.value.keys:
+                    if isinstance(key, ast.Constant) and isinstance(
+                            key.value, str):
+                        yield key.value, key.lineno
+            if not found:
+                # the seed table is load-bearing (docs/ARCHITECTURE.md
+                # §11); its disappearance is itself a finding
+                yield "(CRASH_SITES table missing)", 1

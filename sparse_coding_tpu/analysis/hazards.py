@@ -1,0 +1,718 @@
+"""JAX dispatch-discipline passes: host-sync hazards and donation safety.
+
+These are the bug classes the legacy regex lints could never express —
+both need the parsed tree plus intra-function dataflow:
+
+``host-sync``
+    ``float()`` / ``int()`` / ``bool()`` / ``.item()`` / ``np.asarray()``
+    applied to a device-array-producing expression INSIDE a per-step hot
+    loop forces a device→host transfer per iteration, which stalls XLA's
+    async dispatch pipeline (the ROADMAP item-2 MFU plateau is partly
+    this). Scope detection is conservative: a value is "device-array-
+    producing" only when it taints back, through assignments in the same
+    function, to a call of a jitted step (a name bound to
+    ``jax.jit``/``pjit``/``shard_map``/``cached_compile`` — directly, via
+    a local factory, or via a ``*step*``-named callable); a sink is only
+    flagged inside a ``for``/``while`` body. Reads already batched
+    through ``jax.device_get`` are host values and never flagged —
+    that IS the fix.
+
+``donation``
+    invocations of donated executables (``donate_argnums``/``donate``)
+    whose donated argument reaches back, via intra-function assignment
+    chains, to externally-owned memory: ``np.frombuffer``/``memoryview``
+    views (and view-producing methods on them), checkpoint-restore
+    payloads (``*restore*``/``from_bytes`` results), or raw function
+    parameters never materialized through ``jnp.array(...)``. This is
+    the PR-5 use-after-release class: a cache-loaded executable retains
+    input-output aliasing that a fresh CPU compile drops, so donating a
+    buffer jax does not own turns the first step into heap corruption
+    (docs/ARCHITECTURE.md §13, "donation rule").
+
+Both passes fail open-eyed: what they cannot resolve they do not flag
+(a finding should always be worth reading), and the standard
+``# lint: allow-host-sync <why>`` / ``# lint: allow-donation <why>``
+hatches excuse audited boundary syncs and provably-owned donations.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from sparse_coding_tpu.analysis.core import (
+    FileCtx,
+    Match,
+    Pass,
+    RepoCtx,
+    dotted_name,
+    last_segment,
+    register,
+)
+from sparse_coding_tpu.analysis.legacy import _pkg_rel
+
+JIT_WRAPPERS = ("jit", "pjit", "shard_map", "cached_compile")
+SANITIZERS = ("jax.device_get", "device_get")
+MATERIALIZERS = ("jnp.array", "jax.numpy.array")
+TREE_MAPS = ("jax.tree.map", "jax.tree_map", "jax.tree_util.tree_map")
+NP_SYNCS = ("np.asarray", "numpy.asarray", "np.array", "numpy.array")
+
+
+class ModuleInfo:
+    """Per-module facts shared by both passes: which names are jitted
+    callables, which functions are factories returning them, and which
+    ``self.<attr>`` slots classes bind them to."""
+
+    def __init__(self, tree: ast.AST):
+        self.functions: dict[str, ast.FunctionDef] = {}
+        self.classes: list[ast.ClassDef] = []
+        self.jitted_names: set[str] = set()
+        self.factory_names: set[str] = set()
+        for node in tree.body if isinstance(tree, ast.Module) else []:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+                if self._decorated_jit(node):
+                    self.jitted_names.add(node.name)
+            elif isinstance(node, ast.ClassDef):
+                self.classes.append(node)
+            elif isinstance(node, ast.Assign):
+                if self._is_jitty_value(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.jitted_names.add(t.id)
+        # factories: functions returning a jit-wrapped callable, chased to
+        # a small fixpoint so factory-calls-factory chains resolve
+        changed = True
+        while changed:
+            changed = False
+            for name, fn in self.functions.items():
+                if name in self.factory_names:
+                    continue
+                for ret in ast.walk(fn):
+                    if isinstance(ret, ast.Return) and ret.value is not None \
+                            and self._is_jitty_value(ret.value):
+                        self.factory_names.add(name)
+                        changed = True
+                        break
+
+    @staticmethod
+    def _decorated_jit(fn) -> bool:
+        for dec in fn.decorator_list:
+            if last_segment(dec) in JIT_WRAPPERS:
+                return True
+            if isinstance(dec, ast.Call):
+                if last_segment(dec.func) in JIT_WRAPPERS:
+                    return True
+                if last_segment(dec.func) == "partial" and dec.args and \
+                        last_segment(dec.args[0]) in JIT_WRAPPERS:
+                    return True
+        return False
+
+    def _is_jitty_value(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        if last_segment(node.func) in JIT_WRAPPERS:
+            return True
+        callee = last_segment(node.func)
+        return callee in self.factory_names or callee in self.jitted_names
+
+
+def _walk_functions(tree: ast.AST):
+    """Every FunctionDef in the module, each paired with its enclosing
+    class (or None) — nested functions are yielded as their own scopes."""
+    def visit(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, cls
+                yield from visit(child, cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, child)
+            else:
+                yield from visit(child, cls)
+    yield from visit(tree, None)
+
+
+# host-sync ----------------------------------------------------------------
+
+@register
+class HostSyncPass(Pass):
+    rule = "host-sync"
+    description = ("float()/int()/bool()/.item()/np.asarray() on a "
+                   "device-array value inside a per-step hot loop — a "
+                   "host sync per iteration stalls XLA pipelining; batch "
+                   "reads with one jax.device_get per log window")
+
+    LINTED_DIRS = ("data/", "train/", "serve/")
+
+    def run(self, ctx: FileCtx, repo: RepoCtx) -> Iterable[Match]:
+        in_scope = _pkg_rel(ctx).startswith(self.LINTED_DIRS)
+        info = ModuleInfo(ctx.tree)
+        seen: set[tuple[int, str]] = set()
+        for fn, _cls in _walk_functions(ctx.tree):
+            analyzer = _TaintAnalyzer(info)
+            for sink_line, sink_desc in analyzer.analyze(fn):
+                if (sink_line, sink_desc) in seen:
+                    continue
+                seen.add((sink_line, sink_desc))
+                yield Match(
+                    self.rule, ctx.rel, sink_line, sink_line,
+                    f"{sink_desc} forces a device→host sync every "
+                    "iteration of this hot loop — batch the reads with "
+                    "one jax.device_get per window, or excuse a true "
+                    "boundary sync with '# lint: allow-host-sync <why>'",
+                    in_scope=in_scope)
+
+
+class _TaintAnalyzer:
+    """Intra-function taint: values returned by jitted-step calls are
+    device arrays; syncing builtins applied to them inside a loop are
+    sinks. Two statement passes give loop-carried assignments a chance
+    to taint before sinks are judged."""
+
+    SYNC_BUILTINS = ("float", "int", "bool")
+
+    def __init__(self, info: ModuleInfo):
+        self.info = info
+        self.taint: set[str] = set()
+        self.local_jitted: set[str] = set()
+        self.sinks: list[tuple[int, str]] = []
+        self.emit = False
+
+    def analyze(self, fn) -> list[tuple[int, str]]:
+        for final in (False, True):
+            self.emit = final
+            self.loop_depth = 0
+            self._stmts(fn.body)
+        return self.sinks
+
+    # -- steppy-call detection --------------------------------------------
+
+    def _is_step_call(self, call: ast.Call) -> bool:
+        func = call.func
+        seg = last_segment(func)
+        if not seg:
+            return False
+        if seg in self.local_jitted or seg in self.info.jitted_names:
+            return True
+        if isinstance(func, ast.Name) and seg in self.info.factory_names:
+            # calling the factory returns the step, it does not run it
+            return False
+        return "step" in seg.lower()
+
+    def _is_jitty_local(self, value: ast.AST) -> bool:
+        if self.info._is_jitty_value(value):
+            return True
+        # stepper = ensemble.run_steps — binding a step method
+        return (isinstance(value, (ast.Attribute, ast.Name))
+                and "step" in (last_segment(value) or "").lower())
+
+    # -- statements -------------------------------------------------------
+
+    def _stmts(self, body) -> None:
+        for node in body:
+            self._stmt(node)
+
+    def _stmt(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # own scope, analyzed separately
+        if isinstance(node, ast.Assign):
+            if self._is_jitty_local(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.local_jitted.add(t.id)
+            t = self._ev(node.value)
+            for target in node.targets:
+                self._assign(target, t)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                if self._is_jitty_local(node.value) and isinstance(
+                        node.target, ast.Name):
+                    self.local_jitted.add(node.target.id)
+                self._assign(node.target, self._ev(node.value))
+        elif isinstance(node, ast.AugAssign):
+            t = self._ev(node.value) or (
+                isinstance(node.target, ast.Name)
+                and node.target.id in self.taint)
+            self._assign(node.target, t)
+        elif isinstance(node, ast.For):
+            self._assign(node.target, self._ev(node.iter))
+            self.loop_depth += 1
+            self._stmts(node.body)
+            self.loop_depth -= 1
+            self._stmts(node.orelse)
+        elif isinstance(node, ast.While):
+            # the condition re-evaluates every iteration: a sync there
+            # (`while float(loss) > tol:`) is a per-iteration sync
+            self.loop_depth += 1
+            self._ev(node.test)
+            self._stmts(node.body)
+            self.loop_depth -= 1
+            self._stmts(node.orelse)
+        elif isinstance(node, ast.If):
+            self._ev(node.test)
+            self._stmts(node.body)
+            self._stmts(node.orelse)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                t = self._ev(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, t)
+            self._stmts(node.body)
+        elif isinstance(node, ast.Try):
+            self._stmts(node.body)
+            for handler in node.handlers:
+                self._stmts(handler.body)
+            self._stmts(node.orelse)
+            self._stmts(node.finalbody)
+        elif isinstance(node, (ast.Expr, ast.Return)):
+            if getattr(node, "value", None) is not None:
+                self._ev(node.value)
+        elif isinstance(node, ast.Raise):
+            if node.exc is not None:
+                self._ev(node.exc)
+        elif isinstance(node, ast.Assert):
+            self._ev(node.test)
+
+    def _assign(self, target: ast.AST, tainted: bool) -> None:
+        # flow-sensitive: a clean (re)binding clears taint — `losses =
+        # jax.device_get(...)` and a fresh `for k, v in host.items()`
+        # launder their names; the two statement passes re-taint anything
+        # loop-carried
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.taint.add(target.id)
+            else:
+                self.taint.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign(elt, tainted)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, tainted)
+
+    # -- expressions ------------------------------------------------------
+
+    def _ev(self, node: ast.AST) -> bool:
+        """Taint of an expression; emits sinks as a side effect."""
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.taint
+        if isinstance(node, ast.Attribute):
+            return self._ev(node.value)
+        if isinstance(node, ast.Subscript):
+            self._ev(node.slice)
+            return self._ev(node.value)
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any([self._ev(e) for e in node.elts])
+        if isinstance(node, ast.Dict):
+            keys = [self._ev(k) for k in node.keys if k is not None]
+            vals = [self._ev(v) for v in node.values]
+            return any(keys) or any(vals)
+        if isinstance(node, ast.BinOp):
+            left, right = self._ev(node.left), self._ev(node.right)
+            return left or right
+        if isinstance(node, ast.UnaryOp):
+            return self._ev(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any([self._ev(v) for v in node.values])
+        if isinstance(node, ast.Compare):
+            parts = [self._ev(node.left)] + [self._ev(c)
+                                             for c in node.comparators]
+            return any(parts)
+        if isinstance(node, ast.IfExp):
+            self._ev(node.test)
+            body, orelse = self._ev(node.body), self._ev(node.orelse)
+            return body or orelse
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            return self._comp(node, [node.elt])
+        if isinstance(node, ast.DictComp):
+            return self._comp(node, [node.key, node.value])
+        if isinstance(node, ast.Starred):
+            return self._ev(node.value)
+        if isinstance(node, ast.NamedExpr):
+            t = self._ev(node.value)
+            self._assign(node.target, t)
+            return t
+        if isinstance(node, ast.Lambda):
+            return False  # own scope; not analyzed from here
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    self._ev(v.value)
+            return False
+        if isinstance(node, (ast.Await, ast.YieldFrom)):
+            return self._ev(node.value)
+        if isinstance(node, ast.Yield):
+            return self._ev(node.value) if node.value else False
+        return False
+
+    def _comp(self, node, result_exprs) -> bool:
+        for gen in node.generators:
+            self._assign(gen.target, self._ev(gen.iter))
+            for cond in gen.ifs:
+                self._ev(cond)
+        results = [self._ev(e) for e in result_exprs]
+        return any(results)
+
+    def _call(self, call: ast.Call) -> bool:
+        func = call.func
+        dn = dotted_name(func)
+        arg_taints = [self._ev(a) for a in call.args]
+        kw_taints = [self._ev(kw.value) for kw in call.keywords]
+
+        # sinks (judged before result taint): syncing builtins
+        if isinstance(func, ast.Name) and \
+                func.id in self.SYNC_BUILTINS and call.args:
+            if arg_taints[0]:
+                self._sink(call, f"{func.id}()")
+            return False  # host scalar
+        if isinstance(func, ast.Attribute) and func.attr == "item" \
+                and not call.args:
+            if self._ev(func.value):
+                self._sink(call, ".item()")
+            return False
+        if dn in NP_SYNCS:
+            if call.args and arg_taints[0]:
+                self._sink(call, f"{dn}()")
+            return False  # host array
+        if dn in SANITIZERS:
+            return False  # the sanctioned batched read: host values out
+        if self._is_step_call(call):
+            return True  # device-array-producing seed
+        # pass-through: tainted inputs (or a method on a tainted object,
+        # e.g. metrics.items()) produce tainted outputs
+        base_taint = (isinstance(func, ast.Attribute)
+                      and self._ev(func.value))
+        return bool(base_taint or any(arg_taints) or any(kw_taints))
+
+    def _sink(self, call: ast.Call, what: str) -> None:
+        if self.emit and self.loop_depth > 0:
+            self.sinks.append((call.lineno, what))
+
+
+# donation safety ----------------------------------------------------------
+
+@register
+class DonationSafetyPass(Pass):
+    rule = "donation"
+    description = ("donated executable invoked with an argument that may "
+                   "alias externally-owned memory (np.frombuffer views, "
+                   "checkpoint-restore payloads, raw parameters) — "
+                   "materialize through jnp.array(...) first "
+                   "(docs/ARCHITECTURE.md §13 donation rule)")
+
+    def run(self, ctx: FileCtx, repo: RepoCtx) -> Iterable[Match]:
+        resolver = _DonationResolver(ctx.tree)
+        for fn, cls in _walk_functions(ctx.tree):
+            local = resolver.local_donating(fn, cls)
+            if not local:
+                continue
+            assigns = _assignment_map(fn)
+            params = _param_names(fn)
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                positions = self._donating_positions(call, local)
+                if positions is _NOT_DONATING:
+                    continue
+                args = call.args
+                # positions: ints check positional slots, strings (from
+                # donate_argnames) check matching keywords, None = donating
+                # but unresolvable — check every argument either way
+                checked: list[tuple[str, ast.AST]] = []
+                if positions is None:
+                    checked = [(str(i), a) for i, a in enumerate(args)]
+                    checked += [(kw.arg or "**", kw.value)
+                                for kw in call.keywords]
+                else:
+                    checked = [(str(p), args[p]) for p in positions
+                               if isinstance(p, int) and p < len(args)]
+                    named = {p for p in positions if isinstance(p, str)}
+                    checked += [(kw.arg, kw.value) for kw in call.keywords
+                                if kw.arg in named]
+                for pos, arg in checked:
+                    reason = _hazard(arg, assigns, params, set(),
+                                     direct=True)
+                    if reason is None:
+                        continue
+                    yield Match(
+                        self.rule, ctx.rel, call.lineno,
+                        call.end_lineno or call.lineno,
+                        f"argument {pos} of donated executable "
+                        f"'{last_segment(call.func) or '<expr>'}' "
+                        f"{reason} — donation aliases the input buffer "
+                        "(use-after-release once a cache-loaded "
+                        "executable retains aliasing); materialize with "
+                        "jnp.array(...), or excuse a provably "
+                        "runtime-owned buffer with "
+                        "'# lint: allow-donation <why>'")
+
+    @staticmethod
+    def _donating_positions(call: ast.Call, local: dict):
+        seg = last_segment(call.func)
+        if isinstance(call.func, ast.Attribute) and isinstance(
+                call.func.value, ast.Name) and call.func.value.id == "self":
+            key = f"self.{seg}"
+        elif isinstance(call.func, ast.Name):
+            key = seg
+        else:
+            return _NOT_DONATING
+        return local.get(key, _NOT_DONATING)
+
+
+_NOT_DONATING = object()
+
+
+def _param_names(fn) -> set[str]:
+    a = fn.args
+    names = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+def _assignment_map(fn) -> dict[str, list[ast.AST]]:
+    """name -> every expression assigned to it in this function (for-loop
+    targets record the iterated expression: an element of a hazardous
+    iterable is hazardous)."""
+    out: dict[str, list[ast.AST]] = {}
+
+    def add(target, value):
+        if isinstance(target, ast.Name):
+            out.setdefault(target.id, []).append(value)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                add(elt, value)
+        elif isinstance(target, ast.Starred):
+            add(target.value, value)
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            continue
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                add(t, node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            add(node.target, node.value)
+        elif isinstance(node, ast.For):
+            add(node.target, node.iter)
+        elif isinstance(node, ast.NamedExpr):
+            add(node.target, node.value)
+    return out
+
+
+VIEW_SAFE_METHODS = ("copy", "astype", "tolist")
+HAZARD_CALL_MARKS = ("frombuffer", "memoryview")
+# wrappers that preserve buffer identity (zero-copy on CPU): hazard — and
+# parameter provenance — flows straight through them (§13: jnp.asarray /
+# device_put wrap external memory without copying; only jnp.array owns)
+ZERO_COPY_WRAPPERS = ("jnp.asarray", "jax.numpy.asarray", "np.asarray",
+                      "numpy.asarray", "jax.device_put", "device_put")
+
+
+def _hazard(node: ast.AST, assigns, params: set[str], visiting: set[str],
+            direct: bool = True) -> Optional[str]:
+    """Why ``node`` may alias externally-owned memory, or None.
+
+    ``direct`` tracks whether the value IS the traced object (parameter
+    hazards do not propagate through attribute access: ``self.state`` is
+    an instance slot of unknown—assumed owned—provenance, not the
+    parameter itself)."""
+    if isinstance(node, ast.Name):
+        if node.id in visiting:
+            return None
+        if node.id in assigns:
+            visiting = visiting | {node.id}
+            for value in assigns[node.id]:
+                reason = _hazard(value, assigns, params, visiting, direct)
+                if reason is not None:
+                    return reason
+            return None
+        if direct and node.id in params:
+            return (f"is the raw parameter '{node.id}', never "
+                    "materialized through jnp.array(...)")
+        return None
+    if isinstance(node, ast.Attribute):
+        return _hazard(node.value, assigns, params, visiting, direct=False)
+    if isinstance(node, ast.Subscript):
+        return _hazard(node.value, assigns, params, visiting, direct)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            reason = _hazard(elt, assigns, params, visiting, direct)
+            if reason is not None:
+                return reason
+        return None
+    if isinstance(node, ast.IfExp):
+        return (_hazard(node.body, assigns, params, visiting, direct)
+                or _hazard(node.orelse, assigns, params, visiting, direct))
+    if isinstance(node, ast.Starred):
+        return _hazard(node.value, assigns, params, visiting, direct)
+    if isinstance(node, ast.Call):
+        dn = dotted_name(node.func)
+        seg = last_segment(node.func)
+        if dn in MATERIALIZERS:
+            return None  # jnp.array copies into a runtime-owned buffer
+        if dn in TREE_MAPS and node.args and \
+                dotted_name(node.args[0]) in MATERIALIZERS:
+            return None  # jax.tree.map(jnp.array, tree) — the §13 idiom
+        if dn in ZERO_COPY_WRAPPERS and node.args:
+            return _hazard(node.args[0], assigns, params, visiting, direct)
+        low = seg.lower()
+        if any(mark in low for mark in HAZARD_CALL_MARKS):
+            return f"flows from {seg}() (a zero-copy view of host memory)"
+        if "restore" in low or low == "from_bytes":
+            return (f"flows from {seg}() (checkpoint-restore payloads "
+                    "are numpy views into the serialized buffer)")
+        if isinstance(node.func, ast.Attribute):
+            base = _hazard(node.func.value, assigns, params, visiting,
+                           direct=False)
+            if base is not None and seg not in VIEW_SAFE_METHODS:
+                return base  # .reshape()/.view() of a view is a view
+            if base is not None:
+                return None
+        for arg in node.args:
+            reason = _hazard(arg, assigns, params, visiting, direct=False)
+            if reason is not None:
+                return reason
+        return None
+    return None
+
+
+class _DonationResolver:
+    """Resolve which callables in a module donate, and at which argument
+    positions: direct ``jax.jit(..., donate_argnums=...)`` bindings,
+    ``cached_compile`` wrappers, local factory functions, and
+    ``self.<attr>`` slots bound by any method of the enclosing class."""
+
+    def __init__(self, tree: ast.AST):
+        self.tree = tree
+        self.functions: dict[str, ast.FunctionDef] = {
+            n.name: n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self._factory_memo: dict[str, object] = {}
+
+    def local_donating(self, fn, cls) -> dict:
+        """name (or 'self.attr') -> donated positions (set | None=all)."""
+        out: dict = {}
+        local_assigns = _assignment_map(fn)
+        for name, values in local_assigns.items():
+            for value in values:
+                pos = self.donating_positions(value, local_assigns)
+                if pos is not _NOT_DONATING:
+                    out[name] = pos
+        if cls is not None:
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                m_assigns = _assignment_map(method)
+                for node in ast.walk(method):
+                    if isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            if (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"):
+                                pos = self.donating_positions(
+                                    node.value, m_assigns)
+                                if pos is not _NOT_DONATING:
+                                    out[f"self.{t.attr}"] = pos
+                pos = self._factory_positions(method, 0)
+                if pos is not _NOT_DONATING:
+                    # a method that RETURNS a donating executable: local
+                    # names bound from self.<method>(...) resolve below
+                    self._factory_memo[f"self.{method.name}"] = pos
+        # re-resolve local names bound from self-method factories
+        for name, values in local_assigns.items():
+            if name in out:
+                continue
+            for value in values:
+                if isinstance(value, ast.Call) and isinstance(
+                        value.func, ast.Attribute) and isinstance(
+                        value.func.value, ast.Name) \
+                        and value.func.value.id == "self":
+                    key = f"self.{value.func.attr}"
+                    if key in self._factory_memo:
+                        out[name] = self._factory_memo[key]
+        return out
+
+    def donating_positions(self, node: ast.AST, local_assigns,
+                           depth: int = 0):
+        """positions donated by the executable ``node`` evaluates to, or
+        _NOT_DONATING. None means "unknown positions: check all"."""
+        if depth > 6 or not isinstance(node, ast.Call):
+            return _NOT_DONATING
+        seg = last_segment(node.func)
+        if seg in ("jit", "pjit"):
+            return self._positions_from_jit(node, local_assigns)
+        if seg == "cached_compile" and node.args:
+            return self.donating_positions(node.args[0], local_assigns,
+                                           depth + 1)
+        if seg in self.functions and (
+                isinstance(node.func, ast.Name)
+                or (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self")):
+            return self._factory_positions(self.functions[seg], depth + 1)
+        return _NOT_DONATING
+
+    def _positions_from_jit(self, call: ast.Call, local_assigns):
+        for kw in call.keywords:
+            if kw.arg not in ("donate_argnums", "donate_argnames",
+                              "donate"):
+                continue
+            value = kw.value
+            # a bare Name resolves one hop through local assignments
+            if isinstance(value, ast.Name) and local_assigns and \
+                    value.id in local_assigns:
+                exprs = local_assigns[value.id]
+                value = ast.Tuple(elts=list(exprs), ctx=ast.Load())
+            ints = [n.value for n in ast.walk(value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, int)
+                    and not isinstance(n.value, bool)]
+            # donate_argnames: string names — donated args are matched by
+            # keyword at the call site (positional passing of a named
+            # donation is not mapped: that needs the wrapped signature)
+            names = [n.value for n in ast.walk(value)
+                     if isinstance(n, ast.Constant)
+                     and isinstance(n.value, str)]
+            if ints or names:
+                return set(ints) | set(names)
+            if isinstance(kw.value, ast.Tuple) and not kw.value.elts:
+                return _NOT_DONATING  # literal (): explicitly no donation
+            if isinstance(kw.value, ast.Constant) and \
+                    kw.value.value in (False, None):
+                return _NOT_DONATING
+            return None  # donating, positions unknown: check all args
+        return _NOT_DONATING
+
+    def _factory_positions(self, fn, depth: int):
+        if fn.name in self._factory_memo:
+            return self._factory_memo[fn.name]
+        self._factory_memo[fn.name] = _NOT_DONATING  # cycle guard
+        assigns = _assignment_map(fn)
+        result = _NOT_DONATING
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                value = node.value
+                if isinstance(value, ast.Name) and value.id in assigns:
+                    candidates = assigns[value.id]
+                else:
+                    candidates = [value]
+                for cand in candidates:
+                    pos = self.donating_positions(cand, assigns, depth)
+                    if pos is not _NOT_DONATING:
+                        result = pos
+                        break
+                if result is not _NOT_DONATING:
+                    break
+        self._factory_memo[fn.name] = result
+        return result

@@ -1,0 +1,176 @@
+"""The six regex lint suites, ported onto the shared AST engine.
+
+Each pass preserves its legacy test's verdicts (tests/test_*_lint.py are
+now thin wrappers asserting these passes' findings, planted-violation
+self-tests included) but matches on the parsed tree instead of re-running
+a per-suite regex walk:
+
+- ``bare-write``     — tests/test_atomic_write_lint.py's convention
+- ``raw-timer``      — tests/test_obs_lint.py's convention
+- ``raw-profiler``   — tests/test_profiler_lint.py's convention
+- ``bare-compile``   — tests/test_xcache_lint.py's convention
+
+(the fault/crash coverage lints live in ``coverage.py``; the JAX-hazard
+passes regex could never express live in ``hazards.py``/``nondet.py``.)
+
+AST matching is strictly more precise than the old line regexes in the
+directions that were documented as acceptable false-negatives: a pattern
+named in a comment or docstring is not a call, and a default argument
+like ``clock=time.time`` is a reference, not a read.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from sparse_coding_tpu.analysis.core import (
+    FileCtx,
+    Match,
+    Pass,
+    RepoCtx,
+    dotted_name,
+    register,
+)
+
+
+def _in_package(ctx: FileCtx) -> bool:
+    return ctx.rel.startswith("sparse_coding_tpu/")
+
+
+def _pkg_rel(ctx: FileCtx) -> str:
+    """path relative to the package dir ('' for repo-root scripts)."""
+    if _in_package(ctx):
+        return ctx.rel.split("/", 1)[1]
+    return ""
+
+
+def _calls(tree: ast.AST) -> Iterable[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+@register
+class BareWritePass(Pass):
+    """Shared-path artifacts must go through resilience/atomic.py's
+    tmp+fsync+rename helpers — a bare ``write_text``/``write_bytes``/
+    ``np.save``/``pickle.dump`` lets a crash (or a concurrent reader)
+    observe a truncated file at the final name."""
+
+    rule = "bare-write"
+    description = ("bare write_text/write_bytes/np.save/pickle.dump in "
+                   "package code — use resilience.atomic, or excuse a "
+                   "provably process-private path")
+
+    # whole file implementing the sanctioned primitives (its internal
+    # buffer writes are the mechanism, not a violation)
+    ALLOWED_FILES = ("resilience/atomic.py",)
+
+    def run(self, ctx: FileCtx, repo: RepoCtx) -> Iterable[Match]:
+        in_scope = (_in_package(ctx)
+                    and _pkg_rel(ctx) not in self.ALLOWED_FILES)
+        for call in _calls(ctx.tree):
+            func = call.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            name = dotted_name(func)
+            hit = (func.attr in ("write_text", "write_bytes")
+                   or name in ("np.save", "pickle.dump"))
+            if not hit:
+                continue
+            line = ctx.line_of(call, f".{func.attr}(")
+            yield Match(self.rule, ctx.rel, line,
+                        call.end_lineno or line, ctx.src(line),
+                        in_scope=in_scope)
+
+
+@register
+class RawTimerPass(Pass):
+    """Hot-path subsystems must not read raw clocks ad hoc — timing goes
+    through obs (obs.monotime, obs.span/record_span, StepTimer) so every
+    duration lands in the registry/event stream obs.report merges."""
+
+    rule = "raw-timer"
+    description = ("ad-hoc time.time()/time.monotonic()/"
+                   "time.perf_counter() in a hot-path subsystem — route "
+                   "timing through obs (docs/ARCHITECTURE.md §12)")
+
+    LINTED_DIRS = ("data/", "train/", "serve/", "pipeline/")
+    CLOCKS = ("time", "monotonic", "perf_counter")
+
+    def run(self, ctx: FileCtx, repo: RepoCtx) -> Iterable[Match]:
+        in_scope = _pkg_rel(ctx).startswith(self.LINTED_DIRS)
+        for call in _calls(ctx.tree):
+            if dotted_name(call.func) in [f"time.{c}" for c in self.CLOCKS]:
+                line = ctx.line_of(call, "time.")
+                yield Match(self.rule, ctx.rel, line,
+                            call.end_lineno or line, ctx.src(line),
+                            in_scope=in_scope)
+
+
+@register
+class RawProfilerPass(Pass):
+    """Device-trace capture goes through obs.trace.capture/TraceCapture:
+    an unmanaged start_trace/stop_trace pair has no exception-path
+    guarantee and writes straight into its final directory, so a crash
+    mid-capture leaves a half-written artifact indistinguishable from a
+    real one."""
+
+    rule = "raw-profiler"
+    description = ("bare jax.profiler.start_trace/stop_trace outside "
+                   "obs/trace.py — use obs.trace.capture / TraceCapture "
+                   "(docs/ARCHITECTURE.md §12)")
+
+    # the managed wrapper itself is the one sanctioned home of the raw API
+    EXEMPT = ("obs/trace.py",)
+
+    def run(self, ctx: FileCtx, repo: RepoCtx) -> Iterable[Match]:
+        in_scope = _pkg_rel(ctx) not in self.EXEMPT
+        for call in _calls(ctx.tree):
+            func = call.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr in ("start_trace", "stop_trace")):
+                continue
+            base = func.value
+            is_profiler = (isinstance(base, ast.Name)
+                           and base.id == "profiler") or (
+                isinstance(base, ast.Attribute) and base.attr == "profiler")
+            if not is_profiler:
+                continue
+            line = ctx.line_of(call, f".{func.attr}(")
+            yield Match(self.rule, ctx.rel, line,
+                        call.end_lineno or line, ctx.src(line),
+                        in_scope=in_scope)
+
+
+@register
+class BareCompilePass(Pass):
+    """AOT compile chains in serve/ and train/ go through
+    xcache.cached_compile so every program joins the persistent
+    executable cache, the warmup manifest, and the xcache fault/crash
+    story — a bare .lower(...).compile() silently re-pays XLA compile on
+    every restart."""
+
+    rule = "bare-compile"
+    description = ("bare jit(...).lower(...).compile() call site — route "
+                   "AOT compilation through xcache.cached_compile "
+                   "(docs/ARCHITECTURE.md §13)")
+
+    LINTED_DIRS = ("serve/", "train/")
+
+    def run(self, ctx: FileCtx, repo: RepoCtx) -> Iterable[Match]:
+        in_scope = _pkg_rel(ctx).startswith(self.LINTED_DIRS)
+        for call in _calls(ctx.tree):
+            func = call.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr == "compile"
+                    and isinstance(func.value, ast.Call)
+                    and isinstance(func.value.func, ast.Attribute)
+                    and func.value.func.attr == "lower"):
+                continue
+            # report the .lower( line, as the legacy multi-line regex did
+            line = ctx.line_of(call, ".lower")
+            yield Match(self.rule, ctx.rel, line,
+                        call.end_lineno or line, ctx.src(line),
+                        in_scope=in_scope)

@@ -1,0 +1,96 @@
+"""In-trace nondeterminism pass: host entropy baked into traced code.
+
+A ``time.*``, ``random.*``, or ``np.random.*`` call lexically inside a
+function that jax TRACES (decorated/wrapped with ``jit``/``pjit``/
+``shard_map``, or passed to ``lax.scan``) does not re-execute per step —
+it executes ONCE at trace time, baking that host value into the compiled
+executable as a constant. With the persistent executable cache (§13)
+the accident becomes permanent: the stale constant survives process
+restarts. ``jax.random`` (functional, key-threaded) is the sanctioned
+in-trace randomness and is never flagged.
+
+Escape hatch: ``# lint: allow-in-trace-nondet <why>`` for the rare
+deliberate trace-time constant (e.g. a build stamp).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from sparse_coding_tpu.analysis.core import (
+    FileCtx,
+    Match,
+    Pass,
+    RepoCtx,
+    dotted_name,
+    last_segment,
+    register,
+)
+from sparse_coding_tpu.analysis.hazards import ModuleInfo
+
+NONDET_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.")
+SCAN_CALLEES = ("jax.lax.scan", "lax.scan")
+WRAP_CALLEES = ("jit", "pjit", "shard_map")
+
+
+def _traced_functions(tree: ast.AST) -> list[ast.AST]:
+    """FunctionDef/Lambda nodes jax will trace: jit-decorated defs, and
+    defs/lambdas passed (by name, locally resolvable) to a jit wrapper
+    or to lax.scan."""
+    by_name: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+
+    traced: list[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and ModuleInfo._decorated_jit(node):
+            traced.append(node)
+        if not isinstance(node, ast.Call):
+            continue
+        seg = last_segment(node.func)
+        candidates: list[ast.AST] = []
+        if seg in WRAP_CALLEES and node.args:
+            candidates.append(node.args[0])
+        if dotted_name(node.func) in SCAN_CALLEES and node.args:
+            candidates.append(node.args[0])
+        for cand in candidates:
+            if isinstance(cand, ast.Lambda):
+                traced.append(cand)
+            elif isinstance(cand, ast.Name):
+                traced.extend(by_name.get(cand.id, ()))
+    return traced
+
+
+@register
+class InTraceNondetPass(Pass):
+    rule = "in-trace-nondet"
+    description = ("time.*/random.*/np.random.* call inside a "
+                   "jit/pjit/shard_map/lax.scan-traced function — the "
+                   "host value is baked into the cached executable at "
+                   "trace time (use jax.random with a threaded key)")
+
+    def run(self, ctx: FileCtx, repo: RepoCtx) -> Iterable[Match]:
+        seen: set[int] = set()
+        for fn in _traced_functions(ctx.tree):
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                dn = dotted_name(call.func)
+                if not dn or not dn.startswith(NONDET_PREFIXES):
+                    continue
+                if call.lineno in seen:
+                    continue
+                seen.add(call.lineno)
+                owner = getattr(fn, "name", "<lambda>")
+                yield Match(
+                    self.rule, ctx.rel, call.lineno,
+                    call.end_lineno or call.lineno,
+                    f"{dn}() inside traced function '{owner}' executes "
+                    "once at trace time and bakes a host value into the "
+                    "cached executable — thread a jax.random key (or "
+                    "pass the value as an argument); excuse a deliberate "
+                    "trace-time constant with "
+                    "'# lint: allow-in-trace-nondet <why>'")

@@ -86,8 +86,10 @@ def basic_l1_sweep(
                 last_log = step
                 if scan_k > 1:
                     aux = jax.tree.map(lambda a: a[-1], aux)
-                losses = jax.device_get(aux.losses)
-                l0 = jax.device_get(aux.l0)
+                # ONE host sync for all members' stacked metrics per log
+                # window (rule host-sync: per-member float() reads would
+                # cost 2×members device round-trips per log step)
+                losses, l0 = jax.device_get((aux.losses, aux.l0))
                 for i, l1 in enumerate(l1_values):
                     logger.log({f"l1={l1:.2e}/loss": float(losses["loss"][i]),
                                 f"l1={l1:.2e}/l0": float(l0[i])}, step=step)

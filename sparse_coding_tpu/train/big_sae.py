@@ -461,11 +461,18 @@ def train_big_sae(cfg, store=None, mesh: Optional[Mesh] = None,
                     # slice the window's last step only when logging — the
                     # slice is its own device dispatch
                     metrics = {k: v[-1] for k, v in metrics.items()}
-                logger.log({k: float(v) for k, v in metrics.items()}, step=steps)
+                # ONE host sync for the whole metrics dict per log window
+                # (a float() per key is a device→host round-trip per key,
+                # which stalls XLA pipelining — rule host-sync)
+                host_metrics = jax.device_get(metrics)
+                logger.log({k: float(v) for k, v in host_metrics.items()},
+                           step=steps)
             if (cfg.resurrect_every
                     and steps - last_resurrect >= cfg.resurrect_every):
                 last_resurrect = steps
                 state, n_dead = resurrect_dead_features(state)
                 if logger is not None:
-                    logger.log({"n_dead_feats": int(n_dead)}, step=steps)
+                    # single scalar at cfg.resurrect_every cadence, not a
+                    # per-step sync
+                    logger.log({"n_dead_feats": int(n_dead)}, step=steps)  # lint: allow-host-sync resurrection-cadence scalar read, orders of magnitude rarer than steps
     return state

@@ -1,0 +1,39 @@
+"""Shared access to ONE whole-repo static-analysis run per test process.
+
+The six legacy lint suites (tests/test_*_lint.py) and the engine suite
+(tests/test_analysis.py) all assert against the same
+:func:`repo_result` — the engine parses each file once and every pass
+shares that parse, so what used to be six independent tree walks is now
+a single cached run (ISSUE 13 tentpole). Planted-violation self-tests
+build scratch trees and call :func:`sparse_coding_tpu.analysis.
+run_analysis` directly; only the whole-repo verdicts share the cache.
+"""
+
+from functools import lru_cache
+from pathlib import Path
+
+from sparse_coding_tpu.analysis import run_analysis
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "sparse_coding_tpu"
+
+
+@lru_cache(maxsize=1)
+def repo_result():
+    """The one engine run over the real tree (parse-once, all passes)."""
+    return run_analysis(package=PACKAGE, repo_root=REPO)
+
+
+def repo_findings(rule: str) -> list[str]:
+    """Legacy-formatted findings ('rel:line: message') for one rule."""
+    return [fmt(f) for f in repo_result().for_rule(rule)]
+
+
+def fmt(finding) -> str:
+    return f"{finding.rel}:{finding.line}: {finding.message}"
+
+
+def scratch_findings(package, rule: str, **kw) -> list[str]:
+    """Run the engine on a planted scratch tree; findings for one rule."""
+    res = run_analysis(package=package, **kw)
+    return [fmt(f) for f in res.findings if f.rule == rule]

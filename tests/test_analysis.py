@@ -1,0 +1,359 @@
+"""Tier-1 gate for the unified AST analysis engine (ISSUE 13,
+docs/ARCHITECTURE.md §17).
+
+This file is THE engine entry: ``test_whole_repo_is_clean`` runs every
+registered pass over the real tree in one shared parse (cached in
+``analysis_helpers.repo_result``; the six legacy lint wrappers assert
+against the same run, so six tree walks collapsed to one) and requires
+zero unexcused findings for ALL rules — legacy conventions and the new
+JAX-hazard passes alike.
+
+The planted-violation matrix mirrors the legacy
+``test_lint_catches_a_planted_violation`` pattern for each NEW pass:
+one scratch tree per rule with excused and unexcused lines, the exact
+finding set asserted — including the PR-5 donation regression fixture
+(``restore_ensemble`` returning zero-copy numpy views into a donated
+step, the use-after-release class the §13 donation rule exists for).
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from analysis_helpers import REPO, fmt, repo_result, scratch_findings
+
+from sparse_coding_tpu.analysis import ALL_RULES, rule_table, run_analysis
+
+
+def _plant(tmp_path, rel, source):
+    pkg = tmp_path / "sparse_coding_tpu"
+    path = pkg / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return pkg
+
+
+# -- the single whole-repo gate -------------------------------------------
+
+def test_whole_repo_is_clean():
+    """Zero unexcused findings, any rule, on the real tree."""
+    res = repo_result()
+    assert not res.findings, (
+        "static-analysis findings on the real tree — fix them or excuse "
+        "with '# lint: allow-<rule> <why>':\n"
+        + "\n".join(f.render() for f in res.findings))
+
+
+def test_engine_scans_the_real_tree():
+    """Guard against a vacuously-green gate: the run actually parsed the
+    package (and repo-root scripts) and saw the live escape hatches."""
+    res = repo_result()
+    assert res.meta["files_scanned"] > 100
+    hatch_rules = {h.rule for _, h in res.hatches}
+    assert {"raw-profiler", "unmatrixed-crash"} <= hatch_rules
+    # every registered rule has a description in the §17 table
+    table = rule_table()
+    assert set(ALL_RULES) == set(table) and all(table.values())
+
+
+# -- host-sync planted matrix ---------------------------------------------
+
+def test_host_sync_catches_planted_hot_loop_syncs(tmp_path):
+    pkg = _plant(tmp_path, "train/hot.py", """
+        import jax
+
+        def sweep(batches, state, step_fn, logger):
+            for b in batches:
+                state, metrics = step_fn(state, b)
+                logger.log({k: float(v) for k, v in metrics.items()})
+                n = int(metrics["n"])  # lint: allow-host-sync boundary read
+                m = metrics["m"].item()
+            tail = float(metrics["loss"])  # epoch boundary: not in the loop
+            return tail
+
+        def batched_ok(batches, state, step_fn, logger):
+            for b in batches:
+                state, metrics = step_fn(state, b)
+                host = jax.device_get(metrics)  # the sanctioned batched read
+                logger.log({k: float(v) for k, v in host.items()})
+        """)
+    hits = scratch_findings(pkg, "host-sync")
+    assert len(hits) == 2, hits
+    assert "hot.py:7" in hits[0] and "float()" in hits[0]
+    assert "hot.py:9" in hits[1] and ".item()" in hits[1]
+
+
+def test_host_sync_catches_while_condition_syncs(tmp_path):
+    """A while-condition re-evaluates every iteration: `while
+    float(loss) > tol` IS a per-iteration sync (code-review regression)."""
+    pkg = _plant(tmp_path, "train/converge.py", """
+        def run_until(state, batch, step_fn, tol):
+            state, loss = step_fn(state, batch)
+            while float(loss) > tol:
+                state, loss = step_fn(state, batch)
+            return state
+        """)
+    hits = scratch_findings(pkg, "host-sync")
+    assert len(hits) == 1 and "converge.py:4" in hits[0], hits
+
+
+def test_host_sync_out_of_scope_dirs_not_flagged(tmp_path):
+    # same sync shape in utils/: the convention covers data/train/serve
+    pkg = _plant(tmp_path, "utils/free.py", """
+        def helper(batches, state, step_fn):
+            for b in batches:
+                state, aux = step_fn(state, b)
+                x = float(aux)
+        """)
+    assert scratch_findings(pkg, "host-sync") == []
+
+
+# -- donation planted matrix (the PR-5 regression shape) ------------------
+
+def test_donation_redetects_the_pr5_restore_view_bug(tmp_path):
+    """Reconstruction of the PR-5 use-after-release: restore_ensemble
+    returns zero-copy numpy views into the serialized payload, which a
+    donated (cache-loaded, aliasing-retaining) step then frees."""
+    pkg = _plant(tmp_path, "train/resume.py", """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def restore_ensemble(path):
+            payload = open(path, 'rb').read()
+            flat = np.frombuffer(payload, np.float32)
+            return flat.reshape(4, 8)
+
+        def resume_and_train(path, batches, step):
+            params = restore_ensemble(path)
+            train_step = jax.jit(step, donate_argnums=(0,))
+            for b in batches:
+                params, aux = train_step(params, b)
+            return params
+
+        def resume_safely(path, batches, step):
+            params = jnp.array(restore_ensemble(path))  # materialized: owned
+            train_step = jax.jit(step, donate_argnums=(0,))
+            for b in batches:
+                params, aux = train_step(params, b)
+            return params
+
+        def donate_view_directly(payload, batches, step):
+            flat = np.frombuffer(payload, np.float32)
+            view = flat.reshape(4, 8)
+            train_step = jax.jit(step, donate_argnums=(0,))
+            return train_step(view, batches)
+
+        def donate_raw_param(params, b, step):
+            train_step = jax.jit(step, donate_argnums=(0,))
+            return train_step(params, b)
+
+        def donate_excused(params, b, step):
+            train_step = jax.jit(step, donate_argnums=(0,))
+            return train_step(params, b)  # lint: allow-donation caller contract: params are device-owned
+        """)
+    hits = scratch_findings(pkg, "donation")
+    assert len(hits) == 3, hits
+    assert "resume.py:15" in hits[0] and "restore_ensemble" in hits[0]
+    assert "resume.py:29" in hits[1] and "frombuffer" in hits[1]
+    assert "resume.py:33" in hits[2] and "raw parameter" in hits[2]
+
+
+def test_donation_only_donated_positions_are_checked(tmp_path):
+    # donate_argnums=(0,): a raw parameter in position 1 is fine
+    pkg = _plant(tmp_path, "serve/pos.py", """
+        import jax
+
+        def run(state_init, batch, step):
+            step_fn = jax.jit(step, donate_argnums=(0,))
+            state = jax.numpy.array(state_init)
+            return step_fn(state, batch)
+        """)
+    assert scratch_findings(pkg, "donation") == []
+
+
+def test_donation_checks_keyword_arguments(tmp_path):
+    """donate_argnames passes the donated buffer BY NAME — keyword
+    arguments must be traced too (code-review regression)."""
+    pkg = _plant(tmp_path, "train/kw.py", """
+        import jax
+        import numpy as np
+
+        def resume(payload, batches, step):
+            view = np.frombuffer(payload, np.float32).reshape(4, 8)
+            train_step = jax.jit(step, donate_argnames=('state',))
+            return train_step(state=view, batch=batches)
+        """)
+    hits = scratch_findings(pkg, "donation")
+    assert len(hits) == 1, hits
+    assert "kw.py:8" in hits[0] and "frombuffer" in hits[0]
+    assert "argument state" in hits[0]
+
+
+# -- in-trace nondeterminism planted matrix -------------------------------
+
+def test_in_trace_nondet_catches_planted_entropy(tmp_path):
+    pkg = _plant(tmp_path, "ops/traced.py", """
+        import time, random
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def bad_step(x):
+            t = time.time()
+            r = np.random.rand(4)
+            k = jax.random.PRNGKey(0)  # functional: sanctioned
+            return x * t + r
+
+        @jax.jit
+        def excused_step(x):
+            stamp = time.time()  # lint: allow-in-trace-nondet deliberate build stamp
+            return x + stamp
+
+        def make_scan(xs):
+            def inner(c, x):
+                seed = random.random()
+                return c, x * seed
+            return jax.lax.scan(inner, 0.0, xs)
+
+        def host_side_fine(x):
+            return x + random.random()  # not traced: host code may roll dice
+        """)
+    hits = scratch_findings(pkg, "in-trace-nondet")
+    assert len(hits) == 3, hits
+    assert "traced.py:8" in hits[0] and "time.time" in hits[0]
+    assert "traced.py:9" in hits[1] and "np.random.rand" in hits[1]
+    assert "traced.py:20" in hits[2] and "random.random" in hits[2]
+
+
+# -- stale escape hatches planted matrix ----------------------------------
+
+def test_stale_hatches_are_findings(tmp_path):
+    pkg = _plant(tmp_path, "data/hatches.py", """
+        import time
+        x = 1  # lint: allow-raw-timer this clock read is long gone
+        t = time.time()  # lint: allow-raw-timer
+        u = time.time()  # lint: allow-raw-timer backoff deadline only
+        y = 2  # lint: allow-made-up-rule whatever
+        """)
+    hits = scratch_findings(pkg, "stale-hatch")
+    assert len(hits) == 3, hits
+    assert "hatches.py:3" in hits[0] and "stale" in hits[0]
+    assert "hatches.py:4" in hits[1] and "no reason" in hits[1]
+    assert "hatches.py:6" in hits[2] and "unknown rule" in hits[2]
+
+
+def test_hatch_in_docstring_is_not_a_hatch(tmp_path):
+    """The engine reads COMMENT tokens: documentation quoting the
+    protocol (obs/__init__.py, obs/trace.py docstrings) never registers
+    as a hatch, so it can never go stale."""
+    pkg = _plant(tmp_path, "obs/doc.py", '''
+        """Escape hatch protocol: append
+        ``# lint: allow-raw-timer <why>`` to the offending line."""
+        VALUE = 1
+        ''')
+    assert scratch_findings(pkg, "stale-hatch") == []
+
+
+def test_scope_exempt_file_hatches_are_not_stale(tmp_path):
+    """A hatch in a scope-exempt file (obs/trace.py holds the sanctioned
+    raw profiler calls) stays valid: staleness is judged pattern-level,
+    not scope-level."""
+    pkg = _plant(tmp_path, "obs/trace.py", """
+        import jax
+        jax.profiler.start_trace('/t')  # lint: allow-raw-profiler the managed wrapper itself
+        """)
+    assert scratch_findings(pkg, "stale-hatch") == []
+    assert scratch_findings(pkg, "raw-profiler") == []
+
+
+# -- engine mechanics ------------------------------------------------------
+
+def test_parse_error_is_a_finding(tmp_path):
+    pkg = _plant(tmp_path, "broken.py", "def f(:\n")
+    res = run_analysis(package=pkg)
+    assert [f.rule for f in res.findings] == ["parse-error"]
+
+
+def test_parse_error_survives_rule_filter(tmp_path):
+    """--rule must never hide a broken file: no pass analyzed it, so a
+    'clean for rule X' verdict would be vacuous (code-review regression)."""
+    pkg = _plant(tmp_path, "broken.py", "def f(:\n")
+    res = run_analysis(package=pkg, rules=["host-sync"])
+    assert [f.rule for f in res.findings] == ["parse-error"]
+
+
+def test_parse_error_file_hatches_not_reported_stale(tmp_path):
+    """A valid hatch in a file with a later syntax error must not be
+    reported stale — staleness is unjudgeable when no pass ran
+    (code-review regression)."""
+    pkg = _plant(tmp_path, "data/half.py", """
+        import time
+        t = time.time()  # lint: allow-raw-timer backoff deadline only
+        def broken(:
+        """)
+    res = run_analysis(package=pkg)
+    assert [f.rule for f in res.findings] == ["parse-error"], \
+        [fmt(f) for f in res.findings]
+
+
+def test_cli_json_report_and_exit_codes(tmp_path):
+    """The CLI is the one front door (scripts/lint.sh): JSON report,
+    exit 1 on findings, 0 on clean — run jax-free in a subprocess."""
+    pkg = _plant(tmp_path, "train/hot.py", """
+        def sweep(batches, state, step_fn):
+            for b in batches:
+                state, aux = step_fn(state, b)
+                x = float(aux)
+        """)
+    import os
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["PYTHONPATH"] = str(REPO)
+    cmd = [sys.executable, "-m", "sparse_coding_tpu.analysis", "--json",
+           "--package", str(pkg), "--repo-root", str(tmp_path)]
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    assert proc.returncode == 1, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["counts"] == {"host-sync": 1}
+    assert report["findings"][0]["file"] == "sparse_coding_tpu/train/hot.py"
+    # --rule filtering flips the verdict for an unrelated rule
+    proc2 = subprocess.run(cmd + ["--rule", "bare-write"],
+                           capture_output=True, text=True, env=env)
+    assert proc2.returncode == 0, proc2.stderr
+    assert json.loads(proc2.stdout)["findings"] == []
+
+
+def test_cli_import_chain_is_jax_free():
+    """scripts/lint.sh must be safe under a wedged TPU tunnel: importing
+    the analysis package (and the lazy package __init__) must not pull
+    in jax."""
+    import os
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["PYTHONPATH"] = str(REPO)
+    code = ("import sys; import sparse_coding_tpu.analysis; "
+            "assert 'jax' not in sys.modules, 'jax leaked'; print('ok')")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, env=env)
+    assert proc.returncode == 0 and proc.stdout.strip() == "ok", proc.stderr
+
+
+def test_engine_parses_each_file_once(monkeypatch, tmp_path):
+    """The tentpole economy claim: N passes, ONE FileCtx per file."""
+    import sparse_coding_tpu.analysis.core as core
+    pkg = _plant(tmp_path, "a.py", "x = 1\n")
+    _plant(tmp_path, "b.py", "y = 2\n")
+    built = []
+    real_init = core.FileCtx.__init__
+
+    def counting_init(self, path, rel):
+        built.append(rel)
+        real_init(self, path, rel)
+
+    monkeypatch.setattr(core.FileCtx, "__init__", counting_init)
+    run_analysis(package=pkg)
+    assert sorted(built) == ["sparse_coding_tpu/a.py",
+                             "sparse_coding_tpu/b.py"]

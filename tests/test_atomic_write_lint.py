@@ -6,45 +6,19 @@ sanctioned primitives are `resilience/atomic.py`'s tmp+fsync+rename
 helpers (`atomic_write_text/bytes`, `atomic_save_npy`,
 `atomic_pickle_dump`).
 
-This is a grep, not a dataflow analysis, by design: the convention is
-cheap to follow and the false-positive escape hatch is explicit — append
-`# lint: allow-bare-write <why>` to a line that provably writes a
-process-private path. New unexplained hits fail the build.
+Now a thin wrapper over the unified AST engine's ``bare-write`` pass
+(`sparse_coding_tpu/analysis/`, docs/ARCHITECTURE.md §17) — same
+verdicts, one shared tree walk instead of a private regex scan, and the
+escape hatch (`# lint: allow-bare-write <why>`) is the engine-wide
+protocol with a mandatory reason. A mention inside a comment or
+docstring is not a write (the parser, unlike the old regex, knows).
 """
 
-import re
-from pathlib import Path
-
-PACKAGE = Path(__file__).resolve().parent.parent / "sparse_coding_tpu"
-
-BARE_WRITE = re.compile(
-    r"\.write_text\(|\.write_bytes\(|np\.save\(|pickle\.dump\(")
-OPT_OUT = "# lint: allow-bare-write"
-
-# whole files implementing the sanctioned primitives (their internal
-# buffer writes are the mechanism, not a violation)
-ALLOWED_FILES = {"resilience/atomic.py"}
-
-
-def _violations():
-    hits = []
-    for path in sorted(PACKAGE.rglob("*.py")):
-        rel = path.relative_to(PACKAGE).as_posix()
-        if rel in ALLOWED_FILES:
-            continue
-        for lineno, line in enumerate(path.read_text().splitlines(), 1):
-            # match only the code portion: a mention inside a comment is
-            # not a write (a '#' inside a string arg would false-NEGATIVE,
-            # which for a lint is the safe direction)
-            code = line.split("#", 1)[0]
-            if BARE_WRITE.search(code) and OPT_OUT not in line:
-                hits.append(f"sparse_coding_tpu/{rel}:{lineno}: "
-                            f"{line.strip()}")
-    return hits
+from analysis_helpers import repo_findings, scratch_findings
 
 
 def test_no_bare_writes_to_shared_paths():
-    hits = _violations()
+    hits = repo_findings("bare-write")
     assert not hits, (
         "bare write_text/write_bytes/np.save/pickle.dump in package code — "
         "use resilience.atomic (atomic_write_text/bytes, atomic_save_npy, "
@@ -52,9 +26,9 @@ def test_no_bare_writes_to_shared_paths():
         "for a provably process-private path:\n" + "\n".join(hits))
 
 
-def test_lint_catches_a_planted_violation(tmp_path, monkeypatch):
+def test_lint_catches_a_planted_violation(tmp_path):
     """The lint must actually bite: plant a bare np.save in a scratch tree
-    and watch it get flagged (guards against the regex rotting)."""
+    and watch it get flagged (guards against the pass rotting)."""
     pkg = tmp_path / "sparse_coding_tpu"
     pkg.mkdir()
     (pkg / "bad.py").write_text(
@@ -62,8 +36,5 @@ def test_lint_catches_a_planted_violation(tmp_path, monkeypatch):
         "np.save('shared.npy', data)\n"
         "ok = 1  # np.save( in a comment does not count\n"
         "np.save('private.npy', d)  # lint: allow-bare-write scratch file\n")
-    import test_atomic_write_lint as lint
-
-    monkeypatch.setattr(lint, "PACKAGE", pkg)
-    hits = lint._violations()
+    hits = scratch_findings(pkg, "bare-write")
     assert len(hits) == 1 and "bad.py:2" in hits[0]

@@ -10,73 +10,18 @@ registration line. A crash barrier without a kill+restart+bitwise case
 is a durability ordering that ships unproven — exactly the rot the
 chaos matrix exists to prevent (docs/ARCHITECTURE.md §11).
 
-A grep, not a dataflow analysis, by design (the fault-site lint's
-pattern): registering a barrier and writing its chaos case are one PR,
-and the false-positive escape hatch is explicit and reviewed.
+Now a thin wrapper over the unified AST engine's ``unmatrixed-crash``
+pass (`sparse_coding_tpu/analysis/`, docs/ARCHITECTURE.md §17) — same
+verdicts, one shared tree walk; the ``CRASH_SITES`` dict literal is read
+off the parse tree (keys with exact linenos), and its disappearance is
+itself a finding instead of a scanner assert.
 """
 
-import re
-from pathlib import Path
-
-ROOT = Path(__file__).resolve().parent.parent
-PACKAGE = ROOT / "sparse_coding_tpu"
-MATRIX = ROOT / "tests" / "test_pipeline_chaos.py"
-
-# register_crash_site( "site.name"  — the literal-name form every host
-# module uses; a computed name cannot be linted and would be flagged by
-# review instead
-REGISTER = re.compile(r"register_crash_site\(\s*['\"]([\w.]+)['\"]")
-# the canonical seed table in resilience/crash.py: sites must be known
-# there too (a child's plan can parse before host modules import), so the
-# lint scans its quoted keys as registrations of crash.py itself
-SEED_TABLE = re.compile(r"CRASH_SITES:[^=]*=\s*\{(.*?)\n\}", re.DOTALL)
-SEED_KEY = re.compile(r"['\"]([\w.]+)['\"]\s*:")
-OPT_OUT = "# lint: allow-unmatrixed-crash"
-
-
-def _registered_sites(package: Path):
-    """(site, file:line, excused) for every literal registration and
-    every canonical seed-table entry."""
-    out = []
-    for path in sorted(package.rglob("*.py")):
-        text = path.read_text()
-        lines = text.splitlines()
-
-        def _add(m: re.Match, site: str) -> None:
-            lineno = text.count("\n", 0, m.start()) + 1
-            excused = OPT_OUT in lines[lineno - 1]
-            rel = path.relative_to(package.parent).as_posix()
-            out.append((site, f"{rel}:{lineno}", excused))
-
-        for m in REGISTER.finditer(text):
-            _add(m, m.group(1))
-        if path.name == "crash.py" and path.parent.name == "resilience":
-            table = SEED_TABLE.search(text)
-            assert table, "resilience/crash.py lost its CRASH_SITES table"
-            for m in SEED_KEY.finditer(table.group(1)):
-                _add(m, m.group(1))
-    return out
-
-
-def _violations(package: Path = PACKAGE, matrix_text: str = None):
-    if matrix_text is None:
-        matrix_text = MATRIX.read_text()
-    hits = []
-    for site, where, excused in _registered_sites(package):
-        if excused:
-            continue
-        # a chaos case names the site as a string literal (a compact
-        # "site:nth=..." plan string, or inject-style site="...")
-        if f'"{site}"' in matrix_text or f"'{site}'" in matrix_text \
-                or f"{site}:" in matrix_text:
-            continue
-        hits.append(f"{where}: crash site {site!r} has no case in "
-                    f"tests/test_pipeline_chaos.py")
-    return hits
+from analysis_helpers import repo_findings, repo_result, scratch_findings
 
 
 def test_every_registered_crash_site_has_a_chaos_case():
-    hits = _violations()
+    hits = repo_findings("unmatrixed-crash")
     assert not hits, (
         "crash site(s) registered without a SIGKILL chaos-matrix case — "
         "add a kill-at-barrier + restart + bitwise-artifact test to "
@@ -102,16 +47,28 @@ def test_lint_catches_a_planted_unmatrixed_site(tmp_path):
         'site = register_fault_site("fault.only")  # not a crash site\n')
     matrix = ('def test_covered(monkeypatch):\n'
               '    monkeypatch.setenv(ENV, "covered.site:nth=1")\n')
-    hits = _violations(pkg, matrix)
+    hits = scratch_findings(pkg, "unmatrixed-crash",
+                            crash_matrix_text=matrix, fault_matrix_text="")
     assert len(hits) == 1, hits
     assert "orphan.site" in hits[0] and "x.py:3" in hits[0]
+
+
+def test_seed_table_disappearance_is_a_finding(tmp_path):
+    """resilience/crash.py without its canonical CRASH_SITES table is
+    flagged instead of silently scanning nothing."""
+    pkg = tmp_path / "sparse_coding_tpu"
+    (pkg / "resilience").mkdir(parents=True)
+    (pkg / "resilience" / "crash.py").write_text("SITES = {}\n")
+    hits = scratch_findings(pkg, "unmatrixed-crash", crash_matrix_text="",
+                            fault_matrix_text="")
+    assert len(hits) == 1 and "CRASH_SITES" in hits[0], hits
 
 
 def test_current_tree_sites_all_known():
     """Sanity: the scan sees both registration forms — host-module
     ``register_crash_site`` calls AND the canonical seed table — so the
     coverage assertion can't go vacuously green."""
-    sites = {s for s, _, _ in _registered_sites(PACKAGE)}
+    sites = {s for s, _, _ in repo_result().meta["crash_sites"]}
     for expected in ("chunk.flushed", "store.finalize", "sweep.chunk",
                      "ckpt.swap", "eval.write", "obs.sink.write",
                      "xcache.store", "shard.finalize", "scrub.repair",

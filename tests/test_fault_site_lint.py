@@ -9,59 +9,17 @@ registration line. A fault site without a matrix entry is a failure
 path that ships untested — exactly the rot the injection harness exists
 to prevent (docs/ARCHITECTURE.md §10).
 
-A grep, not a dataflow analysis, by design (the raw-timer, atomic-write
-and bare-compile lints' pattern): the convention is cheap to follow —
-registering a site and writing its matrix case are one PR — and the
-false-positive escape hatch is explicit and reviewed.
+Now a thin wrapper over the unified AST engine's ``unmatrixed-fault``
+pass (`sparse_coding_tpu/analysis/`, docs/ARCHITECTURE.md §17) — same
+verdicts, one shared tree walk, registrations read off the parse tree
+instead of a regex.
 """
 
-import re
-from pathlib import Path
-
-ROOT = Path(__file__).resolve().parent.parent
-PACKAGE = ROOT / "sparse_coding_tpu"
-MATRIX = ROOT / "tests" / "test_resilience.py"
-
-# register_fault_site( "site.name"  — the literal-name form every host
-# module uses; a computed name cannot be linted and would be flagged by
-# review instead
-REGISTER = re.compile(r"register_fault_site\(\s*['\"]([\w.]+)['\"]")
-OPT_OUT = "# lint: allow-unmatrixed-fault"
-
-
-def _registered_sites(package: Path):
-    """(site, file:line, excused) for every literal registration."""
-    out = []
-    for path in sorted(package.rglob("*.py")):
-        text = path.read_text()
-        lines = text.splitlines()
-        for m in REGISTER.finditer(text):
-            lineno = text.count("\n", 0, m.start()) + 1
-            excused = OPT_OUT in lines[lineno - 1]
-            rel = path.relative_to(package.parent).as_posix()
-            out.append((m.group(1), f"{rel}:{lineno}", excused))
-    return out
-
-
-def _violations(package: Path = PACKAGE, matrix_text: str = None):
-    if matrix_text is None:
-        matrix_text = MATRIX.read_text()
-    hits = []
-    for site, where, excused in _registered_sites(package):
-        if excused:
-            continue
-        # a matrix entry names the site as a string literal (inject(
-        # site="..."), a compact plan "site:nth=..", or a docstring row)
-        if f'"{site}"' in matrix_text or f"'{site}'" in matrix_text \
-                or f"{site}:" in matrix_text:
-            continue
-        hits.append(f"{where}: fault site {site!r} has no entry in "
-                    f"tests/test_resilience.py")
-    return hits
+from analysis_helpers import repo_findings, repo_result, scratch_findings
 
 
 def test_every_registered_fault_site_has_a_matrix_entry():
-    hits = _violations()
+    hits = repo_findings("unmatrixed-fault")
     assert not hits, (
         "fault site(s) registered without a deterministic fault-matrix "
         "entry — add an inject()-driven case to tests/test_resilience.py "
@@ -88,7 +46,8 @@ def test_lint_catches_a_planted_unmatrixed_site(tmp_path):
     matrix = ('def test_covered():\n'
               '    with inject(site="covered.site", nth=1):\n'
               '        pass\n')
-    hits = _violations(pkg, matrix)
+    hits = scratch_findings(pkg, "unmatrixed-fault",
+                            fault_matrix_text=matrix, crash_matrix_text="")
     assert len(hits) == 1, hits
     assert "orphan.site" in hits[0] and "x.py:3" in hits[0]
 
@@ -97,7 +56,7 @@ def test_current_tree_sites_all_known():
     """Sanity: the scan actually sees the live registrations (engine,
     gateway, chunk store, checkpoint, xcache) — an empty scan would make
     the coverage assertion vacuously green."""
-    sites = {s for s, _, _ in _registered_sites(PACKAGE)}
+    sites = {s for s, _, _ in repo_result().meta["fault_sites"]}
     for expected in ("serve.dispatch", "gateway.route", "gateway.hedge",
                      "gateway.spare.activate", "chunk.read", "chunk.write",
                      "ckpt.save", "ckpt.restore", "xcache.load"):

@@ -6,48 +6,19 @@ through `obs` (`obs.monotime`, `obs.span`/`record_span`, `StepTimer`) so
 every duration lands in the same registry/event stream `obs.report`
 merges, instead of rotting in print statements and private variables.
 
-A grep, not a dataflow analysis, by design (the atomic-write lint's
-pattern): the convention is cheap to follow and the false-positive escape
-hatch is explicit — append `# lint: allow-raw-timer <why>` to a line
-whose raw clock read provably should not feed observability (e.g. a
-backoff deadline). Default args like ``clock=time.time`` are references,
-not reads, and do not match. New unexplained hits fail the build.
+Now a thin wrapper over the unified AST engine's ``raw-timer`` pass
+(`sparse_coding_tpu/analysis/`, docs/ARCHITECTURE.md §17) — same
+verdicts, one shared tree walk. The escape hatch is
+`# lint: allow-raw-timer <why>` (reason mandatory). Default args like
+``clock=time.time`` are references, not reads, and never match — the
+parser sees the call, not the token.
 """
 
-import re
-from pathlib import Path
-
-PACKAGE = Path(__file__).resolve().parent.parent / "sparse_coding_tpu"
-
-# the hot-path subsystems the convention covers; obs/ itself and utils/
-# (where the sanctioned primitives live) are exempt by scope
-LINTED_DIRS = ("data", "train", "serve", "pipeline")
-
-RAW_TIMER = re.compile(r"\btime\.(time|monotonic|perf_counter)\s*\(")
-OPT_OUT = "# lint: allow-raw-timer"
-
-
-def _violations(package: Path = None):
-    root = package if package is not None else PACKAGE
-    hits = []
-    for sub in LINTED_DIRS:
-        folder = root / sub
-        if not folder.exists():
-            continue
-        for path in sorted(folder.rglob("*.py")):
-            rel = path.relative_to(root).as_posix()
-            for lineno, line in enumerate(path.read_text().splitlines(), 1):
-                # match only the code portion: a mention inside a comment
-                # is not a clock read
-                code = line.split("#", 1)[0]
-                if RAW_TIMER.search(code) and OPT_OUT not in line:
-                    hits.append(f"sparse_coding_tpu/{rel}:{lineno}: "
-                                f"{line.strip()}")
-    return hits
+from analysis_helpers import repo_findings, scratch_findings
 
 
 def test_no_raw_timers_in_hot_paths():
-    hits = _violations()
+    hits = repo_findings("raw-timer")
     assert not hits, (
         "ad-hoc raw clock read in a hot-path subsystem — route timing "
         "through obs (obs.monotime, obs.span/record_span, StepTimer; "
@@ -58,7 +29,7 @@ def test_no_raw_timers_in_hot_paths():
 def test_lint_catches_a_planted_violation(tmp_path):
     """The lint must actually bite: plant raw timer reads in a scratch
     tree and watch exactly the unexcused ones get flagged (guards against
-    the regex rotting)."""
+    the pass rotting)."""
     pkg = tmp_path / "sparse_coding_tpu"
     (pkg / "serve").mkdir(parents=True)
     (pkg / "utils").mkdir()
@@ -71,6 +42,6 @@ def test_lint_catches_a_planted_violation(tmp_path):
         "t2 = time.monotonic()\n")
     # outside the linted dirs: never flagged, whatever it does
     (pkg / "utils" / "free.py").write_text("import time\nt = time.time()\n")
-    hits = _violations(pkg)
+    hits = scratch_findings(pkg, "raw-timer")
     assert len(hits) == 2, hits
     assert "bad.py:2" in hits[0] and "bad.py:6" in hits[1]

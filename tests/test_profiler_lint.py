@@ -7,52 +7,19 @@ one (exactly the bug this replaced in train/sweep.py:418/421/635).
 Capture goes through ``obs.trace.capture`` / ``TraceCapture`` (bounded
 window, tmp-then-atomic finalize, counted skip on error).
 
-A grep, not a dataflow analysis, by design (the raw-timer lint's
-pattern): the escape hatch is explicit — append
-``# lint: allow-raw-profiler <why>`` to a line that provably must touch
-the raw API. ``TraceAnnotation``/``annotate`` regions are fine (they
-only label an open trace, they cannot tear one).
+Now a thin wrapper over the unified AST engine's ``raw-profiler`` pass
+(`sparse_coding_tpu/analysis/`, docs/ARCHITECTURE.md §17) — same
+verdicts (repo-root scripts included), one shared tree walk. The escape
+hatch is ``# lint: allow-raw-profiler <why>`` (reason mandatory).
+``TraceAnnotation``/``annotate`` regions are fine (they only label an
+open trace, they cannot tear one).
 """
 
-import re
-from pathlib import Path
-
-REPO = Path(__file__).resolve().parent.parent
-PACKAGE = REPO / "sparse_coding_tpu"
-
-RAW_PROFILER = re.compile(r"\bprofiler\.(start_trace|stop_trace)\s*\(")
-OPT_OUT = "# lint: allow-raw-profiler"
-# the managed wrapper itself is the one sanctioned home of the raw API
-EXEMPT = ("obs/trace.py",)
-
-
-def _scan(paths, label_root: Path):
-    hits = []
-    for path in paths:
-        rel = path.relative_to(label_root).as_posix()
-        if rel in EXEMPT:
-            continue
-        for lineno, line in enumerate(path.read_text().splitlines(), 1):
-            # match only the code portion: a mention inside a comment or
-            # docstring reference is not a capture call
-            code = line.split("#", 1)[0]
-            if RAW_PROFILER.search(code) and OPT_OUT not in line:
-                hits.append(f"{rel}:{lineno}: {line.strip()}")
-    return hits
-
-
-def _violations(package: Path = None):
-    root = package if package is not None else PACKAGE
-    hits = _scan(sorted(root.rglob("*.py")), root)
-    if package is None:
-        # root scripts (bench.py, tune.py, bench_suite.py, ...) profile
-        # through the same managed path
-        hits += _scan(sorted(REPO.glob("*.py")), REPO)
-    return hits
+from analysis_helpers import repo_findings, scratch_findings
 
 
 def test_no_raw_profiler_calls():
-    hits = _violations()
+    hits = repo_findings("raw-profiler")
     assert not hits, (
         "bare jax.profiler.start_trace/stop_trace outside obs/trace.py — "
         "use obs.trace.capture / TraceCapture (crash-safe: bounded "
@@ -64,7 +31,7 @@ def test_no_raw_profiler_calls():
 def test_lint_catches_a_planted_violation(tmp_path):
     """The lint must actually bite: plant raw profiler calls in a scratch
     tree and watch exactly the unexcused ones get flagged (guards against
-    the regex rotting)."""
+    the pass rotting)."""
     pkg = tmp_path / "sparse_coding_tpu"
     (pkg / "train").mkdir(parents=True)
     (pkg / "obs").mkdir()
@@ -78,7 +45,8 @@ def test_lint_catches_a_planted_violation(tmp_path):
         "jax.profiler.TraceAnnotation('fine')\n")
     # the managed wrapper itself is exempt by scope
     (pkg / "obs" / "trace.py").write_text(
-        "import jax\njax.profiler.start_trace('/tmp/t')\n")
-    hits = _violations(pkg)
+        "import jax\njax.profiler.start_trace('/tmp/t')  "
+        "# lint: allow-raw-profiler the managed wrapper itself\n")
+    hits = scratch_findings(pkg, "raw-profiler")
     assert len(hits) == 2, hits
     assert "bad.py:2" in hits[0] and "bad.py:6" in hits[1]

@@ -7,55 +7,19 @@ persistent executable cache, the warmup manifest, and the
 ``xcache.load``/``xcache.store`` fault/crash story (docs/ARCHITECTURE.md
 §13) instead of silently re-paying XLA compile on every restart.
 
-A grep, not a dataflow analysis, by design (the raw-timer and
-atomic-write lints' pattern): the convention is cheap to follow and the
-false-positive escape hatch is explicit — append
-`# lint: allow-bare-compile <why>` to the ``.compile()`` line of a site
-that provably must not be cached (e.g. a deliberately-uncached probe).
-New unexplained hits fail the build. The pattern spans lines: the
-engine's original call site was ``jit(fn, ...)\n.lower(model,
-spec).compile()``.
+Now a thin wrapper over the unified AST engine's ``bare-compile`` pass
+(`sparse_coding_tpu/analysis/`, docs/ARCHITECTURE.md §17) — same
+verdicts, one shared tree walk, and the multi-line chain handling the
+legacy regex approximated with a one-nesting-level pattern is exact on
+the parse tree. The escape hatch (`# lint: allow-bare-compile <why>`,
+reason mandatory) may sit on any line of the chain.
 """
 
-import re
-from pathlib import Path
-
-PACKAGE = Path(__file__).resolve().parent.parent / "sparse_coding_tpu"
-
-# the AOT-compiling subsystems the convention covers; xcache/ itself owns
-# the one sanctioned lower().compile() call and is exempt by scope
-LINTED_DIRS = ("serve", "train")
-
-# ".lower( <args, one nesting level> ) . compile(" — possibly across lines
-BARE_COMPILE = re.compile(
-    r"\.lower\s*\((?:[^()]|\([^()]*\))*\)\s*\.\s*compile\s*\(", re.S)
-OPT_OUT = "# lint: allow-bare-compile"
-
-
-def _violations(package: Path = None):
-    root = package if package is not None else PACKAGE
-    hits = []
-    for sub in LINTED_DIRS:
-        folder = root / sub
-        if not folder.exists():
-            continue
-        for path in sorted(folder.rglob("*.py")):
-            rel = path.relative_to(root).as_posix()
-            text = path.read_text()
-            lines = text.splitlines()
-            for m in BARE_COMPILE.finditer(text):
-                first = text.count("\n", 0, m.start())
-                last = text.count("\n", 0, m.end())
-                if any(OPT_OUT in lines[i]
-                       for i in range(first, min(last + 1, len(lines)))):
-                    continue
-                hits.append(f"sparse_coding_tpu/{rel}:{first + 1}: "
-                            f"{lines[first].strip()}")
-    return hits
+from analysis_helpers import repo_findings, scratch_findings
 
 
 def test_no_bare_lower_compile_in_serve_and_train():
-    hits = _violations()
+    hits = repo_findings("bare-compile")
     assert not hits, (
         "bare jit(...).lower(...).compile() call site — route AOT "
         "compilation through xcache.cached_compile (docs/ARCHITECTURE.md "
@@ -83,7 +47,7 @@ def test_lint_catches_a_planted_violation(tmp_path):
     # outside the linted dirs: never flagged, whatever it does
     (pkg / "interp" / "free.py").write_text(
         "z = jax.jit(f).lower(x).compile()\n")
-    hits = _violations(pkg)
+    hits = scratch_findings(pkg, "bare-compile")
     assert len(hits) == 3, hits
     assert "bad.py:2" in hits[0]
     assert "bad.py:4" in hits[1]  # multi-line chain: the .lower(...) line
