@@ -14,8 +14,11 @@ with taps), sequence-parallel long-context forward (over whatever mesh the
 host offers), chunk-store IO, the guardian divergence soak (sentinel
 step overhead + frozen-member/zero-rollback drill semantics), and the
 device-time perf-probe overhead A/B (ISSUE 12; probe ON at default
-cadence must sit within noise of OFF). Every scenario row also lands in
-the durable perf_ledger.jsonl, asserted at exit.
+cadence must sit within noise of OFF), and the two-tenant fleet soak
+(ISSUE 14: whole-fleet throughput + tenant B's time-to-first-step
+through the real scheduler, workers cpu-pinned — safe under a wedged or
+busy tunnel). Every scenario row also lands in the durable
+perf_ledger.jsonl, asserted at exit.
 """
 
 from __future__ import annotations
@@ -696,6 +699,86 @@ def bench_gateway(quick: bool) -> None:
           recompiles=snap["recompiles"], steady_compiles=steady_compiles)
 
 
+def bench_fleet_soak(quick: bool) -> None:
+    """Two-tenant fleet soak (ISSUE 14): two identical healthy tenants
+    through the REAL scheduler — per-run worker subprocesses, one shared
+    xcache — measuring (a) whole-fleet training throughput and (b) the
+    number production cares about at tenant scale: TIME-TO-FIRST-STEP
+    for tenant B, i.e. how long the second tenant waits from fleet start
+    until its FIRST step child spawns (queue wait + tenant A's run on
+    this serial container; on a pod with free slices it is ~placement
+    latency — B's own pipeline work is excluded by construction). Worker children are ALWAYS cpu-pinned with the axon plugin
+    stripped (the bench process may own the tunnel; a worker's jax child
+    must never be the second tunnel-touching process — CLAUDE.md), so
+    the row is labeled ``worker_backend: cpu`` whatever the bench
+    backend. Also records tenant B's executable-store misses — 0 means
+    the shared-cache warm start held at soak scale."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from sparse_coding_tpu.obs.report import build_fleet_report
+    from sparse_coding_tpu.pipeline import FleetScheduler, RunJournal
+
+    d, rows = (16, 2048) if quick else (32, 16384)
+    with tempfile.TemporaryDirectory() as td:
+        root = Path(td)
+
+        def tenant_config(name):
+            base = root / "fleet" / "runs" / name / "data"
+            return {
+                "harvest": {"mode": "synthetic",
+                            "dataset_folder": str(base / "chunks"),
+                            "activation_dim": d,
+                            "n_ground_truth_features": 2 * d,
+                            "feature_num_nonzero": 5,
+                            "feature_prob_decay": 0.99,
+                            "dataset_size": rows, "n_chunks": 4,
+                            "batch_rows": 512, "seed": 0},
+                "sweep": {"experiment": "dense_l1_range",
+                          "ensemble": {"output_folder": str(base / "sweep"),
+                                       "dataset_folder": str(base / "chunks"),
+                                       "batch_size": 128, "n_chunks": 4,
+                                       "learned_dict_ratio": 2.0,
+                                       "tied_ae": True,
+                                       "checkpoint_every_chunks": 2,
+                                       "seed": 0},
+                          "log_every": 10 ** 9},
+                "eval": {"output_folder": str(base / "eval"),
+                         "n_eval_rows": 512, "seed": 0},
+            }
+
+        sched = FleetScheduler(root / "fleet", n_slices=1,
+                               max_concurrent=1, poll_s=0.05,
+                               max_wall_s=1800)
+        cpu_env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
+        for name in ("tenant-a", "tenant-b"):
+            sched.enqueue(name, tenant_config(name), env=cpu_env)
+        t0_wall, t0 = _time.time(), _time.perf_counter()
+        summary = sched.run()
+        wall = _time.perf_counter() - t0
+
+        # time-to-first-step: fleet start -> tenant B's FIRST step spawn
+        # (its harvest) — pure queue wait + placement latency, with B's
+        # own pipeline work excluded by construction
+        b_journal = RunJournal(root / "fleet" / "runs" / "tenant-b"
+                               / "journal.jsonl")
+        spawns = [r["ts"] for r in b_journal.records()
+                  if r["event"] == "step.spawn"]
+        tts_b = (min(spawns) - t0_wall) if spawns else None
+        fleet = build_fleet_report(root / "fleet")
+        b_cc = fleet["tenants"]["tenant-b"]["report"]["compile_cache"]
+        _emit("fleet_soak", 2 * rows / wall, "activations/s",
+              tenants=2, d=d, rows_per_tenant=rows,
+              states=summary, worker_backend="cpu",
+              time_to_first_step_b_s=(round(tts_b, 3)
+                                      if tts_b is not None else None),
+              store_misses_b=b_cc["store_misses"],
+              store_hits_b=b_cc["store_hits"],
+              placements=fleet["scheduler"]["placements"])
+        shutil.rmtree(root / "fleet", ignore_errors=True)
+
+
 def bench_seq_parallel(quick: bool) -> None:
     # The pre-r4 version of this suite hung indefinitely behind the axon
     # tunnel (eager shard_map); the jitted _sp_program fixed it, but a
@@ -758,7 +841,7 @@ def main() -> None:
                   bench_harvest,
                   bench_chunk_io, bench_ingest_soak, bench_streaming_eval,
                   bench_guardian_soak, bench_perf_probe, bench_gateway,
-                  bench_seq_parallel):
+                  bench_fleet_soak, bench_seq_parallel):
         try:
             suite(args.quick)
         except Exception as e:

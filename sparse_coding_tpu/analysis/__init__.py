@@ -29,6 +29,7 @@ from sparse_coding_tpu.analysis.core import (
 )
 
 # importing registers the passes
+from sparse_coding_tpu.analysis import beats as _beats  # noqa: F401
 from sparse_coding_tpu.analysis import coverage as _coverage  # noqa: F401
 from sparse_coding_tpu.analysis import hazards as _hazards  # noqa: F401
 from sparse_coding_tpu.analysis import legacy as _legacy  # noqa: F401

@@ -1,0 +1,79 @@
+"""Beat-coverage pass: polling loops in pipeline/ must heartbeat.
+
+The crash-only supervision story (docs/ARCHITECTURE.md §11/§18) turns on
+ONE signal: the lease heartbeat. A supervisor/scheduler process that
+loops-and-sleeps while babysitting children — the shape of every
+long-running work loop in ``pipeline/`` — is indistinguishable from a
+wedged one unless the loop itself calls ``resilience.lease.beat()`` (or
+an owned ``Lease``'s ``.beat()``) at a progress point. Heartbeats are
+deliberately emitted from the work loop on the main thread, never a side
+thread (resilience/lease.py): a side-thread beat would keep beating
+through exactly the hang the watchdog exists to catch — so a missing
+in-loop beat cannot be papered over elsewhere, and rots silently until
+the first real hang. This pass makes the convention mechanical.
+
+Detection is deliberately narrow so every finding is worth reading: a
+``for``/``while`` loop in ``pipeline/`` whose body (nested included)
+calls ``sleep`` — the signature of a polling loop that runs for a long
+time — must lexically contain a ``beat`` call. Loops that never sleep
+finish fast and are not the watchdog's concern. Escape hatch:
+``# lint: allow-beat-coverage <why>`` anywhere in the loop's span.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from sparse_coding_tpu.analysis.core import (
+    FileCtx,
+    Match,
+    Pass,
+    RepoCtx,
+    last_segment,
+    register,
+)
+from sparse_coding_tpu.analysis.legacy import _pkg_rel
+
+
+def _calls_in(node: ast.AST) -> Iterable[ast.Call]:
+    for child in ast.walk(node):
+        if isinstance(child, ast.Call):
+            yield child
+
+
+@register
+class BeatCoveragePass(Pass):
+    rule = "beat-coverage"
+    description = ("polling loop (sleeps between iterations) in pipeline/ "
+                   "with no lease heartbeat — long-running work loops must "
+                   "call resilience.lease.beat() at a progress point so "
+                   "the watchdog can tell working from wedged "
+                   "(docs/ARCHITECTURE.md §11/§18)")
+
+    LINTED_DIRS = ("pipeline/",)
+
+    def run(self, ctx: FileCtx, repo: RepoCtx) -> Iterable[Match]:
+        in_scope = _pkg_rel(ctx).startswith(self.LINTED_DIRS)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            body = list(node.body) + list(node.orelse)
+            sleeps = any(last_segment(c.func) == "sleep"
+                         for stmt in body for c in _calls_in(stmt))
+            if not sleeps:
+                continue
+            beats = any(last_segment(c.func) == "beat"
+                        for stmt in body for c in _calls_in(stmt))
+            if beats:
+                continue
+            line = ctx.line_of(node, "while " if isinstance(
+                node, ast.While) else "for ")
+            yield Match(
+                self.rule, ctx.rel, line,
+                node.end_lineno or line,
+                "polling loop sleeps but never heartbeats — call "
+                "resilience.lease.beat() (or the owned Lease's .beat()) "
+                "at a progress point, or excuse a provably short-lived "
+                "loop with '# lint: allow-beat-coverage <why>'",
+                in_scope=in_scope)

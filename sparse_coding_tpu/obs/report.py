@@ -309,6 +309,88 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
     }
 
 
+def is_fleet_dir(path: str | Path) -> bool:
+    """A fleet dir is recognized by its queue file — the CLI auto-routes
+    to the fleet section (one report command, whatever the layout)."""
+    from sparse_coding_tpu.pipeline.fleet_queue import QUEUE_NAME
+
+    return (Path(path) / QUEUE_NAME).exists()
+
+
+def build_fleet_report(fleet_dir: str | Path) -> dict:
+    """The multi-tenant merge (docs/ARCHITECTURE.md §18): replay the
+    fleet queue (jax-free — runs against a wedged tunnel) and build each
+    tenant's OWN merged report over its run dir, plus the scheduler's
+    placement/preemption/containment counters from the fleet-level event
+    files. One command answers the incident questions: which tenant
+    halted, what did it cost everyone else (nothing), and did the next
+    tenant warm-start from the shared cache."""
+    from sparse_coding_tpu.pipeline.fleet_queue import QUEUE_NAME, FleetQueue
+
+    fleet_dir = Path(fleet_dir)
+    state = FleetQueue(fleet_dir / QUEUE_NAME).replay()
+    tenants = {}
+    for name, run in sorted(state.runs.items()):
+        report = build_report(fleet_dir / "runs" / name)
+        tenants[name] = {
+            "state": run.state, "priority": run.priority,
+            "slices": run.slices, "attempts": run.attempts,
+            "report": report,
+        }
+    # the scheduler's own evidence stream (obs/fleet-<pid>.jsonl files)
+    sched = build_report(fleet_dir)
+    counters = sched.get("counters", {})
+    releases = {}
+    for cname, v in counters.items():
+        base, labels = split_labels(cname)
+        if base == "fleet.releases" and "outcome" in labels:
+            releases[labels["outcome"]] = releases.get(
+                labels["outcome"], 0) + int(v)
+    return {
+        "fleet_dir": str(fleet_dir),
+        "states": state.summary(),
+        "tenants": tenants,
+        "scheduler": {
+            "placements": counters.get("fleet.placements", 0),
+            "preemptions": counters.get("fleet.preemptions", 0),
+            "halts": counters.get("fleet.halts", 0),
+            "reclaims": counters.get("fleet.reclaims", 0),
+            "worker_hangs": counters.get("fleet.worker_hangs", 0),
+            "place_errors": counters.get("fleet.place_errors", 0),
+            "preempt_errors": counters.get("fleet.preempt_errors", 0),
+            "releases": releases,
+            "events": sched.get("events", 0),
+        },
+    }
+
+
+def format_fleet_report(fleet: dict) -> str:
+    sched = fleet["scheduler"]
+    lines = [f"fleet {fleet['fleet_dir']} — "
+             f"{len(fleet['tenants'])} tenant(s)",
+             f"scheduler: {sched['placements']} placement(s), "
+             f"{sched['preemptions']} preemption(s), "
+             f"{sched['halts']} halt(s), {sched['reclaims']} reclaim(s), "
+             f"{sched['worker_hangs']} hung worker(s); releases "
+             + (", ".join(f"{k}={v}"
+                          for k, v in sorted(sched["releases"].items()))
+                or "-")]
+    for name, t in fleet["tenants"].items():
+        rep = t["report"]
+        gd = rep.get("guardian", {})
+        cc = rep.get("compile_cache", {})
+        lines.append(
+            f"tenant {name}: {t['state']} ({t['priority']}, "
+            f"{t['slices']} slice(s), {t['attempts']} attempt(s)) — "
+            f"guardian {gd.get('halts', 0)} halt(s)/"
+            f"{gd.get('rollbacks', 0)} rollback(s), xcache "
+            f"{cc.get('store_hits', 0)}h/{cc.get('store_misses', 0)}m, "
+            f"{rep.get('events', 0)} event(s)")
+    lines.append("per-tenant detail: python -m sparse_coding_tpu.obs."
+                 "report <fleet_dir>/runs/<tenant>")
+    return "\n".join(lines)
+
+
 def _fmt_s(v: Optional[float]) -> str:
     if v is None:
         return "-"
@@ -520,6 +602,18 @@ def format_diff(diff: dict) -> str:
     return "\n".join(lines)
 
 
+def _print_report(payload: dict, formatter, as_json: bool) -> None:
+    """The one CLI emit path: JSON or formatted, `| head`-tolerant."""
+    try:
+        print(json.dumps(payload, indent=2, default=float) if as_json
+              else formatter(payload))
+    except BrokenPipeError:
+        # `... | head` closed the pipe: normal CLI usage, not an error
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+
+
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     as_json = "--json" in argv
@@ -547,17 +641,13 @@ def main(argv=None) -> None:
         return
     if len(argv) != 1:
         raise SystemExit(
-            "usage: python -m sparse_coding_tpu.obs.report <run_dir> "
-            "[--json] | --diff <run_a> <run_b>")
-    report = build_report(argv[0])
-    try:
-        print(json.dumps(report, indent=2, default=float) if as_json
-              else format_report(report))
-    except BrokenPipeError:
-        # `... | head` closed the pipe: normal CLI usage, not an error
-        import os
-
-        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+            "usage: python -m sparse_coding_tpu.obs.report "
+            "<run_dir|fleet_dir> [--json] | --diff <run_a> <run_b>")
+    if is_fleet_dir(argv[0]):
+        _print_report(build_fleet_report(argv[0]), format_fleet_report,
+                      as_json)
+        return
+    _print_report(build_report(argv[0]), format_report, as_json)
 
 
 if __name__ == "__main__":

@@ -1,42 +1,60 @@
-"""Crash-only pipeline supervision: journaled harvest→sweep→eval.
+"""Crash-only pipeline supervision: journaled runs, one pod, many tenants.
 
-- :mod:`journal`    — append-only run journal (the supervisor's only
+- :mod:`journal`     — append-only run journal (the supervisor's only
   memory; atomic appends, artifact-beats-journal recovery);
-- :mod:`supervisor` — the step DAG runner: child processes, lease
+- :mod:`supervisor`  — the step DAG runner: child processes, lease
   takeover, SIGKILL recovery, hang watchdog with tunnel diagnosis,
   degrade-to-CPU, plus ``supervise_bench`` (bench.py ``--supervised``);
-- :mod:`steps`      — the built-in resumable step children.
+- :mod:`steps`       — the built-in resumable step children;
+- :mod:`fleet` / :mod:`fleet_queue` / :mod:`placement` — the fleet
+  scheduler (docs/ARCHITECTURE.md §18): a durable bitwise-replay run
+  queue bin-packed onto mesh slices with serve/slo.py's priority
+  classes, per-run worker subprocesses (one Supervisor each), chunk-
+  boundary SIGTERM preemption, per-tenant guardian-halt containment,
+  and one shared executable cache across tenants.
 
-Design + formats: docs/ARCHITECTURE.md §11; wedged-tunnel operations:
-docs/RUNBOOK_TUNNEL.md; kill coverage: tests/test_pipeline_chaos.py.
+Design + formats: docs/ARCHITECTURE.md §11 + §18; wedged-tunnel
+operations: docs/RUNBOOK_TUNNEL.md; kill coverage:
+tests/test_pipeline_chaos.py.
 """
 
-from sparse_coding_tpu.pipeline.journal import RunJournal
-from sparse_coding_tpu.pipeline.supervisor import (
-    ConcurrentSupervisorError,
-    PipelineError,
-    Step,
-    StepFailed,
-    StepHung,
-    Supervisor,
-    build_pipeline,
-    build_sharded_pipeline,
-    load_or_create_run_id,
-    step_argv,
-    supervise_bench,
-)
+import importlib
 
-__all__ = [
-    "ConcurrentSupervisorError",
-    "PipelineError",
-    "RunJournal",
-    "Step",
-    "StepFailed",
-    "StepHung",
-    "Supervisor",
-    "build_pipeline",
-    "build_sharded_pipeline",
-    "load_or_create_run_id",
-    "step_argv",
-    "supervise_bench",
-]
+# Lazy attribute resolution (PEP 562, mirroring the package root and
+# serve/): `python -m sparse_coding_tpu.pipeline.fleet` is a runpy
+# entrypoint — an eager `from .fleet import ...` here would import the
+# module a second time under runpy and trip its double-execution
+# warning in every worker log.
+_LAZY_ATTRS = {
+    "FleetScheduler": ("sparse_coding_tpu.pipeline.fleet",
+                       "FleetScheduler"),
+    "run_worker": ("sparse_coding_tpu.pipeline.fleet", "run_worker"),
+    "FleetQueue": ("sparse_coding_tpu.pipeline.fleet_queue", "FleetQueue"),
+    "FleetState": ("sparse_coding_tpu.pipeline.fleet_queue", "FleetState"),
+    "RunJournal": ("sparse_coding_tpu.pipeline.journal", "RunJournal"),
+    "PlacementPlan": ("sparse_coding_tpu.pipeline.placement",
+                      "PlacementPlan"),
+    "RunState": ("sparse_coding_tpu.pipeline.placement", "RunState"),
+    "plan_placement": ("sparse_coding_tpu.pipeline.placement",
+                       "plan_placement"),
+}
+for _name in ("STEP_EXIT_HALTED", "STEP_EXIT_PREEMPTED",
+              "ConcurrentSupervisorError", "PipelineError", "Step",
+              "StepFailed", "StepHalted", "StepHung", "StepPreempted",
+              "Supervisor", "build_pipeline", "build_sharded_pipeline",
+              "load_or_create_run_id", "step_argv", "supervise_bench"):
+    _LAZY_ATTRS[_name] = ("sparse_coding_tpu.pipeline.supervisor", _name)
+
+__all__ = sorted(_LAZY_ATTRS)
+
+
+def __getattr__(name):
+    if name in _LAZY_ATTRS:
+        module, attr = _LAZY_ATTRS[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(
+        f"module 'sparse_coding_tpu.pipeline' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_ATTRS))

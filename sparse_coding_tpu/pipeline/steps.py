@@ -386,6 +386,26 @@ def main(argv=None) -> None:
                 STEPS[step](config, shard)
             else:
                 STEPS[step](config)
+    except BaseException as e:
+        # the two STRUCTURED shutdown classes leave as typed exit codes
+        # (pipeline/supervisor.py maps them back): a SIGTERM preemption
+        # checkpointed at its chunk boundary and resumes bitwise; a
+        # guardian divergence halt is deterministic and must not be
+        # retried. Everything else propagates as a plain failure.
+        from sparse_coding_tpu.pipeline.supervisor import (
+            STEP_EXIT_HALTED,
+            STEP_EXIT_PREEMPTED,
+        )
+        from sparse_coding_tpu.resilience.errors import DivergenceHaltError
+        from sparse_coding_tpu.resilience.preempt import SweepPreempted
+
+        if isinstance(e, SweepPreempted):
+            print(f"step {step}: {e}", file=sys.stderr)
+            raise SystemExit(STEP_EXIT_PREEMPTED) from e
+        if isinstance(e, DivergenceHaltError):
+            print(f"step {step}: {e}", file=sys.stderr)
+            raise SystemExit(STEP_EXIT_HALTED) from e
+        raise
     finally:
         obs.update_memory_gauges()
         obs.flush_metrics()

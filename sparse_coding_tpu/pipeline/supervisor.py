@@ -59,6 +59,17 @@ from sparse_coding_tpu.resilience.watchdog import (
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 
+# Typed step-child exit codes (pipeline/steps.py maps the two structured
+# shutdown classes onto these; everything else is a plain failure). 75 =
+# EX_TEMPFAIL: a SIGTERM-preempted step checkpointed at its chunk boundary
+# and will resume bitwise (resilience/preempt.py) — retrying IN PLACE
+# would undo the preemption, so the supervisor surfaces it typed instead.
+# 78 = a guardian divergence halt (train/guardian.py DivergenceHaltError):
+# deterministic — the ledger records the halt, so a retry would replay the
+# same sweep to the same halt; the supervisor must not burn attempts on it.
+STEP_EXIT_PREEMPTED = 75
+STEP_EXIT_HALTED = 78
+
 
 def load_or_create_run_id(run_dir: str | Path) -> str:
     """The run's correlation ID (docs/ARCHITECTURE.md §12): minted once
@@ -110,6 +121,32 @@ class StepHung(PipelineError):
         super().__init__(f"step {step!r} hung; {format_diagnosis(diagnosis)}")
         self.step = step
         self.diagnosis = diagnosis
+
+
+class StepPreempted(PipelineError):
+    """A step child exited with ``STEP_EXIT_PREEMPTED``: a SIGTERM landed
+    and it checkpointed at its chunk boundary (resilience/preempt.py).
+    The run is RESUMABLE, not failed — the fleet scheduler re-queues it;
+    a bare supervisor surfaces it typed so the operator decides."""
+
+    def __init__(self, step: str):
+        super().__init__(f"step {step!r} preempted (checkpointed at its "
+                         "chunk boundary; re-run to resume)")
+        self.step = step
+
+
+class StepHalted(PipelineError):
+    """A step child exited with ``STEP_EXIT_HALTED``: the training
+    guardian raised its typed divergence halt (docs/ARCHITECTURE.md §16).
+    The halt is deterministic — the guardian ledger already records it, a
+    respawn replays the same sweep into the same halt — so the supervisor
+    raises immediately instead of burning its attempt budget."""
+
+    def __init__(self, step: str):
+        super().__init__(
+            f"step {step!r} halted by the training guardian "
+            "(DivergenceHaltError; triage: docs/RUNBOOK_TUNNEL.md)")
+        self.step = step
 
 
 class ConcurrentSupervisorError(PipelineError):
@@ -182,13 +219,19 @@ class Supervisor:
     def __init__(self, run_dir: str | Path, steps: Sequence[Step], *,
                  max_attempts: int = 2, heartbeat_stale_s: float = 120.0,
                  poll_s: float = 0.25, cpu_only: bool = False,
-                 prober=None, clock=time.time):
+                 prober=None, clock=time.time,
+                 preempt_flag: Optional[Callable[[], bool]] = None):
         self.run_dir = Path(run_dir)
         self.steps = _toposort(steps)
         self.max_attempts = int(max_attempts)
         self.heartbeat_stale_s = float(heartbeat_stale_s)
         self.poll_s = float(poll_s)
         self.cpu_only = bool(cpu_only)
+        # a fleet worker's cooperative preemption hook (pipeline/fleet.py,
+        # resilience/preempt.py): checked between steps and between
+        # attempts, so a SIGTERM that lands while NO child is running
+        # still stops the run typed instead of spawning fresh work
+        self._preempt_flag = preempt_flag
         self._prober = prober or watchdog_mod.probe_tunnel
         self._clock = clock
         # the run's correlation identity: journal records carry it, child
@@ -243,6 +286,7 @@ class Supervisor:
                                             note="artifact present at startup")
                     summary[step.name] = "skipped"
                     continue
+                self._check_preempted(step.name)
                 self._takeover_lease(step)
                 self._run_step(step)
                 summary[step.name] = "done"
@@ -338,10 +382,18 @@ class Supervisor:
             env = stripped_cpu_env(env)
         return env
 
+    def _check_preempted(self, step_name: str) -> None:
+        if self._preempt_flag is not None and self._preempt_flag():
+            self.journal.append("step.preempted", step_name,
+                                note="flag checked before spawn")
+            raise StepPreempted(step_name)
+
     def _run_step(self, step: Step) -> None:
         degraded = False
         last_reason = "never spawned"
         for attempt in range(1, self.max_attempts + 1):
+            if attempt > 1:
+                self._check_preempted(step.name)
             argv = (step.degrade_argv
                     if degraded and step.degrade_argv else step.argv)
             log_path = self._log_path(step, attempt)
@@ -399,6 +451,22 @@ class Supervisor:
                                         attempt=attempt, rc=0,
                                         reason=last_reason)
                     _span("failed", ok=False)
+                elif rc == STEP_EXIT_PREEMPTED:
+                    # graceful SIGTERM shutdown: checkpointed, resumable —
+                    # typed out instead of burning the attempt budget
+                    self.journal.append("step.preempted", step.name,
+                                        attempt=attempt)
+                    self.lease_path(step).unlink(missing_ok=True)
+                    _span("preempted", ok=False)
+                    raise StepPreempted(step.name)
+                elif rc == STEP_EXIT_HALTED:
+                    # guardian divergence halt: deterministic, a respawn
+                    # replays into the same halt — never retried
+                    self.journal.append("step.halted", step.name,
+                                        attempt=attempt, log=str(log_path))
+                    self.lease_path(step).unlink(missing_ok=True)
+                    _span("halted", ok=False)
+                    raise StepHalted(step.name)
                 elif rc < 0:
                     last_reason = f"killed by signal {-rc}"
                     self.journal.append("step.killed", step.name,
@@ -432,6 +500,11 @@ class Supervisor:
         while True:
             if proc.poll() is not None:
                 return None
+            # the supervisor's OWN heartbeat: when this supervisor is a
+            # fleet per-run worker (pipeline/fleet.py), the scheduler
+            # watches a worker lease exported through the env — babysitting
+            # a live child IS progress; a no-op outside a fleet
+            lease_mod.beat()
             state = lease_state(path, self.heartbeat_stale_s,
                                 clock=self._clock)
             if state == "stale" or state == "missing":

@@ -84,6 +84,12 @@ CRASH_SITES: dict[str, str] = {
                          "yet performed (train/guardian.py)",
     "obs.trace.capture": "profiler stopped, trace tmp dir durable, final "
                          "rename not yet performed (obs/trace.py)",
+    # seeded like shard.finalize/scrub.repair: a fleet worker's step
+    # children inherit the scheduler's SPARSE_CODING_CRASH_PLAN and parse
+    # it at their first barrier, without ever importing pipeline/fleet.py
+    "fleet.place": "run.place queue record durable, the worker not yet "
+                   "spawned (pipeline/fleet.py) — the no-run-lost/"
+                   "none-double-placed instant",
 }
 
 

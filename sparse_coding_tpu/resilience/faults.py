@@ -90,6 +90,16 @@ FAULT_SITES: dict[str, str] = {
     "obs.trace.capture": "managed profiler capture — begin and atomic "
                          "finalize (obs/trace.py)",
     "obs.ledger.append": "perf-ledger row append (obs/ledger.py)",
+    # seeded here (not only registered at pipeline/fleet*.py import): a
+    # fleet worker's STEP children inherit the scheduler's env plan and
+    # parse it at their first fault_point — long before (and without
+    # ever) importing the fleet modules
+    "fleet.enqueue": "fleet queue admission — the durable run.enqueue "
+                     "append (pipeline/fleet_queue.py)",
+    "fleet.place": "fleet placement decision — before the durable "
+                   "run.place append (pipeline/fleet.py)",
+    "fleet.preempt": "fleet preemption — before the run.preempt append "
+                     "+ SIGTERM (pipeline/fleet.py)",
 }
 
 

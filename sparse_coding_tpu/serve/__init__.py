@@ -35,32 +35,56 @@ Above the single engine sits the **self-healing gateway**
 See docs/ARCHITECTURE.md §8 for the engine design rationale.
 """
 
-from sparse_coding_tpu.resilience.breaker import CircuitBreaker
-from sparse_coding_tpu.serve.batching import (
-    CircuitOpenError,
-    DispatchError,
-    QueueFullError,
-    RequestTooLargeError,
-    ServeError,
-    ServeFuture,
-)
-from sparse_coding_tpu.serve.engine import (
-    ServingEngine,
-    bucket_op_fn,
-    build_bucket_program,
-)
-from sparse_coding_tpu.serve.gateway import Replica, ServingGateway
-from sparse_coding_tpu.serve.health import EwmaHealth
-from sparse_coding_tpu.serve.metrics import ServingMetrics
-from sparse_coding_tpu.serve.offline import score_offline
-from sparse_coding_tpu.serve.registry import ModelRegistry, RegistryEntry
-from sparse_coding_tpu.serve.slo import (
-    BATCH,
-    INTERACTIVE,
-    PRIORITIES,
-    SCAVENGER,
-    AdmissionController,
-)
+import importlib
+
+# Attributes resolve LAZILY (PEP 562, mirroring the package root): the
+# fleet scheduler (pipeline/fleet.py) shares slo.py's priority classes,
+# and its import chain — like every scheduler-side pipeline module — must
+# stay jax-free so the scheduler process never becomes a second
+# tunnel-touching jax process while its worker children own the tunnel
+# (CLAUDE.md). Importing the engine/gateway submodules still pulls jax;
+# importing `sparse_coding_tpu.serve` (or slo/batching/metrics) does not.
+_LAZY_ATTRS = {
+    "CircuitBreaker": ("sparse_coding_tpu.resilience.breaker",
+                       "CircuitBreaker"),
+    "CircuitOpenError": ("sparse_coding_tpu.serve.batching",
+                         "CircuitOpenError"),
+    "DispatchError": ("sparse_coding_tpu.serve.batching", "DispatchError"),
+    "QueueFullError": ("sparse_coding_tpu.serve.batching", "QueueFullError"),
+    "RequestTooLargeError": ("sparse_coding_tpu.serve.batching",
+                             "RequestTooLargeError"),
+    "ServeError": ("sparse_coding_tpu.serve.batching", "ServeError"),
+    "ServeFuture": ("sparse_coding_tpu.serve.batching", "ServeFuture"),
+    "ServingEngine": ("sparse_coding_tpu.serve.engine", "ServingEngine"),
+    "bucket_op_fn": ("sparse_coding_tpu.serve.engine", "bucket_op_fn"),
+    "build_bucket_program": ("sparse_coding_tpu.serve.engine",
+                             "build_bucket_program"),
+    "Replica": ("sparse_coding_tpu.serve.gateway", "Replica"),
+    "ServingGateway": ("sparse_coding_tpu.serve.gateway", "ServingGateway"),
+    "EwmaHealth": ("sparse_coding_tpu.serve.health", "EwmaHealth"),
+    "ServingMetrics": ("sparse_coding_tpu.serve.metrics", "ServingMetrics"),
+    "score_offline": ("sparse_coding_tpu.serve.offline", "score_offline"),
+    "ModelRegistry": ("sparse_coding_tpu.serve.registry", "ModelRegistry"),
+    "RegistryEntry": ("sparse_coding_tpu.serve.registry", "RegistryEntry"),
+    "BATCH": ("sparse_coding_tpu.serve.slo", "BATCH"),
+    "INTERACTIVE": ("sparse_coding_tpu.serve.slo", "INTERACTIVE"),
+    "PRIORITIES": ("sparse_coding_tpu.serve.slo", "PRIORITIES"),
+    "SCAVENGER": ("sparse_coding_tpu.serve.slo", "SCAVENGER"),
+    "AdmissionController": ("sparse_coding_tpu.serve.slo",
+                            "AdmissionController"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY_ATTRS:
+        module, attr = _LAZY_ATTRS[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(
+        f"module 'sparse_coding_tpu.serve' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_ATTRS))
 
 __all__ = [
     "AdmissionController",

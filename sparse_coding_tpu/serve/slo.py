@@ -46,6 +46,18 @@ SCAVENGER = "scavenger"
 PRIORITIES = (INTERACTIVE, BATCH, SCAVENGER)
 
 
+def priority_rank(priority: str) -> int:
+    """Scheduling rank (0 = most urgent). The ONE ordering shared by the
+    gateway's admission ladder and the fleet scheduler's bin-packing
+    (pipeline/placement.py): a tenant's sweep and a serving request mean
+    the same thing by "interactive". Unknown priorities raise — both
+    callers validate at their front door."""
+    if priority not in PRIORITIES:
+        raise ValueError(f"unknown priority {priority!r} "
+                         f"(supported: {PRIORITIES})")
+    return PRIORITIES.index(priority)
+
+
 def windowed_quantile(samples, q: float):
     """Nearest-rank quantile over a RECENT-sample window (the gateway's
     rolling latency deque). The closed loop must read this, never a

@@ -315,7 +315,16 @@ def _sharded_fused_loss_and_grads(params: dict, batch: Array, l1_alpha,
         if tied:
             x_hat = x_hat + p["centering"]
         r = x_hat - local_batch  # replicated over "model"
-        mse_losses = jnp.mean(jnp.square(r), axis=-1)
+        # per-row losses leave as an EXPLICITLY replicated [B] array (one
+        # all_gather over "data", out_spec P()): under this container's
+        # older shard_map, a P("data") output that is merely replicated
+        # over "model" (check_rep off) gets re-partitioned by SUMMING over
+        # every mesh axis when a downstream op (the worst-loss concat in
+        # make_big_sae_step) needs it replicated — each worst-loss entry
+        # came back as a sum of ~mesh_size different rows. Replicated-P()
+        # outputs ride the same proven path as the psum'd scalars.
+        mse_losses = jax.lax.all_gather(jnp.mean(jnp.square(r), axis=-1),
+                                        "data", tiled=True)
         mse = jax.lax.psum(jnp.sum(jnp.square(r)), "data") / (total_b * d)
         de, dwn, dt, dctr_enc, c_totals, scal = big_sae_backward(
             p, alpha, xc, r, bt, ft, interpret=interpret,
@@ -338,7 +347,7 @@ def _sharded_fused_loss_and_grads(params: dict, batch: Array, l1_alpha,
     param_specs = {"dict": P("model", None), "encoder": P(None, "model"),
                    "threshold": P("model"), "centering": P()}
     aux_specs = {"mse": P(), "sparsity": P(), "c_totals_delta": P("model"),
-                 "mse_losses": P("data"), "l0_mean": P()}
+                 "mse_losses": P(), "l0_mean": P()}
     grad_specs = dict(param_specs)
     fn = compat_shard_map(local_fn, mesh,
                           in_specs=(param_specs, P(), P("data")),

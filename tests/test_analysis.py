@@ -228,6 +228,71 @@ def test_in_trace_nondet_catches_planted_entropy(tmp_path):
     assert "traced.py:20" in hits[2] and "random.random" in hits[2]
 
 
+# -- beat-coverage planted matrix (ISSUE 14) ------------------------------
+
+def test_beat_coverage_catches_sleeping_loop_without_beat(tmp_path):
+    pkg = _plant(tmp_path, "pipeline/poller.py", """
+        import time
+        from sparse_coding_tpu.resilience import lease
+
+        def watch_bad(proc, poll_s):
+            while proc.poll() is None:
+                time.sleep(poll_s)
+
+        def watch_good(proc, poll_s):
+            while proc.poll() is None:
+                lease.beat()
+                time.sleep(poll_s)
+
+        def watch_owned(proc, my_lease, poll_s):
+            for _ in range(10):
+                my_lease.beat()  # owned-Lease form counts too
+                time.sleep(poll_s)
+
+        def watch_excused(proc, poll_s):
+            for _ in range(3):  # lint: allow-beat-coverage bounded three-tick startup probe
+                time.sleep(poll_s)
+
+        def fast_loop(items):
+            total = 0
+            for x in items:  # no sleep: not a polling loop
+                total += x
+            return total
+        """)
+    hits = scratch_findings(pkg, "beat-coverage")
+    assert len(hits) == 1, hits
+    assert "poller.py:6" in hits[0] and "never heartbeats" in hits[0]
+
+
+def test_beat_coverage_out_of_scope_dirs_not_flagged(tmp_path):
+    # the convention covers pipeline/ — a sleeping retry loop in data/
+    # belongs to the retry/backoff story, not the supervision watchdog
+    pkg = _plant(tmp_path, "data/backoff.py", """
+        import time
+
+        def retry(fn, n):
+            for _ in range(n):
+                time.sleep(0.1)
+        """)
+    assert scratch_findings(pkg, "beat-coverage") == []
+
+
+def test_beat_coverage_nested_beat_covers_outer_loop(tmp_path):
+    # ast-nested: a beat anywhere inside the loop body (incl. an inner
+    # loop) is a progress point for every enclosing polling loop
+    pkg = _plant(tmp_path, "pipeline/nested.py", """
+        import time
+        from sparse_coding_tpu.resilience import lease
+
+        def drain(queues, poll_s):
+            while queues:
+                for q in queues:
+                    lease.beat()
+                time.sleep(poll_s)
+        """)
+    assert scratch_findings(pkg, "beat-coverage") == []
+
+
 # -- stale escape hatches planted matrix ----------------------------------
 
 def test_stale_hatches_are_findings(tmp_path):

@@ -40,9 +40,20 @@ def test_batched_pca_matches_direct(rng):
     direct_cov = jnp.cov(x.T, bias=True)
     np.testing.assert_allclose(np.asarray(pca.state.cov), np.asarray(direct_cov),
                                rtol=1e-3, atol=1e-3)
-    # top eigenvector aligns with the largest-variance axis
+    # Top-eigenvector checks, robust to the near-degenerate top of the
+    # SAMPLE spectrum: with n=2000 and adjacent population variances only
+    # (32/31)^2 ≈ 6.5% apart, eigenvalue sampling noise (~λ·√(2/n) ≈ 3%)
+    # can reorder/mix the top axes — this container's jax PRNG draws a
+    # sample whose top two eigenvalues land 1051 vs 1032, so "top vector
+    # == e_31" is not a property of the code (verified against float64
+    # numpy.linalg.eigh on the same covariance). The real contracts:
+    # streaming PCA's top vector matches the DIRECT covariance's top
+    # vector, and it lives in the top-variance subspace.
     top = np.asarray(pca.get_dict()[0])
-    assert abs(top[-1]) > 0.9
+    w_d, v_d = np.linalg.eigh(np.asarray(direct_cov, np.float64))
+    direct_top = v_d[:, np.argmax(w_d)]
+    assert abs(float(top @ direct_top)) > 0.99
+    assert float(np.sum(top[-4:] ** 2)) > 0.9  # top-variance subspace
 
 
 def test_pca_encoder_topk(rng):

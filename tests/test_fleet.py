@@ -1,0 +1,583 @@
+"""Fleet scheduler suite (ISSUE 14, marker ``fleet``, tier-1).
+
+Three layers, cheapest first:
+
+- **pure placement**: the bin-packing planner driven exactly (no
+  processes, no clocks);
+- **queue + scheduler mechanics** on cheap non-jax command children:
+  replay, idempotent enqueue, crash re-queue, preemption of a running
+  scavenger, scheduler contention (``ConcurrentSupervisorError``) and
+  dead-scheduler lease takeover that resumes the queue without
+  double-running any run;
+- **the two-tenant containment drill** (ROADMAP item 5's done bar, real
+  jax children): tenant A's poisoned data walks the guardian ladder to a
+  typed halt INSIDE its own run dir; tenant B's sweep then runs to
+  completion with artifacts bitwise-identical to a standalone run and
+  ZERO executable-store misses — every program loaded from the fleet's
+  ONE shared xcache that tenant A populated before halting — and each
+  tenant reads its own merged ``obs.report``.
+
+The ``fleet.place`` SIGKILL chaos case lives with the rest of the kill
+matrix in tests/test_pipeline_chaos.py; the fleet fault-site entries in
+tests/test_resilience.py.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from sparse_coding_tpu.pipeline import (
+    ConcurrentSupervisorError,
+    FleetQueue,
+    FleetScheduler,
+    plan_placement,
+)
+from sparse_coding_tpu.pipeline.fleet import (
+    WORKER_EXIT_HALTED,
+    worker_lease_path,
+)
+from sparse_coding_tpu.pipeline.fleet_queue import QUEUE_NAME
+from sparse_coding_tpu.pipeline.placement import RunState
+from sparse_coding_tpu.resilience import lease as lease_mod
+from sparse_coding_tpu.resilience.lease import seed_lease
+from sparse_coding_tpu.serve.slo import BATCH, INTERACTIVE, SCAVENGER
+
+pytestmark = pytest.mark.fleet
+
+POLL_S = 0.05
+WALL_S = 120.0
+
+
+@pytest.fixture(autouse=True)
+def _hermetic(monkeypatch):
+    monkeypatch.delenv("SPARSE_CODING_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("SPARSE_CODING_CRASH_PLAN", raising=False)
+    monkeypatch.delenv(lease_mod.ENV_PATH, raising=False)
+    monkeypatch.delenv("SPARSE_CODING_XCACHE_DIR", raising=False)
+    yield
+    lease_mod.configure(None)
+
+
+def _sched(tmp_path, **kw):
+    kw.setdefault("poll_s", POLL_S)
+    kw.setdefault("max_wall_s", WALL_S)
+    return FleetScheduler(tmp_path / "fleet", **kw)
+
+
+def _touch_run(sched, name, out: Path, content=None, priority=BATCH,
+               **kw):
+    content = content if content is not None else f"done-{name}"
+    return sched.enqueue(
+        name, priority=priority, kind="command",
+        argv=[sys.executable, "-c",
+              f"open({str(out)!r}, 'w').write({content!r})"],
+        done_path=out, **kw)
+
+
+def _events(sched):
+    return [(r["event"], r.get("step"))
+            for r in sched.queue.journal.records()
+            if r["event"].startswith("run.")]
+
+
+# -- placement planner (pure) -------------------------------------------------
+
+
+def _rs(name, priority, state="queued", slices=1, seq=0, placed_seq=0):
+    return RunState(name=name, priority=priority, slices=slices,
+                    state=state, seq=seq, placed_seq=placed_seq)
+
+
+def test_placement_priority_order_and_fifo_tiebreak():
+    plan = plan_placement(
+        [_rs("s", SCAVENGER, seq=1), _rs("b2", BATCH, seq=3),
+         _rs("i", INTERACTIVE, seq=4), _rs("b1", BATCH, seq=2)],
+        n_slices=4, max_concurrent=4)
+    assert plan.place == ("i", "b1", "b2", "s")
+    assert plan.preempt == () and plan.blocked == ()
+
+
+def test_placement_no_backfill_behind_blocked_head():
+    # the 3-slice batch head blocks; the 1-slice scavenger behind it must
+    # NOT be backfilled around it (starvation guard)
+    plan = plan_placement(
+        [_rs("big", BATCH, slices=3, seq=1), _rs("small", SCAVENGER, seq=2),
+         _rs("running", BATCH, state="placed", slices=2, seq=0)],
+        n_slices=4, max_concurrent=4)
+    assert plan.place == ()
+    assert plan.blocked == ("big", "small")
+
+
+def test_placement_preempts_most_recent_scavenger_for_higher_class():
+    plan = plan_placement(
+        [_rs("old", SCAVENGER, state="placed", seq=1, placed_seq=10),
+         _rs("new", SCAVENGER, state="placed", seq=2, placed_seq=20),
+         _rs("i", INTERACTIVE, seq=3)],
+        n_slices=2, max_concurrent=2)
+    # one slice needed -> exactly one victim, the most recently placed
+    assert plan.preempt == ("new",)
+    assert plan.place == () and plan.blocked == ("i",)
+
+
+def test_placement_never_preempts_for_scavenger_and_not_twice():
+    # a scavenger head never creates victims; a PREEMPTING victim is
+    # already draining and must not be signaled again
+    plan = plan_placement(
+        [_rs("a", SCAVENGER, state="placed", placed_seq=1),
+         _rs("b", SCAVENGER, seq=2)],
+        n_slices=1, max_concurrent=1)
+    assert plan.preempt == () and plan.blocked == ("b",)
+    plan2 = plan_placement(
+        [_rs("a", SCAVENGER, state="preempting", placed_seq=1),
+         _rs("i", INTERACTIVE, seq=2)],
+        n_slices=1, max_concurrent=1)
+    assert plan2.preempt == ()  # victim already on its way out
+    assert plan2.blocked == ("i",)
+
+
+def test_placement_concurrency_cap_preempts_scavenger_for_slot():
+    # capacity fits but the one-jax-process cap is taken by a scavenger:
+    # the interactive head still drains it
+    plan = plan_placement(
+        [_rs("s", SCAVENGER, state="placed", placed_seq=5),
+         _rs("i", INTERACTIVE, seq=6)],
+        n_slices=8, max_concurrent=1)
+    assert plan.preempt == ("s",)
+
+
+# -- queue mechanics ----------------------------------------------------------
+
+
+def test_queue_enqueue_validation_and_idempotence(tmp_path):
+    sched = _sched(tmp_path, n_slices=2)
+    with pytest.raises(ValueError, match="unknown priority"):
+        sched.enqueue("a", priority="urgent", kind="command",
+                      argv=["true"], done_path=tmp_path / "x")
+    with pytest.raises(ValueError, match="could never place"):
+        sched.enqueue("a", slices=3, kind="command", argv=["true"],
+                      done_path=tmp_path / "x")
+    with pytest.raises(ValueError, match="argv and done_path"):
+        sched.enqueue("a", kind="command")
+    with pytest.raises(ValueError, match="config dict"):
+        sched.enqueue("a", kind="flat")
+    with pytest.raises(ValueError, match="only \\[A-Za-z0-9"):
+        sched.enqueue("bad/name", kind="command", argv=["true"],
+                      done_path=tmp_path / "x")
+    assert sched.enqueue("a", kind="command", argv=["true"],
+                         done_path=tmp_path / "x")
+    assert not sched.enqueue("a", kind="command", argv=["other"],
+                             done_path=tmp_path / "y")  # idempotent
+    st = sched.queue.replay()
+    assert st.runs["a"].state == "queued"
+    assert st.specs["a"]["argv"] == ["true"]  # first spec wins
+
+
+def test_queue_replay_folds_full_lifecycle(tmp_path):
+    q = FleetQueue(tmp_path / QUEUE_NAME)
+    q.enqueue("r", {"kind": "command", "argv": ["true"],
+                    "done_path": "d", "priority": SCAVENGER}, n_slices=1)
+    q.append("run.place", "r", attempt=1)
+    q.append("run.preempt", "r")
+    assert q.replay().runs["r"].state == "preempting"
+    q.append("run.release", "r", outcome="preempted")
+    assert q.replay().runs["r"].state == "queued"
+    q.append("run.place", "r", attempt=2)
+    q.append("run.release", "r", outcome="done")
+    run = q.replay().runs["r"]
+    assert run.state == "done" and run.attempts == 2
+    # operator breadcrumbs and unknown runs never corrupt the fold
+    q.append("scheduler.start")
+    q.append("run.release", "ghost", outcome="done")
+    assert q.replay().summary() == {"r": "done"}
+
+
+# -- scheduler over cheap children --------------------------------------------
+
+
+def test_fleet_runs_two_tenants_serially_one_slice(tmp_path):
+    sched = _sched(tmp_path, n_slices=1)
+    a_out, b_out = tmp_path / "a.out", tmp_path / "b.out"
+    _touch_run(sched, "a", a_out)
+    _touch_run(sched, "b", b_out)
+    assert sched.run() == {"a": "done", "b": "done"}
+    assert a_out.read_text() == "done-a" and b_out.read_text() == "done-b"
+    # per-run worker leases cleaned, per-run dirs journaled
+    assert not worker_lease_path(sched.fleet_dir, "a").exists()
+    assert (sched.fleet_dir / "runs" / "a" / "journal.jsonl").exists()
+    events = _events(sched)
+    assert events.index(("run.place", "a")) < events.index(
+        ("run.release", "a")) < events.index(("run.place", "b"))
+
+
+def test_crashed_worker_requeued_then_run_completes(tmp_path):
+    """Run-level retry rides the QUEUE (durable), not worker memory: the
+    first worker attempt dies (its command child fails fast, worker
+    max_attempts=1), the scheduler re-queues off the release record, the
+    second placement succeeds."""
+    sched = _sched(tmp_path, n_slices=1, max_run_attempts=2)
+    out, marker = tmp_path / "flaky.out", tmp_path / "flaky.once"
+    body = (f"import pathlib, sys; m = pathlib.Path({str(marker)!r})\n"
+            f"if not m.exists(): m.write_text('x'); sys.exit(1)\n"
+            f"pathlib.Path({str(out)!r}).write_text('recovered')")
+    sched.enqueue("flaky", kind="command",
+                  argv=[sys.executable, "-c", body], done_path=out,
+                  max_attempts=1)
+    assert sched.run() == {"flaky": "done"}
+    assert out.read_text() == "recovered"
+    st = sched.queue.replay()
+    assert st.runs["flaky"].attempts == 2
+    outcomes = [r["detail"]["outcome"]
+                for r in sched.queue.journal.records()
+                if r["event"] == "run.release"]
+    assert outcomes == ["requeued", "done"]
+
+
+def test_crashed_worker_exhausts_attempt_budget_typed_failed(tmp_path):
+    sched = _sched(tmp_path, n_slices=1, max_run_attempts=2)
+    sched.enqueue("doomed", kind="command",
+                  argv=[sys.executable, "-c", "raise SystemExit(9)"],
+                  done_path=tmp_path / "never.out", max_attempts=1)
+    assert sched.run() == {"doomed": "failed"}
+
+
+def test_halted_worker_marked_halted_not_retried(tmp_path):
+    """A worker exiting WORKER_EXIT_HALTED (the guardian containment
+    code) is terminal 'halted' — the slice frees, nothing is retried.
+    The real guardian chain is exercised in the two-tenant drill; this
+    pins the scheduler-side classification."""
+    sched = _sched(tmp_path, n_slices=1)
+    sched.enqueue(
+        "sick", kind="command",
+        argv=[sys.executable, "-c",
+              f"raise SystemExit({WORKER_EXIT_HALTED})"],
+        done_path=tmp_path / "never.out", max_attempts=1)
+    after = tmp_path / "after.out"
+    _touch_run(sched, "healthy", after)
+    assert sched.run() == {"healthy": "done", "sick": "halted"}
+    assert after.read_text() == "done-healthy"
+    places = [e for e in _events(sched) if e == ("run.place", "sick")]
+    assert len(places) == 1  # halted is never re-placed
+
+
+def _run_fleet_in_thread(sched):
+    result = {}
+    thread = threading.Thread(
+        target=lambda: result.update(sched.run()), daemon=True)
+    thread.start()
+    return thread, result
+
+
+def _wait_state(queue, name, state, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        run = queue.replay().runs.get(name)
+        if run is not None and run.state == state:
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"{name} never reached {state!r}")
+
+
+def test_interactive_preempts_running_scavenger_at_checkpoint(tmp_path):
+    """The live preemption path: a running scavenger is SIGTERMed when an
+    interactive run arrives, checkpoints (graceful exit 75), the
+    interactive run places, and the scavenger resumes from its
+    checkpoint afterwards — nothing lost, everything in the queue."""
+    sched = _sched(tmp_path, n_slices=1)
+    scav_out, ckpt = tmp_path / "scav.out", tmp_path / "scav.ckpt"
+    inter_out = tmp_path / "inter.out"
+    started = tmp_path / "scav.started"
+    scav_body = f"""
+import signal, sys, time, pathlib
+ckpt = pathlib.Path({str(ckpt)!r}); out = pathlib.Path({str(scav_out)!r})
+flag = []
+signal.signal(signal.SIGTERM, lambda *a: flag.append(1))
+if ckpt.exists():
+    out.write_text("resumed"); sys.exit(0)
+pathlib.Path({str(started)!r}).write_text("up")
+for _ in range(1200):
+    time.sleep(0.05)
+    if flag:
+        ckpt.write_text("ckpt"); sys.exit(75)
+out.write_text("never-preempted"); sys.exit(0)
+"""
+    sched.enqueue("scav", priority=SCAVENGER, kind="command",
+                  argv=[sys.executable, "-c", scav_body],
+                  done_path=scav_out)
+    thread, result = _run_fleet_in_thread(sched)
+    queue = FleetQueue(sched.fleet_dir / QUEUE_NAME)
+    _wait_state(queue, "scav", "placed")
+    # wait for the CHILD (not just the worker) to be live: the graceful
+    # checkpoint path is what this test pins — a SIGTERM racing the
+    # worker's interpreter startup degrades to crash-requeue semantics,
+    # which test_crashed_worker_requeued... covers
+    deadline = time.monotonic() + 30.0
+    while not started.exists():
+        assert time.monotonic() < deadline, "scavenger child never started"
+        time.sleep(0.02)
+    _touch_run(sched, "inter", inter_out, content="hi",
+               priority=INTERACTIVE)
+    thread.join(timeout=WALL_S)
+    assert not thread.is_alive()
+    assert result == {"inter": "done", "scav": "done"}
+    assert scav_out.read_text() == "resumed"  # checkpointed + resumed
+    assert inter_out.read_text() == "hi"
+    events = _events(sched)
+    scav_replace = len(events) - 1 - events[::-1].index(
+        ("run.place", "scav"))
+    assert events.index(("run.preempt", "scav")) < events.index(
+        ("run.place", "inter")) < scav_replace
+
+
+def test_preemption_does_not_burn_the_crash_retry_budget(tmp_path):
+    """Placements consumed by preemption or reclaim are scheduling
+    events, not failures: the crash budget counts 'requeued' releases
+    only (code-review regression). A run preempted once and crashed once
+    still has its retry and completes."""
+    q = FleetQueue(tmp_path / QUEUE_NAME)
+    q.enqueue("r", {"kind": "command", "argv": ["true"],
+                    "done_path": "d"}, n_slices=1)
+    q.append("run.place", "r")
+    q.append("run.release", "r", outcome="preempted")
+    q.append("run.place", "r")
+    q.append("run.release", "r", outcome="reclaimed")
+    q.append("run.place", "r")
+    run = q.replay().runs["r"]
+    assert run.attempts == 3 and run.requeues == 0
+    sched = _sched(tmp_path, max_run_attempts=2)
+    sched.queue = q
+    assert sched._classify_exit(1, run) == "requeued"  # first real crash
+    q.append("run.release", "r", outcome="requeued")
+    run = q.replay().runs["r"]
+    assert run.requeues == 1
+    assert sched._classify_exit(1, run) == "failed"  # budget of 2 spent
+
+
+def test_abnormal_scheduler_exit_kills_workers_and_releases(tmp_path):
+    """A scheduler that exits ABNORMALLY while workers run (max_wall_s
+    here; ^C or a queue I/O error in production) must not strand live
+    worker groups — this process survives, so no future takeover would
+    reclaim them (code-review regression). The finally SIGKILLs the
+    groups and releases the placements, keeping the queue accurate."""
+    sched = _sched(tmp_path, n_slices=1, max_wall_s=1.0)
+    pid_file = tmp_path / "sleeper.pid"
+    body = (f"import os, time, pathlib; "
+            f"pathlib.Path({str(pid_file)!r}).write_text(str(os.getpid())); "
+            f"time.sleep(600)")
+    sched.enqueue("sleeper", kind="command",
+                  argv=[sys.executable, "-c", body],
+                  done_path=tmp_path / "never.out")
+    with pytest.raises(TimeoutError, match="did not drain"):
+        sched.run()
+    assert not sched._workers
+    # the step child is dead, not orphaned
+    deadline = time.monotonic() + 15.0
+    child_pid = int(pid_file.read_text())
+    while time.monotonic() < deadline:
+        try:
+            os.kill(child_pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.05)
+    else:
+        os.kill(child_pid, 9)
+        raise AssertionError("step child survived scheduler shutdown")
+    st = sched.queue.replay()
+    assert st.runs["sleeper"].state == "queued"  # released, resumable
+    outcomes = [r["detail"]["outcome"]
+                for r in sched.queue.journal.records()
+                if r["event"] == "run.release"]
+    assert outcomes == ["reclaimed"]
+
+
+# -- contention + takeover (satellite 3) --------------------------------------
+
+
+def test_second_scheduler_on_same_fleet_dir_refused(tmp_path):
+    sched = _sched(tmp_path, n_slices=1)
+    out = tmp_path / "a.out"
+    _touch_run(sched, "a", out)
+    # scheduler 1 holds a LIVE heartbeating lease (same-pid fresh beat)
+    seed_lease(sched.lease_path, pid=os.getpid(), step="fleet")
+    rival = _sched(tmp_path, n_slices=1)
+    with pytest.raises(ConcurrentSupervisorError, match="live heartbeating"):
+        rival.run()
+    assert not out.exists()  # refused before placing anything
+
+
+def test_dead_scheduler_takeover_resumes_without_double_running(tmp_path):
+    """The dead-scheduler story: a SIGKILLed scheduler left (a) its own
+    dead lease, (b) a run.place record whose worker is gone. A fresh
+    scheduler takes over, reclaims the orphan placement, and finishes
+    every run — with the append-marker proving no run's work executed
+    twice."""
+    sched = _sched(tmp_path, n_slices=1)
+    a_log, b_log = tmp_path / "a.log", tmp_path / "b.log"
+    a_out, b_out = tmp_path / "a.out", tmp_path / "b.out"
+    for name, log, out in (("a", a_log, a_out), ("b", b_log, b_out)):
+        body = (f"open({str(log)!r}, 'a').write('ran\\n'); "
+                f"open({str(out)!r}, 'w').write('done')")
+        sched.enqueue(name, kind="command",
+                      argv=[sys.executable, "-c", body], done_path=out)
+    # simulate the dead scheduler's debris
+    dead_pid = 2 ** 22 + 4242
+    sched.queue.append("run.place", "a", attempt=1)
+    seed_lease(worker_lease_path(sched.fleet_dir, "a"), pid=dead_pid,
+               step="run-a")
+    seed_lease(sched.lease_path, pid=dead_pid, step="fleet")
+
+    fresh = _sched(tmp_path, n_slices=1)
+    assert fresh.run() == {"a": "done", "b": "done"}
+    records = fresh.queue.journal.records()
+    assert any(r["event"] == "scheduler.takeover" for r in records)
+    reclaims = [r for r in records if r["event"] == "run.release"
+                and r["detail"]["outcome"] == "reclaimed"]
+    assert [r["step"] for r in reclaims] == ["a"]
+    # the work itself ran exactly once per run — no double-placement
+    assert a_log.read_text() == "ran\n" and b_log.read_text() == "ran\n"
+    # and no instant had two concurrent placements of one run: every
+    # place record is separated from the next by a release
+    for name in ("a", "b"):
+        seq = [r["event"] for r in records if r.get("step") == name
+               and r["event"] in ("run.place", "run.release")]
+        for first, second in zip(seq, seq[1:]):
+            assert (first, second) != ("run.place", "run.place")
+
+
+# -- the two-tenant containment drill (ROADMAP item 5 done bar) ---------------
+
+
+def _tenant_config(base: Path, poisoned: bool) -> dict:
+    cfg = {
+        "harvest": {"mode": "synthetic",
+                    "dataset_folder": str(base / "chunks"),
+                    "activation_dim": 16, "n_ground_truth_features": 24,
+                    "feature_num_nonzero": 5, "feature_prob_decay": 0.99,
+                    "dataset_size": 2048, "n_chunks": 4, "batch_rows": 512,
+                    "seed": 0},
+        "sweep": {"experiment": "dense_l1_range",
+                  "ensemble": {"output_folder": str(base / "sweep"),
+                               "dataset_folder": str(base / "chunks"),
+                               "batch_size": 128, "n_chunks": 4,
+                               "learned_dict_ratio": 2.0, "tied_ae": True,
+                               "checkpoint_every_chunks": 1, "seed": 0},
+                  "log_every": 1000},
+        "eval": {"output_folder": str(base / "eval"), "n_eval_rows": 512,
+                 "seed": 0},
+    }
+    if poisoned:
+        # budget 1: chunk-0 poison rolls back once, chunk-1 poison then
+        # exhausts the ladder -> typed DivergenceHaltError (§16)
+        cfg["sweep"]["ensemble"]["guardian_rollback_budget"] = 1
+    return cfg
+
+
+def _artifact_digests(base: Path) -> dict[str, str]:
+    out = {}
+    for pattern in ("chunks/*.npy", "chunks/meta.json",
+                    "sweep/final/*.pkl", "sweep/ckpt/*",
+                    "sweep/ckpt_prev/*", "eval/eval.json"):
+        for p in sorted(base.glob(pattern)):
+            if p.is_file():
+                out[str(p.relative_to(base))] = hashlib.sha256(
+                    p.read_bytes()).hexdigest()
+    return out
+
+
+@pytest.mark.faults
+def test_two_tenant_drill_halt_contained_warm_start_zero_misses(tmp_path):
+    """ROADMAP item 5's done bar, end to end on the real steps:
+
+    - tenant A's data is poisoned (every batch NaN via the
+      ``sweep.anomaly`` drill riding ONLY A's env): guardian rollback →
+      ladder exhausted → typed halt, confined to A's run dir; the
+      scheduler marks A ``halted`` and re-packs the slice;
+    - tenant B's identical-shape sweep then completes: artifacts
+      BITWISE-identical to a standalone (fleet-free, cache-free) run,
+      and its executable-store misses are ZERO — tenant A's warm-start
+      precompile populated the fleet's ONE shared xcache before the
+      first poisoned batch ever reached training;
+    - each tenant gets its own merged obs.report: A's shows the guardian
+      halt, B's shows a clean sweep + the store hits.
+    """
+    from sparse_coding_tpu.obs.report import build_report
+    from sparse_coding_tpu.pipeline.steps import (
+        run_eval,
+        run_harvest,
+        run_sweep,
+    )
+
+    # standalone golden for tenant B (no fleet, no cache)
+    golden_base = tmp_path / "golden"
+    golden_cfg = _tenant_config(golden_base, poisoned=False)
+    run_harvest(golden_cfg)
+    run_sweep(golden_cfg)
+    run_eval(golden_cfg)
+    want = _artifact_digests(golden_base)
+    assert any(k.startswith("sweep/final") for k in want)
+
+    sched = _sched(tmp_path, n_slices=1, max_run_attempts=1)
+    a_base = sched.fleet_dir / "runs" / "tenant-a" / "data"
+    b_base = sched.fleet_dir / "runs" / "tenant-b" / "data"
+    sched.enqueue("tenant-a", _tenant_config(a_base, poisoned=True),
+                  env={"SPARSE_CODING_FAULT_PLAN":
+                       "sweep.anomaly:nth=1,count=0,mode=nan"},
+                  max_attempts=1)
+    sched.enqueue("tenant-b", _tenant_config(b_base, poisoned=False),
+                  max_attempts=2)
+    summary = sched.run()
+    assert summary == {"tenant-a": "halted", "tenant-b": "done"}
+
+    # A's incident is durable and CONTAINED in its own run dir
+    guardian = json.loads((a_base / "sweep" / "guardian.json").read_text())
+    assert "halt" in guardian and guardian["halt"]["diagnosis"] == \
+        "poisoned-data"
+    assert (a_base / "chunks" / "quarantine.json").exists()
+    assert not (b_base / "sweep" / "guardian.json").exists() or \
+        "halt" not in json.loads(
+            (b_base / "sweep" / "guardian.json").read_text())
+
+    # B's artifacts are bitwise the standalone run's
+    got = _artifact_digests(b_base)
+    assert set(got) == set(want), set(got) ^ set(want)
+    diff = [k for k in want if got[k] != want[k]]
+    assert not diff, f"tenant B artifacts differ from standalone: {diff}"
+
+    # B warm-started from the cache A populated: zero store misses
+    report_b = build_report(sched.fleet_dir / "runs" / "tenant-b")
+    assert report_b["compile_cache"]["store_misses"] == 0
+    assert report_b["compile_cache"]["store_hits"] >= 1
+    assert report_b["compile_cache"]["store_errors"] == 0
+    assert report_b["spans"]["sweep.warmstart"]["count"] >= 1
+    assert report_b["guardian"]["halts"] == 0
+
+    # per-tenant merged reports: A's tells the whole incident story
+    report_a = build_report(sched.fleet_dir / "runs" / "tenant-a")
+    assert report_a["guardian"]["halts"] == 1
+    assert report_a["guardian"]["rollbacks"] >= 1
+    assert report_a["compile_cache"]["store_misses"] >= 1  # A compiled
+    assert report_a["run_ids"] and report_b["run_ids"]
+    assert report_a["run_ids"] != report_b["run_ids"]
+
+    # ONE fleet report merges the whole incident per tenant (§18)
+    from sparse_coding_tpu.obs.report import (
+        build_fleet_report,
+        format_fleet_report,
+        is_fleet_dir,
+    )
+
+    assert is_fleet_dir(sched.fleet_dir)
+    fleet = build_fleet_report(sched.fleet_dir)
+    assert fleet["states"] == {"tenant-a": "halted", "tenant-b": "done"}
+    assert fleet["tenants"]["tenant-a"]["report"]["guardian"]["halts"] == 1
+    assert fleet["tenants"]["tenant-b"]["report"]["compile_cache"][
+        "store_misses"] == 0
+    assert fleet["scheduler"]["placements"] >= 2
+    assert fleet["scheduler"]["halts"] >= 1
+    rendered = format_fleet_report(fleet)
+    assert "tenant-a: halted" in rendered and "tenant-b: done" in rendered

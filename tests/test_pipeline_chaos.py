@@ -641,3 +641,92 @@ def test_trace_capture_kill_restart_bitwise(tmp_path, monkeypatch, golden):
     assert (sweep_dir / "trace").exists()
     assert not [p for p in sweep_dir.iterdir()
                 if p.name.startswith(".trace.tmp.")]
+
+
+# -- fleet scheduler chaos (ISSUE 14) -----------------------------------------
+
+
+def test_fleet_place_kill_scheduler_restart_no_loss_no_double_place(
+        tmp_path, monkeypatch):
+    """ISSUE 14 chaos case: SIGKILL a REAL scheduler process exactly at
+    the ``fleet.place`` crash barrier — the ``run.place`` queue record is
+    durable, the worker was never spawned. A restarted scheduler replays
+    the queue (bitwise: the fold is pure over the journal bytes the dead
+    scheduler left), reclaims the orphan placement, and finishes every
+    run — no run lost, none double-placed, artifacts byte-identical to
+    an uninterrupted fleet."""
+    import subprocess
+    import sys
+
+    from sparse_coding_tpu.pipeline import FleetQueue, FleetScheduler
+    from sparse_coding_tpu.pipeline.supervisor import REPO_ROOT
+
+    def enqueue_pair(fleet_dir, out_dir):
+        sched = FleetScheduler(fleet_dir, n_slices=1)
+        for name in ("a", "b"):
+            out = out_dir / f"{name}.out"
+            sched.enqueue(name, kind="command",
+                          argv=[sys.executable, "-c",
+                                f"open({str(out)!r}, 'w')"
+                                f".write('fleet-{name}')"],
+                          done_path=out)
+        return sched
+
+    def schedule_subprocess(fleet_dir, extra_env):
+        return subprocess.run(
+            [sys.executable, "-m", "sparse_coding_tpu.pipeline.fleet",
+             "schedule", "--fleet-dir", str(fleet_dir),
+             "--poll-s", "0.05", "--max-wall-s", "120"],
+            cwd=str(REPO_ROOT), env={**os.environ, **extra_env},
+            capture_output=True, text=True, timeout=180)
+
+    # golden: an uninterrupted fleet over the same pair
+    gold_dir, gold_out = tmp_path / "gold_fleet", tmp_path / "gold_out"
+    gold_out.mkdir()
+    enqueue_pair(gold_dir, gold_out)
+    gold = schedule_subprocess(gold_dir, {})
+    assert gold.returncode == 0, gold.stdout + gold.stderr
+    want_state = FleetQueue(gold_dir / "fleet_queue.jsonl").replay()
+    assert want_state.summary() == {"a": "done", "b": "done"}
+
+    # run 1: the scheduler dies BY SIGKILL between the durable place
+    # record and the spawn
+    fleet_dir, out_dir = tmp_path / "fleet", tmp_path / "out"
+    out_dir.mkdir()
+    enqueue_pair(fleet_dir, out_dir)
+    killed = schedule_subprocess(
+        fleet_dir, {"SPARSE_CODING_CRASH_PLAN": "fleet.place:nth=1"})
+    assert killed.returncode == -9, killed.stdout + killed.stderr
+    queue = FleetQueue(fleet_dir / "fleet_queue.jsonl")
+    st = queue.replay()
+    assert st.runs["a"].state == "placed"  # the record IS durable
+    assert not (out_dir / "a.out").exists()  # the worker never spawned
+
+    # run 2: a fresh scheduler, no plan — takeover + reclaim + finish
+    done = schedule_subprocess(fleet_dir, {})
+    assert done.returncode == 0, done.stdout + done.stderr
+    st2 = queue.replay()
+    assert st2.summary() == want_state.summary()  # no run lost
+    assert (out_dir / "a.out").read_text() == "fleet-a"
+    assert (out_dir / "b.out").read_text() == "fleet-b"
+    records = queue.journal.records()
+    assert any(r["event"] == "scheduler.takeover" for r in records)
+    reclaims = [r["step"] for r in records if r["event"] == "run.release"
+                and r["detail"]["outcome"] == "reclaimed"]
+    assert reclaims == ["a"]
+    # never double-placed: per run, every place is separated from the
+    # next by a release (no instant had two live placements)
+    for name in ("a", "b"):
+        seq = [r["event"] for r in records if r.get("step") == name
+               and r["event"] in ("run.place", "run.release")]
+        assert seq[0] == "run.place" and seq[-1] == "run.release"
+        for first, second in zip(seq, seq[1:]):
+            assert (first, second) != ("run.place", "run.place")
+    # the orphaned placement cost exactly one extra place record
+    places = {n: sum(1 for r in records if r["event"] == "run.place"
+                     and r["step"] == n) for n in ("a", "b")}
+    assert places == {"a": 2, "b": 1}
+    # replay is pure: folding the journal bytes again gives the same
+    # state a restarted scheduler acted on
+    assert FleetQueue(fleet_dir / "fleet_queue.jsonl").replay().summary() \
+        == st2.summary()

@@ -1134,7 +1134,11 @@ def test_ingest_decode_nan_injection_quarantined_positionally(tmp_path):
     """``ingest.decode`` corrupt-mode matrix entry (mode=nan): an injected
     non-finite payload on a stream decode fails the finite gate, the
     chunk quarantines through the durable ledger, and delivery stays
-    positional — neighbors arrive bit-identical to the serial reader."""
+    positional — neighbors arrive bit-identical to the serial reader.
+    WHICH chunk eats the 2nd hit depends on the 2-stream thread
+    interleave (usually chunk 1, sometimes a worker races ahead to chunk
+    2), so the assertions are victim-agnostic: exactly one positional
+    hole, everyone else bitwise, the ledger naming exactly the hole."""
     from sparse_coding_tpu.data.ingest import chunk_stream
     from sparse_coding_tpu.data.ledger import load_quarantine
 
@@ -1145,11 +1149,12 @@ def test_ingest_decode_nan_injection_quarantined_positionally(tmp_path):
     with inject(site="ingest.decode", nth=2, mode="nan") as plan:
         got = list(chunk_stream(store, range(4), streams=2))
     assert plan.fired_count("ingest.decode") == 1
-    assert [c is None for c in got] == [False, True, False, False]
-    for a, b in zip([got[0], got[2], got[3]],
-                    [serial[0], serial[2], serial[3]]):
-        np.testing.assert_array_equal(a, b)
-    assert set(load_quarantine(folder)) == {1}
+    holes = [i for i, c in enumerate(got) if c is None]
+    assert len(holes) == 1, holes
+    for i, chunk in enumerate(got):
+        if i != holes[0]:
+            np.testing.assert_array_equal(chunk, serial[i])
+    assert set(load_quarantine(folder)) == set(holes)
 
 
 def _drill_build(dim=16, l1s=(1e-3, 2e-3, 4e-3)):
@@ -1494,3 +1499,88 @@ def test_ledger_append_fault_drops_row_counted(tmp_path, monkeypatch):
     assert perf_ledger.append_row({"kind": "bench", "n": 3}) is True
     assert obs.counter("obs.ledger.dropped").value == before + 1
     assert [r["n"] for r in perf_ledger.read_rows()] == [1, 3]
+
+
+# -- fleet scheduler fault matrix (ISSUE 14, docs/ARCHITECTURE.md §18) --------
+
+
+def test_fleet_enqueue_fault_propagates_queue_untouched_retry_identical(
+        tmp_path):
+    """``fleet.enqueue`` matrix entry: the injected failure fires BEFORE
+    the durable append, so the caller sees the (typed, injected) error,
+    the queue file is untouched, and a blind retry produces a queue
+    byte-identical to one that never failed (fixed clock — the records
+    carry timestamps)."""
+    from sparse_coding_tpu.pipeline import FleetScheduler
+
+    clock = lambda: 1234.5  # noqa: E731
+
+    def fleet(d):
+        return FleetScheduler(tmp_path / d, n_slices=1, clock=clock)
+
+    spec = dict(kind="command", argv=["true"],
+                done_path=str(tmp_path / "x"))
+    sched = fleet("fleet")
+    with inject(site="fleet.enqueue", nth=1, error="OSError") as plan:
+        with pytest.raises(OSError) as err:
+            sched.enqueue("a", **spec)
+        assert isinstance(err.value, InjectedFault)
+    assert plan.fired_count("fleet.enqueue") == 1
+    assert not sched.queue.path.exists()  # nothing durable happened
+    assert sched.enqueue("a", **spec)  # the retry
+    golden = fleet("golden")
+    assert golden.enqueue("a", **spec)
+    assert sched.queue.path.read_bytes() == golden.queue.path.read_bytes()
+
+
+def test_fleet_place_fault_counted_run_stays_queued_then_places(tmp_path):
+    """``fleet.place`` matrix entry: an injected placement failure is
+    counted (``fleet.place_errors``), leaves the run QUEUED with no
+    ``run.place`` record, and the next scheduler tick places it — the
+    finished queue shows exactly ONE placement."""
+    import sys as _sys
+
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.pipeline import FleetScheduler
+
+    sched = FleetScheduler(tmp_path / "fleet", n_slices=1, poll_s=0.05,
+                           max_wall_s=60)
+    out = tmp_path / "a.out"
+    sched.enqueue("a", kind="command",
+                  argv=[_sys.executable, "-c",
+                        f"open({str(out)!r}, 'w').write('ok')"],
+                  done_path=out)
+    before = obs.counter("fleet.place_errors").value
+    with inject(site="fleet.place", nth=1, error="OSError") as plan:
+        assert sched.run() == {"a": "done"}
+    assert plan.fired_count("fleet.place") == 1
+    assert obs.counter("fleet.place_errors").value == before + 1
+    assert out.read_text() == "ok"
+    places = [r for r in sched.queue.journal.records()
+              if r["event"] == "run.place"]
+    assert len(places) == 1  # the faulted attempt never went durable
+
+
+def test_fleet_preempt_fault_counted_victim_untouched_then_retried(
+        tmp_path):
+    """``fleet.preempt`` matrix entry: an injected preemption failure is
+    counted (``fleet.preempt_errors``) and appends NO ``run.preempt``
+    record — the victim keeps running untouched; the cleared plan's
+    retry goes durable. (The full preempt→checkpoint→resume behavior is
+    tests/test_fleet.py's live drill.)"""
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.pipeline import FleetScheduler
+
+    sched = FleetScheduler(tmp_path / "fleet", n_slices=1)
+    sched.enqueue("scav", kind="command", priority="scavenger",
+                  argv=["true"], done_path=tmp_path / "x")
+    before = obs.counter("fleet.preempt_errors").value
+    with inject(site="fleet.preempt", nth=1, error="OSError") as plan:
+        sched._preempt("scav")
+    assert plan.fired_count("fleet.preempt") == 1
+    assert obs.counter("fleet.preempt_errors").value == before + 1
+    events = [r["event"] for r in sched.queue.journal.records()]
+    assert "run.preempt" not in events
+    sched._preempt("scav")  # the retry (next scheduler tick re-plans)
+    events = [r["event"] for r in sched.queue.journal.records()]
+    assert events.count("run.preempt") == 1
