@@ -114,7 +114,8 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
                    scan_chunk=None, batch_dtype=None,
                    batch_tile=None, fused_compute_dtype=None,
                    sig="tied_sae", fused_path=None,
-                   fused_moments_dtype=None, feat_tile=None) -> WindowedRate:
+                   fused_moments_dtype=None, feat_tile=None,
+                   sharded=False) -> WindowedRate:
     """Shared ensemble-throughput measurement (bench_suite.py and tune.py
     reuse it with their own scales; batch_tile forces the fused kernel's
     batch tile, None = auto-pick; feat_tile pins the feature-axis-TILED
@@ -123,9 +124,13 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
     kernel's dots on the MXU bf16 path — matmul_precision does not reach
     Pallas dots; sig="sae" times the untied FunctionalSAE family instead;
     fused_path forces the kernel choice: "two_stage" | "train_step" |
-    "two_stage_tiled" | "train_step_tiled". The returned rate carries the
-    RESOLVED path as ``.fused_path`` so ratio sweeps can record which
-    program actually ran."""
+    "two_stage_tiled" | "train_step_tiled"; sharded=True composes the
+    step over a ("model", "data") mesh spanning every visible device
+    (ISSUE 15: the whole-step paths run grads kernel → psum("data") →
+    fused epilogue under shard_map — on a 1-chip tunnel the mesh is 1x1
+    and the A/B isolates the shard_map wrapper cost). The returned rate
+    carries the RESOLVED path as ``.fused_path`` so ratio sweeps can
+    record which program actually ran."""
     import contextlib
 
     from sparse_coding_tpu import obs
@@ -152,7 +157,13 @@ def _time_ensemble(use_fused, matmul_precision=None, d_act=None, n_dict=None,
         l1s = jnp.logspace(-4, -2, n_members)
         members = [sig_cls.init(k, d_act, n_dict, l1_alpha=float(l1))
                    for k, l1 in zip(keys, l1s)]
-        ens = Ensemble(members, sig_cls, lr=1e-3, use_fused=use_fused,
+        mesh = None
+        if sharded:
+            from sparse_coding_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(1)  # every visible device on the data axis
+        ens = Ensemble(members, sig_cls, lr=1e-3, mesh=mesh,
+                       use_fused=use_fused,
                        fused_batch_tile=batch_tile,
                        fused_feat_tile=feat_tile,
                        fused_compute_dtype=fused_compute_dtype or "float32",
@@ -371,7 +382,7 @@ def _load_tuned_variant(path: str | None = None) -> dict | None:
     best = data.get("best") or {}
     keys = ("use_fused", "matmul_precision", "batch_dtype", "scan_chunk",
             "batch_tile", "feat_tile", "fused_compute_dtype", "fused_path",
-            "fused_moments_dtype")
+            "fused_moments_dtype", "sharded")
     variant = {k: v for k, v in best.items() if k in keys and v is not None}
     if variant.get("scan_chunk") == SCAN_CHUNK:
         del variant["scan_chunk"]  # default — keep the variant dedupable
@@ -557,6 +568,15 @@ def main() -> None:
         # 10-step window): their ratio is pool-state- and dispatch-invariant
         variants = [{"use_fused": True, "fused_path": "two_stage"},
                     {"use_fused": True, "fused_path": "train_step"},
+                    # the ISSUE 15 mesh A/B: whole-step vs two-stage
+                    # COMPOSED over the mesh (grads kernel → psum("data")
+                    # → fused epilogue) — on the 1-chip tunnel this
+                    # isolates the shard_map wrapper cost; on a pod it is
+                    # the two-stage-penalty-gone acceptance measurement
+                    {"use_fused": True, "fused_path": "train_step",
+                     "sharded": True},
+                    {"use_fused": True, "fused_path": "two_stage",
+                     "sharded": True},
                     # the feature-axis-tiled pair (r11): at the canonical
                     # ratio-4 shape these are the A/B against the untiled
                     # kernels; at ratio 16+ they are the ONLY fused paths

@@ -57,7 +57,11 @@ def _emit(suite: str, value: float, unit: str, **extra) -> None:
     # backend on every record so unattended captures can tell a real TPU
     # profile from a CPU run (scripts/on_tunnel_return.sh only assembles
     # BENCH_SUITE_TPU.json from backend:"tpu" records)
-    record = {"suite": suite, "value": round(value, 1), "unit": unit,
+    # ratios live near 1.0 where one decimal would erase the effect being
+    # measured (the mesh_scale penalty A/B is a ~9% signal); rates keep
+    # the compact one-decimal form
+    digits = 4 if unit == "ratio" else 1
+    record = {"suite": suite, "value": round(value, digits), "unit": unit,
               "backend": jax.default_backend(), **extra}
     print(json.dumps(record), flush=True)
     from sparse_coding_tpu.obs import ledger as perf_ledger
@@ -779,6 +783,102 @@ def bench_fleet_soak(quick: bool) -> None:
         shutil.rmtree(root / "fleet", ignore_errors=True)
 
 
+def bench_mesh_scale(quick: bool) -> None:
+    """ISSUE 15 scenario: whole-step vs two-stage fused A/B at 1 device
+    and on the ("model", "data") mesh spanning every visible device —
+    the two-stage-multi-chip-penalty-gone acceptance measurement. Off
+    TPU the kernels run interpret-mode on the
+    --xla_force_host_platform_device_count CPU mesh and every row is
+    labeled ``cpu-fallback`` (ranking evidence, not wall-clock). Each
+    config's device-time samples ride a DeviceStepProbe with the mesh
+    shape folded into the path label, so the per-mesh-shape MFU and the
+    RESOLVED kernel path are read back through obs.report — the emitted
+    rows carry what the report computed, not a side channel."""
+    import dataclasses
+    import tempfile
+
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.ensemble import Ensemble
+    from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+    from sparse_coding_tpu.obs.report import build_report
+    from sparse_coding_tpu.parallel.mesh import make_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    backend_label = jax.default_backend() if on_tpu else "cpu-fallback"
+    d, n_dict, n_members = (32, 64, 4) if quick else (64, 256, 8)
+    steps = 4 if quick else 20
+    n_dev = len(jax.devices())
+    meshes = [("1x1", make_mesh(1, 1))]
+    if n_dev >= 8:
+        meshes.append(("2x4", make_mesh(2, 4)))
+    elif n_dev > 1:
+        meshes.append((f"1x{n_dev}", make_mesh(1)))
+
+    run_dir = Path(tempfile.mkdtemp(prefix="mesh_scale_"))
+    prev_reg = obs.set_registry(obs.Registry())
+    prev_sink = obs.configure_sink(
+        obs.EventSink(run_dir / "obs" / "events.jsonl"))
+    results = []
+    try:
+        for mesh_label, mesh in meshes:
+            batch = 64 * int(mesh.shape["data"]) * 2
+            x = jax.random.normal(jax.random.PRNGKey(1), (batch, d))
+            for path in ("two_stage", "train_step"):
+                members = [
+                    FunctionalTiedSAE.init(k, d, n_dict, l1_alpha=1e-3)
+                    for k in jax.random.split(jax.random.PRNGKey(0),
+                                              n_members)]
+                ens = Ensemble(members, FunctionalTiedSAE, mesh=mesh,
+                               donate=False, use_fused=True,
+                               fused_interpret=not on_tpu,
+                               fused_path=path)
+                probe = obs.DeviceStepProbe("train", every=1, warmup=0)
+
+                def one(e=ens, xb=x):
+                    return e.step_batch(xb)
+
+                one()
+                jax.block_until_ready(ens.state.params)
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    cost = ens.step_cost(batch)
+                    # mesh shape folded into the label so the report's
+                    # mfu gauges separate per (path, mesh)
+                    cost = dataclasses.replace(
+                        cost, path=f"{cost.path}@{mesh_label}")
+                    probe.measure(one, cost=cost,
+                                  block_before=ens.state.params)
+                rate = steps * batch / (time.perf_counter() - t0)
+                results.append((mesh_label, path, ens.fused_path, rate))
+        obs.flush_metrics()
+        mfu = build_report(run_dir).get("perf", {}).get("mfu", {})
+        for mesh_label, path, resolved, rate in results:
+            key = next((k for k in mfu
+                        if f"path={resolved}@{mesh_label}" in k), None)
+            _emit("mesh_scale", rate, "activations/s",
+                  variant=f"{path}@{mesh_label}", resolved_path=resolved,
+                  mesh=mesh_label, backend=backend_label,
+                  mfu=round(mfu[key], 4) if key is not None else None,
+                  **({} if on_tpu
+                     else {"note": "interpret-mode kernels on the CPU "
+                                   "mesh — ranking evidence only"}))
+        # the acceptance ratio on the WIDEST mesh: auto mode must have
+        # resolved the whole-step path, and it must not lose to two-stage
+        by_key = {(m, p): r for m, p, _, r in results}
+        widest = meshes[-1][0]
+        ws, ts = by_key[(widest, "train_step")], by_key[(widest,
+                                                        "two_stage")]
+        _emit("mesh_scale", ws / ts, "ratio",
+              variant=f"wholestep_over_twostage@{widest}",
+              backend=backend_label)
+    finally:
+        obs.configure_sink(prev_sink)
+        obs.set_registry(prev_reg)
+        import shutil
+
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+
 def bench_seq_parallel(quick: bool) -> None:
     # The pre-r4 version of this suite hung indefinitely behind the axon
     # tunnel (eager shard_map); the jitted _sp_program fixed it, but a
@@ -841,7 +941,7 @@ def main() -> None:
                   bench_harvest,
                   bench_chunk_io, bench_ingest_soak, bench_streaming_eval,
                   bench_guardian_soak, bench_perf_probe, bench_gateway,
-                  bench_fleet_soak, bench_seq_parallel):
+                  bench_fleet_soak, bench_mesh_scale, bench_seq_parallel):
         try:
             suite(args.quick)
         except Exception as e:

@@ -34,6 +34,7 @@ from sparse_coding_tpu.analysis import coverage as _coverage  # noqa: F401
 from sparse_coding_tpu.analysis import hazards as _hazards  # noqa: F401
 from sparse_coding_tpu.analysis import legacy as _legacy  # noqa: F401
 from sparse_coding_tpu.analysis import nondet as _nondet  # noqa: F401
+from sparse_coding_tpu.analysis import sharding as _sharding  # noqa: F401
 from sparse_coding_tpu.analysis.core import _REGISTRY, STALE_HATCH_RULE
 
 

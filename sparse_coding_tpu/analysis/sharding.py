@@ -1,0 +1,87 @@
+"""Bare-sharding pass: placement decisions belong to the partition layer.
+
+The partition rule layer (``parallel/partition.py``, docs/ARCHITECTURE.md
+§19) is the single home of "which leaf lives where" on the ("model",
+"data") mesh: named rule sets resolve pytrees to PartitionSpecs, named
+spec constants (``partition.MEMBER``/``BATCH``/...) are the vocabulary
+for shard_map signatures, and every mesh device_put funnels through the
+``partition.place`` fault site. A raw ``NamedSharding(...)`` or
+``PartitionSpec(...)`` construction in train/serve/data/pipeline code
+(or the ensemble engine) is how two call sites drift about one leaf's
+placement — invisible until a resharding collective shows up in a
+profile — so this pass makes the convention mechanical: construct specs
+only inside ``parallel/``; everywhere else, reference the partition
+layer. Escape hatch: ``# lint: allow-bare-sharding <why>`` for the rare
+placement genuinely outside the layer's vocabulary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from sparse_coding_tpu.analysis.core import (
+    FileCtx,
+    Match,
+    Pass,
+    RepoCtx,
+    dotted_name,
+    register,
+)
+from sparse_coding_tpu.analysis.legacy import _pkg_rel
+
+SHARDING_CTORS = ("NamedSharding", "PartitionSpec", "PositionalSharding")
+SHARDING_MODULES = ("jax.sharding", "jax.experimental.pjit")
+
+
+def _ctor_aliases(tree: ast.AST) -> set[str]:
+    """Local names bound to the sharding constructors, import aliases
+    included (``from jax.sharding import PartitionSpec as P`` binds P)."""
+    names: set[str] = set(SHARDING_CTORS)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                node.module.startswith(SHARDING_MODULES):
+            for alias in node.names:
+                if alias.name in SHARDING_CTORS:
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+@register
+class BareShardingPass(Pass):
+    rule = "bare-sharding"
+    description = ("raw NamedSharding/PartitionSpec construction in "
+                   "train/serve/data/pipeline code or the ensemble engine "
+                   "— placement goes through the partition rule layer "
+                   "(parallel/partition.py, docs/ARCHITECTURE.md §19): "
+                   "named rule sets + spec constants, one place to drift")
+
+    LINTED_DIRS = ("train/", "serve/", "data/", "pipeline/")
+    LINTED_FILES = ("ensemble.py",)
+
+    def run(self, ctx: FileCtx, repo: RepoCtx) -> Iterable[Match]:
+        rel = _pkg_rel(ctx)
+        in_scope = (rel.startswith(self.LINTED_DIRS)
+                    or rel in self.LINTED_FILES)
+        ctors = _ctor_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if not dn:
+                continue
+            tail = dn.rsplit(".", 1)[-1]
+            bare = dn in ctors
+            dotted = "." in dn and tail in SHARDING_CTORS
+            if not (bare or dotted):
+                continue
+            yield Match(
+                self.rule, ctx.rel, node.lineno,
+                node.end_lineno or node.lineno,
+                f"raw {tail}(...) constructed outside parallel/ — resolve "
+                "placement through the partition rule layer "
+                "(parallel/partition.py: match_partition_rules / "
+                "place_tree / the named spec constants), or excuse a "
+                "placement outside its vocabulary with "
+                "'# lint: allow-bare-sharding <why>'",
+                in_scope=in_scope)

@@ -15,7 +15,13 @@ huge_batch_size.py's gloo DDP):
   axis (each shard owns N/mesh_model members — the moral equivalent of one
   reference worker process, with zero host code);
 - the activation batch sharded over "data"; per-member grads/losses are
-  reduced over "data" by XLA-inserted collectives riding ICI.
+  reduced over "data" by XLA-inserted collectives riding ICI;
+- placement resolves through the partition rule layer
+  (parallel/partition.py, docs/ARCHITECTURE.md §19), and since r15 the
+  WHOLE-STEP fused paths run on the mesh too: grads kernel →
+  psum("data") → fused Adam/VJP epilogue kernel
+  (make_fullfused_step_sharded), so auto mode keeps whole-step on
+  meshes and the two-stage multi-chip penalty is gone by construction.
 
 Members whose loss has *static* hyperparameters that change compiled shapes
 (e.g. TopK's k) are bucketed into sub-ensembles — the analogue of the
@@ -32,7 +38,7 @@ import flax.struct as struct
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from sparse_coding_tpu.models.signatures import AuxData
 from sparse_coding_tpu.utils.trees import stack_trees, tree_index
@@ -52,6 +58,7 @@ _safe_increment = getattr(optax, "safe_increment",
 from sparse_coding_tpu.ops.roofline import KERNEL_PATHS  # noqa: E402
 
 
+from sparse_coding_tpu.parallel import partition  # noqa: E402
 from sparse_coding_tpu.parallel.mesh import compat_shard_map as _shard_map  # noqa: E402
 
 _STATIC_TYPES = (int, float, bool, str, type(None))
@@ -359,9 +366,9 @@ def make_fused_step_sharded(
         sharded = _shard_map(
             functools.partial(local_step, total_batch=batch.shape[0]),
             mesh,
-            in_specs=(P("model"), P("model"), P("model"), P("model"),
-                      P("model"), P("data")),
-            out_specs=(P("model"), P("model"), P("model")))
+            in_specs=(partition.MEMBER, partition.MEMBER, partition.MEMBER,
+                      partition.MEMBER, partition.MEMBER, partition.BATCH),
+            out_specs=(partition.MEMBER, partition.MEMBER, partition.MEMBER))
         params, opt_state, aux = sharded(
             state.params, state.buffers, state.opt_state, state.lrs,
             state.live, batch)
@@ -665,6 +672,177 @@ def make_fullfused_tiled_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
+def make_fullfused_step_sharded(
+    family: str,
+    adam_hypers: tuple[float, float, float],
+    mesh: Mesh,
+    tiled: bool = False,
+    batch_tile: Optional[int] = None,
+    feat_tile: Optional[int] = None,
+    donate: bool = True,
+    interpret: bool = False,
+    compute_dtype: str = "float32",
+    sentinel: bool = True,
+) -> Callable[[EnsembleState, Array], tuple[EnsembleState, AuxData]]:
+    """Mesh-composed WHOLE-STEP fused path (ISSUE 15): the sharded twin of
+    make_fullfused_untied_step / make_fullfused_tiled_step, closing the
+    two-stage multi-chip penalty by construction. Under compat_shard_map
+    each device runs the grads kernel (untiled two-stage kernels, or the
+    feature-tiled pair when ``tiled``) on its local batch slice with the
+    GLOBAL batch denominator, ONE psum over "data" yields exact full-batch
+    losses/grads, and then the feature-tiled Adam/normalization-VJP
+    epilogue kernel applies the exact optax update to the member shard —
+    no XLA optimizer pass touches the [N, n, d] tensors. The data-axis
+    psum sits exactly BETWEEN the two kernels, which is why the
+    single-kernel tied train step cannot shard but this factoring can
+    (the untied path was already factored this way; see
+    make_fullfused_untied_step). Sentinel norms stay kernel-folded: the
+    update norm comes out of the epilogue kernel's accumulator (+ the
+    tiny [N, n] bias delta in XLA), and because the post-psum grads are
+    identical on every data shard, the epilogue — and therefore the
+    finite flags and the member-select freeze — agrees across the whole
+    mesh by construction; the guardian's per-member quarantine
+    (train/guardian.py) then needs consensus only across HOSTS, which
+    ``parallel.agree_any`` already provides. Numerically identical to the
+    sharded two-stage path (same grad kernels, same optax formulas;
+    parity locked by tests/test_sharding.py)."""
+    from sparse_coding_tpu.ops.fused_sae import (
+        fused_adam_vjp_update,
+        fused_tied_adam_vjp_update,
+        fused_tied_sae_grads,
+        fused_untied_sae_grads,
+        pick_epilogue_tile,
+        pick_tied_epilogue_tile,
+        prepare_kernel_batch,
+        untied_bias_decay_terms,
+    )
+    from sparse_coding_tpu.ops.fused_sae_tiled import (
+        prepare_tiled_batch,
+        tiled_tied_sae_grads,
+        tiled_untied_sae_grads,
+    )
+
+    if family not in ("tied", "untied"):
+        raise ValueError(
+            f"no sharded whole-step path for family {family!r} (the masked "
+            "family's coef_mask rides the two-stage kernels only)")
+    b1, b2, eps = adam_hypers
+    tied = family == "tied"
+
+    def local_step(params, buffers, opt_state, lrs, live, local_batch,
+                   total_batch):
+        e = params["encoder"]
+        bias = params["encoder_bias"]
+        n_feats, d = e.shape[1], e.shape[2]
+        ftile = (pick_tied_epilogue_tile if tied
+                 else pick_epilogue_tile)(n_feats, d)
+        if ftile is None:
+            raise ValueError(
+                f"no dividing epilogue feature tile for n_feats={n_feats}, "
+                f"d={d}; use the sharded two-stage path")
+        # grads kernel on the local slice, GLOBAL loss denominator
+        if tiled:
+            batch2, bt, ft = prepare_tiled_batch(
+                local_batch, n_feats, d, batch_tile, feat_tile,
+                compute_dtype, n_mats=1 if tied else 2,
+                lane_rule=not interpret)
+            if tied:
+                losses, dw, db, activity, _ = tiled_tied_sae_grads(
+                    e, bias, buffers["l1_alpha"], batch2, batch_tile=bt,
+                    feat_tile=ft, interpret=interpret,
+                    total_batch=total_batch, compute_dtype=compute_dtype)
+            else:
+                losses, de, dwn, db, activity, _ = tiled_untied_sae_grads(
+                    e, params["decoder"], bias, buffers["l1_alpha"], batch2,
+                    batch_tile=bt, feat_tile=ft, interpret=interpret,
+                    total_batch=total_batch, compute_dtype=compute_dtype)
+        else:
+            batch2, bt = prepare_kernel_batch(
+                local_batch, n_feats, d, batch_tile, compute_dtype,
+                n_mats=1 if tied else 2)
+            if tied:
+                losses, dw, db, activity = fused_tied_sae_grads(
+                    e, bias, buffers["l1_alpha"], batch2, batch_tile=bt,
+                    interpret=interpret, total_batch=total_batch,
+                    compute_dtype=compute_dtype)
+            else:
+                losses, de, dwn, db, activity = fused_untied_sae_grads(
+                    e, params["decoder"], bias, buffers["l1_alpha"], batch2,
+                    batch_tile=bt, interpret=interpret,
+                    total_batch=total_batch, compute_dtype=compute_dtype)
+        # THE psum: per-shard partial sums -> exact full-batch losses/grads,
+        # identical on every data shard from here on. The kernel-epilogue
+        # grad_sq (tiled producers) is a per-shard partial and is discarded
+        # — sum-of-squares of partials is not the square of the sum.
+        if tied:
+            losses, dw, db, activity = jax.lax.psum(
+                (losses, dw, db, activity), "data")
+        else:
+            losses, de, dwn, db, activity = jax.lax.psum(
+                (losses, de, dwn, db, activity), "data")
+            # batch-independent terms count once per member, AFTER the psum
+            decay_loss, db = untied_bias_decay_terms(
+                bias, buffers["bias_decay"], db)
+            losses = dict(losses, bias_decay=decay_loss)
+        # fused Adam/normalization-VJP epilogue on the member shard
+        opt = opt_state
+        count_inc = _safe_increment(opt.count)
+        bc1 = 1.0 - b1 ** count_inc
+        bc2 = 1.0 - b2 ** count_inc
+        if tied:
+            e2, mu_e, nu_e, un_sq = fused_tied_adam_vjp_update(
+                e, dw, opt.mu["encoder"], opt.nu["encoder"], lrs, bc1, bc2,
+                ftile=ftile, interpret=interpret, b1=b1, b2=b2, eps=eps)
+            new_params = {"encoder": e2}
+            mu = {"encoder": mu_e}
+            nu = {"encoder": nu_e}
+        else:
+            e2, mu_e, nu_e, d2, mu_d, nu_d, un_sq = fused_adam_vjp_update(
+                e, de, opt.mu["encoder"], opt.nu["encoder"],
+                params["decoder"], dwn, opt.mu["decoder"], opt.nu["decoder"],
+                lrs, bc1, bc2, ftile=ftile, interpret=interpret,
+                b1=b1, b2=b2, eps=eps)
+            new_params = {"encoder": e2, "decoder": d2}
+            mu = {"encoder": mu_e, "decoder": mu_d}
+            nu = {"encoder": nu_e, "decoder": nu_d}
+        bias2, mu_b, nu_b = _bias_adam_update(bias, db, opt, lrs, bc1, bc2,
+                                              b1, b2, eps)
+        new_params["encoder_bias"] = bias2
+        mu["encoder_bias"] = mu_b
+        nu["encoder_bias"] = nu_b
+        new_opt = opt._replace(count=count_inc, mu=mu, nu=nu)
+        aux = _fused_aux(losses, activity)
+        if not sentinel or live is None:
+            return new_params, new_opt, aux
+        # sentinel, kernel-folded (no extra pass over [N, n, d]): update
+        # norm from the epilogue accumulator + the [N, n] bias delta; the
+        # post-psum inputs make every data shard's verdict identical, so
+        # the member-select agrees across the mesh by construction
+        un = jnp.sqrt(un_sq + jnp.sum(jnp.square(bias2 - bias), axis=-1))
+        finite = _sentinel_finite(aux.losses["loss"], un)
+        ok = live & finite
+        return (_select_members(ok, new_params, params),
+                _select_members(ok, new_opt, opt),
+                aux.replace(finite=finite, grad_norm=un))
+
+    def step(state: EnsembleState, batch: Array) -> tuple[EnsembleState, AuxData]:
+        sharded = _shard_map(
+            functools.partial(local_step, total_batch=batch.shape[0]),
+            mesh,
+            in_specs=(partition.MEMBER, partition.MEMBER, partition.MEMBER,
+                      partition.MEMBER, partition.MEMBER, partition.BATCH),
+            out_specs=(partition.MEMBER, partition.MEMBER, partition.MEMBER))
+        params, opt_state, aux = sharded(
+            state.params, state.buffers, state.opt_state, state.lrs,
+            state.live, batch)
+        aux = _stamp_inputs_finite(aux, batch, sentinel)
+        new_state = state.replace(params=params, opt_state=opt_state,
+                                  step=state.step + 1)
+        return new_state, aux
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
 def make_fused_tied_step(optimizer, donate=True, interpret=False,
                          batch_tile=None, compute_dtype="float32",
                          sentinel=True):
@@ -952,7 +1130,10 @@ class Ensemble:
             # the masked family has no train-step kernel — its coef_mask
             # operand is two-stage only); untied = grads kernel + the
             # feature-tiled Adam/VJP epilogue kernel (a single kernel would
-            # exceed VMEM — see make_fullfused_untied_step)
+            # exceed VMEM — see make_fullfused_untied_step). Mesh buckets
+            # get their whole-step programs lazily from _step_for_plan
+            # (make_fullfused_step_sharded: grads kernel → psum("data") →
+            # epilogue kernel — ISSUE 15)
             make_fullfused = None
             if mesh is None:
                 if (make_single is make_fused_tied_step
@@ -978,28 +1159,26 @@ class Ensemble:
         # arg pins it (the bench/tune A/B knob — a perf-regressing
         # default must stay measurable).
         self._forced_fused_path = fused_path
-        if fused_path == "train_step" and self._fullfused_step is None:
+        if fused_path == "train_step" and mesh is None \
+                and self._fullfused_step is None:
             raise ValueError(
-                "fused_path='train_step' requires a single-device bucket "
-                "with the fused path enabled: identity-centered tied_sae "
-                "(one-kernel whole step) or plain sae (grads + fused "
-                "Adam/VJP epilogue); the whole-step path has no sharded "
-                "variant")
+                "fused_path='train_step' requires a bucket with the fused "
+                "path enabled: identity-centered tied_sae (one-kernel whole "
+                "step) or plain sae (grads + fused Adam/VJP epilogue)")
         if fused_path in ("two_stage", "two_stage_tiled") and \
                 self._fused_step is None:
             raise ValueError(
                 f"fused_path={fused_path!r} but no fused kernel is eligible "
                 "for this bucket (see use_fused=True error for the "
                 "conditions)")
-        if fused_path == "train_step_tiled":
-            if mesh is not None:
-                raise ValueError(
-                    "fused_path='train_step_tiled' requires a single-device "
-                    "bucket (the whole-step paths have no sharded variant: "
-                    "the data-axis psum must run between grads and Adam)")
+        if fused_path in ("train_step", "train_step_tiled"):
+            # whole-step paths exist on meshes too (ISSUE 15): the sharded
+            # variant runs grads kernel → psum("data") → Adam/VJP epilogue
+            # kernel, so only the masked family (two-stage-only kernels)
+            # is excluded
             if self._fused_family not in ("tied", "untied"):
                 raise ValueError(
-                    "fused_path='train_step_tiled' requires an eligible "
+                    f"fused_path={fused_path!r} requires an eligible "
                     "identity-centered tied_sae or plain sae bucket (the "
                     "masked family rides the two-stage kernels only)")
         self.fused = self._fused_step is not None
@@ -1058,24 +1237,36 @@ class Ensemble:
                     reason=reason).inc()
 
     def _step_for_plan(self, plan):
-        """The jitted step program for a resolved KernelPlan. Untiled paths
-        reuse the construction-time programs; tiled paths are built per
+        """The jitted step program for a resolved KernelPlan. Untiled
+        single-device paths reuse the construction-time programs; tiled
+        and mesh whole-step programs are built per
         (path, batch_tile, feat_tile) and cached."""
-        if plan.path == "train_step":
+        if plan.path == "train_step" and self.mesh is None:
             return self._fullfused_step
         if plan.path == "two_stage":
             return self._fused_step
         key = (plan.path, plan.batch_tile, plan.feat_tile)
         fn = self._tiled_steps.get(key)
         if fn is None:
-            if plan.path == "two_stage_tiled":
+            if self.mesh is not None and plan.path in ("train_step",
+                                                       "train_step_tiled"):
+                # mesh whole-step (ISSUE 15): grads kernel on the local
+                # slice → psum("data") → fused Adam/VJP epilogue kernel
+                fn = make_fullfused_step_sharded(
+                    self._fused_family, self._adam_hypers, self.mesh,
+                    tiled=plan.path == "train_step_tiled",
+                    batch_tile=plan.batch_tile, feat_tile=plan.feat_tile,
+                    donate=self._donate, interpret=self._fused_interpret,
+                    compute_dtype=self._fused_compute_dtype,
+                    sentinel=self.sentinel)
+            elif plan.path == "two_stage_tiled":
                 fn = make_tiled_step(
                     self._fused_family, self.optimizer, plan.batch_tile,
                     plan.feat_tile, mesh=self.mesh, donate=self._donate,
                     interpret=self._fused_interpret,
                     compute_dtype=self._fused_compute_dtype,
                     sentinel=self.sentinel)
-            else:  # train_step_tiled
+            else:  # train_step_tiled, single device
                 fn = make_fullfused_tiled_step(
                     self._fused_family, self._adam_hypers, plan.batch_tile,
                     plan.feat_tile, donate=self._donate,
@@ -1171,7 +1362,7 @@ class Ensemble:
 
         self._resolve_step(batch.shape[0], kernel_batch_itemsize(batch.dtype))
         if self.mesh is not None:
-            batch = jax.device_put(batch, NamedSharding(self.mesh, P("data")))
+            batch = partition.place_batch(batch, self.mesh)
         self.state, aux = self._step_fn(self.state, batch)
         return aux
 
@@ -1191,8 +1382,7 @@ class Ensemble:
         self._resolve_step(int(batches.shape[1]),
                            kernel_batch_itemsize(batches.dtype))
         if self.mesh is not None:
-            batches = jax.device_put(
-                batches, NamedSharding(self.mesh, P(None, "data")))
+            batches = partition.place_batch(batches, self.mesh, stacked=True)
         if self._scan_fn is None:
             self._scan_fn = self._build_scan_fn()
         self.state, aux = self._scan_fn(self.state, batches)
@@ -1239,9 +1429,9 @@ class Ensemble:
         else:
             fn = self._step_fn
         if self.mesh is not None:
-            part = P(None, "data") if scan else P("data")
             spec = jax.ShapeDtypeStruct(
-                shape, dt, sharding=NamedSharding(self.mesh, part))
+                shape, dt,
+                sharding=partition.batch_sharding(self.mesh, stacked=scan))
         else:
             spec = jax.ShapeDtypeStruct(shape, dt)
         return xcache.cached_compile(
@@ -1395,30 +1585,18 @@ def _resurrect_jit(state: EnsembleState, dead_mask: Array, key: Array,
 
 
 def shard_ensemble_state(state: EnsembleState, mesh: Mesh) -> EnsembleState:
-    """Place a stacked state on a mesh: ensemble axis over "model"
-    (each model-shard owns N/mesh_model members, the analogue of one
-    reference worker process, cluster_runs.py:110-127)."""
+    """Place a stacked state on a mesh through the partition rule layer
+    (parallel/partition.py ENSEMBLE_STATE_RULES, §19): ensemble axis over
+    "model" (each model-shard owns N/mesh_model members, the analogue of
+    one reference worker process, cluster_runs.py:110-127), scalars
+    replicated, one ``partition.place`` fault-sited device_put."""
     n_model = mesh.shape["model"]
     if state.n_members % n_model != 0:
         raise ValueError(
             f"ensemble size {state.n_members} not divisible by mesh model axis "
             f"{n_model}; pad the sweep grid or choose a dividing mesh_model")
-
-    def place(leaf):
-        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
-            return jax.device_put(leaf, NamedSharding(mesh, P()))
-        return jax.device_put(leaf, NamedSharding(mesh, P("model")))
-
-    return EnsembleState(
-        params=jax.tree.map(place, state.params),
-        buffers=jax.tree.map(place, state.buffers),
-        opt_state=jax.tree.map(place, state.opt_state),
-        lrs=place(state.lrs),
-        step=jax.device_put(state.step, NamedSharding(mesh, P())),
-        live=place(state.live) if state.live is not None else None,
-        static_buffers=state.static_buffers,
-        sig_name=state.sig_name,
-    )
+    return partition.place_tree(state, mesh,
+                                partition.ENSEMBLE_STATE_RULES)
 
 
 class EnsembleGroup:

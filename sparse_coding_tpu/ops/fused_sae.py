@@ -418,8 +418,9 @@ def fused_tied_sae_loss_and_grads(params_stacked: dict, alphas: Array,
 #                 NEXT member's tiles.
 # HBM per step: x once, params+moments read+written once. No XLA prologue or
 # epilogue remains. Single-device only: under shard_map the data-axis psum
-# must happen between grads and Adam, so sharded meshes keep the two-stage
-# path (ensemble.make_fused_step_sharded).
+# must happen between grads and Adam, so mesh buckets ride the whole-step
+# FACTORING instead — grads kernel → psum("data") → the fused Adam/VJP
+# epilogue kernels below (ensemble.make_fullfused_step_sharded, ISSUE 15).
 
 
 def _train_working_set(batch_tile: int, n_feats: int, d: int,

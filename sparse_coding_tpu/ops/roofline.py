@@ -58,6 +58,14 @@ tile), ``2·X4 + X`` (residual formation), and
 ``(n/ft)·(X + X4) + 2·P·mats`` (backward: x and r re-streamed per
 feature tile). The 12-vs-10 flops gap is the flash recompute trade.
 
+Sharded (r15): the per-device step is modeled at the per-device batch
+slice with the same table — ICI psum traffic is common to every fused
+path and drops out of the ranking — except the tied ``train_step``,
+which on a mesh is the grads-kernel + Adam/VJP-epilogue FACTORING
+(``ensemble.make_fullfused_step_sharded``; the one-kernel pass cannot
+shard because the data-axis psum must run between grads and Adam), so
+its sharded cost/admission follow the untied epilogue form.
+
 Unit-pinned by tests/test_roofline.py; the admission tile pickers are
 the SAME functions the kernel wrappers call, so a chosen plan can never
 disagree with the kernel's own admission.
@@ -92,16 +100,19 @@ KERNEL_PATHS = ("train_step", "train_step_tiled", "two_stage",
 _PREFERENCE = {p: i for i, p in enumerate(KERNEL_PATHS)}
 
 # which paths exist per bucket family / placement. masked_tied: the
-# coef_mask operand rides the two-stage grads kernels only. sharded:
-# the whole-step paths fold the optimizer update into the kernel, but
-# under shard_map the data-axis psum must run BETWEEN grads and Adam,
-# so meshes keep the two-stage paths.
+# coef_mask operand rides the two-stage grads kernels only. sharded
+# (ISSUE 15): ALL paths — the whole-step variants shard by factoring
+# the step as grads kernel → psum("data") → fused Adam/VJP epilogue
+# kernel (ensemble.make_fullfused_step_sharded), so the data-axis psum
+# runs exactly between the two kernels; only the tied ONE-kernel train
+# step (fused_tied_sae_train_step) is single-device — under sharding
+# the tied family rides the epilogue factoring instead.
 FAMILY_PATHS = {
     "tied": KERNEL_PATHS,
     "untied": KERNEL_PATHS,
     "masked_tied": ("two_stage", "two_stage_tiled"),
 }
-SHARDED_PATHS = ("two_stage", "two_stage_tiled")
+SHARDED_PATHS = KERNEL_PATHS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -167,9 +178,15 @@ def path_cost(path: Optional[str], n_members: int, batch: int, n_feats: int,
               d: int, *, batch_itemsize: int = 4, n_mats: int = 1,
               moments_itemsize: int = 4, batch_tile: Optional[int] = None,
               feat_tile: Optional[int] = None,
-              sentinel: bool = True) -> tuple[float, float]:
+              sentinel: bool = True,
+              sharded: bool = False) -> tuple[float, float]:
     """(hbm_bytes, mxu_flops) for one whole step on this path — the table
-    in the module docstring. ``path=None`` models XLA autodiff."""
+    in the module docstring. ``path=None`` models XLA autodiff.
+    ``sharded`` models the per-device step at the PER-DEVICE batch (ICI
+    psum traffic is common to every fused path and drops out of the
+    ranking); its one structural effect is the tied ``train_step``:
+    sharded it is the grads-kernel + Adam/VJP-epilogue factoring, not
+    the single-device one-kernel pass."""
     p = n_feats * d * 4
     pm = n_feats * d * moments_itemsize
     x = batch * d * batch_itemsize
@@ -187,9 +204,11 @@ def path_cost(path: Optional[str], n_members: int, batch: int, n_feats: int,
         per = x + 2 * p * n_mats + adam + sent
         flops = 5 * mad
     elif path == "train_step":
-        if n_mats == 1:  # tied one-kernel pass + XLA delta-norm sentinel
+        if n_mats == 1 and not sharded:
+            # tied one-kernel pass + XLA delta-norm sentinel
             per = x + 2 * (p + 2 * pm) + (2 * p if sentinel else 0)
-        else:  # untied: grads kernel + fused Adam/VJP epilogue kernel
+        else:  # grads kernel + fused Adam/VJP epilogue kernel (untied
+            # always; tied under sharding — the psum sits between them)
             per = x + 2 * p * n_mats + epilogue
         flops = 5 * mad
     elif path in ("two_stage_tiled", "train_step_tiled"):
@@ -214,12 +233,16 @@ def _admit(path: str, batch: int, n_feats: int, d: int, *,
            batch_itemsize: int, compute_itemsize: int, n_mats: int,
            moments_itemsize: int, batch_tile: Optional[int],
            feat_tile: Optional[int],
-           lane_rule: bool = True) -> Optional[tuple[Optional[int],
-                                                     Optional[int]]]:
+           lane_rule: bool = True,
+           sharded: bool = False) -> Optional[tuple[Optional[int],
+                                                    Optional[int]]]:
     """(batch_tile, feat_tile) admission for one path, or None. Explicit
     tiles must themselves pass (same rule the kernels apply); an explicit
     feat_tile pins resolution to the TILED paths (it has no meaning for
-    the untiled kernels)."""
+    the untiled kernels). ``sharded``: the whole-step paths run the
+    grads-kernel + epilogue-kernel factoring on every family, so the
+    tied train_step admits by the two-stage rule + a dividing epilogue
+    tile instead of the one-kernel working set."""
     if path in ("two_stage", "train_step") and feat_tile is not None:
         return None
     if path == "two_stage":
@@ -231,15 +254,19 @@ def _admit(path: str, batch: int, n_feats: int, d: int, *,
                              compute_itemsize=compute_itemsize, n_mats=n_mats)
         return None if bt is None else (bt, None)
     if path == "train_step":
-        if n_mats == 2:
-            # untied whole-step = the SAME grads kernel as two_stage plus
-            # the feature-tiled Adam/VJP epilogue kernel
+        if n_mats == 2 or sharded:
+            # whole-step = the SAME grads kernel as two_stage plus the
+            # feature-tiled Adam/VJP epilogue kernel (untied always;
+            # both families under sharding, where the data-axis psum
+            # runs between the two kernels)
             pair = _admit("two_stage", batch, n_feats, d,
                           batch_itemsize=batch_itemsize,
                           compute_itemsize=compute_itemsize, n_mats=n_mats,
                           moments_itemsize=moments_itemsize,
                           batch_tile=batch_tile, feat_tile=None)
-            if pair is None or pick_epilogue_tile(n_feats, d) is None:
+            epi = (pick_epilogue_tile(n_feats, d) if n_mats == 2
+                   else pick_tied_epilogue_tile(n_feats, d))
+            if pair is None or epi is None:
                 return None
             return pair
         if batch_tile is not None:
@@ -290,7 +317,7 @@ def candidate_plans(*, n_members: int, batch: int, n_feats: int, d: int,
                       compute_itemsize=compute_itemsize, n_mats=n_mats,
                       moments_itemsize=moments_itemsize,
                       batch_tile=batch_tile, feat_tile=feat_tile,
-                      lane_rule=lane_rule)
+                      lane_rule=lane_rule, sharded=sharded)
         if pair is None:
             continue
         bt, ft = pair
@@ -298,7 +325,7 @@ def candidate_plans(*, n_members: int, batch: int, n_feats: int, d: int,
                                batch_itemsize=batch_itemsize, n_mats=n_mats,
                                moments_itemsize=moments_itemsize,
                                batch_tile=bt, feat_tile=ft,
-                               sentinel=sentinel)
+                               sentinel=sentinel, sharded=sharded)
         out.append(KernelPlan(path=path, batch_tile=bt, feat_tile=ft,
                               hbm_bytes=hbm, mxu_flops=flops,
                               est_s=_est_s(hbm, flops, KERNEL_MXU_EFF),

@@ -83,25 +83,43 @@ def single_device_mesh() -> Mesh:
     return make_mesh(1, 1)
 
 
+# -- placement helpers (thin aliases over the partition rule layer) ----------
+#
+# The SINGLE home of "which leaf lives where" is parallel/partition.py
+# (docs/ARCHITECTURE.md §19); these wrappers survive for the call sites
+# that predate it and DELEGATE so the two modules can never drift.
+# Imports are deferred: partition imports this module at load time.
+
+
 def batch_sharding(mesh: Mesh, stacked: bool = False) -> NamedSharding:
     """Activations [batch, d] — or a [K, batch, d] scan-window stack when
-    stacked=True — sharded over the data axis."""
-    return NamedSharding(mesh, P(None, DATA_AXIS) if stacked else P(DATA_AXIS))
+    stacked=True — sharded over the data axis (= partition.batch_sharding)."""
+    from sparse_coding_tpu.parallel import partition
+
+    return partition.batch_sharding(mesh, stacked=stacked)
 
 
 def ensemble_sharding(mesh: Mesh) -> NamedSharding:
-    """Stacked ensemble leaves [N, ...] sharded over the model axis."""
-    return NamedSharding(mesh, P(MODEL_AXIS))
+    """Stacked ensemble leaves [N, ...] sharded over the model axis
+    (= NamedSharding over partition.MEMBER)."""
+    from sparse_coding_tpu.parallel import partition
+
+    return NamedSharding(mesh, partition.MEMBER)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
+    from sparse_coding_tpu.parallel import partition
+
+    return NamedSharding(mesh, partition.REPLICATED)
 
 
 def feature_sharding(mesh: Mesh) -> NamedSharding:
     """A single giant SAE's [n_feats, d] params sharded over "model" on the
-    feature axis — tensor parallelism for the huge_batch_size.py regime."""
-    return NamedSharding(mesh, P(MODEL_AXIS, None))
+    feature axis — tensor parallelism for the huge_batch_size.py regime
+    (= NamedSharding over partition.FEATURE_ROWS)."""
+    from sparse_coding_tpu.parallel import partition
+
+    return NamedSharding(mesh, partition.FEATURE_ROWS)
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,

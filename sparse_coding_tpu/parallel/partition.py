@@ -1,0 +1,221 @@
+"""Rule-based partition layer (docs/ARCHITECTURE.md §19).
+
+The SINGLE home of "which leaf lives where" for everything that rides
+the ("model", "data") mesh. Before this module every mesh consumer
+hand-built its own ``NamedSharding``/``PartitionSpec`` table (the
+ensemble state placer, big-SAE tensor parallelism, the serving engine),
+which is exactly how placement drifts: two call sites disagree about one
+leaf and the disagreement is invisible until a resharding collective
+shows up in a profile. Now a placement is an ordered **rule set** —
+``(regex, PartitionSpec)`` pairs matched against each leaf's
+``/``-joined tree path, first match wins, scalars never partitioned
+(after the ``match_partition_rules`` idiom, SNIPPETS.md [3]) — and the
+named rule sets below are the only placement vocabulary train/serve/data
+code may use (analysis rule ``bare-sharding``, §17).
+
+The layer is also the placement *seam* for resilience: every device_put
+that moves a tree onto a mesh funnels through :func:`place_tree` and its
+named fault site ``partition.place`` (§10), so placement failure — the
+transfer path to a sick chip — is drillable like any other I/O edge.
+
+Serving restarts key on :func:`sharding_fingerprint`: the mesh axis
+sizes + every leaf's resolved spec, folded into the xcache program key
+and warmup-manifest descriptors so a warm mesh restart loads the
+mesh-sharded executables instead of recompiling (§13, §19).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparse_coding_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from sparse_coding_tpu.resilience.faults import (
+    fault_point,
+    register_fault_site,
+)
+
+register_fault_site("partition.place",
+                    "partition.place_tree — immediately before the "
+                    "device_put that moves a pytree onto the mesh per its "
+                    "resolved partition rules (the mesh placement seam: "
+                    "ensemble state, big-SAE params, serving dict stacks)")
+
+# -- the spec vocabulary ------------------------------------------------------
+#
+# Named specs for mesh-composed program signatures (shard_map in/out
+# specs, ShapeDtypeStruct shardings): train/serve/data code references
+# these instead of constructing PartitionSpec literals (bare-sharding).
+
+MEMBER = P(MODEL_AXIS)            # stacked [N, ...] member/ensemble axis
+BATCH = P(DATA_AXIS)              # activation rows [B, d]
+STACKED_BATCH = P(None, DATA_AXIS)  # [K, B, d] scan-window stacks
+REPLICATED = P()
+FEATURE_ROWS = P(MODEL_AXIS, None)  # [n, d] feature-axis tensor parallel
+FEATURE_COLS = P(None, MODEL_AXIS)  # [d, n] transposed feature sharding
+
+Rules = Sequence[tuple[str, P]]
+
+# -- named rule sets ----------------------------------------------------------
+
+# Stacked ensemble training state (EnsembleState): every leaf carries a
+# leading [N] member axis sharded over "model" (each model-shard owns
+# N/mesh_model members — the moral equivalent of one reference worker
+# process, cluster_runs.py:110-127); scalars (the step counter) replicate
+# via the scalar guard in match_partition_rules.
+ENSEMBLE_STATE_RULES: Rules = ((r".*", MEMBER),)
+
+# Serving dict stacks (serve/registry.py register_stack): the leading
+# stacked-member axis shards over "model", mirroring the training-side
+# member placement so ensemble-trained dicts serve where they trained.
+SERVE_STACK_RULES: Rules = ((r".*", MEMBER),)
+
+# Single-dict serving entries: replicate — every chip holds the (small)
+# dict and the row-sharded batch stays fully data-parallel.
+SERVE_REPLICATED_RULES: Rules = ((r".*", REPLICATED),)
+
+# Big-SAE tensor parallelism (train/big_sae.py, the huge_batch_size.py
+# regime): the feature axis shards over "model" — dict rows, encoder
+# columns, per-feature vectors — and the centering stats replicate.
+BIG_SAE_PARAM_RULES: Rules = (
+    (r"(^|/)dict$", FEATURE_ROWS),
+    (r"(^|/)encoder$", FEATURE_COLS),
+    (r"(^|/)threshold$", MEMBER),
+    (r"(^|/)centering$", REPLICATED),
+)
+
+# Full BigSAEState placement: the param rules (also matching the mirrored
+# Adam moment leaves by name), per-feature activation totals over
+# "model", and a replicated catch-all for the worst-example tracker and
+# optimizer tail.
+BIG_SAE_STATE_RULES: Rules = BIG_SAE_PARAM_RULES + (
+    (r"(^|/)c_totals$", MEMBER),
+    (r".*", REPLICATED),
+)
+
+
+def batch_spec(stacked: bool = False) -> P:
+    """The activation-batch spec: rows over "data" ([B, d], or [K, B, d]
+    scan windows when ``stacked``)."""
+    return STACKED_BATCH if stacked else BATCH
+
+
+def serve_rules(is_stack: bool) -> Rules:
+    """The rule set for one serving registry entry's pytree."""
+    return SERVE_STACK_RULES if is_stack else SERVE_REPLICATED_RULES
+
+
+# -- rule matching ------------------------------------------------------------
+
+
+def _key_str(key: Any) -> str:
+    """One path entry rendered for rule matching: dict keys and attribute
+    names verbatim, sequence/namedtuple positions as digits."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(key, attr):
+            return str(getattr(key, attr))
+    return str(key)
+
+
+def tree_paths(tree: Any) -> list[tuple[str, Any]]:
+    """[(path, leaf)] with '/'-joined paths ("params/encoder",
+    "opt_state/0/mu/encoder") in flatten order."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [("/".join(_key_str(k) for k in path), leaf)
+            for path, leaf in flat]
+
+
+def match_partition_rules(rules: Rules, tree: Any) -> Any:
+    """Pytree of PartitionSpec resolved from an ordered rule set
+    (SNIPPETS.md [3] ``match_partition_rules``): each leaf's '/'-joined
+    path is matched with ``re.search``, first hit wins; 0-d and
+    single-element leaves are never partitioned (P()); a leaf no rule
+    covers is a hard error — placement must be total, never implicit."""
+    import jax
+
+    def spec_for(path: str, leaf: Any) -> P:
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or all(int(s) == 1 for s in shape):
+            return REPLICATED
+        for pattern, spec in rules:
+            if re.search(pattern, path) is not None:
+                return spec
+        raise ValueError(
+            f"no partition rule matches leaf {path!r} (shape {shape}); "
+            "extend the rule set — placement must be total")
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    specs = [spec_for("/".join(_key_str(k) for k in path), leaf)
+             for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def tree_shardings(mesh: Mesh, tree: Any, rules: Rules) -> Any:
+    """Pytree of NamedSharding over ``mesh`` resolved from ``rules``."""
+    import jax
+
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                        match_partition_rules(rules, tree))
+
+
+def place_tree(tree: Any, mesh: Mesh, rules: Rules,
+               site: str = "partition.place") -> Any:
+    """Move a pytree onto the mesh per its resolved rules — THE placement
+    seam (§10 fault site ``partition.place``, hit once per placement).
+    Leaves are placed one device_put at a time, mirroring the pre-rule
+    per-leaf placers this seam replaced — the batched
+    ``device_put(tree, shardings)`` form takes a different multi-process
+    dispatch path, and placement refactors must never change what
+    executes."""
+    import jax
+
+    fault_point(site)
+    shardings = tree_shardings(mesh, tree, rules)
+    return jax.tree.map(lambda leaf, sh: jax.device_put(leaf, sh),
+                        tree, shardings)
+
+
+def place_batch(batch: Any, mesh: Mesh, stacked: bool = False) -> Any:
+    """Row-shard one activation slab (or [K, B, d] window stack) over the
+    data axis."""
+    import jax
+
+    return jax.device_put(batch, NamedSharding(mesh, batch_spec(stacked)))
+
+
+def batch_sharding(mesh: Mesh, stacked: bool = False) -> NamedSharding:
+    """NamedSharding form of :func:`batch_spec` (ShapeDtypeStruct
+    shardings for AOT compiles)."""
+    return NamedSharding(mesh, batch_spec(stacked))
+
+
+def sharding_fingerprint(mesh: Optional[Mesh], tree: Any = None,
+                         rules: Optional[Rules] = None) -> str:
+    """Deterministic string naming one placement: mesh axis sizes plus
+    every leaf's resolved spec. Folded into xcache program keys and
+    warmup-manifest descriptors (§13) so a mesh-sharded executable and
+    its single-device twin never collide, and a warm restart of a mesh
+    pool matches exactly the programs it stored."""
+    if mesh is None:
+        return "unsharded"
+    axes = ",".join(f"{name}={size}" for name, size in mesh.shape.items())
+    if tree is None or rules is None:
+        return f"mesh({axes})"
+    paths = tree_paths(match_partition_rules(rules, tree))
+    leaves = ";".join(f"{path}:{spec}" for path, spec in paths)
+    return f"mesh({axes})|{leaves}"
+
+
+__all__ = [
+    "MEMBER", "BATCH", "STACKED_BATCH", "REPLICATED",
+    "FEATURE_ROWS", "FEATURE_COLS",
+    "ENSEMBLE_STATE_RULES", "SERVE_STACK_RULES", "SERVE_REPLICATED_RULES",
+    "BIG_SAE_PARAM_RULES", "BIG_SAE_STATE_RULES",
+    "batch_spec", "serve_rules", "tree_paths", "match_partition_rules",
+    "tree_shardings", "place_tree", "place_batch", "batch_sharding",
+    "sharding_fingerprint",
+]

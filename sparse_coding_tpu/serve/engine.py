@@ -48,6 +48,7 @@ import numpy as np
 
 from sparse_coding_tpu import obs, xcache
 from sparse_coding_tpu.obs import monotime
+from sparse_coding_tpu.parallel import partition
 from sparse_coding_tpu.resilience.breaker import CircuitBreaker
 from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
 from sparse_coding_tpu.serve.batching import (
@@ -214,9 +215,27 @@ class ServingEngine:
                  retry_backoff_s: float = 0.002,
                  warmup_workers: int | None = None,
                  program_cache: ProgramCache | None = None,
-                 perf_probe_every: int = obs.perf.DEFAULT_PROBE_EVERY):
+                 perf_probe_every: int = obs.perf.DEFAULT_PROBE_EVERY,
+                 mesh=None):
         if not buckets or list(buckets) != sorted(set(buckets)):
             raise ValueError(f"buckets must be unique ascending: {buckets}")
+        # mesh-sharded serving (docs/ARCHITECTURE.md §19, ISSUE 15): with a
+        # ("model", "data") mesh, entry pytrees place once through the
+        # partition rule layer (dict stacks member-sharded over "model",
+        # single dicts replicated), padded inputs row-shard over "data",
+        # and every bucket program compiles WITH those shardings — the
+        # sharding fingerprint is folded into the xcache key and warmup
+        # manifest so a warm mesh restart loads the mesh executables at
+        # zero backend compiles.
+        self._mesh = mesh
+        if mesh is not None:
+            n_data = int(mesh.shape["data"])
+            bad = [b for b in buckets if int(b) % n_data != 0]
+            if bad:
+                raise ValueError(
+                    f"buckets {bad} not divisible by mesh data axis "
+                    f"{n_data}; pick a divisible bucket ladder")
+        self._placed_trees: dict[str, Any] = {}
         self._registry = registry
         self._buckets = tuple(int(b) for b in buckets)
         self._ops = tuple(ops)
@@ -434,6 +453,20 @@ class ServingEngine:
             raise RequestTooLargeError(rows, self._buckets[-1])
         return self._buckets[i]
 
+    def _entry_tree(self, model: str):
+        """The served pytree of one entry: mesh-placed (once, through the
+        partition rule layer — dict stacks member-sharded over "model",
+        single dicts replicated) or the registry tree verbatim."""
+        entry = self._registry.get(model)
+        if self._mesh is None:
+            return entry.tree
+        tree = self._placed_trees.get(model)
+        if tree is None:
+            tree = partition.place_tree(
+                entry.tree, self._mesh, partition.serve_rules(entry.is_stack))
+            self._placed_trees[model] = tree
+        return tree
+
     def _compile(self, entry: RegistryEntry, op: str, bucket: int,
                  model: str):
         fn, spec = build_bucket_program(entry, op, bucket, self._dtype,
@@ -444,13 +477,29 @@ class ServingEngine:
         # cache key — depends only on shapes, and same-shape models share
         # one stored executable per (op, bucket). The manifest descriptor
         # records the program so a restarted process knows the warm set.
+        # On a mesh (§19) the program is lowered WITH the partition-rule
+        # shardings — entry tree per serve_rules, input rows over "data" —
+        # and the sharding fingerprint salts the key so mesh and
+        # single-device twins never collide in one shared cache dir.
+        jit_kwargs: dict[str, Any] = {"donate_argnums": donate}
+        fingerprint = None
+        if self._mesh is not None:
+            rules = partition.serve_rules(entry.is_stack)
+            fingerprint = partition.sharding_fingerprint(
+                self._mesh, entry.tree, rules)
+            jit_kwargs["in_shardings"] = (
+                partition.tree_shardings(self._mesh, entry.tree, rules),
+                partition.batch_sharding(self._mesh))
+        desc = {"kind": "serve", "model": model, "op": op,
+                "bucket": int(bucket), "dtype": str(self._dtype),
+                "stack": bool(entry.is_stack)}
+        if fingerprint is not None:
+            desc["sharding"] = fingerprint
         return xcache.cached_compile(
-            jax.jit(fn, donate_argnums=donate), (entry.tree, spec),
+            jax.jit(fn, **jit_kwargs), (entry.tree, spec),
+            key=fingerprint,
             label=f"serve/{model}/{op}/{bucket}",
-            manifest_desc={"kind": "serve", "model": model, "op": op,
-                           "bucket": int(bucket),
-                           "dtype": str(self._dtype),
-                           "stack": bool(entry.is_stack)})
+            manifest_desc=desc)
 
     def _get_compiled(self, model: str, op: str, bucket: int,
                       count_miss: bool = True):
@@ -501,11 +550,16 @@ class ServingEngine:
         # input-output aliasing, and x may even be the caller's own
         # request array. jnp.array materializes an owned copy; TPU
         # transfers copy by construction, so the hot path stays asarray.
-        if self._donate and jax.default_backend() != "tpu":
+        if self._mesh is not None:
+            # mesh path: row-shard the padded batch over "data";
+            # device_put of host numpy always materializes runtime-owned
+            # buffers, so the donation rule holds by construction
+            dev_x = partition.place_batch(x, self._mesh)
+        elif self._donate and jax.default_backend() != "tpu":
             dev_x = jnp.array(x)
         else:
             dev_x = jnp.asarray(x)
-        out = compiled(self._registry.get(model).tree, dev_x)
+        out = compiled(self._entry_tree(model), dev_x)
         entry = self._registry.get(model)
         rows_axis = 1 if entry.is_stack else 0
         sl = (slice(None),) * rows_axis + (slice(0, rows),)

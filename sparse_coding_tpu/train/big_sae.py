@@ -27,9 +27,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from sparse_coding_tpu.models import learned_dict as ld
+from sparse_coding_tpu.parallel import partition
 
 Array = jax.Array
 
@@ -141,7 +142,7 @@ def make_big_sae_step(optimizer: optax.GradientTransformation,
             # pin the batch to the data axis even if the caller forgot to
             # device_put it — grads then reduce over "data" as documented
             batch = jax.lax.with_sharding_constraint(
-                batch, NamedSharding(mesh, P("data")))
+                batch, partition.batch_sharding(mesh))
         n, d = state.params["dict"].shape
         # the fused kernels see PER-DEVICE shapes under shard_map: features
         # sharded over "model", batch over "data" — which also requires the
@@ -344,41 +345,32 @@ def _sharded_fused_loss_and_grads(params: dict, batch: Array, l1_alpha,
                "l0_mean": l0_sum / total_b}
         return mse + sparsity, aux, grads
 
-    param_specs = {"dict": P("model", None), "encoder": P(None, "model"),
-                   "threshold": P("model"), "centering": P()}
-    aux_specs = {"mse": P(), "sparsity": P(), "c_totals_delta": P("model"),
-                 "mse_losses": P(), "l0_mean": P()}
+    # placement vocabulary from the partition rule layer (§19): the param
+    # spec tree resolves from the SAME rule set shard_big_sae places with,
+    # so program specs and state placement can never drift
+    param_specs = partition.match_partition_rules(
+        partition.BIG_SAE_PARAM_RULES, params)
+    aux_specs = {"mse": partition.REPLICATED, "sparsity": partition.REPLICATED,
+                 "c_totals_delta": partition.MEMBER,
+                 "mse_losses": partition.REPLICATED,
+                 "l0_mean": partition.REPLICATED}
     grad_specs = dict(param_specs)
     fn = compat_shard_map(local_fn, mesh,
-                          in_specs=(param_specs, P(), P("data")),
-                          out_specs=(P(), aux_specs, grad_specs))
+                          in_specs=(param_specs, partition.REPLICATED,
+                                    partition.BATCH),
+                          out_specs=(partition.REPLICATED, aux_specs,
+                                     grad_specs))
     return fn(params, jnp.asarray(l1_alpha, jnp.float32), batch)
 
 
 def shard_big_sae(state: BigSAEState, mesh: Mesh) -> BigSAEState:
-    """Feature-axis tensor parallelism over "model" + replicated small leaves:
-    dict [n, d] → P("model", None); encoder [d, n] → P(None, "model");
-    threshold/c_totals [n] → P("model")."""
-    specs = {"dict": P("model", None), "encoder": P(None, "model"),
-             "threshold": P("model"), "centering": P()}
-
-    def put(tree):
-        return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
-                for k, v in tree.items()}
-
-    def put_opt(opt_state):
-        adam = opt_state[0]
-        adam = adam._replace(mu=put(adam.mu), nu=put(adam.nu))
-        return (adam,) + tuple(jax.device_put(s, NamedSharding(mesh, P()))
-                               for s in opt_state[1:])
-
-    return state.replace(
-        params=put(state.params),
-        opt_state=put_opt(state.opt_state),
-        c_totals=jax.device_put(state.c_totals, NamedSharding(mesh, P("model"))),
-        worst_losses=jax.device_put(state.worst_losses, NamedSharding(mesh, P())),
-        worst_vectors=jax.device_put(state.worst_vectors, NamedSharding(mesh, P())),
-        step=jax.device_put(state.step, NamedSharding(mesh, P())))
+    """Feature-axis tensor parallelism over "model" + replicated small
+    leaves, placed through the partition rule layer
+    (parallel/partition.py BIG_SAE_STATE_RULES, §19): dict [n, d] →
+    ("model", None); encoder [d, n] → (None, "model"); threshold /
+    c_totals and the mirrored Adam moments [n] → ("model"); everything
+    else replicated. One ``partition.place`` fault-sited device_put."""
+    return partition.place_tree(state, mesh, partition.BIG_SAE_STATE_RULES)
 
 
 class BigSAEDict(ld.LearnedDict):
@@ -445,11 +437,12 @@ def train_big_sae(cfg, store=None, mesh: Optional[Mesh] = None,
         window_fn = jax.jit(
             lambda s, stack: jax.lax.scan(step_fn, s, stack),
             donate_argnums=(0,))
-        sharding = (NamedSharding(mesh, P(None, "data"))
+        sharding = (partition.batch_sharding(mesh, stacked=True)
                     if mesh is not None else None)
     else:
         window_fn = None
-        sharding = NamedSharding(mesh, P("data")) if mesh is not None else None
+        sharding = (partition.batch_sharding(mesh)
+                    if mesh is not None else None)
     steps = 0
     last_log = 0
     last_resurrect = 0

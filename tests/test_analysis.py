@@ -293,6 +293,52 @@ def test_beat_coverage_nested_beat_covers_outer_loop(tmp_path):
     assert scratch_findings(pkg, "beat-coverage") == []
 
 
+# -- bare-sharding planted matrix (ISSUE 15) -------------------------------
+
+def test_bare_sharding_catches_raw_constructions(tmp_path):
+    """Raw NamedSharding/PartitionSpec constructions in scoped dirs are
+    findings — import-alias (P) and dotted (jax.sharding.*) forms alike —
+    while partition-layer calls and hatched lines pass."""
+    pkg = _plant(tmp_path, "train/placer.py", """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from sparse_coding_tpu.parallel import partition
+
+        def place(tree, mesh, batch):
+            tree = jax.device_put(tree, NamedSharding(mesh, P("model")))
+            spec = jax.sharding.PartitionSpec(None, "data")
+            odd = jax.device_put(batch, NamedSharding(mesh, P()))  # lint: allow-bare-sharding scratch drill
+            good = partition.place_tree(tree, mesh,
+                                        partition.ENSEMBLE_STATE_RULES)
+            return tree, spec, odd, good
+        """)
+    hits = scratch_findings(pkg, "bare-sharding")
+    # line 8 carries NamedSharding + P (two calls), line 9 the dotted
+    # form; line 10 is excused, the partition-layer call never matches
+    assert len(hits) == 3, hits
+    assert all("placer.py" in h for h in hits)
+    assert sum("placer.py:8" in h for h in hits) == 2
+    assert sum("placer.py:9" in h for h in hits) == 1
+
+
+def test_bare_sharding_engine_and_scope(tmp_path):
+    """ensemble.py is in scope (the training engine drives the mesh);
+    parallel/ — the layer itself — and unscoped dirs are not."""
+    src = """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def shard(tree, mesh):
+            return NamedSharding(mesh, P("model"))
+        """
+    pkg = _plant(tmp_path, "ensemble.py", src)
+    assert len(scratch_findings(pkg, "bare-sharding")) == 2  # ctor + P
+    pkg = _plant(tmp_path / "b", "parallel/rules.py", src)
+    assert scratch_findings(pkg, "bare-sharding") == []
+    pkg = _plant(tmp_path / "c", "utils/free.py", src)
+    assert scratch_findings(pkg, "bare-sharding") == []
+
+
 # -- stale escape hatches planted matrix ----------------------------------
 
 def test_stale_hatches_are_findings(tmp_path):

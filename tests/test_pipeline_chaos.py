@@ -157,6 +157,57 @@ def test_kill_at_barrier_restart_bitwise(tmp_path, monkeypatch, golden,
                     compare if compare is not None else list(_FAMILIES))
 
 
+def _mesh_sweep_config(base: Path) -> dict:
+    """The chaos sweep on a 2x4 mesh riding the SHARDED WHOLE-STEP fused
+    path (ISSUE 15): partition-layer placement, grads kernel →
+    psum("data") → Adam/VJP epilogue under shard_map, interpret mode
+    standing in for Mosaic on CPU. batch 256 → per-device 64 admits the
+    smallest batch tile."""
+    config = _config(base)
+    config["sweep"]["ensemble"].update({
+        "mesh_model": 2, "mesh_data": 4, "batch_size": 256,
+        "use_fused": "on", "fused_interpret": True,
+        "fused_path": "train_step"})
+    return config
+
+
+def test_mesh_sharded_sweep_kill_resume_bitwise(tmp_path, monkeypatch,
+                                                golden):
+    """ISSUE 15 chaos case: a MESH-SHARDED sweep child SIGKILLed at its
+    2nd chunk barrier resumes — fresh supervisor, journal + checkpoints
+    as its only memory — to artifacts bitwise identical to the
+    uninterrupted mesh run's. The kill lands while the ensemble state is
+    sharded across 8 devices; resume re-places the restored checkpoint
+    through the same partition rules."""
+    gbase = tmp_path / "golden_mesh"
+    gbase.mkdir()
+    shutil.copytree(golden["base"] / "chunks", gbase / "chunks")
+    run_sweep(_mesh_sweep_config(gbase))
+    want = _digests(gbase, ["sweep"])
+    assert any(k.startswith("sweep/final") for k in want)
+
+    base = tmp_path / "run_base"
+    base.mkdir()
+    shutil.copytree(golden["base"] / "chunks", base / "chunks")
+    config = _mesh_sweep_config(base)
+    run_dir = base / "run"
+    monkeypatch.setenv(crash_mod.ENV_VAR, "sweep.chunk:nth=2")
+    sup = Supervisor(run_dir,
+                     build_pipeline(run_dir, config, only=["sweep"]),
+                     max_attempts=1, heartbeat_stale_s=STALE_S)
+    with pytest.raises(StepFailed, match="killed by signal 9"):
+        sup.run()
+    monkeypatch.delenv(crash_mod.ENV_VAR)
+    sup2 = Supervisor(run_dir,
+                      build_pipeline(run_dir, config, only=["sweep"]),
+                      max_attempts=2, heartbeat_stale_s=STALE_S)
+    assert sup2.run() == {"sweep": "done"}
+    got = _digests(base, ["sweep"])
+    assert set(got) == set(want), set(got) ^ set(want)
+    diff = [k for k in want if got[k] != want[k]]
+    assert not diff, f"mesh sweep artifacts differ after kill+resume: {diff}"
+
+
 def test_repeated_kills_self_heal_in_one_supervisor(tmp_path, monkeypatch,
                                                     golden):
     """Forward progress under RECURRING kills: hit counting is

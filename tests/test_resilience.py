@@ -667,6 +667,58 @@ def test_xcache_persistent_load_failure_is_bounded(_xcache):
             np.testing.assert_array_equal(np.asarray(out), np.full(8, 9.0))
 
 
+# -- partition.place (mesh placement seam, §19) ------------------------------
+
+
+def test_partition_place_fault_typed_and_recoverable(rng):
+    """``partition.place`` matrix entry (ISSUE 15): an injected failure
+    at the mesh placement seam surfaces TYPED from the ensemble's mesh
+    constructor (shard_ensemble_state → partition.place_tree), leaves no
+    half-placed state behind, and the next placement attempt succeeds
+    and trains — a flaky transfer edge to one chip fails one run
+    attempt, never the process-wide placement machinery."""
+    from sparse_coding_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(2, 4)
+    members = [FunctionalTiedSAE.init(k, 16, 32, l1_alpha=1e-3)
+               for k in jax.random.split(rng, 4)]
+    with inject(site="partition.place", nth=1) as plan:
+        with pytest.raises(OSError) as exc:
+            Ensemble(members, FunctionalTiedSAE, mesh=mesh, donate=False)
+    assert isinstance(exc.value, InjectedFault)
+    assert plan.fired_count("partition.place") == 1
+    ens = Ensemble(members, FunctionalTiedSAE, mesh=mesh, donate=False)
+    aux = ens.step_batch(jax.random.normal(rng, (64, 16)))
+    assert np.isfinite(np.asarray(jax.device_get(
+        aux.losses["loss"]))).all()
+
+
+def test_partition_place_fault_on_serving_placement(rng):
+    """The same seam drilled from the serving side: a mesh engine's
+    first entry placement fails inside the dispatch, where the transient
+    I/O family is RETRIED against the stream budget — the request still
+    succeeds, the retry is counted, and the placed-tree cache never
+    retains a poisoned entry (the fault fires before placement)."""
+    from sparse_coding_tpu.models import TiedSAE
+    from sparse_coding_tpu.parallel.mesh import make_mesh
+    from sparse_coding_tpu.serve import ModelRegistry, ServingEngine
+
+    k1, k2 = jax.random.split(rng)
+    reg = ModelRegistry()
+    reg.register("tied", TiedSAE(
+        dictionary=jax.random.normal(k1, (32, 16)),
+        encoder_bias=0.1 * jax.random.normal(k2, (32,))))
+    mesh = make_mesh(2, 4)
+    with ServingEngine(reg, buckets=(8,), ops=("encode",), mesh=mesh,
+                       max_wait_ms=0.0) as eng:
+        x = np.zeros((2, 16), np.float32)
+        with inject(site="partition.place", nth=1) as plan:
+            out = eng.query("tied", x, timeout=30.0)
+        assert plan.fired_count("partition.place") == 1
+        assert out.shape == (2, 32)
+        assert eng.stats()["dispatch_retries"] >= 1
+
+
 # -- obs.sink.write (observability event sink) -------------------------------
 
 

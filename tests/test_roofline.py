@@ -113,19 +113,51 @@ def test_forced_path_and_family_restrictions():
     plan = roofline.choose_plan(n_members=8, batch=96, n_feats=2048, d=512,
                                 family="tied", forced_path="two_stage")
     assert plan.path is None and plan.reason.startswith("forced_unfit")
-    # whole-step paths never run under shard_map (psum must sit between
-    # grads and Adam) nor for the masked family (coef_mask is a
-    # two-stage-kernel operand)
+    # whole-step paths now exist under shard_map too (ISSUE 15: grads
+    # kernel → psum("data") → fused Adam/VJP epilogue kernel), and their
+    # smaller byte count makes auto mode pick them on meshes — the
+    # two-stage multi-chip penalty is gone by construction
     plan = roofline.choose_plan(**kw, family="tied", sharded=True)
-    assert plan.path == "two_stage"
+    assert plan.path == "train_step"
     plan = roofline.choose_plan(n_members=8, batch=2048, n_feats=8192,
                                 d=512, family="tied", sharded=True)
-    assert plan.path == "two_stage_tiled"
+    assert plan.path == "train_step_tiled"
+    # the masked family stays two-stage everywhere (coef_mask is a
+    # two-stage-kernel operand)
     plan = roofline.choose_plan(**kw, family="masked_tied")
+    assert plan.path == "two_stage"
+    plan = roofline.choose_plan(**kw, family="masked_tied", sharded=True)
     assert plan.path == "two_stage"
     plan = roofline.choose_plan(**kw, family="tied", sharded=True,
                                 forced_path="train_step")
+    assert plan.path == "train_step" and plan.reason == "forced"
+    plan = roofline.choose_plan(**kw, family="masked_tied", sharded=True,
+                                forced_path="train_step")
     assert plan.path is None and "forced_unavailable" in plan.reason
+
+
+def test_sharded_wholestep_beats_two_stage_in_model():
+    """The ISSUE 15 acceptance shape: on a mesh the whole-step plan's
+    modeled bytes (grads kernel + fused epilogue) undercut the sharded
+    two-stage plan's (grads kernel + XLA Adam + sentinel norms), so auto
+    mode resolves whole-step and the ~9% two-stage penalty disappears."""
+    for family, n_feats in (("tied", 2048), ("untied", 2048)):
+        plans = {p.path: p for p in roofline.candidate_plans(
+            n_members=8, batch=2048, n_feats=n_feats, d=512, family=family,
+            sharded=True)}
+        assert "train_step" in plans and "two_stage" in plans
+        assert plans["train_step"].hbm_bytes < plans["two_stage"].hbm_bytes
+        assert plans["train_step"].mxu_flops == plans["two_stage"].mxu_flops
+        best = roofline.choose_plan(n_members=8, batch=2048,
+                                    n_feats=n_feats, d=512, family=family,
+                                    sharded=True)
+        assert best.path == "train_step"
+    # sharded tied train_step is modeled as the epilogue factoring, not
+    # the single-device one-kernel pass
+    single = roofline.path_cost("train_step", 8, 2048, 2048, 512)
+    sharded = roofline.path_cost("train_step", 8, 2048, 2048, 512,
+                                 sharded=True)
+    assert sharded != single
 
 
 def test_explicit_tiles_respected():

@@ -74,6 +74,90 @@ def test_batch_sharding_spec(devices8):
     assert ws.sharding.spec == P("model")
 
 
+def test_sharded_wholestep_matches_single_device(rng, devices8):
+    """ISSUE 15 acceptance: the mesh whole-step fused paths (grads kernel
+    → psum("data") → fused Adam/VJP epilogue kernel) are exact parity
+    with the single-device whole-step paths — tied and untied families,
+    untiled and feature-tiled — under CPU interpret mode."""
+    from sparse_coding_tpu.models.sae import FunctionalSAE
+
+    mesh = make_mesh(2, 4)
+    k_init, k_data = jax.random.split(rng)
+    # per-device slice (batch/4) must admit a >=64 batch tile
+    batch = jax.random.normal(k_data, (256, D))
+
+    cases = [
+        (FunctionalTiedSAE,
+         [FunctionalTiedSAE.init(k, D, N_DICT, l1_alpha=1e-3)
+          for k in jax.random.split(k_init, 4)]),
+        (FunctionalSAE,
+         [FunctionalSAE.init(k, D, N_DICT, l1_alpha=1e-3, bias_decay=0.01)
+          for k in jax.random.split(k_init, 4)]),
+    ]
+    for sig, members in cases:
+        for path in ("train_step", "train_step_tiled"):
+            sharded = Ensemble(members, sig, mesh=mesh, donate=False,
+                               use_fused=True, fused_interpret=True,
+                               fused_path=path)
+            plain = Ensemble(members, sig, donate=False, use_fused=True,
+                             fused_interpret=True, fused_path=path)
+            for _ in range(5):
+                aux_s = sharded.step_batch(batch)
+                aux_p = plain.step_batch(batch)
+            assert sharded.fused_path == path
+            p_s = jax.device_get(sharded.state.params)
+            p_p = jax.device_get(plain.state.params)
+            for name in p_p:
+                np.testing.assert_allclose(
+                    p_s[name], p_p[name], rtol=1e-5, atol=1e-6,
+                    err_msg=f"{sig.signature_name}/{path}/{name}")
+            # the sentinel rode the sharded program: finite flags and the
+            # epilogue-folded update norm came back per member
+            assert jnp.all(aux_s.finite) and jnp.all(aux_s.grad_norm > 0)
+
+
+def test_mesh_auto_mode_resolves_wholestep(rng, devices8):
+    """Roofline auto mode on a mesh resolves a WHOLE-STEP path (the
+    two-stage multi-chip penalty is gone by construction) and counts the
+    resolution."""
+    mesh = make_mesh(2, 4)
+    ens = Ensemble(_members(rng, 4), FunctionalTiedSAE, mesh=mesh,
+                   donate=False, use_fused=True, fused_interpret=True)
+    ens.step_batch(jax.random.normal(rng, (512, D)))
+    assert ens.fused_path in ("train_step", "train_step_tiled")
+    assert ens.fused_plan is not None and ens.fused_plan.reason == "roofline"
+
+
+def test_guardian_quarantine_freezes_member_spanning_chips(rng, devices8):
+    """The PR-10 per-member quarantine keeps working when members span
+    chips on the whole-step path: a frozen member's params and optimizer
+    state pass through the sharded whole-step program bit-identically
+    unchanged while live members keep training."""
+    mesh = make_mesh(2, 4)
+    ens = Ensemble(_members(rng, 4), FunctionalTiedSAE, mesh=mesh,
+                   donate=False, use_fused=True, fused_interpret=True,
+                   fused_path="train_step")
+    batch = jax.random.normal(rng, (256, D))
+    ens.step_batch(batch)
+    frozen = 2  # lives on the second model-shard
+    ens.freeze_members([frozen])
+    before = jax.device_get(ens.state.params)
+    before_mu = jax.device_get(ens.state.opt_state.mu)
+    for _ in range(3):
+        ens.step_batch(batch)
+    after = jax.device_get(ens.state.params)
+    after_mu = jax.device_get(ens.state.opt_state.mu)
+    for name in before:
+        np.testing.assert_array_equal(before[name][frozen],
+                                      after[name][frozen])
+        assert not np.array_equal(before[name][(frozen + 1) % 4],
+                                  after[name][(frozen + 1) % 4])
+    for name in before_mu:
+        np.testing.assert_array_equal(before_mu[name][frozen],
+                                      after_mu[name][frozen])
+    assert list(ens.live_mask()) == [True, True, False, True]
+
+
 def test_sweep_on_mesh(rng, devices8, tmp_path):
     """The full sweep driver on a 2x4 mesh: sharded ensembles + data-sharded
     prefetch, artifacts written, results match the unsharded sweep."""

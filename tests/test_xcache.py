@@ -309,6 +309,104 @@ obs.close_sink()
 """
 
 
+_MESH_WARM_SCRIPT = """
+import json, os, sys
+import numpy as np
+phase, cache_dir = sys.argv[1], sys.argv[2]
+from sparse_coding_tpu import obs, xcache
+obs.configure_sink_from_env(phase)
+obs.install_jax_probes()
+xcache.enable(cache_dir)
+import jax
+import jax.numpy as jnp
+from sparse_coding_tpu.models import TiedSAE
+from sparse_coding_tpu.parallel.mesh import make_mesh
+from sparse_coding_tpu.serve import ModelRegistry, ServingEngine
+
+D, N = 32, 64
+reg = ModelRegistry(audit=False)
+rngs = jax.random.split(jax.random.PRNGKey(0), 4)
+dicts = [TiedSAE(dictionary=jax.random.normal(k, (N, D)),
+                 encoder_bias=jnp.zeros((N,))) for k in rngs]
+reg.register_stack("stack", dicts)
+reg.register("solo", dicts[0])
+mesh = make_mesh(2, 4)
+compiles_before_warmup = obs.counter("jax.compiles").value
+with ServingEngine(reg, buckets=(8, 64), ops=("encode", "decode"),
+                   mesh=mesh, max_wait_ms=0.0) as engine:
+    if phase == "warm":
+        n_programs = engine.warmup_from_manifest()
+    else:
+        n_programs = engine.warmup()
+    compiles_after_warmup = obs.counter("jax.compiles").value
+    out = engine.query("stack", np.ones((5, D), np.float32), timeout=120)
+    snap = engine.stats()
+print(json.dumps({
+    "phase": phase,
+    "programs": n_programs,
+    "recompiles": snap["recompiles"],
+    "compiles_warmed_set": compiles_after_warmup - compiles_before_warmup,
+    "compiles_first_dispatch": obs.counter("jax.compiles").value
+                               - compiles_after_warmup,
+    "xc_hits": obs.counter("xcache.hits").value,
+    "xc_misses": obs.counter("xcache.misses").value,
+    "result_sum": float(np.asarray(out).sum()),
+}))
+obs.flush_metrics()
+obs.close_sink()
+"""
+
+
+def test_mesh_warm_restart_zero_compiles(tmp_path):
+    """ISSUE 15 acceptance: a cold/warm subprocess pair serving a
+    MESH-SHARDED pool (2x4 mesh, member-sharded stack + replicated solo
+    entry via the partition rules) through one cache dir. The warm
+    restart completes warmup from the xcache manifest — whose
+    descriptors carry the sharding fingerprint — with ``jax.compiles ==
+    0`` over the warmed set and zero steady-state recompiles, serving
+    bit-identical results; the merged obs report carries both phases'
+    warmup spans and the store hits."""
+    from sparse_coding_tpu.obs.report import build_report
+
+    run_dir = tmp_path / "run"
+    cache_dir = str(tmp_path / "xc")
+    env = {"SPARSE_CODING_OBS_DIR": str(run_dir / "obs"),
+           "SPARSE_CODING_RUN_ID": "mesh-warm"}
+    cold = _run_script(tmp_path, "mesh_warm.py", _MESH_WARM_SCRIPT,
+                       ["cold", cache_dir],
+                       {**env, "SPARSE_CODING_OBS_STEP": "cold"})
+    warm = _run_script(tmp_path, "mesh_warm.py", _MESH_WARM_SCRIPT,
+                       ["warm", cache_dir],
+                       {**env, "SPARSE_CODING_OBS_STEP": "warm"})
+
+    # 2 models x 2 ops x 2 buckets
+    assert cold["programs"] == warm["programs"] == 8
+    assert cold["xc_misses"] == 8 and cold["xc_hits"] == 0
+    assert cold["compiles_warmed_set"] >= 8
+    # the warm mesh restart: every mesh executable loaded, ZERO compiles
+    # over the warmed set
+    assert warm["xc_hits"] == 8 and warm["xc_misses"] == 0
+    assert warm["compiles_warmed_set"] == 0
+    assert warm["recompiles"] == 0
+    # the first dispatch pays only the eager mesh-placement transfer
+    # programs (entry tree + padded batch device_put) — identical in
+    # both phases, so the serving path itself compiled nothing
+    assert warm["compiles_first_dispatch"] == cold["compiles_first_dispatch"]
+    assert warm["result_sum"] == cold["result_sum"]
+    # the manifest's serve descriptors carry the sharding fingerprint,
+    # so the warm set names the MESH programs, not single-device twins
+    from sparse_coding_tpu import xcache as _xc
+
+    cache = _xc.XCache(cache_dir)
+    descs = cache.warmup.descriptors(kind="serve")
+    assert descs and all(d.get("sharding", "").startswith("mesh(")
+                         for d in descs)
+    report = build_report(run_dir)
+    assert report["compile_cache"]["store_hits"] == 8
+    assert report["spans"]["serve.warmup"]["count"] == 2
+    assert report["run_ids"] == ["mesh-warm"]
+
+
 def test_warm_restart_zero_compiles_and_faster_first_result(tmp_path):
     """ISSUE 5 acceptance, hermetic on CPU: a cold/warm subprocess pair
     sharing one cache dir. The warm process loads every serving program
