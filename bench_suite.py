@@ -17,14 +17,21 @@ device-time perf-probe overhead A/B (ISSUE 12; probe ON at default
 cadence must sit within noise of OFF), and the two-tenant fleet soak
 (ISSUE 14: whole-fleet throughput + tenant B's time-to-first-step
 through the real scheduler, workers cpu-pinned — safe under a wedged or
-busy tunnel). Every scenario row also lands in the durable
-perf_ledger.jsonl, asserted at exit.
+busy tunnel), and the feature-catalog scenario (ISSUE 16: index build
+wall + top-k neighbor query latency through the gateway). Every
+scenario row also lands in the durable perf_ledger.jsonl, asserted at
+exit — then GATED on (ROADMAP 3(b)): each suite row is diffed against
+the last prior ledger row with the same (suite, variant, unit,
+backend), and a threshold-flagged regression exits nonzero
+(SPARSE_CODING_BENCH_GATE=0 disables,
+SPARSE_CODING_BENCH_GATE_THRESHOLD overrides the bar).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -618,10 +625,12 @@ def bench_serving(quick: bool) -> None:
 def bench_gateway(quick: bool) -> None:
     """Mixed-tenant gateway soak (ISSUE 6 / ROADMAP item 2): three
     priority classes from concurrent tenants through a replica pool with
-    hedging live, reporting throughput, p50/p95/p99 request latency read
-    back from a merged ``obs.report`` (the production evidence path, not
-    an ad-hoc timer), sheds by priority, hedge accounting, and the
-    steady-state compile count — which must be 0: after warmup, no
+    hedging live — including a feature-catalog tenant (ISSUE 16) firing
+    interactive top-k ``neighbors`` requests into the SAME pool as the
+    encode tenants — reporting throughput, p50/p95/p99 request latency
+    read back from a merged ``obs.report`` (the production evidence
+    path, not an ad-hoc timer), sheds by priority, hedge accounting, and
+    the steady-state compile count — which must be 0: after warmup, no
     request may ever pay a trace or compile in the latency path."""
     import tempfile
     import threading
@@ -630,6 +639,8 @@ def bench_gateway(quick: bool) -> None:
     from sparse_coding_tpu.models.sae import FunctionalTiedSAE
     from sparse_coding_tpu.obs.report import build_report
     from sparse_coding_tpu.serve import (
+        DEFAULT_OPS,
+        INTERACTIVE,
         PRIORITIES,
         ModelRegistry,
         QueueFullError,
@@ -647,10 +658,18 @@ def bench_gateway(quick: bool) -> None:
     sizes = rng.integers(1, 65, n_threads * per_thread)
     payloads = [np.asarray(rng.standard_normal((int(s), d)), np.float32)
                 for s in sizes]
+    # the catalog tenant's feature-intelligence requests (ISSUE 16):
+    # top-k decoder-row similarity through the same pool, so the soak
+    # exercises mixed encode+neighbors flushes under priority pressure
+    cat_per_thread = per_thread // 2
+    cat_payloads = [np.asarray(rng.standard_normal((int(s), d)), np.float32)
+                    for s in rng.integers(1, 65, cat_per_thread)]
     obs.install_jax_probes()
     with ServingGateway(registry, n_replicas=2, n_spares=1,
                         max_wait_ms=1.0, max_queue_rows=1 << 20,
-                        hedge_min_samples=64) as gw:
+                        hedge_min_samples=64,
+                        ops=tuple(DEFAULT_OPS) + ("neighbors",),
+                        engine_kwargs={"topk_k": 8}) as gw:
         gw.warmup()
         compiles0 = obs.counter("jax.compiles").value
 
@@ -667,8 +686,20 @@ def bench_gateway(quick: bool) -> None:
             for f in futures:
                 f.result(timeout=120)
 
+        def catalog_tenant() -> None:
+            futures = []
+            for p in cat_payloads:
+                try:
+                    futures.append(gw.submit("sae", p, op="neighbors",
+                                             priority=INTERACTIVE))
+                except QueueFullError:
+                    pass
+            for f in futures:
+                f.result(timeout=120)
+
         threads = [threading.Thread(target=submitter, args=(t,))
                    for t in range(n_threads)]
+        threads.append(threading.Thread(target=catalog_tenant))
         t0 = time.perf_counter()
         for th in threads:
             th.start()
@@ -692,8 +723,9 @@ def bench_gateway(quick: bool) -> None:
     total_rows = sum(b["rows"] for b in snap["buckets"].values())
     g = snap["gateway"]
     _emit("gateway_soak", total_rows / dt, "activations/s",
-          n_requests=len(payloads), n_threads=n_threads, d=d,
-          n_replicas=2,
+          n_requests=len(payloads) + len(cat_payloads),
+          catalog_requests=len(cat_payloads), n_threads=n_threads + 1,
+          d=d, n_replicas=2,
           p50_ms=(round(lat["p50"] * 1e3, 3) if lat.get("p50") else None),
           p95_ms=(round(lat["p95"] * 1e3, 3) if lat.get("p95") else None),
           p99_ms=(round(lat["p99"] * 1e3, 3) if lat.get("p99") else None),
@@ -701,6 +733,119 @@ def bench_gateway(quick: bool) -> None:
           hedges_fired=g["hedges_fired"], hedges_won=g["hedges_won"],
           failovers=g["failovers"],
           recompiles=snap["recompiles"], steady_compiles=steady_compiles)
+
+
+def bench_catalog(quick: bool) -> None:
+    """Feature-catalog scenario (ISSUE 16): (a) the index build wall —
+    the jax-free compile of per-feature stats + cross-dict matches over
+    a synthetic sweep artifact and chunk store (catalog/build.py; safe
+    under a wedged tunnel) — and (b) concurrent top-k neighbor queries
+    through the REAL gateway query path (SLO admission, micro-batched
+    AOT ``neighbors`` bucket programs riding the catalog request
+    classes), with p50/p99 request latency read back from a merged
+    ``obs.report`` (the production evidence path). Off TPU both rows
+    are labeled ``cpu-fallback`` — ranking evidence for the on-chip
+    round, not wall-clock truth."""
+    import tempfile
+    import threading
+
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.catalog import (
+        CatalogIndex,
+        CatalogService,
+        build_catalog,
+    )
+    from sparse_coding_tpu.data.chunk_store import ChunkWriter
+    from sparse_coding_tpu.models.sae import FunctionalTiedSAE
+    from sparse_coding_tpu.obs.report import build_report
+    from sparse_coding_tpu.serve import ModelRegistry, ServingGateway
+    from sparse_coding_tpu.utils.artifacts import (
+        load_learned_dicts,
+        save_learned_dicts,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    backend_label = jax.default_backend() if on_tpu else "cpu-fallback"
+    d, ratio, n_dicts = (64, 4, 3) if quick else (128, 8, 4)
+    rows = 40_000 if quick else 200_000
+    n_threads, per_thread = (2, 40) if quick else (4, 150)
+    k = 8 if quick else 16
+    n_feats = d * ratio
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as td:
+        base = Path(td)
+        w = ChunkWriter(base / "chunks", d,
+                        chunk_size_gb=(rows // 4) * d * 2 / 2**30,
+                        dtype="float16")
+        w.add(rng.standard_normal((rows, d), dtype=np.float32)
+              .astype(np.float16))
+        w.finalize()
+        pkl = base / "sweep" / "learned_dicts.pkl"
+        save_learned_dicts(
+            [(FunctionalTiedSAE.to_learned_dict(
+                *FunctionalTiedSAE.init(jax.random.PRNGKey(i), d, n_feats,
+                                        l1_alpha=1e-3)),
+              {"l1_alpha": 1e-3, "seed": i}) for i in range(n_dicts)],
+            pkl)
+        t0 = time.perf_counter()
+        build_catalog(pkl, base / "chunks", base / "cat",
+                      experiment="bench")
+        build_wall = time.perf_counter() - t0
+        _emit("catalog", build_wall, "s", variant="build",
+              backend=backend_label, rows=rows, n_dicts=n_dicts, d=d,
+              n_feats=n_feats,
+              **({} if on_tpu
+                 else {"note": "host-side build on a cpu-fallback run"}))
+
+        index = CatalogIndex.load(base / "cat", verify=True)
+        reg = ModelRegistry()
+        names = reg.load_native(pkl, prefix="cat")
+        reg.register_stack("cat/stack",
+                           [ld for ld, _ in load_learned_dicts(pkl)])
+        feats = rng.integers(0, n_feats, n_threads * per_thread)
+        obs.install_jax_probes()
+        with ServingGateway(reg, n_replicas=1, n_spares=0, buckets=(8,),
+                            ops=("neighbors", "vote"), max_wait_ms=1.0,
+                            engine_kwargs={"topk_k": k}) as gw:
+            gw.warmup()
+            svc = CatalogService(index, gw, models=names,
+                                 stack_model="cat/stack")
+
+            def submitter(tid: int) -> None:
+                for i in range(per_thread):
+                    svc.neighbors(tid % n_dicts,
+                                  int(feats[tid * per_thread + i]))
+
+            threads = [threading.Thread(target=submitter, args=(t,))
+                       for t in range(n_threads)]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            dt = time.perf_counter() - t0
+            # latency quantiles via the production evidence path: flush
+            # the gateway registry into an event file, merge via report
+            with tempfile.TemporaryDirectory() as run_dir:
+                prev = obs.configure_sink(obs.EventSink(
+                    Path(run_dir) / "obs" / "catalog.jsonl"))
+                try:
+                    obs.flush_metrics(registry=gw.metrics.registry)
+                finally:
+                    obs.configure_sink(prev)
+                report = build_report(run_dir)
+            lat = report["histograms"].get("gateway.latency_s", {})
+        n_q = n_threads * per_thread
+        _emit("catalog", n_q / dt, "queries/s", variant="query",
+              backend=backend_label, n_queries=n_q, k=k,
+              n_dicts=n_dicts, d=d, n_feats=n_feats,
+              p50_ms=(round(lat["p50"] * 1e3, 3) if lat.get("p50")
+                      else None),
+              p99_ms=(round(lat["p99"] * 1e3, 3) if lat.get("p99")
+                      else None),
+              **({} if on_tpu
+                 else {"note": "cpu-fallback queries — ranking "
+                               "evidence only"}))
 
 
 def bench_fleet_soak(quick: bool) -> None:
@@ -941,7 +1086,8 @@ def main() -> None:
                   bench_harvest,
                   bench_chunk_io, bench_ingest_soak, bench_streaming_eval,
                   bench_guardian_soak, bench_perf_probe, bench_gateway,
-                  bench_fleet_soak, bench_mesh_scale, bench_seq_parallel):
+                  bench_catalog, bench_fleet_soak, bench_mesh_scale,
+                  bench_seq_parallel):
         try:
             suite(args.quick)
         except Exception as e:
@@ -949,13 +1095,40 @@ def main() -> None:
     # ledger accounting (ISSUE 12): every emitted scenario row must have
     # LANDED in the durable perf ledger — the regression record is only
     # trustworthy if writing it is verified, not assumed
-    landed = len(perf_ledger.read_rows()) - rows_before
+    all_rows = perf_ledger.read_rows()
+    landed = len(all_rows) - rows_before
     print(f"perf ledger: {_LEDGER['emitted']} row(s) emitted, "
           f"{_LEDGER['appended']} appended, {landed} landed at "
           f"{perf_ledger.ledger_path()}", file=sys.stderr)
     assert landed >= _LEDGER["emitted"], (
         f"perf ledger lost rows: emitted {_LEDGER['emitted']}, "
         f"landed {landed}")
+    # regression exit gate (ROADMAP 3(b), ISSUE 16): this run's suite
+    # rows vs the last prior ledger row with the same
+    # (suite, variant, unit, backend) — backend in the key means a
+    # cpu-fallback round never gates against an on-chip round. A flagged
+    # regression exits nonzero so unattended rounds cannot silently rot
+    # the record they are supposed to defend. SPARSE_CODING_BENCH_GATE=0
+    # disables (exploratory runs); the default 25% bar sits above this
+    # serial container's measured host noise (±5-7% per read), override
+    # via SPARSE_CODING_BENCH_GATE_THRESHOLD.
+    from sparse_coding_tpu.obs.report import (
+        diff_ledger_suites,
+        format_ledger_diff,
+    )
+
+    if os.environ.get("SPARSE_CODING_BENCH_GATE", "1").strip().lower() \
+            in ("0", "false", "off"):
+        print("bench gate: disabled (SPARSE_CODING_BENCH_GATE)",
+              file=sys.stderr)
+        return
+    threshold = float(os.environ.get(
+        "SPARSE_CODING_BENCH_GATE_THRESHOLD", "0.25"))
+    diff = diff_ledger_suites(all_rows[:rows_before],
+                              all_rows[rows_before:], threshold=threshold)
+    print(format_ledger_diff(diff), file=sys.stderr)
+    if diff["regressions"]:
+        raise SystemExit(3)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,60 @@
+"""Feature catalog: a queryable, serveable feature-intelligence index
+over sweep artifacts (docs/ARCHITECTURE.md §20).
+
+Two halves:
+
+- :mod:`build` — the **backend-free** catalog build (jax is never
+  imported, like ``data/scrub.py``): streams per-feature activation
+  frequency + mean magnitude from the chunk store through
+  ``data/ingest.chunk_stream``, drops guardian-quarantined
+  (``diverged=True``) members, flags dead features, and compiles
+  cross-dict feature matching (the ``metrics/core.py`` MMCS machinery,
+  mirrored in numpy) into a byte-deterministic on-disk index
+  (``index.json`` + per-dict ``.npy`` arrays, all written through
+  ``resilience/atomic.py``). It rides the supervisor DAG as the
+  ``catalog`` step after ``eval`` (pipeline/steps.py) behind the
+  ``catalog.finalize`` crash barrier.
+- :mod:`query` / :mod:`serve` — the serving half: batched top-k
+  decoder-row similarity and the 2505.16077 union/vote aggregation op
+  compiled as ordinary shape-bucket programs (``xcache.cached_compile``,
+  mesh placement through ``parallel/partition.py``), fronted by
+  :class:`~sparse_coding_tpu.catalog.serve.CatalogService`'s request
+  classes (``feature.neighbors`` / ``feature.stats`` /
+  ``feature.search``) with their own SLO priorities.
+
+Attributes resolve LAZILY (PEP 562, mirroring the package root):
+importing ``sparse_coding_tpu.catalog`` (or :mod:`build`) must stay
+jax-free so the build step is schedulable against a wedged TPU tunnel;
+only :mod:`query` / :mod:`serve` pull jax.
+"""
+
+import importlib
+
+_LAZY_ATTRS = {
+    "CatalogIndex": ("sparse_coding_tpu.catalog.build", "CatalogIndex"),
+    "build_catalog": ("sparse_coding_tpu.catalog.build", "build_catalog"),
+    "load_catalog_records": ("sparse_coding_tpu.catalog.build",
+                             "load_catalog_records"),
+    "neighbor_topk": ("sparse_coding_tpu.catalog.query", "neighbor_topk"),
+    "union_vote": ("sparse_coding_tpu.catalog.query", "union_vote"),
+    "unpack_neighbors": ("sparse_coding_tpu.catalog.query",
+                         "unpack_neighbors"),
+    "CatalogService": ("sparse_coding_tpu.catalog.serve", "CatalogService"),
+    "REQUEST_CLASSES": ("sparse_coding_tpu.catalog.serve",
+                        "REQUEST_CLASSES"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY_ATTRS:
+        module, attr = _LAZY_ATTRS[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(
+        f"module 'sparse_coding_tpu.catalog' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_ATTRS))
+
+
+__all__ = sorted(_LAZY_ATTRS)

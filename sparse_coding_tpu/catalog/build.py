@@ -1,0 +1,391 @@
+"""Catalog build: sweep artifacts -> a byte-deterministic on-disk index.
+
+**Backend-free by design** (CLAUDE.md / docs/ARCHITECTURE.md §20): this
+module never imports jax, so a catalog rebuild is schedulable while the
+TPU tunnel is wedged — exactly like ``data/scrub.py``. Everything a jax
+module would provide is mirrored in numpy against the exact reference
+formulas:
+
+- encode mirrors cite the flax classes they shadow
+  (models/learned_dict.py); parity is asserted in tests/test_catalog.py;
+- the cross-dict matching mirrors ``metrics/core.py:225-255``
+  (``mcs_duplicates`` / ``mmcs`` / ``mmcs_from_list`` — reference
+  standard_metrics.py:270-297), gated by the same parity test.
+
+Determinism contract: records are processed in artifact order, chunks in
+ascending index order (quarantined positions skipped — the quarantine
+set is durable store state, so two builds over the same store agree),
+accumulators are float64 cast once to float32, every array is written
+as a raw ``.npy`` via :func:`resilience.atomic.atomic_save_npy` (never
+npz — zip headers embed timestamps), and ``index.json`` is
+``json.dumps(..., sort_keys=True)``. Two builds from the same artifact
+set + store are byte-identical (tests/test_catalog.py, and the chaos
+matrix proves it across a SIGKILL at ``catalog.finalize``).
+
+Diverged members (``hyperparams["diverged"]=True`` — the training
+guardian's quarantine tag) are dropped before any stats are computed,
+mirroring ``load_learned_dicts(skip_diverged=True)``
+(utils/artifacts.py:70-96) without the jax reconstruction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pickle
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from sparse_coding_tpu import obs
+from sparse_coding_tpu.resilience.atomic import (
+    atomic_save_npy,
+    atomic_write_text,
+)
+from sparse_coding_tpu.resilience.crash import (
+    crash_barrier,
+    register_crash_site,
+)
+from sparse_coding_tpu.resilience.faults import (
+    fault_point,
+    register_fault_site,
+)
+
+register_fault_site("catalog.build",
+                    "catalog build I/O — the artifact-set read and every "
+                    "chunk-stats accumulation step (catalog/build.py)")
+register_crash_site("catalog.finalize",
+                    "catalog build — every per-dict/cross-dict .npy array "
+                    "durable, index.json (the completion marker and "
+                    "serving manifest) not yet written")
+
+INDEX_NAME = "index.json"
+INDEX_VERSION = 1
+_NORM_EPS = 1e-8  # models/learned_dict.py _NORM_EPS
+
+
+class CatalogBuildError(ValueError):
+    """Typed build failure: unsupported dictionary class or empty input."""
+
+
+def _relu(x: np.ndarray) -> np.ndarray:
+    return np.maximum(x, 0.0)
+
+
+def normalize_rows_np(d: np.ndarray) -> np.ndarray:
+    """numpy mirror of models/learned_dict.py:30 ``normalize_rows``:
+    clip (not +eps), so catalog decoder rows equal the served ones."""
+    n = np.linalg.norm(d, axis=-1, keepdims=True)
+    return d / np.clip(n, _NORM_EPS, None)
+
+
+def load_catalog_records(path: str | Path,
+                         skip_diverged: bool = True) -> list[dict]:
+    """Read a ``learned_dicts.pkl`` artifact as raw records without jax
+    reconstruction — the backend-free twin of
+    ``load_learned_dicts(skip_diverged=True)`` (utils/artifacts.py:70-96;
+    same record schema, same diverged filter, no device transfers)."""
+    fault_point("catalog.build")
+    with Path(path).open("rb") as fh:
+        records = pickle.load(fh)
+    if skip_diverged:
+        records = [r for r in records
+                   if not r["hyperparams"].get("diverged")]
+    return records
+
+
+def decoder_rows_np(rec: dict) -> np.ndarray:
+    """Normalized decoder rows [n_feats, d] of one artifact record —
+    numpy mirror of ``get_learned_dict()`` for the dictionary-bearing
+    classes (models/learned_dict.py)."""
+    fields = rec["fields"]
+    for name in ("dictionary", "encoder", "eye", "pm_eye", "rotation"):
+        if name in fields:
+            d = np.asarray(fields[name], dtype=np.float32)
+            # Identity/Rotation classes return their matrix verbatim;
+            # every *SAE/RandomDict normalizes (learned_dict.py)
+            if name in ("eye", "pm_eye", "rotation"):
+                return d
+            if name == "encoder" and "dictionary" in fields:
+                continue  # UntiedSAE: the decoder is `dictionary`
+            return normalize_rows_np(d)
+    raise CatalogBuildError(
+        f"record class {rec['cls']!r} carries no decoder matrix "
+        f"(fields: {sorted(fields)})")
+
+
+def encode_np(rec: dict, x: np.ndarray) -> np.ndarray:
+    """numpy mirror of ``encode`` for the artifact classes the sweep
+    produces. Formulas cite models/learned_dict.py; parity with the flax
+    classes is asserted in tests/test_catalog.py."""
+    cls = rec["cls"]
+    fields = rec["fields"]
+    if cls in ("TiedSAE", "TiedCenteredSAE", "ReverseSAE"):
+        # learned_dict.py:242-243 / :283-284:
+        # relu(x @ normalize_rows(D).T + encoder_bias)
+        dn = normalize_rows_np(np.asarray(fields["dictionary"], np.float32))
+        bias = np.asarray(fields["encoder_bias"], np.float32)
+        return _relu(x @ dn.T + bias)
+    if cls == "UntiedSAE":
+        # learned_dict.py:223-224: relu(x @ encoder.T + encoder_bias)
+        enc = np.asarray(fields["encoder"], np.float32)
+        bias = np.asarray(fields["encoder_bias"], np.float32)
+        return _relu(x @ enc.T + bias)
+    if cls == "RandomDict":
+        # learned_dict.py:151-152
+        dn = normalize_rows_np(np.asarray(fields["dictionary"], np.float32))
+        return _relu(x @ dn.T)
+    if cls == "TopKLearnedDict":
+        # learned_dict.py:302-307: keep top-k scores, relu them into a
+        # scatter (argpartition — ties are measure-zero for real sweeps)
+        dn = normalize_rows_np(np.asarray(fields["dictionary"], np.float32))
+        k = int(rec["static"].get("k", 8))
+        scores = x @ dn.T
+        idx = np.argpartition(scores, -k, axis=1)[:, -k:]
+        out = np.zeros_like(scores)
+        rows = np.arange(scores.shape[0])[:, None]
+        out[rows, idx] = _relu(np.take_along_axis(scores, idx, axis=1))
+        return out
+    raise CatalogBuildError(
+        f"no backend-free encode mirror for class {cls!r}; supported: "
+        "TiedSAE, TiedCenteredSAE, ReverseSAE, UntiedSAE, RandomDict, "
+        "TopKLearnedDict")
+
+
+def mmcs_np(rows_a: np.ndarray, rows_b: np.ndarray) -> float:
+    """numpy mirror of ``metrics/core.py:232`` ``mmcs(a, b)`` =
+    mean over a's atoms of max cosine to any b atom
+    (``mcs_duplicates(ground=b, model=a)``, core.py:225-229; reference
+    standard_metrics.py:270-277). Inputs are already row-normalized."""
+    return float(np.mean(np.max(rows_a @ rows_b.T, axis=-1)))
+
+
+def _sanitize_hyperparams(hyper: dict) -> dict:
+    return {k: v for k, v in sorted(hyper.items())
+            if isinstance(v, (bool, int, float, str))}
+
+
+def _sha256(path: Path) -> str:
+    h = hashlib.sha256()
+    with path.open("rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _dict_tag(i: int) -> str:
+    return f"d{i:03d}"
+
+
+def build_catalog(artifact_path: str | Path, store_dir: str | Path,
+                  out_dir: str | Path, *, dead_threshold: float = 0.0,
+                  experiment: Optional[str] = None) -> dict:
+    """Build the feature-intelligence index for one sweep artifact set.
+
+    Streams every sound chunk of ``store_dir`` once through
+    ``data/ingest.chunk_stream`` (lease beats per delivered chunk ride
+    along), accumulating per-feature activation counts and magnitude
+    sums for every non-diverged record, then computes the cross-dict
+    matching arrays and writes:
+
+    - per dict ``i`` (tag ``d{i:03d}``): ``<tag>_rows.npy`` (normalized
+      decoder rows), ``<tag>_freq.npy`` (activation frequency),
+      ``<tag>_mag.npy`` (mean magnitude over firing events),
+      ``<tag>_dead.npy`` (bool: frequency <= ``dead_threshold``),
+      ``<tag>_match_dict.npy`` / ``<tag>_match_feat.npy`` /
+      ``<tag>_match_cos.npy`` (nearest live partner feature across the
+      other dicts; -1/-1/0 with a single dict);
+    - ``mmcs.npy``: the pairwise MMCS matrix
+      (mirrors ``metrics/core.py:248`` ``mmcs_from_list``);
+    - ``index.json`` — written LAST, behind the ``catalog.finalize``
+      crash barrier: the completion marker AND the serving manifest
+      (schema + per-file sha256 digests).
+
+    Returns the index metadata dict. Byte-deterministic: rebuilding over
+    the same inputs reproduces every file bit for bit.
+    """
+    from sparse_coding_tpu.data.ingest import chunk_stream
+    from sparse_coding_tpu.data.shard_store import open_store
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    with obs.span("catalog.build"):
+        records = load_catalog_records(artifact_path, skip_diverged=True)
+        if not records:
+            raise CatalogBuildError(
+                f"no non-diverged records in {artifact_path}")
+        n_dropped = _count_diverged(artifact_path, len(records))
+        rows_norm = [decoder_rows_np(rec) for rec in records]
+        store = open_store(store_dir, quarantine_corrupt=True)
+        indices = list(range(store.n_chunks))
+        counts = [np.zeros(r.shape[0], dtype=np.int64) for r in rows_norm]
+        mags = [np.zeros(r.shape[0], dtype=np.float64) for r in rows_norm]
+        rows_total = 0
+        chunks_read = 0
+        for chunk in chunk_stream(store, indices):
+            if chunk is None:  # quarantined position (durable store state)
+                continue
+            fault_point("catalog.build")
+            x = np.asarray(chunk, dtype=np.float32)
+            for i, rec in enumerate(records):
+                codes = encode_np(rec, x)
+                counts[i] += (codes > 0).sum(axis=0)
+                mags[i] += codes.sum(axis=0, dtype=np.float64)
+            rows_total += x.shape[0]
+            chunks_read += 1
+        if rows_total == 0:
+            raise CatalogBuildError(
+                f"store {store_dir} delivered zero rows (all chunks "
+                "quarantined?)")
+
+        meta_dicts = []
+        files: dict[str, Path] = {}
+        freqs, deads = [], []
+        for i, rec in enumerate(records):
+            tag = _dict_tag(i)
+            freq = (counts[i] / rows_total).astype(np.float32)
+            mag = (mags[i] / np.maximum(counts[i], 1)).astype(np.float32)
+            dead = freq <= np.float32(dead_threshold)
+            freqs.append(freq)
+            deads.append(dead)
+            for suffix, arr in (("rows", rows_norm[i]), ("freq", freq),
+                                ("mag", mag), ("dead", dead)):
+                files[f"{tag}_{suffix}.npy"] = arr
+            meta_dicts.append({
+                "tag": tag, "cls": rec["cls"],
+                "n_feats": int(rows_norm[i].shape[0]),
+                "d_activation": int(rows_norm[i].shape[1]),
+                "n_dead": int(dead.sum()),
+                "hyperparams": _sanitize_hyperparams(rec["hyperparams"])})
+
+        # cross-dict matching (metrics/core.py MMCS machinery, §20):
+        # mmcs.npy mirrors mmcs_from_list exactly (upper triangle computed,
+        # mirrored — core.py:248-255); the per-feature nearest-partner
+        # arrays exclude DEAD partner atoms so a dead feature can never be
+        # offered as a neighbor
+        m = len(records)
+        mmcs_mat = np.eye(m, dtype=np.float32)
+        for i in range(m):
+            for j in range(i):
+                v = np.float32(mmcs_np(rows_norm[i], rows_norm[j]))
+                mmcs_mat[i, j] = mmcs_mat[j, i] = v
+        files["mmcs.npy"] = mmcs_mat
+        for i in range(m):
+            n_i = rows_norm[i].shape[0]
+            best_cos = np.full(n_i, -np.inf, dtype=np.float32)
+            best_dict = np.full(n_i, -1, dtype=np.int32)
+            best_feat = np.full(n_i, -1, dtype=np.int32)
+            for j in range(m):
+                if j == i or rows_norm[j].shape[1] != rows_norm[i].shape[1]:
+                    continue
+                sims = (rows_norm[i] @ rows_norm[j].T).astype(np.float32)
+                sims[:, deads[j]] = -np.inf
+                feat_j = np.argmax(sims, axis=1).astype(np.int32)
+                cos_j = sims[np.arange(n_i), feat_j]
+                better = cos_j > best_cos
+                best_cos = np.where(better, cos_j, best_cos)
+                best_dict = np.where(better, np.int32(j), best_dict)
+                best_feat = np.where(better, feat_j, best_feat)
+            tag = _dict_tag(i)
+            files[f"{tag}_match_dict.npy"] = best_dict
+            files[f"{tag}_match_feat.npy"] = best_feat
+            files[f"{tag}_match_cos.npy"] = np.where(
+                np.isfinite(best_cos), best_cos, np.float32(0.0))
+
+        for name, arr in files.items():
+            atomic_save_npy(out / name, arr)
+        index = {
+            "version": INDEX_VERSION,
+            "experiment": experiment,
+            "dead_threshold": float(dead_threshold),
+            "n_rows": int(rows_total),
+            "n_chunks_read": int(chunks_read),
+            "quarantined_chunks": sorted(int(c) for c in store.quarantined),
+            "dropped_diverged": int(n_dropped),
+            "dicts": meta_dicts,
+            "files": {name: _sha256(out / name) for name in sorted(files)},
+        }
+        # worst instant: every array durable, the completion marker not
+        # yet written — a SIGKILL here must leave a restart that rebuilds
+        # to the bitwise-identical index (chaos matrix, §20)
+        crash_barrier("catalog.finalize")
+        atomic_write_text(out / INDEX_NAME,
+                          json.dumps(index, indent=2, sort_keys=True))
+    return index
+
+
+def _count_diverged(artifact_path: str | Path, n_kept: int) -> int:
+    with Path(artifact_path).open("rb") as fh:
+        return len(pickle.load(fh)) - n_kept
+
+
+class CatalogIndex:
+    """Read-side handle on a built catalog directory (jax-free).
+
+    Loads ``index.json`` plus every array eagerly (catalog arrays are
+    per-feature vectors — tiny next to the chunk store). ``verify=True``
+    re-hashes each array file against the manifest digests, turning a
+    torn/stale directory into a typed error instead of silent garbage.
+    """
+
+    def __init__(self, root: Path, meta: dict,
+                 arrays: dict[str, np.ndarray]):
+        self.root = root
+        self.meta = meta
+        self._arrays = arrays
+
+    @classmethod
+    def load(cls, root: str | Path, verify: bool = False) -> "CatalogIndex":
+        root = Path(root)
+        marker = root / INDEX_NAME
+        if not marker.exists():
+            raise FileNotFoundError(
+                f"no catalog index at {marker} (incomplete build?)")
+        meta = json.loads(marker.read_text())
+        arrays = {}
+        for name, digest in meta["files"].items():
+            path = root / name
+            if verify and _sha256(path) != digest:
+                raise CatalogBuildError(
+                    f"catalog array {name} does not match its index.json "
+                    "digest (torn or stale build directory)")
+            arrays[name] = np.load(path)
+        return cls(root, meta, arrays)
+
+    @property
+    def n_dicts(self) -> int:
+        return len(self.meta["dicts"])
+
+    def _arr(self, i: int, suffix: str) -> np.ndarray:
+        return self._arrays[f"{_dict_tag(i)}_{suffix}.npy"]
+
+    def rows(self, i: int) -> np.ndarray:
+        return self._arr(i, "rows")
+
+    def freq(self, i: int) -> np.ndarray:
+        return self._arr(i, "freq")
+
+    def mag(self, i: int) -> np.ndarray:
+        return self._arr(i, "mag")
+
+    def dead(self, i: int) -> np.ndarray:
+        return self._arr(i, "dead")
+
+    def mmcs_matrix(self) -> np.ndarray:
+        return self._arrays["mmcs.npy"]
+
+    def feature_stats(self, dict_i: int, feature_id: int) -> dict:
+        """One feature's full stat row — the payload ``feature.stats``
+        serves (catalog/serve.py)."""
+        f = int(feature_id)
+        return {
+            "dict": int(dict_i),
+            "feature": f,
+            "freq": float(self.freq(dict_i)[f]),
+            "mag": float(self.mag(dict_i)[f]),
+            "dead": bool(self.dead(dict_i)[f]),
+            "match_dict": int(self._arr(dict_i, "match_dict")[f]),
+            "match_feat": int(self._arr(dict_i, "match_feat")[f]),
+            "match_cos": float(self._arr(dict_i, "match_cos")[f]),
+        }

@@ -1,0 +1,66 @@
+"""Catalog query kernels: the exact pure functions the engine compiles.
+
+Module-level (not closures) for the same reason as
+``serve/engine.py::bucket_op_fn``: tests/test_tpu_lowering.py must
+AOT-lower the REAL programs the serving path dispatches, not a
+reconstruction. Both kernels ride the ordinary shape-bucket machinery —
+compiled through ``xcache.cached_compile``, mesh-placed through
+``parallel/partition.py`` (a dict stack's member axis is already the
+sharded axis; a big single dict's feature rows shard via
+``CATALOG_FEATURE_RULES``).
+
+The top-k result is PACKED into one array ``[rows, 2k]`` (similarity
+values, then neighbor indices cast to the value dtype) so the result
+stays a single-leaf tree through the padded fan-out slicing
+(``fanout_results``); :func:`unpack_neighbors` splits it back on host.
+Index precision is exact for any real dictionary (n_feats < 2**24).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def neighbor_topk(ld, x, k: int):
+    """Batched top-k decoder-row similarity for one dictionary: cosine of
+    each query row against every (already normalized) decoder row,
+    ``jax.lax.top_k`` over the feature axis. ``x`` is [rows, d] query
+    vectors (unit-normalize on host for true cosines); returns the packed
+    [rows, 2k] (values ++ indices) array."""
+    sims = x @ ld.get_learned_dict().T
+    vals, idx = jax.lax.top_k(sims, k)
+    return jnp.concatenate([vals, idx.astype(vals.dtype)], axis=-1)
+
+
+def union_vote(ld_stack, x):
+    """The 2505.16077 union/vote aggregation op over a vmapped multi-dict
+    stack ("Ensembling Sparse Autoencoders", PAPERS.md): every member
+    encodes the same batch, and each feature's vote count is the number
+    of members whose code fires. Consumes the stacked tree directly —
+    ``build_bucket_program`` must NOT re-vmap it — and reduces the member
+    axis, so the result rows axis is 0 even for a stack
+    (``op_rows_axis``). Returns [rows, n_feats] vote counts."""
+    codes = jax.vmap(lambda ld, b: ld.encode(b), in_axes=(0, None))(
+        ld_stack, x)
+    return jnp.sum((codes > 0).astype(x.dtype), axis=0)
+
+
+def unpack_neighbors(packed) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side split of the packed neighbor result: [..., 2k] ->
+    (values [..., k] float, indices [..., k] int32)."""
+    packed = np.asarray(packed)
+    k = packed.shape[-1] // 2
+    return (packed[..., :k],
+            packed[..., k:].astype(np.int32))
+
+
+def place_catalog_rows(rows, mesh):
+    """Shard one big dictionary's normalized decoder rows over the mesh
+    feature axis (``partition.CATALOG_FEATURE_RULES`` — [n, d] rows over
+    "model", docs/ARCHITECTURE.md §19/§20) through the placement seam."""
+    from sparse_coding_tpu.parallel import partition
+
+    return partition.place_tree(rows, mesh,
+                                partition.CATALOG_FEATURE_RULES)

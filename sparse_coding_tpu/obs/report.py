@@ -582,6 +582,89 @@ def diff_reports(report_a: dict, report_b: dict,
             "regressions": regressions, "improvements": improvements}
 
 
+def _unit_higher_is_better(unit: str) -> Optional[bool]:
+    """Direction semantics of a ledger row's unit: rates (``.../s``) and
+    ratios improve upward; walls (``s``/``ms``) and overhead percentages
+    improve downward. ``None`` = unknown semantics — never gated on."""
+    u = (unit or "").strip()
+    if "/s" in u or u == "ratio":
+        return True
+    head = u.split()[0] if u else ""
+    if head in ("s", "ms") or u.startswith("%"):
+        return False
+    return None
+
+
+def diff_ledger_suites(prior_rows: list[dict], new_rows: list[dict],
+                       threshold: float = 0.10) -> dict:
+    """Compare a bench run's suite rows against the last prior ledger row
+    with the same (suite, variant, unit, backend) — the round-over-round
+    regression gate (ROADMAP item 3(b); bench_suite.py exits nonzero on
+    a flagged regression). Backend is part of the key, so a cpu-fallback
+    round never compares against an on-chip round (the same guard
+    ``diff_reports`` applies per-run); rows with no prior twin are listed
+    as ``fresh``, not flagged; units with unknown direction semantics are
+    skipped and counted."""
+    def _key(r: dict) -> tuple:
+        return (r.get("suite"), json.dumps(r.get("variant"), sort_keys=True,
+                                           default=repr),
+                r.get("unit"), r.get("backend"))
+
+    baseline: dict[tuple, dict] = {}
+    for r in prior_rows:
+        if r.get("kind") == "suite" and isinstance(r.get("value"),
+                                                   (int, float)):
+            baseline[_key(r)] = r  # last prior row per key = the baseline
+    regressions: list[str] = []
+    improvements: list[str] = []
+    fresh: list[str] = []
+    compared = 0
+    skipped = 0
+    for r in new_rows:
+        if r.get("kind") != "suite" or not isinstance(r.get("value"),
+                                                      (int, float)):
+            continue
+        variant = r.get("variant")
+        label = (f"{r.get('suite')}[{variant}]" if variant is not None
+                 else str(r.get("suite")))
+        label += f" ({r.get('unit')}, {r.get('backend')})"
+        prior = baseline.get(_key(r))
+        if prior is None:
+            fresh.append(label)
+            continue
+        higher = _unit_higher_is_better(r.get("unit") or "")
+        a, b = float(prior["value"]), float(r["value"])
+        if higher is None or a <= 0:
+            skipped += 1
+            continue
+        compared += 1
+        rel = (b - a) / a
+        line = f"{label}: {a:g} -> {b:g} ({rel * 100.0:+.1f}%)"
+        worse = rel < -threshold if higher else rel > threshold
+        better = rel > threshold if higher else rel < -threshold
+        if worse:
+            regressions.append(line)
+        elif better:
+            improvements.append(line)
+    return {"threshold": threshold, "compared": compared,
+            "skipped": skipped, "fresh": fresh,
+            "regressions": regressions, "improvements": improvements}
+
+
+def format_ledger_diff(diff: dict) -> str:
+    lines = [f"bench gate: {diff['compared']} suite row(s) compared "
+             f"against the perf ledger (threshold "
+             f"{diff['threshold'] * 100:.0f}%, {len(diff['fresh'])} "
+             f"fresh, {diff['skipped']} skipped)"]
+    for r in diff["regressions"]:
+        lines.append(f"  REGRESSION  {r}")
+    for i in diff["improvements"]:
+        lines.append(f"  improvement {i}")
+    if not diff["regressions"] and not diff["improvements"]:
+        lines.append("  no significant change vs prior rounds")
+    return "\n".join(lines)
+
+
 def format_diff(diff: dict) -> str:
     lines = [f"perf diff {diff['run_a']} -> {diff['run_b']} "
              f"({diff['compared']} metric(s) compared, threshold "

@@ -86,6 +86,14 @@ BIG_SAE_PARAM_RULES: Rules = (
     (r"(^|/)centering$", REPLICATED),
 )
 
+# Catalog query tensors (catalog/query.py, §20): a big single dict's
+# normalized decoder rows [n, d] shard over "model" on the feature axis —
+# the same placement the big-SAE dict rows train under, so a catalog
+# built from a sharded-training run queries where it trained. (Stacked
+# catalog entries need no new rule: SERVE_STACK_RULES already
+# member-shards them through the engine's serve_rules path.)
+CATALOG_FEATURE_RULES: Rules = ((r".*", FEATURE_ROWS),)
+
 # Full BigSAEState placement: the param rules (also matching the mirrored
 # Adam moment leaves by name), per-feature activation totals over
 # "model", and a replicated catch-all for the worst-example tracker and
@@ -214,7 +222,7 @@ __all__ = [
     "MEMBER", "BATCH", "STACKED_BATCH", "REPLICATED",
     "FEATURE_ROWS", "FEATURE_COLS",
     "ENSEMBLE_STATE_RULES", "SERVE_STACK_RULES", "SERVE_REPLICATED_RULES",
-    "BIG_SAE_PARAM_RULES", "BIG_SAE_STATE_RULES",
+    "BIG_SAE_PARAM_RULES", "BIG_SAE_STATE_RULES", "CATALOG_FEATURE_RULES",
     "batch_spec", "serve_rules", "tree_paths", "match_partition_rules",
     "tree_shardings", "place_tree", "place_batch", "batch_sharding",
     "sharding_fingerprint",

@@ -343,9 +343,32 @@ def run_eval(config: dict) -> None:
          "dicts": records}, indent=2))
 
 
+def run_catalog(config: dict) -> None:
+    """``config["catalog"]`` keys: ``output_folder``, optional
+    ``dead_threshold``. Builds the feature-intelligence index
+    (docs/ARCHITECTURE.md §20) from the sweep's final artifact set + the
+    harvest chunk store. Backend-free — catalog/build.py never imports
+    jax, so like ``scrub`` this step runs against a wedged tunnel.
+    Idempotent behind ``index.json`` (the build's own completion marker,
+    written behind the ``catalog.finalize`` crash barrier); a killed
+    build rebuilds byte-identically."""
+    from sparse_coding_tpu.catalog.build import build_catalog
+
+    cfg = config["catalog"]
+    out = Path(cfg["output_folder"])
+    if (out / "index.json").exists():
+        return
+    name = config["sweep"].get("experiment", "dense_l1_range")
+    pkl = (Path(config["sweep"]["ensemble"]["output_folder"]) / "final"
+           / f"{name}_learned_dicts.pkl")
+    build_catalog(pkl, config["harvest"]["dataset_folder"], out,
+                  dead_threshold=float(cfg.get("dead_threshold", 0.0)),
+                  experiment=name)
+
+
 STEPS = {"harvest": run_harvest, "shard_harvest": run_shard_harvest,
          "manifest": run_store_manifest, "scrub": run_scrub,
-         "sweep": run_sweep, "eval": run_eval}
+         "sweep": run_sweep, "eval": run_eval, "catalog": run_catalog}
 
 
 def main(argv=None) -> None:

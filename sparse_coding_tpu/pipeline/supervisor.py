@@ -588,13 +588,21 @@ def _sweep_eval_steps(cfg_path: Path, config: dict, anchor,
     sweep_out = anchor(config["sweep"]["ensemble"]["output_folder"])
     eval_out = anchor(config["eval"]["output_folder"])
     name = config["sweep"].get("experiment", "dense_l1_range")
-    return [
+    steps = [
         Step("sweep", step_argv("sweep", cfg_path), deps=(sweep_dep,),
              done=lambda: (sweep_out / "final"
                            / f"{name}_learned_dicts.pkl").exists()),
         Step("eval", step_argv("eval", cfg_path), deps=("sweep",),
              done=lambda: (eval_out / "eval.json").exists()),
     ]
+    if "catalog" in config:
+        # opt-in DAG tail (§20): configs without a "catalog" section keep
+        # the exact sweep → eval shape they always had
+        cat_out = anchor(config["catalog"]["output_folder"])
+        steps.append(
+            Step("catalog", step_argv("catalog", cfg_path), deps=("eval",),
+                 done=lambda: (cat_out / "index.json").exists()))
+    return steps
 
 
 def _prune(steps: list[Step], only: Optional[Sequence[str]]) -> list[Step]:

@@ -90,6 +90,11 @@ CRASH_SITES: dict[str, str] = {
     "fleet.place": "run.place queue record durable, the worker not yet "
                    "spawned (pipeline/fleet.py) — the no-run-lost/"
                    "none-double-placed instant",
+    # seeded like the fleet sites: the catalog step child parses the env
+    # plan at its first barrier hit, before catalog/build.py imports
+    "catalog.finalize": "catalog build — every .npy array durable, "
+                        "index.json (the completion marker) not yet "
+                        "written (catalog/build.py)",
 }
 
 

@@ -100,6 +100,16 @@ FAULT_SITES: dict[str, str] = {
                    "run.place append (pipeline/fleet.py)",
     "fleet.preempt": "fleet preemption — before the run.preempt append "
                      "+ SIGTERM (pipeline/fleet.py)",
+    # seeded here (not only registered at catalog module import): the
+    # catalog pipeline step child inherits the env plan and parses it at
+    # its first fault_point — often obs.sink.write at startup, before
+    # catalog/build.py or catalog/serve.py ever import
+    "catalog.build": "catalog build I/O — the artifact-set read and "
+                     "every chunk-stats accumulation step "
+                     "(catalog/build.py)",
+    "catalog.query": "catalog query path — before the index lookup / "
+                     "gateway submit of one feature.* request "
+                     "(catalog/serve.py)",
 }
 
 

@@ -56,9 +56,12 @@ _LAZY_ATTRS = {
     "ServeError": ("sparse_coding_tpu.serve.batching", "ServeError"),
     "ServeFuture": ("sparse_coding_tpu.serve.batching", "ServeFuture"),
     "ServingEngine": ("sparse_coding_tpu.serve.engine", "ServingEngine"),
+    "CATALOG_OPS": ("sparse_coding_tpu.serve.engine", "CATALOG_OPS"),
+    "DEFAULT_OPS": ("sparse_coding_tpu.serve.engine", "DEFAULT_OPS"),
     "bucket_op_fn": ("sparse_coding_tpu.serve.engine", "bucket_op_fn"),
     "build_bucket_program": ("sparse_coding_tpu.serve.engine",
                              "build_bucket_program"),
+    "op_rows_axis": ("sparse_coding_tpu.serve.engine", "op_rows_axis"),
     "Replica": ("sparse_coding_tpu.serve.gateway", "Replica"),
     "ServingGateway": ("sparse_coding_tpu.serve.gateway", "ServingGateway"),
     "EwmaHealth": ("sparse_coding_tpu.serve.health", "EwmaHealth"),
@@ -89,7 +92,9 @@ def __dir__():
 __all__ = [
     "AdmissionController",
     "BATCH",
+    "CATALOG_OPS",
     "CircuitBreaker",
+    "DEFAULT_OPS",
     "CircuitOpenError",
     "DispatchError",
     "EwmaHealth",
@@ -108,5 +113,6 @@ __all__ = [
     "RequestTooLargeError",
     "bucket_op_fn",
     "build_bucket_program",
+    "op_rows_axis",
     "score_offline",
 ]

@@ -65,6 +65,11 @@ from sparse_coding_tpu.serve.registry import ModelRegistry, RegistryEntry
 
 DEFAULT_BUCKETS = (8, 64, 512)
 DEFAULT_OPS = ("encode", "decode", "topk")
+# catalog query ops (docs/ARCHITECTURE.md §20): compiled/bucketed/warmed
+# exactly like DEFAULT_OPS but opt-in per engine — the catalog serving
+# surface constructs its pool with ops=DEFAULT_OPS + CATALOG_OPS, and
+# plain feature-extraction engines keep their warm set unchanged.
+CATALOG_OPS = ("neighbors", "vote")
 
 register_fault_site("serve.dispatch",
                     "ServingEngine.run_padded — immediately before the "
@@ -96,8 +101,24 @@ def bucket_op_fn(op: str, k: int | None = None):
             return vals, idx
 
         return topk
-    raise ValueError(f"unknown serving op {op!r} "
-                     f"(supported: encode, decode, predict, topk)")
+    if op == "neighbors":
+        # catalog top-k decoder-row similarity (catalog/query.py —
+        # module-level there so the lowering tests exercise the real
+        # kernel; §20)
+        if k is None or k < 1:
+            raise ValueError("neighbors op needs k >= 1")
+        from sparse_coding_tpu.catalog.query import neighbor_topk
+
+        return lambda ld, x: neighbor_topk(ld, x, k)
+    if op == "vote":
+        # 2505.16077 union/vote aggregation: consumes the STACKED tree
+        # itself (vmaps internally, reduces the member axis) — see the
+        # vote special case in build_bucket_program
+        from sparse_coding_tpu.catalog.query import union_vote
+
+        return union_vote
+    raise ValueError(f"unknown serving op {op!r} (supported: encode, "
+                     f"decode, predict, topk, neighbors, vote)")
 
 
 def op_width(entry: RegistryEntry, op: str) -> int:
@@ -105,6 +126,14 @@ def op_width(entry: RegistryEntry, op: str) -> int:
     shared by submit-time validation and program compilation so the two
     can never drift."""
     return entry.n_feats if op == "decode" else entry.d_activation
+
+
+def op_rows_axis(entry: RegistryEntry, op: str) -> int:
+    """Rows axis of one op's host result tree: stack entries carry a
+    leading member axis — EXCEPT the catalog ``vote`` op, which reduces
+    it (catalog/query.py::union_vote). The SINGLE home of the fan-out
+    axis rule, shared by the engine and gateway dispatch paths."""
+    return 1 if (entry.is_stack and op != "vote") else 0
 
 
 def prepare_request(entry: RegistryEntry, op: str, ops: Sequence[str],
@@ -116,6 +145,9 @@ def prepare_request(entry: RegistryEntry, op: str, ops: Sequence[str],
     with ``arr`` always [rows, width]."""
     if op not in ops:
         raise ValueError(f"op {op!r} not served (engine ops: {tuple(ops)})")
+    if op == "vote" and not entry.is_stack:
+        raise ValueError(f"op 'vote' aggregates a multi-dict stack; "
+                         f"{entry.name!r} is a single-dict entry")
     arr = np.asarray(x, dtype=np_dtype)
     squeeze = arr.ndim == 1
     if squeeze:
@@ -164,7 +196,15 @@ def build_bucket_program(entry: RegistryEntry, op: str, bucket: int,
     tests/test_tpu_lowering.py lowers the hardened dispatch path's real
     programs rather than a reconstruction."""
     fn = bucket_op_fn(op, k=min(topk_k, entry.n_feats))
-    if entry.is_stack:
+    if op == "vote":
+        # union_vote consumes the stacked tree whole (vmaps internally
+        # over the member axis, then reduces it) — re-vmapping would
+        # split the stack before the vote can count across members
+        if not entry.is_stack:
+            raise ValueError(
+                f"op 'vote' aggregates a multi-dict stack; register "
+                f"{entry.name!r} via register_stack")
+    elif entry.is_stack:
         fn = jax.vmap(fn, in_axes=(0, None))
     spec = jax.ShapeDtypeStruct((bucket, op_width(entry, op)),
                                 jnp.dtype(dtype))
@@ -308,7 +348,10 @@ class ServingEngine:
                 for name in self._registry.names()
                 for op in self._ops
                 for bucket in self._buckets
-                if (name, op, bucket) not in self._programs.compiled]
+                if (name, op, bucket) not in self._programs.compiled
+                # vote is stack-only: a mixed pool (single-dict catalog
+                # entries + one stack) warms each entry's valid ops
+                and (op != "vote" or self._registry.get(name).is_stack)]
         workers = (max(1, int(max_workers)) if max_workers is not None
                    else self._warmup_workers)
         workers = min(workers, len(todo)) if todo else 1
@@ -353,7 +396,9 @@ class ServingEngine:
             (d["model"], d["op"], int(d["bucket"]))
             for d in descs
             if (d.get("model") in names and d.get("op") in self._ops
-                and int(d.get("bucket", -1)) in self._buckets)})
+                and int(d.get("bucket", -1)) in self._buckets
+                and (d.get("op") != "vote"
+                     or self._registry.get(d["model"]).is_stack))})
         if not matched:
             # no manifest, or none of its descriptors name programs THIS
             # engine serves (foreign deployment sharing the cache dir,
@@ -561,7 +606,7 @@ class ServingEngine:
             dev_x = jnp.asarray(x)
         out = compiled(self._entry_tree(model), dev_x)
         entry = self._registry.get(model)
-        rows_axis = 1 if entry.is_stack else 0
+        rows_axis = op_rows_axis(entry, op)
         sl = (slice(None),) * rows_axis + (slice(0, rows),)
         host = jax.tree.map(lambda a: np.asarray(a)[sl], out)
         if sample_perf:
@@ -648,7 +693,7 @@ class ServingEngine:
         self._refill_retry_budget(key)
         self.metrics.record_batch(bucket, len(requests), rows,
                                   deadline_flush)
-        rows_axis = 1 if self._registry.get(model).is_stack else 0
+        rows_axis = op_rows_axis(self._registry.get(model), op)
         fanout_results(
             requests, host, rows_axis,
             on_latency=lambda r, lat: self.metrics.record_latency(bucket,

@@ -80,6 +80,7 @@ from sparse_coding_tpu.serve.engine import (
     ProgramCache,
     ServingEngine,
     fanout_results,
+    op_rows_axis,
     prepare_request,
 )
 from sparse_coding_tpu.serve.health import EwmaHealth
@@ -622,7 +623,7 @@ class ServingGateway:
         model, op = key
         self.metrics.record_batch(bucket, len(requests), rows,
                                   deadline_flush)
-        rows_axis = 1 if self._registry.get(model).is_stack else 0
+        rows_axis = op_rows_axis(self._registry.get(model), op)
         flush = getattr(self, "_last_flush", {})
         t_fan = monotime()
 

@@ -1,0 +1,317 @@
+"""Feature-catalog suite (docs/ARCHITECTURE.md §20; marker ``catalog``).
+
+Tier-1 coverage of the ISSUE 16 acceptance drill:
+
+- index determinism: two builds over the same artifact set + chunk store
+  are byte-identical, file for file (the chaos matrix extends this across
+  a SIGKILL at ``catalog.finalize``);
+- exclusion: diverged members never enter the index, dead features never
+  appear in cross-dict match arrays or neighbor results;
+- parity: the backend-free numpy mirrors (``encode_np``, ``mmcs_np``,
+  the ``mmcs.npy`` matrix) match the jax/flax originals
+  (models/learned_dict.py, metrics/core.py) on small dicts;
+- serving: the full gateway end-to-end query drill — ``feature.stats``,
+  ``feature.neighbors``, ``feature.search``, ``feature.union`` — through
+  a real ServingGateway pool.
+"""
+
+import hashlib
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.catalog.build import (
+    CatalogBuildError,
+    CatalogIndex,
+    build_catalog,
+    decoder_rows_np,
+    encode_np,
+    load_catalog_records,
+    mmcs_np,
+)
+from sparse_coding_tpu.catalog.serve import (
+    REQUEST_CLASSES,
+    CatalogService,
+    request_priority,
+)
+from sparse_coding_tpu.data.chunk_store import ChunkWriter
+from sparse_coding_tpu.models.learned_dict import (
+    RandomDict,
+    TiedSAE,
+    TopKLearnedDict,
+    UntiedSAE,
+)
+from sparse_coding_tpu.utils.artifacts import (
+    load_learned_dicts,
+    save_learned_dicts,
+)
+
+pytestmark = pytest.mark.catalog
+
+D, N = 16, 32
+DEAD_FEAT = 7  # bias-silenced in dict 0 (see _tied): never fires
+TWIN_OF = 3    # dict 0 row DEAD_FEAT duplicates row TWIN_OF (cos = 1)
+
+
+def _tied(seed: int, silence_dead: bool = False) -> TiedSAE:
+    r = np.random.default_rng(seed)
+    d = r.normal(size=(N, D)).astype(np.float32)
+    bias = (r.normal(size=(N,)) * 0.1).astype(np.float32)
+    if silence_dead:
+        # a dead feature whose decoder row is the BEST possible neighbor
+        # of TWIN_OF — if dead filtering ever regresses, neighbors(0,
+        # TWIN_OF) returns it as the top hit and the test fails loudly
+        d[DEAD_FEAT] = d[TWIN_OF]
+        bias[DEAD_FEAT] = -1000.0
+    return TiedSAE(dictionary=jnp.asarray(d), encoder_bias=jnp.asarray(bias))
+
+
+def _untied(seed: int) -> UntiedSAE:
+    r = np.random.default_rng(seed)
+    return UntiedSAE(
+        encoder=jnp.asarray(r.normal(size=(N, D)).astype(np.float32)),
+        encoder_bias=jnp.asarray((r.normal(size=(N,)) * 0.1).astype(
+            np.float32)),
+        dictionary=jnp.asarray(r.normal(size=(N, D)).astype(np.float32)))
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """One artifact set + chunk store + built catalog, shared read-only
+    by the whole module (every test treats it as immutable)."""
+    base = tmp_path_factory.mktemp("catalog_corpus")
+    rng = np.random.default_rng(0)
+    w = ChunkWriter(base / "chunks", D,
+                    chunk_size_gb=D * 128 * 4 / 2**30, dtype="float32")
+    w.add(rng.normal(size=(384, D)).astype(np.float32))
+    w.finalize()
+    pkl = base / "sweep" / "learned_dicts.pkl"
+    save_learned_dicts(
+        [(_tied(1, silence_dead=True), {"l1_alpha": 1e-3}),
+         (_tied(2), {"l1_alpha": 3e-3}),
+         (_untied(4), {"l1_alpha": 1e-3}),
+         (_tied(9), {"l1_alpha": 1.0, "diverged": True})], pkl)
+    build_catalog(pkl, base / "chunks", base / "cat", experiment="t")
+    return base
+
+
+def _digests(folder: Path) -> dict[str, str]:
+    return {p.name: hashlib.sha256(p.read_bytes()).hexdigest()
+            for p in sorted(Path(folder).iterdir())}
+
+
+# -- determinism & schema -----------------------------------------------------
+
+
+def test_build_twice_byte_identical(corpus, tmp_path):
+    """The §20 determinism contract: a rebuild over the same inputs
+    reproduces every file — arrays and index.json — bit for bit."""
+    pkl = corpus / "sweep" / "learned_dicts.pkl"
+    build_catalog(pkl, corpus / "chunks", tmp_path / "again",
+                  experiment="t")
+    assert _digests(tmp_path / "again") == _digests(corpus / "cat")
+
+
+def test_index_schema_and_digest_verify(corpus, tmp_path):
+    meta = json.loads((corpus / "cat" / "index.json").read_text())
+    assert meta["version"] == 1
+    assert meta["n_rows"] == 384
+    assert meta["quarantined_chunks"] == []
+    assert {d["tag"] for d in meta["dicts"]} == {"d000", "d001", "d002"}
+    assert all((corpus / "cat" / name).exists() for name in meta["files"])
+    # verify=True turns a tampered array into a typed error
+    import shutil
+    shutil.copytree(corpus / "cat", tmp_path / "torn")
+    victim = tmp_path / "torn" / "d000_freq.npy"
+    arr = np.load(victim)
+    arr[0] += 1
+    np.save(victim, arr)  # lint: allow-bare-write test-private tamper copy
+    CatalogIndex.load(tmp_path / "torn")  # unverified load still works
+    with pytest.raises(CatalogBuildError, match="digest"):
+        CatalogIndex.load(tmp_path / "torn", verify=True)
+
+
+def test_quarantined_chunk_skipped_deterministically(corpus, tmp_path):
+    """A digest-quarantined chunk is skipped (not crashed into), recorded
+    in index.json, and the remaining stats stay deterministic."""
+    import shutil
+    store = tmp_path / "chunks"
+    shutil.copytree(corpus / "chunks", store)
+    rot = np.random.default_rng(5).normal(size=(128, D)).astype(np.float32)
+    np.save(store / "1.npy", rot)  # lint: allow-bare-write test-private corruption
+    pkl = corpus / "sweep" / "learned_dicts.pkl"
+    meta1 = build_catalog(pkl, store, tmp_path / "c1", experiment="t")
+    assert meta1["quarantined_chunks"] == [1]
+    assert meta1["n_rows"] == 256 and meta1["n_chunks_read"] == 2
+    build_catalog(pkl, store, tmp_path / "c2", experiment="t")
+    assert _digests(tmp_path / "c1") == _digests(tmp_path / "c2")
+
+
+# -- exclusion ----------------------------------------------------------------
+
+
+def test_diverged_records_dropped(corpus):
+    meta = json.loads((corpus / "cat" / "index.json").read_text())
+    assert meta["dropped_diverged"] == 1
+    assert len(meta["dicts"]) == 3
+    assert all(d["hyperparams"].get("l1_alpha") != 1.0
+               for d in meta["dicts"])
+    recs = load_catalog_records(corpus / "sweep" / "learned_dicts.pkl")
+    assert len(recs) == 3
+
+
+def test_dead_features_flagged_and_never_matched(corpus):
+    index = CatalogIndex.load(corpus / "cat")
+    dead0 = index.dead(0)
+    assert bool(dead0[DEAD_FEAT]) and index.freq(0)[DEAD_FEAT] == 0.0
+    assert index.meta["dicts"][0]["n_dead"] == int(dead0.sum())
+    # no other dict's nearest-partner arrays may point at a dead atom
+    for i in range(1, index.n_dicts):
+        md = index._arr(i, "match_dict")
+        mf = index._arr(i, "match_feat")
+        hits_d0 = mf[md == 0]
+        assert not dead0[hits_d0].any()
+
+
+# -- parity with the jax originals --------------------------------------------
+
+
+def test_encode_np_parity_all_classes():
+    x = np.asarray(np.random.default_rng(3).normal(size=(8, D)), np.float32)
+    dicts = [
+        (_tied(1, silence_dead=True), {}),
+        (_untied(4), {}),
+        (RandomDict(dictionary=jnp.asarray(np.random.default_rng(6).normal(
+            size=(N, D)).astype(np.float32))), {}),
+        (TopKLearnedDict(dictionary=jnp.asarray(np.random.default_rng(
+            7).normal(size=(N, D)).astype(np.float32)), k=4), {}),
+    ]
+    import pickle
+    import tempfile
+
+    # round-trip through the real artifact writer so the records carry
+    # exactly the schema build.py reads in production
+    with tempfile.TemporaryDirectory() as td:
+        pkl = Path(td) / "learned_dicts.pkl"
+        save_learned_dicts(dicts, pkl)
+        with pkl.open("rb") as fh:
+            records = pickle.load(fh)
+    for (ld, _), rec in zip(dicts, records):
+        want = np.asarray(ld.encode(jnp.asarray(x)))
+        got = encode_np(rec, x)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5), rec["cls"]
+        np.testing.assert_allclose(
+            decoder_rows_np(rec), np.asarray(ld.get_learned_dict()),
+            rtol=1e-6, atol=1e-6)
+
+
+def test_encode_np_unsupported_class_is_typed():
+    with pytest.raises(CatalogBuildError, match="no backend-free"):
+        encode_np({"cls": "Lista", "fields": {}, "static": {}}, np.ones((1, D)))
+
+
+def test_mmcs_parity_with_metrics_core(corpus):
+    from sparse_coding_tpu.metrics.core import mmcs, mmcs_from_list
+
+    pkl = corpus / "sweep" / "learned_dicts.pkl"
+    lds = [ld for ld, _ in load_learned_dicts(pkl, skip_diverged=True)]
+    recs = load_catalog_records(pkl)
+    got = mmcs_np(decoder_rows_np(recs[0]), decoder_rows_np(recs[1]))
+    assert abs(got - float(mmcs(lds[0], lds[1]))) < 1e-5
+    index = CatalogIndex.load(corpus / "cat")
+    np.testing.assert_allclose(index.mmcs_matrix(),
+                               np.asarray(mmcs_from_list(lds)),
+                               rtol=1e-5, atol=1e-5)
+
+
+# -- serving ------------------------------------------------------------------
+
+
+def test_request_classes_and_priorities():
+    from sparse_coding_tpu.serve.slo import BATCH, INTERACTIVE
+
+    assert request_priority("feature.stats") == INTERACTIVE
+    assert request_priority("feature.neighbors") == INTERACTIVE
+    assert request_priority("feature.search") == BATCH
+    assert request_priority("feature.union") == BATCH
+    assert set(REQUEST_CLASSES) == {"feature.stats", "feature.neighbors",
+                                    "feature.search", "feature.union"}
+    with pytest.raises(ValueError, match="unknown catalog request class"):
+        request_priority("feature.nope")
+
+
+def test_gateway_end_to_end_drill(corpus):
+    """The acceptance drill: index + registry loaded from the SAME
+    artifact set with the SAME diverged filter, every request class
+    served through a real gateway pool, dead/self hits filtered."""
+    from sparse_coding_tpu.serve.gateway import ServingGateway
+    from sparse_coding_tpu.serve.registry import ModelRegistry
+
+    pkl = corpus / "sweep" / "learned_dicts.pkl"
+    index = CatalogIndex.load(corpus / "cat", verify=True)
+    reg = ModelRegistry()
+    names = reg.load_native(pkl, prefix="cat",
+                            select=lambda h: not h.get("diverged"))
+    assert len(names) == index.n_dicts
+    stacked = [ld for ld, _ in load_learned_dicts(
+        pkl, select=lambda h: not h.get("diverged"))
+        if isinstance(ld, TiedSAE)]
+    reg.register_stack("cat/stack", stacked)
+    with ServingGateway(reg, n_replicas=1, n_spares=0, buckets=(8,),
+                        ops=("neighbors", "vote"),
+                        engine_kwargs={"topk_k": 8}) as gw:
+        svc = CatalogService(index, gw, models=names,
+                             stack_model="cat/stack")
+        stats = svc.stats(0, TWIN_OF)
+        assert stats["feature"] == TWIN_OF and not stats["dead"]
+        hits = svc.neighbors(0, TWIN_OF, k=5)
+        assert 1 <= len(hits) <= 5
+        feats = [h["feature"] for h in hits]
+        assert TWIN_OF not in feats        # self-match filtered
+        assert DEAD_FEAT not in feats      # the planted twin is dead
+        dead0 = index.dead(0)
+        assert not any(dead0[f] for f in feats)
+        # cosines sorted descending, consistent with the host matmul
+        sims = index.rows(0) @ index.rows(0)[TWIN_OF]
+        for h in hits:
+            assert abs(h["cos"] - float(sims[h["feature"]])) < 1e-5
+        assert feats[0] == int(np.argmax(
+            np.where(dead0 | (np.arange(N) == TWIN_OF), -np.inf, sims)))
+        # feature.search over a caller vector, 2-D batch form included
+        q = np.asarray(np.random.default_rng(8).normal(size=(2, D)),
+                       np.float32)
+        batched = svc.search(0, q, k=4)
+        assert len(batched) == 2 and all(len(b) <= 4 for b in batched)
+        # feature.union: quorum votes over the stack
+        mask = svc.union(q, quorum=len(stacked))
+        assert mask.shape == (2, N) and mask.dtype == bool
+        votes = svc.union(q, quorum=1)
+        assert (mask <= votes).all()       # stricter quorum ⊆ looser
+
+
+def test_service_rejects_misaligned_registry(corpus):
+    index = CatalogIndex.load(corpus / "cat")
+    with pytest.raises(ValueError, match="same artifact set"):
+        CatalogService(index, gateway=None, models=["just-one"])
+
+
+def test_supervisor_dag_gains_catalog_step(tmp_path):
+    """Pipeline wiring: a config WITH a catalog section appends the
+    catalog step after eval; one without keeps the historical DAG."""
+    from sparse_coding_tpu.pipeline.supervisor import build_pipeline
+
+    cfg = {"harvest": {"dataset_folder": str(tmp_path / "chunks")},
+           "sweep": {"ensemble": {"output_folder": str(tmp_path / "sweep")}},
+           "eval": {"output_folder": str(tmp_path / "eval")},
+           "catalog": {"output_folder": str(tmp_path / "cat")}}
+    steps = build_pipeline(tmp_path / "r1", cfg)
+    assert [s.name for s in steps] == ["harvest", "sweep", "eval", "catalog"]
+    assert steps[-1].deps == ("eval",)
+    assert not steps[-1].done()
+    del cfg["catalog"]
+    steps = build_pipeline(tmp_path / "r2", cfg)
+    assert [s.name for s in steps] == ["harvest", "sweep", "eval"]

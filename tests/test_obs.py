@@ -354,3 +354,61 @@ def test_supervised_run_yields_single_correlated_report(tmp_path):
     assert "step.sweep" in text and "retrace" in text
     assert "sweep.items_per_sec" in text
     assert "perf:" in text
+
+
+# -- bench ledger gate (ISSUE 16, ROADMAP 3(b)) -------------------------------
+
+
+def test_diff_ledger_suites_gate_semantics():
+    """The bench exit gate's comparator: last prior same-(suite,
+    variant, unit, backend) row is the baseline; rate units regress
+    downward, wall units regress upward; backend mismatch and fresh
+    suites never flag; unknown units are skipped, not gated."""
+    from sparse_coding_tpu.obs.report import (diff_ledger_suites,
+                                              format_ledger_diff)
+
+    def row(suite, value, unit, backend="cpu", **extra):
+        return {"kind": "suite", "suite": suite, "value": value,
+                "unit": unit, "backend": backend, **extra}
+
+    prior = [
+        row("ensemble_train", 500.0, "activations/s", variant="autodiff"),
+        # an older, slower round for the same key: the LAST row must win
+        row("ensemble_train", 1000.0, "activations/s", variant="autodiff"),
+        row("catalog", 10.0, "s", variant="build"),
+        row("catalog", 200.0, "queries/s", variant="query"),
+        row("mesh_scale", 1.05, "ratio", variant="ws@1x1"),
+        row("on_chip_only", 9000.0, "activations/s", backend="tpu"),
+        row("weird", 5.0, "furlongs"),
+        {"kind": "run", "value": 1.0, "unit": "activations/s",
+         "suite": "ensemble_train"},  # non-suite kinds never baseline
+    ]
+    new = [
+        row("ensemble_train", 600.0, "activations/s",
+            variant="autodiff"),                       # -40% rate: flag
+        row("catalog", 14.0, "s", variant="build"),    # +40% wall: flag
+        row("catalog", 300.0, "queries/s", variant="query"),  # better
+        row("mesh_scale", 1.04, "ratio", variant="ws@1x1"),   # in noise
+        row("on_chip_only", 100.0, "activations/s"),   # cpu vs tpu: fresh
+        row("weird", 50.0, "furlongs"),                # unknown unit: skip
+        row("brand_new", 1.0, "queries/s"),            # no baseline: fresh
+    ]
+    diff = diff_ledger_suites(prior, new, threshold=0.25)
+    assert len(diff["regressions"]) == 2
+    assert any("ensemble_train[autodiff]" in r and "1000" in r
+               for r in diff["regressions"])
+    assert any("catalog[build]" in r for r in diff["regressions"])
+    assert diff["improvements"] and "catalog[query]" in \
+        diff["improvements"][0]
+    assert diff["compared"] == 4
+    assert diff["skipped"] == 1
+    assert len(diff["fresh"]) == 2
+    text = format_ledger_diff(diff)
+    assert "REGRESSION" in text and "catalog[build]" in text
+
+    # a clean round formats as a pass
+    clean = diff_ledger_suites(prior, [row("catalog", 10.1, "s",
+                                           variant="build")],
+                               threshold=0.25)
+    assert not clean["regressions"]
+    assert "no significant change" in format_ledger_diff(clean)

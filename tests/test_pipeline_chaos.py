@@ -26,7 +26,12 @@ from sparse_coding_tpu.pipeline import (
     Supervisor,
     build_pipeline,
 )
-from sparse_coding_tpu.pipeline.steps import run_eval, run_harvest, run_sweep
+from sparse_coding_tpu.pipeline.steps import (
+    run_catalog,
+    run_eval,
+    run_harvest,
+    run_sweep,
+)
 from sparse_coding_tpu.resilience import crash as crash_mod
 from sparse_coding_tpu.resilience import lease as lease_mod
 
@@ -61,6 +66,7 @@ def _config(base: Path) -> dict:
                   "log_every": 1000},
         "eval": {"output_folder": str(base / "eval"), "n_eval_rows": 512,
                  "seed": 0},
+        "catalog": {"output_folder": str(base / "catalog")},
     }
 
 
@@ -71,6 +77,7 @@ _FAMILIES = {
     "sweep": ["final/*.pkl", "ckpt/*", "ckpt_prev/*", "_*/*.json",
               "_*/*.pkl"],
     "eval": ["eval.json"],
+    "catalog": ["*.npy", "index.json"],
 }
 
 
@@ -95,10 +102,12 @@ def golden(tmp_path_factory):
     run_harvest(config)
     run_sweep(config)
     run_eval(config)
+    run_catalog(config)
     digests = _digests(base, _FAMILIES)
     assert any(k.startswith("chunks/") for k in digests)
     assert any(k.startswith("sweep/final") for k in digests)
     assert "eval/eval.json" in digests
+    assert "catalog/index.json" in digests
     return {"base": base, "digests": digests}
 
 
@@ -124,6 +133,8 @@ MATRIX = [
     ("ckpt.swap", "ckpt.swap:nth=2", ["sweep"], ["chunks"], ["sweep"]),
     ("eval.write", "eval.write:nth=1", ["eval"], ["chunks", "sweep"],
      ["eval"]),
+    ("catalog.finalize", "catalog.finalize:nth=1", ["catalog"],
+     ["chunks", "sweep", "eval"], ["catalog"]),
 ]
 
 

@@ -25,6 +25,11 @@ Sites × handlers covered here:
                       artifact under the final name
 - ``obs.ledger.append`` → a failing perf-ledger row append drops THAT
                       row (counted), never the bench/run it records
+- ``catalog.build`` → an injected artifact/chunk read failure is typed;
+                      nothing half-built goes durable under the index
+                      name; the retry is byte-identical to a clean build
+- ``catalog.query`` → an injected query-path failure is typed and scoped
+                      to THAT request; the next query serves normally
 - SIGTERM           → sweep checkpoints at the chunk boundary and resume
                       continues BITWISE-identically
 """
@@ -1636,3 +1641,95 @@ def test_fleet_preempt_fault_counted_victim_untouched_then_retried(
     sched._preempt("scav")  # the retry (next scheduler tick re-plans)
     events = [r["event"] for r in sched.queue.journal.records()]
     assert events.count("run.preempt") == 1
+
+
+# -- feature-catalog fault matrix (ISSUE 16, docs/ARCHITECTURE.md §20) --------
+
+
+def _catalog_fixture(tmp_path, rows: int = 128):
+    """Tiny artifact set + chunk store for the catalog matrix entries."""
+    import jax.numpy as jnp
+
+    from sparse_coding_tpu.models.learned_dict import TiedSAE
+    from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+
+    d, n = 8, 16
+    nrng = np.random.default_rng(0)
+    w = ChunkWriter(tmp_path / "chunks", d,
+                    chunk_size_gb=d * 64 * 4 / 2**30, dtype="float32")
+    w.add(nrng.normal(size=(rows, d)).astype(np.float32))
+    w.finalize()
+    pkl = tmp_path / "sweep" / "learned_dicts.pkl"
+    dicts = []
+    for seed in (1, 2):
+        r = np.random.default_rng(seed)
+        dicts.append((TiedSAE(
+            dictionary=jnp.asarray(r.normal(size=(n, d)).astype(np.float32)),
+            encoder_bias=jnp.zeros((n,), jnp.float32)),
+            {"l1_alpha": float(seed)}))
+    save_learned_dicts(dicts, pkl)
+    return pkl, tmp_path / "chunks"
+
+
+def test_catalog_build_fault_typed_then_retry_byte_identical(tmp_path):
+    """``catalog.build`` matrix entry: the injected read failure is typed
+    (InjectedFault), leaves NO completion marker behind, and the retry
+    over the same inputs produces an index byte-identical to a build
+    that never failed (the §20 determinism contract survives a failed
+    first attempt)."""
+    import hashlib
+
+    from sparse_coding_tpu.catalog.build import build_catalog
+
+    pkl, store = _catalog_fixture(tmp_path)
+    out = tmp_path / "cat"
+    with inject(site="catalog.build", nth=1, error="OSError") as plan:
+        with pytest.raises(OSError) as err:
+            build_catalog(pkl, store, out, experiment="t")
+        assert isinstance(err.value, InjectedFault)
+    assert plan.fired_count("catalog.build") == 1
+    assert not (out / "index.json").exists()  # never half-completed
+    build_catalog(pkl, store, out, experiment="t")  # the retry
+    build_catalog(pkl, store, tmp_path / "golden", experiment="t")
+
+    def digests(folder):
+        return {p.name: hashlib.sha256(p.read_bytes()).hexdigest()
+                for p in sorted(folder.iterdir())}
+
+    assert digests(out) == digests(tmp_path / "golden")
+
+
+def test_catalog_build_fault_mid_stream_leaves_no_marker(tmp_path):
+    """``catalog.build`` also guards every per-chunk accumulation step:
+    a failure AFTER the artifact read (nth=2 — mid chunk stream) still
+    surfaces typed with no completion marker durable."""
+    from sparse_coding_tpu.catalog.build import build_catalog
+
+    pkl, store = _catalog_fixture(tmp_path)
+    out = tmp_path / "cat"
+    with inject(site="catalog.build", nth=2, error="OSError") as plan:
+        with pytest.raises(OSError):
+            build_catalog(pkl, store, out, experiment="t")
+    assert plan.fired_count("catalog.build") == 1
+    assert plan.hits["catalog.build"] == 2  # fired on the 2nd (chunk) hit
+    assert not (out / "index.json").exists()
+
+
+def test_catalog_query_fault_typed_next_query_serves(tmp_path):
+    """``catalog.query`` matrix entry: an injected query-path failure is
+    typed and scoped to the ONE request that hit it — the same service
+    object serves the very next query from the intact index."""
+    from sparse_coding_tpu.catalog.build import CatalogIndex, build_catalog
+    from sparse_coding_tpu.catalog.serve import CatalogService
+
+    pkl, store = _catalog_fixture(tmp_path)
+    build_catalog(pkl, store, tmp_path / "cat", experiment="t")
+    index = CatalogIndex.load(tmp_path / "cat", verify=True)
+    svc = CatalogService(index, gateway=None, models=["a", "b"])
+    with inject(site="catalog.query", nth=1, error="OSError") as plan:
+        with pytest.raises(OSError) as err:
+            svc.stats(0, 0)
+        assert isinstance(err.value, InjectedFault)
+    assert plan.fired_count("catalog.query") == 1
+    stats = svc.stats(0, 0)  # the next request is untouched
+    assert stats["dict"] == 0 and stats["feature"] == 0

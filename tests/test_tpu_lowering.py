@@ -286,6 +286,48 @@ def test_serving_bucket_programs_lower(rng):
                    stacked, jnp.zeros((rows, d)))
 
 
+def test_catalog_query_programs_lower(rng):
+    """ISSUE 16 AOT gate: the catalog query kernels — the batched top-k
+    decoder-row similarity program (``neighbors``) and the 2505.16077
+    union/vote aggregation over a vmapped dict stack (``vote``) — pass
+    the TPU lowering pipeline at the canonical ratio-16 and ratio-32
+    dictionary shapes, both as raw kernels and as the REAL engine bucket
+    programs (serve/engine.py::build_bucket_program, what warmup
+    AOT-compiles through xcache)."""
+    from sparse_coding_tpu.catalog.query import neighbor_topk, union_vote
+    from sparse_coding_tpu.models import TiedSAE
+    from sparse_coding_tpu.serve.engine import build_bucket_program
+    from sparse_coding_tpu.serve.registry import ModelRegistry
+    from sparse_coding_tpu.utils.trees import stack_trees
+
+    d = 32
+    for ratio in (16, 32):
+        n = ratio * d
+        k1 = jax.random.fold_in(rng, ratio)
+        tied = TiedSAE(dictionary=jax.random.normal(k1, (n, d)),
+                       encoder_bias=jnp.zeros(n))
+        stacked = stack_trees([tied, tied, tied])
+        for rows in (8, 512):
+            x = jnp.zeros((rows, d))
+            _lower_tpu(lambda ld, b: neighbor_topk(ld, b, 16), tied, x)
+            _lower_tpu(union_vote, stacked, x)
+            # the stacked neighbors program exactly as the engine vmaps it
+            _lower_tpu(jax.vmap(lambda ld, b: neighbor_topk(ld, b, 16),
+                                in_axes=(0, None)), stacked, x)
+        # the engine's own bucket programs for both catalog ops
+        reg = ModelRegistry()
+        reg.register("single", tied)
+        reg.register_stack("stack", [tied, tied, tied])
+        for name, ops in (("single", ("neighbors",)),
+                          ("stack", ("neighbors", "vote"))):
+            entry = reg.get(name)
+            for op in ops:
+                fn, spec = build_bucket_program(entry, op, 64, jnp.float32,
+                                                topk_k=16)
+                jax.jit(fn).trace(entry.tree, spec).lower(
+                    lowering_platforms=("tpu",))
+
+
 def test_hardened_serve_dispatch_programs_lower(rng):
     """The resilience-hardened dispatch path (breaker + retry wrapping in
     engine._dispatch) is host-side Python by construction — the DEVICE

@@ -357,6 +357,110 @@ obs.close_sink()
 """
 
 
+_CATALOG_WARM_SCRIPT = """
+import json, os, sys
+import numpy as np
+phase, cache_dir, data_dir = sys.argv[1], sys.argv[2], sys.argv[3]
+from sparse_coding_tpu import obs, xcache
+obs.configure_sink_from_env(phase)
+obs.install_jax_probes()
+xcache.enable(cache_dir)
+import jax
+from pathlib import Path
+from sparse_coding_tpu.catalog.build import CatalogIndex
+from sparse_coding_tpu.catalog.serve import CatalogService
+from sparse_coding_tpu.serve.gateway import ServingGateway
+from sparse_coding_tpu.serve.registry import ModelRegistry
+from sparse_coding_tpu.utils.artifacts import load_learned_dicts
+
+base = Path(data_dir)
+pkl = base / "learned_dicts.pkl"
+index = CatalogIndex.load(base / "cat", verify=True)
+reg = ModelRegistry(audit=False)
+names = reg.load_native(pkl, prefix="cat",
+                        select=lambda h: not h.get("diverged"))
+reg.register_stack("cat/stack", [
+    ld for ld, _ in load_learned_dicts(pkl, skip_diverged=True)])
+compiles_before = obs.counter("jax.compiles").value
+with ServingGateway(reg, n_replicas=1, n_spares=0, buckets=(8,),
+                    ops=("neighbors", "vote"),
+                    engine_kwargs={"topk_k": 8}) as gw:
+    n_programs = gw.warmup()
+    compiles_after_warmup = obs.counter("jax.compiles").value
+    svc = CatalogService(index, gw, models=names, stack_model="cat/stack")
+    hits = svc.neighbors(0, 3, k=4)
+    mask = svc.union(np.ones((4, index.rows(0).shape[1]), np.float32),
+                     quorum=1)
+print(json.dumps({
+    "phase": phase,
+    "programs": n_programs,
+    "compiles_warmed_set": compiles_after_warmup - compiles_before,
+    "xc_hits": obs.counter("xcache.hits").value,
+    "xc_misses": obs.counter("xcache.misses").value,
+    "neighbors": hits,
+    "union_sum": int(mask.sum()),
+}))
+obs.flush_metrics()
+obs.close_sink()
+"""
+
+
+def test_catalog_warm_restart_zero_compiles(tmp_path):
+    """ISSUE 16 satellite: a warm gateway restart serves CATALOG queries
+    (``feature.neighbors`` through the top-k bucket program,
+    ``feature.union`` through the stacked vote program) with ZERO backend
+    compiles — every catalog executable loads from the shared store —
+    and returns results identical to the cold process's."""
+    import jax.numpy as jnp
+
+    from sparse_coding_tpu.catalog.build import build_catalog
+    from sparse_coding_tpu.data.chunk_store import ChunkWriter
+    from sparse_coding_tpu.models import TiedSAE
+    from sparse_coding_tpu.utils.artifacts import save_learned_dicts
+
+    data = tmp_path / "data"
+    d, n = 16, 32
+    nrng = np.random.default_rng(0)
+    w = ChunkWriter(data / "chunks", d,
+                    chunk_size_gb=d * 128 * 4 / 2**30, dtype="float32")
+    w.add(nrng.normal(size=(256, d)).astype(np.float32))
+    w.finalize()
+    dicts = []
+    for seed in (1, 2):
+        r = np.random.default_rng(seed)
+        dicts.append((TiedSAE(
+            dictionary=jnp.asarray(r.normal(size=(n, d)).astype(np.float32)),
+            encoder_bias=jnp.zeros((n,), jnp.float32)),
+            {"l1_alpha": float(seed)}))
+    pkl = data / "learned_dicts.pkl"
+    save_learned_dicts(dicts, pkl)
+    build_catalog(pkl, data / "chunks", data / "cat", experiment="t")
+
+    run_dir = tmp_path / "run"
+    cache_dir = str(tmp_path / "xc")
+    env = {"SPARSE_CODING_OBS_DIR": str(run_dir / "obs"),
+           "SPARSE_CODING_RUN_ID": "catalog-warm"}
+    cold = _run_script(tmp_path, "cat_warm.py", _CATALOG_WARM_SCRIPT,
+                       ["cold", cache_dir, str(data)],
+                       {**env, "SPARSE_CODING_OBS_STEP": "cold"})
+    warm = _run_script(tmp_path, "cat_warm.py", _CATALOG_WARM_SCRIPT,
+                       ["warm", cache_dir, str(data)],
+                       {**env, "SPARSE_CODING_OBS_STEP": "warm"})
+
+    # 3 entries x neighbors + 1 stack vote, one bucket = 4 programs; the
+    # two structurally identical single-dict entries share one executable
+    # key (weights are runtime args, not part of the program) → 3 store
+    # entries, and the in-process dedupe makes the 4th prep a HIT
+    assert cold["programs"] == warm["programs"] == 4
+    assert cold["xc_misses"] == 3 and cold["xc_hits"] == 1
+    assert cold["compiles_warmed_set"] >= 3
+    # the warm restart serves catalog queries at ZERO backend compiles
+    assert warm["xc_hits"] == 4 and warm["xc_misses"] == 0
+    assert warm["compiles_warmed_set"] == 0
+    assert warm["neighbors"] == cold["neighbors"]  # bit-identical hits
+    assert warm["union_sum"] == cold["union_sum"]
+
+
 def test_mesh_warm_restart_zero_compiles(tmp_path):
     """ISSUE 15 acceptance: a cold/warm subprocess pair serving a
     MESH-SHARDED pool (2x4 mesh, member-sharded stack + replicated solo
