@@ -928,6 +928,159 @@ def bench_fleet_soak(quick: bool) -> None:
         shutil.rmtree(root / "fleet", ignore_errors=True)
 
 
+def bench_plane_tide(quick: bool) -> None:
+    """Elastic-plane tide cycle (ISSUE 17): a real gateway + real fleet
+    scheduler under one ElasticPlane arbiter, through a full tide —
+    traffic ramp, scale-up that SIGTERM-reclaims a live scavenger sweep
+    and activates a warm spare, drain, ebb, scale-down, sweep resume.
+    Reports the number serving cares about — client-observed
+    INTERACTIVE p99 across the ramp-and-scale-up window — plus the two
+    elasticity walls (reclaim: up-rebalance → scavenger checkpointed
+    out; resume: down-rebalance → sweep finished) and the steady-state
+    compile count across the whole cycle (0 = the spare came off the
+    xcache warmup manifest; anything else is the §13 regression this
+    row exists to catch). The scavenger child is a jax-free command
+    worker, so the scenario admits exactly ONE jax process (this one —
+    CLAUDE.md) and is safe under a wedged tunnel."""
+    import shutil
+    import tempfile
+    import threading
+    import time as _time
+
+    from sparse_coding_tpu import obs, xcache
+    from sparse_coding_tpu.models import UntiedSAE
+    from sparse_coding_tpu.pipeline import FleetScheduler
+    from sparse_coding_tpu.pipeline.fleet_queue import (
+        QUEUE_NAME,
+        FleetQueue,
+    )
+    from sparse_coding_tpu.pipeline.plane import ElasticPlane, PlaneConfig
+    from sparse_coding_tpu.serve import ModelRegistry, ServingGateway
+    from sparse_coding_tpu.serve.slo import INTERACTIVE, SCAVENGER
+
+    d, n, burst, steps = (32, 64, 48, 60) if quick else (64, 256, 160, 200)
+    rng = jax.random.PRNGKey(7)
+    k1, k2, k3 = jax.random.split(rng, 3)
+    reg = ModelRegistry()
+    reg.register("tide", UntiedSAE(
+        encoder=jax.random.normal(k1, (n, d), jnp.float32),
+        encoder_bias=jax.random.normal(k2, (n,), jnp.float32),
+        dictionary=jax.random.normal(k3, (n, d), jnp.float32)))
+    nrng = np.random.default_rng(11)
+    payloads = [nrng.normal(size=(8, d)).astype(np.float32)
+                for _ in range(burst)]
+
+    scav_body = (
+        "import json, pathlib, signal, sys, time\n"
+        "state = pathlib.Path(sys.argv[1]); out = pathlib.Path(sys.argv[2])\n"
+        "flag = []\n"
+        "signal.signal(signal.SIGTERM, lambda *a: flag.append(1))\n"
+        "vals = json.loads(state.read_text()) if state.exists() else []\n"
+        f"while len(vals) < {steps}:\n"
+        "    vals.append(len(vals))\n"
+        "    time.sleep(0.02)\n"
+        "    if flag:\n"
+        "        state.write_text(json.dumps(vals)); sys.exit(75)\n"
+        "out.write_text(json.dumps(vals)); sys.exit(0)\n")
+
+    with tempfile.TemporaryDirectory() as td:
+        root = Path(td)
+        fleet_dir = root / "fleet"
+        xcache.enable(root / "xc")
+        try:
+            with ServingGateway(reg, n_replicas=1, n_spares=1,
+                                buckets=(8,), ops=("encode",),
+                                max_wait_ms=0.5) as gw:
+                gw.warmup()
+                for p in payloads[:4]:
+                    gw.query("tide", p, priority=INTERACTIVE, timeout=60)
+
+                sched = FleetScheduler(fleet_dir, n_slices=1, poll_s=0.05,
+                                       max_wall_s=600)
+                plane = ElasticPlane(
+                    fleet_dir,
+                    PlaneConfig(n_slices=2, min_replicas=1, max_replicas=2,
+                                up_queued_rows=4.0, down_queued_rows=2.0,
+                                hold_ticks=2),
+                    gateway=gw, fleet=sched)
+                plane.reconcile()
+                sched.enqueue("scav", priority=SCAVENGER, kind="command",
+                              argv=[sys.executable, "-c", scav_body,
+                                    str(root / "scav.ckpt"),
+                                    str(root / "scav.out")],
+                              done_path=root / "scav.out")
+                summary: dict = {}
+                worker = threading.Thread(
+                    target=lambda: summary.update(sched.run()),
+                    daemon=True)
+                t_fleet = _time.perf_counter()
+                worker.start()
+                queue = FleetQueue(fleet_dir / QUEUE_NAME)
+                deadline = _time.perf_counter() + 60
+                while queue.replay().runs["scav"].state != "placed" \
+                        and _time.perf_counter() < deadline:
+                    _time.sleep(0.02)
+
+                compiles0 = obs.counter("jax.compiles").value
+                # ---- ramp: hold the dispatcher, pile the burst, let
+                # the plane confirm an up move, then serve it all wide
+                gw.pause()
+                t_sub, futs = [], []
+                for p in payloads[4:]:
+                    t_sub.append(_time.perf_counter())
+                    futs.append(gw.submit("tide", p,
+                                          priority=INTERACTIVE))
+                plane.tick()
+                t_up = _time.perf_counter()
+                up = plane.tick()
+                gw.resume()
+                lat_ms = []
+                for t0, f in zip(t_sub, futs):
+                    f.result(timeout=120)
+                    lat_ms.append((_time.perf_counter() - t0) * 1e3)
+                p99_ms = float(np.percentile(lat_ms, 99))
+                # reclaim wall: up-rebalance -> sweep checkpointed out
+                while queue.replay().runs["scav"].state != "queued" \
+                        and _time.perf_counter() < deadline:
+                    _time.sleep(0.02)
+                reclaim_s = _time.perf_counter() - t_up
+
+                # ---- ebb: EWMA decays, plane hands the slice back
+                t_down = None
+                for _ in range(200):
+                    out = plane.tick()
+                    if out["split"].serve_slices == 1:
+                        t_down = _time.perf_counter()
+                        break
+                    _time.sleep(0.02)
+                plane.tick()  # drain window: replica back to spare
+                worker.join(timeout=600)
+                t_end = _time.perf_counter()
+                resume_s = (t_end - t_down
+                            if t_down is not None else None)
+                # useful steps per wall: every step the sweep completed
+                # (checkpointed steps count — the reclaim is a pause,
+                # not a loss) over its whole tide-interrupted residency
+                scav_steps_s = steps / (t_end - t_fleet)
+                steady_compiles = obs.counter("jax.compiles").value \
+                    - compiles0
+                planes = [r for r in queue.journal.records()
+                          if r["event"] == "plane.rebalance"]
+            _emit("plane_tide", p99_ms, "ms",
+                  variant="ramp_scaleup_p99", d=d, burst=burst,
+                  scaled_up=bool(up["rebalanced"]),
+                  rebalances=len(planes),
+                  reclaim_s=round(reclaim_s, 3),
+                  resume_s=(round(resume_s, 3)
+                            if resume_s is not None else None),
+                  scav_steps_per_s=round(scav_steps_s, 2),
+                  steady_compiles=steady_compiles,
+                  states=summary, worker_backend="cpu")
+        finally:
+            xcache.disable()
+        shutil.rmtree(fleet_dir, ignore_errors=True)
+
+
 def bench_mesh_scale(quick: bool) -> None:
     """ISSUE 15 scenario: whole-step vs two-stage fused A/B at 1 device
     and on the ("model", "data") mesh spanning every visible device —
@@ -1086,8 +1239,8 @@ def main() -> None:
                   bench_harvest,
                   bench_chunk_io, bench_ingest_soak, bench_streaming_eval,
                   bench_guardian_soak, bench_perf_probe, bench_gateway,
-                  bench_catalog, bench_fleet_soak, bench_mesh_scale,
-                  bench_seq_parallel):
+                  bench_catalog, bench_fleet_soak, bench_plane_tide,
+                  bench_mesh_scale, bench_seq_parallel):
         try:
             suite(args.quick)
         except Exception as e:

@@ -269,6 +269,26 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "trace_skipped": counters.get("obs.trace.skipped", 0),
     }
 
+    # elastic-plane evidence (docs/ARCHITECTURE.md §21): the arbiter's
+    # rebalance story — how often serving and the fleet traded slices,
+    # which direction, what it cost (scavenger reclaims), what failed
+    # (fault-sited errors, retried next tick) — and the current split
+    # gauges, so one merged report shows a whole tide cycle next to the
+    # latency, compile, and preemption evidence it produced
+    plane = {
+        "rebalances": counters.get("plane.rebalances", 0),
+        "scale_ups": counters.get("plane.scale_ups", 0),
+        "scale_downs": counters.get("plane.scale_downs", 0),
+        "reclaims": counters.get("plane.reclaims", 0),
+        "reconciles": counters.get("plane.reconciles", 0),
+        "replicas_released": counters.get("plane.replicas_released", 0),
+        "rebalance_errors": counters.get("plane.rebalance_errors", 0),
+        "scale_errors": counters.get("plane.scale_errors", 0),
+        "serve_slices": gauges.get("plane.serve_slices", {}).get("value"),
+        "fleet_slices": gauges.get("plane.fleet_slices", {}).get("value"),
+        "replicas": gauges.get("plane.replicas", {}).get("value"),
+    }
+
     # guardian evidence (docs/ARCHITECTURE.md §16): the sweep's divergence
     # ladder — member quarantines, chunk quarantines, rollbacks, typed
     # halts — plus the boundary-check and rollback walls, so one merged
@@ -301,6 +321,7 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "compiles": counters.get("jax.compiles", 0),
         "compile_cache": compile_cache,
         "gateway": gateway,
+        "plane": plane,
         "ingest": ingest,
         "guardian": guardian,
         "kernel_paths": kernel_paths,
@@ -346,10 +367,23 @@ def build_fleet_report(fleet_dir: str | Path) -> dict:
         if base == "fleet.releases" and "outcome" in labels:
             releases[labels["outcome"]] = releases.get(
                 labels["outcome"], 0) + int(v)
+    # plane.rebalance records are plane-level journal events (step=""),
+    # invisible to the run-state fold by design — surface them here so
+    # the fleet report shows the tide cycle the tenants lived through
+    rebalances = [
+        {"seq": int(r.get("seq", 0)),
+         "serve_slices": int((r.get("detail") or {}).get(
+             "serve_slices", 0)),
+         "fleet_slices": int((r.get("detail") or {}).get(
+             "fleet_slices", 0)),
+         "reason": (r.get("detail") or {}).get("reason", "?")}
+        for r in FleetQueue(fleet_dir / QUEUE_NAME).journal.records()
+        if r.get("event") == "plane.rebalance"]
     return {
         "fleet_dir": str(fleet_dir),
         "states": state.summary(),
         "tenants": tenants,
+        "plane": {**sched.get("plane", {}), "records": rebalances},
         "scheduler": {
             "placements": counters.get("fleet.placements", 0),
             "preemptions": counters.get("fleet.preemptions", 0),
@@ -375,6 +409,17 @@ def format_fleet_report(fleet: dict) -> str:
              + (", ".join(f"{k}={v}"
                           for k, v in sorted(sched["releases"].items()))
                 or "-")]
+    plane = fleet.get("plane", {})
+    if plane.get("records") or plane.get("rebalances"):
+        lines.append(
+            f"plane: {plane.get('rebalances', 0)} rebalance(s) "
+            f"({plane.get('scale_ups', 0)} up/"
+            f"{plane.get('scale_downs', 0)} down), "
+            f"{plane.get('reclaims', 0)} scavenger reclaim(s), "
+            f"{plane.get('rebalance_errors', 0)}+"
+            f"{plane.get('scale_errors', 0)} error(s); split "
+            f"serve={plane.get('serve_slices', '-')}/"
+            f"fleet={plane.get('fleet_slices', '-')} slice(s)")
     for name, t in fleet["tenants"].items():
         rep = t["report"]
         gd = rep.get("guardian", {})

@@ -11,7 +11,13 @@
   queue bin-packed onto mesh slices with serve/slo.py's priority
   classes, per-run worker subprocesses (one Supervisor each), chunk-
   boundary SIGTERM preemption, per-tenant guardian-halt containment,
-  and one shared executable cache across tenants.
+  and one shared executable cache across tenants;
+- :mod:`plane`      — the elastic resource plane (docs/ARCHITECTURE.md
+  §21): ONE arbiter trading mesh slices between the serving gateway's
+  replica pool and the fleet's scavenger tenants, with durable
+  bitwise-replayable rebalance records in the fleet queue journal,
+  zero-compile warm-spare scale-up, SIGTERM-checkpoint reclaim, and
+  hysteresis against flapping load.
 
 Design + formats: docs/ARCHITECTURE.md §11 + §18; wedged-tunnel
 operations: docs/RUNBOOK_TUNNEL.md; kill coverage:
@@ -37,6 +43,12 @@ _LAZY_ATTRS = {
     "RunState": ("sparse_coding_tpu.pipeline.placement", "RunState"),
     "plan_placement": ("sparse_coding_tpu.pipeline.placement",
                        "plan_placement"),
+    "ElasticPlane": ("sparse_coding_tpu.pipeline.plane", "ElasticPlane"),
+    "PlaneConfig": ("sparse_coding_tpu.pipeline.plane", "PlaneConfig"),
+    "PlaneSplit": ("sparse_coding_tpu.pipeline.plane", "PlaneSplit"),
+    "desired_replicas": ("sparse_coding_tpu.pipeline.plane",
+                         "desired_replicas"),
+    "replay_split": ("sparse_coding_tpu.pipeline.plane", "replay_split"),
 }
 for _name in ("STEP_EXIT_HALTED", "STEP_EXIT_PREEMPTED",
               "ConcurrentSupervisorError", "PipelineError", "Step",

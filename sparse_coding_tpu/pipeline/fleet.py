@@ -85,6 +85,7 @@ from sparse_coding_tpu.resilience.lease import (
     seed_lease,
 )
 from sparse_coding_tpu.resilience.preempt import PreemptionGuard
+from sparse_coding_tpu.serve.slo import SCAVENGER
 
 register_fault_site("fleet.place",
                     "fleet placement decision — fires before the durable "
@@ -313,16 +314,47 @@ class FleetScheduler:
         obs.emit_event("fleet.place", sink=self._sink, run_name=name,
                        attempt=attempt, pid=proc.pid)
 
-    def _preempt(self, name: str) -> None:
+    def _preempt(self, name: str) -> bool:
         try:
             fault_point("fleet.preempt")
         except Exception:  # noqa: BLE001 — injected/transient: re-plan next tick
             obs.counter("fleet.preempt_errors").inc()
-            return
+            return False
         self.queue.append("run.preempt", name)
         self._signal_group(name, signal.SIGTERM)
         obs.counter("fleet.preemptions").inc()
         obs.emit_event("fleet.preempt", sink=self._sink, run_name=name)
+        return True
+
+    def reclaim_scavengers(self, max_slices: int) -> list[str]:
+        """Elastic-plane reclaim (pipeline/plane.py): when the arbiter
+        shrinks the fleet's share of the pod, SIGTERM-preempt
+        most-recently-placed scavenger runs until the slices held by
+        live scavengers fit ``max_slices``. Rides the exact ``_preempt``
+        path (durable ``run.preempt`` + group SIGTERM at a chunk
+        boundary), so a reclaimed sweep checkpoints and later resumes
+        bitwise. Only scavengers are plane-reclaimable — higher classes
+        keep their slices until they finish. Returns the names
+        signaled."""
+        st = self.queue.replay()
+        # PREEMPTING runs are already on their way to freeing their
+        # slices — counting them toward usage would cascade one extra
+        # SIGTERM per tick onto still-useful sweeps while the first
+        # victim drains (the futile-preemption class the placement
+        # planner also guards against)
+        victims = sorted((r for r in st.runs.values()
+                          if r.state == PLACED
+                          and r.priority == SCAVENGER),
+                         key=lambda r: -r.placed_seq)
+        usage = sum(r.slices for r in victims)
+        signaled: list[str] = []
+        for victim in victims:
+            if usage <= max(0, int(max_slices)):
+                break
+            if self._preempt(victim.name):
+                usage -= victim.slices
+                signaled.append(victim.name)
+        return signaled
 
     def _signal_group(self, name: str, sig: int) -> None:
         proc = self._workers.get(name)

@@ -107,14 +107,20 @@ def plan_placement(runs: list[RunState], n_slices: int,
         if priority_rank(run.priority) < priority_rank(SCAVENGER):
             # drain scavengers until this head run WOULD fit (capacity
             # and concurrency); placement happens on a later tick, once
-            # the preempted workers have checkpointed and exited
+            # the preempted workers have checkpointed and exited.
+            # Futility guard first: if draining EVERY scavenger still
+            # could not fit the head run (capacity- or slot-wise), plan
+            # no victims at all — SIGTERMing useful work that frees
+            # nothing the head can use is pure loss
             need = used + run.slices - n_slices
-            freed = 0
-            while victims and (freed < need or running >= cap):
-                victim = victims.pop(0)
-                preempt.append(victim.name)
-                freed += victim.slices
-                running -= 1
+            reclaimable = sum(v.slices for v in victims)
+            if reclaimable >= need and running - len(victims) < cap:
+                freed = 0
+                while victims and (freed < need or running >= cap):
+                    victim = victims.pop(0)
+                    preempt.append(victim.name)
+                    freed += victim.slices
+                    running -= 1
         blocked.append(run.name)
     return PlacementPlan(place=tuple(place), preempt=tuple(preempt),
                          blocked=tuple(blocked))

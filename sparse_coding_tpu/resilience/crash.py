@@ -95,6 +95,13 @@ CRASH_SITES: dict[str, str] = {
     "catalog.finalize": "catalog build — every .npy array durable, "
                         "index.json (the completion marker) not yet "
                         "written (catalog/build.py)",
+    # seeded like the fleet sites: worker children inherit the arbiter's
+    # env plan and parse it at their first barrier, before
+    # pipeline/plane.py ever imports
+    "plane.rebalance": "elastic plane — rebalance record durable in the "
+                       "fleet queue journal, NEITHER consumer resized "
+                       "yet (pipeline/plane.py) — the no-double-booking "
+                       "reconcile instant",
 }
 
 

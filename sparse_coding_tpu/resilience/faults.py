@@ -110,6 +110,15 @@ FAULT_SITES: dict[str, str] = {
     "catalog.query": "catalog query path — before the index lookup / "
                      "gateway submit of one feature.* request "
                      "(catalog/serve.py)",
+    # seeded here (not only registered at pipeline/plane.py import): the
+    # arbiter shares a process with fleet workers' env plans — children
+    # parse the plan at their first fault_point, before plane.py imports
+    "plane.scale": "elastic plane — before applying one gateway replica "
+                   "scale action (activate spare / drain) "
+                   "(pipeline/plane.py)",
+    "plane.rebalance": "elastic plane — before the durable "
+                       "plane.rebalance record append "
+                       "(pipeline/plane.py)",
 }
 
 

@@ -210,6 +210,14 @@ class MicroBatcher:
         with self._cond:
             return self._queued_rows
 
+    @property
+    def service_rate_rows_s(self) -> float | None:
+        """Recent rows/s service-rate EWMA (None before the first timed
+        dispatch) — the typed ``LoadSignals`` feed (serve/slo.py): the
+        elastic plane reads load through this, never the raw field."""
+        with self._cond:
+            return self._rate_rows_s
+
     def _observe_service(self, rows: int, dur_s: float) -> None:
         if rows <= 0 or dur_s <= 0:
             return

@@ -90,6 +90,8 @@ from sparse_coding_tpu.serve.slo import (
     BATCH,
     PRIORITIES,
     AdmissionController,
+    LoadSignals,
+    LoadTracker,
     windowed_quantile,
 )
 
@@ -221,6 +223,10 @@ class ServingGateway:
         self._dispatch_timeout_s = float(dispatch_timeout_s)
         self._admission = admission if admission is not None \
             else AdmissionController()
+        # typed load snapshot for the elastic plane (serve/slo.py):
+        # advanced only by load_signals() calls, so the plane's scale
+        # decisions are deterministic under a scripted observation stream
+        self._load = LoadTracker()
         # the closed loop must see RECENT latency, not all-time history:
         # a cumulative histogram's p99 would hold the brownout ladder up
         # for tens of thousands of requests after an incident ends.
@@ -332,6 +338,11 @@ class ServingGateway:
 
     def replica_names(self) -> list[str]:
         return list(self._order)
+
+    def active_replica_names(self) -> list[str]:
+        """Names currently in the routing set (construction order) —
+        the elastic plane's view of how wide the pool actually is."""
+        return [r.name for r in self._active_replicas()]
 
     # -- request path --------------------------------------------------------
 
@@ -681,15 +692,18 @@ class ServingGateway:
                     drained.append(rep.name)
         return drained
 
-    def _activate_spare(self, spare: Replica, replacing: Replica) -> bool:
+    def _activate_spare(self, spare: Replica,
+                        replacing: Optional[Replica] = None) -> bool:
         """Warm the spare from the xcache warmup manifest, then swap it
-        into the routing set in place of ``replacing``. On failure the
-        spare stays a spare (retried next maintain pass) and the pool
-        keeps serving on the surviving replicas — activation is never on
-        the failure path of in-flight traffic."""
+        into the routing set — in place of ``replacing`` (self-healing
+        drain) or as an EXTRA active when ``replacing`` is None (elastic
+        scale-up: nothing drains, the pool widens). On failure the spare
+        stays a spare (retried next maintain pass) and the pool keeps
+        serving on the surviving replicas — activation is never on the
+        failure path of in-flight traffic."""
         try:
             with obs.span("gateway.spare.activate", spare=spare.name,
-                          replacing=replacing.name):
+                          replacing=replacing.name if replacing else ""):
                 fault_point("gateway.spare.activate")
                 programs = spare.engine.warmup_from_manifest()
                 # worst instant: the spare's full warm set is loaded (and
@@ -698,13 +712,60 @@ class ServingGateway:
                 # must leave a restart that heals identically
                 crash_barrier("gateway.spare.activate")
                 spare.state = ACTIVE
-                replacing.state = DRAINING
+                if replacing is not None:
+                    replacing.state = DRAINING
         except BaseException:  # noqa: BLE001 — activation is off-path
             self._reg.counter("gateway.spare_activation_errors").inc()
             return False
         self._reg.counter("gateway.spare_activations").inc()
         self._reg.counter("gateway.spare_programs_warmed").inc(programs)
         return True
+
+    # -- elastic pool (pipeline/plane.py drives these) -----------------------
+
+    def scale_up(self, n: int = 1) -> list[str]:
+        """Elastic scale-up: activate up to ``n`` warm spares as EXTRA
+        actives (no replica drained). Zero compiles by construction —
+        the spare warms from the xcache manifest through the pool's
+        shared program table, exactly the self-healing activation path.
+        Returns the names activated (may be shorter when spares ran out
+        or an activation failed; the plane retries next tick)."""
+        activated: list[str] = []
+        with self._pool_lock:
+            for spare in self._spare_replicas()[:max(0, int(n))]:
+                if self._activate_spare(spare, replacing=None):
+                    activated.append(spare.name)
+        return activated
+
+    def scale_down(self, n: int = 1) -> list[str]:
+        """Elastic scale-down: drain the ``n`` least-healthy actives
+        (never below one). A DRAINING replica leaves the routing order
+        immediately — in-flight dispatches finish on it, new flushes
+        don't start — and ``reinstate()`` returns it to the spare set
+        once the plane's drain window passes. Returns the names
+        drained."""
+        drained: list[str] = []
+        with self._pool_lock:
+            for rep in reversed(self._routing_order()):
+                if len(drained) >= max(0, int(n)):
+                    break
+                if len(self._active_replicas()) <= 1:
+                    break  # the front door never scales to zero
+                rep.state = DRAINING
+                drained.append(rep.name)
+        return drained
+
+    def load_signals(self) -> LoadSignals:
+        """Fold one load observation and return the typed snapshot the
+        elastic plane scales from (serve/slo.py ``LoadSignals``): queue
+        depth + service-rate EWMA from the micro-batcher, brownout rung
+        from the admission controller — one audited struct, no
+        controller internals."""
+        return self._load.observe(
+            queued_rows=self._batcher.queued_rows,
+            service_rate_rows_s=self._batcher.service_rate_rows_s,
+            predicted_wait_s=self._batcher.predicted_wait_s(),
+            admission_level=self._admission.level)
 
     def reinstate(self, name: str) -> None:
         """Ops hook: return a drained (repaired) replica to the pool as

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import math
 import threading
+from dataclasses import dataclass
 
 from sparse_coding_tpu.serve.batching import QueueFullError
 
@@ -78,6 +79,73 @@ _LADDER: dict[int, frozenset] = {
     2: frozenset({SCAVENGER, BATCH}),
 }
 MAX_LEVEL = max(_LADDER)
+
+
+@dataclass(frozen=True)
+class LoadSignals:
+    """One typed load observation — the AUDITED struct the elastic plane
+    (pipeline/plane.py) scales the pod's serve/train split from. The
+    gateway assembles it from the controllers that already compute each
+    number (micro-batcher queue + service-rate EWMA, admission ladder);
+    the plane never reaches into controller internals, so the seam
+    between "what serving knows" and "what the arbiter acts on" is this
+    one immutable record."""
+
+    queued_rows: int                        # rows waiting right now
+    queue_depth_ewma: float                 # LoadTracker's smoothed depth
+    service_rate_rows_s: float | None       # batcher EWMA; None pre-traffic
+    predicted_wait_s: float | None          # drain estimate for new work
+    admission_level: int                    # brownout rung (0 = open)
+    ticks: int = 0                          # observations folded so far
+
+
+class LoadTracker:
+    """Deterministic EWMA fold over load observations.
+
+    Like everything in this module, NO clock reads — state advances only
+    on :meth:`observe` calls, so a scripted observation sequence always
+    produces the exact same :class:`LoadSignals` stream and the plane's
+    scale decisions replay bit-for-bit in tests."""
+
+    def __init__(self, alpha: float = 0.3):
+        if not (0.0 < alpha <= 1.0):
+            raise ValueError("alpha must be in (0, 1]")
+        self._alpha = float(alpha)
+        self._lock = threading.Lock()
+        self._depth_ewma: float | None = None
+        self._ticks = 0
+        self._last: LoadSignals | None = None
+
+    def observe(self, queued_rows: int,
+                service_rate_rows_s: float | None = None,
+                predicted_wait_s: float | None = None,
+                admission_level: int = 0) -> LoadSignals:
+        """Fold one observation; returns the updated snapshot."""
+        rows = max(0, int(queued_rows))
+        with self._lock:
+            if self._depth_ewma is None:
+                self._depth_ewma = float(rows)
+            else:
+                self._depth_ewma += self._alpha * (rows - self._depth_ewma)
+            self._ticks += 1
+            self._last = LoadSignals(
+                queued_rows=rows,
+                queue_depth_ewma=self._depth_ewma,
+                service_rate_rows_s=service_rate_rows_s,
+                predicted_wait_s=predicted_wait_s,
+                admission_level=int(admission_level),
+                ticks=self._ticks)
+            return self._last
+
+    def snapshot(self) -> LoadSignals:
+        """Latest signals without advancing state (all-zero pre-traffic)."""
+        with self._lock:
+            if self._last is None:
+                return LoadSignals(queued_rows=0, queue_depth_ewma=0.0,
+                                   service_rate_rows_s=None,
+                                   predicted_wait_s=None,
+                                   admission_level=0, ticks=0)
+            return self._last
 
 
 class AdmissionController:

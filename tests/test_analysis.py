@@ -277,6 +277,32 @@ def test_beat_coverage_out_of_scope_dirs_not_flagged(tmp_path):
     assert scratch_findings(pkg, "beat-coverage") == []
 
 
+def test_beat_coverage_catches_beatless_arbiter_loop(tmp_path):
+    # ISSUE 17 planted matrix row: an elastic-plane-shaped control loop
+    # (tick + sleep, pipeline/) that never beats the lease is exactly
+    # the hang the watchdog cannot distinguish from a slow rebalance —
+    # the real arbiter loop (pipeline/plane.py ``ElasticPlane.run``)
+    # beats at its progress point and must stay covered
+    pkg = _plant(tmp_path, "pipeline/arbiter.py", """
+        import time
+        from sparse_coding_tpu.resilience import lease
+
+        def run_bad(plane, poll_s, stop):
+            while not stop():
+                plane.tick()
+                time.sleep(poll_s)
+
+        def run_good(plane, poll_s, stop):
+            while not stop():
+                plane.tick()
+                lease.beat()
+                time.sleep(poll_s)
+        """)
+    hits = scratch_findings(pkg, "beat-coverage")
+    assert len(hits) == 1, hits
+    assert "arbiter.py:6" in hits[0] and "never heartbeats" in hits[0]
+
+
 def test_beat_coverage_nested_beat_covers_outer_loop(tmp_path):
     # ast-nested: a beat anywhere inside the loop body (incl. an inner
     # loop) is a progress point for every enclosing polling loop

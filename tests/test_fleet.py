@@ -141,6 +141,59 @@ def test_placement_never_preempts_for_scavenger_and_not_twice():
     assert plan2.blocked == ("i",)
 
 
+def test_placement_no_futile_preemption_when_head_can_never_fit():
+    # head wants 4 slices; draining EVERY scavenger frees only 1 against
+    # a need of 3 (a pinned batch run holds the rest) — SIGTERMing the
+    # sweep would free nothing the head can use, so NO victims planned
+    plan = plan_placement(
+        [_rs("pinned", BATCH, state="placed", slices=3, placed_seq=1),
+         _rs("s", SCAVENGER, state="placed", slices=1, placed_seq=2),
+         _rs("head", INTERACTIVE, slices=4, seq=3)],
+        n_slices=4, max_concurrent=4)
+    assert plan.preempt == ()  # futility guard: useful work survives
+    assert plan.place == () and plan.blocked == ("head",)
+    # ... and the guard also covers the concurrency axis: enough
+    # reclaimable capacity, but non-victim runs hold every slot
+    plan2 = plan_placement(
+        [_rs("b1", BATCH, state="placed", slices=1, placed_seq=1),
+         _rs("b2", BATCH, state="placed", slices=1, placed_seq=2),
+         _rs("s", SCAVENGER, state="placed", slices=2, placed_seq=3),
+         _rs("head", INTERACTIVE, slices=2, seq=4)],
+        n_slices=4, max_concurrent=2)
+    assert plan2.preempt == () and plan2.blocked == ("head",)
+    # sanity: give it a big enough victim set and preemption still fires
+    plan3 = plan_placement(
+        [_rs("s1", SCAVENGER, state="placed", slices=2, placed_seq=1),
+         _rs("s2", SCAVENGER, state="placed", slices=2, placed_seq=2),
+         _rs("head", INTERACTIVE, slices=4, seq=3)],
+        n_slices=4, max_concurrent=4)
+    assert set(plan3.preempt) == {"s1", "s2"}
+
+
+def test_reclaim_scavengers_preempts_newest_until_under_share(tmp_path):
+    # the elastic plane's reclaim entrypoint: only scavenger-class PLACED
+    # runs are victims, newest placement first, stopping at the share
+    sched = _sched(tmp_path, n_slices=4)
+    q = sched.queue
+    for i, name in enumerate(("s1", "s2", "b")):
+        sched.enqueue(name, kind="command",
+                      priority=SCAVENGER if name != "b" else BATCH,
+                      argv=["true"], done_path=tmp_path / f"{name}.out")
+        q.append("run.place", name)
+    assert sched.reclaim_scavengers(4) == []  # already under the share
+    signaled = sched.reclaim_scavengers(1)
+    assert signaled == ["s2"]  # newest scavenger first; batch untouched
+    st = q.replay()
+    assert st.runs["s2"].state == "preempting"
+    assert st.runs["s1"].state == "placed"
+    assert st.runs["b"].state == "placed"
+    # idempotent: the PREEMPTING victim is not signaled twice
+    assert sched.reclaim_scavengers(1) == []
+    # share of zero drains the remaining scavenger too, never the batch
+    assert sched.reclaim_scavengers(0) == ["s1"]
+    assert q.replay().runs["b"].state == "placed"
+
+
 def test_placement_concurrency_cap_preempts_scavenger_for_slot():
     # capacity fits but the one-jax-process cap is taken by a scavenger:
     # the interactive head still drains it

@@ -792,3 +792,113 @@ def test_fleet_place_kill_scheduler_restart_no_loss_no_double_place(
     # state a restarted scheduler acted on
     assert FleetQueue(fleet_dir / "fleet_queue.jsonl").replay().summary() \
         == st2.summary()
+
+
+# -- elastic plane chaos (ISSUE 17) -------------------------------------------
+
+
+_PLANE_DRIVER = """
+import json, sys
+from sparse_coding_tpu.pipeline import FleetScheduler
+from sparse_coding_tpu.pipeline.plane import ElasticPlane, PlaneConfig
+from sparse_coding_tpu.serve.slo import LoadSignals
+
+fleet_dir, phase = sys.argv[1], sys.argv[2]
+clock = lambda: 1234.5  # fixed: the journal must be bitwise-replayable
+sched = FleetScheduler(fleet_dir, n_slices=2, clock=clock)
+high = LoadSignals(queued_rows=500, queue_depth_ewma=500.0,
+                   service_rate_rows_s=None, predicted_wait_s=None,
+                   admission_level=0, ticks=1)
+plane = ElasticPlane(fleet_dir, PlaneConfig(n_slices=2, hold_ticks=2),
+                     fleet=sched, signals_fn=lambda: high)
+if phase == "ramp":
+    sched.enqueue("scav", kind="command", priority="scavenger",
+                  argv=["true"], done_path=fleet_dir + "/scav.out")
+    sched.queue.append("run.place", "scav")
+    plane.reconcile()  # base split: serve 1 / fleet 1 — the sweep fits
+    plane.tick()       # vote 1: streak forming
+    plane.tick()       # vote 2: record durable -> BARRIER -> apply
+split = plane.reconcile()
+print(json.dumps({"serve": split.serve_slices,
+                  "fleet": split.fleet_slices,
+                  "n_slices": sched.n_slices}))
+"""
+
+
+def test_plane_rebalance_kill_arbiter_restart_reconciles(tmp_path):
+    """ISSUE 17 chaos case: SIGKILL a REAL arbiter process exactly at
+    the ``plane.rebalance`` crash barrier — the rebalance record is
+    durable in the fleet queue journal, NEITHER consumer has been
+    resized (no preemption signaled, the placed scavenger untouched). A
+    restarted arbiter replays the journal and reconciles: the fleet's
+    share shrinks, the scavenger is reclaimed through the checkpoint
+    path, and the finished journal is record-for-record identical to an
+    uninterrupted arbiter's (modulo pid/timestamp) — no slice
+    double-booked, no tenant lost."""
+    import subprocess
+    import sys
+
+    from sparse_coding_tpu.pipeline import FleetQueue
+    from sparse_coding_tpu.pipeline.supervisor import REPO_ROOT
+
+    def drive(fleet_dir, phase, extra_env):
+        return subprocess.run(
+            [sys.executable, "-c", _PLANE_DRIVER, str(fleet_dir), phase],
+            cwd=str(REPO_ROOT), env={**os.environ, **extra_env},
+            capture_output=True, text=True, timeout=120)
+
+    def essence(fleet_dir):
+        # journal records minus process identity (ts/pid) and with the
+        # per-run directory normalized out of the enqueue spec
+        out = []
+        for r in FleetQueue(fleet_dir / "fleet_queue.jsonl") \
+                .journal.records():
+            r = {k: v for k, v in r.items() if k not in ("ts", "pid")}
+            out.append(json.loads(
+                json.dumps(r).replace(str(fleet_dir), "<fleet>")))
+        return out
+
+    # golden: the same ramp, never killed
+    gold_dir = tmp_path / "gold_fleet"
+    gold = drive(gold_dir, "ramp", {})
+    assert gold.returncode == 0, gold.stdout + gold.stderr
+    assert json.loads(gold.stdout.strip().splitlines()[-1]) == \
+        {"serve": 2, "fleet": 0, "n_slices": 0}
+
+    # run 1: the arbiter dies BY SIGKILL at the rebalance barrier
+    fleet_dir = tmp_path / "fleet"
+    killed = drive(fleet_dir, "ramp",
+                   {crash_mod.ENV_VAR: "plane.rebalance:nth=1"})
+    assert killed.returncode == -9, killed.stdout + killed.stderr
+    queue = FleetQueue(fleet_dir / "fleet_queue.jsonl")
+    records = queue.journal.records()
+    planes = [r for r in records if r["event"] == "plane.rebalance"]
+    assert len(planes) == 1  # the decision IS durable...
+    assert planes[0]["detail"]["serve_slices"] == 2
+    assert planes[0]["detail"]["fleet_slices"] == 0
+    # ...but neither consumer was resized: no preemption ever signaled,
+    # the scavenger still holds its placement
+    assert not any(r["event"] == "run.preempt" for r in records)
+    assert queue.replay().runs["scav"].state == "placed"
+
+    # run 2: a fresh arbiter, no plan — replay + reconcile
+    done = drive(fleet_dir, "reconcile", {})
+    assert done.returncode == 0, done.stdout + done.stderr
+    assert json.loads(done.stdout.strip().splitlines()[-1]) == \
+        {"serve": 2, "fleet": 0, "n_slices": 0}
+    st = queue.replay()
+    assert st.runs["scav"].state == "preempting"  # checkpoint path, live
+    # the dead arbiter's decision was applied, not re-decided: still
+    # exactly ONE rebalance record, and the journal matches the golden
+    # run record-for-record
+    assert essence(fleet_dir) == essence(gold_dir)
+    # no double-booking at any instant: every recorded split covers the
+    # pod exactly
+    for rec in queue.journal.records():
+        if rec["event"] == "plane.rebalance":
+            assert rec["detail"]["serve_slices"] \
+                + rec["detail"]["fleet_slices"] == 2
+    # replay is pure: folding the journal bytes again gives the same
+    # state the restarted arbiter acted on
+    assert FleetQueue(fleet_dir / "fleet_queue.jsonl").replay() \
+        .runs["scav"].state == "preempting"

@@ -1,0 +1,489 @@
+"""Elastic resource plane suite (ISSUE 17, tier-1).
+
+Three layers, cheapest first:
+
+- **pure decision logic**: PlaneConfig validation, the
+  ``desired_replicas`` vote, hysteresis against flapping signals, the
+  deterministic ``LoadTracker`` EWMA fold (no clocks — scripted
+  observation streams drive everything exactly), and ``replay_split``
+  over hand-written journals;
+- **arbiter mechanics on duck-typed consumers**: rebalance records are
+  durable and bitwise-replayable, ``reconcile()`` drives a fresh
+  arbiter to the recorded split, the convergent apply shrinks the
+  fleet's share (reclaim) BEFORE widening the gateway and releases
+  drained replicas a tick later;
+- **the tide drill** (the ISSUE 17 acceptance bar, real gateway + real
+  fleet): a traffic ramp scales the replica set up — preempting a live
+  scavenger sweep through the SIGTERM checkpoint path with ZERO
+  admitted interactive requests lost and ZERO steady-state compiles
+  (the spare warms off the xcache manifest) — then traffic ebbs, the
+  slices return to the fleet, the sweep resumes bitwise-identical to
+  an unpreempted run, and one merged ``obs.report`` shows the whole
+  cycle.
+
+The ``plane.rebalance`` SIGKILL chaos case lives with the kill matrix
+in tests/test_pipeline_chaos.py; the plane fault-site entries in
+tests/test_resilience.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.pipeline import FleetScheduler
+from sparse_coding_tpu.pipeline.fleet_queue import QUEUE_NAME, FleetQueue
+from sparse_coding_tpu.pipeline.plane import (
+    REBALANCE_EVENT,
+    ElasticPlane,
+    Hysteresis,
+    PlaneConfig,
+    PlaneSplit,
+    desired_replicas,
+    replay_split,
+)
+from sparse_coding_tpu.resilience import lease as lease_mod
+from sparse_coding_tpu.serve.slo import (
+    INTERACTIVE,
+    SCAVENGER,
+    LoadSignals,
+    LoadTracker,
+)
+
+pytestmark = pytest.mark.fleet
+
+POLL_S = 0.05
+WALL_S = 120.0
+
+
+@pytest.fixture(autouse=True)
+def _hermetic(monkeypatch):
+    monkeypatch.delenv("SPARSE_CODING_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("SPARSE_CODING_CRASH_PLAN", raising=False)
+    monkeypatch.delenv(lease_mod.ENV_PATH, raising=False)
+    monkeypatch.delenv("SPARSE_CODING_XCACHE_DIR", raising=False)
+    yield
+    lease_mod.configure(None)
+
+
+def _signals(queued=0, ewma=0.0, level=0, ticks=1):
+    return LoadSignals(queued_rows=queued, queue_depth_ewma=ewma,
+                       service_rate_rows_s=100.0, predicted_wait_s=None,
+                       admission_level=level, ticks=ticks)
+
+
+def _cfg(**kw):
+    kw.setdefault("n_slices", 4)
+    kw.setdefault("replica_slices", 1)
+    kw.setdefault("up_queued_rows", 64.0)
+    kw.setdefault("down_queued_rows", 8.0)
+    kw.setdefault("hold_ticks", 2)
+    return PlaneConfig(**kw)
+
+
+# -- pure decision logic ------------------------------------------------------
+
+
+def test_plane_config_validation():
+    with pytest.raises(ValueError, match="n_slices and replica_slices"):
+        PlaneConfig(n_slices=0)
+    with pytest.raises(ValueError, match="never scales to zero"):
+        PlaneConfig(n_slices=2, min_replicas=0)
+    with pytest.raises(ValueError, match="outgrow the pod"):
+        PlaneConfig(n_slices=2, replica_slices=2, min_replicas=2)
+    with pytest.raises(ValueError, match="down_queued_rows"):
+        PlaneConfig(n_slices=2, up_queued_rows=1.0, down_queued_rows=2.0)
+    cfg = _cfg(n_slices=5, replica_slices=2, max_replicas=0)
+    assert cfg.replica_cap() == 2  # floor division: 5 // 2
+    assert _cfg(max_replicas=3).replica_cap() == 3
+    assert cfg.clamp(99) == 2 and cfg.clamp(0) == 1
+
+
+def test_desired_replicas_votes_one_step_clamped():
+    cfg = _cfg()
+    # smoothed depth above the threshold (or any brownout rung): up
+    assert desired_replicas(_signals(ewma=100.0), 1, cfg) == 2
+    assert desired_replicas(_signals(level=1), 1, cfg) == 2
+    assert desired_replicas(_signals(ewma=100.0), 4, cfg) == 4  # capped
+    # quiet AND empty AND ladder open: down, floored at min_replicas
+    assert desired_replicas(_signals(), 2, cfg) == 1
+    assert desired_replicas(_signals(), 1, cfg) == 1
+    # in the dead band (or queue non-empty): hold
+    assert desired_replicas(_signals(ewma=32.0), 2, cfg) == 2
+    assert desired_replicas(_signals(queued=5), 2, cfg) == 2
+    assert desired_replicas(_signals(level=1, ewma=0.0), 2, cfg) == 3
+
+
+def test_hysteresis_confirms_streaks_and_blocks_flap():
+    h = Hysteresis(2)
+    assert [h.vote(v) for v in (1, 1, 1, 1)] == [0, 1, 0, 1]
+    # a flapping signal never completes a streak — no thrash
+    h2 = Hysteresis(2)
+    assert [h2.vote(v) for v in (1, -1, 1, -1, 1, 0, 1)] == [0] * 7
+    # direction change resets; neutral resets
+    h3 = Hysteresis(2)
+    assert [h3.vote(v) for v in (1, 0, 1, 1)] == [0, 0, 0, 1]
+    assert Hysteresis(1).vote(-1) == -1  # hold_ticks=1 acts immediately
+
+
+def test_load_tracker_deterministic_fold():
+    """Satellite 2's contract: no clock reads — a scripted observation
+    sequence produces EXACT EWMA values, every time."""
+    t = LoadTracker(alpha=0.5)
+    s = [t.observe(q, service_rate_rows_s=10.0, admission_level=lvl)
+         for q, lvl in ((0, 0), (100, 0), (100, 1), (0, 0))]
+    assert [x.queue_depth_ewma for x in s] == [0.0, 50.0, 75.0, 37.5]
+    assert [x.queued_rows for x in s] == [0, 100, 100, 0]
+    assert [x.admission_level for x in s] == [0, 0, 1, 0]
+    assert [x.ticks for x in s] == [1, 2, 3, 4]
+    assert t.snapshot() == s[-1]  # snapshot never advances state
+    assert t.snapshot() == s[-1]
+    fresh = LoadTracker(alpha=0.5)
+    assert fresh.snapshot().ticks == 0  # all-zero pre-traffic
+    with pytest.raises(ValueError, match="alpha"):
+        LoadTracker(alpha=0.0)
+
+
+def test_replay_split_last_record_wins(tmp_path):
+    cfg = _cfg(n_slices=4, min_replicas=1)
+    q = FleetQueue(tmp_path / QUEUE_NAME)
+    assert replay_split(q, cfg) == PlaneSplit(1, 3, 0)  # base split
+    q.append(REBALANCE_EVENT, serve_slices=2, fleet_slices=2, reason="up")
+    rec = q.append(REBALANCE_EVENT, serve_slices=3, fleet_slices=1,
+                   reason="up")
+    split = replay_split(q, cfg)
+    assert (split.serve_slices, split.fleet_slices) == (3, 1)
+    assert split.seq == int(rec["seq"])
+    # the run-state fold never sees plane records (step="" by design)
+    assert q.replay().runs == {}
+
+
+# -- arbiter mechanics on duck-typed consumers --------------------------------
+
+
+class _FakeFleet:
+    """Duck-typed FleetScheduler: the plane touches n_slices, queue, and
+    reclaim_scavengers only."""
+
+    def __init__(self, fleet_dir):
+        self.n_slices = 0
+        self.queue = FleetQueue(Path(fleet_dir) / QUEUE_NAME)
+        self.reclaim_calls: list[int] = []
+        self.calls: list[str] = []
+
+    def reclaim_scavengers(self, max_slices):
+        self.reclaim_calls.append(max_slices)
+        self.calls.append(f"reclaim:{max_slices}")
+        return []
+
+
+class _FakeGateway:
+    """Duck-typed ServingGateway: replica-count arithmetic only."""
+
+    def __init__(self, active=1, spares=1, calls=None):
+        self.active = ["replica-0"][:active] + \
+            [f"replica-{i}" for i in range(1, active)]
+        self.spares = [f"spare-{i}" for i in range(spares)]
+        self.drained: list[str] = []
+        self.calls = calls if calls is not None else []
+
+    def active_replica_names(self):
+        return list(self.active)
+
+    def scale_up(self, n=1):
+        out = []
+        for _ in range(n):
+            if not self.spares:
+                break
+            name = self.spares.pop(0)
+            self.active.append(name)
+            out.append(name)
+        self.calls.append(f"scale_up:{len(out)}")
+        return out
+
+    def scale_down(self, n=1):
+        out = []
+        for _ in range(n):
+            if len(self.active) <= 1:
+                break
+            name = self.active.pop()
+            self.drained.append(name)
+            out.append(name)
+        self.calls.append(f"scale_down:{len(out)}")
+        return out
+
+    def reinstate(self, name):
+        if name not in self.drained:
+            raise ValueError(f"{name} not draining")
+        self.drained.remove(name)
+        self.spares.append(name)
+        self.calls.append(f"reinstate:{name}")
+
+    def load_signals(self):  # unused when signals_fn is injected
+        return _signals()
+
+
+def test_tick_scale_up_reclaims_fleet_before_widening_gateway(tmp_path):
+    """The no-double-booking ordering: on a confirmed up move the
+    fleet's share shrinks (scavenger reclaim through the checkpoint
+    path) BEFORE the gateway widens onto the freed slices — and the
+    rebalance record is durable before either."""
+    calls: list[str] = []
+    fleet = _FakeFleet(tmp_path)
+    fleet.calls = calls
+    gw = _FakeGateway(active=1, spares=1, calls=calls)
+    feed = [_signals(queued=200, ewma=200.0)] * 8
+    plane = ElasticPlane(tmp_path, _cfg(n_slices=2, hold_ticks=2),
+                         gateway=gw, fleet=fleet,
+                         signals_fn=lambda: feed.pop(0))
+    out1 = plane.tick()
+    assert not out1["rebalanced"]  # hysteresis holds the first vote
+    assert fleet.n_slices == 1  # convergent apply still ran (base split)
+    out2 = plane.tick()
+    assert out2["rebalanced"] and out2["replicas"] == 2
+    assert fleet.n_slices == 0
+    assert gw.active_replica_names() == ["replica-0", "spare-0"]
+    up = calls.index("scale_up:1")
+    assert "reclaim:0" in calls[:up]  # fleet shrank first
+    # the record was durable before the apply: replay agrees
+    split = plane.split()
+    assert (split.serve_slices, split.fleet_slices) == (2, 0)
+
+
+def test_tick_scale_down_drains_then_releases_next_tick(tmp_path):
+    fleet = _FakeFleet(tmp_path)
+    gw = _FakeGateway(active=2, spares=0)
+    feed = ([_signals(queued=0, ewma=0.0)] * 8)
+    plane = ElasticPlane(tmp_path, _cfg(n_slices=2, hold_ticks=2),
+                         gateway=gw, fleet=fleet,
+                         signals_fn=lambda: feed.pop(0))
+    # seed a recorded 2-replica split so there is something to shrink
+    plane.queue.append(REBALANCE_EVENT, serve_slices=2, fleet_slices=0,
+                       reason="up")
+    plane.tick()
+    out = plane.tick()
+    assert out["rebalanced"] and out["replicas"] == 1
+    assert gw.drained == ["replica-1"]  # drained, NOT yet a spare
+    assert fleet.n_slices == 1  # the freed slice went back to the fleet
+    plane.tick()  # the drain window passes
+    assert gw.drained == [] and "replica-1" in gw.spares
+
+
+def test_reconcile_drives_fresh_arbiter_to_recorded_split(tmp_path):
+    """The restart path the chaos case SIGKILLs into: a dead arbiter's
+    durable record is applied by a FRESH plane before any new votes."""
+    fleet = _FakeFleet(tmp_path)
+    fleet.queue.append(REBALANCE_EVENT, serve_slices=2, fleet_slices=0,
+                       reason="up")
+    gw = _FakeGateway(active=1, spares=1)
+    plane = ElasticPlane(tmp_path, _cfg(n_slices=2), gateway=gw,
+                         fleet=fleet, signals_fn=_signals)
+    split = plane.reconcile()
+    assert (split.serve_slices, split.fleet_slices) == (2, 0)
+    assert fleet.n_slices == 0
+    assert gw.active_replica_names() == ["replica-0", "spare-0"]
+    # idempotent: reconciling again changes nothing
+    plane.reconcile()
+    assert gw.active_replica_names() == ["replica-0", "spare-0"]
+
+
+def test_plane_requires_a_load_source(tmp_path):
+    with pytest.raises(ValueError, match="signals_fn"):
+        ElasticPlane(tmp_path, _cfg())
+
+
+# -- the tide drill (ISSUE 17 acceptance bar) ---------------------------------
+
+
+_SCAV_BODY = """
+import json, pathlib, signal, sys, time
+state = pathlib.Path({state!r}); out = pathlib.Path({out!r})
+flag = []
+signal.signal(signal.SIGTERM, lambda *a: flag.append(1))
+vals = json.loads(state.read_text()) if state.exists() else []
+pathlib.Path({started!r}).write_text("up")
+while len(vals) < 40:
+    vals.append((len(vals) * 7919) % 104729)
+    time.sleep(0.03)
+    if flag:
+        state.write_text(json.dumps(vals)); sys.exit(75)
+out.write_text(json.dumps(vals)); sys.exit(0)
+"""
+
+
+def _wait(predicate, timeout_s=60.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"{what} never happened")
+
+
+def test_tide_drill_scale_up_zero_lost_zero_compiles_bitwise_resume(
+        tmp_path):
+    """ISSUE 17's done bar, end to end on the real consumers: ramp →
+    scale-up (live scavenger preempted via SIGTERM checkpoint, warm
+    spare activated at zero compiles, zero admitted interactive
+    requests lost) → ebb → scale-down (slices back to the fleet, sweep
+    resumes bitwise-identical to an unpreempted run) — one merged
+    obs.report showing the whole cycle."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_coding_tpu import obs, xcache
+    from sparse_coding_tpu.models import UntiedSAE
+    from sparse_coding_tpu.obs.report import (
+        build_fleet_report,
+        format_fleet_report,
+    )
+    from sparse_coding_tpu.serve import ModelRegistry, ServingGateway
+
+    fleet_dir = tmp_path / "fleet"
+    scav_out, ckpt = tmp_path / "scav.out", tmp_path / "scav.ckpt"
+    started = tmp_path / "scav.started"
+    body = _SCAV_BODY.format(state=str(ckpt), out=str(scav_out),
+                             started=str(started))
+
+    # golden: the SAME sweep, standalone and never preempted
+    gold_out, gold_ckpt = tmp_path / "gold.out", tmp_path / "gold.ckpt"
+    gold = subprocess.run(
+        [sys.executable, "-c",
+         _SCAV_BODY.format(state=str(gold_ckpt), out=str(gold_out),
+                           started=str(tmp_path / "gold.started"))],
+        capture_output=True, text=True, timeout=120)
+    assert gold.returncode == 0, gold.stderr
+    golden_bytes = gold_out.read_bytes()
+
+    d, n = 16, 32
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    ld = UntiedSAE(
+        encoder=jax.random.randint(k1, (n, d), -4, 5).astype(jnp.float32),
+        encoder_bias=jax.random.randint(k2, (n,), -4, 5).astype(
+            jnp.float32),
+        dictionary=jax.random.randint(k3, (n, d), -4, 5).astype(
+            jnp.float32))
+    reg = ModelRegistry()
+    reg.register("int", ld)
+    nrng = np.random.default_rng(3)
+    payloads = [np.asarray(nrng.integers(-4, 5, (8, d)), np.float32)
+                for _ in range(16)]
+    enc = jax.jit(lambda m, x: m.encode(x))
+    expected = [np.asarray(enc(ld, jnp.asarray(p))) for p in payloads]
+
+    xcache.enable(tmp_path / "xc")
+    prev_sink = obs.configure_sink(
+        obs.EventSink(fleet_dir / "obs" / f"drill-{os.getpid()}.jsonl"))
+    sched = FleetScheduler(fleet_dir, n_slices=1, poll_s=POLL_S,
+                           max_wall_s=WALL_S)
+    try:
+        with ServingGateway(reg, n_replicas=1, n_spares=1, buckets=(8,),
+                            ops=("encode",), max_wait_ms=0.5) as gw:
+            gw.warmup()  # writes the xcache warmup manifest
+            # prime the service-rate EWMA with a little real traffic
+            for p in payloads[:4]:
+                gw.query("int", p, priority=INTERACTIVE, timeout=60)
+
+            cfg = PlaneConfig(n_slices=2, replica_slices=1,
+                              min_replicas=1, max_replicas=2,
+                              up_queued_rows=4.0, down_queued_rows=2.0,
+                              hold_ticks=2)
+            plane = ElasticPlane(fleet_dir, cfg, gateway=gw, fleet=sched)
+            plane.reconcile()  # base split: serve 1 / fleet 1
+            assert sched.n_slices == 1
+
+            sched.enqueue("scav", priority=SCAVENGER, kind="command",
+                          argv=[sys.executable, "-c", body],
+                          done_path=scav_out)
+            result: dict = {}
+            thread = threading.Thread(
+                target=lambda: result.update(sched.run()), daemon=True)
+            thread.start()
+            queue = FleetQueue(fleet_dir / QUEUE_NAME)
+            _wait(started.exists, what="scavenger child start")
+
+            # ---- the tide rises: hold the dispatcher, pile up depth
+            compiles_before = obs.counter("jax.compiles").value
+            gw.pause()
+            futs = [gw.submit("int", p, priority=INTERACTIVE)
+                    for p in payloads[4:]]
+            out1 = plane.tick()
+            assert not out1["rebalanced"]  # hysteresis: one vote held
+            out2 = plane.tick()
+            assert out2["rebalanced"] and out2["replicas"] == 2
+            assert gw.active_replica_names() == ["replica-0", "spare-0"]
+            gw.resume()
+            # ZERO admitted interactive requests lost, results exact
+            for f, want in zip(futs, expected[4:]):
+                np.testing.assert_array_equal(
+                    np.asarray(f.result(timeout=60)), want)
+            # ZERO steady-state compiles: the spare came off the manifest
+            assert obs.counter("jax.compiles").value == compiles_before
+
+            # the live sweep checkpointed out through SIGTERM
+            _wait(lambda: queue.replay().runs["scav"].state == "queued",
+                  what="scavenger checkpoint-release")
+            assert ckpt.exists() and not scav_out.exists()
+
+            # ---- the tide ebbs: queue empty, EWMA decays, plane
+            # shrinks serving and hands the slice back to the fleet
+            for _ in range(80):
+                out = plane.tick()
+                if out["split"].serve_slices == 1:
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError("plane never scaled back down")
+            assert sched.n_slices == 1
+            plane.tick()  # drain window passes: replica back to spare
+            states = {nm: gw.replica(nm).state
+                      for nm in gw.replica_names()}
+            assert sorted(states.values()) == ["active", "spare"]
+
+            thread.join(timeout=WALL_S)
+            assert not thread.is_alive()
+            snap = gw.stats()
+            obs.flush_metrics(registry=gw.metrics.registry)
+
+        # the sweep finished, bitwise-identical to the unpreempted run
+        assert result == {"scav": "done"}
+        assert scav_out.read_bytes() == golden_bytes
+        assert snap["request_errors"] == {}
+        assert snap["gateway"]["shed"][INTERACTIVE] == 0
+
+        # journal tells the cycle in order: place → preempt → release
+        # (preempted) → re-place → release (done), with the two plane
+        # records bracketing the preemption
+        records = queue.journal.records()
+        events = [(r["event"], r.get("step")) for r in records]
+        assert events.index(("run.preempt", "scav")) < \
+            len(events) - 1 - events[::-1].index(("run.place", "scav"))
+        planes = [r for r in records if r["event"] == REBALANCE_EVENT]
+        assert [p["detail"]["reason"] for p in planes] == ["up", "down"]
+        assert all(p["detail"]["serve_slices"]
+                   + p["detail"]["fleet_slices"] == 2 for p in planes)
+        outcomes = [r["detail"]["outcome"] for r in records
+                    if r["event"] == "run.release"]
+        assert outcomes == ["preempted", "done"]
+
+        # one merged report shows the whole tide cycle
+        fleet_rep = build_fleet_report(fleet_dir)
+        assert fleet_rep["states"] == {"scav": "done"}
+        assert [r["reason"] for r in fleet_rep["plane"]["records"]] == \
+            ["up", "down"]
+        assert fleet_rep["plane"]["rebalances"] >= 2
+        assert fleet_rep["plane"]["reclaims"] >= 1
+        assert fleet_rep["plane"]["serve_slices"] == 1
+        assert fleet_rep["plane"]["fleet_slices"] == 1
+        assert fleet_rep["scheduler"]["preemptions"] >= 1
+        rendered = format_fleet_report(fleet_rep)
+        assert "plane:" in rendered and "scav: done" in rendered
+    finally:
+        obs.configure_sink(prev_sink)
+        xcache.disable()

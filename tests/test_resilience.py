@@ -1643,6 +1643,104 @@ def test_fleet_preempt_fault_counted_victim_untouched_then_retried(
     assert events.count("run.preempt") == 1
 
 
+# -- elastic-plane fault matrix (ISSUE 17, docs/ARCHITECTURE.md §21) ----------
+
+
+def test_plane_rebalance_fault_journal_untouched_retry_identical(tmp_path):
+    """``plane.rebalance`` matrix entry: the injected failure fires
+    BEFORE the durable journal append — no record lands, the error is
+    counted (``plane.rebalance_errors``), and once the hysteresis
+    streak re-forms the retried rebalance produces a journal
+    byte-identical to one that never faulted (fixed clock)."""
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.pipeline.plane import ElasticPlane, PlaneConfig
+    from sparse_coding_tpu.serve.slo import LoadSignals
+
+    clock = lambda: 1234.5  # noqa: E731
+    high = LoadSignals(queued_rows=500, queue_depth_ewma=500.0,
+                       service_rate_rows_s=None, predicted_wait_s=None,
+                       admission_level=0, ticks=1)
+    cfg = PlaneConfig(n_slices=2, hold_ticks=2)
+
+    def plane(d):
+        return ElasticPlane(tmp_path / d, cfg, signals_fn=lambda: high,
+                            clock=clock)
+
+    p = plane("fleet")
+    before = obs.counter("plane.rebalance_errors").value
+    with inject(site="plane.rebalance", nth=1, error="OSError") as plan:
+        p.tick()                             # vote 1: streak forming
+        out = p.tick()                       # vote 2: confirmed, faulted
+        assert not out["rebalanced"]
+    assert plan.fired_count("plane.rebalance") == 1
+    assert obs.counter("plane.rebalance_errors").value == before + 1
+    assert not p.queue.path.exists()  # nothing durable happened
+    p.tick()                          # streak re-forms...
+    assert p.tick()["rebalanced"]     # ...and the retry goes durable
+    golden = plane("golden")
+    golden.tick()
+    assert golden.tick()["rebalanced"]
+    assert p.queue.path.read_bytes() == golden.queue.path.read_bytes()
+
+
+def test_plane_scale_fault_counted_replica_set_unchanged_self_heals(
+        tmp_path):
+    """``plane.scale`` matrix entry: an injected gateway-scale failure
+    is counted (``plane.scale_errors``) and leaves the replica set
+    untouched; the convergent apply self-heals on the next pass — no
+    compensation logic, the recorded split simply wins."""
+    from sparse_coding_tpu import obs
+    from sparse_coding_tpu.pipeline.plane import (
+        REBALANCE_EVENT,
+        ElasticPlane,
+        PlaneConfig,
+    )
+    from sparse_coding_tpu.serve.slo import LoadSignals
+
+    class _Gateway:
+        def __init__(self):
+            self.active = ["replica-0"]
+            self.spares = ["spare-0"]
+
+        def active_replica_names(self):
+            return list(self.active)
+
+        def scale_up(self, n=1):
+            moved = self.spares[:n]
+            del self.spares[:n]
+            self.active += moved
+            return moved
+
+        def scale_down(self, n=1):
+            return []
+
+        def reinstate(self, name):
+            raise KeyError(name)
+
+        def load_signals(self):
+            return LoadSignals(queued_rows=0, queue_depth_ewma=0.0,
+                               service_rate_rows_s=None,
+                               predicted_wait_s=None, admission_level=0,
+                               ticks=1)
+
+    gw = _Gateway()
+    p = ElasticPlane(tmp_path, PlaneConfig(n_slices=2), gateway=gw)
+    p.queue.append(REBALANCE_EVENT, serve_slices=2, fleet_slices=0,
+                   reason="up")
+    before = obs.counter("plane.scale_errors").value
+    with inject(site="plane.scale", nth=1, error="OSError") as plan:
+        p.reconcile()
+    assert plan.fired_count("plane.scale") == 1
+    assert obs.counter("plane.scale_errors").value == before + 1
+    assert gw.active == ["replica-0"]  # the faulted action changed nothing
+    p.reconcile()  # convergent apply: the next pass drives to the split
+    assert gw.active == ["replica-0", "spare-0"]
+    # idempotent once converged: the fault site no longer even arms
+    with inject(site="plane.scale", nth=1, error="OSError") as plan2:
+        p.reconcile()
+    assert plan2.fired_count("plane.scale") == 0
+
+
 # -- feature-catalog fault matrix (ISSUE 16, docs/ARCHITECTURE.md §20) --------
 
 
