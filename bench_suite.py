@@ -1081,6 +1081,53 @@ def bench_plane_tide(quick: bool) -> None:
         shutil.rmtree(fleet_dir, ignore_errors=True)
 
 
+def bench_fsck_scan(quick: bool) -> None:
+    """Durable-state audit scenario (ISSUE 18): full-verification fsck
+    throughput over a synthetic run tree — a real ChunkWriter store
+    (every chunk digest recomputed), leases, and torn-tail-checked
+    event streams. The audit is host-side and jax-free by construction
+    (the operator's wedged-tunnel tool), so off TPU the row is labeled
+    ``cpu-fallback`` only to keep the ledger gate from diffing it
+    against an on-chip round — the number itself is wall-clock truth
+    on this host either way."""
+    import tempfile
+
+    from sparse_coding_tpu.data.chunk_store import ChunkWriter
+    from sparse_coding_tpu.fsck import scan_tree
+    from sparse_coding_tpu.resilience.lease import seed_lease
+
+    on_tpu = jax.default_backend() == "tpu"
+    backend_label = jax.default_backend() if on_tpu else "cpu-fallback"
+    d, rows = (64, 32_768) if quick else (128, 262_144)
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as td:
+        base = Path(td)
+        w = ChunkWriter(base / "chunks", d,
+                        chunk_size_gb=(rows // 8) * d * 2 / 2**30,
+                        dtype="float16")
+        w.add(rng.standard_normal((rows, d), dtype=np.float32)
+              .astype(np.float16))
+        w.finalize()
+        seed_lease(base / "leases" / "bench.json", pid=os.getpid())
+        (base / "events.jsonl").write_bytes(
+            b"".join(json.dumps({"seq": i}).encode() + b"\n"
+                     for i in range(2000)))
+        n_bytes = sum(p.stat().st_size for p in base.rglob("*")
+                      if p.is_file())
+        scan_tree(base)  # warm the page cache: time digesting, not disk
+        t0 = time.perf_counter()
+        report = scan_tree(base)
+        wall = time.perf_counter() - t0
+        assert report.clean, [f"{f.path}: {f.detail}"
+                              for f in report.findings]
+        _emit("fsck_scan", n_bytes / wall / 2**20, "MB/s",
+              variant="full_verify", backend=backend_label,
+              n_files=sum(1 for p in base.rglob("*") if p.is_file()),
+              tree_mb=round(n_bytes / 2**20, 2), wall_s=round(wall, 4),
+              **({} if on_tpu
+                 else {"note": "host-side audit on a cpu-fallback run"}))
+
+
 def bench_mesh_scale(quick: bool) -> None:
     """ISSUE 15 scenario: whole-step vs two-stage fused A/B at 1 device
     and on the ("model", "data") mesh spanning every visible device —
@@ -1240,7 +1287,7 @@ def main() -> None:
                   bench_chunk_io, bench_ingest_soak, bench_streaming_eval,
                   bench_guardian_soak, bench_perf_probe, bench_gateway,
                   bench_catalog, bench_fleet_soak, bench_plane_tide,
-                  bench_mesh_scale, bench_seq_parallel):
+                  bench_fsck_scan, bench_mesh_scale, bench_seq_parallel):
         try:
             suite(args.quick)
         except Exception as e:

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Whole-tree durable-state audit & repair (docs/ARCHITECTURE.md §22):
+# registry-driven checkers over a run dir or fleet tree — completion-
+# marker digests, shard seals, checkpoint retention pairs, xcache entry
+# headers, catalog indexes, torn JSONL tails, tmp debris, dead leases —
+# plus the cross-checks no single reader performs (journal "done" ⇔
+# artifact verifies, store manifest ⇔ sealed shards, queue replay ⇔
+# run dirs). `--repair` applies only the provably-safe subset and
+# re-scans.
+#
+# Safe under a wedged TPU tunnel BY CONSTRUCTION: the fsck package's
+# import chain is jax-free (tests/test_fsck.py enforces it), so this is
+# exactly the tool for auditing cold state while the tunnel is dead
+# (docs/RUNBOOK_TUNNEL.md). The env strip below is belt and braces.
+#
+# Usage: scripts/fsck.sh <run-or-fleet-dir> [--repair] [--json]
+# Exit:  0 clean · 1 findings · 2 fatal findings (do NOT resume over it)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env -u PALLAS_AXON_POOL_IPS python -m sparse_coding_tpu.fsck "$@"

@@ -24,7 +24,12 @@ import json
 from pathlib import Path
 
 from sparse_coding_tpu.resilience.atomic import atomic_write_text
+from sparse_coding_tpu.resilience.errors import LedgerCorruptionError
 from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
+from sparse_coding_tpu.resilience.manifest import (
+    check_payload_digest,
+    embed_payload_digest,
+)
 
 LEDGER_NAME = "quarantine.json"
 
@@ -44,12 +49,21 @@ def load_quarantine(folder: str | Path) -> dict[int, dict]:
     ledger; ``{}`` when missing. Atomic writes make torn ledgers
     impossible, so an unreadable file means no valid ledger — treated as
     empty rather than poisoning the reader (the chunk digests themselves
-    still catch any corruption the lost ledger knew about)."""
+    still catch any corruption the lost ledger knew about). A ledger that
+    PARSES but fails its embedded payload digest is different: the file
+    is lying about which chunks are quarantined, and acting on it could
+    un-hole a poisoned chunk — raise a typed
+    :class:`LedgerCorruptionError` instead (fsck reports the same file
+    as ``INCONSISTENT``). Digest-less legacy ledgers load unverified."""
+    path = ledger_path(folder)
     try:
-        raw = json.loads(ledger_path(folder).read_text())
-        return {int(k): dict(v) for k, v in raw.get("chunks", {}).items()}
+        raw = json.loads(path.read_text())
+        chunks = {int(k): dict(v) for k, v in raw.get("chunks", {}).items()}
     except (OSError, ValueError, TypeError, AttributeError):
         return {}
+    if check_payload_digest(raw) == "mismatch":
+        raise LedgerCorruptionError(path, "payload digest mismatch")
+    return chunks
 
 
 def record_quarantine(folder: str | Path, chunk_index: int, reason: str,
@@ -89,7 +103,8 @@ def _rewrite(folder: Path, entries: dict[int, dict]) -> None:
         except FileNotFoundError:
             pass
         return
-    payload = {"version": 1,
-               "chunks": {str(k): entries[k] for k in sorted(entries)}}
+    payload = embed_payload_digest(
+        {"version": 1,
+         "chunks": {str(k): entries[k] for k in sorted(entries)}})
     fault_point("ledger.write")
     atomic_write_text(path, json.dumps(payload, indent=2, sort_keys=True))

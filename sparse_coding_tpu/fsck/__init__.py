@@ -1,0 +1,34 @@
+"""Whole-tree durable-state audit & repair (docs/ARCHITECTURE.md §22).
+
+Lazy exports (PEP 562) keep ``import sparse_coding_tpu.fsck`` itself
+free of numpy/registry imports until a symbol is touched; the full
+scan path stays jax-free by contract (tests/test_fsck.py).
+"""
+
+from __future__ import annotations
+
+_LAZY_ATTRS = {
+    "scan_tree": ("sparse_coding_tpu.fsck.core", "scan_tree"),
+    "run_fsck": ("sparse_coding_tpu.fsck.core", "run_fsck"),
+    "artifact_roots": ("sparse_coding_tpu.fsck.core", "artifact_roots"),
+    "repair_findings": ("sparse_coding_tpu.fsck.repair", "repair_findings"),
+    "Finding": ("sparse_coding_tpu.fsck.findings", "Finding"),
+    "Report": ("sparse_coding_tpu.fsck.findings", "Report"),
+    "FINDING_KINDS": ("sparse_coding_tpu.fsck.findings", "FINDING_KINDS"),
+}
+
+__all__ = sorted(_LAZY_ATTRS)
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _LAZY_ATTRS[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_ATTRS))

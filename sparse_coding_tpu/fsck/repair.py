@@ -1,0 +1,187 @@
+"""The provably-safe repair subset: mechanical actions whose safety is
+an invariant of the write paths, not a judgment call.
+
+Only findings carrying a ``repair`` action id are touched; everything
+else — above all ``INCONSISTENT`` — is an operator decision and repair
+REFUSES it by construction (the action table simply has no entry that
+could destroy contradictory evidence). Actions:
+
+``debris.sweep``      unlink ``.{name}.tmp.{pid}`` debris (dead owner —
+                      the committed file is complete either way)
+``lease.drop``        unlink a dead pid's (or unreadable) lease file —
+                      the same takeover lease_state() already permits
+``journal.trim_tail`` drop the unterminated final line of a JSONL file
+                      (strict readers skip it already; trimming makes
+                      the lenient ones safe too)
+``xcache.drop_entry`` unlink an entry that fails its own header digest,
+                      then reconcile the LRU manifest (worst case: one
+                      fresh compile)
+``xcache.reconcile``  rebuild the LRU manifest deterministically from
+                      the entry files (bookkeeping, never ground truth)
+``ckpt.drop_staging`` remove ``ckpt_staging/`` leftovers (the resuming
+                      sweep discards them anyway)
+``ckpt.fallback_prev`` remove a corrupt live ``ckpt/`` set whose
+                      ``ckpt_prev/`` fallback verified sound — resume
+                      then replays from the last-good set, exactly the
+                      path the retention pair exists to provide
+
+Crash-safety is the same contract as every other durable writer:
+``crash_barrier("fsck.repair")`` fires immediately before EACH action's
+durable mutation, every action is idempotent (``missing_ok``,
+rebuild-compare-skip), and actions apply in sorted order — so SIGKILL
+mid-repair, restart, re-run converges on the bitwise-identical repaired
+tree (tests/test_pipeline_chaos.py, marker ``chaos``).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import shutil
+from pathlib import Path
+
+from sparse_coding_tpu.fsck.findings import Finding
+from sparse_coding_tpu.resilience.atomic import atomic_write_bytes, atomic_write_text
+from sparse_coding_tpu.resilience.crash import crash_barrier, register_crash_site
+
+register_crash_site("fsck.repair",
+                    "fsck repair engine — immediately before applying one "
+                    "repair action's durable mutation (fsck/repair.py); "
+                    "SIGKILL here, restart, and the re-run repairs the "
+                    "remainder to a bitwise-identical tree")
+
+
+def _resolve(root: Path, finding: Finding) -> Path:
+    p = Path(finding.path)
+    return p if p.is_absolute() else root / p
+
+
+def _unlink(path: Path) -> None:
+    path.unlink(missing_ok=True)
+
+
+def _rmtree(path: Path) -> None:
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def _trim_tail(path: Path) -> None:
+    """Keep everything through the last newline; a file with no newline
+    at all becomes empty (its only line is the torn one)."""
+    try:
+        data = path.read_bytes()
+    except OSError:
+        return
+    if not data or data.endswith(b"\n"):
+        return
+    cut = data.rfind(b"\n")
+    kept = data[: cut + 1] if cut >= 0 else b""
+    atomic_write_bytes(path, kept)
+
+
+def _reconcile_manifest(cache_dir: Path) -> None:
+    """Deterministic LRU-manifest rebuild from the ``exec/`` directory:
+    surviving keys keep their metadata, orphans are adopted with neutral
+    metadata and increasing ``last_used`` in sorted-key order, ghosts
+    drop. Rebuilding twice (or crashing between) yields identical bytes,
+    which is what lets the chaos drill compare repaired trees bitwise."""
+    exec_dir = cache_dir / "exec"
+    man_path = cache_dir / "manifest.json"
+    old = None
+    old_entries: dict = {}
+    clock = 0
+    try:
+        old = json.loads(man_path.read_text())
+        old_entries = dict(old.get("entries", {}))
+        clock = int(old.get("clock", 0))
+    except (OSError, ValueError, TypeError, AttributeError):
+        old = None
+    keys = sorted(p.name[: -len(".bin")] for p in exec_dir.glob("*.bin")) \
+        if exec_dir.is_dir() else []
+    entries: dict = {}
+    for key in keys:
+        size = (exec_dir / f"{key}.bin").stat().st_size
+        rec = old_entries.get(key)
+        if isinstance(rec, dict) and int(rec.get("size", -1)) == size:
+            entries[key] = rec
+        else:
+            clock += 1
+            entries[key] = {"size": size, "compile_s": 0.0, "label": "",
+                            "last_used": clock}
+    if isinstance(old, dict) and old_entries == entries \
+            and old.get("clock") == clock:
+        return  # already reconciled — idempotent re-run writes nothing
+    payload = {"clock": clock, "entries": entries}
+    atomic_write_text(man_path, json.dumps(payload, indent=2, sort_keys=True))
+
+
+_CKPT_SET_RE = re.compile(r"^ckpt(_prev|_staging)?$")
+
+
+def _ckpt_set_dir(root: Path, finding: Finding, name: str) -> Path | None:
+    """Walk up from the finding's path to the checkpoint-set dir called
+    ``name`` (findings may point at a file inside the set)."""
+    p = _resolve(root, finding)
+    for cand in (p, *p.parents):
+        if cand.name == name:
+            return cand
+    return None
+
+
+def repair_findings(root: str | Path,
+                    findings: list[Finding]) -> list[dict]:
+    """Apply every finding's named repair action; returns the applied
+    action list (sorted, deduped — the report's ``repaired`` field).
+    Unknown action ids are skipped loudly in the return value rather
+    than raised: a newer scanner must never brick an older repairer."""
+    root = Path(root).resolve()
+    # dedupe: several findings can demand the same mutation (e.g. every
+    # corrupt file in a live ckpt set resolves to one fallback_prev)
+    planned: dict[tuple[str, str], Finding] = {}
+    for f in findings:
+        if not f.repair:
+            continue
+        target = _resolve(root, f)
+        if f.repair == "xcache.drop_entry":
+            key = (f.repair, str(target))
+        elif f.repair == "xcache.reconcile":
+            # findings point either at exec/<key>.bin or at a file in the
+            # cache dir itself (manifest.json) — normalize to the cache dir
+            cache = (target.parent.parent if target.parent.name == "exec"
+                     else target.parent)
+            key = (f.repair, str(cache))
+        elif f.repair == "ckpt.fallback_prev":
+            d = _ckpt_set_dir(root, f, "ckpt")
+            if d is None:
+                continue
+            key = (f.repair, str(d))
+        elif f.repair == "ckpt.drop_staging":
+            d = _ckpt_set_dir(root, f, "ckpt_staging")
+            if d is None:
+                continue
+            key = (f.repair, str(d))
+        else:
+            key = (f.repair, str(target))
+        planned.setdefault(key, f)
+
+    applied: list[dict] = []
+    for (action, target_s), f in sorted(planned.items()):
+        target = Path(target_s)
+        crash_barrier("fsck.repair")
+        if action == "debris.sweep" or action == "lease.drop":
+            _unlink(target)
+        elif action == "journal.trim_tail":
+            _trim_tail(target)
+        elif action == "xcache.drop_entry":
+            _unlink(target)
+            _reconcile_manifest(target.parent.parent)
+        elif action == "xcache.reconcile":
+            _reconcile_manifest(target)
+        elif action == "ckpt.drop_staging" or action == "ckpt.fallback_prev":
+            _rmtree(target)
+        else:
+            applied.append({"action": action, "path": f.path,
+                            "applied": False,
+                            "note": "unknown repair action — skipped"})
+            continue
+        applied.append({"action": action, "path": f.path, "applied": True})
+    return sorted(applied, key=lambda a: (a["action"], a["path"]))

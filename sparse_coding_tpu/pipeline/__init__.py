@@ -51,7 +51,8 @@ _LAZY_ATTRS = {
     "replay_split": ("sparse_coding_tpu.pipeline.plane", "replay_split"),
 }
 for _name in ("STEP_EXIT_HALTED", "STEP_EXIT_PREEMPTED",
-              "ConcurrentSupervisorError", "PipelineError", "Step",
+              "ConcurrentSupervisorError", "PipelineError",
+              "PreflightAuditError", "Step",
               "StepFailed", "StepHalted", "StepHung", "StepPreempted",
               "Supervisor", "build_pipeline", "build_sharded_pipeline",
               "load_or_create_run_id", "step_argv", "supervise_bench"):

@@ -43,6 +43,7 @@ children own.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
@@ -169,6 +170,25 @@ class FleetScheduler:
         if done_path is not None:
             spec["done_path"] = str(done_path)
         return self.queue.enqueue(name, spec, self.n_slices)
+
+    # -- cold-state audit ------------------------------------------------------
+
+    def fsck_sweep(self, repair: bool = False):
+        """Audit the whole fleet tree — queue, scheduler leases, every
+        tenant's ``runs/<name>/`` dir and its artifact roots — with fsck
+        (docs/ARCHITECTURE.md §22) and leave a queue breadcrumb. Meant
+        for a COLD fleet (no live scheduler lease); per-tenant rot then
+        also halts at that tenant's own resume preflight, but the sweep
+        sees cross-tenant state (orphan run dirs, queue⇔dir drift) no
+        single worker can."""
+        from sparse_coding_tpu.fsck.core import run_fsck
+
+        report = run_fsck(self.fleet_dir, repair=repair)
+        self.queue.append(
+            "scheduler.fsck", findings=len(report.findings),
+            fatal=[f.path for f in report.fatal],
+            repaired=len(report.repaired))
+        return report
 
     # -- scheduler lease (contention + takeover) ------------------------------
 
@@ -555,9 +575,21 @@ def main(argv=None) -> int:
     worker = sub.add_parser("worker", help="run one placed run")
     worker.add_argument("--fleet-dir", required=True)
     worker.add_argument("--run", required=True)
+    fsck = sub.add_parser("fsck", help="audit (and optionally repair) the "
+                                       "whole fleet tree's durable state")
+    fsck.add_argument("--fleet-dir", required=True)
+    fsck.add_argument("--repair", action="store_true")
     args = parser.parse_args(argv)
     if args.cmd == "worker":
         return run_worker(args.fleet_dir, args.run, guard=entry_guard)
+    if args.cmd == "fsck":
+        report = FleetScheduler(args.fleet_dir).fsck_sweep(
+            repair=args.repair)
+        print(json.dumps({"findings": len(report.findings),
+                          "fatal": len(report.fatal),
+                          "repaired": len(report.repaired),
+                          "clean": report.clean}, sort_keys=True))
+        return 2 if report.fatal else (0 if report.clean else 1)
     summary = FleetScheduler(
         args.fleet_dir, n_slices=args.slices,
         max_concurrent=args.max_concurrent, poll_s=args.poll_s,

@@ -79,6 +79,9 @@ class FleetState:
 
     runs: dict[str, RunState] = field(default_factory=dict)
     specs: dict[str, dict] = field(default_factory=dict)
+    # torn/corrupt queue lines skipped by the replay fold (scan_records
+    # contract) — nonzero after a crash mid-append; fsck reports the tail
+    skipped_lines: int = 0
 
     def terminal(self) -> bool:
         return all(r.state in TERMINAL for r in self.runs.values())
@@ -157,9 +160,14 @@ class FleetQueue:
 
     def replay(self) -> FleetState:
         """Fold the queue file into the current state — the ONLY way any
-        scheduler (first, restarted, or taken-over) knows the fleet."""
-        st = FleetState()
-        for rec in self.journal.records():
+        scheduler (first, restarted, or taken-over) knows the fleet.
+        Torn-tail safe: a crash mid-append can leave an unterminated final
+        line that still PARSES as JSON (a truncated ``{"seq": 12}`` reads
+        as ``{"seq": 1}``), so the fold uses the strict newline-terminated
+        reader and counts what it skipped instead of folding it."""
+        recs, skipped = self.journal.scan_records()
+        st = FleetState(skipped_lines=skipped)
+        for rec in recs:
             event = rec.get("event", "")
             name = rec.get("step", "")
             detail = rec.get("detail", {}) or {}

@@ -63,16 +63,55 @@ class RunJournal:
     def records(self) -> list[dict]:
         """All records, oldest first. Tolerant of a malformed line (cannot
         happen under the atomic append, but a journal is also an operator-
-        edited artifact during incident response — never die over it)."""
+        edited artifact during incident response — never die over it).
+        Unlike :meth:`scan_records` this accepts an unterminated final
+        line: an operator edit may legitimately drop the trailing newline,
+        and the appender must still see that record to continue seq."""
         if not self.path.exists():
             return []
         out = []
         for line in self.path.read_bytes().splitlines():
             try:
-                out.append(json.loads(line))
+                rec = json.loads(line)
             except ValueError:
                 continue
+            if isinstance(rec, dict):
+                out.append(rec)
         return out
+
+    def scan_records(self) -> tuple[list[dict], int]:
+        """``(records, skipped_lines)`` under the obs event readers'
+        torn-tail contract (obs/sink.py::scan_events): only newline-
+        terminated, JSON-parsing dict lines count; an unterminated tail
+        is skipped and counted, never folded. The distinction matters
+        because a TRUNCATED json line can still parse as valid JSON
+        (``{"seq": 12}`` torn to ``{"seq": 1}``) — any reader folding the
+        journal into state (fleet queue replay, fsck) must use this, not
+        :meth:`records`."""
+        if not self.path.exists():
+            return [], 0
+        raw = self.path.read_bytes()
+        out: list[dict] = []
+        skipped = 0
+        if not raw:
+            return out, skipped
+        lines = raw.split(b"\n")
+        torn_tail = lines.pop()  # b"" when the last append committed
+        if torn_tail:
+            skipped += 1
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+            else:
+                skipped += 1
+        return out, skipped
 
     def _next_seq(self) -> int:
         recs = self.records()

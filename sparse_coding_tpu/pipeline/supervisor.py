@@ -70,6 +70,10 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 STEP_EXIT_PREEMPTED = 75
 STEP_EXIT_HALTED = 78
 
+# set to "0" to skip the resume preflight audit (fsck §22) — the perf
+# escape hatch for trees too large to re-digest on every restart
+PREFLIGHT_ENV = "SPARSE_CODING_FSCK_PREFLIGHT"
+
 
 def load_or_create_run_id(run_dir: str | Path) -> str:
     """The run's correlation ID (docs/ARCHITECTURE.md §12): minted once
@@ -153,6 +157,28 @@ class ConcurrentSupervisorError(PipelineError):
     """A live, heartbeating lease for a step this supervisor wants to run:
     another supervisor (or a still-running orphan) owns the run. Refusing
     is the safe default — two writers on one run dir is undefined."""
+
+
+class PreflightAuditError(PipelineError):
+    """The resume preflight audit (fsck, docs/ARCHITECTURE.md §22) found
+    durable state that contradicts itself — e.g. a completion artifact
+    that exists but no longer verifies, chunk bytes not matching their
+    recorded digests, or both checkpoint sets damaged. Resuming over it
+    could silently diverge, so the supervisor halts typed, naming the
+    rotted artifacts; the operator triages with
+    ``python -m sparse_coding_tpu.fsck <run_dir>`` (and ``--repair`` for
+    the provably-safe subset)."""
+
+    def __init__(self, run_dir, findings):
+        named = "; ".join(f"{f.path} ({f.kind}: {f.detail})"
+                          for f in findings[:4])
+        more = f" (+{len(findings) - 4} more)" if len(findings) > 4 else ""
+        super().__init__(
+            f"preflight audit of {run_dir} found {len(findings)} fatal "
+            f"finding(s): {named}{more} — refusing to resume; triage "
+            f"with `python -m sparse_coding_tpu.fsck {run_dir}`")
+        self.run_dir = Path(run_dir)
+        self.findings = list(findings)
 
 
 @dataclass
@@ -270,6 +296,10 @@ class Supervisor:
         """Execute every step not already complete; returns
         ``{step: "done" | "skipped"}``. Raises typed errors on failure —
         after which calling ``run()`` again (same or new process) resumes."""
+        # BEFORE the first journal append: append normalizes an
+        # unterminated tail by terminating it, which would commit a
+        # torn (possibly still-parsing) line the audit should see raw
+        self._preflight_audit()
         self.journal.append("run.start",
                             detail_steps=[s.name for s in self.steps])
         self._sink = obs.EventSink(
@@ -304,6 +334,39 @@ class Supervisor:
             obs.flush_metrics(sink=self._sink)
             self._sink.close()
             self._sink = None
+
+    def _preflight_audit(self) -> None:
+        """Resume preflight (docs/ARCHITECTURE.md §22): a run dir that
+        already holds journal records is a RESUME over cold durable
+        state, and the supervisor's own ``done()`` probes only check
+        existence — so before admitting any work, fsck the run's whole
+        durable footprint. Fatal findings (INCONSISTENT state a resume
+        could silently diverge over) halt typed via
+        :class:`PreflightAuditError` — never silently. Scan-only:
+        repair stays an explicit operator action.
+        ``SPARSE_CODING_FSCK_PREFLIGHT=0`` disables (perf escape hatch
+        for trees too large to re-digest every restart)."""
+        if os.environ.get(PREFLIGHT_ENV, "1") == "0":
+            return
+        jpath = self.run_dir / "journal.jsonl"
+        try:
+            if not jpath.exists() or jpath.stat().st_size == 0:
+                return  # fresh run: nothing durable to audit yet
+        except OSError:
+            return
+        from sparse_coding_tpu.fsck.core import run_fsck
+
+        t0 = obs.monotime()
+        report = run_fsck(self.run_dir, repair=False)
+        self.journal.append(
+            "run.fsck", findings=len(report.findings),
+            fatal=[f.path for f in report.fatal])
+        self._record_span("pipeline.preflight_fsck",
+                          obs.monotime() - t0,
+                          ok=not report.fatal,
+                          findings=len(report.findings))
+        if report.fatal:
+            raise PreflightAuditError(self.run_dir, report.fatal)
 
     def _append_perf_ledger(self) -> None:
         """One durable perf summary row per completed run (ISSUE 12):

@@ -102,6 +102,12 @@ CRASH_SITES: dict[str, str] = {
                        "fleet queue journal, NEITHER consumer resized "
                        "yet (pipeline/plane.py) — the no-double-booking "
                        "reconcile instant",
+    # seeded like the fleet sites: `python -m sparse_coding_tpu.fsck
+    # --repair` children parse the env plan at their first barrier
+    "fsck.repair": "fsck repair engine — immediately before applying one "
+                   "repair action's durable mutation (fsck/repair.py); "
+                   "SIGKILL here, restart, and the re-run repairs the "
+                   "remainder to a bitwise-identical tree",
 }
 
 

@@ -57,6 +57,21 @@ class CheckpointCorruptionError(ResilienceError):
         self.reason = reason
 
 
+class LedgerCorruptionError(ResilienceError):
+    """A small JSON ledger (``guardian.json``, ``quarantine.json``) fails
+    its embedded payload digest (resilience/manifest.py
+    ``check_payload_digest``). Atomic writes make torn ledgers impossible,
+    so a mismatch means bit rot or a hand-edit that forgot to re-digest —
+    either way the recorded incidents can no longer be trusted and the
+    reader must not silently act on them. ``fsck`` reports the same
+    condition as an ``INCONSISTENT`` finding."""
+
+    def __init__(self, path: str | Path, reason: str):
+        super().__init__(f"ledger corrupt at {path}: {reason}")
+        self.path = Path(path)
+        self.reason = reason
+
+
 class UndersizedInputError(ResilienceError, ValueError):
     """A streaming statistic consumed ZERO complete batches (input smaller
     than ``batch_size``) — the result would be silent NaN, which is exactly

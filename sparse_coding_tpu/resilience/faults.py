@@ -119,6 +119,14 @@ FAULT_SITES: dict[str, str] = {
     "plane.rebalance": "elastic plane — before the durable "
                        "plane.rebalance record append "
                        "(pipeline/plane.py)",
+    # seeded here (not only registered at fsck import): the supervisor's
+    # resume preflight audits BEFORE any step child spawns, and a CLI
+    # fsck process may parse an env plan at its very first read
+    "fsck.scan": "fsck audit read — every artifact byte-read the checkers "
+                 "perform (fsck/checkers.py _read_bytes); mode=error "
+                 "degrades the file to an 'unreadable' finding, "
+                 "mode=corrupt flips a read byte so a sound tree reports "
+                 "digest mismatches (scan must still complete)",
 }
 
 

@@ -28,6 +28,14 @@ from sparse_coding_tpu.resilience.errors import CheckpointCorruptionError
 
 MANIFEST_SUFFIX = ".manifest.json"
 
+# Key under which small JSON ledgers (guardian.json, quarantine.json)
+# embed a digest of their own payload. The digest covers the canonical
+# ``json.dumps(body, sort_keys=True)`` bytes of every OTHER key, so any
+# writer that dumps with sorted keys produces a verifiable file and a
+# digest-less legacy file stays loadable (readers treat absence as
+# "unverified", fsck flags it STALE).
+PAYLOAD_DIGEST_KEY = "payload_sha256"
+
 
 def bytes_sha256(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()
@@ -48,6 +56,32 @@ def file_sha256(path: str | Path, block: int = 1 << 20) -> str:
             if not chunk:
                 return h.hexdigest()
             h.update(chunk)
+
+
+def _payload_body_digest(payload: dict) -> str:
+    body = {k: payload[k] for k in payload if k != PAYLOAD_DIGEST_KEY}
+    return bytes_sha256(json.dumps(body, sort_keys=True).encode())
+
+
+def embed_payload_digest(payload: dict) -> dict:
+    """Return ``payload`` with :data:`PAYLOAD_DIGEST_KEY` set to the
+    sha256 of its canonical dump. Pure — the input dict is not mutated,
+    and re-embedding an already-digested payload is idempotent."""
+    out = {k: payload[k] for k in payload if k != PAYLOAD_DIGEST_KEY}
+    out[PAYLOAD_DIGEST_KEY] = _payload_body_digest(out)
+    return out
+
+
+def check_payload_digest(payload) -> str:
+    """``"ok"`` (digest present and matches), ``"absent"`` (legacy
+    digest-less payload — loadable, unverified), or ``"mismatch"``.
+    Non-dict payloads are ``"mismatch"`` — they cannot carry a digest."""
+    if not isinstance(payload, dict):
+        return "mismatch"
+    want = payload.get(PAYLOAD_DIGEST_KEY)
+    if want is None:
+        return "absent"
+    return "ok" if _payload_body_digest(payload) == want else "mismatch"
 
 
 def manifest_path(target: str | Path) -> Path:

@@ -58,6 +58,11 @@ from sparse_coding_tpu.resilience.crash import crash_barrier, register_crash_sit
 from sparse_coding_tpu.resilience.errors import (
     ChunkCorruptionError,
     DivergenceHaltError,
+    LedgerCorruptionError,
+)
+from sparse_coding_tpu.resilience.manifest import (
+    check_payload_digest,
+    embed_payload_digest,
 )
 from sparse_coding_tpu.resilience.faults import (
     InjectedFault,
@@ -207,12 +212,21 @@ class Guardian:
     def _load(self) -> dict:
         try:
             raw = json.loads(self.path.read_text())
-            if isinstance(raw, dict) and raw.get("version") == 1:
-                raw.setdefault("members", {})
-                raw.setdefault("rollbacks", {})
-                return raw
         except (OSError, ValueError):
-            pass
+            return {"version": 1, "members": {}, "rollbacks": {}}
+        if isinstance(raw, dict) and raw.get("version") == 1:
+            # a parse-able ledger failing its embedded digest is bit rot
+            # or a hand-edit: resuming on fabricated quarantines/rollback
+            # counts could halt a healthy run (or trust a diverged
+            # member), so the mismatch is typed, never silent. Legacy
+            # digest-less ledgers load unverified (fsck flags them STALE).
+            if check_payload_digest(raw) == "mismatch":
+                raise LedgerCorruptionError(self.path,
+                                            "payload digest mismatch")
+            raw.pop("payload_sha256", None)
+            raw.setdefault("members", {})
+            raw.setdefault("rollbacks", {})
+            return raw
         return {"version": 1, "members": {}, "rollbacks": {}}
 
     def _write(self) -> None:
@@ -225,8 +239,10 @@ class Guardian:
 
         if jax.process_index() != 0:
             return
-        atomic_write_text(self.path,
-                          json.dumps(self._state, indent=2, sort_keys=True))
+        atomic_write_text(
+            self.path,
+            json.dumps(embed_payload_digest(self._state), indent=2,
+                       sort_keys=True))
 
     @property
     def quarantined_members(self) -> dict[str, dict]:

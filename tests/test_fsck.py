@@ -1,0 +1,657 @@
+"""fsck: whole-tree durable-state audit & repair (docs/ARCHITECTURE.md
+§22).
+
+Trees are hand-built through the same write-side primitives production
+uses (array/bytes digests, packed xcache entries, journal appends,
+payload-digest ledgers), then rotted in controlled ways; the suite pins
+the finding taxonomy, the provably-safe repair subset (with bitwise
+idempotence), the torn-tail hardening of the fleet queue fold, the
+payload-digest verification of the small JSON ledgers, and the
+SIGKILL-mid-atomic-write debris story end to end. The rot-fuzzing resume
+drill itself lives in the chaos matrix (tests/test_pipeline_chaos.py).
+"""
+
+import hashlib
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.fsck import Finding, run_fsck, scan_tree
+from sparse_coding_tpu.fsck.findings import (
+    CORRUPT,
+    INCONSISTENT,
+    MISSING,
+    ORPHAN,
+    STALE,
+    TORN,
+)
+from sparse_coding_tpu.fsck.repair import repair_findings
+from sparse_coding_tpu.pipeline.journal import RunJournal
+from sparse_coding_tpu.resilience.lease import seed_lease
+from sparse_coding_tpu.resilience.manifest import (
+    array_sha256,
+    bytes_sha256,
+    embed_payload_digest,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+DEAD_PID = 4999999  # beyond kernel.pid_max defaults — never a live process
+
+
+# -- tree builders (the real write-side formats, by hand) ---------------------
+
+
+def _chunk_store(d: Path, n: int = 3, dim: int = 4) -> dict:
+    d.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(0)
+    digests = {}
+    for i in range(n):
+        arr = rng.normal(size=(8, dim)).astype(np.float32)
+        np.save(d / f"{i}.npy", arr)
+        digests[str(i)] = array_sha256(arr)
+    meta = {"n_chunks": n, "activation_dim": dim, "chunk_digests": digests}
+    (d / "meta.json").write_text(json.dumps(meta, indent=2, sort_keys=True))
+    return meta
+
+
+def _xcache(d: Path, keys=("k1", "k2")) -> dict:
+    from sparse_coding_tpu.xcache.store import _pack_entry
+
+    (d / "exec").mkdir(parents=True, exist_ok=True)
+    entries = {}
+    for i, key in enumerate(keys):
+        blob = _pack_entry(f"payload-{key}".encode(),
+                           {"compile_s": 1.0, "label": key})
+        (d / "exec" / f"{key}.bin").write_bytes(blob)
+        entries[key] = {"size": len(blob), "compile_s": 1.0, "label": key,
+                        "last_used": i + 1}
+    (d / "manifest.json").write_text(json.dumps(
+        {"clock": len(entries), "entries": entries}, indent=2,
+        sort_keys=True))
+    return entries
+
+
+def _catalog(d: Path) -> None:
+    d.mkdir(parents=True, exist_ok=True)
+    arr = np.arange(6, dtype=np.float32)
+    np.save(d / "mcs.npy", arr)
+    files = {"mcs.npy": bytes_sha256((d / "mcs.npy").read_bytes())}
+    (d / "index.json").write_text(json.dumps(
+        {"version": 1, "files": files}, indent=2, sort_keys=True))
+
+
+def _shard_store(d: Path, n_shards: int = 2) -> None:
+    d.mkdir(parents=True, exist_ok=True)
+    shards = []
+    total = 0
+    for i in range(n_shards):
+        name = f"shard-{i:03d}"
+        meta = _chunk_store(d / name, n=2)
+        total += meta["n_chunks"]
+        meta_digest = bytes_sha256((d / name / "meta.json").read_bytes())
+        (d / name / "shard.digest").write_text(
+            json.dumps({"meta_sha256": meta_digest}, sort_keys=True) + "\n")
+        shards.append({"name": name, "n_chunks": meta["n_chunks"],
+                       "meta_sha256": meta_digest})
+    (d / "manifest.json").write_text(json.dumps(
+        {"version": 1, "kind": "sharded_chunk_store", "n_shards": n_shards,
+         "n_chunks": total, "shards": shards}, indent=2, sort_keys=True))
+
+
+def _ckpt_set(d: Path, payload: bytes = b"model-bytes-v1") -> None:
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "m.msgpack").write_bytes(payload)
+    (d / "m.msgpack.meta.json").write_text(json.dumps(
+        {"payload_sha256": bytes_sha256(payload)}, sort_keys=True))
+
+
+def _guardian(d: Path) -> None:
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "guardian.json").write_text(json.dumps(
+        embed_payload_digest({"version": 1, "members": {},
+                              "rollbacks": {}}),
+        indent=2, sort_keys=True))
+
+
+def _run_dir(d: Path, eval_dir: Path) -> RunJournal:
+    """A supervisor run dir whose journal certifies step ``eval`` done,
+    with ``pipeline.json`` pointing at the eval artifact root."""
+    d.mkdir(parents=True, exist_ok=True)
+    eval_dir.mkdir(parents=True, exist_ok=True)
+    (eval_dir / "eval.json").write_text(json.dumps({"fvu": 0.5}))
+    (d / "pipeline.json").write_text(json.dumps(
+        {"eval": {"output_folder": str(eval_dir)}}, indent=2,
+        sort_keys=True))
+    j = RunJournal(d / "journal.jsonl", clock=lambda: 0.0)
+    j.append("run.start")
+    j.append("step.done", "eval")
+    return j
+
+
+def _kinds(report) -> set:
+    return {(f.kind, f.artifact_class) for f in report.findings}
+
+
+def _by_class(report, cls: str) -> list:
+    return [f for f in report.findings if f.artifact_class == cls]
+
+
+def _tree_digests(root: Path, exclude=("fsck",)) -> dict:
+    out = {}
+    for p in sorted(root.rglob("*")):
+        if p.is_file() and not any(part in exclude for part in
+                                   p.relative_to(root).parts):
+            out[str(p.relative_to(root))] = hashlib.sha256(
+                p.read_bytes()).hexdigest()
+    return out
+
+
+# -- the clean contract -------------------------------------------------------
+
+
+def test_sound_tree_of_every_class_scans_clean(tmp_path):
+    """One of each artifact class, built sound: zero findings — the
+    acceptance shape of `fsck <fresh tree>`."""
+    _chunk_store(tmp_path / "chunks")
+    _guardian(tmp_path / "sweep")
+    _ckpt_set(tmp_path / "sweep" / "ckpt")
+    _ckpt_set(tmp_path / "sweep" / "ckpt_prev")
+    _shard_store(tmp_path / "shards")
+    _xcache(tmp_path / "xcache")
+    _catalog(tmp_path / "catalog")
+    _run_dir(tmp_path / "run", tmp_path / "eval")
+    report = run_fsck(tmp_path)
+    assert report.clean, [f"{f.kind} {f.path}: {f.detail}"
+                          for f in report.findings]
+    # the report was written last, atomically, and is excluded from
+    # auditing itself
+    assert (tmp_path / "fsck" / "report.json").exists()
+    again = run_fsck(tmp_path)
+    assert again.clean
+
+
+def test_report_bytes_are_deterministic(tmp_path):
+    _chunk_store(tmp_path / "chunks")
+    (tmp_path / "chunks" / f".rot.tmp.{DEAD_PID}").write_bytes(b"x")
+    r1 = run_fsck(tmp_path, write_report=False)
+    r2 = run_fsck(tmp_path, write_report=False)
+    assert r1.to_json() == r2.to_json()
+    assert not r1.clean
+
+
+# -- per-class detection ------------------------------------------------------
+
+
+def test_chunk_bitflip_missing_and_orphan(tmp_path):
+    store = tmp_path / "chunks"
+    meta = _chunk_store(store, n=3)
+    raw = bytearray((store / "1.npy").read_bytes())
+    raw[-1] ^= 0x01
+    (store / "1.npy").write_bytes(bytes(raw))
+    (store / "2.npy").unlink()
+    np.save(store / "9.npy", np.zeros(2, dtype=np.float32))
+    report = scan_tree(tmp_path)
+    kinds = _kinds(report)
+    assert (INCONSISTENT, "chunk_store") in kinds  # the bitflip
+    assert (MISSING, "chunk_store") in kinds       # the deletion
+    assert (ORPHAN, "chunk_store") in kinds        # 9.npy beyond n_chunks
+    assert any(f.fatal for f in _by_class(report, "chunk_store"))
+    assert len(meta["chunk_digests"]) == 3
+
+
+def test_quarantined_chunk_is_a_hole_not_a_defect(tmp_path):
+    from sparse_coding_tpu.data.ledger import record_quarantine
+
+    store = tmp_path / "chunks"
+    _chunk_store(store, n=3)
+    (store / "1.npy").write_bytes(b"poison")
+    record_quarantine(store, 1, "digest mismatch", "1.npy")
+    report = scan_tree(tmp_path)
+    assert not _by_class(report, "chunk_store"), report.findings
+
+
+def test_ledger_digest_mismatch_is_fatal_and_typed(tmp_path):
+    """Satellite: guardian.json / quarantine.json now carry an embedded
+    payload digest — a parse-able ledger failing it raises typed on load
+    and is an INCONSISTENT fsck finding; digest-less legacy files load
+    but are flagged STALE."""
+    from sparse_coding_tpu.data.ledger import load_quarantine
+    from sparse_coding_tpu.resilience.errors import LedgerCorruptionError
+
+    store = tmp_path / "chunks"
+    _chunk_store(store, n=2)
+    payload = embed_payload_digest(
+        {"version": 1, "chunks": {"1": {"reason": "r", "file": "1.npy"}}})
+    payload["chunks"]["0"] = {"reason": "forged", "file": "0.npy"}
+    (store / "quarantine.json").write_text(json.dumps(payload))
+    with pytest.raises(LedgerCorruptionError):
+        load_quarantine(store)
+    report = scan_tree(tmp_path)
+    assert (INCONSISTENT, "quarantine_ledger") in _kinds(report)
+    assert any(f.fatal for f in _by_class(report, "quarantine_ledger"))
+
+    gdir = tmp_path / "sweep"
+    _guardian(gdir)
+    graw = json.loads((gdir / "guardian.json").read_text())
+    graw["rollbacks"] = {"forged": {"count": 3}}
+    (gdir / "guardian.json").write_text(json.dumps(graw))
+    report = scan_tree(tmp_path)
+    assert (INCONSISTENT, "guardian_ledger") in _kinds(report)
+
+    # legacy digest-less ledgers: load fine, flagged STALE
+    graw.pop("payload_sha256")
+    graw.pop("rollbacks")
+    (gdir / "guardian.json").write_text(json.dumps(
+        {"version": 1, "members": {}, "rollbacks": {}}))
+    report = scan_tree(gdir)
+    assert {(STALE, "guardian_ledger")} == _kinds(report)
+
+
+def test_guardian_load_raises_on_digest_mismatch(tmp_path):
+    from sparse_coding_tpu.resilience.errors import LedgerCorruptionError
+    from sparse_coding_tpu.train.guardian import Guardian
+
+    _guardian(tmp_path)
+    raw = json.loads((tmp_path / "guardian.json").read_text())
+    raw["members"] = {"forged": {"reason": "x"}}
+    (tmp_path / "guardian.json").write_text(json.dumps(raw))
+    with pytest.raises(LedgerCorruptionError):
+        Guardian(tmp_path, ensembles=[], member_names=[])
+
+
+def test_shard_manifest_cross_checks(tmp_path):
+    store = tmp_path / "shards"
+    _shard_store(store, n_shards=2)
+    # re-write one shard's meta without re-sealing: seal+manifest disagree
+    meta_p = store / "shard-001" / "meta.json"
+    meta = json.loads(meta_p.read_text())
+    meta["activation_dim"] = 999
+    meta_p.write_text(json.dumps(meta, indent=2, sort_keys=True))
+    # and plant an unlisted shard dir
+    _chunk_store(store / "shard-777", n=1)
+    report = scan_tree(tmp_path)
+    kinds = _kinds(report)
+    assert (INCONSISTENT, "shard_store") in kinds
+    assert (ORPHAN, "shard_store") in kinds
+
+
+def test_catalog_index_cross_checks(tmp_path):
+    cat = tmp_path / "catalog"
+    _catalog(cat)
+    raw = bytearray((cat / "mcs.npy").read_bytes())
+    raw[-1] ^= 0x01
+    (cat / "mcs.npy").write_bytes(bytes(raw))
+    np.save(cat / "extra.npy", np.zeros(2))
+    report = scan_tree(tmp_path)
+    kinds = _kinds(report)
+    assert (INCONSISTENT, "catalog") in kinds
+    assert (ORPHAN, "catalog") in kinds
+    assert any(f.fatal for f in _by_class(report, "catalog"))
+
+
+def test_xcache_corrupt_orphan_ghost_all_repairable(tmp_path):
+    cache = tmp_path / "xcache"
+    entries = _xcache(cache, keys=("k1", "k2", "k3"))
+    # corrupt one entry's payload (its header digest catches it)
+    p = cache / "exec" / "k1.bin"
+    raw = bytearray(p.read_bytes())
+    raw[-1] ^= 0x01
+    p.write_bytes(bytes(raw))
+    # orphan: an entry the manifest never heard of
+    from sparse_coding_tpu.xcache.store import _pack_entry
+    (cache / "exec" / "k9.bin").write_bytes(
+        _pack_entry(b"orphan", {"compile_s": 0.0, "label": "k9"}))
+    # ghost: a manifest key with no file
+    (cache / "exec" / "k3.bin").unlink()
+    report = scan_tree(tmp_path)
+    by_kind = {f.kind for f in _by_class(report, "xcache")}
+    assert by_kind == {CORRUPT, ORPHAN, STALE}
+    assert all(f.repair for f in _by_class(report, "xcache"))
+    assert not report.fatal  # every xcache defect costs at most a compile
+
+    repair_findings(tmp_path, report.findings)
+    after = scan_tree(tmp_path)
+    assert after.clean, after.findings
+    man = json.loads((cache / "manifest.json").read_text())
+    assert set(man["entries"]) == {"k2", "k9"}
+    # surviving key kept its metadata; the orphan was adopted neutrally
+    assert man["entries"]["k2"] == entries["k2"]
+    assert man["entries"]["k9"]["compile_s"] == 0.0
+
+
+def test_ckpt_live_corrupt_prev_sound_falls_back(tmp_path):
+    out = tmp_path / "sweep"
+    _ckpt_set(out / "ckpt", b"new-bytes")
+    _ckpt_set(out / "ckpt_prev", b"old-bytes")
+    (out / "ckpt" / "m.msgpack").write_bytes(b"rotted")
+    report = scan_tree(tmp_path)
+    ck = _by_class(report, "checkpoint")
+    assert [f.kind for f in ck] == [CORRUPT]
+    assert ck[0].repair == "ckpt.fallback_prev"
+    repair_findings(tmp_path, report.findings)
+    assert not (out / "ckpt").exists()
+    assert (out / "ckpt_prev" / "m.msgpack").read_bytes() == b"old-bytes"
+    assert scan_tree(tmp_path).clean
+
+
+def test_ckpt_prev_corrupt_live_sound_is_stale(tmp_path):
+    out = tmp_path / "sweep"
+    _ckpt_set(out / "ckpt")
+    _ckpt_set(out / "ckpt_prev")
+    (out / "ckpt_prev" / "m.msgpack").write_bytes(b"rotted")
+    report = scan_tree(tmp_path)
+    ck = _by_class(report, "checkpoint")
+    assert [f.kind for f in ck] == [STALE] and not report.fatal
+
+
+def test_ckpt_damage_after_final_marker_is_fatal(tmp_path):
+    """Dormant-artifact rule: once final/ exists nothing regenerates the
+    retained checkpoint sets — damage there must refuse auto-repair."""
+    out = tmp_path / "sweep"
+    _ckpt_set(out / "ckpt")
+    _ckpt_set(out / "ckpt_prev")
+    (out / "final").mkdir()
+    (out / "final" / "x_learned_dicts.pkl").write_bytes(
+        pickle.dumps([1, 2, 3]))
+    (out / "ckpt" / "m.msgpack").write_bytes(b"rotted")
+    report = scan_tree(tmp_path)
+    ck = _by_class(report, "checkpoint")
+    assert ck and all(f.kind == INCONSISTENT and f.fatal and not f.repair
+                      for f in ck)
+
+
+def test_both_ckpt_sets_corrupt_is_fatal(tmp_path):
+    out = tmp_path / "sweep"
+    _ckpt_set(out / "ckpt")
+    _ckpt_set(out / "ckpt_prev")
+    (out / "ckpt" / "m.msgpack").write_bytes(b"rot-a")
+    (out / "ckpt_prev" / "m.msgpack").write_bytes(b"rot-b")
+    report = scan_tree(tmp_path)
+    assert report.fatal and all(not f.repair
+                                for f in _by_class(report, "checkpoint"))
+
+
+def test_ckpt_staging_is_orphan_debris(tmp_path):
+    out = tmp_path / "sweep"
+    _ckpt_set(out / "ckpt")
+    _ckpt_set(out / "ckpt_staging")
+    report = scan_tree(tmp_path)
+    staging = [f for f in report.findings
+               if f.repair == "ckpt.drop_staging"]
+    assert staging
+    repair_findings(tmp_path, report.findings)
+    assert not (out / "ckpt_staging").exists()
+
+
+# -- journal cross-check ------------------------------------------------------
+
+
+def test_journal_done_with_vanished_artifact_is_stale(tmp_path):
+    _run_dir(tmp_path / "run", tmp_path / "eval")
+    (tmp_path / "eval" / "eval.json").unlink()
+    report = run_fsck(tmp_path / "run", write_report=False)
+    j = [f for f in _by_class(report, "journal") if f.kind == STALE]
+    assert j and "re-run" in j[0].detail
+    assert not report.fatal  # artifacts beat the journal: it just re-runs
+
+
+def test_journal_done_with_unverifiable_artifact_is_fatal(tmp_path):
+    """The one the supervisor cannot see: done() only checks existence,
+    so a present-but-rotted completion artifact would be silently
+    trusted. fsck makes it a fatal INCONSISTENT."""
+    _run_dir(tmp_path / "run", tmp_path / "eval")
+    (tmp_path / "eval" / "eval.json").write_text('{"fvu": 0.')  # truncated
+    report = run_fsck(tmp_path / "run", write_report=False)
+    fatal = [f for f in report.fatal if f.artifact_class == "journal"]
+    assert fatal and "eval" in fatal[0].detail
+
+
+def test_torn_journal_tail_found_and_trimmed(tmp_path):
+    j = _run_dir(tmp_path / "run", tmp_path / "eval")
+    sound = j.path.read_bytes()
+    # the hazard case: the torn line still PARSES as JSON
+    j.path.write_bytes(sound + b'{"seq": 99, "event": "step.done"')
+    report = run_fsck(tmp_path / "run", write_report=False, repair=True)
+    assert j.path.read_bytes() == sound
+    assert report.clean
+    assert any(a["action"] == "journal.trim_tail" for a in report.repaired)
+
+
+def test_dead_lease_dropped_live_lease_kept(tmp_path):
+    _run_dir(tmp_path / "run", tmp_path / "eval")
+    leases = tmp_path / "run" / "leases"
+    leases.mkdir()
+    seed_lease(leases / "dead.json", DEAD_PID, step="sweep")
+    seed_lease(leases / "live.json", os.getpid(), step="eval")
+    report = run_fsck(tmp_path / "run", write_report=False, repair=True)
+    assert not (leases / "dead.json").exists()
+    assert (leases / "live.json").exists()
+    assert report.clean
+
+
+# -- fleet queue --------------------------------------------------------------
+
+
+def test_fleet_queue_torn_tail_replay_regression(tmp_path):
+    """Satellite: the replay fold must never fold an unterminated tail —
+    even one that parses as JSON (`{"seq": 12}` torn to `{"seq": 1}`)."""
+    from sparse_coding_tpu.pipeline.fleet_queue import FleetQueue
+
+    q = FleetQueue(tmp_path / "fleet_queue.jsonl", clock=lambda: 0.0)
+    q.enqueue("runa", {"kind": "command", "argv": ["true"],
+                       "done_path": str(tmp_path / "d")}, 1)
+    q.append("run.place", "runa")
+    sound = q.path.read_bytes()
+    # the hazard case: an UNTERMINATED final line that still parses as a
+    # JSON dict (e.g. a crash truncated a longer record at a lucky byte).
+    # A lenient fold would flip runa out of PLACED on evidence that was
+    # never committed; the strict fold must skip and count it.
+    torn = json.dumps({"seq": 3, "ts": 0.0, "pid": 1,
+                       "event": "run.release", "step": "runa",
+                       "detail": {"outcome": "done"}}).encode()
+    assert json.loads(torn)  # parses — and is still not folded
+    q.path.write_bytes(sound + torn)  # no trailing newline
+    st = FleetQueue(tmp_path / "fleet_queue.jsonl").replay()
+    assert st.runs["runa"].state == "placed"
+    assert st.skipped_lines == 1
+
+
+def test_fleet_tree_cross_checks_and_sweep(tmp_path):
+    from sparse_coding_tpu.pipeline.fleet import FleetScheduler
+
+    fleet = tmp_path / "fleet"
+    sched = FleetScheduler(fleet, n_slices=1)
+    sched.enqueue("runa", argv=["true"], done_path=str(fleet / "d.json"),
+                  kind="command")
+    sched.queue.append("run.place", "runa")  # placed, but no run dir
+    (fleet / "runs" / "ghost").mkdir(parents=True)  # dir with no record
+    report = sched.fsck_sweep()
+    kinds = _kinds(report)
+    assert (MISSING, "fleet_queue") in kinds
+    assert (ORPHAN, "fleet_queue") in kinds
+    # the sweep left a queue breadcrumb
+    events = [r["event"] for r in sched.queue.journal.records()]
+    assert "scheduler.fsck" in events
+
+
+# -- debris + atomic-write SIGKILL regression ---------------------------------
+
+
+def test_sigkill_mid_atomic_write_leaves_only_sweepable_debris(tmp_path):
+    """Satellite: SIGKILL a real child between tmp-write and rename
+    (resilience/atomic.py): the destination must be untouched and the
+    only residue the `.name.tmp.<pid>` debris fsck sweeps."""
+    target = tmp_path / "state.json"
+    target.write_text("committed")
+    code = (
+        "import os, signal, sys\n"
+        "from sparse_coding_tpu.resilience import atomic\n"
+        "os.replace = lambda a, b: os.kill(os.getpid(), signal.SIGKILL)\n"
+        f"atomic.atomic_write_bytes({str(target)!r}, b'never-lands')\n"
+    )
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["PYTHONPATH"] = str(REPO)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True)
+    assert proc.returncode == -signal.SIGKILL
+    assert target.read_text() == "committed"
+    debris = list(tmp_path.glob(".state.json.tmp.*"))
+    assert len(debris) == 1
+    report = run_fsck(tmp_path, write_report=False, repair=True)
+    assert report.clean
+    assert not list(tmp_path.glob(".state.json.tmp.*"))
+    assert target.read_text() == "committed"
+
+
+def test_live_pid_debris_is_left_alone(tmp_path):
+    (tmp_path / f".x.tmp.{os.getpid()}").write_bytes(b"in-flight")
+    report = run_fsck(tmp_path, write_report=False, repair=True)
+    assert _kinds(report) == {(STALE, "debris")}
+    assert (tmp_path / f".x.tmp.{os.getpid()}").exists()
+
+
+# -- repair engine properties -------------------------------------------------
+
+
+def test_repair_is_idempotent_and_bitwise_convergent(tmp_path):
+    _chunk_store(tmp_path / "chunks")
+    (tmp_path / "chunks" / f".0.npy.tmp.{DEAD_PID}").write_bytes(b"x")
+    _xcache(tmp_path / "xcache")
+    (tmp_path / "xcache" / "exec" / "k1.bin").write_bytes(b"short")
+    (tmp_path / "events.jsonl").write_bytes(b'{"a":1}\n{"torn')
+    leases = tmp_path / "leases"
+    leases.mkdir()
+    seed_lease(leases / "gone.json", DEAD_PID)
+
+    r1 = run_fsck(tmp_path, repair=True, write_report=False)
+    assert r1.clean and len(r1.repaired) >= 4
+    assert {a["action"] for a in r1.repaired} == {
+        "debris.sweep", "journal.trim_tail", "lease.drop",
+        "xcache.drop_entry"}
+    digests = _tree_digests(tmp_path)
+    r2 = run_fsck(tmp_path, repair=True, write_report=False)
+    assert r2.clean and r2.repaired == []
+    assert _tree_digests(tmp_path) == digests
+
+
+def test_repair_refuses_inconsistent_findings(tmp_path):
+    store = tmp_path / "chunks"
+    _chunk_store(store, n=2)
+    raw = bytearray((store / "0.npy").read_bytes())
+    raw[-1] ^= 0x01
+    (store / "0.npy").write_bytes(bytes(raw))
+    before = _tree_digests(tmp_path)
+    report = run_fsck(tmp_path, repair=True, write_report=False)
+    assert report.fatal and report.repaired == []
+    assert _tree_digests(tmp_path) == before  # evidence untouched
+
+
+def test_unknown_repair_action_skips_loudly(tmp_path):
+    out = repair_findings(tmp_path, [Finding(
+        path="x", artifact_class="debris", kind=ORPHAN, detail="d",
+        repair="not.an.action")])
+    assert out == [{"action": "not.an.action", "path": "x",
+                    "applied": False,
+                    "note": "unknown repair action — skipped"}]
+
+
+# -- supervisor preflight -----------------------------------------------------
+
+
+def _noop_step():
+    from sparse_coding_tpu.pipeline import Step
+
+    return Step(name="noop", argv=["true"], done=lambda: True)
+
+
+def test_preflight_halts_typed_on_fatal_rot(tmp_path, monkeypatch):
+    from sparse_coding_tpu.pipeline import PreflightAuditError, Supervisor
+
+    monkeypatch.delenv("SPARSE_CODING_FSCK_PREFLIGHT", raising=False)
+    run = tmp_path / "run"
+    _run_dir(run, tmp_path / "eval")
+    (tmp_path / "eval" / "eval.json").write_text('{"fvu": 0.')  # rot
+    sup = Supervisor(run, [_noop_step()], heartbeat_stale_s=300.0)
+    with pytest.raises(PreflightAuditError) as exc:
+        sup.run()
+    assert "eval.json" in str(exc.value)
+    # the refusal itself was journaled (typed, never silent)
+    fsck_recs = [r for r in sup.journal.records()
+                 if r["event"] == "run.fsck"]
+    assert fsck_recs and fsck_recs[-1]["detail"]["fatal"]
+
+
+def test_preflight_passes_on_benign_findings_and_fresh_runs(tmp_path,
+                                                            monkeypatch):
+    from sparse_coding_tpu.pipeline import Supervisor
+
+    monkeypatch.delenv("SPARSE_CODING_FSCK_PREFLIGHT", raising=False)
+    fresh = Supervisor(tmp_path / "fresh", [_noop_step()],
+                       heartbeat_stale_s=300.0)
+    assert fresh.run() == {"noop": "skipped"}  # no journal yet: no audit
+
+    run = tmp_path / "run"
+    _run_dir(run, tmp_path / "eval")
+    (run / f".j.tmp.{DEAD_PID}").write_bytes(b"benign debris")
+    sup = Supervisor(run, [_noop_step()], heartbeat_stale_s=300.0)
+    assert sup.run() == {"noop": "skipped"}
+    assert any(r["event"] == "run.fsck" for r in sup.journal.records())
+
+
+def test_preflight_env_escape_hatch(tmp_path, monkeypatch):
+    from sparse_coding_tpu.pipeline import Supervisor
+
+    run = tmp_path / "run"
+    _run_dir(run, tmp_path / "eval")
+    (tmp_path / "eval" / "eval.json").write_text('{"fvu": 0.')
+    monkeypatch.setenv("SPARSE_CODING_FSCK_PREFLIGHT", "0")
+    sup = Supervisor(run, [_noop_step()], heartbeat_stale_s=300.0)
+    assert sup.run() == {"noop": "skipped"}
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_is_jax_free_and_exit_codes_hold(tmp_path):
+    """The wedged-tunnel contract: a full scan+repair through the CLI
+    entrypoint must never import jax; exit codes 0/1/2 are the
+    scripting interface."""
+    _chunk_store(tmp_path / "chunks")
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["PYTHONPATH"] = str(REPO)
+    code = (
+        "import sys\n"
+        "from sparse_coding_tpu.fsck.__main__ import main\n"
+        f"rc = main([{str(tmp_path)!r}, '--repair'])\n"
+        "assert 'jax' not in sys.modules, 'jax leaked into fsck'\n"
+        "sys.exit(rc)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout.strip().splitlines()[-1])["clean"] is True
+
+    # findings → 1; fatal → 2
+    (tmp_path / "events.jsonl").write_bytes(b'{"a":1}\n{"torn')
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparse_coding_tpu.fsck", str(tmp_path),
+         "--no-report"], env=env, capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stderr
+    raw = bytearray((tmp_path / "chunks" / "0.npy").read_bytes())
+    raw[-1] ^= 0x01
+    (tmp_path / "chunks" / "0.npy").write_bytes(bytes(raw))
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparse_coding_tpu.fsck", str(tmp_path),
+         "--json", "--no-report"], env=env, capture_output=True, text=True)
+    assert proc.returncode == 2, proc.stderr
+    full = json.loads(proc.stdout)
+    assert full["n_fatal"] >= 1 and full["version"] == 1

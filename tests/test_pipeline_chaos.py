@@ -902,3 +902,211 @@ def test_plane_rebalance_kill_arbiter_restart_reconciles(tmp_path):
     # state the restarted arbiter acted on
     assert FleetQueue(fleet_dir / "fleet_queue.jsonl").replay() \
         .runs["scav"].state == "preempting"
+
+
+# -- fsck rot-fuzzing drill (ISSUE 18) ----------------------------------------
+
+
+def _rot_ops(base: Path, run_dir: Path):
+    """The rot campaign table: ``(name, fatal, plant)`` rows. Non-fatal
+    rot is either provably-safe-repairable (debris, dead lease, torn
+    tails) or regenerable (a deleted completion marker re-runs its
+    step); fatal rot corrupts a digest- or parse-protected artifact IN
+    PLACE — the state a supervisor's done() probe would silently trust."""
+    chunks = base / "chunks"
+
+    def _flip_mid(p: Path) -> Path:
+        raw = bytearray(p.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        p.write_bytes(bytes(raw))
+        return p
+
+    def _halve(p: Path) -> Path:
+        p.write_bytes(p.read_bytes()[: max(1, p.stat().st_size // 2)])
+        return p
+
+    def debris():
+        p = chunks / ".rot.tmp.4999999"
+        p.write_bytes(b"half a chunk")
+        return p
+
+    def dead_lease():
+        p = run_dir / "leases" / "rot.json"
+        lease_mod.seed_lease(p, pid=4999999, step="rot")
+        return p
+
+    def torn_journal():
+        p = run_dir / "journal.jsonl"
+        with open(p, "ab") as f:
+            f.write(b'{"seq": 999, "event": "run.done"')
+        return p
+
+    def torn_events():
+        p = run_dir / "rot_events.jsonl"
+        p.write_bytes(b'{"seq": 0, "event": "beat"}\n{"seq": 1, "ev')
+        return p
+
+    def drop_eval():
+        p = base / "eval" / "eval.json"
+        p.unlink()
+        return p
+
+    return [
+        ("debris", False, debris),
+        ("dead_lease", False, dead_lease),
+        ("torn_journal", False, torn_journal),
+        ("torn_events", False, torn_events),
+        ("drop_eval", False, drop_eval),
+        ("bitflip_chunk", True, lambda: _flip_mid(chunks / "0.npy")),
+        ("truncate_eval", True, lambda: _halve(base / "eval" / "eval.json")),
+        ("truncate_index", True,
+         lambda: _halve(base / "catalog" / "index.json")),
+        ("bitflip_catalog", True, lambda: _flip_mid(
+            sorted((base / "catalog").glob("*.npy"))[0])),
+    ]
+
+
+def _completed_run(golden, base: Path):
+    """A COMPLETED supervised run tree: all four artifact families seeded
+    from golden, then a real supervisor pass that journals every step
+    done — the state an operator's fsck audits cold."""
+    _seed_from_golden(golden, base, list(_FAMILIES))
+    config = _config(base)
+    run_dir = base / "run"
+    sup = Supervisor(run_dir, build_pipeline(run_dir, config),
+                     heartbeat_stale_s=STALE_S)
+    assert all(v in ("done", "skipped") for v in sup.run().values())
+    return run_dir, config
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_rot_fuzzing_fsck_repair_resume_drill(tmp_path, golden, seed):
+    """ISSUE 18 acceptance drill: a seeded corruption campaign (1–3 ops
+    drawn from the rot table) against a completed supervised run tree,
+    then ``fsck --repair``, then resume. The property, per seed: the
+    pipeline completes with artifacts BITWISE IDENTICAL to golden, or
+    halts with a typed error naming the damaged artifact. Silent
+    divergence — resume "succeeding" over corrupt inputs — is the
+    forbidden outcome, and the fatal plants are exactly the ones a
+    done() probe would otherwise trust."""
+    import numpy as np
+
+    from sparse_coding_tpu.fsck import run_fsck
+    from sparse_coding_tpu.pipeline import PreflightAuditError
+
+    run_dir, config = _completed_run(golden, tmp_path)
+
+    ops = _rot_ops(tmp_path, run_dir)
+    rng = np.random.default_rng(seed)
+    n = 1 + int(rng.integers(0, 3))
+    picks = sorted(int(i) for i in rng.choice(len(ops), size=n,
+                                              replace=False))
+    names = [ops[i][0] for i in picks]
+    if "truncate_eval" in names and "drop_eval" in names:
+        # can't truncate a file the other op deleted — drop the delete
+        picks.remove(picks[names.index("drop_eval")])
+    planted_fatal = []
+    for i in picks:
+        _, fatal, plant = ops[i]
+        p = plant()
+        if fatal:
+            planted_fatal.append(p)
+    rotten = {p: hashlib.sha256(p.read_bytes()).hexdigest()
+              for p in planted_fatal}
+
+    report = run_fsck(run_dir, repair=True)
+    assert report.findings or report.repaired  # every campaign leaves a trace
+    # repair never touches evidence it cannot prove safe to fix
+    for p, dig in rotten.items():
+        assert hashlib.sha256(p.read_bytes()).hexdigest() == dig
+
+    sup2 = Supervisor(run_dir, build_pipeline(run_dir, _config(tmp_path)),
+                      max_attempts=2, heartbeat_stale_s=STALE_S)
+    if planted_fatal:
+        with pytest.raises(PreflightAuditError) as err:
+            sup2.run()
+        named = " ".join(f.path for f in err.value.findings)
+        for p in planted_fatal:
+            assert p.name in named, (p, named)
+    else:
+        assert all(v in ("done", "skipped") for v in sup2.run().values())
+        _assert_bitwise(golden, tmp_path, list(_FAMILIES))
+        # the preflight audit ran and left its breadcrumb
+        assert any(r["event"] == "run.fsck" for r in sup2.journal.records())
+        assert not run_fsck(run_dir, repair=False, write_report=False).fatal
+
+
+def test_rot_drill_regenerates_deleted_eval_bitwise(tmp_path, golden):
+    """The regenerate arm of the drill, deterministically: delete the
+    eval completion marker and plant sweepable debris. fsck --repair
+    sweeps the debris and flags the absent marker STALE (artifacts beat
+    the journal — never fatal); resume re-runs ONLY eval and converges
+    to bitwise-identical artifacts."""
+    from sparse_coding_tpu.fsck import run_fsck
+
+    run_dir, config = _completed_run(golden, tmp_path)
+    (tmp_path / "eval" / "eval.json").unlink()
+    (tmp_path / "chunks" / ".rot.tmp.4999999").write_bytes(b"junk")
+
+    report = run_fsck(run_dir, repair=True)
+    assert [r["action"] for r in report.repaired] == ["debris.sweep"]
+    assert [f.kind for f in report.findings] == ["STALE"]
+    assert not report.fatal
+
+    sup2 = Supervisor(run_dir, build_pipeline(run_dir, config),
+                      max_attempts=2, heartbeat_stale_s=STALE_S)
+    summary = sup2.run()
+    assert summary["eval"] == "done"
+    assert all(v == "skipped" for k, v in summary.items() if k != "eval")
+    _assert_bitwise(golden, tmp_path, list(_FAMILIES))
+
+
+def test_fsck_repair_kill_midway_rerun_converges(tmp_path):
+    """``fsck.repair`` chaos case: SIGKILL a REAL ``fsck --repair``
+    process between repair actions (the barrier fires before EACH one).
+    The interrupted run wrote NO report — the report is the LAST write —
+    and a plain re-run converges to a tree byte-identical to an
+    uninterrupted repair's, with both final reports clean: repair is
+    idempotent through a kill at its worst instant."""
+    import subprocess
+    import sys
+
+    from sparse_coding_tpu.pipeline.supervisor import REPO_ROOT
+
+    def build(root: Path) -> None:
+        (root / "store").mkdir(parents=True)
+        (root / "store" / ".rot.tmp.4999999").write_bytes(b"a")
+        (root / "store" / ".rot2.tmp.4999999").write_bytes(b"bb")
+        lease_mod.seed_lease(root / "leases" / "dead.json", pid=4999999)
+        (root / "events.jsonl").write_bytes(b'{"seq": 0}\n{"se')
+
+    case, control = tmp_path / "case", tmp_path / "control"
+    build(case)
+    build(control)
+
+    def fsck_cli(root: Path, extra_env: dict):
+        return subprocess.run(
+            [sys.executable, "-m", "sparse_coding_tpu.fsck", str(root),
+             "--repair"],
+            cwd=str(REPO_ROOT), env={**os.environ, **extra_env},
+            capture_output=True, text=True, timeout=120)
+
+    killed = fsck_cli(case, {crash_mod.ENV_VAR: "fsck.repair:nth=2"})
+    assert killed.returncode == -9, killed.stdout + killed.stderr
+    assert not (case / "fsck").exists()  # report write is last — never torn
+
+    done = fsck_cli(case, {})
+    assert done.returncode == 0, done.stdout + done.stderr
+    ctrl = fsck_cli(control, {})
+    assert ctrl.returncode == 0, ctrl.stdout + ctrl.stderr
+
+    def tree(root: Path) -> dict[str, str]:
+        return {str(p.relative_to(root)):
+                hashlib.sha256(p.read_bytes()).hexdigest()
+                for p in sorted(root.rglob("*"))
+                if p.is_file() and p.relative_to(root).parts[0] != "fsck"}
+
+    assert tree(case) == tree(control)
+    for root in (case, control):
+        rep = json.loads((root / "fsck" / "report.json").read_text())
+        assert rep["clean"] is True
