@@ -17,8 +17,11 @@ device-time perf-probe overhead A/B (ISSUE 12; probe ON at default
 cadence must sit within noise of OFF), and the two-tenant fleet soak
 (ISSUE 14: whole-fleet throughput + tenant B's time-to-first-step
 through the real scheduler, workers cpu-pinned — safe under a wedged or
-busy tunnel), and the feature-catalog scenario (ISSUE 16: index build
-wall + top-k neighbor query latency through the gateway). Every
+busy tunnel), the feature-catalog scenario (ISSUE 16: index build
+wall + top-k neighbor query latency through the gateway), and the
+Group-SAE cost curve (ISSUE 19: G grouped tenants vs L per-layer
+baseline tenants at a fixed per-SAE budget — wall speedup + both arms'
+aggregate FVU, workers cpu-pinned). Every
 scenario row also lands in the durable perf_ledger.jsonl, asserted at
 exit — then GATED on (ROADMAP 3(b)): each suite row is diffed against
 the last prior ledger row with the same (suite, variant, unit,
@@ -928,6 +931,122 @@ def bench_fleet_soak(quick: bool) -> None:
         shutil.rmtree(root / "fleet", ignore_errors=True)
 
 
+def bench_group_sae(quick: bool) -> None:
+    """Group-SAE cost curve (ISSUE 19): G grouped tenants vs L per-layer
+    baseline tenants through the REAL fleet scheduler, same per-SAE
+    training budget — the paper's claim is that pooling adjacent layers
+    cuts sweep cost by ~G/L at comparable FVU (arXiv 2410.21508), so the
+    row reports the measured wall speedup AND both arms' aggregate FVU.
+    The multi-tap store is harvested in-process (this bench process is
+    the one jax process); each group tenant samples its pool at one
+    layer's chunk budget (the paper's fixed-budget comparison — noted on
+    the row). Worker children are ALWAYS cpu-pinned with the axon plugin
+    stripped (CLAUDE.md: a worker's jax child must never be the second
+    tunnel-touching process), so the row is labeled
+    ``worker_backend: cpu`` whatever the bench backend."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from sparse_coding_tpu.data.shard_store import shard_name
+    from sparse_coding_tpu.groups import group_tenant_config, load_groups
+    from sparse_coding_tpu.pipeline import FleetScheduler
+    from sparse_coding_tpu.pipeline.steps import (
+        run_group,
+        run_group_harvest,
+        run_store_manifest,
+    )
+
+    d, rows, n_layers, n_groups = ((16, 1024, 4, 2) if quick
+                                   else (32, 4096, 6, 2))
+    per_layer_chunks = 4
+    with tempfile.TemporaryDirectory() as td:
+        root = Path(td)
+        store = root / "store"
+        cfg = {"harvest": {"mode": "synthetic",
+                           "dataset_folder": str(store),
+                           "layers": list(range(n_layers)),
+                           "activation_dim": d,
+                           "n_ground_truth_features": 2 * d,
+                           "feature_num_nonzero": 5,
+                           "feature_prob_decay": 0.99,
+                           "dataset_size": rows,
+                           "n_chunks": per_layer_chunks,
+                           "batch_rows": 512, "seed": 0,
+                           "phase_step": 0.35},
+               "group": {"n_groups": n_groups, "n_sample_chunks": 2,
+                         "n_sample_rows": 512, "seed": 0}}
+        for i in range(n_layers):
+            run_group_harvest(cfg, i)
+        run_store_manifest(cfg)
+        run_group(cfg)
+        payload = load_groups(store)
+
+        def sweep_eval(data_dir: str, out: Path) -> dict:
+            return {
+                "harvest": {"dataset_folder": data_dir},
+                "sweep": {"experiment": "dense_l1_range",
+                          "ensemble": {"output_folder": str(out / "sweep"),
+                                       "dataset_folder": data_dir,
+                                       "batch_size": 128,
+                                       "n_chunks": per_layer_chunks,
+                                       "learned_dict_ratio": 2.0,
+                                       "tied_ae": True,
+                                       "checkpoint_every_chunks": 2,
+                                       "seed": 0},
+                          "log_every": 10 ** 9},
+                "eval": {"output_folder": str(out / "eval"),
+                         "n_eval_rows": 512, "seed": 0},
+            }
+
+        cpu_env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
+
+        def run_arm(fleet_dir: Path, tenants: list) -> tuple[float, float]:
+            sched = FleetScheduler(fleet_dir, n_slices=1, max_concurrent=1,
+                                   poll_s=0.05, max_wall_s=1800)
+            for name, tcfg, kind in tenants:
+                sched.enqueue(name, tcfg, kind=kind, env=cpu_env)
+            t0 = _time.perf_counter()
+            sched.run()
+            wall = _time.perf_counter() - t0
+            fvus = []
+            for name, tcfg, _ in tenants:
+                ev = json.loads((Path(tcfg["eval"]["output_folder"])
+                                 / "eval.json").read_text())
+                fvus.append(min(r["fvu"] for r in ev["dicts"]))
+            return wall, float(np.mean(fvus))
+
+        group_tenants = []
+        base = sweep_eval(str(store), root / "unused")
+        for g in payload["groups"]:
+            tcfg = group_tenant_config(base, g, store, root / "grouped")
+            # the paper's fixed-budget comparison: each group SAE trains
+            # one layer's chunk budget sampled from its pool, not G×
+            tcfg["sweep"]["ensemble"]["n_chunks"] = per_layer_chunks
+            group_tenants.append((g["name"], tcfg, "group"))
+        group_wall, group_fvu = run_arm(root / "fleet_g", group_tenants)
+
+        layer_tenants = []
+        for i in range(n_layers):
+            sd = str(store / shard_name(i))
+            layer_tenants.append(
+                (f"layer-{i}", sweep_eval(sd, root / "baseline" / str(i)),
+                 "flat"))
+        base_wall, base_fvu = run_arm(root / "fleet_l", layer_tenants)
+
+        _emit("group_sae", base_wall / group_wall, "x_speedup",
+              variant=f"g{n_groups}_of_l{n_layers}",
+              n_layers=n_layers, n_groups=n_groups, d=d,
+              rows_per_layer=rows, group_wall_s=round(group_wall, 3),
+              baseline_wall_s=round(base_wall, 3),
+              fvu_group=round(group_fvu, 4),
+              fvu_baseline=round(base_fvu, 4),
+              worker_backend="cpu",
+              note="fixed per-SAE chunk budget; group arm samples each "
+                   "pool at one layer's budget (paper's G/L comparison)")
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_plane_tide(quick: bool) -> None:
     """Elastic-plane tide cycle (ISSUE 17): a real gateway + real fleet
     scheduler under one ElasticPlane arbiter, through a full tide —
@@ -1286,7 +1405,8 @@ def main() -> None:
                   bench_harvest,
                   bench_chunk_io, bench_ingest_soak, bench_streaming_eval,
                   bench_guardian_soak, bench_perf_probe, bench_gateway,
-                  bench_catalog, bench_fleet_soak, bench_plane_tide,
+                  bench_catalog, bench_fleet_soak, bench_group_sae,
+                  bench_plane_tide,
                   bench_fsck_scan, bench_mesh_scale, bench_seq_parallel):
         try:
             suite(args.quick)

@@ -178,10 +178,40 @@ def _dict_tag(i: int) -> str:
     return f"d{i:03d}"
 
 
-def build_catalog(artifact_path: str | Path, store_dir: str | Path,
+def _normalize_artifacts(artifact_path,
+                         group: Optional[str]) -> list[tuple[Path, object]]:
+    """Accept one path, a list of paths, or a list of ``(path, group)``
+    pairs; return ``[(Path, group_label), ...]`` in input order. The
+    bare forms inherit the build-level ``group`` label."""
+    if isinstance(artifact_path, (str, Path)):
+        return [(Path(artifact_path), group)]
+    out = []
+    for item in artifact_path:
+        if isinstance(item, (tuple, list)):
+            path, label = item
+            out.append((Path(path), label))
+        else:
+            out.append((Path(item), group))
+    if not out:
+        raise CatalogBuildError("empty artifact list")
+    return out
+
+
+def build_catalog(artifact_path, store_dir: str | Path,
                   out_dir: str | Path, *, dead_threshold: float = 0.0,
-                  experiment: Optional[str] = None) -> dict:
-    """Build the feature-intelligence index for one sweep artifact set.
+                  experiment: Optional[str] = None,
+                  group: Optional[str] = None) -> dict:
+    """Build the feature-intelligence index for one or more sweep
+    artifact sets.
+
+    ``artifact_path`` is one ``learned_dicts.pkl`` path, a list of them,
+    or a list of ``(path, group_label)`` pairs — the Group-SAE case
+    (§23): a group's dictionaries indexed TOGETHER with its per-layer
+    baseline dictionaries, so the cross-dict MMCS/matching arrays pair a
+    group feature directly against its baselines. Every index row
+    carries a ``group`` label (the pair's, else the build-level
+    ``group=`` kwarg, else None); records concatenate in artifact order
+    so the determinism contract is unchanged.
 
     Streams every sound chunk of ``store_dir`` once through
     ``data/ingest.chunk_stream`` (lease beats per delivered chunk ride
@@ -210,12 +240,19 @@ def build_catalog(artifact_path: str | Path, store_dir: str | Path,
 
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
+    artifacts = _normalize_artifacts(artifact_path, group)
     with obs.span("catalog.build"):
-        records = load_catalog_records(artifact_path, skip_diverged=True)
+        records, labels = [], []
+        n_dropped = 0
+        for path, label in artifacts:
+            recs = load_catalog_records(path, skip_diverged=True)
+            n_dropped += _count_diverged(path, len(recs))
+            records.extend(recs)
+            labels.extend([label] * len(recs))
         if not records:
             raise CatalogBuildError(
-                f"no non-diverged records in {artifact_path}")
-        n_dropped = _count_diverged(artifact_path, len(records))
+                "no non-diverged records in "
+                f"{[str(p) for p, _ in artifacts]}")
         rows_norm = [decoder_rows_np(rec) for rec in records]
         store = open_store(store_dir, quarantine_corrupt=True)
         indices = list(range(store.n_chunks))
@@ -254,6 +291,7 @@ def build_catalog(artifact_path: str | Path, store_dir: str | Path,
                 files[f"{tag}_{suffix}.npy"] = arr
             meta_dicts.append({
                 "tag": tag, "cls": rec["cls"],
+                "group": (None if labels[i] is None else str(labels[i])),
                 "n_feats": int(rows_norm[i].shape[0]),
                 "d_activation": int(rows_norm[i].shape[1]),
                 "n_dead": int(dead.sum()),

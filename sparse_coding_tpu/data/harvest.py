@@ -104,10 +104,17 @@ def harvest_activations(
     forward=None,
     mesh=None,
     scan_batches: int = 1,
+    tap_dirs: Optional[dict] = None,
 ) -> dict[str, int]:
     """Run the LM over packed token rows, streaming each tap's activations to
     its own chunk folder `{output_folder}/{tap}/`. Multi-layer in one pass
     (as the reference does, activation_dataset.py:323-391).
+
+    ``tap_dirs`` remaps a tap's chunk folder (``{tap: Path}``) — the
+    group harvest writes tap i into the multi-tap store's ``shard-<i>/``
+    instead of a tap-named subfolder; unmapped taps keep the default.
+    Every tap's finalize metadata carries its identity (``tap``,
+    ``layer``) so the grouping pass can read layer order from the store.
 
     Returns {tap_name: n_chunks_written}. `skip_chunks` resumes mid-dataset
     by skipping already-harvested leading chunks (reference:
@@ -129,8 +136,9 @@ def harvest_activations(
     seq_len = token_rows.shape[1]
     # chunk boundaries aligned to whole model batches so skip_chunks resume
     # maps exactly onto token-row offsets (no duplicated/shifted data)
+    tap_dirs = dict(tap_dirs or {})
     writers = {
-        t: ChunkWriter(Path(output_folder) / t, width,
+        t: ChunkWriter(Path(tap_dirs.get(t, Path(output_folder) / t)), width,
                        chunk_size_gb=chunk_size_gb, dtype=dtype,
                        start_index=skip_chunks,
                        round_rows_to=model_batch_size * seq_len,
@@ -211,7 +219,9 @@ def harvest_activations(
     # centering happens INSIDE the writers (first flushed chunk's mean
     # subtracted from every chunk, reference: activation_dataset.py:379-381);
     # the writer stamps the truthful "centered" flag and saves center.npy
-    result = {name: w.finalize({"model": cfg.arch, "layer_loc": layer_loc})
+    result = {name: w.finalize({"model": cfg.arch, "layer_loc": layer_loc,
+                                "tap": name,
+                                "layer": hooks.parse_tap_name(name)[1]})
               for name, w in writers.items()}
     obs.record_span("harvest.run", obs.monotime() - t_harvest,
                     taps=list(taps), rows=int(n_rows - skip_rows),

@@ -68,6 +68,7 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 
 _TMP_RE = re.compile(r"^\..+\.tmp\.(\d+)$")
 _SHARD_RE = re.compile(r"^shard-\d+$")
+_GROUP_RE = re.compile(r"^group-\d+$")
 
 
 @dataclass
@@ -536,6 +537,92 @@ def check_xcache(ctx: ScanCtx, d: Path, files: set, dirs: set) -> None:
                         "degrade to cold compiles)")
 
 
+# -- group assignment (Group-SAE, §23) ----------------------------------------
+
+@checker
+def check_groups(ctx: ScanCtx, d: Path, files: set, dirs: set) -> None:
+    """``groups.json`` (kind ``group_assignment``) is the group build's
+    completion marker, written LAST: its self-digest must hold, every
+    file it certifies (``similarity.npy``, each pooled
+    ``group-<g>/manifest.json``) must exist and match, and every shard a
+    group references must be listed by the sibling store manifest — a
+    marker steering tenants at shards the store does not carry would
+    train the wrong pool silently. ``group-<g>/`` dirs no group names
+    are orphans (a rebuild at a smaller G leaves them behind)."""
+    if "groups.json" not in files:
+        return
+    path = d / "groups.json"
+    data = ctx.read_bytes(path, "groups")
+    if data is None:
+        return
+    try:
+        payload = json.loads(data)
+    except ValueError as e:
+        ctx.add(path, "groups", CORRUPT,
+                f"unparseable group-assignment marker: {e}", fatal=True)
+        return
+    if not isinstance(payload, dict) \
+            or payload.get("kind") != "group_assignment":
+        return  # some other subsystem's groups.json
+    state = check_payload_digest(payload)
+    if state == "mismatch":
+        ctx.add(path, "groups", INCONSISTENT,
+                "payload digest mismatch — the group assignment cannot "
+                "be trusted (GroupBuildError on load; rebuild via the "
+                "group step)", fatal=True)
+    elif state == "absent":
+        ctx.add(path, "groups", STALE,
+                "digest-less group-assignment marker (loads unverified)")
+    fmap = payload.get("files", {})
+    if isinstance(fmap, dict):
+        for name in sorted(fmap):
+            p = d / name
+            if not p.exists():
+                ctx.add(p, "groups", MISSING,
+                        "file certified by groups.json is absent",
+                        fatal=True)
+                continue
+            raw = ctx.read_bytes(p, "groups")
+            if raw is None:
+                continue
+            if bytes_sha256(raw) != str(fmap[name]):
+                ctx.add(p, "groups", INCONSISTENT,
+                        "file bytes do not match the digest groups.json "
+                        "recorded at finalize", fatal=True)
+    # cross-check against the sibling store manifest: every shard a
+    # group pools must exist in the store the marker sits in
+    listed: Optional[set] = None
+    if "manifest.json" in files:
+        mdata = ctx.read_quiet(d / "manifest.json")[0]
+        try:
+            manifest = json.loads(mdata) if mdata is not None else None
+        except ValueError:
+            manifest = None  # shard_store checker owns that finding
+        if isinstance(manifest, dict) \
+                and manifest.get("kind") == "sharded_chunk_store":
+            listed = {str(s.get("name", ""))
+                      for s in manifest.get("shards", [])}
+    named = set()
+    for g in (payload.get("groups") or []):
+        if not isinstance(g, dict):
+            continue
+        named.add(str(g.get("name", "")))
+        if listed is None:
+            continue
+        for shard in (g.get("shards") or []):
+            if str(shard) not in listed:
+                ctx.add(path, "groups", INCONSISTENT,
+                        f"group {g.get('name')!r} references shard "
+                        f"{shard!r} absent from the store manifest — "
+                        "tenants would train the wrong pool", fatal=True)
+    for name in sorted(dirs):
+        if _GROUP_RE.match(name) and name not in named:
+            ctx.add(d / name, "groups", ORPHAN,
+                    "group dir absent from groups.json (a rebuild at a "
+                    "smaller G leaves stale pools behind)",
+                    repair="groups.drop_pool")
+
+
 # -- catalog ------------------------------------------------------------------
 
 @checker
@@ -593,10 +680,14 @@ def _marker_table(config: dict) -> dict[str, tuple[Path, str]]:
         harvest = config.get("harvest", {})
         if "dataset_folder" in harvest:
             dataset = anchor(harvest["dataset_folder"])
-            if "n_shards" in harvest:
+            if "n_shards" in harvest or "layers" in harvest:
+                # sharded OR group (multi-tap) data plane: the store-
+                # level manifest is the aggregate completion marker
                 out["manifest"] = (dataset / "manifest.json", "json")
             else:
                 out["harvest"] = (dataset / "meta.json", "json")
+            if "group" in config:
+                out["group"] = (dataset / "groups.json", "json")
         if "sweep" in config:
             sweep_out = anchor(config["sweep"]["ensemble"]["output_folder"])
             name = config["sweep"].get("experiment", "dense_l1_range")

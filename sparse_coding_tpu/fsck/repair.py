@@ -24,6 +24,11 @@ could destroy contradictory evidence). Actions:
                       ``ckpt_prev/`` fallback verified sound — resume
                       then replays from the last-good set, exactly the
                       path the retention pair exists to provide
+``groups.drop_pool``  remove a ``group-<g>/`` pooled-view dir absent
+                      from ``groups.json`` (a rebuild at a smaller G
+                      leaves stale pools behind); the view holds only a
+                      derivable manifest — the chunk bytes live in the
+                      shard dirs, untouched
 
 Crash-safety is the same contract as every other durable writer:
 ``crash_barrier("fsck.repair")`` fires immediately before EACH action's
@@ -176,7 +181,8 @@ def repair_findings(root: str | Path,
             _reconcile_manifest(target.parent.parent)
         elif action == "xcache.reconcile":
             _reconcile_manifest(target)
-        elif action == "ckpt.drop_staging" or action == "ckpt.fallback_prev":
+        elif action == "ckpt.drop_staging" or action == "ckpt.fallback_prev" \
+                or action == "groups.drop_pool":
             _rmtree(target)
         else:
             applied.append({"action": action, "path": f.path,

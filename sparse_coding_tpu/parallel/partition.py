@@ -103,6 +103,17 @@ BIG_SAE_STATE_RULES: Rules = BIG_SAE_PARAM_RULES + (
     (r".*", REPLICATED),
 )
 
+# Grouped-sweep ensemble state (Group-SAE, §23): a group tenant's sweep
+# is the stacked-ensemble whole-step program over the group's POOLED
+# store, so member leaves keep the [N]-over-"model" placement — but the
+# pooled-store statistics a grouped run carries (the shared center, any
+# per-layer pooling stats) are store-level, not member-level, and
+# replicate so every model-shard normalizes pooled rows identically.
+GROUP_STATE_RULES: Rules = (
+    (r"(^|/)(center|pooled_stats|group_stats)($|/)", REPLICATED),
+    (r".*", MEMBER),
+)
+
 
 def batch_spec(stacked: bool = False) -> P:
     """The activation-batch spec: rows over "data" ([B, d], or [K, B, d]
@@ -223,6 +234,7 @@ __all__ = [
     "FEATURE_ROWS", "FEATURE_COLS",
     "ENSEMBLE_STATE_RULES", "SERVE_STACK_RULES", "SERVE_REPLICATED_RULES",
     "BIG_SAE_PARAM_RULES", "BIG_SAE_STATE_RULES", "CATALOG_FEATURE_RULES",
+    "GROUP_STATE_RULES",
     "batch_spec", "serve_rules", "tree_paths", "match_partition_rules",
     "tree_shardings", "place_tree", "place_batch", "batch_sharding",
     "sharding_fingerprint",

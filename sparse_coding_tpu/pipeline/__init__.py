@@ -54,7 +54,9 @@ for _name in ("STEP_EXIT_HALTED", "STEP_EXIT_PREEMPTED",
               "ConcurrentSupervisorError", "PipelineError",
               "PreflightAuditError", "Step",
               "StepFailed", "StepHalted", "StepHung", "StepPreempted",
-              "Supervisor", "build_pipeline", "build_sharded_pipeline",
+              "Supervisor", "build_group_pipeline",
+              "build_group_tenant_pipeline", "build_pipeline",
+              "build_sharded_pipeline",
               "load_or_create_run_id", "step_argv", "supervise_bench"):
     _LAZY_ATTRS[_name] = ("sparse_coding_tpu.pipeline.supervisor", _name)
 

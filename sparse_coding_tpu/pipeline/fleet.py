@@ -478,10 +478,16 @@ class FleetScheduler:
 
 
 def build_run_steps(run_dir: Path, spec: dict) -> list:
-    """The run's step DAG from its queue spec: the flat or sharded
-    builders over ``spec['config']``, or the single resumable command
-    step the cheap-child tests drive. Tenant env rides every step."""
-    from sparse_coding_tpu.pipeline.supervisor import Step
+    """The run's step DAG from its queue spec: the flat, sharded, or
+    group-tenant builders over ``spec['config']``, or the single
+    resumable command step the cheap-child tests drive. Tenant env rides
+    every step. ``kind="group"`` is one Group-SAE tenant (§23): the
+    sweep → eval (→ catalog) tail over its pooled store view, no harvest
+    edge — ``groups.json`` was durable before enqueue."""
+    from sparse_coding_tpu.pipeline.supervisor import (
+        Step,
+        build_group_tenant_pipeline,
+    )
 
     kind = spec.get("kind", "flat")
     if kind == "command":
@@ -490,6 +496,7 @@ def build_run_steps(run_dir: Path, spec: dict) -> list:
                       done=done.exists)]
     else:
         builder = (build_sharded_pipeline if kind == "sharded"
+                   else build_group_tenant_pipeline if kind == "group"
                    else build_pipeline)
         steps = builder(run_dir, spec["config"])
     for step in steps:

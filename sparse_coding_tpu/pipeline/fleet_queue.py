@@ -62,7 +62,7 @@ from sparse_coding_tpu.resilience.faults import fault_point, register_fault_site
 from sparse_coding_tpu.serve.slo import BATCH, priority_rank
 
 QUEUE_NAME = "fleet_queue.jsonl"
-RUN_KINDS = ("flat", "sharded", "command")
+RUN_KINDS = ("flat", "sharded", "group", "command")
 
 register_fault_site("fleet.enqueue",
                     "fleet queue admission — the durable run.enqueue "

@@ -43,6 +43,12 @@ register_crash_site("eval.write",
                     "not yet written")
 
 
+class HarvestConfigError(ValueError):
+    """Typed harvest-config contradiction: ``layer`` and ``layers``
+    given inconsistently, or a ``dataset_folder`` that is not the
+    primary tap subfolder the multi-layer harvester will write."""
+
+
 def run_harvest(config: dict) -> None:
     """``config["harvest"]`` keys — common: ``mode`` ("synthetic" | "lm"),
     ``dataset_folder`` (the chunk store the sweep reads; completion marker
@@ -67,7 +73,8 @@ def run_harvest(config: dict) -> None:
 
 
 def _synthetic_harvest(cfg: dict, folder: Path = None,
-                       row_range: tuple = None) -> None:
+                       row_range: tuple = None, transform=None,
+                       extra_meta: dict = None) -> None:
     """Deterministic synthetic activation store with crash-resume: the
     generator stream is replayed from its seed and the rows already
     covered by durable chunks are skipped, so the finished store —
@@ -78,7 +85,13 @@ def _synthetic_harvest(cfg: dict, folder: Path = None,
     into ``folder`` — the sharded-writer case: every shard writer replays
     the SAME seeded stream and keeps its own rows, so N writers sharing
     nothing produce a store whose concatenation is bitwise the unsharded
-    harvest's."""
+    harvest's.
+
+    ``transform`` (row-wise, pure numpy, deterministic) maps kept rows
+    before they are written — the multi-TAP writer case (group harvest):
+    every layer writer replays the same stream and applies its own
+    layer mix, so rows stay positionally aligned across layers.
+    ``extra_meta`` merges into the finalize metadata (tap identity)."""
     import jax
 
     from sparse_coding_tpu.data.chunk_store import (
@@ -118,25 +131,61 @@ def _synthetic_harvest(cfg: dict, folder: Path = None,
             b_lo = max(0, skip_rows - produced)
             b_hi = min(n, hi_row - produced)
             if b_hi > b_lo:
-                writer.add(batch[b_lo:b_hi])
+                kept = batch[b_lo:b_hi]
+                writer.add(transform(kept) if transform is not None
+                           else kept)
         produced += n
         lease.beat()
     writer.finalize({"synthetic": True, "seed": seed,
                      **({"row_range": [lo_row, hi_row]}
-                        if row_range is not None else {})})
+                        if row_range is not None else {}),
+                     **(extra_meta or {})})
 
 
-def _lm_harvest(cfg: dict) -> None:
+def _resolve_layers(cfg: dict) -> list[int]:
+    """The harvest layer list: ``layers`` (DataArgs.layers semantics,
+    multi-tap) with ``layer`` kept as the single-tap back-compat alias.
+    Giving both is fine only when they agree — a config saying
+    ``layer: 3`` but ``layers: [1, 2]`` would silently harvest the wrong
+    tap under one reading, so it raises typed instead."""
+    layers, layer = cfg.get("layers"), cfg.get("layer")
+    if layers is None:
+        return [int(layer if layer is not None else 1)]
+    layers = [int(v) for v in layers]
+    if not layers:
+        raise HarvestConfigError("harvest.layers must be non-empty")
+    if layer is not None and int(layer) not in layers:
+        raise HarvestConfigError(
+            f"harvest.layer={int(layer)} contradicts "
+            f"harvest.layers={layers} — drop the alias or include it")
+    return layers
+
+
+def _lm_harvest(cfg: dict, tap_dirs: dict = None) -> None:
     """Tiny-LM harvest through the REAL ``harvest_activations`` path
     (random-init weights, seeded token rows — no network), resuming via
-    ``skip_chunks`` from the durable chunk prefix."""
+    ``skip_chunks`` from the durable chunk prefix. Multi-tap when
+    ``layers`` lists several: one forward pass writes every tap's
+    subfolder of ``dataset_folder``'s parent (``dataset_folder`` itself
+    must be the PRIMARY — first — tap subfolder, the step's completion
+    marker); ``tap_dirs`` remaps tap → folder (group harvest shards)."""
     import jax
 
     from sparse_coding_tpu.data.chunk_store import complete_chunk_count
     from sparse_coding_tpu.data.harvest import harvest_activations
+    from sparse_coding_tpu.lm.hooks import tap_name, taps_for
     from sparse_coding_tpu.lm.model_config import tiny_test_config
 
-    folder = Path(cfg["dataset_folder"])  # the tap subfolder
+    folder = Path(cfg["dataset_folder"])  # the PRIMARY tap subfolder
+    layers = _resolve_layers(cfg)
+    layer_loc = cfg.get("layer_loc", "residual")
+    taps = taps_for(layers, layer_loc)
+    tap_dirs = dict(tap_dirs or {})
+    if not tap_dirs and folder.name != tap_name(layers[0], layer_loc):
+        raise HarvestConfigError(
+            f"harvest.dataset_folder must be the primary tap subfolder "
+            f"{tap_name(layers[0], layer_loc)!r} the harvester writes "
+            f"(got {folder.name!r})")
     arch = cfg.get("arch", "gptneox")
     lm_cfg = tiny_test_config(arch)
     if arch == "gptneox":
@@ -149,13 +198,17 @@ def _lm_harvest(cfg: dict) -> None:
     token_rows = rng.integers(
         0, lm_cfg.vocab_size,
         (int(cfg["n_rows"]), int(cfg.get("context_len", 16))))
+    # resume from the SHORTEST durable tap prefix: one forward feeds all
+    # writers, so a tap ahead of the others just re-seals idempotently
+    skip = min(complete_chunk_count(Path(tap_dirs.get(t, folder.parent / t)))
+               for t in taps)
     harvest_activations(
-        params, lm_cfg, token_rows, [int(cfg.get("layer", 1))],
-        cfg.get("layer_loc", "residual"), folder.parent,
+        params, lm_cfg, token_rows, layers, layer_loc, folder.parent,
         model_batch_size=int(cfg.get("model_batch_size", 2)),
         chunk_size_gb=float(cfg["chunk_size_gb"]),
-        skip_chunks=complete_chunk_count(folder),
-        dtype=cfg.get("dtype", "float16"))
+        skip_chunks=skip,
+        dtype=cfg.get("dtype", "float16"),
+        tap_dirs=tap_dirs or None)
 
 
 def run_shard_harvest(config: dict, shard: int) -> None:
@@ -204,6 +257,107 @@ def run_shard_harvest(config: dict, shard: int) -> None:
     write_shard_digest(folder)
 
 
+def _layer_mixer(dim: int, layer: int, seed: int, phase_step: float):
+    """Deterministic per-layer mix for the synthetic multi-tap harvest:
+    ``x ↦ cos(φ)·x + sin(φ)·(x·Q)`` with one orthogonal Q shared by all
+    layers and φ = phase_step·layer, so two layers' rows subtend angle
+    ≈ |φ_i − φ_j| and adjacent layers are measurably more similar — the
+    Group-SAE premise (arXiv 2410.21508 §3), reproduced synthetically.
+    Pure rowwise numpy, a function of (dim, layer, seed) only — resume
+    replays bitwise."""
+    q, _ = np.linalg.qr(
+        np.random.default_rng(int(seed) + 7919).normal(size=(dim, dim)))
+    q = q.astype(np.float32)
+    c, s = np.float32(np.cos(phase_step * layer)), \
+        np.float32(np.sin(phase_step * layer))
+
+    def mix(rows: np.ndarray) -> np.ndarray:
+        x = rows.astype(np.float32, copy=False)
+        return c * x + s * (x @ q)
+
+    return mix
+
+
+def run_group_harvest(config: dict, shard: int) -> None:
+    """One PARALLEL multi-TAP writer owning one layer (= one shard of
+    the multi-tap store): ``config["harvest"]`` plus ``layers`` — child
+    ``i`` harvests layer ``layers[i]`` into
+    ``<dataset_folder>/shard-<i>/`` and NOTHING else. Taps ARE shards:
+    the sealed-shard layout, manifest step, scrub and fsck shard
+    checkers carry the multi-tap store unchanged, and the DAG carries no
+    edges between the writers (this container runs them serially — one
+    jax process at a time, CLAUDE.md).
+
+    Every writer replays the SAME producer stream over all rows, so row
+    ``r`` of shard ``i`` and row ``r`` of shard ``j`` are the same input
+    observed at two depths — the row alignment
+    ``groups/similarity.py`` depends on. Synthetic mode applies the
+    deterministic per-layer rotation (``_layer_mixer``); LM mode runs
+    the real ``harvest_activations`` with this child's tap remapped to
+    its shard dir. Resume/seal contract is ``run_shard_harvest``'s:
+    durable chunk prefix + row skip, idempotent re-seal behind the
+    ``shard.finalize`` crash barrier."""
+    from sparse_coding_tpu.data.shard_store import shard_name, write_shard_digest
+    from sparse_coding_tpu.lm.hooks import tap_name
+
+    cfg = config["harvest"]
+    layers = _resolve_layers(cfg)
+    shard = int(shard)
+    if not 0 <= shard < len(layers):
+        raise ValueError(f"shard {shard} out of range [0, {len(layers)})")
+    layer = layers[shard]
+    layer_loc = cfg.get("layer_loc", "residual")
+    tap = tap_name(layer, layer_loc)
+    folder = Path(cfg["dataset_folder"]) / shard_name(shard)
+    if not (folder / "meta.json").exists():
+        from sparse_coding_tpu.data.chunk_store import clean_write_debris
+
+        folder.mkdir(parents=True, exist_ok=True)
+        clean_write_debris(folder)  # tmp debris from a killed writer
+        if cfg.get("mode", "synthetic") == "synthetic":
+            mixer = _layer_mixer(int(cfg["activation_dim"]), layer,
+                                 int(cfg.get("seed", 0)),
+                                 float(cfg.get("phase_step", 0.35)))
+            _synthetic_harvest(cfg, folder=folder, transform=mixer,
+                               extra_meta={"tap": tap, "layer": layer,
+                                           "layer_loc": layer_loc})
+        else:
+            _lm_harvest({**cfg, "layers": [layer], "layer": layer,
+                         "dataset_folder": str(folder)},
+                        tap_dirs={tap: folder})
+    # seal (idempotent): meta durable -> crash barrier -> shard.digest
+    write_shard_digest(folder)
+
+
+def run_group(config: dict) -> None:
+    """``config["group"]`` keys: ``n_groups``, optional
+    ``n_sample_chunks`` / ``n_sample_rows`` / ``seed``. Similarity pass
+    + greedy adjacent assignment over the multi-tap store, finalizing
+    ``groups.json`` (docs/ARCHITECTURE.md §23). Backend-free —
+    ``groups/`` never imports jax, so like scrub/catalog this step runs
+    against a wedged tunnel. Idempotent behind a digest-SOUND
+    ``groups.json`` (a rotted marker is rebuilt, byte-deterministic);
+    a killed build rebuilds identically (crash barrier
+    ``groups.finalize``)."""
+    from sparse_coding_tpu.groups.assign import (
+        GroupBuildError,
+        build_groups,
+        load_groups,
+    )
+
+    cfg = config.get("group", {})
+    store = Path(config["harvest"]["dataset_folder"])
+    try:
+        load_groups(store)
+        return  # digest-sound completion marker: idempotent skip
+    except (FileNotFoundError, GroupBuildError):
+        pass  # absent or rotted: (re)build overwrites atomically
+    build_groups(store, n_groups=int(cfg.get("n_groups", 2)),
+                 n_sample_chunks=int(cfg.get("n_sample_chunks", 1)),
+                 n_sample_rows=int(cfg.get("n_sample_rows", 2048)),
+                 seed=int(cfg.get("seed", 0)))
+
+
 def run_store_manifest(config: dict) -> None:
     """Aggregate the sealed shards into the store-level manifest (the
     sharded store's completeness marker). Backend-free — never touches a
@@ -219,7 +373,10 @@ def run_store_manifest(config: dict) -> None:
 
     cfg = config["harvest"]
     folder = Path(cfg["dataset_folder"])
-    n_shards = int(cfg["n_shards"])
+    # sharded harvest: explicit n_shards; group (multi-tap) harvest:
+    # one shard per layer — taps ARE shards
+    n_shards = (int(cfg["n_shards"]) if "n_shards" in cfg
+                else len(_resolve_layers(cfg)))
     existing = read_store_manifest(folder)
     if existing is not None and int(existing.get("n_shards", -1)) == n_shards:
         return  # complete store at THIS shard count: idempotent
@@ -363,12 +520,15 @@ def run_catalog(config: dict) -> None:
            / f"{name}_learned_dicts.pkl")
     build_catalog(pkl, config["harvest"]["dataset_folder"], out,
                   dead_threshold=float(cfg.get("dead_threshold", 0.0)),
-                  experiment=name)
+                  experiment=name, group=cfg.get("group"))
 
 
 STEPS = {"harvest": run_harvest, "shard_harvest": run_shard_harvest,
+         "group_harvest": run_group_harvest, "group": run_group,
          "manifest": run_store_manifest, "scrub": run_scrub,
          "sweep": run_sweep, "eval": run_eval, "catalog": run_catalog}
+
+_SHARDED_STEPS = {"shard_harvest", "group_harvest"}
 
 
 def main(argv=None) -> None:
@@ -381,11 +541,11 @@ def main(argv=None) -> None:
         shard = int(argv[at + 1])
         del argv[at:at + 2]
     if len(argv) != 3 or argv[1] != "--config" or argv[0] not in STEPS \
-            or (argv[0] == "shard_harvest") != (shard is not None):
+            or (argv[0] in _SHARDED_STEPS) != (shard is not None):
         raise SystemExit(
             f"usage: python -m sparse_coding_tpu.pipeline.steps "
             f"{{{'|'.join(STEPS)}}} --config pipeline.json "
-            "[--shard I  (shard_harvest only)]")
+            "[--shard I  (shard_harvest/group_harvest only)]")
     step, config_path = argv[0], argv[2]
     # claim the lease before any real work: from here on, silence = hang
     lease.configure_from_env(step=step)

@@ -644,15 +644,18 @@ def _persist_pipeline_config(run_dir: str | Path, config: dict):
 
 
 def _sweep_eval_steps(cfg_path: Path, config: dict, anchor,
-                      sweep_dep: str) -> list[Step]:
+                      sweep_dep: Optional[str]) -> list[Step]:
     """The sweep → eval DAG tail, shared by every pipeline builder so the
     step argv, dependency shape, and done() markers cannot drift between
-    the flat and sharded data planes."""
+    the flat and sharded data planes. ``sweep_dep=None`` drops the
+    harvest edge entirely — the group-tenant case (§23): the pooled
+    store the tenant trains on is already durable before enqueue."""
     sweep_out = anchor(config["sweep"]["ensemble"]["output_folder"])
     eval_out = anchor(config["eval"]["output_folder"])
     name = config["sweep"].get("experiment", "dense_l1_range")
     steps = [
-        Step("sweep", step_argv("sweep", cfg_path), deps=(sweep_dep,),
+        Step("sweep", step_argv("sweep", cfg_path),
+             deps=(sweep_dep,) if sweep_dep is not None else (),
              done=lambda: (sweep_out / "final"
                            / f"{name}_learned_dicts.pkl").exists()),
         Step("eval", step_argv("eval", cfg_path), deps=("sweep",),
@@ -744,6 +747,79 @@ def build_sharded_pipeline(run_dir: str | Path, config: dict,
              done=scrub_done.exists),
     ] + _sweep_eval_steps(cfg_path, config, anchor, sweep_dep="scrub")
     return _prune(steps, only)
+
+
+def build_group_pipeline(run_dir: str | Path, config: dict,
+                         only: Optional[Sequence[str]] = None) -> list[Step]:
+    """The Group-SAE data-plane DAG (§23):
+
+        harvest-<i> (one multi-TAP writer child per layer — taps ARE
+                     shards, no edges between the writers)
+          → manifest (aggregate sealed shards, backend-free)
+          → scrub (digest re-verify + quarantine/repair, backend-free)
+          → group (similarity + greedy assignment → ``groups.json``,
+                   backend-free; done() = the digest-sound marker)
+          [→ sweep → eval (→ catalog) — opt-in: a config WITH a "sweep"
+             section trains one pooled-store sweep inline; the usual
+             shape instead enqueues one fleet tenant PER group after the
+             ``group`` step finalizes (groups/tenants.py)]
+
+    ``config["harvest"]["layers"]`` sets the writer count: writer ``i``
+    harvests layer ``layers[i]`` into ``shard-<i>/``, replaying the SAME
+    producer stream as every other writer so rows stay aligned across
+    layers (the similarity pass's contract). Everything below the
+    writers reuses the sharded plane verbatim — same manifest/scrub
+    steps, same done() markers."""
+    from sparse_coding_tpu.data.shard_store import (
+        SHARD_DIGEST_NAME,
+        shard_name,
+    )
+    from sparse_coding_tpu.groups.assign import GROUPS_NAME
+    from sparse_coding_tpu.pipeline.steps import SCRUB_MARKER_NAME, _resolve_layers
+
+    cfg_path, anchor = _persist_pipeline_config(run_dir, config)
+    dataset = anchor(config["harvest"]["dataset_folder"])
+    scrub_done = Path(run_dir) / SCRUB_MARKER_NAME
+    n_layers = len(_resolve_layers(config["harvest"]))
+
+    def sealed(i: int) -> Callable[[], bool]:
+        d = dataset / shard_name(i)
+        return lambda: ((d / "meta.json").exists()
+                        and (d / SHARD_DIGEST_NAME).exists())
+
+    writers = [Step(f"harvest-{i}",
+                    step_argv("group_harvest", cfg_path)
+                    + ["--shard", str(i)],
+                    done=sealed(i))
+               for i in range(n_layers)]
+    steps = writers + [
+        Step("manifest", step_argv("manifest", cfg_path),
+             deps=tuple(w.name for w in writers),
+             done=lambda: _manifest_matches(dataset, n_layers)),
+        Step("scrub", step_argv("scrub", cfg_path), deps=("manifest",),
+             done=scrub_done.exists),
+        Step("group", step_argv("group", cfg_path), deps=("scrub",),
+             done=lambda: (dataset / GROUPS_NAME).exists()),
+    ]
+    if "sweep" in config:
+        steps += _sweep_eval_steps(cfg_path, config, anchor,
+                                   sweep_dep="group")
+    return _prune(steps, only)
+
+
+def build_group_tenant_pipeline(run_dir: str | Path, config: dict,
+                                only: Optional[Sequence[str]] = None,
+                                ) -> list[Step]:
+    """One group tenant's DAG (fleet ``kind="group"``, §23): just the
+    sweep → eval (→ catalog) tail over the group's pooled store view —
+    no harvest edge, because ``groups.json`` (and every pooled manifest
+    under it) was durable before the tenant could be enqueued
+    (groups/tenants.py reads the finalized assignment). Guardian halts
+    stay contained to this tenant's run dir exactly as for flat
+    tenants."""
+    cfg_path, anchor = _persist_pipeline_config(run_dir, config)
+    return _prune(_sweep_eval_steps(cfg_path, config, anchor,
+                                    sweep_dep=None), only)
 
 
 def supervise_bench(run_dir: str | Path, *, max_attempts: int = 2,

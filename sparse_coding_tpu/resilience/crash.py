@@ -102,6 +102,12 @@ CRASH_SITES: dict[str, str] = {
                        "fleet queue journal, NEITHER consumer resized "
                        "yet (pipeline/plane.py) — the no-double-booking "
                        "reconcile instant",
+    # seeded like the fleet sites: the `group` step child parses the env
+    # plan at its first barrier hit, before groups/assign.py imports
+    "groups.finalize": "group assignment build — similarity.npy and "
+                       "every per-group pooled-store manifest durable, "
+                       "groups.json (the completion marker) not yet "
+                       "written (groups/assign.py)",
     # seeded like the fleet sites: `python -m sparse_coding_tpu.fsck
     # --repair` children parse the env plan at their first barrier
     "fsck.repair": "fsck repair engine — immediately before applying one "

@@ -119,6 +119,18 @@ FAULT_SITES: dict[str, str] = {
     "plane.rebalance": "elastic plane — before the durable "
                        "plane.rebalance record append "
                        "(pipeline/plane.py)",
+    # seeded here (not only registered at groups module import): the
+    # `group` pipeline step child inherits the env plan and parses it at
+    # its first fault_point — often obs.sink.write at startup, before
+    # groups/similarity.py or groups/assign.py ever import
+    "groups.similarity": "group-SAE similarity pass — every digest-"
+                         "verified sampled-chunk read feeding the "
+                         "pairwise layer-similarity accumulation "
+                         "(groups/similarity.py)",
+    "groups.build": "group-SAE assignment build I/O — the durable "
+                    "writes of similarity.npy and the per-group pooled-"
+                    "store manifests, before groups.json "
+                    "(groups/assign.py)",
     # seeded here (not only registered at fsck import): the supervisor's
     # resume preflight audits BEFORE any step child spawns, and a CLI
     # fsck process may parse an env plan at its very first read

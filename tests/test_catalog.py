@@ -231,6 +231,44 @@ def test_mmcs_parity_with_metrics_core(corpus):
 # -- serving ------------------------------------------------------------------
 
 
+def test_group_labels_on_index_rows_and_cross_dict_pairing(corpus,
+                                                           tmp_path):
+    """ISSUE 19 satellite (§23): a build over ``(path, group_label)``
+    pairs stamps every index row with its artifact's label and indexes
+    group dictionaries TOGETHER with their unlabeled baselines — the
+    mmcs matrix pairs a group dict directly against a baseline dict,
+    byte-for-byte what ``mmcs_np`` computes on their decoder rows."""
+    pkl_group = corpus / "sweep" / "learned_dicts.pkl"  # 3 usable dicts
+    pkl_base = tmp_path / "baseline.pkl"
+    save_learned_dicts([(_tied(7), {"l1_alpha": 1e-3})], pkl_base)
+    meta = build_catalog([(pkl_group, "group-000"), (pkl_base, None)],
+                         corpus / "chunks", tmp_path / "cat",
+                         experiment="t")
+    assert [d["group"] for d in meta["dicts"]] == \
+        ["group-000", "group-000", "group-000", None]
+    idx = CatalogIndex.load(tmp_path / "cat", verify=True)
+    mm = idx.mmcs_matrix()
+    assert mm.shape == (4, 4)
+    rows_g = decoder_rows_np(load_catalog_records(pkl_group)[0])
+    rows_b = decoder_rows_np(load_catalog_records(pkl_base)[0])
+    # mmcs_from_list order (core.py:248): the upper-triangle entry is
+    # mmcs_np(later, earlier) — the baseline scored against the group
+    assert mm[0, 3] == np.float32(mmcs_np(rows_b, rows_g))
+    # the single-artifact shape keeps its None default (back-compat)
+    base_meta = json.loads((corpus / "cat" / "index.json").read_text())
+    assert all(d["group"] is None for d in base_meta["dicts"])
+
+
+def test_group_kwarg_labels_every_row(corpus, tmp_path):
+    """The build-level ``group=`` kwarg (what a group tenant's catalog
+    step passes) labels every row of a single-artifact build."""
+    pkl = corpus / "sweep" / "learned_dicts.pkl"
+    meta = build_catalog(pkl, corpus / "chunks", tmp_path / "cat",
+                         experiment="t", group="group-001")
+    assert meta["dicts"] and \
+        all(d["group"] == "group-001" for d in meta["dicts"])
+
+
 def test_request_classes_and_priorities():
     from sparse_coding_tpu.serve.slo import BATCH, INTERACTIVE
 

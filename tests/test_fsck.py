@@ -105,6 +105,37 @@ def _shard_store(d: Path, n_shards: int = 2) -> None:
          "n_chunks": total, "shards": shards}, indent=2, sort_keys=True))
 
 
+def _group_store(d: Path) -> dict:
+    """A sound grouped multi-tap store: 3 layer shards (taps ARE shards)
+    built through the hand primitives, then the REAL (jax-free)
+    ``build_groups`` over them — similarity, pooled views, and the
+    digest-sealed ``groups.json`` marker all land exactly as the group
+    step writes them."""
+    from sparse_coding_tpu.groups.assign import build_groups
+
+    d.mkdir(parents=True, exist_ok=True)
+    shards = []
+    total = 0
+    for i in range(3):
+        name = f"shard-{i:03d}"
+        meta = _chunk_store(d / name, n=2)
+        meta.update({"tap": f"residual.{i}", "layer": i,
+                     "layer_loc": "residual"})
+        (d / name / "meta.json").write_text(
+            json.dumps(meta, indent=2, sort_keys=True))
+        total += meta["n_chunks"]
+        meta_digest = bytes_sha256((d / name / "meta.json").read_bytes())
+        (d / name / "shard.digest").write_text(
+            json.dumps({"meta_sha256": meta_digest}, sort_keys=True) + "\n")
+        shards.append({"name": name, "n_chunks": meta["n_chunks"],
+                       "meta_sha256": meta_digest})
+    (d / "manifest.json").write_text(json.dumps(
+        {"version": 1, "kind": "sharded_chunk_store", "n_shards": 3,
+         "n_chunks": total, "activation_dim": 4, "dtype": "float32",
+         "shards": shards}, indent=2, sort_keys=True))
+    return build_groups(d, n_groups=2, n_sample_chunks=1, n_sample_rows=8)
+
+
 def _ckpt_set(d: Path, payload: bytes = b"model-bytes-v1") -> None:
     d.mkdir(parents=True, exist_ok=True)
     (d / "m.msgpack").write_bytes(payload)
@@ -164,6 +195,7 @@ def test_sound_tree_of_every_class_scans_clean(tmp_path):
     _ckpt_set(tmp_path / "sweep" / "ckpt")
     _ckpt_set(tmp_path / "sweep" / "ckpt_prev")
     _shard_store(tmp_path / "shards")
+    _group_store(tmp_path / "gstore")
     _xcache(tmp_path / "xcache")
     _catalog(tmp_path / "catalog")
     _run_dir(tmp_path / "run", tmp_path / "eval")
@@ -294,6 +326,81 @@ def test_catalog_index_cross_checks(tmp_path):
     assert (INCONSISTENT, "catalog") in kinds
     assert (ORPHAN, "catalog") in kinds
     assert any(f.fatal for f in _by_class(report, "catalog"))
+
+
+def test_groups_marker_digest_mismatch_is_fatal(tmp_path):
+    """Rot the assignment payload in place, keeping the JSON parseable:
+    only the embedded digest can tell, and the finding is fatal — a
+    resume would enqueue tenants off the wrong pools."""
+    store = tmp_path / "gstore"
+    payload = _group_store(store)
+    marker = store / "groups.json"
+    raw = marker.read_bytes()
+    rotted = raw.replace(b'"n_layers": 3', b'"n_layers": 4')
+    assert rotted != raw
+    marker.write_bytes(rotted)
+    report = scan_tree(tmp_path)
+    assert (INCONSISTENT, "groups") in _kinds(report)
+    assert any(f.fatal and f.path.endswith("groups.json")
+               for f in _by_class(report, "groups"))
+    assert payload["n_layers"] == 3
+
+
+def test_groups_certified_file_missing_or_rotted_is_fatal(tmp_path):
+    """Every file groups.json certifies must exist and match: a deleted
+    similarity matrix is MISSING, a bitflipped pooled-view manifest is
+    INCONSISTENT — both fatal."""
+    store = tmp_path / "gstore"
+    _group_store(store)
+    (store / "similarity.npy").unlink()
+    pooled = store / "group-000" / "manifest.json"
+    raw = bytearray(pooled.read_bytes())
+    raw[-2] ^= 0x01
+    pooled.write_bytes(bytes(raw))
+    report = scan_tree(tmp_path)
+    kinds = _kinds(report)
+    assert (MISSING, "groups") in kinds
+    assert (INCONSISTENT, "groups") in kinds
+    assert all(f.fatal for f in _by_class(report, "groups"))
+
+
+def test_groups_shard_reference_absent_from_store_is_fatal(tmp_path):
+    """A digest-VALID marker whose group references a shard the store
+    manifest does not list still fails the cross-check: the digest only
+    proves the marker is what the build wrote, not that the store still
+    agrees."""
+    store = tmp_path / "gstore"
+    payload = _group_store(store)
+    del payload["payload_sha256"]
+    payload["groups"][0]["shards"] = ["shard-999"]
+    (store / "groups.json").write_text(json.dumps(
+        embed_payload_digest(payload), indent=2, sort_keys=True))
+    report = scan_tree(tmp_path)
+    hits = [f for f in _by_class(report, "groups")
+            if "shard-999" in f.detail]
+    assert hits and all(f.fatal for f in hits)
+
+
+def test_groups_orphan_pool_dir_dropped_by_repair(tmp_path):
+    """A ``group-<g>/`` dir no group names (a rebuild at smaller G left
+    it behind) is an ORPHAN with the provably-safe ``groups.drop_pool``
+    repair: the view holds only a derivable manifest — dropping it
+    touches no chunk bytes — and the repaired tree rescans clean."""
+    store = tmp_path / "gstore"
+    _group_store(store)
+    stale = store / "group-009"
+    stale.mkdir()
+    (stale / "manifest.json").write_text("{}")
+    report = scan_tree(tmp_path)
+    orphans = [f for f in _by_class(report, "groups") if f.kind == ORPHAN]
+    assert orphans and all(f.repair == "groups.drop_pool" for f in orphans)
+    assert not report.fatal
+    repair_findings(tmp_path, report.findings)
+    assert not stale.exists()
+    after = scan_tree(tmp_path)
+    assert after.clean, after.findings
+    # the chunk bytes never moved
+    assert (store / "shard-000" / "0.npy").exists()
 
 
 def test_xcache_corrupt_orphan_ghost_all_repairable(tmp_path):

@@ -1,0 +1,310 @@
+"""Group-SAE subsystem suite (ISSUE 19, docs/ARCHITECTURE.md §23, tier-1).
+
+Three layers, cheapest first:
+
+- **pure assignment**: greedy adjacent-layer clustering driven exactly
+  on hand-built similarity matrices (determinism, tie-breaks, range
+  errors) plus the ``harvest.layers`` config resolution satellite;
+- **store contracts**: the multi-tap store's grouping preconditions
+  (manifest required, >= 2 layers, chunk-count agreement) and the
+  byte-determinism of the finalized ``groups.json`` marker;
+- **the end-to-end drill** (the ISSUE 19 acceptance bar): synthetic
+  multi-layer harvest through the real ``build_group_pipeline``
+  supervisor DAG, G < L adjacent groups out of the measured similarity,
+  a bitwise-identical marker on rebuild, then one fleet tenant PER group
+  over the pooled views — one group's tenant poisoned to a contained
+  guardian halt while the other trains to completion with a readable
+  per-group FVU.
+
+The ``groups.finalize`` SIGKILL chaos case lives with the kill matrix in
+tests/test_pipeline_chaos.py; the ``groups.similarity``/``groups.build``
+fault rows in tests/test_resilience.py; the ``groups.json`` fsck rows in
+tests/test_fsck.py.
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.groups import (
+    GROUPS_NAME,
+    GroupBuildError,
+    build_groups,
+    enqueue_group_tenants,
+    greedy_adjacent_groups,
+    group_name,
+    load_groups,
+)
+from sparse_coding_tpu.groups.similarity import GroupStoreError, layer_similarity, layer_taps
+from sparse_coding_tpu.pipeline.steps import (
+    HarvestConfigError,
+    _resolve_layers,
+    run_group,
+    run_group_harvest,
+    run_store_manifest,
+)
+from sparse_coding_tpu.resilience import lease as lease_mod
+
+POLL_S = 0.05
+WALL_S = 120.0
+STALE_S = 30.0
+
+
+@pytest.fixture(autouse=True)
+def _hermetic(monkeypatch):
+    monkeypatch.delenv("SPARSE_CODING_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("SPARSE_CODING_CRASH_PLAN", raising=False)
+    monkeypatch.delenv(lease_mod.ENV_PATH, raising=False)
+    monkeypatch.delenv("SPARSE_CODING_XCACHE_DIR", raising=False)
+    yield
+    lease_mod.configure(None)
+
+
+# -- greedy adjacent assignment (pure) ----------------------------------------
+
+
+def _block_matrix():
+    """Two clean blocks: layers {0,1} agree, {2,3} agree, the blocks
+    barely speak — the unambiguous G=2 instance."""
+    m = np.full((4, 4), 0.1)
+    m[0, 1] = m[1, 0] = 0.9
+    m[2, 3] = m[3, 2] = 0.8
+    np.fill_diagonal(m, 1.0)
+    return m
+
+
+def test_greedy_adjacent_groups_block_structure_and_determinism():
+    m = _block_matrix()
+    assert greedy_adjacent_groups(m, 2) == [[0, 1], [2, 3]]
+    # deterministic: same matrix, same result, every time
+    assert greedy_adjacent_groups(m, 2) == greedy_adjacent_groups(m, 2)
+    # adjacency invariant at every G: groups are contiguous layer runs
+    for g in range(1, 5):
+        groups = greedy_adjacent_groups(m, g)
+        assert len(groups) == g
+        flat = [l for grp in groups for l in grp]
+        assert flat == list(range(4)), "groups must partition layers in order"
+
+
+def test_greedy_adjacent_groups_tie_breaks_to_lowest_boundary():
+    # all off-diagonal similarities equal: every merge is a tie, and the
+    # strict > comparison keeps the LOWEST boundary index each round
+    m = np.full((4, 4), 0.5)
+    np.fill_diagonal(m, 1.0)
+    assert greedy_adjacent_groups(m, 2) == [[0, 1, 2], [3]]
+
+
+def test_greedy_adjacent_groups_range_errors():
+    m = _block_matrix()
+    with pytest.raises(GroupBuildError):
+        greedy_adjacent_groups(m, 0)
+    with pytest.raises(GroupBuildError):
+        greedy_adjacent_groups(m, 5)
+
+
+def test_group_name_format():
+    assert group_name(0) == "group-000"
+    assert group_name(12) == "group-012"
+
+
+# -- harvest.layers resolution (satellite) ------------------------------------
+
+
+def test_resolve_layers_default_and_alias():
+    assert _resolve_layers({}) == [1]
+    assert _resolve_layers({"layer": 3}) == [3]
+    assert _resolve_layers({"layers": [2, 3, 4]}) == [2, 3, 4]
+    # back-compat alias allowed when consistent with the list
+    assert _resolve_layers({"layers": [2, 3], "layer": 2}) == [2, 3]
+
+
+def test_resolve_layers_typed_errors():
+    with pytest.raises(HarvestConfigError):
+        _resolve_layers({"layers": []})
+    with pytest.raises(HarvestConfigError, match="contradicts"):
+        _resolve_layers({"layers": [2, 3], "layer": 5})
+
+
+# -- store contracts ----------------------------------------------------------
+
+
+def _tap(i, n_chunks=4):
+    return {"shard": f"shard-{i:03d}", "tap": f"residual.{i}", "layer": i,
+            "layer_loc": "residual", "n_chunks": n_chunks}
+
+
+def test_layer_taps_requires_store_manifest(tmp_path):
+    with pytest.raises(GroupStoreError, match="manifest"):
+        layer_taps(tmp_path)
+
+
+def test_similarity_requires_two_aligned_layers(tmp_path):
+    with pytest.raises(GroupStoreError, match="at least two"):
+        layer_similarity(tmp_path, taps=[_tap(0)])
+    with pytest.raises(GroupStoreError, match="disagree on chunk count"):
+        layer_similarity(tmp_path, taps=[_tap(0, 4), _tap(1, 3)])
+
+
+def _group_config(base: Path, n_layers=4, n_groups=2) -> dict:
+    return {
+        "harvest": {"mode": "synthetic",
+                    "dataset_folder": str(base / "store"),
+                    "layers": list(range(n_layers)),
+                    "activation_dim": 16, "n_ground_truth_features": 24,
+                    "feature_num_nonzero": 5, "feature_prob_decay": 0.99,
+                    "dataset_size": 1024, "n_chunks": 4, "batch_rows": 256,
+                    "seed": 0, "phase_step": 0.35},
+        "group": {"n_groups": n_groups, "n_sample_chunks": 2,
+                  "n_sample_rows": 128, "seed": 0},
+    }
+
+
+def _build_store(cfg: dict) -> Path:
+    for i in range(len(cfg["harvest"]["layers"])):
+        run_group_harvest(cfg, i)
+    run_store_manifest(cfg)
+    run_group(cfg)
+    return Path(cfg["harvest"]["dataset_folder"])
+
+
+def test_groups_marker_bitwise_deterministic_and_verified(tmp_path):
+    cfg = _group_config(tmp_path)
+    store = _build_store(cfg)
+    marker = store / GROUPS_NAME
+    first = marker.read_bytes()
+
+    # run_group is idempotent over a sound marker: bytes untouched
+    run_group(cfg)
+    assert marker.read_bytes() == first
+
+    # rebuild from scratch converges bitwise (the chaos drill's bar)
+    marker.unlink()
+    run_group(cfg)
+    assert marker.read_bytes() == first
+
+    # load_groups verifies: a flipped payload byte is a typed error
+    payload = json.loads(first)
+    assert payload["n_groups"] == 2 and payload["n_layers"] == 4
+    rotted = first.replace(b'"n_groups": 2', b'"n_groups": 3')
+    assert rotted != first
+    marker.write_bytes(rotted)
+    with pytest.raises(GroupBuildError, match="digest"):
+        load_groups(store)
+    marker.write_bytes(first)  # restore for the store's later readers
+
+    # similarity decays with layer distance under the synthetic mixer
+    sim = np.load(store / "similarity.npy")
+    assert sim[0, 0] == 1.0
+    assert sim[0, 1] > sim[0, 2] > sim[0, 3]
+    # ... so the greedy pass groups ADJACENT layers, G < L
+    names = [(g["name"], g["layers"]) for g in payload["groups"]]
+    assert names == [("group-000", [0, 1]), ("group-001", [2, 3])]
+
+
+def test_pooled_view_serves_member_layers_chunks(tmp_path):
+    from sparse_coding_tpu.data.shard_store import open_store
+
+    cfg = _group_config(tmp_path)
+    store = _build_store(cfg)
+    payload = load_groups(store)
+    g0 = payload["groups"][0]
+    pooled = open_store(store / g0["name"])
+    # the pooled view concatenates its member layers' chunks...
+    assert pooled.n_chunks == g0["n_chunks"] == 8
+    rows = pooled.load_chunk(0)
+    assert rows.shape == (256, 16)
+    # ...by reference: chunk 0 IS layer-0 chunk 0, chunk 4 IS layer-1
+    # chunk 0 (taps are shards; no bytes were copied)
+    from sparse_coding_tpu.data.chunk_store import ChunkStore
+
+    assert np.array_equal(rows, ChunkStore(store / "shard-000").load_chunk(0))
+    assert np.array_equal(pooled.load_chunk(4),
+                          ChunkStore(store / "shard-001").load_chunk(0))
+
+
+# -- the end-to-end drill (ISSUE 19 acceptance) -------------------------------
+
+
+@pytest.mark.fleet
+@pytest.mark.faults
+def test_group_pipeline_then_per_group_tenants_halt_contained(tmp_path):
+    """The §23 acceptance drill, end to end on the real steps:
+
+    - the ``build_group_pipeline`` DAG (multi-tap writers → manifest →
+      scrub → group) runs under a real Supervisor and finalizes a
+      G=2 < L=4 assignment;
+    - ``enqueue_group_tenants`` turns the assignment into one fleet
+      tenant per group over the pooled views; group-000's env carries
+      the ``sweep.anomaly`` poison (every batch NaN) so its guardian
+      ladder exhausts to a typed halt CONTAINED in its own run dir;
+    - group-001 trains to completion regardless, with a readable
+      per-group FVU in its eval.json.
+    """
+    from sparse_coding_tpu.pipeline import (
+        FleetScheduler,
+        Supervisor,
+        build_group_pipeline,
+    )
+
+    cfg = _group_config(tmp_path / "data")
+    store = Path(cfg["harvest"]["dataset_folder"])
+    run_dir = tmp_path / "group_run"
+    sup = Supervisor(run_dir, build_group_pipeline(run_dir, cfg),
+                     max_attempts=2, heartbeat_stale_s=STALE_S)
+    summary = sup.run()
+    assert set(summary) == {"harvest-0", "harvest-1", "harvest-2",
+                            "harvest-3", "manifest", "scrub", "group"}
+    assert all(v == "done" for v in summary.values())
+    payload = load_groups(store)
+    assert payload["n_groups"] == 2 and payload["n_layers"] == 4
+    assert [g["layers"] for g in payload["groups"]] == [[0, 1], [2, 3]]
+
+    # a RESUMED supervisor over the finished run skips every step
+    sup2 = Supervisor(run_dir, build_group_pipeline(run_dir, cfg),
+                      max_attempts=2, heartbeat_stale_s=STALE_S)
+    assert all(v == "skipped" for v in sup2.run().values())
+
+    out_root = tmp_path / "tenants"
+    base = {
+        "sweep": {"experiment": "dense_l1_range",
+                  "ensemble": {"batch_size": 128,
+                               "learned_dict_ratio": 2.0, "tied_ae": True,
+                               "checkpoint_every_chunks": 2, "seed": 0,
+                               # budget 1: chunk-0 poison rolls back once,
+                               # the next poisoned chunk exhausts the
+                               # ladder -> typed halt (§16); the clean
+                               # tenant never touches the budget
+                               "guardian_rollback_budget": 1},
+                  "log_every": 1000},
+        "eval": {"n_eval_rows": 512, "seed": 0},
+    }
+    sched = FleetScheduler(tmp_path / "fleet", poll_s=POLL_S,
+                           max_wall_s=WALL_S, n_slices=1,
+                           max_run_attempts=1)
+    names = enqueue_group_tenants(
+        sched, store, base, out_root, max_attempts=1,
+        env_overrides={"group-000": {"SPARSE_CODING_FAULT_PLAN":
+                                     "sweep.anomaly:nth=1,count=0,mode=nan"}})
+    assert names == ["group-000", "group-001"]
+    assert sched.run() == {"group-000": "halted", "group-001": "done"}
+
+    # the halt is durable and CONTAINED in group-000's artifacts
+    g0_guardian = out_root / "group-000" / "sweep" / "guardian.json"
+    assert "halt" in json.loads(g0_guardian.read_text())
+    g1_guardian = out_root / "group-001" / "sweep" / "guardian.json"
+    assert not g1_guardian.exists() or \
+        "halt" not in json.loads(g1_guardian.read_text())
+
+    # the surviving group trained on its POOLED view to a readable FVU
+    ev = json.loads((out_root / "group-001" / "eval"
+                     / "eval.json").read_text())
+    fvus = [rec["fvu"] for rec in ev["dicts"]]
+    assert fvus and all(np.isfinite(v) for v in fvus)
+    final = (out_root / "group-001" / "sweep" / "final"
+             / "dense_l1_range_learned_dicts.pkl")
+    assert final.exists()
+    # group-000 never produced final artifacts — the halt preceded them
+    assert not (out_root / "group-000" / "sweep" / "final"
+                / "dense_l1_range_learned_dicts.pkl").exists()

@@ -1110,3 +1110,119 @@ def test_fsck_repair_kill_midway_rerun_converges(tmp_path):
     for root in (case, control):
         rep = json.loads((root / "fsck" / "report.json").read_text())
         assert rep["clean"] is True
+
+
+# -- Group-SAE assignment chaos cases (ISSUE 19, §23) -------------------------
+
+
+def _group_chaos_config(base: Path) -> dict:
+    return {"harvest": {"mode": "synthetic",
+                        "dataset_folder": str(base / "store"),
+                        "layers": [0, 1, 2],
+                        "activation_dim": 8, "n_ground_truth_features": 12,
+                        "feature_num_nonzero": 3, "feature_prob_decay": 0.99,
+                        "dataset_size": 256, "n_chunks": 2,
+                        "batch_rows": 128, "seed": 0, "phase_step": 0.35},
+            "group": {"n_groups": 2, "n_sample_chunks": 1,
+                      "n_sample_rows": 64, "seed": 0}}
+
+
+def test_group_finalize_kill_restart_bitwise_marker(tmp_path, monkeypatch):
+    """ISSUE 19 acceptance chaos case: SIGKILL the group step at
+    ``groups.finalize`` — similarity.npy and every pooled-view manifest
+    durable, ``groups.json`` (the completion marker) not yet written. A
+    restarted supervisor re-runs the step, which rebuilds from the same
+    sealed store and finalizes a marker — and every grouping artifact —
+    BITWISE identical to an uninterrupted build's."""
+    from sparse_coding_tpu.groups.assign import GROUPS_NAME
+    from sparse_coding_tpu.pipeline import build_group_pipeline
+    from sparse_coding_tpu.pipeline.steps import (
+        run_group,
+        run_group_harvest,
+        run_store_manifest,
+    )
+
+    # the golden grouped store, in-process and uninterrupted
+    gcfg = _group_chaos_config(tmp_path / "g")
+    for i in range(3):
+        run_group_harvest(gcfg, i)
+    run_store_manifest(gcfg)
+    run_group(gcfg)
+    want = _store_digests(tmp_path / "g" / "store")
+    assert GROUPS_NAME in want and "similarity.npy" in want
+
+    config = _group_chaos_config(tmp_path)
+    run_dir = tmp_path / "run"
+    only = ["harvest-0", "harvest-1", "harvest-2", "manifest", "group"]
+
+    # run 1: the group child dies BY SIGKILL after the pooled manifests,
+    # before the marker
+    monkeypatch.setenv(crash_mod.ENV_VAR, "groups.finalize:nth=1")
+    sup = Supervisor(run_dir,
+                     build_group_pipeline(run_dir, config, only=only),
+                     max_attempts=1, heartbeat_stale_s=STALE_S)
+    with pytest.raises(StepFailed, match="killed by signal 9"):
+        sup.run()
+    store = tmp_path / "store"
+    assert (store / "similarity.npy").exists(), "kill landed before matrix"
+    assert (store / "group-000" / "manifest.json").exists()
+    assert not (store / GROUPS_NAME).exists(), "kill landed after marker"
+
+    # run 2: fresh supervisor, no plan — the group step rebuilds
+    monkeypatch.delenv(crash_mod.ENV_VAR)
+    sup2 = Supervisor(run_dir,
+                      build_group_pipeline(run_dir, config, only=only),
+                      max_attempts=2, heartbeat_stale_s=STALE_S)
+    summary = sup2.run()
+    assert all(v in ("done", "skipped") for v in summary.values())
+    assert _store_digests(store) == want
+
+
+def test_rot_groups_marker_preflight_halts_then_rebuilds_bitwise(
+        tmp_path, monkeypatch):
+    """The rot campaign's ``groups.json`` row: rot the finalized
+    assignment IN PLACE (still parses — only the embedded digest knows)
+    after a completed group run. fsck flags it fatal WITHOUT repairing
+    (contradictory evidence is an operator decision), supervisor resume
+    halts typed naming the marker, and the documented operator action —
+    delete the marker, re-run — converges to bitwise-identical bytes.
+    Silent divergence (tenants enqueued off a rotted assignment) is the
+    forbidden outcome."""
+    from sparse_coding_tpu.fsck import run_fsck
+    from sparse_coding_tpu.groups.assign import GROUPS_NAME
+    from sparse_coding_tpu.pipeline import (
+        PreflightAuditError,
+        build_group_pipeline,
+    )
+
+    config = _group_chaos_config(tmp_path)
+    run_dir = tmp_path / "run"
+    sup = Supervisor(run_dir, build_group_pipeline(run_dir, config),
+                     heartbeat_stale_s=STALE_S)
+    assert all(v == "done" for v in sup.run().values())
+    marker = tmp_path / "store" / GROUPS_NAME
+    want = marker.read_bytes()
+
+    # rot that keeps the JSON parseable: the done() probe and the parse
+    # verifier both trust it; only the payload digest can tell
+    rotted = want.replace(b'"n_layers": 3', b'"n_layers": 4')
+    assert rotted != want
+    marker.write_bytes(rotted)
+
+    report = run_fsck(run_dir, repair=True)
+    assert any(f.fatal and f.path.endswith(GROUPS_NAME)
+               for f in report.findings)
+    assert marker.read_bytes() == rotted  # evidence never auto-repaired
+
+    with pytest.raises(PreflightAuditError, match="groups.json"):
+        Supervisor(run_dir, build_group_pipeline(run_dir, config),
+                   heartbeat_stale_s=STALE_S).run()
+
+    # the documented operator action (groups/assign.py load_groups):
+    # delete the marker and re-run — the rebuild is bitwise the original
+    marker.unlink()
+    sup3 = Supervisor(run_dir, build_group_pipeline(run_dir, config),
+                      heartbeat_stale_s=STALE_S)
+    summary = sup3.run()
+    assert summary["group"] == "done"  # the marker's step actually re-ran
+    assert marker.read_bytes() == want

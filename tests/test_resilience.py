@@ -30,6 +30,12 @@ Sites × handlers covered here:
                       name; the retry is byte-identical to a clean build
 - ``catalog.query`` → an injected query-path failure is typed and scoped
                       to THAT request; the next query serves normally
+- ``groups.similarity`` → a transient sampled-chunk read failure is
+                      absorbed by the bounded retry (matrix unchanged);
+                      a persistent one propagates typed
+- ``groups.build``  → a durable-write failure is typed; nothing
+                      half-built goes durable under ``groups.json``; the
+                      retry is byte-identical to a clean build
 - SIGTERM           → sweep checkpoints at the chunk boundary and resume
                       continues BITWISE-identically
 """
@@ -1884,3 +1890,81 @@ def test_fsck_scan_fault_corrupt_flips_a_read_byte_not_the_disk(tmp_path):
                                    for f in report.findings)
     assert (store / "0.npy").read_bytes() == before
     assert scan_tree(tmp_path).clean
+
+
+# -- Group-SAE build path (ISSUE 19, §23) -------------------------------------
+
+
+def _multitap_store(tmp_path, n_layers=2):
+    """A tiny sealed multi-tap store (taps ARE shards) for the grouping
+    fault rows — 2 layers, 2 aligned chunks each."""
+    from sparse_coding_tpu.pipeline.steps import (
+        run_group_harvest,
+        run_store_manifest,
+    )
+
+    cfg = {"harvest": {"mode": "synthetic",
+                       "dataset_folder": str(tmp_path / "store"),
+                       "layers": list(range(n_layers)),
+                       "activation_dim": 8, "n_ground_truth_features": 12,
+                       "feature_num_nonzero": 3, "feature_prob_decay": 0.99,
+                       "dataset_size": 128, "n_chunks": 2, "batch_rows": 64,
+                       "seed": 0}}
+    for i in range(n_layers):
+        run_group_harvest(cfg, i)
+    run_store_manifest(cfg)
+    return tmp_path / "store"
+
+
+def test_groups_similarity_fault_transient_absorbed_persistent_typed(
+        tmp_path):
+    """``groups.similarity`` matrix entry: a transient sampled-chunk read
+    failure is absorbed by the bounded retry — and the measured matrix is
+    BITWISE the clean pass's (determinism survives a flaky read); a
+    persistent failure propagates typed after the budget."""
+    from sparse_coding_tpu.groups.similarity import layer_similarity
+
+    store = _multitap_store(tmp_path)
+    want = layer_similarity(store, n_sample_chunks=1, n_sample_rows=32)
+    with inject(site="groups.similarity", nth=1) as plan:
+        got = layer_similarity(store, n_sample_chunks=1, n_sample_rows=32)
+    assert plan.fired_count("groups.similarity") == 1
+    assert got["matrix"].tobytes() == want["matrix"].tobytes()
+    with inject(site="groups.similarity", nth=1, count=0) as plan:
+        with pytest.raises(OSError) as err:
+            layer_similarity(store, n_sample_chunks=1, n_sample_rows=32)
+        assert isinstance(err.value, InjectedFault)
+    assert plan.fired_count("groups.similarity") >= 3  # whole retry budget
+
+
+def test_groups_build_fault_typed_then_retry_byte_identical(tmp_path):
+    """``groups.build`` matrix entry: a persistent durable-write failure
+    is typed and leaves NO ``groups.json`` marker behind (tenants can
+    never enqueue against a half-built assignment); the retry over the
+    same store produces a marker byte-identical to a build that never
+    failed — and a transient failure is absorbed outright."""
+    from sparse_coding_tpu.groups.assign import GROUPS_NAME, build_groups
+
+    store = _multitap_store(tmp_path)
+    build_groups(store, n_groups=1, n_sample_chunks=1, n_sample_rows=32)
+    want = (store / GROUPS_NAME).read_bytes()
+
+    # reset to an unbuilt store: marker, matrix, and pooled views gone
+    (store / GROUPS_NAME).unlink()
+    (store / "similarity.npy").unlink()
+    for d in store.glob("group-*"):
+        (d / "manifest.json").unlink()
+        d.rmdir()
+
+    with inject(site="groups.build", nth=1, count=0) as plan:
+        with pytest.raises(OSError) as err:
+            build_groups(store, n_groups=1, n_sample_chunks=1,
+                         n_sample_rows=32)
+        assert isinstance(err.value, InjectedFault)
+    assert plan.fired_count("groups.build") >= 3  # the whole retry budget
+    assert not (store / GROUPS_NAME).exists()  # never half-completed
+
+    with inject(site="groups.build", nth=1) as plan:  # transient: absorbed
+        build_groups(store, n_groups=1, n_sample_chunks=1, n_sample_rows=32)
+    assert plan.fired_count("groups.build") == 1
+    assert (store / GROUPS_NAME).read_bytes() == want

@@ -108,6 +108,41 @@ def test_mesh_sharded_serving_bucket_lowers(rng):
     assert "sharding" in text  # the mesh placement is in the program
 
 
+def test_group_state_rules_resolve_and_sharded_step_lowers(rng):
+    """ISSUE 19 AOT gate (§23): GROUP_STATE_RULES — a group tenant's
+    sweep state with member leaves over "model" and the pooled-store
+    statistics (shared center, per-layer pooling stats) replicated —
+    resolves totally, and the ensemble train step with those shardings
+    baked in passes the TPU lowering pipeline."""
+    from jax.sharding import PartitionSpec as P
+
+    from sparse_coding_tpu.parallel import partition
+    from sparse_coding_tpu.parallel.mesh import make_mesh
+
+    # rule resolution: pooled-store stats replicate, member leaves shard
+    probe_tree = {"params": {"dict": jnp.zeros((4, 64, 32))},
+                  "center": jnp.zeros((32,)),
+                  "pooled_stats": jnp.zeros((2, 32))}
+    specs = partition.match_partition_rules(partition.GROUP_STATE_RULES,
+                                            probe_tree)
+    assert specs["center"] == P() and specs["pooled_stats"] == P()
+    assert specs["params"]["dict"] == partition.MEMBER
+
+    mesh = make_mesh(2, 4)
+    members = [FunctionalTiedSAE.init(k, 32, 64, l1_alpha=1e-3)
+               for k in jax.random.split(rng, 4)]
+    ens = Ensemble(members, FunctionalTiedSAE, donate=False)
+    batch = jnp.zeros((512, 32))
+    state_shardings = partition.tree_shardings(mesh, ens.state,
+                                               partition.GROUP_STATE_RULES)
+    jitted = jax.jit(lambda s, b: ens._standard_step(s, b),
+                     in_shardings=(state_shardings,
+                                   partition.batch_sharding(mesh)))
+    text = jitted.trace(ens.state, batch).lower(
+        lowering_platforms=("tpu",)).as_text()
+    assert "sharding" in text  # the group placement is in the program
+
+
 def test_sharded_sentinel_epilogue_no_hlo_change_and_no_host_transfer(rng):
     """ISSUE 15 AOT gate for the sentinel-under-sharding claim: the mesh
     whole-step program with the sentinel ON contains EXACTLY the same
