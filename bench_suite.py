@@ -625,16 +625,31 @@ def bench_serving(quick: bool) -> None:
           fill_ratio=round(fill, 3), recompiles=snap["recompiles"])
 
 
-def bench_gateway(quick: bool) -> None:
-    """Mixed-tenant gateway soak (ISSUE 6 / ROADMAP item 2): three
-    priority classes from concurrent tenants through a replica pool with
-    hedging live — including a feature-catalog tenant (ISSUE 16) firing
-    interactive top-k ``neighbors`` requests into the SAME pool as the
-    encode tenants — reporting throughput, p50/p95/p99 request latency
-    read back from a merged ``obs.report`` (the production evidence
-    path, not an ad-hoc timer), sheds by priority, hedge accounting, and
-    the steady-state compile count — which must be 0: after warmup, no
-    request may ever pay a trace or compile in the latency path."""
+def bench_gateway(quick: bool, variant: str | None = None) -> None:
+    """Mixed-tenant gateway soak (ISSUE 6 / ROADMAP item 2; ladder
+    variants ISSUE 20): three priority classes from concurrent tenants
+    through a replica pool with hedging live — including a
+    feature-catalog tenant (ISSUE 16) firing interactive top-k
+    ``neighbors`` requests into the SAME pool as the encode tenants —
+    under a SKEWED request-size mix that pads badly on the static
+    ladder. Two variants, each its own ledger row: ``static_ladder``
+    (fixed buckets, no rebatching) and ``derived_ladder`` (continuous
+    rebatching on, traffic-derived ladder swapped in mid-stream through
+    ``maybe_swap_ladder`` — the zero-compile path). Reported per
+    variant: throughput, ``ttfr_s`` (construction→first result wall),
+    ``wasted_pad_rows`` over the measured soak, p50/p95/p99 request
+    latency read back from a merged ``obs.report`` (the production
+    evidence path, not an ad-hoc timer), sheds, hedge accounting, and
+    the steady-state compile count — which must be 0: after warmup (and
+    after the ladder swap), no request may ever pay a trace or compile
+    in the latency path."""
+    variants = (variant,) if variant else ("static_ladder",
+                                           "derived_ladder")
+    for v in variants:
+        _gateway_soak_variant(quick, v)
+
+
+def _gateway_soak_variant(quick: bool, variant: str) -> None:
     import tempfile
     import threading
 
@@ -650,6 +665,10 @@ def bench_gateway(quick: bool) -> None:
         ServingGateway,
     )
 
+    if variant not in ("static_ladder", "derived_ladder"):
+        raise ValueError(f"unknown gateway_soak variant {variant!r} "
+                         "(choose static_ladder | derived_ladder)")
+    derived = variant == "derived_ladder"
     d, ratio = (256, 2) if quick else (512, 4)
     n_threads, per_thread = (3, 40) if quick else (6, 150)
     ld = FunctionalTiedSAE.to_learned_dict(
@@ -658,7 +677,15 @@ def bench_gateway(quick: bool) -> None:
     registry = ModelRegistry()
     registry.register("sae", ld)
     rng = np.random.default_rng(0)
-    sizes = rng.integers(1, 65, n_threads * per_thread)
+    # skewed request-size mix (ISSUE 20): ~85% cluster just above the
+    # static ladder's smallest rung — every one pads 18-30 rows up to 64
+    # on (8, 64, 512) — plus a mid-size tail that pads up to 512. The
+    # shape a derived ladder earns its keep on; same mix for BOTH
+    # variants so the rows compare.
+    n_req = n_threads * per_thread
+    small = rng.integers(18, 31, n_req)
+    large = rng.integers(200, 281, n_req)
+    sizes = np.where(rng.random(n_req) < 0.85, small, large)
     payloads = [np.asarray(rng.standard_normal((int(s), d)), np.float32)
                 for s in sizes]
     # the catalog tenant's feature-intelligence requests (ISSUE 16):
@@ -666,15 +693,35 @@ def bench_gateway(quick: bool) -> None:
     # exercises mixed encode+neighbors flushes under priority pressure
     cat_per_thread = per_thread // 2
     cat_payloads = [np.asarray(rng.standard_normal((int(s), d)), np.float32)
-                    for s in rng.integers(1, 65, cat_per_thread)]
+                    for s in rng.integers(18, 31, cat_per_thread)]
+    # prime traffic: replayed before the measured soak to feed the
+    # request-size histogram the derivation snapshots
+    prime = payloads[:max(8, n_req // 8)]
     obs.install_jax_probes()
+    t_start = time.perf_counter()
     with ServingGateway(registry, n_replicas=2, n_spares=1,
                         max_wait_ms=1.0, max_queue_rows=1 << 20,
                         hedge_min_samples=64,
                         ops=tuple(DEFAULT_OPS) + ("neighbors",),
+                        rebatch=derived, ladder_hold_ticks=1,
                         engine_kwargs={"topk_k": 8}) as gw:
         gw.warmup()
+        # ttfr: construction + warmup + one real request resolved
+        gw.submit("sae", payloads[0]).result(timeout=120)
+        ttfr_s = time.perf_counter() - t_start
+        for p in prime:
+            gw.submit("sae", p).result(timeout=120)
+        swap = gw.maybe_swap_ladder() if derived else None
+        # pad/compile baselines AFTER the swap: wasted_pad_rows and
+        # steady_compiles measure the soak on the ladder that serves it
         compiles0 = obs.counter("jax.compiles").value
+
+        def _pad_state() -> tuple:
+            bk = gw.stats()["buckets"]
+            return (sum(b["batches"] * size for size, b in bk.items()),
+                    sum(b["rows"] for b in bk.values()))
+
+        cap0, rows0 = _pad_state()
 
         def submitter(tid: int) -> None:
             prio = PRIORITIES[tid % len(PRIORITIES)]
@@ -710,6 +757,8 @@ def bench_gateway(quick: bool) -> None:
             th.join()
         dt = time.perf_counter() - t0
         steady_compiles = obs.counter("jax.compiles").value - compiles0
+        cap1, rows1 = _pad_state()
+        active_rungs = list(gw.active_buckets)
         snap = gw.stats()
         # latency quantiles via the production evidence path: flush the
         # gateway registry into an event file, merge with obs.report
@@ -722,13 +771,21 @@ def bench_gateway(quick: bool) -> None:
                 obs.configure_sink(prev)
             report = build_report(run_dir)
         lat = report["histograms"].get("gateway.latency_s", {})
-    # throughput counts the rows actually served (sheds excluded)
-    total_rows = sum(b["rows"] for b in snap["buckets"].values())
+    # throughput counts the rows actually served during the measured
+    # soak (sheds and prime traffic excluded); wasted pad likewise
+    soak_rows = rows1 - rows0
+    wasted_pad_rows = (cap1 - cap0) - soak_rows
     g = snap["gateway"]
-    _emit("gateway_soak", total_rows / dt, "activations/s",
+    _emit("gateway_soak", soak_rows / dt, "activations/s",
+          variant=variant,
           n_requests=len(payloads) + len(cat_payloads),
           catalog_requests=len(cat_payloads), n_threads=n_threads + 1,
           d=d, n_replicas=2,
+          ttfr_s=round(ttfr_s, 3),
+          wasted_pad_rows=int(wasted_pad_rows),
+          ladder_rungs=active_rungs, ladder_swapped=swap is not None,
+          rebatch_joined=snap["rebatch"]["joined"],
+          rebatch_joined_rows=snap["rebatch"]["joined_rows"],
           p50_ms=(round(lat["p50"] * 1e3, 3) if lat.get("p50") else None),
           p95_ms=(round(lat["p95"] * 1e3, 3) if lat.get("p95") else None),
           p99_ms=(round(lat["p99"] * 1e3, 3) if lat.get("p99") else None),
@@ -1394,22 +1451,44 @@ def _bench_seq_parallel_impl(quick: bool) -> None:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
+    parser.add_argument("suite", nargs="?", default=None,
+                        help="run only this suite (e.g. gateway_soak); "
+                             "default runs everything")
+    parser.add_argument("--variant", default=None,
+                        help="gateway_soak only: static_ladder | "
+                             "derived_ladder (default runs both)")
     parser.add_argument("--quick", action="store_true")
     args = parser.parse_args()
     from sparse_coding_tpu.obs import ledger as perf_ledger
 
-    rows_before = len(perf_ledger.read_rows())
     # seq_parallel runs LAST: its hang watchdog exits the process, and every
     # earlier suite's JSON line is flushed by then
-    for suite in (bench_ensemble, bench_ensemble_ratio, bench_big_sae,
+    all_suites = (bench_ensemble, bench_ensemble_ratio, bench_big_sae,
                   bench_harvest,
                   bench_chunk_io, bench_ingest_soak, bench_streaming_eval,
                   bench_guardian_soak, bench_perf_probe, bench_gateway,
                   bench_catalog, bench_fleet_soak, bench_group_sae,
                   bench_plane_tide,
-                  bench_fsck_scan, bench_mesh_scale, bench_seq_parallel):
+                  bench_fsck_scan, bench_mesh_scale, bench_seq_parallel)
+    # each suite is addressable by its emitted row name where it
+    # differs from the function name (gateway_soak -> bench_gateway)
+    by_name = {fn.__name__.removeprefix("bench_"): fn for fn in all_suites}
+    by_name["gateway_soak"] = bench_gateway
+    if args.suite is not None:
+        if args.suite not in by_name:
+            raise SystemExit(f"unknown suite {args.suite!r} "
+                             f"(choose from {sorted(by_name)})")
+        suites = (by_name[args.suite],)
+    else:
+        suites = all_suites
+
+    rows_before = len(perf_ledger.read_rows())
+    for suite in suites:
         try:
-            suite(args.quick)
+            if suite is bench_gateway:
+                suite(args.quick, variant=args.variant)
+            else:
+                suite(args.quick)
         except Exception as e:
             print(f"{suite.__name__} failed: {e!r}", file=sys.stderr)
     # ledger accounting (ISSUE 12): every emitted scenario row must have

@@ -195,6 +195,43 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "admission_level":
             gauges.get("gateway.admission_level", {}).get("value"),
     }
+
+    # traffic-shaped ladder evidence (docs/ARCHITECTURE.md §24): the
+    # ACTIVE rung set (published as idx-labeled gauges at every swap),
+    # the swap/hold/skip tallies, the continuous-rebatching outcome, and
+    # the pad-waste the ladder exists to shrink — Σ over buckets of
+    # (batches x bucket − rows served). One section answers "did the
+    # derived ladder actually pay": rungs match traffic, wasted pad
+    # falls, swaps are counted not flapping
+    active_rungs = []
+    for name, g in gauges.items():
+        base, labels = split_labels(name)
+        if base == "gateway.ladder.rung" and "idx" in labels:
+            v = g.get("value")
+            if v:
+                active_rungs.append((int(labels["idx"]), int(v)))
+    wasted_pad_rows = 0
+    served_rows = _by_label("serve.rows", "bucket")
+    for b, n_batches in _by_label("serve.batches", "bucket").items():
+        try:
+            wasted_pad_rows += (int(b) * int(n_batches)
+                                - int(served_rows.get(b, 0)))
+        except (TypeError, ValueError):
+            continue
+    ladder = {
+        "rungs": [r for _, r in sorted(active_rungs)],
+        "swaps": counters.get("gateway.ladder.swaps", 0),
+        "held": counters.get("gateway.ladder.held", 0),
+        "derive_errors": counters.get("gateway.ladder.derive_errors", 0),
+        "swap_errors": counters.get("gateway.ladder.swap_errors", 0),
+        "rebatch_joined": counters.get("serve.rebatch.joined", 0),
+        "rebatch_joined_rows": counters.get("serve.rebatch.joined_rows", 0),
+        "rebatch_rejected": counters.get("serve.rebatch.rejected", 0),
+        # every joined row is a pad row the dispatched batch would have
+        # burned anyway — the rebatcher's direct savings
+        "pad_rows_saved": counters.get("serve.rebatch.joined_rows", 0),
+        "wasted_pad_rows": wasted_pad_rows,
+    }
     # data-plane evidence (docs/ARCHITECTURE.md §15): the async ingest
     # pipeline's per-stage walls (decode vs host→device staging vs the
     # whole sweep.chunk block — "compute-bound" means decode stops
@@ -321,6 +358,7 @@ def build_report(run_dir: str | Path, obs_subdir: str = "obs") -> dict:
         "compiles": counters.get("jax.compiles", 0),
         "compile_cache": compile_cache,
         "gateway": gateway,
+        "ladder": ladder,
         "plane": plane,
         "ingest": ingest,
         "guardian": guardian,
@@ -490,6 +528,21 @@ def format_report(report: dict) -> str:
             lines.append(f"  shed: {shed}")
         if routes:
             lines.append(f"  routes: {routes}")
+    lad = report.get("ladder", {})
+    if lad.get("rungs") or any(
+            lad.get(k) for k in ("swaps", "held", "derive_errors",
+                                 "swap_errors", "rebatch_joined",
+                                 "rebatch_rejected")):
+        rungs = ",".join(str(r) for r in lad.get("rungs", [])) or "?"
+        lines.append(
+            f"ladder: active [{rungs}], {lad['swaps']} swap(s) "
+            f"({lad['held']} held, {lad['derive_errors']} derive err, "
+            f"{lad['swap_errors']} swap err); rebatch "
+            f"{lad['rebatch_joined']} joined "
+            f"(+{lad['rebatch_joined_rows']} rows) / "
+            f"{lad['rebatch_rejected']} rejected; pad rows "
+            f"{lad['wasted_pad_rows']} wasted / "
+            f"{lad['pad_rows_saved']} saved")
     ing = report.get("ingest", {})
     if any(ing.get(k) for k in ("decoded_chunks", "degraded_streams",
                                 "scrub_checked", "scrub_quarantined")):

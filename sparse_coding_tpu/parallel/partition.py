@@ -229,6 +229,18 @@ def sharding_fingerprint(mesh: Optional[Mesh], tree: Any = None,
     return f"mesh({axes})|{leaves}"
 
 
+def batch_alignment(mesh: Optional[Mesh]) -> int:
+    """Row alignment the data axis imposes on serving batch shapes: every
+    bucket rung must be a multiple of this so row-sharding divides
+    evenly (1 when unsharded). The SINGLE home of the divisibility rule —
+    engine bucket validation and derived-ladder alignment
+    (serve/ladder.py §24) both read it, so a derived rung can never be
+    un-shardable on the mesh it will serve on."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape["data"])
+
+
 __all__ = [
     "MEMBER", "BATCH", "STACKED_BATCH", "REPLICATED",
     "FEATURE_ROWS", "FEATURE_COLS",
@@ -237,5 +249,5 @@ __all__ = [
     "GROUP_STATE_RULES",
     "batch_spec", "serve_rules", "tree_paths", "match_partition_rules",
     "tree_shardings", "place_tree", "place_batch", "batch_sharding",
-    "sharding_fingerprint",
+    "sharding_fingerprint", "batch_alignment",
 ]

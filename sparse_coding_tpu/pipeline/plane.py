@@ -289,9 +289,21 @@ class ElasticPlane:
                 if new_split is not None:
                     split, rebalanced = new_split, True
         self._apply(split)
+        # the ladder swap rides the arbiter tick (§24): one
+        # derive→hold→swap pass per tick, duck-typed so jax-free
+        # fleet-only arbiters (and test doubles without the method) are
+        # untouched. maybe_swap_ladder never raises — failures are
+        # counted skips inside the gateway.
+        ladder_swap = None
+        swap_fn = getattr(self.gateway, "maybe_swap_ladder", None)
+        if swap_fn is not None:
+            ladder_swap = swap_fn()
+        if ladder_swap is not None:
+            obs.counter("plane.ladder_swaps").inc()
         return {"tick": self._ticks, "signals": signals, "split": split,
                 "replicas": self.target_replicas(split), "vote": vote,
-                "rebalanced": rebalanced}
+                "rebalanced": rebalanced,
+                "ladder_swapped": ladder_swap is not None}
 
     def run(self, *, poll_s: float = 0.25,
             max_wall_s: Optional[float] = None,

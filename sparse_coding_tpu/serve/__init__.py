@@ -65,6 +65,19 @@ _LAZY_ATTRS = {
     "Replica": ("sparse_coding_tpu.serve.gateway", "Replica"),
     "ServingGateway": ("sparse_coding_tpu.serve.gateway", "ServingGateway"),
     "EwmaHealth": ("sparse_coding_tpu.serve.health", "EwmaHealth"),
+    # ladder derivation is jax-free by design (§24): importing these
+    # never pulls the engine/gateway modules
+    "STATIC_LADDER": ("sparse_coding_tpu.serve.ladder", "STATIC_LADDER"),
+    "LadderError": ("sparse_coding_tpu.serve.ladder", "LadderError"),
+    "derive_ladder": ("sparse_coding_tpu.serve.ladder", "derive_ladder"),
+    "ladder_pad_rows": ("sparse_coding_tpu.serve.ladder",
+                        "ladder_pad_rows"),
+    "ladder_to_json": ("sparse_coding_tpu.serve.ladder", "ladder_to_json"),
+    "parse_snapshot": ("sparse_coding_tpu.serve.ladder", "parse_snapshot"),
+    "pinned_ladder": ("sparse_coding_tpu.serve.ladder", "pinned_ladder"),
+    "snapshot_bytes": ("sparse_coding_tpu.serve.ladder", "snapshot_bytes"),
+    "traffic_snapshot": ("sparse_coding_tpu.serve.ladder",
+                         "traffic_snapshot"),
     "ServingMetrics": ("sparse_coding_tpu.serve.metrics", "ServingMetrics"),
     "score_offline": ("sparse_coding_tpu.serve.offline", "score_offline"),
     "ModelRegistry": ("sparse_coding_tpu.serve.registry", "ModelRegistry"),
@@ -99,11 +112,13 @@ __all__ = [
     "DispatchError",
     "EwmaHealth",
     "INTERACTIVE",
+    "LadderError",
     "ModelRegistry",
     "PRIORITIES",
     "RegistryEntry",
     "Replica",
     "SCAVENGER",
+    "STATIC_LADDER",
     "ServingEngine",
     "ServingGateway",
     "ServingMetrics",
@@ -113,6 +128,13 @@ __all__ = [
     "RequestTooLargeError",
     "bucket_op_fn",
     "build_bucket_program",
+    "derive_ladder",
+    "ladder_pad_rows",
+    "ladder_to_json",
     "op_rows_axis",
+    "parse_snapshot",
+    "pinned_ladder",
     "score_offline",
+    "snapshot_bytes",
+    "traffic_snapshot",
 ]

@@ -211,6 +211,51 @@ class MicroBatcher:
             return self._queued_rows
 
     @property
+    def max_rows(self) -> int:
+        with self._cond:
+            return self._max_rows
+
+    def set_max_rows(self, max_rows: int) -> None:
+        """Hot-swap the capacity-flush threshold to a new ladder's
+        largest bucket (gateway ladder swap, serve/ladder.py §24).
+        Queued requests are untouched — an already-admitted request
+        larger than the new ladder still dispatches (the engine falls
+        back to a previously-warmed rung), so a shrink-swap can never
+        strand admitted work."""
+        if max_rows < 1:
+            raise ValueError("max_rows must be >= 1")
+        with self._cond:
+            self._max_rows = int(max_rows)
+            self._cond.notify_all()
+
+    def take_joiners(self, key: tuple,
+                     remaining_rows: int) -> list[Request]:
+        """Continuous rebatching (§24): pop queued requests of ``key``'s
+        stream — strictly FIFO, never skipping the head (skipping would
+        reorder results against submission order and break dispatch
+        determinism) — while they fit ``remaining_rows``, so requests
+        that arrived after the flush was popped ride the already-chosen
+        bucket's pad rows instead of waiting a full cycle. Joining only
+        ever ACCELERATES a request, so deadlines and priority ordering
+        are respected by construction. A present head that does not fit
+        is counted rejected (``serve.rebatch.rejected``)."""
+        joined: list[Request] = []
+        rows = 0
+        with self._cond:
+            q = self._queues.get(key)
+            while q and remaining_rows - rows >= q[0].rows:
+                r = q.popleft()
+                joined.append(r)
+                rows += r.rows
+            rejected = 1 if (q and remaining_rows - rows > 0) else 0
+            if rows:
+                self._queued_rows -= rows
+        if rows:
+            self._metrics.record_dequeue(rows)
+        self._metrics.record_rebatch(len(joined), rows, rejected)
+        return joined
+
+    @property
     def service_rate_rows_s(self) -> float | None:
         """Recent rows/s service-rate EWMA (None before the first timed
         dispatch) — the typed ``LoadSignals`` feed (serve/slo.py): the

@@ -257,8 +257,6 @@ class ServingEngine:
                  program_cache: ProgramCache | None = None,
                  perf_probe_every: int = obs.perf.DEFAULT_PROBE_EVERY,
                  mesh=None):
-        if not buckets or list(buckets) != sorted(set(buckets)):
-            raise ValueError(f"buckets must be unique ascending: {buckets}")
         # mesh-sharded serving (docs/ARCHITECTURE.md §19, ISSUE 15): with a
         # ("model", "data") mesh, entry pytrees place once through the
         # partition rule layer (dict stacks member-sharded over "model",
@@ -268,16 +266,14 @@ class ServingEngine:
         # manifest so a warm mesh restart loads the mesh executables at
         # zero backend compiles.
         self._mesh = mesh
-        if mesh is not None:
-            n_data = int(mesh.shape["data"])
-            bad = [b for b in buckets if int(b) % n_data != 0]
-            if bad:
-                raise ValueError(
-                    f"buckets {bad} not divisible by mesh data axis "
-                    f"{n_data}; pick a divisible bucket ladder")
         self._placed_trees: dict[str, Any] = {}
         self._registry = registry
-        self._buckets = tuple(int(b) for b in buckets)
+        self._buckets = self._validate_buckets(buckets)
+        # every ladder this engine has EVER served (construction + swaps):
+        # their programs are warm in the shared ProgramCache, so an
+        # admitted request a shrink-swap left above the active max falls
+        # back to a known larger rung instead of being stranded (§24)
+        self._known_buckets = self._buckets
         self._ops = tuple(ops)
         self._topk_k = int(topk_k)
         self._dtype = jnp.dtype(dtype)
@@ -328,6 +324,72 @@ class ServingEngine:
             max_wait_s=max_wait_ms / 1e3,
             max_queue_rows=max_queue_rows,
             metrics=self.metrics)
+
+    # -- bucket ladder -------------------------------------------------------
+
+    def _validate_buckets(self, buckets: Sequence[int]) -> tuple[int, ...]:
+        if not buckets or list(buckets) != sorted(set(buckets)):
+            raise ValueError(f"buckets must be unique ascending: {buckets}")
+        align = partition.batch_alignment(self._mesh)
+        if align > 1:
+            bad = [b for b in buckets if int(b) % align != 0]
+            if bad:
+                raise ValueError(
+                    f"buckets {bad} not divisible by mesh data axis "
+                    f"{align}; pick a divisible bucket ladder")
+        return tuple(int(b) for b in buckets)
+
+    @property
+    def buckets(self) -> tuple[int, ...]:
+        """The ACTIVE bucket ladder (may differ from construction after
+        a gateway ladder swap, serve/ladder.py §24)."""
+        return self._buckets
+
+    def set_buckets(self, buckets: Sequence[int]) -> None:
+        """Atomically replace the active ladder (gateway ladder swap,
+        §24). The old rungs stay in the known set so already-admitted
+        oversize work still finds a warm program; warm the NEW rungs
+        first (:meth:`warm_buckets`) or steady state pays recompiles."""
+        new = self._validate_buckets(buckets)
+        self._known_buckets = tuple(sorted(set(self._known_buckets)
+                                           | set(new)))
+        self._buckets = new
+        self._batcher.set_max_rows(new[-1])
+
+    def warm_buckets(self, buckets: Sequence[int],
+                     max_workers: int | None = None) -> int:
+        """AOT compile-or-load every (model, op) program for the GIVEN
+        rungs — the candidate-ladder warm pass of a zero-compile swap:
+        run against a spare's engine (or any pool member — the program
+        table is shared), the executables land durably in the xcache
+        store and in the warmup manifest, so the subsequent
+        :meth:`set_buckets` is a pure table flip. Returns the number of
+        programs prepared; does not change the active ladder."""
+        rungs = self._validate_buckets(buckets)
+        todo = [(name, op, bucket)
+                for name in self._registry.names()
+                for op in self._ops
+                for bucket in rungs
+                if (name, op, bucket) not in self._programs.compiled
+                and (op != "vote" or self._registry.get(name).is_stack)]
+        workers = (max(1, int(max_workers)) if max_workers is not None
+                   else self._warmup_workers)
+        workers = min(workers, len(todo)) if todo else 1
+        with obs.span("serve.warmup", programs=len(todo), workers=workers,
+                      source="ladder"):
+            if workers <= 1:
+                for key in todo:
+                    self._get_compiled(*key, count_miss=False)
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    futures = [pool.submit(self._get_compiled, *key,
+                                           count_miss=False)
+                               for key in todo]
+                    for f in futures:
+                        f.result()
+        return len(todo)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -396,7 +458,9 @@ class ServingEngine:
             (d["model"], d["op"], int(d["bucket"]))
             for d in descs
             if (d.get("model") in names and d.get("op") in self._ops
-                and int(d.get("bucket", -1)) in self._buckets
+                # known (not just active) rungs: after a shrink-swap a
+                # spare may still be routed admitted old-ladder work
+                and int(d.get("bucket", -1)) in self._known_buckets
                 and (d.get("op") != "vote"
                      or self._registry.get(d["model"]).is_stack))})
         if not matched:
@@ -493,10 +557,20 @@ class ServingEngine:
         return op_width(entry, op)
 
     def _bucket_for(self, rows: int) -> int:
-        i = bisect.bisect_left(self._buckets, rows)
-        if i == len(self._buckets):
-            raise RequestTooLargeError(rows, self._buckets[-1])
-        return self._buckets[i]
+        buckets = self._buckets
+        i = bisect.bisect_left(buckets, rows)
+        if i < len(buckets):
+            return buckets[i]
+        # §24: a shrink-swap may land while work admitted against the
+        # OLD ladder is still queued — its old rungs stay warm in the
+        # shared program table, so cover from the known set rather than
+        # stranding admitted requests. Fresh oversize submissions are
+        # still rejected against the ACTIVE ladder (prepare_request).
+        known = self._known_buckets
+        j = bisect.bisect_left(known, rows)
+        if j < len(known):
+            return known[j]
+        raise RequestTooLargeError(rows, buckets[-1])
 
     def _entry_tree(self, model: str):
         """The served pytree of one entry: mesh-placed (once, through the

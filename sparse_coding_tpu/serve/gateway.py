@@ -34,12 +34,28 @@ every failure mode a handled, observable path:
   closed-loop controller widening/narrowing from the observed p99.
   Sheds reuse the typed ``QueueFullError`` (now with ``retry_after_s``)
   / ``CircuitOpenError`` contracts.
+- **traffic-shaped bucket ladders** (serve/ladder.py, §24) — the bucket
+  ladder is a derived, hot-swappable artifact: ``maybe_swap_ladder``
+  (riding the elastic plane's arbiter tick) derives a pad-minimizing
+  candidate from a self-digested traffic snapshot (fault site
+  ``gateway.ladder.derive``), holds it through the plane's
+  ``Hysteresis`` flap guard, warms its programs through xcache in a
+  spare, and flips atomically behind crash barrier
+  ``gateway.ladder.swap`` — zero backend compiles on the swap. The
+  dispatch path continuously REBATCHES: late-arriving queued requests
+  that fit the chosen bucket's remaining rows join the in-flight
+  assembly in strict FIFO order (``serve.rebatch.joined/rejected``).
+  Every admission check reads the ACTIVE ladder, so a post-swap
+  largest-bucket change can't strand admitted work (engines fall back
+  to known warm rungs) and oversize errors always cite the live max.
 
 Every routing/hedge/activation decision point is a named fault site
 (``gateway.route``, ``gateway.hedge``, ``gateway.spare.activate`` —
 docs/ARCHITECTURE.md §10/§14) with deterministic fault-matrix entries in
 tests/test_resilience.py; the kill-a-replica drill and the
-SIGKILL-mid-activation chaos case live in tests/test_serve_gateway.py.
+SIGKILL-mid-activation chaos case live in tests/test_serve_gateway.py;
+the SIGKILL-at-ladder-swap chaos case lives in
+tests/test_pipeline_chaos.py.
 """
 
 from __future__ import annotations
@@ -57,6 +73,8 @@ import numpy as np
 
 from sparse_coding_tpu import obs
 from sparse_coding_tpu.obs import monotime
+from sparse_coding_tpu.parallel import partition
+from sparse_coding_tpu.pipeline.plane import Hysteresis
 from sparse_coding_tpu.resilience.breaker import CircuitBreaker
 from sparse_coding_tpu.resilience.crash import (
     crash_barrier,
@@ -84,6 +102,13 @@ from sparse_coding_tpu.serve.engine import (
     prepare_request,
 )
 from sparse_coding_tpu.serve.health import EwmaHealth
+from sparse_coding_tpu.serve.ladder import (
+    derive_ladder,
+    ladder_pad_rows,
+    parse_snapshot,
+    pinned_ladder,
+    snapshot_bytes,
+)
 from sparse_coding_tpu.serve.metrics import ServingMetrics
 from sparse_coding_tpu.serve.registry import ModelRegistry
 from sparse_coding_tpu.serve.slo import (
@@ -107,6 +132,17 @@ register_fault_site("gateway.spare.activate",
 register_crash_site("gateway.spare.activate",  # lint: allow-unmatrixed-crash SIGKILL chaos case lives in tests/test_serve_gateway.py (real gateway at the barrier)
                     "warm spare fully loaded from the executable store, "
                     "not yet admitted to the routing set")
+register_fault_site("gateway.ladder.derive",
+                    "ladder derivation — the self-digested traffic "
+                    "snapshot bytes feeding derive_ladder (corruptible "
+                    "payload); an injected error/corruption is a counted "
+                    "skip (gateway.ladder.derive_errors) and the active "
+                    "ladder is retained")
+register_crash_site("gateway.ladder.swap",
+                    "candidate ladder's programs fully warm in the "
+                    "shared table and durable in the xcache store, the "
+                    "active ladder NOT yet replaced — a restart serves "
+                    "on the old ladder at zero compiles")
 
 ACTIVE = "active"
 DRAINING = "draining"
@@ -207,12 +243,21 @@ class ServingGateway:
                  admission_window: int = 512,
                  metrics_registry=None,
                  breaker_clock=None,
-                 engine_kwargs: Optional[dict] = None):
+                 engine_kwargs: Optional[dict] = None,
+                 rebatch: bool = True,
+                 ladder_max_rungs: int = 4,
+                 ladder_hold_ticks: int = 2,
+                 ladder_align: int = 8):
         if n_replicas < 1:
             raise ValueError("need at least one active replica")
         if n_spares < 0:
             raise ValueError("n_spares must be >= 0")
         self._registry = registry
+        # the ACTIVE bucket ladder: starts at the construction ladder,
+        # atomically replaced by swap_ladder (serve/ladder.py §24) — every
+        # admission-time check (prepare_request's oversize rejection, the
+        # hedge trigger's bucket lookup) reads THIS, never the
+        # construction constant
         self._buckets = tuple(int(b) for b in buckets)
         self._ops = tuple(ops)
         self._max_queue_rows = int(max_queue_rows)
@@ -280,6 +325,20 @@ class ServingGateway:
             max_wait_s=max_wait_ms / 1e3,
             max_queue_rows=self._max_queue_rows,
             metrics=self.metrics)
+        # traffic-shaped ladder state (§24): continuous rebatching on the
+        # dispatch path, plus the derive→hold→swap loop. The swap's flap
+        # guard is the plane's Hysteresis — a candidate must survive
+        # ``ladder_hold_ticks`` consecutive derivations before it swaps
+        # in; derivation alignment folds in the mesh's data-axis
+        # divisibility so a derived rung is always shardable.
+        self._rebatch = bool(rebatch)
+        self._ladder_max_rungs = max(1, int(ladder_max_rungs))
+        self._ladder_align = max(
+            int(ladder_align),
+            partition.batch_alignment(ekw.get("mesh")))
+        self._ladder_hyst = Hysteresis(ladder_hold_ticks)
+        self._candidate_rungs: Optional[tuple] = None
+        self._publish_ladder_gauges()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -561,10 +620,22 @@ class ServingGateway:
         # moment the flush leaves the queue (§12)
         t_flush = monotime()
         queue_hist = self._reg.histogram("serve.stage_s", stage="queue")
-        for r in requests:
-            r.queue_s = t_flush - r.t_submit
-            queue_hist.observe(r.queue_s)
         rows = sum(r.rows for r in requests)
+        # continuous rebatching (§24): membership is no longer frozen at
+        # pop time — queued requests that arrived before dispatch and fit
+        # the chosen bucket's remaining rows join the assembly in strict
+        # FIFO order, converting pad rows into served rows for free
+        if self._rebatch:
+            target = self._covering_bucket(rows)
+            if target is not None and target > rows:
+                joiners = self._batcher.take_joiners(key, target - rows)
+                if joiners:
+                    requests = requests + joiners
+                    rows += sum(r.rows for r in joiners)
+        for r in requests:
+            # clamp: a joiner can be submitted a hair after t_flush
+            r.queue_s = max(0.0, t_flush - r.t_submit)
+            queue_hist.observe(r.queue_s)
         if len(requests) == 1:
             x = requests[0].x
         else:
@@ -672,6 +743,147 @@ class ServingGateway:
     def _lat_hist(self):
         return self._reg.histogram("gateway.latency_s")
 
+    # -- traffic-shaped bucket ladder (serve/ladder.py, §24) -----------------
+
+    @property
+    def active_buckets(self) -> tuple:
+        """The ladder currently admitting and shaping traffic."""
+        return self._buckets
+
+    def _covering_bucket(self, rows: int) -> Optional[int]:
+        """Smallest ACTIVE rung covering ``rows`` (None when a
+        shrink-swap left admitted work above the active max — the engine
+        then covers from its known-rung fallback and rebatching simply
+        skips the flush)."""
+        buckets = self._buckets
+        i = bisect.bisect_left(buckets, rows)
+        return buckets[i] if i < len(buckets) else None
+
+    def _publish_ladder_gauges(self, old_n_rungs: int = 0) -> None:
+        """Active rungs as gauges (``gateway.ladder.rung{idx=..}``) —
+        the obs.report "ladder" section reads these; stale indices from
+        a longer previous ladder are zeroed so the report never shows a
+        ghost rung."""
+        buckets = self._buckets
+        for i, b in enumerate(buckets):
+            self._reg.gauge("gateway.ladder.rung", idx=i).set(b)
+        for i in range(len(buckets), max(old_n_rungs, len(buckets))):
+            self._reg.gauge("gateway.ladder.rung", idx=i).set(0)
+        self._reg.gauge("gateway.ladder.n_rungs").set(len(buckets))
+        self._reg.gauge("gateway.ladder.max_rung").set(buckets[-1])
+
+    def maybe_swap_ladder(self) -> Optional[dict]:
+        """One derive→hold→swap pass; rides the elastic plane's arbiter
+        tick (pipeline/plane.py) and is safe to call from any
+        maintenance loop. Never raises: a failed derivation (fault site
+        ``gateway.ladder.derive``, including corrupt snapshot bytes —
+        the self-digest catches any flip) or a failed swap is a counted
+        skip and the ACTIVE ladder is retained. The operator pin
+        (``SPARSE_CODING_LADDER_PIN``) overrides derivation AND the flap
+        guard. Returns the swap breadcrumb dict, or None when nothing
+        swapped."""
+        try:
+            pin = pinned_ladder()
+        except Exception:  # noqa: BLE001 — malformed pin: counted skip
+            self._reg.counter("gateway.ladder.derive_errors").inc()
+            return None
+        if pin is not None:
+            if pin == self._buckets:
+                return None
+            return self._guarded_swap(pin, source="pin")
+        try:
+            # derivation is seeded from a SNAPSHOT, never live mutable
+            # state: the bytes are the corruptible fault payload, and
+            # parse_snapshot's digest check turns any corruption into a
+            # typed, counted skip
+            raw = snapshot_bytes(self._reg)
+            raw = fault_point("gateway.ladder.derive", raw)
+            snap = parse_snapshot(raw)
+            cand = derive_ladder(snap, max_rungs=self._ladder_max_rungs,
+                                 align=self._ladder_align,
+                                 fallback=self._buckets)
+        except Exception:  # noqa: BLE001 — derive failure: counted skip
+            self._reg.counter("gateway.ladder.derive_errors").inc()
+            return None
+        rungs = tuple(int(b) for b in cand["rungs"])
+        if rungs == self._buckets:
+            self._ladder_hyst.vote(0)
+            self._candidate_rungs = None
+            return None
+        # only swap when the candidate actually saves pad on the
+        # snapshot's own traffic (the derived optimum always does unless
+        # rounding/fallback interfered — this guards the degenerate
+        # cases deterministically)
+        if (ladder_pad_rows(snap, rungs)
+                >= ladder_pad_rows(snap, self._buckets)):
+            self._ladder_hyst.vote(0)
+            self._candidate_rungs = None
+            return None
+        if rungs != self._candidate_rungs:
+            # a NEW candidate restarts the hold window: hysteresis
+            # confirms persistence of one specific ladder, not churn
+            self._ladder_hyst.vote(0)
+            self._candidate_rungs = rungs
+        if not self._ladder_hyst.vote(1):
+            self._reg.counter("gateway.ladder.held").inc()
+            return None
+        self._candidate_rungs = None
+        return self._guarded_swap(
+            rungs, source="derived",
+            expected_pad_rows=cand.get("expected_pad_rows"))
+
+    def _guarded_swap(self, rungs: tuple, source: str,
+                      **detail) -> Optional[dict]:
+        try:
+            return self.swap_ladder(rungs, source=source, **detail)
+        except Exception:  # noqa: BLE001 — swap failure: counted skip,
+            # active ladder retained; warm progress (if any) is durable
+            # in the xcache store so the retry is cheaper
+            self._reg.counter("gateway.ladder.swap_errors").inc()
+            return None
+
+    def swap_ladder(self, rungs, source: str = "manual",
+                    **detail) -> dict:
+        """Zero-compile atomic ladder swap. Order is the whole contract:
+        (1) warm every (model, op, new-rung) program through
+        ``xcache.cached_compile`` in a warm spare (or the healthiest
+        active when the pool has no spare) — the pool's SHARED program
+        table plus the durable executable store make the flip free for
+        every replica; (2) crash barrier ``gateway.ladder.swap`` at the
+        worst instant (candidate fully warm + durable, active ladder
+        untouched — a SIGKILL here restarts onto the OLD ladder at zero
+        compiles, bitwise); (3) under the pool lock, atomically replace
+        the active ladder on the gateway, every replica engine, and the
+        batcher's capacity threshold."""
+        rungs = tuple(int(b) for b in rungs)
+        if not rungs or list(rungs) != sorted(set(rungs)):
+            raise ValueError(f"rungs must be unique ascending: {rungs}")
+        with self._pool_lock:
+            warmer = next(iter(self._spare_replicas()), None)
+            if warmer is None:
+                warmer = self._routing_order()[0]
+        with obs.span("gateway.ladder.swap", source=source,
+                      rungs=",".join(str(b) for b in rungs)):
+            programs = warmer.engine.warm_buckets(rungs)
+            # THE swap instant: every candidate program is in the shared
+            # table and durable in the xcache store; nothing has been
+            # replaced. SIGKILL here must cost nothing (chaos matrix:
+            # restart serves the old ladder, 0 compiles, bitwise).
+            crash_barrier("gateway.ladder.swap")
+            with self._pool_lock:
+                old = self._buckets
+                self._buckets = rungs
+                for name in self._order:
+                    self._replicas[name].engine.set_buckets(rungs)
+                self._batcher.set_max_rows(rungs[-1])
+                self._publish_ladder_gauges(old_n_rungs=len(old))
+        self._reg.counter("gateway.ladder.swaps").inc()
+        obs.emit_event("gateway.ladder.swap", rungs=list(rungs),
+                       old=list(old), source=source,
+                       programs_warmed=programs, **detail)
+        return {"rungs": rungs, "old": old, "source": source,
+                "programs_warmed": programs, **detail}
+
     # -- self-healing --------------------------------------------------------
 
     def maintain(self) -> list[str]:
@@ -765,7 +977,8 @@ class ServingGateway:
             queued_rows=self._batcher.queued_rows,
             service_rate_rows_s=self._batcher.service_rate_rows_s,
             predicted_wait_s=self._batcher.predicted_wait_s(),
-            admission_level=self._admission.level)
+            admission_level=self._admission.level,
+            active_max_rows=self._buckets[-1])
 
     def reinstate(self, name: str) -> None:
         """Ops hook: return a drained (repaired) replica to the pool as
@@ -817,5 +1030,12 @@ class ServingGateway:
             # the controller is the source of truth (the gauge only
             # refreshes per flush and would lag a set_level override)
             "admission_level": self._admission.level,
+            "ladder": {
+                "rungs": list(self._buckets),
+                "swaps": c("gateway.ladder.swaps").value,
+                "held": c("gateway.ladder.held").value,
+                "derive_errors": c("gateway.ladder.derive_errors").value,
+                "swap_errors": c("gateway.ladder.swap_errors").value,
+            },
         }
         return snap

@@ -21,7 +21,11 @@ Instrument names (labels carry the bucket): ``serve.requests``,
 ``serve.request_errors{type=..}``, ``serve.breaker_transitions``,
 ``serve.queue_rows`` (gauge; its high-water mark is the max),
 ``serve.batches{bucket=..}`` / ``serve.batch_requests`` / ``serve.rows``
-/ ``serve.deadline_flushes``, ``serve.latency_s{bucket=..}`` (histogram).
+/ ``serve.deadline_flushes``, ``serve.latency_s{bucket=..}`` (histogram),
+``serve.request_rows`` (row-valued histogram — the rolling request-size
+distribution ladder derivation snapshots, serve/ladder.py §24), and the
+continuous-rebatching counters ``serve.rebatch.joined`` /
+``serve.rebatch.joined_rows`` / ``serve.rebatch.rejected``.
 """
 
 from __future__ import annotations
@@ -32,6 +36,7 @@ from collections import deque
 from typing import Optional
 
 from sparse_coding_tpu.obs.registry import Registry
+from sparse_coding_tpu.serve.ladder import REQUEST_ROW_BOUNDS
 
 
 def _quantile_ms(samples: list[float], q: float) -> float | None:
@@ -75,11 +80,19 @@ class ServingMetrics:
         self._recompiles = r.counter("serve.recompiles")
         self._n_transitions = r.counter("serve.breaker_transitions")
         self._queue_gauge = r.gauge("serve.queue_rows")
+        # the rolling request-size distribution (row-valued bounds, not
+        # the latency default): ladder derivation's primary input
+        self._request_rows = r.histogram("serve.request_rows",
+                                         bounds=REQUEST_ROW_BOUNDS)
+        self._rebatch_joined = r.counter("serve.rebatch.joined")
+        self._rebatch_joined_rows = r.counter("serve.rebatch.joined_rows")
+        self._rebatch_rejected = r.counter("serve.rebatch.rejected")
 
     # -- write side (engine / batcher) --------------------------------------
 
     def record_enqueue(self, rows: int) -> None:
         self._submitted.inc()
+        self._request_rows.observe(rows)
         with self._lock:
             self._queued_rows += rows
             self._queue_gauge.set(self._queued_rows)
@@ -102,6 +115,18 @@ class ServingMetrics:
         r.counter("serve.rows", bucket=bucket).inc(rows)
         if deadline_flush:
             r.counter("serve.deadline_flushes", bucket=bucket).inc()
+
+    def record_rebatch(self, joined: int, joined_rows: int,
+                       rejected: int = 0) -> None:
+        """One flush's continuous-rebatching outcome: ``joined``
+        late-arriving requests (``joined_rows`` rows of pad they filled)
+        merged into the in-flight assembly; ``rejected`` counts a stream
+        head that was present but did not fit the remaining rows."""
+        if joined:
+            self._rebatch_joined.inc(joined)
+            self._rebatch_joined_rows.inc(joined_rows)
+        if rejected:
+            self._rebatch_rejected.inc(rejected)
 
     def record_latency(self, bucket: int, seconds: float) -> None:
         with self._lock:
@@ -198,6 +223,10 @@ class ServingMetrics:
             "request_errors": {
                 t: r.counter("serve.request_errors", type=t).value
                 for t in sorted(error_types)},
+            "rebatch": {
+                "joined": self._rebatch_joined.value,
+                "joined_rows": self._rebatch_joined_rows.value,
+                "rejected": self._rebatch_rejected.value},
             "dispatch_retries": self._retries.value,
             "dispatch_failures": self._failures.value,
             "shed_requests": self._shed.value,

@@ -97,6 +97,11 @@ class LoadSignals:
     predicted_wait_s: float | None          # drain estimate for new work
     admission_level: int                    # brownout rung (0 = open)
     ticks: int = 0                          # observations folded so far
+    # largest rung of the ACTIVE bucket ladder (0 = unreported): ladder
+    # swaps (serve/ladder.py §24) surface through the same audited
+    # struct the arbiter already reads, so plane breadcrumbs and tests
+    # see capacity-shape changes without reaching into the gateway
+    active_max_rows: int = 0
 
 
 class LoadTracker:
@@ -119,7 +124,8 @@ class LoadTracker:
     def observe(self, queued_rows: int,
                 service_rate_rows_s: float | None = None,
                 predicted_wait_s: float | None = None,
-                admission_level: int = 0) -> LoadSignals:
+                admission_level: int = 0,
+                active_max_rows: int = 0) -> LoadSignals:
         """Fold one observation; returns the updated snapshot."""
         rows = max(0, int(queued_rows))
         with self._lock:
@@ -134,7 +140,8 @@ class LoadTracker:
                 service_rate_rows_s=service_rate_rows_s,
                 predicted_wait_s=predicted_wait_s,
                 admission_level=int(admission_level),
-                ticks=self._ticks)
+                ticks=self._ticks,
+                active_max_rows=int(active_max_rows))
             return self._last
 
     def snapshot(self) -> LoadSignals:

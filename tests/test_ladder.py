@@ -1,0 +1,422 @@
+"""Traffic-shaped bucket ladder tests (serve/ladder.py + the gateway
+swap path, docs/ARCHITECTURE.md §24).
+
+Covers the ISSUE 20 invariants: byte-deterministic derivation (same
+snapshot ⇒ identical ladder JSON, build-twice bitwise), the DP beating
+the static ladder on a skewed request mix, self-digested snapshot
+corruption detected (never derived from), continuous rebatching —
+strict-FIFO joiner admission and bit-equality with rebatching on vs off
+— and the mid-stream swap regression: oversize errors cite the ACTIVE
+(possibly swapped) ladder, a grow-swap admits previously-oversized work
+at zero steady compiles, and a shrink-swap never strands admitted work
+(known-rung fallback). The SIGKILL chaos case at ``gateway.ladder.swap``
+lives in tests/test_pipeline_chaos.py; the fault-matrix rows for
+``gateway.ladder.derive`` live in tests/test_resilience.py.
+
+Integer-valued weights/inputs make every dot product exact in f32, so
+results are comparable to the BIT across padding, rebatching, and
+ladder swaps (row-wise encode: batching can never change a row's math).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding_tpu.models import UntiedSAE
+from sparse_coding_tpu.obs.registry import Registry
+from sparse_coding_tpu.serve import (
+    ModelRegistry,
+    RequestTooLargeError,
+    ServingGateway,
+)
+from sparse_coding_tpu.serve.ladder import (
+    PIN_ENV,
+    REQUEST_ROW_BOUNDS,
+    STATIC_LADDER,
+    LadderError,
+    SnapshotIntegrityError,
+    derive_ladder,
+    ladder_pad_rows,
+    ladder_to_json,
+    parse_snapshot,
+    pinned_ladder,
+    snapshot_bytes,
+    traffic_snapshot,
+)
+
+D, N = 16, 32
+
+
+def _int_dict(seed: int = 0) -> UntiedSAE:
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return UntiedSAE(
+        encoder=jax.random.randint(k1, (N, D), -4, 5).astype(jnp.float32),
+        encoder_bias=jax.random.randint(k2, (N,), -4, 5).astype(
+            jnp.float32),
+        dictionary=jax.random.randint(k3, (N, D), -4, 5).astype(
+            jnp.float32))
+
+
+@pytest.fixture
+def int_registry():
+    reg = ModelRegistry()
+    reg.register("int", _int_dict())
+    return reg
+
+
+def _traffic_registry(sizes) -> Registry:
+    """A metrics registry carrying the request-size histogram the
+    derivation snapshots (what record_enqueue feeds in production)."""
+    reg = Registry()
+    hist = reg.histogram("serve.request_rows", bounds=REQUEST_ROW_BOUNDS)
+    for s in sizes:
+        hist.observe(int(s))
+    return reg
+
+
+SKEWED = [21] * 300 + [23] * 150 + [24] * 50 + [250] * 60 + [280] * 40
+
+
+# -- derivation: determinism + quality ----------------------------------------
+
+
+def test_snapshot_and_derivation_byte_deterministic():
+    """Same registry state ⇒ identical snapshot bytes; same snapshot ⇒
+    identical ladder JSON, build-twice bitwise — the §24 determinism
+    doctrine, asserted at the byte level."""
+    reg = _traffic_registry(SKEWED)
+    raw1, raw2 = snapshot_bytes(reg), snapshot_bytes(reg)
+    assert raw1 == raw2
+    snap = parse_snapshot(raw1)
+    assert snap == traffic_snapshot(reg)
+    l1 = derive_ladder(snap, max_rungs=4, align=8)
+    l2 = derive_ladder(parse_snapshot(raw2), max_rungs=4, align=8)
+    assert ladder_to_json(l1) == ladder_to_json(l2)
+    assert l1["reason"] == "derived"
+
+
+def test_derived_ladder_beats_static_on_skewed_mix():
+    """The acceptance shape: a mix clustering just above the static
+    ladder's smallest rung must derive a ladder with strictly less
+    expected pad than (8, 64, 512), within the rung budget, aligned,
+    ascending, and covering the observed max."""
+    snap = traffic_snapshot(_traffic_registry(SKEWED))
+    lad = derive_ladder(snap, max_rungs=4, align=8)
+    rungs = lad["rungs"]
+    assert 1 <= len(rungs) <= 4
+    assert rungs == sorted(set(rungs))
+    assert all(r % 8 == 0 for r in rungs)
+    derived_pad = ladder_pad_rows(snap, rungs)
+    static_pad = ladder_pad_rows(snap, STATIC_LADDER)
+    assert derived_pad < static_pad
+    assert lad["expected_pad_rows"] == derived_pad
+    assert lad["request_count"] == len(SKEWED)
+    # the top rung covers every histogram bin the traffic landed in
+    covers = [b for b in REQUEST_ROW_BOUNDS if b >= max(SKEWED)]
+    assert rungs[-1] >= min(covers)
+
+
+def test_derive_no_traffic_falls_back():
+    """A cold gateway (empty registry) derives the fallback verbatim —
+    it must never swap off a traffic-free snapshot."""
+    snap = traffic_snapshot(Registry())
+    lad = derive_ladder(snap, fallback=(8, 64, 512))
+    assert lad["rungs"] == [8, 64, 512]
+    assert lad["reason"] == "no-traffic"
+    assert lad["request_count"] == 0
+
+
+def test_derive_respects_rung_budget_and_alignment():
+    """max_rungs caps the ladder; the alignment constraint (mesh
+    data-axis divisibility rides on it) rounds every rung up."""
+    snap = traffic_snapshot(_traffic_registry([3, 9, 17, 33, 100]))
+    for k in (1, 2, 3):
+        lad = derive_ladder(snap, max_rungs=k, align=4)
+        assert len(lad["rungs"]) <= k
+        assert all(r % 4 == 0 for r in lad["rungs"])
+    with pytest.raises(LadderError):
+        derive_ladder(snap, max_rungs=0)
+
+
+def test_snapshot_corruption_detected():
+    """Any flip of the self-digested snapshot bytes is a typed
+    integrity failure — derivation is skipped, never guessed."""
+    raw = bytearray(snapshot_bytes(_traffic_registry(SKEWED)))
+    raw[len(raw) // 2] ^= 0x40
+    with pytest.raises(SnapshotIntegrityError):
+        parse_snapshot(bytes(raw))
+    with pytest.raises(SnapshotIntegrityError):
+        parse_snapshot(b"not json at all")
+
+
+def test_pinned_ladder_parsing():
+    """The operator pin: unset ⇒ None; a valid list parses; malformed
+    or non-ascending pins fail loudly (never silently ignored)."""
+    assert pinned_ladder(env={}) is None
+    assert pinned_ladder(env={PIN_ENV: ""}) is None
+    assert pinned_ladder(env={PIN_ENV: "8,24,96"}) == (8, 24, 96)
+    with pytest.raises(LadderError):
+        pinned_ladder(env={PIN_ENV: "banana"})
+    with pytest.raises(LadderError):
+        pinned_ladder(env={PIN_ENV: "96,8"})
+    with pytest.raises(LadderError):
+        pinned_ladder(env={PIN_ENV: "8,8"})
+
+
+# -- continuous rebatching ----------------------------------------------------
+
+
+def test_take_joiners_strict_fifo_and_counters():
+    """The joiner pop is strictly FIFO and never skips the stream head
+    (skipping would reorder results against submission order); a
+    present head that does not fit is counted rejected."""
+    from sparse_coding_tpu.obs import monotime
+    from sparse_coding_tpu.serve.batching import MicroBatcher, Request
+    from sparse_coding_tpu.serve.metrics import ServingMetrics
+
+    metrics = ServingMetrics()
+    batcher = MicroBatcher(dispatch=lambda *a: None,
+                           max_rows_per_batch=64, max_wait_s=100.0,
+                           max_queue_rows=1000, metrics=metrics)
+    try:
+        batcher.pause()
+        key = ("m", "encode")
+        for rows in (4, 3, 5):
+            batcher.submit(Request(key=key,
+                                   x=np.zeros((rows, 4), np.float32),
+                                   rows=rows, squeeze=False,
+                                   t_submit=monotime()))
+        # 8 remaining rows: head 4 fits, then 3 fits (7), head 5 does
+        # not fit the last row — FIFO stops there, counted rejected
+        joined = batcher.take_joiners(key, 8)
+        assert [r.rows for r in joined] == [4, 3]
+        assert batcher.queued_rows == 5
+        snap = metrics.snapshot()["rebatch"]
+        assert snap == {"joined": 2, "joined_rows": 7, "rejected": 1}
+        # zero remaining rows: nothing joins, nothing counted rejected
+        assert batcher.take_joiners(key, 0) == []
+        assert metrics.snapshot()["rebatch"]["rejected"] == 1
+    finally:
+        batcher.shutdown(wait=False)
+
+
+def test_gateway_rebatch_joins_queued_requests_bitwise(int_registry):
+    """The dispatch-path join: with the worker paused and three 4-row
+    requests queued, a 4-row lead flush on a 16-rung ladder pulls all
+    three into the in-flight assembly (16/16 rows, zero pad) and every
+    result — joiners included — is bit-identical to the direct
+    per-request encode."""
+    from sparse_coding_tpu.obs import monotime
+    from sparse_coding_tpu.serve.batching import Request
+
+    nrng = np.random.default_rng(3)
+    payloads = [np.asarray(nrng.integers(-4, 5, (4, D)), np.float32)
+                for _ in range(4)]
+    enc = jax.jit(lambda ld, x: ld.encode(x))
+    expected = [np.asarray(enc(_int_dict(), jnp.asarray(p)))
+                for p in payloads]
+    with ServingGateway(int_registry, n_replicas=1, n_spares=0,
+                        buckets=(16,), ops=("encode",),
+                        max_wait_ms=1000.0, rebatch=True) as gw:
+        gw.warmup()
+        gw.pause()
+        futs = [gw.submit("int", p) for p in payloads[1:]]
+        lead = Request(key=("int", "encode"), x=payloads[0], rows=4,
+                       squeeze=False, t_submit=monotime())
+        served = gw._dispatch(("int", "encode"), [lead], False)
+        assert served == 16  # lead + all three joiners, zero pad
+        results = [lead.future.result(timeout=30)] + [
+            f.result(timeout=30) for f in futs]
+        snap = gw.stats()
+        gw.resume()
+    assert snap["rebatch"] == {"joined": 3, "joined_rows": 12,
+                               "rejected": 0}
+    for got, want in zip(results, expected):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_rebatch_on_off_bit_equality(int_registry):
+    """Determinism gate: the same request stream served with rebatching
+    on and off produces bit-identical per-request results — joining
+    only changes WHEN a row is served, never its math (row-wise encode
+    is padding- and batching-invariant)."""
+    nrng = np.random.default_rng(11)
+    payloads = [np.asarray(nrng.integers(-4, 5, (int(r), D)), np.float32)
+                for r in nrng.integers(1, 9, 24)]
+    enc = jax.jit(lambda ld, x: ld.encode(x))
+    expected = [np.asarray(enc(_int_dict(), jnp.asarray(p)))
+                for p in payloads]
+    for rebatch in (True, False):
+        reg = ModelRegistry()
+        reg.register("int", _int_dict())
+        with ServingGateway(reg, n_replicas=2, n_spares=0, buckets=(8,),
+                            ops=("encode",), max_wait_ms=0.5,
+                            rebatch=rebatch) as gw:
+            gw.warmup()
+            futs = [gw.submit("int", p) for p in payloads]
+            results = [f.result(timeout=60) for f in futs]
+            assert gw.stats()["recompiles"] == 0
+        for got, want in zip(results, expected):
+            np.testing.assert_array_equal(got, want)
+
+
+# -- the mid-stream swap regression (satellite: active-ladder errors) ---------
+
+
+def test_mid_stream_swap_errors_and_admission_track_active_ladder(
+        int_registry):
+    """Regression: the oversize check re-evaluates against the ACTIVE
+    ladder across swaps. Before a grow-swap a 12-row request is refused
+    citing max 8; after swapping to (8, 16) the same request serves
+    bitwise at zero steady compiles; a fresh 20-row request is refused
+    citing the NEW max 16; and a shrink-swap back to (8,) never strands
+    the 12-row request admitted before it (known-rung fallback)."""
+    from sparse_coding_tpu import obs
+
+    nrng = np.random.default_rng(4)
+    p12 = np.asarray(nrng.integers(-4, 5, (12, D)), np.float32)
+    p12b = np.asarray(nrng.integers(-4, 5, (12, D)), np.float32)
+    enc = jax.jit(lambda ld, x: ld.encode(x))
+    with ServingGateway(int_registry, n_replicas=1, n_spares=1,
+                        buckets=(8,), ops=("encode",),
+                        max_wait_ms=0.5) as gw:
+        gw.warmup()
+        with pytest.raises(RequestTooLargeError) as exc:
+            gw.submit("int", p12)
+        assert exc.value.max_rows == 8
+        assert "(8)" in str(exc.value)
+
+        swap = gw.swap_ladder((8, 16))
+        assert swap["rungs"] == (8, 16)
+        assert gw.active_buckets == (8, 16)
+        c0 = obs.counter("jax.compiles").value
+        out = gw.query("int", p12, timeout=60)
+        np.testing.assert_array_equal(
+            out, np.asarray(enc(_int_dict(), jnp.asarray(p12))))
+        # the swap pre-warmed rung 16: serving on it compiles nothing
+        assert obs.counter("jax.compiles").value == c0
+        with pytest.raises(RequestTooLargeError) as exc2:
+            gw.submit("int", np.zeros((20, D), np.float32))
+        assert exc2.value.max_rows == 16  # the ACTIVE (swapped) max
+        assert "(16)" in str(exc2.value)
+
+        # shrink-swap with admitted work above the new max in flight:
+        # the engine covers from its known (previously-warmed) rungs
+        gw.pause()
+        fut = gw.submit("int", p12b)  # admitted against (8, 16)
+        gw.swap_ladder((8,))
+        assert gw.active_buckets == (8,)
+        gw.resume()
+        np.testing.assert_array_equal(
+            fut.result(timeout=60),
+            np.asarray(enc(_int_dict(), jnp.asarray(p12b))))
+        # fresh oversize is rejected against the shrunk active ladder
+        with pytest.raises(RequestTooLargeError) as exc3:
+            gw.submit("int", p12)
+        assert exc3.value.max_rows == 8
+        snap = gw.stats()
+    assert snap["recompiles"] == 0
+    assert snap["gateway"]["ladder"]["swaps"] == 2
+    assert snap["gateway"]["ladder"]["rungs"] == [8]
+    assert snap["request_errors"] == {}
+
+
+# -- the derive → hold → swap loop --------------------------------------------
+
+
+def test_maybe_swap_ladder_hysteresis_then_zero_compile_swap(
+        int_registry):
+    """The full loop against real traffic: a candidate must survive
+    ``ladder_hold_ticks`` consecutive derivations (held passes are
+    counted) before the swap lands; post-swap serving pays ZERO compiles
+    and stays bitwise; and the load-signals struct surfaces the new
+    active max to the elastic plane."""
+    from sparse_coding_tpu import obs
+
+    nrng = np.random.default_rng(9)
+    payloads = [np.asarray(nrng.integers(-4, 5, (int(r), D)), np.float32)
+                for r in nrng.integers(20, 25, 12)]
+    enc = jax.jit(lambda ld, x: ld.encode(x))
+    with ServingGateway(int_registry, n_replicas=1, n_spares=1,
+                        buckets=(64,), ops=("encode",), max_wait_ms=0.5,
+                        ladder_hold_ticks=2) as gw:
+        gw.warmup()
+        for p in payloads[:6]:
+            gw.query("int", p, timeout=60)
+        assert gw.maybe_swap_ladder() is None  # tick 1: held
+        assert gw.stats()["gateway"]["ladder"]["held"] == 1
+        assert gw.active_buckets == (64,)
+        swap = gw.maybe_swap_ladder()  # tick 2: confirmed
+        assert swap is not None and swap["source"] == "derived"
+        assert gw.active_buckets == tuple(swap["rungs"])
+        assert gw.active_buckets[-1] < 64  # traffic-shaped: tighter
+        assert gw.load_signals().active_max_rows \
+            == gw.active_buckets[-1]
+        c0 = obs.counter("jax.compiles").value
+        results = [gw.query("int", p, timeout=60) for p in payloads[6:]]
+        assert obs.counter("jax.compiles").value == c0  # zero-compile
+        snap = gw.stats()
+    assert snap["gateway"]["ladder"]["swaps"] == 1
+    assert snap["recompiles"] == 0
+    for got, p in zip(results, payloads[6:]):
+        np.testing.assert_array_equal(
+            got, np.asarray(enc(_int_dict(), jnp.asarray(p))))
+
+
+def test_maybe_swap_ladder_pin_overrides_and_flap_guard(int_registry,
+                                                        monkeypatch):
+    """The operator pin bypasses derivation AND the hold window; a pin
+    equal to the active ladder is a no-op; a malformed pin is a counted
+    skip that retains the active ladder (never a crash)."""
+    with ServingGateway(int_registry, n_replicas=1, n_spares=1,
+                        buckets=(8,), ops=("encode",), max_wait_ms=0.5,
+                        ladder_hold_ticks=99) as gw:
+        gw.warmup()
+        monkeypatch.setenv(PIN_ENV, "8,32")
+        swap = gw.maybe_swap_ladder()
+        assert swap is not None and swap["source"] == "pin"
+        assert gw.active_buckets == (8, 32)
+        assert gw.maybe_swap_ladder() is None  # pin == active: no-op
+        assert gw.stats()["gateway"]["ladder"]["swaps"] == 1
+        monkeypatch.setenv(PIN_ENV, "not,a,ladder")
+        assert gw.maybe_swap_ladder() is None
+        snap = gw.stats()
+        assert snap["gateway"]["ladder"]["derive_errors"] == 1
+        assert gw.active_buckets == (8, 32)  # retained
+
+
+def test_plane_tick_rides_the_ladder_swap(tmp_path):
+    """The swap rides the arbiter tick (§24): a gateway double whose
+    ``maybe_swap_ladder`` reports a swap surfaces as the tick
+    breadcrumb's ``ladder_swapped`` — and doubles WITHOUT the method
+    (jax-free fleet-only arbiters) are untouched."""
+    from sparse_coding_tpu.pipeline.plane import ElasticPlane, PlaneConfig
+    from sparse_coding_tpu.serve.slo import LoadSignals
+
+    signals = LoadSignals(queued_rows=0, queue_depth_ewma=0.0,
+                          service_rate_rows_s=None, predicted_wait_s=None,
+                          admission_level=0, ticks=1)
+
+    class _GatewayDouble:
+        def __init__(self):
+            self.swaps = [None, {"rungs": (8, 24)}, None]
+
+        def load_signals(self):
+            return signals
+
+        def active_replica_names(self):
+            return ["replica-0"]
+
+        def maybe_swap_ladder(self):
+            return self.swaps.pop(0)
+
+    gw = _GatewayDouble()
+    plane = ElasticPlane(tmp_path, PlaneConfig(n_slices=4), gateway=gw)
+    assert plane.tick()["ladder_swapped"] is False
+    assert plane.tick()["ladder_swapped"] is True
+    assert plane.tick()["ladder_swapped"] is False
+    # a bare double without the hook: the tick must not care
+    plane2 = ElasticPlane(tmp_path, PlaneConfig(n_slices=4),
+                          signals_fn=lambda: signals)
+    assert plane2.tick()["ladder_swapped"] is False

@@ -2,6 +2,7 @@
 (SURVEY.md §4/§7: verify activation equivalence against reference hooks
 without network access — transformers builds models from config offline)."""
 
+import os
 import sys
 
 import jax
@@ -192,11 +193,20 @@ def test_real_pythia70m_logits_parity(monkeypatch):
     torch = pytest.importorskip("torch")
 
     monkeypatch.setenv("HF_HUB_OFFLINE", "1")  # zero-egress image
+    name = "EleutherAI/pythia-70m-deduped"
+    # fast on-disk gate first (scripts/hf_cache_probe.sh's layout):
+    # huggingface_hub reads HF_HUB_OFFLINE at import time, so if another
+    # test imported it first the monkeypatch is moot and from_pretrained
+    # stalls ~60s on connect timeouts in the zero-egress image before
+    # raising — probe the cache dir instead of paying that.
+    cache = Path(os.environ.get(
+        "HF_HOME", Path.home() / ".cache" / "huggingface"))
+    if not (cache / "hub" / ("models--" + name.replace("/", "--"))).is_dir():
+        pytest.skip(f"{name} not in local HF cache (no snapshot dir)")
     from transformers import AutoModelForCausalLM
 
     from sparse_coding_tpu.lm.convert import load_model
 
-    name = "EleutherAI/pythia-70m-deduped"
     try:
         hf_model = AutoModelForCausalLM.from_pretrained(name).eval()
     except Exception as e:

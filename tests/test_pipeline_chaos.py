@@ -904,6 +904,130 @@ def test_plane_rebalance_kill_arbiter_restart_reconciles(tmp_path):
         .runs["scav"].state == "preempting"
 
 
+# -- gateway ladder-swap chaos (ISSUE 20, §24) --------------------------------
+
+# Integer-valued weights/inputs are exact in f32, and encode is row-wise,
+# so the served result is bitwise independent of which bucket ladder the
+# gateway routes through — the invariant every phase below asserts.
+_LADDER_SWAP_DRIVER = r"""
+import sys
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from sparse_coding_tpu import obs, xcache
+from sparse_coding_tpu.models import UntiedSAE
+from sparse_coding_tpu.serve import ModelRegistry, ServingGateway
+
+cache_dir, out_path, phase = sys.argv[1], sys.argv[2], sys.argv[3]
+xcache.enable(cache_dir)
+k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+ld = UntiedSAE(
+    encoder=jax.random.randint(k1, (32, 16), -4, 5).astype(jnp.float32),
+    encoder_bias=jax.random.randint(k2, (32,), -4, 5).astype(jnp.float32),
+    dictionary=jax.random.randint(k3, (32, 16), -4, 5).astype(jnp.float32))
+reg = ModelRegistry()
+reg.register("int", ld)
+c0 = obs.counter("jax.compiles").value  # serve-section delta from here
+x = np.asarray(np.arange(7 * 16).reshape(7, 16) % 9 - 4, np.float32)
+outs = []
+with ServingGateway(reg, n_replicas=1, n_spares=1, buckets=(8,),
+                    ops=("encode",), max_wait_ms=0.0) as gw:
+    gw.warmup()
+    if phase == "swap":
+        # crash barrier gateway.ladder.swap fires AFTER warm_buckets
+        # compiled+stored the candidate rungs, BEFORE the routing flip
+        gw.swap_ladder((8, 24))
+        outs.append(np.asarray(gw.query("int", x, timeout=60)))
+    else:  # restart: serve on whatever ladder came up, THEN re-swap
+        print("RESTART_RUNGS", ",".join(str(b) for b in gw.active_buckets))
+        outs.append(np.asarray(gw.query("int", x, timeout=60)))
+        gw.swap_ladder((8, 24))
+        outs.append(np.asarray(gw.query("int", x, timeout=60)))
+    rungs = ",".join(str(b) for b in gw.active_buckets)
+with open(out_path, "wb") as f:  # process-private scratch result
+    np.save(f, np.stack(outs))
+print("RUNGS", rungs)
+print("SERVE_COMPILES", int(obs.counter("jax.compiles").value - c0))
+print("STORE", int(obs.counter("xcache.hits").value),
+      int(obs.counter("xcache.misses").value),
+      int(obs.counter("xcache.errors").value))
+"""
+
+
+def _ladder_stdout(p, key):
+    for line in p.stdout.splitlines():
+        if line.startswith(key + " "):
+            return line[len(key) + 1:]
+    raise AssertionError(f"no {key!r} line in {p.stdout!r}")
+
+
+def test_ladder_swap_sigkill_restart_old_ladder_zero_compiles(tmp_path):
+    """Chaos case for the ``gateway.ladder.swap`` crash barrier: SIGKILL
+    a real gateway exactly between warming the candidate ladder and the
+    routing flip. The restart must come up serving the OLD ladder (the
+    flip never became visible), complete the identical request at ZERO
+    backend compiles (warmup loads from the store the dead run
+    populated), and a re-attempted swap must also cost zero compiles —
+    the candidate's executables were made durable before the barrier."""
+    import subprocess
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparse_coding_tpu.models import UntiedSAE
+    from tests.conftest import stripped_cpu_subprocess_env
+
+    driver = tmp_path / "driver.py"
+    driver.write_text(_LADDER_SWAP_DRIVER)
+    env = stripped_cpu_subprocess_env()
+
+    def drive(cache, out, phase, extra_env=None):
+        return subprocess.run(
+            [sys.executable, str(driver), str(cache), str(out), phase],
+            env={**env, **(extra_env or {})},
+            capture_output=True, text=True, timeout=300)
+
+    # run 1: SIGKILL exactly at the barrier — the candidate is warmed
+    # and stored, but the routing flip was never made
+    cache_dir, out_path = tmp_path / "xc", tmp_path / "out.npy"
+    p1 = drive(cache_dir, out_path, "swap",
+               {crash_mod.ENV_VAR: "gateway.ladder.swap:nth=1"})
+    assert p1.returncode == -9, (p1.returncode, p1.stderr[-2000:])
+    assert "crash_barrier: SIGKILL at site 'gateway.ladder.swap'" \
+        in p1.stderr
+    assert not out_path.exists()  # it died before serving
+
+    # run 2: restart — comes up on the OLD ladder (the flip never became
+    # visible), serves, then re-attempts the swap. EVERYTHING loads from
+    # the store the dead run populated: zero backend compiles across
+    # warmup, old-ladder serving, the re-swap, and new-ladder serving.
+    p2 = drive(cache_dir, out_path, "serve")
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    assert _ladder_stdout(p2, "RESTART_RUNGS") == "8"  # old ladder
+    assert _ladder_stdout(p2, "RUNGS") == "8,24"  # re-swap completed
+    assert _ladder_stdout(p2, "SERVE_COMPILES") == "0", p2.stdout
+    assert int(_ladder_stdout(p2, "STORE").split()[0]) >= 1  # store hits
+    got = np.load(out_path)  # [old-ladder result, new-ladder result]
+
+    # bitwise-identical to the direct in-process computation, on BOTH
+    # ladders (row-wise encode: the ladder can never change a row)
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    ld = UntiedSAE(
+        encoder=jax.random.randint(k1, (32, 16), -4, 5).astype(
+            jnp.float32),
+        encoder_bias=jax.random.randint(k2, (32,), -4, 5).astype(
+            jnp.float32),
+        dictionary=jax.random.randint(k3, (32, 16), -4, 5).astype(
+            jnp.float32))
+    x = np.asarray(np.arange(7 * 16).reshape(7, 16) % 9 - 4, np.float32)
+    want = np.asarray(ld.encode(jnp.asarray(x)))
+    np.testing.assert_array_equal(got[0], want)
+    np.testing.assert_array_equal(got[1], want)
+
+
 # -- fsck rot-fuzzing drill (ISSUE 18) ----------------------------------------
 
 

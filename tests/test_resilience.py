@@ -872,6 +872,55 @@ def test_gateway_spare_activate_fault_bounded_and_retried(rng):
         assert snap["replicas"]["replica-0"]["state"] == "draining"
 
 
+def test_gateway_ladder_derive_fault_is_counted_skip(rng):
+    """``gateway.ladder.derive`` matrix entry (error mode): an injected
+    derivation failure is a counted skip — the ACTIVE ladder is
+    retained, serving is untouched, and the NEXT pass (plan exhausted)
+    derives normally."""
+    import numpy as np
+
+    with _mk_gateway(rng, buckets=(64,), ladder_hold_ticks=1) as gw:
+        gw.warmup()
+        # traffic whose derived ladder would differ from the active one
+        for _ in range(6):
+            gw.query("tied", np.zeros((20, 16), np.float32), timeout=30)
+        with inject(site="gateway.ladder.derive", nth=1,
+                    error="OSError") as plan:
+            assert gw.maybe_swap_ladder() is None
+        assert plan.fired_count("gateway.ladder.derive") == 1
+        snap = gw.stats()
+        assert snap["gateway"]["ladder"]["derive_errors"] == 1
+        assert snap["gateway"]["ladder"]["rungs"] == [64]  # retained
+        # serving was never disturbed, and the retry derives + swaps
+        out = gw.query("tied", np.zeros((2, 16), np.float32), timeout=30)
+        assert out.shape == (2, 32)
+        assert gw.maybe_swap_ladder() is not None
+        assert gw.stats()["gateway"]["ladder"]["swaps"] == 1
+
+
+def test_gateway_ladder_derive_corrupt_snapshot_detected(rng):
+    """``gateway.ladder.derive`` matrix entry (corrupt mode): a
+    bit-flipped snapshot payload is caught by the self-digest — a typed,
+    counted skip, never a garbage ladder — and the active ladder and
+    serving are untouched."""
+    import numpy as np
+
+    with _mk_gateway(rng, buckets=(64,), ladder_hold_ticks=1) as gw:
+        gw.warmup()
+        for _ in range(6):
+            gw.query("tied", np.zeros((20, 16), np.float32), timeout=30)
+        with inject(site="gateway.ladder.derive", nth=1,
+                    mode="corrupt") as plan:
+            assert gw.maybe_swap_ladder() is None
+        assert plan.fired_count("gateway.ladder.derive") == 1
+        snap = gw.stats()
+        assert snap["gateway"]["ladder"]["derive_errors"] == 1
+        assert snap["gateway"]["ladder"]["swaps"] == 0
+        assert snap["gateway"]["ladder"]["rungs"] == [64]  # retained
+        out = gw.query("tied", np.zeros((2, 16), np.float32), timeout=30)
+        assert out.shape == (2, 32)
+
+
 def test_obs_sink_write_corrupt_line_skipped_by_reader(tmp_path):
     """A bit-flipped event line (corrupt-mode fault on the payload) is
     committed but unparseable: the reader skips and counts it, and the

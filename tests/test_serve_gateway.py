@@ -384,6 +384,13 @@ def test_kill_a_replica_drill(int_registry, tmp_path, monkeypatch):
         assert g["shed"].get("scavenger") == 1
         assert "gateway.spare.activate" in report["spans"]
         assert report["spans"]["gateway.spare.activate"]["errors"] == 0
+        # the ladder section reports the active rungs even when no swap
+        # ever ran (ISSUE 20): static ladder, zero swap/derive activity
+        lad = report["ladder"]
+        assert lad["rungs"] == [8]
+        assert lad["swaps"] == 0
+        assert lad["derive_errors"] == 0
+        assert lad["wasted_pad_rows"] >= 0
     finally:
         obs.configure_sink(prev_sink)
         xcache.disable()

@@ -321,6 +321,37 @@ def test_serving_bucket_programs_lower(rng):
                    stacked, jnp.zeros((rows, d)))
 
 
+def test_derived_ladder_rungs_lower(rng):
+    """ISSUE 20 AOT gate: bucket programs at DERIVED rung sizes — which
+    are align-multiples, not powers of two (a skewed mix yields e.g. a
+    24-row rung) — pass the TPU lowering pipeline, so a ladder swap can
+    never hit a Mosaic/XLA shape constraint at warm time."""
+    from sparse_coding_tpu.models import TiedSAE
+    from sparse_coding_tpu.obs.registry import Registry
+    from sparse_coding_tpu.serve.engine import bucket_op_fn
+    from sparse_coding_tpu.serve.ladder import (
+        REQUEST_ROW_BOUNDS,
+        derive_ladder,
+        traffic_snapshot,
+    )
+
+    reg = Registry()
+    hist = reg.histogram("serve.request_rows", bounds=REQUEST_ROW_BOUNDS)
+    for size, count in ((21, 300), (23, 150), (24, 50), (250, 60),
+                        (280, 40)):
+        for _ in range(count):
+            hist.observe(size)
+    ladder = derive_ladder(traffic_snapshot(reg))
+    rungs = ladder["rungs"]
+    assert any(r & (r - 1) for r in rungs)  # a non-power-of-two rung
+    d, n = 32, 64
+    ld = TiedSAE(dictionary=jax.random.normal(rng, (n, d)),
+                 encoder_bias=jnp.zeros(n))
+    for rows in rungs:
+        _lower_tpu(bucket_op_fn("encode"), ld, jnp.zeros((rows, d)))
+        _lower_tpu(bucket_op_fn("decode"), ld, jnp.zeros((rows, n)))
+
+
 def test_catalog_query_programs_lower(rng):
     """ISSUE 16 AOT gate: the catalog query kernels — the batched top-k
     decoder-row similarity program (``neighbors``) and the 2505.16077
